@@ -1,0 +1,16 @@
+"""pampi_tpu — a TPU-native (JAX/XLA/Pallas/shard_map) stencil & linear-algebra
+framework with the capabilities of `alirostami1/practical-parallel-algorithms-with-mpi`.
+
+Built from scratch, TPU-first: fields are JAX arrays sharded over a 1/2/3-D
+`jax.sharding.Mesh`; the reference's MPI halo exchange / ring shifts / Allreduce
+(see /root/reference, e.g. assignment-6/src/comm.h:104-138) become `lax.ppermute`
+and `lax.psum`/`lax.pmax` inside `shard_map`-wrapped, jitted step functions.
+
+Layout (mirrors the layer map in SURVEY.md §1):
+  utils/     L1/L2/L3 — .par config, grid descriptor, timing, progress, .dat/VTK I/O
+  parallel/  L4       — the ten-function Comm API, TPU-native (mesh + ppermute + psum)
+  ops/       L5 math  — stencil sweeps, momentum predictor, BC masks, Pallas kernels
+  models/    L5/L6    — Poisson, NS-2D, NS-3D solvers and DMVM drivers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,81 @@
+"""CLI driver: `python -m pampi_tpu <configFile.par>`.
+
+Parity with the reference's L6 driver convention (`./exe-<TAG> <file.par>`,
+assignment-6/src/main.c:21-110): parse argv -> read .par -> echo config ->
+run solver -> write outputs -> print walltime. Dispatch on the `name` key:
+  poisson           -> 2-D Poisson red-black SOR      (assignment-4)
+  dcavity / canal   -> NS-2D time-stepper             (assignment-5)
+  dcavity3d/canal3d -> NS-3D time-stepper             (assignment-6)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    argv = sys.argv if argv is None else argv
+    if len(argv) < 2:
+        print(f"Usage: {argv[0]} <configFile>")
+        return 0
+
+    from .utils.params import Parameter, read_parameter, print_parameter
+
+    param = read_parameter(argv[1], Parameter())
+
+    if param.tpu_dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    os.environ.setdefault("PAMPI_DTYPE", param.tpu_dtype)
+
+    from .utils.timing import get_timestamp
+
+    print_parameter(param)
+
+    if param.name.startswith("poisson"):
+        from .models.poisson import PoissonSolver
+
+        solver = PoissonSolver(param, problem=2)
+        start = get_timestamp()
+        it, res = solver.solve()
+        end = get_timestamp()
+        # parity: solver prints "%d " (no newline), main appends Walltime
+        print(f"{it} ", end="")
+        solver.write_result("p.dat")
+        print("Walltime %.2fs" % (end - start))
+    elif param.name in ("dcavity", "canal"):
+        try:
+            from .models.ns2d import NS2DSolver
+        except ImportError:
+            print("NS-2D solver not available in this build", file=sys.stderr)
+            return 1
+
+        solver = NS2DSolver(param)
+        start = get_timestamp()
+        solver.run()
+        end = get_timestamp()
+        print("Solution took %.2fs" % (end - start))
+        solver.write_result("pressure.dat", "velocity.dat")
+    elif param.name in ("dcavity3d", "canal3d"):
+        try:
+            from .models.ns3d import NS3DSolver
+        except ImportError:
+            print("NS-3D solver not available in this build", file=sys.stderr)
+            return 1
+
+        solver = NS3DSolver(param)
+        start = get_timestamp()
+        solver.run()
+        end = get_timestamp()
+        print("Solution took %.2fs" % (end - start))
+        solver.write_result()
+    else:
+        print(f"Unknown problem name: {param.name}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+from .poisson import PoissonSolver

@@ -1,0 +1,6 @@
+from .sor import (
+    checkerboard_mask,
+    sor_pass,
+    neumann_bc,
+    residual_all,
+)

@@ -1,0 +1,4 @@
+from .params import Parameter, read_parameter, print_parameter
+from .grid import Grid
+from .timing import get_timestamp, get_time_resolution
+from .progress import Progress

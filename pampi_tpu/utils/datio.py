@@ -1,0 +1,90 @@
+"""Gnuplot-compatible `.dat` writers.
+
+Format parity with the reference's L3 output layer:
+ - write_matrix: the Poisson `p.dat` layout — full array incl. ghost layers,
+   `%f ` per value, one row per j (assignment-4/src/solver.c:301-322).
+ - write_pressure / write_velocity: the NS-2D `pressure.dat` / `velocity.dat`
+   layouts at cell centers, with staggered->center averaging for velocity
+   (assignment-5/sequential/src/solver.c:457-505). Compatible with the
+   committed `surface.plot` / `vector.plot` gnuplot scripts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def write_matrix(p, path: str) -> None:
+    """Write the full (jmax+2, imax+2) array, `%f `-formatted, row per j."""
+    arr = np.asarray(p, dtype=np.float64)
+    with open(path, "w") as fh:
+        for row in arr:
+            fh.write("".join("%f " % v for v in row))
+            fh.write("\n")
+
+
+def read_matrix(path: str) -> np.ndarray:
+    return np.loadtxt(path)
+
+
+def write_pressure(p, dx: float, dy: float, path: str) -> None:
+    """x y p triples at cell centers, blank line between j-rows (gnuplot splot)."""
+    arr = np.asarray(p, dtype=np.float64)
+    jmax, imax = arr.shape[0] - 2, arr.shape[1] - 2
+    with open(path, "w") as fh:
+        for j in range(1, jmax + 1):
+            y = (j - 0.5) * dy
+            for i in range(1, imax + 1):
+                x = (i - 0.5) * dx
+                fh.write("%.2f %.2f %f\n" % (x, y, arr[j, i]))
+            fh.write("\n")
+
+
+def write_velocity(u, v, dx: float, dy: float, path: str) -> None:
+    """x y u v |vel| at cell centers; u,v averaged from staggered faces."""
+    ua = np.asarray(u, dtype=np.float64)
+    va = np.asarray(v, dtype=np.float64)
+    jmax, imax = ua.shape[0] - 2, ua.shape[1] - 2
+    with open(path, "w") as fh:
+        for j in range(1, jmax + 1):
+            y = dy * (j - 0.5)
+            for i in range(1, imax + 1):
+                x = dx * (i - 0.5)
+                uc = (ua[j, i] + ua[j, i - 1]) / 2.0
+                vc = (va[j, i] + va[j - 1, i]) / 2.0
+                ln = np.sqrt(uc * uc + vc * vc)
+                fh.write("%.2f %.2f %f %f %f\n" % (x, y, uc, vc, ln))
+
+
+def read_pressure(path: str) -> np.ndarray:
+    """Read a pressure.dat back into an (jmax, imax) interior array."""
+    rows = []
+    block = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                if block:
+                    rows.append([v for _, _, v in block])
+                    block = []
+                continue
+            x, y, v = line.split()
+            block.append((float(x), float(y), float(v)))
+    if block:
+        rows.append([v for _, _, v in block])
+    return np.array(rows)
+
+
+def read_velocity(path: str):
+    """Read velocity.dat -> (u_center, v_center) arrays of shape (jmax, imax).
+
+    imax is inferred from where x resets to the start of a new j-row (x is
+    non-decreasing within a row even under %.2f rounding collisions)."""
+    data = np.loadtxt(path)
+    x = data[:, 0]
+    resets = np.where(np.diff(x) < 0)[0]
+    imax = int(resets[0]) + 1 if len(resets) else data.shape[0]
+    jmax = data.shape[0] // imax
+    u = data[:, 2].reshape(jmax, imax)
+    v = data[:, 3].reshape(jmax, imax)
+    return u, v

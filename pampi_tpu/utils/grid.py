@@ -1,0 +1,31 @@
+"""Grid descriptor (parity: /root/reference/assignment-6/src/grid.h:149-153)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Grid:
+    imax: int
+    jmax: int
+    kmax: int = 1
+    xlength: float = 1.0
+    ylength: float = 1.0
+    zlength: float = 1.0
+
+    @property
+    def dx(self) -> float:
+        return self.xlength / self.imax
+
+    @property
+    def dy(self) -> float:
+        return self.ylength / self.jmax
+
+    @property
+    def dz(self) -> float:
+        return self.zlength / self.kmax
+
+    @property
+    def ndim(self) -> int:
+        return 2 if self.kmax <= 1 else 3

@@ -1,0 +1,130 @@
+"""Run-time configuration: the `.par` key-value file format.
+
+Capability parity with the reference's L2 config layer (`parameter.{h,c}` in
+assignments 4/5/6; see /root/reference/assignment-6/src/parameter.c:15-126):
+`#` starts a comment, first whitespace token is the key, second is the value,
+keys are matched by *prefix* (the reference uses `strncmp(tok, key, strlen(key))`,
+so a token `imaxFoo` still sets `imax` — we keep that tolerance), unknown keys
+are silently ignored, and every known key has a default.
+
+The parameter set is the union of all assignments:
+  A4  {xlength ylength imax jmax itermax eps omg levels presmooth postsmooth}
+  A5 += {re tau gamma dt te gx gy name bcLeft/Right/Bottom/Top u_init v_init p_init}
+  A6 += {zlength kmax gz bcFront bcBack w_init}
+plus framework-only keys (prefixed `tpu_`) controlling the TPU execution:
+  tpu_mesh   "PY PX" / "PZ PY PX"  device-mesh shape ("auto" = factorize like
+             MPI_Dims_create, ref assignment-5/ex5-nazifkar/src/solver.c:445)
+  tpu_dtype  "float32" | "float64" | "bfloat16"
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from dataclasses import dataclass
+
+
+@dataclass
+class Parameter:
+    # geometry
+    xlength: float = 1.0
+    ylength: float = 1.0
+    zlength: float = 1.0
+    imax: int = 100
+    jmax: int = 100
+    kmax: int = 50
+    # pressure iteration
+    itermax: int = 1000
+    eps: float = 0.0001
+    omg: float = 1.7
+    rho: float = 0.99  # multigrid/extension reserve (unused by reference solvers)
+    # flow
+    re: float = 100.0
+    tau: float = 0.5
+    gamma: float = 0.9
+    dt: float = 0.02
+    te: float = 10.0
+    gx: float = 0.0
+    gy: float = 0.0
+    gz: float = 0.0
+    name: str = "poisson"
+    bcLeft: int = 1
+    bcRight: int = 1
+    bcBottom: int = 1
+    bcTop: int = 1
+    bcFront: int = 1
+    bcBack: int = 1
+    u_init: float = 0.0
+    v_init: float = 0.0
+    w_init: float = 0.0
+    p_init: float = 0.0
+    # framework-only (TPU execution controls; not in the reference)
+    tpu_mesh: str = "auto"
+    tpu_dtype: str = "float64"
+
+    def replace(self, **kw) -> "Parameter":
+        return dataclasses.replace(self, **kw)
+
+
+_FIELDS = {f.name: f.type for f in dataclasses.fields(Parameter)}
+_CASTS = {"int": int, "float": float, "str": str}
+
+
+def _parse_line(line: str):
+    line = line.split("#", 1)[0]
+    toks = line.split()
+    if len(toks) < 2:
+        return None
+    return toks[0], toks[1]
+
+
+def read_parameter(path: str, base: Parameter | None = None) -> Parameter:
+    """Parse a .par file. Prefix-match keys like the reference parser does."""
+    param = dataclasses.replace(base) if base is not None else Parameter()
+    try:
+        fh = open(path)
+    except OSError:
+        print(f"Could not open parameter file: {path}", file=sys.stderr)
+        raise SystemExit(1)
+    with fh:
+        for raw in fh:
+            kv = _parse_line(raw)
+            if kv is None:
+                continue
+            tok, val = kv
+            # reference semantics: every known key whose name is a prefix of the
+            # token gets assigned (independent `if`s, not elif)
+            for key, ftype in _FIELDS.items():
+                if tok.startswith(key):
+                    cast = _CASTS[ftype if isinstance(ftype, str) else ftype.__name__]
+                    try:
+                        setattr(param, key, cast(val))
+                    except ValueError:
+                        print(
+                            f"bad value {val!r} for parameter {key}", file=sys.stderr
+                        )
+                        raise SystemExit(1)
+    return param
+
+
+def print_parameter(p: Parameter, out=sys.stdout) -> None:
+    """Echo the configuration (parity: printParameter, parameter.c:95-126)."""
+    w = out.write
+    w(f"Parameters for {p.name}\n")
+    w(
+        "Boundary conditions Left:%d Right:%d Bottom:%d Top:%d\n"
+        % (p.bcLeft, p.bcRight, p.bcBottom, p.bcTop)
+    )
+    w("\tReynolds number: %.2f\n" % p.re)
+    w("\tInit arrays: U:%.2f V:%.2f P:%.2f\n" % (p.u_init, p.v_init, p.p_init))
+    w("Geometry data:\n")
+    w("\tDomain box size (x, y): %.2f, %.2f\n" % (p.xlength, p.ylength))
+    w("\tCells (x, y): %d, %d\n" % (p.imax, p.jmax))
+    w("Timestep parameters:\n")
+    w("\tDefault stepsize: %.2f, Final time %.2f\n" % (p.dt, p.te))
+    w("\tTau factor: %.2f\n" % p.tau)
+    w("Iterative solver parameters:\n")
+    w("\tMax iterations: %d\n" % p.itermax)
+    w("\tepsilon (stopping tolerance) : %f\n" % p.eps)
+    w("\tgamma factor: %f\n" % p.gamma)
+    w("\tomega (SOR relaxation): %f\n" % p.omg)

@@ -1,0 +1,38 @@
+"""dtype policy: the `tpu_dtype` .par key selects the compute precision.
+
+The reference is double everywhere (C99 `double`); on TPU the native fast path
+is float32 (VPU) / bfloat16 (MXU), and float64 is software-emulated. Solvers
+default to the .par's `tpu_dtype`; float64 requires jax_enable_x64 (the CLI
+turns it on when requested)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_DTYPES = {
+    "float64": jnp.float64,
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "f64": jnp.float64,
+    "f32": jnp.float32,
+    "bf16": jnp.bfloat16,
+}
+
+
+def resolve_dtype(name: str):
+    try:
+        dt = _DTYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown tpu_dtype {name!r}; expected one of {sorted(_DTYPES)}"
+        )
+    if dt == jnp.float64 and not jax.config.jax_enable_x64:
+        # requested double but x64 is off — fall back loudly
+        import warnings
+
+        warnings.warn(
+            "tpu_dtype float64 requested but jax_enable_x64 is off; using float32"
+        )
+        return jnp.float32
+    return dt

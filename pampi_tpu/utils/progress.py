@@ -1,0 +1,35 @@
+"""10-segment progress bar over simulated time.
+
+Parity: initProgress/printProgress/stopProgress
+(/root/reference/assignment-6/src/progress.c:17-50) — a `\r`-redrawn
+`[####      ]` bar that fills as t approaches te. Only redraws when the
+integer decile changes.
+"""
+
+import sys
+
+
+class Progress:
+    def __init__(self, end: float, out=sys.stdout, enabled: bool = True):
+        self._end = end
+        self._current = 0
+        self._out = out
+        self._enabled = enabled
+        if enabled:
+            out.write("[          ]")
+            out.flush()
+
+    def update(self, current: float) -> None:
+        if not self._enabled:
+            return
+        new = int(round((current / self._end) * 10.0))
+        if new > self._current:
+            self._current = new
+            bar = "#" * min(new, 10) + " " * max(10 - new, 0)
+            self._out.write(f"\r[{bar}]")
+        self._out.flush()
+
+    def stop(self) -> None:
+        if self._enabled:
+            self._out.write("\n")
+            self._out.flush()

@@ -1,0 +1,27 @@
+"""Monotonic wall-clock timing (parity: assignment-4/src/timing.c:60-72).
+
+The reference wraps CLOCK_MONOTONIC; Python's time.monotonic() is the same
+clock. MPI mains use MPI_Wtime — also monotonic wall-clock.
+"""
+
+import time
+
+
+def get_timestamp() -> float:
+    return time.monotonic()
+
+
+def get_time_resolution() -> float:
+    return time.get_clock_info("monotonic").resolution
+
+
+class Timer:
+    """Context-manager convenience over get_timestamp()."""
+
+    def __enter__(self):
+        self.start = get_timestamp()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = get_timestamp() - self.start
+        return False

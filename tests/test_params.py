@@ -1,0 +1,60 @@
+"""Config-layer tests: .par parsing parity with the reference's strncmp-prefix
+parser (assignment-5/sequential/src/parameter.c:29-86)."""
+
+import pathlib
+
+from pampi_tpu.utils.params import Parameter, read_parameter
+
+
+def test_defaults():
+    p = Parameter()
+    assert p.imax == 100 and p.jmax == 100
+    assert p.omg == 1.7 and p.eps == 0.0001
+
+
+def test_parse_reference_poisson_par(reference_dir):
+    p = read_parameter(str(reference_dir / "assignment-4" / "poisson.par"))
+    assert p.name == "poisson"
+    assert p.imax == 100 and p.jmax == 100
+    assert p.itermax == 1000000
+    assert p.eps == 1e-6
+    assert p.omg == 1.9
+    assert p.xlength == 1.0 and p.ylength == 1.0
+
+
+def test_parse_reference_dcavity_par(reference_dir):
+    p = read_parameter(
+        str(reference_dir / "assignment-5" / "sequential" / "dcavity.par")
+    )
+    assert p.name == "dcavity"
+    assert p.bcTop == p.bcBottom == p.bcLeft == p.bcRight == 1
+    assert p.re == 10.0
+    assert p.te == 10.0 and p.dt == 0.02 and p.tau == 0.5
+    assert p.itermax == 1000 and p.eps == 0.001 and p.omg == 1.8
+    assert p.gamma == 0.9
+    assert p.u_init == 0.0 and p.v_init == 0.0 and p.p_init == 0.0
+
+
+def test_parse_reference_canal_par(reference_dir):
+    p = read_parameter(str(reference_dir / "assignment-5" / "sequential" / "canal.par"))
+    assert p.name == "canal"
+    assert p.bcLeft == 3 and p.bcRight == 3
+    assert p.xlength == 30.0 and p.ylength == 4.0
+    assert p.imax == 200 and p.jmax == 50
+    assert p.u_init == 1.0
+
+
+def test_prefix_match(tmp_path):
+    # reference semantics: strncmp prefix match — `imaxFoo 7` still sets imax
+    f = tmp_path / "t.par"
+    f.write_text("imaxFoo 7\nunknownKey 3\n# comment imax 9\neps 0.5 # trail\n")
+    p = read_parameter(str(f))
+    assert p.imax == 7
+    assert p.eps == 0.5
+
+
+def test_comments_and_blank_lines(tmp_path):
+    f = tmp_path / "t.par"
+    f.write_text("\n\n# full comment\nomg 1.5\t# inline\n\n")
+    p = read_parameter(str(f))
+    assert p.omg == 1.5
