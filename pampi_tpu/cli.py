@@ -19,6 +19,10 @@ def main(argv=None) -> int:
     if len(argv) < 2:
         print(f"Usage: {argv[0]} <configFile>")
         return 0
+    return _run(argv)
+
+
+def _run(argv) -> int:
 
     from .utils.params import Parameter, read_parameter, print_parameter
 
@@ -35,9 +39,32 @@ def main(argv=None) -> int:
     print_parameter(param)
 
     if param.name.startswith("poisson"):
+        import jax
+
         from .models.poisson import PoissonSolver
 
-        solver = PoissonSolver(param, problem=2)
+        ndev = len(jax.devices())
+        dims = (
+            None
+            if param.tpu_mesh == "auto"
+            else tuple(int(t) for t in param.tpu_mesh.split("x"))
+        )
+        single = ndev == 1 or (dims is not None and all(d == 1 for d in dims))
+        # config errors (bad mesh shape, indivisible grid) get a clean
+        # one-line report; solver-internal errors keep their traceback
+        try:
+            if single:
+                solver = PoissonSolver(param, problem=2)
+            else:
+                from .models.poisson_dist import DistPoissonSolver
+                from .parallel.comm import CartComm
+
+                comm = CartComm(ndims=2, dims=dims)
+                comm.print_config()
+                solver = DistPoissonSolver(param, comm, problem=2)
+        except ValueError as exc:
+            print(f"Error: {exc}", file=sys.stderr)
+            return 1
         start = get_timestamp()
         it, res = solver.solve()
         end = get_timestamp()

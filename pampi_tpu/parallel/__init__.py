@@ -1,0 +1,10 @@
+from .comm import (
+    CartComm,
+    dims_create,
+    halo_exchange,
+    halo_shift,
+    reduction,
+    is_boundary,
+    axis_coord,
+    get_offsets,
+)

@@ -12,8 +12,9 @@ The parameter set is the union of all assignments:
   A5 += {re tau gamma dt te gx gy name bcLeft/Right/Bottom/Top u_init v_init p_init}
   A6 += {zlength kmax gz bcFront bcBack w_init}
 plus framework-only keys (prefixed `tpu_`) controlling the TPU execution:
-  tpu_mesh   "PY PX" / "PZ PY PX"  device-mesh shape ("auto" = factorize like
-             MPI_Dims_create, ref assignment-5/ex5-nazifkar/src/solver.c:445)
+  tpu_mesh   "PJxPI" / "PKxPJxPI" device-mesh shape, "auto" (factorize like
+             MPI_Dims_create, ref assignment-5/ex5-nazifkar/src/solver.c:445),
+             or "1" (force single-device)
   tpu_dtype  "float32" | "float64" | "bfloat16"
 """
 
