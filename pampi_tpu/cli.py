@@ -22,6 +22,36 @@ def main(argv=None) -> int:
     return _run(argv)
 
 
+def _make_comm(param, ndims: int):
+    """Resolve the tpu_mesh key to a CartComm, or None for single-device
+    (the ≙ of ENABLE_MPI=false: same solver API, one process, comm.c:470-488)."""
+    import jax
+
+    ndev = len(jax.devices())
+    dims = (
+        None
+        if param.tpu_mesh == "auto"
+        else tuple(int(t) for t in param.tpu_mesh.split("x"))
+    )
+    if ndev == 1 or (dims is not None and all(d == 1 for d in dims)):
+        return None
+    from .parallel.comm import CartComm
+
+    comm = CartComm(ndims=ndims, dims=dims)
+    comm.print_config()
+    return comm
+
+
+def _try_build(build):
+    """Config errors (bad mesh shape, indivisible grid) get a clean one-line
+    report; solver-internal errors keep their traceback."""
+    try:
+        return build()
+    except ValueError as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        return None
+
+
 def _run(argv) -> int:
 
     from .utils.params import Parameter, read_parameter, print_parameter
@@ -39,31 +69,18 @@ def _run(argv) -> int:
     print_parameter(param)
 
     if param.name.startswith("poisson"):
-        import jax
-
         from .models.poisson import PoissonSolver
 
-        ndev = len(jax.devices())
-        dims = (
-            None
-            if param.tpu_mesh == "auto"
-            else tuple(int(t) for t in param.tpu_mesh.split("x"))
-        )
-        single = ndev == 1 or (dims is not None and all(d == 1 for d in dims))
-        # config errors (bad mesh shape, indivisible grid) get a clean
-        # one-line report; solver-internal errors keep their traceback
-        try:
-            if single:
-                solver = PoissonSolver(param, problem=2)
-            else:
-                from .models.poisson_dist import DistPoissonSolver
-                from .parallel.comm import CartComm
+        def build():
+            comm = _make_comm(param, ndims=2)
+            if comm is None:
+                return PoissonSolver(param, problem=2)
+            from .models.poisson_dist import DistPoissonSolver
 
-                comm = CartComm(ndims=2, dims=dims)
-                comm.print_config()
-                solver = DistPoissonSolver(param, comm, problem=2)
-        except ValueError as exc:
-            print(f"Error: {exc}", file=sys.stderr)
+            return DistPoissonSolver(param, comm, problem=2)
+
+        solver = _try_build(build)
+        if solver is None:
             return 1
         start = get_timestamp()
         it, res = solver.solve()
@@ -73,13 +90,19 @@ def _run(argv) -> int:
         solver.write_result("p.dat")
         print("Walltime %.2fs" % (end - start))
     elif param.name in ("dcavity", "canal"):
-        try:
-            from .models.ns2d import NS2DSolver
-        except ImportError:
-            print("NS-2D solver not available in this build", file=sys.stderr)
-            return 1
+        def build():
+            comm = _make_comm(param, ndims=2)
+            if comm is None:
+                from .models.ns2d import NS2DSolver
 
-        solver = NS2DSolver(param)
+                return NS2DSolver(param)
+            from .models.ns2d_dist import NS2DDistSolver
+
+            return NS2DDistSolver(param, comm)
+
+        solver = _try_build(build)
+        if solver is None:
+            return 1
         start = get_timestamp()
         solver.run()
         end = get_timestamp()

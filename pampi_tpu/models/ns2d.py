@@ -1,0 +1,179 @@
+"""NS-2D incompressible Navier-Stokes time-stepper (lid-driven cavity, canal).
+
+Capability parity with /root/reference/assignment-5/sequential — the full
+pipeline of SURVEY.md §3.5: computeTimestep → setBoundaryConditions →
+setSpecialBoundaryCondition → computeFG → computeRHS → (nt%100==0)
+normalizePressure → solve → adaptUV, advancing t += dt while t <= te
+(main.c:43-60).
+
+TPU-first design:
+- One timestep is a single traced function; the pressure solve inside it is
+  the same red-black `lax.while_loop` used by the Poisson model (equivalence
+  policy documented there — the reference's lexicographic SOR trajectory is
+  matched at the converged-residual level, not sweep-by-sweep).
+- The time loop itself runs ON DEVICE in chunks of `chunk` steps (a
+  `lax.while_loop` whose cond is `t <= te && k < chunk`), so the host syncs
+  once per chunk — not once per step — and XLA overlaps everything else.
+  Progress is reported at chunk granularity (progress.c parity).
+- tau > 0 (adaptive CFL) vs constant-dt is a trace-time branch, like the
+  reference's `if (tau > 0)` (main.c:44).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops import ns2d as ops
+from ..ops.sor import checkerboard_mask, neumann_bc, sor_pass
+from ..utils.datio import write_pressure, write_velocity
+from ..utils.params import Parameter
+from ..utils.precision import resolve_dtype
+from ..utils.progress import Progress
+
+
+def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype):
+    """Pressure-Poisson red-black SOR loop (solve, solver.c:140-191): carry
+    (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep."""
+    dx2, dy2 = dx * dx, dy * dy
+    idx2, idy2 = 1.0 / dx2, 1.0 / dy2
+    factor = omega * 0.5 * (dx2 * dy2) / (dx2 + dy2)
+    red = checkerboard_mask(jmax, imax, 0, dtype)
+    black = checkerboard_mask(jmax, imax, 1, dtype)
+    norm = float(imax * jmax)
+    epssq = eps * eps
+
+    def solve(p, rhs):
+        def cond(c):
+            _, res, it = c
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(c):
+            p, _, it = c
+            p, r0 = sor_pass(p, rhs, red, factor, idx2, idy2)
+            p, r1 = sor_pass(p, rhs, black, factor, idx2, idy2)
+            p = neumann_bc(p)
+            return p, (r0 + r1) / norm, it + 1
+
+        p, res, it = lax.while_loop(
+            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        )
+        return p, res, it
+
+    return solve
+
+
+class NS2DSolver:
+    """Driver-facing NS-2D solver (≙ the Solver struct + main loop)."""
+
+    CHUNK = 64  # device steps per host sync
+
+    def __init__(self, param: Parameter, dtype=None):
+        if dtype is None:
+            dtype = resolve_dtype(param.tpu_dtype)
+        self.param = param
+        self.dtype = dtype
+        self.imax, self.jmax = param.imax, param.jmax
+        self.dx = param.xlength / param.imax
+        self.dy = param.ylength / param.jmax
+        shape = (param.jmax + 2, param.imax + 2)
+        self.u = jnp.full(shape, param.u_init, dtype)
+        self.v = jnp.full(shape, param.v_init, dtype)
+        self.p = jnp.full(shape, param.p_init, dtype)
+        inv_sqr_sum = 1.0 / (self.dx * self.dx) + 1.0 / (self.dy * self.dy)
+        self.dt_bound = 0.5 * param.re / inv_sqr_sum
+        self.t = 0.0
+        self.nt = 0
+        self._chunk_fn = jax.jit(self._build_chunk())
+
+    # -- one full timestep, traced ------------------------------------
+    def _build_step(self):
+        param = self.param
+        dx, dy = self.dx, self.dy
+        dtype = self.dtype
+        solve = make_pressure_solve(
+            param.imax,
+            param.jmax,
+            dx,
+            dy,
+            param.omg,
+            param.eps,
+            param.itermax,
+            dtype,
+        )
+        adaptive = param.tau > 0.0
+        problem = param.name
+
+        def step(u, v, p, t, nt):
+            if adaptive:
+                dt = ops.compute_timestep(u, v, self.dt_bound, dx, dy, param.tau)
+            else:
+                dt = jnp.asarray(param.dt, dtype)
+            u, v = ops.set_boundary_conditions(
+                u, v, param.bcLeft, param.bcRight, param.bcBottom, param.bcTop
+            )
+            if problem == "dcavity":
+                u = ops.set_special_bc_dcavity(u)
+            elif problem == "canal":
+                u = ops.set_special_bc_canal(u, dy, param.ylength, dtype)
+            f, g = ops.compute_fg(
+                u, v, dt, param.re, param.gx, param.gy, param.gamma, dx, dy
+            )
+            rhs = ops.compute_rhs(f, g, dt, dx, dy)
+            p = lax.cond(nt % 100 == 0, ops.normalize_pressure, lambda q: q, p)
+            p, _res, _it = solve(p, rhs)
+            u, v = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
+            # t accumulates in high precision regardless of the field dtype
+            # (bfloat16 would stall t once ulp/2 > dt and never reach te)
+            time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            return u, v, p, t + dt.astype(time_dtype), nt + 1
+
+        return step
+
+    def _build_chunk(self):
+        step = self._build_step()
+        te = self.param.te
+        chunk = self.CHUNK
+
+        def chunk_fn(u, v, p, t, nt):
+            def cond(c):
+                _, _, _, t, _, k = c
+                return jnp.logical_and(t <= te, k < chunk)
+
+            def body(c):
+                u, v, p, t, nt, k = c
+                u, v, p, t, nt = step(u, v, p, t, nt)
+                return u, v, p, t, nt, k + 1
+
+            u, v, p, t, nt, _ = lax.while_loop(
+                cond, body, (u, v, p, t, nt, jnp.asarray(0, jnp.int32))
+            )
+            return u, v, p, t, nt
+
+        return chunk_fn
+
+    # -- driver API ----------------------------------------------------
+    def run(self, progress: bool = True) -> None:
+        """Advance from t to te (main.c:43-60 loop semantics: a step runs
+        whenever t <= te at its start)."""
+        bar = Progress(self.param.te, enabled=progress)
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        t = jnp.asarray(self.t, time_dtype)
+        nt = jnp.asarray(self.nt, jnp.int32)
+        u, v, p = self.u, self.v, self.p
+        while float(t) <= self.param.te:
+            u, v, p, t, nt = self._chunk_fn(u, v, p, t, nt)
+            bar.update(float(t))
+        bar.stop()
+        self.u, self.v, self.p = u, v, p
+        self.t, self.nt = float(t), int(nt)
+
+    def write_result(
+        self, pressure_path: str = "pressure.dat", velocity_path: str = "velocity.dat"
+    ) -> None:
+        write_pressure(np.asarray(self.p), self.dx, self.dy, pressure_path)
+        write_velocity(
+            np.asarray(self.u), np.asarray(self.v), self.dx, self.dy, velocity_path
+        )

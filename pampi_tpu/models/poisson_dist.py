@@ -33,39 +33,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.comm import (
-    CartComm,
-    get_offsets,
-    halo_exchange,
-    is_boundary,
-    reduction,
-)
+from ..parallel.comm import CartComm, get_offsets, halo_exchange, reduction
+from ..parallel.stencil2d import global_checkerboard_masks, neumann_walls
 from ..ops.sor import sor_pass
 from ..utils.datio import write_matrix
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
 
 PI = math.pi
-
-
-def _ext_neumann_on_walls(p, comm: CartComm):
-    """Homogeneous-Neumann ghost copy, applied only on shards owning a wall
-    (parity: the four ghost-copy loops, assignment-4/src/solver.c:157-165)."""
-    Pj = comm.axis_size("j")
-    Pi = comm.axis_size("i")
-    p = p.at[0, 1:-1].set(
-        jnp.where(is_boundary("j", Pj, "lo"), p[1, 1:-1], p[0, 1:-1])
-    )
-    p = p.at[-1, 1:-1].set(
-        jnp.where(is_boundary("j", Pj, "hi"), p[-2, 1:-1], p[-1, 1:-1])
-    )
-    p = p.at[1:-1, 0].set(
-        jnp.where(is_boundary("i", Pi, "lo"), p[1:-1, 1], p[1:-1, 0])
-    )
-    p = p.at[1:-1, -1].set(
-        jnp.where(is_boundary("i", Pi, "hi"), p[1:-1, -2], p[1:-1, -1])
-    )
-    return p
 
 
 class DistPoissonSolver:
@@ -140,13 +115,6 @@ class DistPoissonSolver:
             )
             return jnp.broadcast_to(row[None, :], (jl + 2, il + 2)).astype(dtype)
 
-        def masks():
-            joff, ioff = offsets()
-            jj = jnp.arange(1, jl + 1, dtype=jnp.int32)[:, None] + joff
-            ii = jnp.arange(1, il + 1, dtype=jnp.int32)[None, :] + ioff
-            par = (ii + jj) % 2
-            return (par == 0).astype(dtype), (par == 1).astype(dtype)
-
         def half_sweep(p, rhs, mask):
             return sor_pass(p, rhs, mask, factor, idx2, idy2)
 
@@ -160,9 +128,9 @@ class DistPoissonSolver:
             copy of the interior."""
             p = analytic_ext().at[1:-1, 1:-1].set(p_int)
             if not first:
-                p = _ext_neumann_on_walls(p, comm)
+                p = neumann_walls(p, comm)
             rhs = rhs_kernel()
-            red, black = masks()
+            red, black = global_checkerboard_masks(jl, il, dtype)
 
             def cond(carry):
                 _, res, it = carry
@@ -174,7 +142,7 @@ class DistPoissonSolver:
                 p, r0 = half_sweep(p, rhs, red)
                 p = halo_exchange(p, comm)
                 p, r1 = half_sweep(p, rhs, black)
-                p = _ext_neumann_on_walls(p, comm)
+                p = neumann_walls(p, comm)
                 res = reduction(r0 + r1, comm, "sum") / norm
                 return p, res, it + 1
 

@@ -1,0 +1,200 @@
+"""NS-2D staggered-grid ops: momentum predictor, boundary conditions, CFL
+timestep, projection — branch-free and fully vectorized for TPU.
+
+Capability parity with the reference's sequential nusif-solver
+(/root/reference/assignment-5/sequential/src/solver.c), the numerical ground
+truth for every distributed variant (SURVEY.md §3.5). Each function cites the
+reference routine whose arithmetic it reproduces. Arrays are (jmax+2, imax+2),
+layout [j, i]; u lives on east faces, v on north faces, p at centers (the
+reference's staggered layout).
+
+TPU-first design notes:
+- The reference's per-cell double loops become whole-interior slice algebra;
+  XLA fuses the ~30-term F/G predictor into one pass over u/v.
+- The 4-kind × 4-wall BC switch ladders (solver.c:236-337) are dispatched at
+  TRACE time (bc kinds are static config), so the compiled step has zero
+  control flow — each wall is a fixed strip update.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
+
+
+def compute_fg(u, v, dt, re, gx, gy, gamma, dx, dy):
+    """Momentum predictor F,G (computeFG, solver.c:360-435) INCLUDING the
+    wall fixups — the single-device composition."""
+    f, g = compute_fg_interior(u, v, dt, re, gx, gy, gamma, dx, dy)
+    return apply_fg_wall_fixups(f, g, u, v)
+
+
+def compute_fg_interior(u, v, dt, re, gx, gy, gamma, dx, dy):
+    """Momentum predictor interior only (computeFG, solver.c:360-423): central
+    + γ-blended donor-cell convective fluxes, viscous Laplacian, body force.
+    Distributed callers gate the wall fixups to wall-owning shards (an ungated
+    local fixup would clobber F/G at interior shard edges)."""
+    idx, idy = 1.0 / dx, 1.0 / dy
+    inv_re = 1.0 / re
+
+    uc = u[1:-1, 1:-1]
+    ue = u[1:-1, 2:]
+    uw = u[1:-1, :-2]
+    un = u[2:, 1:-1]
+    us = u[:-2, 1:-1]
+    unw = u[2:, :-2]
+    vc = v[1:-1, 1:-1]
+    ve = v[1:-1, 2:]
+    vw = v[1:-1, :-2]
+    vn = v[2:, 1:-1]
+    vs = v[:-2, 1:-1]
+    vse = v[:-2, 2:]
+
+    du2dx = idx * 0.25 * (
+        (uc + ue) * (uc + ue) - (uc + uw) * (uc + uw)
+    ) + gamma * idx * 0.25 * (
+        jnp.abs(uc + ue) * (uc - ue) + jnp.abs(uc + uw) * (uc - uw)
+    )
+    duvdy = idy * 0.25 * (
+        (vc + ve) * (uc + un) - (vs + vse) * (uc + us)
+    ) + gamma * idy * 0.25 * (
+        jnp.abs(vc + ve) * (uc - un) + jnp.abs(vs + vse) * (uc - us)
+    )
+    lap_u = idx * idx * (ue - 2.0 * uc + uw) + idy * idy * (un - 2.0 * uc + us)
+    f_int = uc + dt * (inv_re * lap_u - du2dx - duvdy + gx)
+
+    duvdx = idx * 0.25 * (
+        (uc + un) * (vc + ve) - (uw + unw) * (vc + vw)
+    ) + gamma * idx * 0.25 * (
+        jnp.abs(uc + un) * (vc - ve) + jnp.abs(uw + unw) * (vc - vw)
+    )
+    dv2dy = idy * 0.25 * (
+        (vc + vn) * (vc + vn) - (vc + vs) * (vc + vs)
+    ) + gamma * idy * 0.25 * (
+        jnp.abs(vc + vn) * (vc - vn) + jnp.abs(vc + vs) * (vc - vs)
+    )
+    lap_v = idx * idx * (ve - 2.0 * vc + vw) + idy * idy * (vn - 2.0 * vc + vs)
+    g_int = vc + dt * (inv_re * lap_v - duvdx - dv2dy + gy)
+
+    f = jnp.zeros_like(u).at[1:-1, 1:-1].set(f_int)
+    g = jnp.zeros_like(v).at[1:-1, 1:-1].set(g_int)
+    return f, g
+
+
+def apply_fg_wall_fixups(f, g, u, v):
+    """Wall fixups: F carries U on vertical walls, G carries V on horizontal
+    walls (solver.c:425-435)."""
+    f = f.at[1:-1, 0].set(u[1:-1, 0])
+    f = f.at[1:-1, -2].set(u[1:-1, -2])
+    g = g.at[0, 1:-1].set(v[0, 1:-1])
+    g = g.at[-2, 1:-1].set(v[-2, 1:-1])
+    return f, g
+
+
+def compute_rhs(f, g, dt, dx, dy):
+    """Pressure-Poisson RHS = div(F,G)/dt (computeRHS, solver.c:122-138)."""
+    rhs_int = (1.0 / dt) * (
+        (f[1:-1, 1:-1] - f[1:-1, :-2]) / dx + (g[1:-1, 1:-1] - g[:-2, 1:-1]) / dy
+    )
+    return jnp.zeros_like(f).at[1:-1, 1:-1].set(rhs_int)
+
+
+def adapt_uv(u, v, f, g, p, dt, dx, dy):
+    """Projection / velocity correction (adaptUV, solver.c:438-455)."""
+    fx = dt / dx
+    fy = dt / dy
+    u = u.at[1:-1, 1:-1].set(
+        f[1:-1, 1:-1] - (p[1:-1, 2:] - p[1:-1, 1:-1]) * fx
+    )
+    v = v.at[1:-1, 1:-1].set(
+        g[1:-1, 1:-1] - (p[2:, 1:-1] - p[1:-1, 1:-1]) * fy
+    )
+    return u, v
+
+
+def set_boundary_conditions(u, v, bc_left, bc_right, bc_bottom, bc_top):
+    """Wall BCs on ghost/wall strips (setBoundaryConditions, solver.c:236-337).
+    bc kinds are static config ⇒ resolved at trace time. PERIODIC is a no-op,
+    exactly as in the reference."""
+    # left wall: U(0,j) is ON the wall, V(0,j) is a ghost
+    if bc_left == NOSLIP:
+        u = u.at[1:-1, 0].set(0.0)
+        v = v.at[1:-1, 0].set(-v[1:-1, 1])
+    elif bc_left == SLIP:
+        u = u.at[1:-1, 0].set(0.0)
+        v = v.at[1:-1, 0].set(v[1:-1, 1])
+    elif bc_left == OUTFLOW:
+        u = u.at[1:-1, 0].set(u[1:-1, 1])
+        v = v.at[1:-1, 0].set(v[1:-1, 1])
+    # right wall: U(imax,j) is on the wall (an interior column!), V(imax+1,j) ghost
+    if bc_right == NOSLIP:
+        u = u.at[1:-1, -2].set(0.0)
+        v = v.at[1:-1, -1].set(-v[1:-1, -2])
+    elif bc_right == SLIP:
+        u = u.at[1:-1, -2].set(0.0)
+        v = v.at[1:-1, -1].set(v[1:-1, -2])
+    elif bc_right == OUTFLOW:
+        u = u.at[1:-1, -2].set(u[1:-1, -3])
+        v = v.at[1:-1, -1].set(v[1:-1, -2])
+    # bottom wall: V(i,0) on the wall, U(i,0) ghost
+    if bc_bottom == NOSLIP:
+        v = v.at[0, 1:-1].set(0.0)
+        u = u.at[0, 1:-1].set(-u[1, 1:-1])
+    elif bc_bottom == SLIP:
+        v = v.at[0, 1:-1].set(0.0)
+        u = u.at[0, 1:-1].set(u[1, 1:-1])
+    elif bc_bottom == OUTFLOW:
+        u = u.at[0, 1:-1].set(u[1, 1:-1])
+        v = v.at[0, 1:-1].set(v[1, 1:-1])
+    # top wall: V(i,jmax) on the wall, U(i,jmax+1) ghost
+    if bc_top == NOSLIP:
+        v = v.at[-2, 1:-1].set(0.0)
+        u = u.at[-1, 1:-1].set(-u[-2, 1:-1])
+    elif bc_top == SLIP:
+        v = v.at[-2, 1:-1].set(0.0)
+        u = u.at[-1, 1:-1].set(u[-2, 1:-1])
+    elif bc_top == OUTFLOW:
+        u = u.at[-1, 1:-1].set(u[-2, 1:-1])
+        v = v.at[-2, 1:-1].set(v[-3, 1:-1])
+    return u, v
+
+
+def set_special_bc_dcavity(u):
+    """Lid U(i,jmax+1) = 2 - U(i,jmax) for i in 1..imax-1 — the reference
+    skips the last interior i (solver.c:345-349, a documented quirk we
+    replicate for trajectory parity)."""
+    return u.at[-1, 1:-2].set(2.0 - u[-2, 1:-2])
+
+
+def set_special_bc_canal(u, dy, ylength, dtype):
+    """Parabolic inflow U(0,j) = y(ylength−y)·4/ylength² (solver.c:350-357)."""
+    jmax = u.shape[0] - 2
+    y = (jnp.arange(1, jmax + 1, dtype=dtype) - 0.5) * dy
+    prof = y * (ylength - y) * 4.0 / (ylength * ylength)
+    return u.at[1:-1, 0].set(prof)
+
+
+def max_element(m):
+    """max |m| over the FULL array incl. ghosts — the reference's maxElement
+    scans ghost cells too (solver.c:193-202, documented quirk, replicated)."""
+    return jnp.max(jnp.abs(m))
+
+
+def compute_timestep(u, v, dt_bound, dx, dy, tau):
+    """Adaptive CFL timestep (computeTimestep, solver.c:219-234)."""
+    umax = max_element(u)
+    vmax = max_element(v)
+    inf = jnp.asarray(jnp.inf, u.dtype)
+    dt = jnp.minimum(
+        dt_bound,
+        jnp.minimum(
+            jnp.where(umax > 0, dx / umax, inf), jnp.where(vmax > 0, dy / vmax, inf)
+        ),
+    )
+    return dt * tau
+
+
+def normalize_pressure(p):
+    """Subtract the mean over the FULL array (normalizePressure, solver.c:204-217)."""
+    return p - jnp.mean(p)

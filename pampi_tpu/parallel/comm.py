@@ -103,11 +103,16 @@ class CartComm:
                 f"tpu_mesh has {len(self.dims)} dims {self.dims} but this "
                 f"problem needs a {self.ndims}-D mesh"
             )
-        if math.prod(self.dims) != n:
+        if any(d < 1 for d in self.dims):
+            raise ValueError(f"mesh dims must be positive, got {self.dims}")
+        if math.prod(self.dims) > n:
             raise ValueError(
                 f"mesh dims {self.dims} need {math.prod(self.dims)} devices "
-                f"but {n} are available"
+                f"but only {n} are available"
             )
+        # like `mpirun -n k` on a larger node: an explicit smaller mesh uses
+        # the first prod(dims) devices
+        devs = list(devs)[: math.prod(self.dims)]
         self.axis_names = AXIS_NAMES[3 - self.ndims :]
         self.mesh = Mesh(np.asarray(devs).reshape(self.dims), self.axis_names)
 

@@ -1,0 +1,48 @@
+"""Shared 2-D distributed-stencil helpers used by every ("j","i")-mesh solver.
+
+These encode the two invariants the distributed solvers must keep in lockstep:
+- wall-gated homogeneous-Neumann ghost copies (≙ the reference's pressure BC
+  loops, assignment-4/src/solver.c:157-165, gated like commIsBoundary), and
+- GLOBAL (i+j)-parity checkerboard masks, so red-black colouring is
+  decomposition-invariant (assignment-4 solveRB cell sets, solver.c:197-234).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .comm import CartComm, get_offsets, is_boundary
+
+
+def wall_flags(comm: CartComm):
+    """(lo_i, hi_i, lo_j, hi_j) boundary predicates for the current shard."""
+    Pj = comm.axis_size("j")
+    Pi = comm.axis_size("i")
+    return (
+        is_boundary("i", Pi, "lo"),
+        is_boundary("i", Pi, "hi"),
+        is_boundary("j", Pj, "lo"),
+        is_boundary("j", Pj, "hi"),
+    )
+
+
+def neumann_walls(p, comm: CartComm):
+    """Homogeneous-Neumann ghost copy on physical walls only; corners
+    untouched (the reference's loops run 1..imax / 1..jmax)."""
+    lo_i, hi_i, lo_j, hi_j = wall_flags(comm)
+    p = p.at[0, 1:-1].set(jnp.where(lo_j, p[1, 1:-1], p[0, 1:-1]))
+    p = p.at[-1, 1:-1].set(jnp.where(hi_j, p[-2, 1:-1], p[-1, 1:-1]))
+    p = p.at[1:-1, 0].set(jnp.where(lo_i, p[1:-1, 1], p[1:-1, 0]))
+    p = p.at[1:-1, -1].set(jnp.where(hi_i, p[1:-1, -2], p[1:-1, -1]))
+    return p
+
+
+def global_checkerboard_masks(jl: int, il: int, dtype):
+    """(red, black) interior masks on the (jl, il) local block using GLOBAL
+    1-based (i + j) parity via the shard's mesh offsets."""
+    joff = get_offsets("j", jl)
+    ioff = get_offsets("i", il)
+    jj = jnp.arange(1, jl + 1, dtype=jnp.int32)[:, None] + joff
+    ii = jnp.arange(1, il + 1, dtype=jnp.int32)[None, :] + ioff
+    par = (ii + jj) % 2
+    return (par == 0).astype(dtype), (par == 1).astype(dtype)
