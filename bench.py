@@ -1,0 +1,73 @@
+"""Headline benchmark: lattice-site updates/sec/chip, Poisson 4096² red-black
+SOR (the BASELINE.json metric).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "updates/s", "vs_baseline": N}
+
+Method: 4096² grid, float32 (TPU-native), 100 timed red-black iterations
+(fixed count via fori_loop — steady-state throughput, no convergence check),
+after one warm-up call; one update = one interior cell relaxed once (red+black
+covers each cell exactly once per iteration, matching the reference's
+per-iteration cell count).
+
+vs_baseline: the reference publishes no numbers (SURVEY.md §6). Baseline is
+the measured throughput of the reference's own assignment-4 C solver
+(gcc -O3 -march=native, lexicographic `solve`, 4096², 20 fixed iterations)
+on this container's host CPU: 1.65e8 updates/s/core, linearly scaled to the
+8-rank MPI baseline BASELINE.json names => 1.32e9 updates/s. Regenerate with
+tools/measure_baseline.sh.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pampi_tpu.models.poisson import init_fields, make_rb_step
+from pampi_tpu.utils.params import Parameter
+
+BASELINE_8RANK_UPDATES_PER_S = 1.32e9  # see module docstring
+
+N = 4096
+ITERS = 100
+
+
+def main() -> None:
+    param = Parameter(imax=N, jmax=N, tpu_dtype="float32")
+    p, rhs = init_fields(param, problem=2, dtype=jnp.float32)
+    step = make_rb_step(N, N, 1.0 / N, 1.0 / N, 1.9, jnp.float32)
+
+    @jax.jit
+    def run_iters(p, rhs):
+        def body(_, carry):
+            p, _res = carry
+            return step(p, rhs)
+
+        return lax.fori_loop(0, ITERS, body, (p, jnp.asarray(0.0, jnp.float32)))
+
+    out = run_iters(p, rhs)
+    out[0].block_until_ready()  # warm-up + compile
+    t0 = time.perf_counter()
+    out = run_iters(p, rhs)
+    out[0].block_until_ready()
+    dt = time.perf_counter() - t0
+    ups = N * N * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "lattice_site_updates_per_sec_per_chip_poisson4096_rbsor",
+                "value": ups,
+                "unit": "updates/s",
+                "vs_baseline": ups / BASELINE_8RANK_UPDATES_PER_S,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

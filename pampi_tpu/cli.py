@@ -89,8 +89,21 @@ def _run(argv) -> int:
         print(f"{it} ", end="")
         solver.write_result("p.dat")
         print("Walltime %.2fs" % (end - start))
-    elif param.name in ("dcavity", "canal"):
+    elif param.name in ("dcavity", "canal", "dcavity3d", "canal3d"):
+        from .utils.params import is_3d_config
+
+        is3d = is_3d_config(param)
+
         def build():
+            if is3d:
+                comm = _make_comm(param, ndims=3)
+                if comm is None:
+                    from .models.ns3d import NS3DSolver
+
+                    return NS3DSolver(param)
+                from .models.ns3d_dist import NS3DDistSolver
+
+                return NS3DDistSolver(param, comm)
             comm = _make_comm(param, ndims=2)
             if comm is None:
                 from .models.ns2d import NS2DSolver
@@ -107,20 +120,10 @@ def _run(argv) -> int:
         solver.run()
         end = get_timestamp()
         print("Solution took %.2fs" % (end - start))
-        solver.write_result("pressure.dat", "velocity.dat")
-    elif param.name in ("dcavity3d", "canal3d"):
-        try:
-            from .models.ns3d import NS3DSolver
-        except ImportError:
-            print("NS-3D solver not available in this build", file=sys.stderr)
-            return 1
-
-        solver = NS3DSolver(param)
-        start = get_timestamp()
-        solver.run()
-        end = get_timestamp()
-        print("Solution took %.2fs" % (end - start))
-        solver.write_result()
+        if is3d:
+            solver.write_result()
+        else:
+            solver.write_result("pressure.dat", "velocity.dat")
     else:
         print(f"Unknown problem name: {param.name}", file=sys.stderr)
         return 1

@@ -1,0 +1,225 @@
+"""NS-3D incompressible Navier-Stokes time-stepper (assignment-6 capability,
+COMPLETED: the reference ships its distributed comm bodies as skeletons).
+
+Pipeline parity with /root/reference/assignment-6/src/main.c:50-67:
+computeTimestep → setBoundaryConditions → setSpecialBoundaryCondition →
+computeFG → computeRHS → solve → adaptUV, t += dt while t <= te. (Unlike the
+2-D driver there is NO normalizePressure in the loop.)
+
+The pressure solve is 3-D red-black SOR (solve, solver.c:175-297): pass 0
+visits (i+j+k) odd cells, pass 1 even (the reference's ksw/jsw/isw
+checkerboard), factor = ω/2·(dx²dy²dz²)/(dy²dz²+dx²dz²+dx²dy²), 6-face
+Neumann ghost copies after both passes, residual normalized by
+imax·jmax·kmax. DOCUMENTED DEVIATION: the reference never resets `res`
+inside the while loop (solver.c:203-230) — an accumulation bug flagged in
+SURVEY.md §2.1; we reset per iteration (and the parity oracle used by the
+tests is the reference built with the same one-line fix).
+
+Time loop runs on-device in host-synced chunks like NS-2D.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops import ns3d as ops
+from ..utils.grid import Grid
+from ..utils.params import Parameter
+from ..utils.precision import resolve_dtype
+from ..utils.progress import Progress
+from ..utils.vtkio import VtkWriter
+
+
+def checkerboard_mask_3d(kmax, jmax, imax, parity, dtype):
+    """Interior mask where (i+j+k) % 2 == parity (1-based indices). Pass 0
+    of the reference's sweep visits parity 1 (odd), pass 1 parity 0."""
+    kk = jnp.arange(1, kmax + 1, dtype=jnp.int32)[:, None, None]
+    jj = jnp.arange(1, jmax + 1, dtype=jnp.int32)[None, :, None]
+    ii = jnp.arange(1, imax + 1, dtype=jnp.int32)[None, None, :]
+    return (((ii + jj + kk) % 2) == parity).astype(dtype)
+
+
+def neumann_faces_3d(p):
+    """6-face pressure ghost copy (solve's commIsBoundary blocks,
+    solver.c:233-279); tangential ranges [1:-1], edges/corners untouched."""
+    p = p.at[0, 1:-1, 1:-1].set(p[1, 1:-1, 1:-1])  # front
+    p = p.at[-1, 1:-1, 1:-1].set(p[-2, 1:-1, 1:-1])  # back
+    p = p.at[1:-1, 0, 1:-1].set(p[1:-1, 1, 1:-1])  # bottom
+    p = p.at[1:-1, -1, 1:-1].set(p[1:-1, -2, 1:-1])  # top
+    p = p.at[1:-1, 1:-1, 0].set(p[1:-1, 1:-1, 1])  # left
+    p = p.at[1:-1, 1:-1, -1].set(p[1:-1, 1:-1, -2])  # right
+    return p
+
+
+def sor_pass_3d(p, rhs, mask, factor, idx2, idy2, idz2):
+    """One masked half-sweep of the 7-point stencil (solver.c:210-229)."""
+    lap = (
+        (p[1:-1, 1:-1, 2:] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[1:-1, 1:-1, :-2]) * idx2
+        + (p[1:-1, 2:, 1:-1] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[1:-1, :-2, 1:-1]) * idy2
+        + (p[2:, 1:-1, 1:-1] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[:-2, 1:-1, 1:-1]) * idz2
+    )
+    r = (rhs[1:-1, 1:-1, 1:-1] - lap) * mask
+    p = p.at[1:-1, 1:-1, 1:-1].add(-factor * r)
+    return p, jnp.sum(r * r)
+
+
+def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax, dtype):
+    dx2, dy2, dz2 = dx * dx, dy * dy, dz * dz
+    idx2, idy2, idz2 = 1.0 / dx2, 1.0 / dy2, 1.0 / dz2
+    factor = omega * 0.5 * (dx2 * dy2 * dz2) / (dy2 * dz2 + dx2 * dz2 + dx2 * dy2)
+    odd = checkerboard_mask_3d(kmax, jmax, imax, 1, dtype)
+    even = checkerboard_mask_3d(kmax, jmax, imax, 0, dtype)
+    norm = float(imax * jmax * kmax)
+    epssq = eps * eps
+
+    def solve(p, rhs):
+        def cond(c):
+            _, res, it = c
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(c):
+            p, _, it = c
+            p, r0 = sor_pass_3d(p, rhs, odd, factor, idx2, idy2, idz2)
+            p, r1 = sor_pass_3d(p, rhs, even, factor, idx2, idy2, idz2)
+            p = neumann_faces_3d(p)
+            return p, (r0 + r1) / norm, it + 1
+
+        return lax.while_loop(
+            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        )
+
+    return solve
+
+
+class NS3DSolver:
+    """Driver-facing NS-3D solver (≙ assignment-6 Solver struct + main loop)."""
+
+    CHUNK = 32
+
+    def __init__(self, param: Parameter, dtype=None):
+        if dtype is None:
+            dtype = resolve_dtype(param.tpu_dtype)
+        self.param = param
+        self.dtype = dtype
+        self.grid = Grid(
+            imax=param.imax,
+            jmax=param.jmax,
+            kmax=param.kmax,
+            xlength=param.xlength,
+            ylength=param.ylength,
+            zlength=param.zlength,
+        )
+        g = self.grid
+        shape = (g.kmax + 2, g.jmax + 2, g.imax + 2)
+        self.u = jnp.full(shape, param.u_init, dtype)
+        self.v = jnp.full(shape, param.v_init, dtype)
+        self.w = jnp.full(shape, param.w_init, dtype)
+        self.p = jnp.full(shape, param.p_init, dtype)
+        inv_sqr_sum = 1.0 / g.dx**2 + 1.0 / g.dy**2 + 1.0 / g.dz**2
+        self.dt_bound = 0.5 * param.re / inv_sqr_sum
+        self.t = 0.0
+        self.nt = 0
+        self._chunk_fn = jax.jit(self._build_chunk())
+
+    def _build_step(self):
+        param = self.param
+        g = self.grid
+        dtype = self.dtype
+        dx, dy, dz = g.dx, g.dy, g.dz
+        solve = make_pressure_solve_3d(
+            g.imax, g.jmax, g.kmax, dx, dy, dz,
+            param.omg, param.eps, param.itermax, dtype,
+        )
+        bcs = {
+            "top": param.bcTop,
+            "bottom": param.bcBottom,
+            "left": param.bcLeft,
+            "right": param.bcRight,
+            "front": param.bcFront,
+            "back": param.bcBack,
+        }
+        adaptive = param.tau > 0.0
+        problem = param.name.replace("3d", "")
+
+        def step(u, v, w, p, t, nt):
+            if adaptive:
+                dt = ops.compute_timestep_3d(
+                    u, v, w, jnp.asarray(self.dt_bound, dtype), dx, dy, dz, param.tau
+                )
+            else:
+                dt = jnp.asarray(param.dt, dtype)
+            u, v, w = ops.set_boundary_conditions_3d(u, v, w, bcs)
+            if problem == "dcavity":
+                u = ops.set_special_bc_dcavity_3d(u)
+            elif problem == "canal":
+                u = ops.set_special_bc_canal_3d(u)
+            f, g_, h = ops.compute_fgh(
+                u, v, w, dt, param.re, param.gx, param.gy, param.gz,
+                param.gamma, dx, dy, dz,
+            )
+            rhs = ops.compute_rhs(f, g_, h, dt, dx, dy, dz)
+            p, _res, _it = solve(p, rhs)
+            u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
+            time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            return u, v, w, p, t + dt.astype(time_dtype), nt + 1
+
+        return step
+
+    def _build_chunk(self):
+        step = self._build_step()
+        te = self.param.te
+        chunk = self.CHUNK
+
+        def chunk_fn(u, v, w, p, t, nt):
+            def cond(c):
+                return jnp.logical_and(c[4] <= te, c[6] < chunk)
+
+            def body(c):
+                u, v, w, p, t, nt, k = c
+                u, v, w, p, t, nt = step(u, v, w, p, t, nt)
+                return u, v, w, p, t, nt, k + 1
+
+            u, v, w, p, t, nt, _ = lax.while_loop(
+                cond, body, (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32))
+            )
+            return u, v, w, p, t, nt
+
+        return chunk_fn
+
+    def run(self, progress: bool = True) -> None:
+        bar = Progress(self.param.te, enabled=progress)
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        t = jnp.asarray(self.t, time_dtype)
+        nt = jnp.asarray(self.nt, jnp.int32)
+        u, v, w, p = self.u, self.v, self.w, self.p
+        while float(t) <= self.param.te:
+            u, v, w, p, t, nt = self._chunk_fn(u, v, w, p, t, nt)
+            bar.update(float(t))
+        bar.stop()
+        self.u, self.v, self.w, self.p = u, v, w, p
+        self.t, self.nt = float(t), int(nt)
+
+    def collect(self):
+        """Cell-centered global fields (≙ commCollectResult's non-MPI path,
+        comm.c:386-426): p interior; velocities averaged from staggered faces."""
+        u = np.asarray(self.u)
+        v = np.asarray(self.v)
+        w = np.asarray(self.w)
+        p = np.asarray(self.p)
+        pg = p[1:-1, 1:-1, 1:-1]
+        ug = (u[1:-1, 1:-1, 1:-1] + u[1:-1, 1:-1, :-2]) / 2.0
+        vg = (v[1:-1, 1:-1, 1:-1] + v[1:-1, :-2, 1:-1]) / 2.0
+        wg = (w[1:-1, 1:-1, 1:-1] + w[:-2, 1:-1, 1:-1]) / 2.0
+        return ug, vg, wg, pg
+
+    def write_result(self, path=None, fmt: str = "ascii") -> None:
+        """VTK output (main.c:100-106): scalar pressure + vector velocity."""
+        ug, vg, wg, pg = self.collect()
+        problem = self.param.name.replace("3d", "")
+        writer = VtkWriter(problem, self.grid, fmt=fmt, path=path)
+        writer.scalar("pressure", pg)
+        writer.vector("velocity", ug, vg, wg)
+        writer.close()

@@ -1,0 +1,322 @@
+"""Distributed NS-3D over a 3-D ("k","j","i") device mesh.
+
+This COMPLETES the capability assignment-6 hands out as a skeleton: the
+reference's `comm.c` ships its `_MPI` bodies unfinished (`// fill`,
+comm.c:124-239,479-483), so the 3-D Cartesian-decomposed solver never runs
+distributed in the reference tree. Here the full 3-D choreography runs over
+the mesh comm layer (halo_exchange = 6-face ppermute, halo_shift = staggered
+donor edges, psum/pmax reductions), with the same EXACT-sequential-parity
+policy as NS-2D (see models/ns2d_dist.py): halos refreshed before every
+cross-shard read makes the distributed trajectory equal the single-device
+solver bitwise (mod reduction order) on any mesh shape.
+
+Exchange points per step (mirroring the reference's own calls where they
+exist): u/v/w at step start (maxElement ghost parity), u/v/w after BCs
+(≙ computeFG's commExchange, solver.c:635-637), F/G/H one-directional shift
+before RHS (≙ commShift, solver.c:161), p before each half-sweep and after
+the solve loop (≙ solve's per-pass commExchange :208 and trailing :288).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops import ns3d as ops
+from ..parallel.comm import (
+    CartComm,
+    halo_exchange,
+    halo_shift,
+    reduction,
+)
+from ..parallel.stencil3d import (
+    face_flags,
+    global_checkerboard_masks_3d,
+    neumann_faces,
+)
+from ..utils.grid import Grid
+from ..utils.params import Parameter
+from ..utils.precision import resolve_dtype
+from ..utils.progress import Progress
+from ..utils.vtkio import VtkWriter
+
+NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
+
+
+def _sel(pred, new, old):
+    return jnp.where(pred, new, old)
+
+
+class NS3DDistSolver:
+    """Mesh-parallel NS-3D solver; same .par interface as NS3DSolver."""
+
+    CHUNK = 32
+
+    def __init__(self, param: Parameter, comm: CartComm | None = None, dtype=None):
+        if dtype is None:
+            dtype = resolve_dtype(param.tpu_dtype)
+        self.param = param
+        self.dtype = dtype
+        self.comm = comm if comm is not None else CartComm(ndims=3)
+        self.grid = Grid(
+            imax=param.imax,
+            jmax=param.jmax,
+            kmax=param.kmax,
+            xlength=param.xlength,
+            ylength=param.ylength,
+            zlength=param.zlength,
+        )
+        g = self.grid
+        self.kl, self.jl, self.il = self.comm.local_shape(
+            (g.kmax, g.jmax, g.imax)
+        )
+        inv_sqr_sum = 1.0 / g.dx**2 + 1.0 / g.dy**2 + 1.0 / g.dz**2
+        self.dt_bound = 0.5 * param.re / inv_sqr_sum
+        self.t = 0.0
+        self.nt = 0
+        self._build()
+        self.u, self.v, self.w, self.p = self._init_sm()
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        comm = self.comm
+        param = self.param
+        g = self.grid
+        dtype = self.dtype
+        kl, jl, il = self.kl, self.jl, self.il
+        dx, dy, dz = g.dx, g.dy, g.dz
+
+        bcs = {
+            "top": param.bcTop,
+            "bottom": param.bcBottom,
+            "left": param.bcLeft,
+            "right": param.bcRight,
+            "front": param.bcFront,
+            "back": param.bcBack,
+        }
+        problem = param.name.replace("3d", "")
+
+        # -- wall-gated BCs (≙ commIsBoundary-guarded face loops) --------
+        def set_bcs(u, v, w):
+            return ops.set_boundary_conditions_3d(
+                u, v, w, bcs, flags=face_flags(comm)
+            )
+
+        def set_special_bc(u):
+            flags = face_flags(comm)
+            if problem == "dcavity":
+                # lid plane u[k, jl+1, i], global k in 1..kmax-1, i in
+                # 1..imax-1: exclude last interior k/i on the hi-wall shards
+                # (reference loop-bound quirk, solver.c:587-594)
+                kmask = jnp.zeros(kl + 2, dtype).at[1:-1].set(1.0)
+                kmask = kmask.at[-2].mul(1.0 - flags["back"].astype(dtype))
+                imask = jnp.zeros(il + 2, dtype).at[1:-1].set(1.0)
+                imask = imask.at[-2].mul(1.0 - flags["right"].astype(dtype))
+                m2 = kmask[:, None] * imask[None, :]
+                lid = 2.0 - u[:, -2, :]
+                new_plane = jnp.where(m2 > 0, lid, u[:, -1, :])
+                u = u.at[:, -1, :].set(_sel(flags["top"], new_plane, u[:, -1, :]))
+            elif problem == "canal":
+                cur = u[:, :, 0]
+                new_plane = cur.at[1:-1, 1:-1].set(2.0)
+                u = u.at[:, :, 0].set(_sel(flags["left"], new_plane, cur))
+            return u
+
+        def fgh_fixups(f, g_, h, u, v, w):
+            flags = face_flags(comm)
+            f = f.at[1:-1, 1:-1, 0].set(
+                _sel(flags["left"], u[1:-1, 1:-1, 0], f[1:-1, 1:-1, 0])
+            )
+            f = f.at[1:-1, 1:-1, -2].set(
+                _sel(flags["right"], u[1:-1, 1:-1, -2], f[1:-1, 1:-1, -2])
+            )
+            g_ = g_.at[1:-1, 0, 1:-1].set(
+                _sel(flags["bottom"], v[1:-1, 0, 1:-1], g_[1:-1, 0, 1:-1])
+            )
+            g_ = g_.at[1:-1, -2, 1:-1].set(
+                _sel(flags["top"], v[1:-1, -2, 1:-1], g_[1:-1, -2, 1:-1])
+            )
+            h = h.at[0, 1:-1, 1:-1].set(
+                _sel(flags["front"], w[0, 1:-1, 1:-1], h[0, 1:-1, 1:-1])
+            )
+            h = h.at[-2, 1:-1, 1:-1].set(
+                _sel(flags["back"], w[-2, 1:-1, 1:-1], h[-2, 1:-1, 1:-1])
+            )
+            return f, g_, h
+
+        # -- pressure solve --------------------------------------------
+        dx2, dy2, dz2 = dx * dx, dy * dy, dz * dz
+        idx2, idy2, idz2 = 1.0 / dx2, 1.0 / dy2, 1.0 / dz2
+        factor = (
+            param.omg * 0.5 * (dx2 * dy2 * dz2) / (dy2 * dz2 + dx2 * dz2 + dx2 * dy2)
+        )
+        epssq = param.eps * param.eps
+        norm = float(g.imax * g.jmax * g.kmax)
+
+        def half_sweep(p, rhs, mask):
+            lap = (
+                (p[1:-1, 1:-1, 2:] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[1:-1, 1:-1, :-2])
+                * idx2
+                + (p[1:-1, 2:, 1:-1] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[1:-1, :-2, 1:-1])
+                * idy2
+                + (p[2:, 1:-1, 1:-1] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[:-2, 1:-1, 1:-1])
+                * idz2
+            )
+            r = (rhs[1:-1, 1:-1, 1:-1] - lap) * mask
+            p = p.at[1:-1, 1:-1, 1:-1].add(-factor * r)
+            return p, jnp.sum(r * r)
+
+        def solve(p, rhs):
+            odd, even = global_checkerboard_masks_3d(kl, jl, il, dtype)
+
+            def cond(c):
+                return jnp.logical_and(c[1] >= epssq, c[2] < param.itermax)
+
+            def body(c):
+                p, _, it = c
+                p = halo_exchange(p, comm)
+                p, r0 = half_sweep(p, rhs, odd)
+                p = halo_exchange(p, comm)
+                p, r1 = half_sweep(p, rhs, even)
+                p = neumann_faces(p, comm)
+                res = reduction(r0 + r1, comm, "sum") / norm
+                return p, res, it + 1
+
+            p, res, it = lax.while_loop(
+                cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+            )
+            return halo_exchange(p, comm), res, it
+
+        def compute_dt(u, v, w):
+            umax = reduction(jnp.max(jnp.abs(u)), comm, "max")
+            vmax = reduction(jnp.max(jnp.abs(v)), comm, "max")
+            wmax = reduction(jnp.max(jnp.abs(w)), comm, "max")
+            inf = jnp.asarray(jnp.inf, dtype)
+            dt = jnp.minimum(
+                jnp.asarray(self.dt_bound, dtype),
+                jnp.minimum(
+                    jnp.where(umax > 0, dx / umax, inf),
+                    jnp.minimum(
+                        jnp.where(vmax > 0, dy / vmax, inf),
+                        jnp.where(wmax > 0, dz / wmax, inf),
+                    ),
+                ),
+            )
+            return dt * param.tau
+
+        adaptive = param.tau > 0.0
+        idx_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+        def step(u, v, w, p, t, nt):
+            u = halo_exchange(u, comm)
+            v = halo_exchange(v, comm)
+            w = halo_exchange(w, comm)
+            dt = compute_dt(u, v, w) if adaptive else jnp.asarray(param.dt, dtype)
+            u, v, w = set_bcs(u, v, w)
+            u = set_special_bc(u)
+            u = halo_exchange(u, comm)
+            v = halo_exchange(v, comm)
+            w = halo_exchange(w, comm)
+            f, g_, h = ops.compute_fgh_interior(
+                u, v, w, dt, param.re, param.gx, param.gy, param.gz,
+                param.gamma, dx, dy, dz,
+            )
+            f, g_, h = fgh_fixups(f, g_, h, u, v, w)
+            f = halo_shift(f, comm, "i")
+            g_ = halo_shift(g_, comm, "j")
+            h = halo_shift(h, comm, "k")
+            rhs = ops.compute_rhs(f, g_, h, dt, dx, dy, dz)
+            p, _res, _it = solve(p, rhs)
+            u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
+            return u, v, w, p, t + dt.astype(idx_dtype), nt + 1
+
+        te = param.te
+        chunk = self.CHUNK
+
+        def chunk_kernel(u, v, w, p, t, nt):
+            def cond(c):
+                return jnp.logical_and(c[4] <= te, c[6] < chunk)
+
+            def body(c):
+                u, v, w, p, t, nt, k = c
+                u, v, w, p, t, nt = step(u, v, w, p, t, nt)
+                return u, v, w, p, t, nt, k + 1
+
+            u, v, w, p, t, nt, _ = lax.while_loop(
+                cond, body, (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32))
+            )
+            return u, v, w, p, t, nt
+
+        def init_kernel():
+            shape = (kl + 2, jl + 2, il + 2)
+            return (
+                jnp.full(shape, param.u_init, dtype),
+                jnp.full(shape, param.v_init, dtype),
+                jnp.full(shape, param.w_init, dtype),
+                jnp.full(shape, param.p_init, dtype),
+            )
+
+        def collect_kernel(u, v, w, p):
+            """Cell-centered interiors (≙ commCollectResult, comm.c:246-427):
+            staggered→center averaging needs fresh minus-side halos."""
+            u = halo_exchange(u, comm)
+            v = halo_exchange(v, comm)
+            w = halo_exchange(w, comm)
+            pg = p[1:-1, 1:-1, 1:-1]
+            ug = (u[1:-1, 1:-1, 1:-1] + u[1:-1, 1:-1, :-2]) / 2.0
+            vg = (v[1:-1, 1:-1, 1:-1] + v[1:-1, :-2, 1:-1]) / 2.0
+            wg = (w[1:-1, 1:-1, 1:-1] + w[:-2, 1:-1, 1:-1]) / 2.0
+            return ug, vg, wg, pg
+
+        spec = P("k", "j", "i")
+        self._init_sm = jax.jit(
+            comm.shard_map(init_kernel, in_specs=(), out_specs=(spec,) * 4)
+        )
+        self._chunk_sm = jax.jit(
+            comm.shard_map(
+                chunk_kernel,
+                in_specs=(spec,) * 4 + (P(), P()),
+                out_specs=(spec,) * 4 + (P(), P()),
+            )
+        )
+        self._collect_sm = jax.jit(
+            comm.shard_map(collect_kernel, in_specs=(spec,) * 4, out_specs=(spec,) * 4)
+        )
+
+    # ------------------------------------------------------------------
+    def run(self, progress: bool = True) -> None:
+        bar = Progress(self.param.te, enabled=progress)
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        t = jnp.asarray(self.t, time_dtype)
+        nt = jnp.asarray(self.nt, jnp.int32)
+        u, v, w, p = self.u, self.v, self.w, self.p
+        while float(t) <= self.param.te:
+            u, v, w, p, t, nt = self._chunk_sm(u, v, w, p, t, nt)
+            bar.update(float(t))
+        bar.stop()
+        self.u, self.v, self.w, self.p = u, v, w, p
+        self.t, self.nt = float(t), int(nt)
+
+    def collect(self):
+        """Gather cell-centered global fields to the host. The collect
+        kernel outputs interior-only blocks, so the shard_map output IS the
+        assembled (kmax, jmax, imax) global array — no assembly code (the
+        80-line subarray dance of assembleResult, comm.c:104-156, vanishes)."""
+        ug, vg, wg, pg = self._collect_sm(self.u, self.v, self.w, self.p)
+        return (
+            np.asarray(jax.device_get(ug)),
+            np.asarray(jax.device_get(vg)),
+            np.asarray(jax.device_get(wg)),
+            np.asarray(jax.device_get(pg)),
+        )
+
+    def write_result(self, path=None, fmt: str = "ascii") -> None:
+        ug, vg, wg, pg = self.collect()
+        problem = self.param.name.replace("3d", "")
+        writer = VtkWriter(problem, self.grid, fmt=fmt, path=path)
+        writer.scalar("pressure", pg)
+        writer.vector("velocity", ug, vg, wg)
+        writer.close()

@@ -1,0 +1,280 @@
+"""NS-3D staggered-grid ops, branch-free for TPU.
+
+Capability parity with /root/reference/assignment-6/src/solver.c — the 3-D
+F/G/H momentum predictor (computeFG:606-769), 6-face × 4-kind BCs
+(setBoundaryConditions:364-577), special BCs (:579-604), CFL timestep
+(:340-362), projection (adaptUV:826-853), RHS (computeRHS:145-173),
+interior-only pressure normalization (:312-338).
+
+Arrays are (kmax+2, jmax+2, imax+2), layout [k, j, i]; u on east faces,
+v on north faces, w on back faces, p at centers.
+
+Replicated reference quirks (documented, required for oracle parity):
+- dvwdz in the G predictor uses V(i,j,k+1) in BOTH flux halves and both
+  γ-terms (solver.c:712-723) where the symmetric scheme would use
+  V(i,j,k-1) in the second — we reproduce the reference's arithmetic.
+- dcavity lid skips the last interior i AND k (loops `< imaxLocal`,
+  `< kmaxLocal`, solver.c:587-594).
+- canal inflow is uniform U=2.0, not the 2-D parabola (solver.c:595-602).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
+
+
+def V3(a, dk=0, dj=0, di=0):
+    """Interior view shifted by (dk, dj, di) — the (i±1, j±1, k±1) stencil
+    accessor over the whole interior at once."""
+    K, J, I = a.shape
+    return a[1 + dk : K - 1 + dk, 1 + dj : J - 1 + dj, 1 + di : I - 1 + di]
+
+
+def compute_fgh_interior(u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz):
+    """3-D momentum predictor interior (computeFG, solver.c:639-769)."""
+    idx, idy, idz = 1.0 / dx, 1.0 / dy, 1.0 / dz
+    inv_re = 1.0 / re
+
+    uc = V3(u)
+    vc = V3(v)
+    wc = V3(w)
+    u_ip, u_im = V3(u, di=1), V3(u, di=-1)
+    u_jp, u_jm = V3(u, dj=1), V3(u, dj=-1)
+    u_kp, u_km = V3(u, dk=1), V3(u, dk=-1)
+    v_ip, v_im = V3(v, di=1), V3(v, di=-1)
+    v_jp, v_jm = V3(v, dj=1), V3(v, dj=-1)
+    v_kp, v_km = V3(v, dk=1), V3(v, dk=-1)
+    w_ip, w_im = V3(w, di=1), V3(w, di=-1)
+    w_jp, w_jm = V3(w, dj=1), V3(w, dj=-1)
+    w_kp, w_km = V3(w, dk=1), V3(w, dk=-1)
+    u_im_jp = V3(u, dj=1, di=-1)
+    u_im_kp = V3(u, dk=1, di=-1)
+    v_jm_ip = V3(v, dj=-1, di=1)
+    v_jm_kp = V3(v, dk=1, dj=-1)
+    w_km_ip = V3(w, dk=-1, di=1)
+    w_km_jp = V3(w, dk=-1, dj=1)
+
+    ab = jnp.abs
+
+    # ---- F ----
+    du2dx = idx * 0.25 * (
+        (uc + u_ip) * (uc + u_ip) - (uc + u_im) * (uc + u_im)
+    ) + gamma * idx * 0.25 * (
+        ab(uc + u_ip) * (uc - u_ip) + ab(uc + u_im) * (uc - u_im)
+    )
+    duvdy = idy * 0.25 * (
+        (vc + v_ip) * (uc + u_jp) - (v_jm + v_jm_ip) * (uc + u_jm)
+    ) + gamma * idy * 0.25 * (
+        ab(vc + v_ip) * (uc - u_jp) + ab(v_jm + v_jm_ip) * (uc - u_jm)
+    )
+    duwdz = idz * 0.25 * (
+        (wc + w_ip) * (uc + u_kp) - (w_km + w_km_ip) * (uc + u_km)
+    ) + gamma * idz * 0.25 * (
+        ab(wc + w_ip) * (uc - u_kp) + ab(w_km + w_km_ip) * (uc - u_km)
+    )
+    lap_u = (
+        idx * idx * (u_ip - 2.0 * uc + u_im)
+        + idy * idy * (u_jp - 2.0 * uc + u_jm)
+        + idz * idz * (u_kp - 2.0 * uc + u_km)
+    )
+    f_int = uc + dt * (inv_re * lap_u - du2dx - duvdy - duwdz + gx)
+
+    # ---- G ----
+    duvdx = idx * 0.25 * (
+        (uc + u_jp) * (vc + v_ip) - (u_im + u_im_jp) * (vc + v_im)
+    ) + gamma * idx * 0.25 * (
+        ab(uc + u_jp) * (vc - v_ip) + ab(u_im + u_im_jp) * (vc - v_im)
+    )
+    dv2dy = idy * 0.25 * (
+        (vc + v_jp) * (vc + v_jp) - (vc + v_jm) * (vc + v_jm)
+    ) + gamma * idy * 0.25 * (
+        ab(vc + v_jp) * (vc - v_jp) + ab(vc + v_jm) * (vc - v_jm)
+    )
+    # reference quirk: v_kp in BOTH halves and both γ-terms (solver.c:712-723)
+    dvwdz = idz * 0.25 * (
+        (wc + w_jp) * (vc + v_kp) - (w_km + w_km_jp) * (vc + v_kp)
+    ) + gamma * idz * 0.25 * (
+        ab(wc + w_jp) * (vc - v_kp) + ab(w_km + w_km_jp) * (vc - v_kp)
+    )
+    lap_v = (
+        idx * idx * (v_ip - 2.0 * vc + v_im)
+        + idy * idy * (v_jp - 2.0 * vc + v_jm)
+        + idz * idz * (v_kp - 2.0 * vc + v_km)
+    )
+    g_int = vc + dt * (inv_re * lap_v - duvdx - dv2dy - dvwdz + gy)
+
+    # ---- H ----
+    duwdx = idx * 0.25 * (
+        (uc + u_kp) * (wc + w_ip) - (u_im + u_im_kp) * (wc + w_im)
+    ) + gamma * idx * 0.25 * (
+        ab(uc + u_kp) * (wc - w_ip) + ab(u_im + u_im_kp) * (wc - w_im)
+    )
+    dvwdy = idy * 0.25 * (
+        (vc + v_kp) * (wc + w_jp) - (v_jm_kp + v_jm) * (wc + w_jm)
+    ) + gamma * idy * 0.25 * (
+        ab(vc + v_kp) * (wc - w_jp) + ab(v_jm_kp + v_jm) * (wc - w_jm)
+    )
+    dw2dz = idz * 0.25 * (
+        (wc + w_kp) * (wc + w_kp) - (wc + w_km) * (wc + w_km)
+    ) + gamma * idz * 0.25 * (
+        ab(wc + w_kp) * (wc - w_kp) + ab(wc + w_km) * (wc - w_km)
+    )
+    lap_w = (
+        idx * idx * (w_ip - 2.0 * wc + w_im)
+        + idy * idy * (w_jp - 2.0 * wc + w_jm)
+        + idz * idz * (w_kp - 2.0 * wc + w_km)
+    )
+    h_int = wc + dt * (inv_re * lap_w - duwdx - dvwdy - dw2dz + gz)
+
+    f = jnp.zeros_like(u).at[1:-1, 1:-1, 1:-1].set(f_int)
+    g = jnp.zeros_like(v).at[1:-1, 1:-1, 1:-1].set(g_int)
+    h = jnp.zeros_like(w).at[1:-1, 1:-1, 1:-1].set(h_int)
+    return f, g, h
+
+
+def apply_fgh_wall_fixups(f, g, h, u, v, w):
+    """F=U on left/right, G=V on bottom/top, H=W on front/back walls
+    (solver.c:771-823) — ungated single-device composition."""
+    f = f.at[1:-1, 1:-1, 0].set(u[1:-1, 1:-1, 0])
+    f = f.at[1:-1, 1:-1, -2].set(u[1:-1, 1:-1, -2])
+    g = g.at[1:-1, 0, 1:-1].set(v[1:-1, 0, 1:-1])
+    g = g.at[1:-1, -2, 1:-1].set(v[1:-1, -2, 1:-1])
+    h = h.at[0, 1:-1, 1:-1].set(w[0, 1:-1, 1:-1])
+    h = h.at[-2, 1:-1, 1:-1].set(w[-2, 1:-1, 1:-1])
+    return f, g, h
+
+
+def compute_fgh(u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz):
+    f, g, h = compute_fgh_interior(u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz)
+    return apply_fgh_wall_fixups(f, g, h, u, v, w)
+
+
+def compute_rhs(f, g, h, dt, dx, dy, dz):
+    """RHS = div(F,G,H)/dt (computeRHS, solver.c:163-172)."""
+    rhs_int = (
+        (V3(f) - V3(f, di=-1)) / dx
+        + (V3(g) - V3(g, dj=-1)) / dy
+        + (V3(h) - V3(h, dk=-1)) / dz
+    ) * (1.0 / dt)
+    return jnp.zeros_like(f).at[1:-1, 1:-1, 1:-1].set(rhs_int)
+
+
+def adapt_uvw(u, v, w, f, g, h, p, dt, dx, dy, dz):
+    """Projection (adaptUV, solver.c:845-852)."""
+    u = u.at[1:-1, 1:-1, 1:-1].set(V3(f) - (V3(p, di=1) - V3(p)) * (dt / dx))
+    v = v.at[1:-1, 1:-1, 1:-1].set(V3(g) - (V3(p, dj=1) - V3(p)) * (dt / dy))
+    w = w.at[1:-1, 1:-1, 1:-1].set(V3(h) - (V3(p, dk=1) - V3(p)) * (dt / dz))
+    return u, v, w
+
+
+# face descriptors: (name, axis, side). axis: 0=k, 1=j, 2=i.
+FACES = {
+    "top": (1, "hi"),
+    "bottom": (1, "lo"),
+    "left": (2, "lo"),
+    "right": (2, "hi"),
+    "front": (0, "lo"),
+    "back": (0, "hi"),
+}
+
+
+def _plane(axis, pos):
+    """Index tuple selecting the `pos` plane along axis, tangential [1:-1]."""
+    idx = [slice(1, -1)] * 3
+    idx[axis] = pos
+    return tuple(idx)
+
+
+def set_boundary_conditions_3d(u, v, w, bcs, flags=None):
+    """6-face × 4-kind BC application (setBoundaryConditions:364-577).
+    bcs is a dict face-name -> kind (insertion order = the reference's
+    application order: top, bottom, left, right, front, back); kinds are
+    static config, resolved at trace time. Staggered positions per the
+    reference's write sets: on a LO face the normal component AND the
+    tangential ghosts live at index 0 (v₀ sits on the bottom wall); on a HI
+    face the normal lives at -2 (on the wall) and tangential ghosts at -1.
+    NOSLIP mirrors tangential ghosts negatively, SLIP positively, OUTFLOW
+    copies everything from the next-inner plane; PERIODIC is a no-op as in
+    the reference.
+
+    flags: optional dict face-name -> boolean predicate gating each face's
+    writes (≙ the commIsBoundary guards); None applies every face
+    (single-device). All write sets are tangentially clipped to [1:-1], so
+    gated faces compose without clobbering each other's planes."""
+    fields = {0: w, 1: v, 2: u}  # normal component per axis
+
+    def write(arr, idx, val, face):
+        if flags is not None:
+            val = jnp.where(flags[face], val, arr[idx])
+        return arr.at[idx].set(val)
+
+    for face, kind in bcs.items():
+        axis, side = FACES[face]
+        if side == "lo":
+            ghost_pos, wall_pos, step = 0, 0, 1
+        else:
+            ghost_pos, wall_pos, step = -1, -2, -1
+        ghost = _plane(axis, ghost_pos)
+        ghost_in = _plane(axis, ghost_pos + step)
+        wall = _plane(axis, wall_pos)
+        wall_in = _plane(axis, wall_pos + step)
+        normal = fields[axis]
+        t_axes = [a for a in (0, 1, 2) if a != axis]
+        if kind == NOSLIP:
+            fields[axis] = write(normal, wall, jnp.zeros_like(normal[wall]), face)
+            for a in t_axes:
+                fields[a] = write(fields[a], ghost, -fields[a][ghost_in], face)
+        elif kind == SLIP:
+            fields[axis] = write(normal, wall, jnp.zeros_like(normal[wall]), face)
+            for a in t_axes:
+                fields[a] = write(fields[a], ghost, fields[a][ghost_in], face)
+        elif kind == OUTFLOW:
+            fields[axis] = write(normal, wall, normal[wall_in], face)
+            for a in t_axes:
+                fields[a] = write(fields[a], ghost, fields[a][ghost_in], face)
+        elif kind == PERIODIC:
+            pass
+    return fields[2], fields[1], fields[0]
+
+
+def set_special_bc_dcavity_3d(u):
+    """Lid U(i, jmax+1, k) = 2 − U(i, jmax, k), skipping the LAST interior i
+    and k (reference loop bounds `< imaxLocal`/`< kmaxLocal`, solver.c:587-594)."""
+    return u.at[1:-2, -1, 1:-2].set(2.0 - u[1:-2, -2, 1:-2])
+
+
+def set_special_bc_canal_3d(u):
+    """Uniform inflow U(0, j, k) = 2.0 (solver.c:595-602)."""
+    return u.at[1:-1, 1:-1, 0].set(2.0)
+
+
+def max_element(m):
+    """max |m| over the FULL local array incl. ghosts (solver.c:299-310)."""
+    return jnp.max(jnp.abs(m))
+
+
+def compute_timestep_3d(u, v, w, dt_bound, dx, dy, dz, tau):
+    """3-D CFL (computeTimestep, solver.c:340-362)."""
+    inf = jnp.asarray(jnp.inf, u.dtype)
+    umax, vmax, wmax = max_element(u), max_element(v), max_element(w)
+    dt = jnp.minimum(
+        dt_bound,
+        jnp.minimum(
+            jnp.where(umax > 0, dx / umax, inf),
+            jnp.minimum(
+                jnp.where(vmax > 0, dy / vmax, inf),
+                jnp.where(wmax > 0, dz / wmax, inf),
+            ),
+        ),
+    )
+    return dt * tau
+
+
+def normalize_pressure_3d(p, imax, jmax, kmax):
+    """Interior-only mean subtract, normalized by imax·jmax·kmax
+    (normalizePressure, solver.c:312-338 — NOTE: unlike the 2-D sequential
+    variant, ghosts are excluded)."""
+    avg = jnp.sum(p[1:-1, 1:-1, 1:-1]) / float(imax * jmax * kmax)
+    return p.at[1:-1, 1:-1, 1:-1].add(-avg)

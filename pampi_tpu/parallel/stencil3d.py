@@ -1,0 +1,61 @@
+"""Shared 3-D distributed-stencil helpers for ("k","j","i")-mesh solvers
+(3-D twins of stencil2d; ≙ assignment-6's commIsBoundary-gated face loops)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .comm import CartComm, get_offsets, is_boundary
+
+
+def face_flags(comm: CartComm):
+    """dict face-name -> boundary predicate for the current shard, matching
+    the reference's Direction enum faces (comm.h:98)."""
+    Pk = comm.axis_size("k")
+    Pj = comm.axis_size("j")
+    Pi = comm.axis_size("i")
+    return {
+        "front": is_boundary("k", Pk, "lo"),
+        "back": is_boundary("k", Pk, "hi"),
+        "bottom": is_boundary("j", Pj, "lo"),
+        "top": is_boundary("j", Pj, "hi"),
+        "left": is_boundary("i", Pi, "lo"),
+        "right": is_boundary("i", Pi, "hi"),
+    }
+
+
+def neumann_faces(p, comm: CartComm):
+    """6-face pressure ghost copy, wall shards only (solver.c:233-279)."""
+    f = face_flags(comm)
+    p = p.at[0, 1:-1, 1:-1].set(
+        jnp.where(f["front"], p[1, 1:-1, 1:-1], p[0, 1:-1, 1:-1])
+    )
+    p = p.at[-1, 1:-1, 1:-1].set(
+        jnp.where(f["back"], p[-2, 1:-1, 1:-1], p[-1, 1:-1, 1:-1])
+    )
+    p = p.at[1:-1, 0, 1:-1].set(
+        jnp.where(f["bottom"], p[1:-1, 1, 1:-1], p[1:-1, 0, 1:-1])
+    )
+    p = p.at[1:-1, -1, 1:-1].set(
+        jnp.where(f["top"], p[1:-1, -2, 1:-1], p[1:-1, -1, 1:-1])
+    )
+    p = p.at[1:-1, 1:-1, 0].set(
+        jnp.where(f["left"], p[1:-1, 1:-1, 1], p[1:-1, 1:-1, 0])
+    )
+    p = p.at[1:-1, 1:-1, -1].set(
+        jnp.where(f["right"], p[1:-1, 1:-1, -2], p[1:-1, 1:-1, -1])
+    )
+    return p
+
+
+def global_checkerboard_masks_3d(kl: int, jl: int, il: int, dtype):
+    """(odd, even) interior masks by GLOBAL 1-based (i+j+k) parity — pass 0
+    of the reference's sweep is parity 1 (solver.c:203-231)."""
+    koff = get_offsets("k", kl)
+    joff = get_offsets("j", jl)
+    ioff = get_offsets("i", il)
+    kk = jnp.arange(1, kl + 1, dtype=jnp.int32)[:, None, None] + koff
+    jj = jnp.arange(1, jl + 1, dtype=jnp.int32)[None, :, None] + joff
+    ii = jnp.arange(1, il + 1, dtype=jnp.int32)[None, None, :] + ioff
+    par = (ii + jj + kk) % 2
+    return (par == 1).astype(dtype), (par == 0).astype(dtype)

@@ -62,12 +62,20 @@ class Parameter:
     # framework-only (TPU execution controls; not in the reference)
     tpu_mesh: str = "auto"
     tpu_dtype: str = "float64"
+    # keys explicitly present in the parsed file (not a .par key itself);
+    # lets the driver tell a 3-D config (kmax/zlength/bcFront set) from a
+    # 2-D one, since the reference distinguishes by binary instead
+    seen_keys: tuple = ()
 
     def replace(self, **kw) -> "Parameter":
         return dataclasses.replace(self, **kw)
 
 
-_FIELDS = {f.name: f.type for f in dataclasses.fields(Parameter)}
+_FIELDS = {
+    f.name: f.type
+    for f in dataclasses.fields(Parameter)
+    if f.name != "seen_keys"
+}
 _CASTS = {"int": int, "float": float, "str": str}
 
 
@@ -87,6 +95,7 @@ def read_parameter(path: str, base: Parameter | None = None) -> Parameter:
     except OSError:
         print(f"Could not open parameter file: {path}", file=sys.stderr)
         raise SystemExit(1)
+    seen = set(param.seen_keys)
     with fh:
         for raw in fh:
             kv = _parse_line(raw)
@@ -100,27 +109,60 @@ def read_parameter(path: str, base: Parameter | None = None) -> Parameter:
                     cast = _CASTS[ftype if isinstance(ftype, str) else ftype.__name__]
                     try:
                         setattr(param, key, cast(val))
+                        seen.add(key)
                     except ValueError:
                         print(
                             f"bad value {val!r} for parameter {key}", file=sys.stderr
                         )
                         raise SystemExit(1)
+    param.seen_keys = tuple(sorted(seen))
     return param
 
 
-def print_parameter(p: Parameter, out=sys.stdout) -> None:
-    """Echo the configuration (parity: printParameter, parameter.c:95-126)."""
-    w = out.write
-    w(f"Parameters for {p.name}\n")
-    w(
-        "Boundary conditions Left:%d Right:%d Bottom:%d Top:%d\n"
-        % (p.bcLeft, p.bcRight, p.bcBottom, p.bcTop)
+def is_3d_config(p: Parameter) -> bool:
+    """True when the .par explicitly configures the third dimension (the
+    reference distinguishes 2-D/3-D by binary; we dispatch on the geometry/BC
+    keys every real 3-D config sets)."""
+    return p.name.endswith("3d") or any(
+        k in p.seen_keys for k in ("kmax", "zlength", "bcFront", "bcBack")
     )
+
+
+def print_parameter(p: Parameter, out=sys.stdout) -> None:
+    """Echo the configuration (parity: A5 parameter.c:88-111 for 2-D configs,
+    A6 parameter.c:95-126 — Front/Back, W, z-dims — for 3-D ones)."""
+    w = out.write
+    three_d = is_3d_config(p)
+    w(f"Parameters for {p.name}\n")
+    if three_d:
+        w(
+            "Boundary conditions Left:%d Right:%d Bottom:%d Top:%d Front:%d "
+            "Back:%d\n"
+            % (p.bcLeft, p.bcRight, p.bcBottom, p.bcTop, p.bcFront, p.bcBack)
+        )
+    else:
+        w(
+            "Boundary conditions Left:%d Right:%d Bottom:%d Top:%d\n"
+            % (p.bcLeft, p.bcRight, p.bcBottom, p.bcTop)
+        )
     w("\tReynolds number: %.2f\n" % p.re)
-    w("\tInit arrays: U:%.2f V:%.2f P:%.2f\n" % (p.u_init, p.v_init, p.p_init))
+    if three_d:
+        w(
+            "\tInit arrays: U:%.2f V:%.2f W:%.2f P:%.2f\n"
+            % (p.u_init, p.v_init, p.w_init, p.p_init)
+        )
+    else:
+        w("\tInit arrays: U:%.2f V:%.2f P:%.2f\n" % (p.u_init, p.v_init, p.p_init))
     w("Geometry data:\n")
-    w("\tDomain box size (x, y): %.2f, %.2f\n" % (p.xlength, p.ylength))
-    w("\tCells (x, y): %d, %d\n" % (p.imax, p.jmax))
+    if three_d:
+        w(
+            "\tDomain box size (x, y, z): %.2f, %.2f, %.2f\n"
+            % (p.xlength, p.ylength, p.zlength)
+        )
+        w("\tCells (x, y, z): %d, %d, %d\n" % (p.imax, p.jmax, p.kmax))
+    else:
+        w("\tDomain box size (x, y): %.2f, %.2f\n" % (p.xlength, p.ylength))
+        w("\tCells (x, y): %d, %d\n" % (p.imax, p.jmax))
     w("Timestep parameters:\n")
     w("\tDefault stepsize: %.2f, Final time %.2f\n" % (p.dt, p.te))
     w("\tTau factor: %.2f\n" % p.tau)

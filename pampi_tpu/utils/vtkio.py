@@ -1,0 +1,109 @@
+"""Legacy-VTK STRUCTURED_POINTS writer (ASCII and BINARY big-endian).
+
+Byte-format parity with /root/reference/assignment-6/src/vtkWriter.c:
+header (:43-66), `SCALARS <name> double 1` + LOOKUP_TABLE with `%f` per line
+(:83-105,116), `VECTORS <name> double` with `%f %f %f` (:146-175), binary
+mode = big-endian float64 stream terminated by a newline (floatSwap :24-41).
+Values are cell-centered (ORIGIN at dx/2), i fastest, then j, then k.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .grid import Grid
+
+
+class VtkWriter:
+    def __init__(self, problem: str, grid: Grid, fmt: str = "ascii", path=None):
+        assert fmt in ("ascii", "binary")
+        self.grid = grid
+        self.fmt = fmt
+        self.path = path or f"{problem}.vtk"
+        self.fh = open(self.path, "wb")
+        self._header(problem)
+
+    def _w(self, s: str) -> None:
+        self.fh.write(s.encode())
+
+    def _header(self, problem: str) -> None:
+        g = self.grid
+        self._w("# vtk DataFile Version 3.0\n")
+        self._w("PAMPI cfd solver output\n")
+        self._w("ASCII\n" if self.fmt == "ascii" else "BINARY\n")
+        self._w("DATASET STRUCTURED_POINTS\n")
+        self._w("DIMENSIONS %d %d %d\n" % (g.imax, g.jmax, g.kmax))
+        self._w("ORIGIN %f %f %f\n" % (g.dx * 0.5, g.dy * 0.5, g.dz * 0.5))
+        self._w("SPACING %f %f %f\n" % (g.dx, g.dy, g.dz))
+        self._w("POINT_DATA %d\n" % (g.imax * g.jmax * g.kmax))
+
+    def scalar(self, name: str, s) -> None:
+        """s: (kmax, jmax, imax) cell-centered array."""
+        arr = np.asarray(s, dtype=np.float64)
+        self._w("SCALARS %s double 1\n" % name)
+        self._w("LOOKUP_TABLE default\n")
+        if self.fmt == "ascii":
+            self._w("".join("%f\n" % val for val in arr.ravel()))
+        else:
+            self.fh.write(arr.astype(">f8").tobytes())
+            self._w("\n")
+
+    def vector(self, name: str, u, v, w) -> None:
+        """u, v, w: (kmax, jmax, imax) cell-centered arrays."""
+        uu = np.asarray(u, dtype=np.float64).ravel()
+        vv = np.asarray(v, dtype=np.float64).ravel()
+        ww = np.asarray(w, dtype=np.float64).ravel()
+        self._w("VECTORS %s double\n" % name)
+        if self.fmt == "ascii":
+            self._w(
+                "".join(
+                    "%f %f %f\n" % (a, b, c) for a, b, c in zip(uu, vv, ww)
+                )
+            )
+        else:
+            inter = np.stack([uu, vv, ww], axis=1).astype(">f8")
+            self.fh.write(inter.tobytes())
+            self._w("\n")
+
+    def close(self) -> None:
+        self.fh.close()
+
+
+def read_vtk_ascii(path: str):
+    """Parse an ASCII legacy VTK file back into {name: array} dicts for
+    regression tests. Scalars -> (kmax, jmax, imax); vectors -> tuple of 3."""
+    scalars, vectors = {}, {}
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    dims = None
+    i = 0
+    while i < len(lines):
+        ln = lines[i].split()
+        if not ln:
+            i += 1
+            continue
+        if ln[0] == "DIMENSIONS":
+            dims = (int(ln[3]), int(ln[2]), int(ln[1]))  # (kmax, jmax, imax)
+        elif ln[0] == "SCALARS":
+            name = ln[1]
+            n = dims[0] * dims[1] * dims[2]
+            vals = []
+            j = i + 2  # skip LOOKUP_TABLE
+            while len(vals) < n:
+                vals.extend(float(x) for x in lines[j].split())
+                j += 1
+            scalars[name] = np.array(vals).reshape(dims)
+            i = j - 1
+        elif ln[0] == "VECTORS":
+            name = ln[1]
+            n = dims[0] * dims[1] * dims[2]
+            vals = []
+            j = i + 1
+            while len(vals) < 3 * n:
+                vals.extend(float(x) for x in lines[j].split())
+                j += 1
+            arr = np.array(vals).reshape(n, 3)
+            vectors[name] = tuple(arr[:, c].reshape(dims) for c in range(3))
+            i = j - 1
+        i += 1
+    return scalars, vectors

@@ -1,0 +1,37 @@
+"""Distributed NS-3D: exact equality with the single-device solver on 3-D
+mesh shapes (the capability assignment-6 leaves as an unfinished skeleton,
+completed here; equivalence policy in models/ns3d_dist.py)."""
+
+import numpy as np
+import pytest
+
+from pampi_tpu.models.ns3d import NS3DSolver
+from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+from pampi_tpu.parallel.comm import CartComm
+from pampi_tpu.utils.params import read_parameter
+
+
+def _compare(param, dims):
+    single = NS3DSolver(param)
+    single.run(progress=False)
+    dist = NS3DDistSolver(param, CartComm(ndims=3, dims=dims))
+    dist.run(progress=False)
+    assert dist.nt == single.nt
+    for a, b in zip(single.collect(), dist.collect()):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("dims", [(2, 2, 2), (1, 2, 4), (4, 2, 1)])
+def test_dcavity3d_dist_exact_vs_single(reference_dir, dims):
+    param = read_parameter(
+        str(reference_dir / "assignment-6" / "dcavity.par")
+    ).replace(imax=16, jmax=16, kmax=16, te=0.5, re=100.0)
+    _compare(param, dims)
+
+
+def test_canal3d_dist_exact_vs_single(reference_dir):
+    # outflow + uniform-inflow special BC across a full 3-D decomposition
+    param = read_parameter(
+        str(reference_dir / "assignment-6" / "canal.par")
+    ).replace(imax=48, jmax=16, kmax=16, te=0.5)
+    _compare(param, (2, 2, 2))

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Regenerate the vs_baseline constant in bench.py: throughput of the
+# reference's assignment-4 C solver at 4096^2 (single core), x8 for the
+# 8-rank MPI baseline named in BASELINE.json.
+set -e
+work=$(mktemp -d)
+cp -r /root/reference/assignment-4/src "$work/src"
+gcc -O3 -march=native -o "$work/poisson" "$work"/src/*.c -lm
+cat > "$work/big.par" <<EOF
+name poisson
+xlength 1.0
+ylength 1.0
+imax 4096
+jmax 4096
+itermax 20
+eps 0.0
+omg 1.9
+EOF
+cd "$work"
+out=$(./poisson big.par | tail -1)  # "20 Walltime X.XXs"
+secs=$(echo "$out" | sed 's/.*Walltime \([0-9.]*\)s/\1/')
+python3 - "$secs" <<'EOF'
+import sys
+secs = float(sys.argv[1])
+ups = 4096*4096*20/secs
+print(f"C single-core: {ups:.3e} updates/s; 8-rank proxy: {8*ups:.3e}")
+EOF
