@@ -17,8 +17,13 @@ import sys
 def main(argv=None) -> int:
     argv = sys.argv if argv is None else argv
     if len(argv) < 2:
-        print(f"Usage: {argv[0]} <configFile>")
+        print(f"Usage: {argv[0]} <configFile>  |  {argv[0]} <N> <iter>")
         return 0
+    if argv[1].isdigit():
+        # DMVM mode (≙ assignment-3a/3b CLI: ./exe <N> <iter>)
+        from .models.dmvm import main as dmvm_main
+
+        return dmvm_main(argv)
     return _run(argv)
 
 

@@ -66,10 +66,28 @@ def sor_pass_3d(p, rhs, mask, factor, idx2, idy2, idz2):
     return p, jnp.sum(r * r)
 
 
-def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax, dtype):
+def sor_coefficients_3d(dx, dy, dz, omega):
+    """(factor, idx2, idy2, idz2) of the 3-D SOR update (solver.c:186-196) —
+    the single source of truth for both the single-device and distributed
+    solvers."""
     dx2, dy2, dz2 = dx * dx, dy * dy, dz * dz
-    idx2, idy2, idz2 = 1.0 / dx2, 1.0 / dy2, 1.0 / dz2
     factor = omega * 0.5 * (dx2 * dy2 * dz2) / (dy2 * dz2 + dx2 * dz2 + dx2 * dy2)
+    return factor, 1.0 / dx2, 1.0 / dy2, 1.0 / dz2
+
+
+def write_vtk_result(param, grid, fields, path=None, fmt: str = "ascii") -> None:
+    """VTK output (main.c:100-106): scalar pressure + vector velocity.
+    fields = (ug, vg, wg, pg) cell-centered global arrays."""
+    ug, vg, wg, pg = fields
+    problem = param.name.replace("3d", "")
+    writer = VtkWriter(problem, grid, fmt=fmt, path=path)
+    writer.scalar("pressure", pg)
+    writer.vector("velocity", ug, vg, wg)
+    writer.close()
+
+
+def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax, dtype):
+    factor, idx2, idy2, idz2 = sor_coefficients_3d(dx, dy, dz, omega)
     odd = checkerboard_mask_3d(kmax, jmax, imax, 1, dtype)
     even = checkerboard_mask_3d(kmax, jmax, imax, 0, dtype)
     norm = float(imax * jmax * kmax)
@@ -216,10 +234,4 @@ class NS3DSolver:
         return ug, vg, wg, pg
 
     def write_result(self, path=None, fmt: str = "ascii") -> None:
-        """VTK output (main.c:100-106): scalar pressure + vector velocity."""
-        ug, vg, wg, pg = self.collect()
-        problem = self.param.name.replace("3d", "")
-        writer = VtkWriter(problem, self.grid, fmt=fmt, path=path)
-        writer.scalar("pressure", pg)
-        writer.vector("velocity", ug, vg, wg)
-        writer.close()
+        write_vtk_result(self.param, self.grid, self.collect(), path, fmt)

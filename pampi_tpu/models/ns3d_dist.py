@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops import ns3d as ops
+from .ns3d import sor_coefficients_3d, sor_pass_3d, write_vtk_result
 from ..parallel.comm import (
     CartComm,
     halo_exchange,
@@ -148,26 +149,12 @@ class NS3DDistSolver:
             return f, g_, h
 
         # -- pressure solve --------------------------------------------
-        dx2, dy2, dz2 = dx * dx, dy * dy, dz * dz
-        idx2, idy2, idz2 = 1.0 / dx2, 1.0 / dy2, 1.0 / dz2
-        factor = (
-            param.omg * 0.5 * (dx2 * dy2 * dz2) / (dy2 * dz2 + dx2 * dz2 + dx2 * dy2)
-        )
+        factor, idx2, idy2, idz2 = sor_coefficients_3d(dx, dy, dz, param.omg)
         epssq = param.eps * param.eps
         norm = float(g.imax * g.jmax * g.kmax)
 
         def half_sweep(p, rhs, mask):
-            lap = (
-                (p[1:-1, 1:-1, 2:] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[1:-1, 1:-1, :-2])
-                * idx2
-                + (p[1:-1, 2:, 1:-1] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[1:-1, :-2, 1:-1])
-                * idy2
-                + (p[2:, 1:-1, 1:-1] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[:-2, 1:-1, 1:-1])
-                * idz2
-            )
-            r = (rhs[1:-1, 1:-1, 1:-1] - lap) * mask
-            p = p.at[1:-1, 1:-1, 1:-1].add(-factor * r)
-            return p, jnp.sum(r * r)
+            return sor_pass_3d(p, rhs, mask, factor, idx2, idy2, idz2)
 
         def solve(p, rhs):
             odd, even = global_checkerboard_masks_3d(kl, jl, il, dtype)
@@ -314,9 +301,4 @@ class NS3DDistSolver:
         )
 
     def write_result(self, path=None, fmt: str = "ascii") -> None:
-        ug, vg, wg, pg = self.collect()
-        problem = self.param.name.replace("3d", "")
-        writer = VtkWriter(problem, self.grid, fmt=fmt, path=path)
-        writer.scalar("pressure", pg)
-        writer.vector("velocity", ug, vg, wg)
-        writer.close()
+        write_vtk_result(self.param, self.grid, self.collect(), path, fmt)

@@ -275,6 +275,7 @@ def compute_timestep_3d(u, v, w, dt_bound, dx, dy, dz, tau):
 def normalize_pressure_3d(p, imax, jmax, kmax):
     """Interior-only mean subtract, normalized by imax·jmax·kmax
     (normalizePressure, solver.c:312-338 — NOTE: unlike the 2-D sequential
-    variant, ghosts are excluded)."""
+    variant, ghosts are excluded). API-parity function: the reference defines
+    it but its 3-D main loop never calls it (main.c:50-67) — same here."""
     avg = jnp.sum(p[1:-1, 1:-1, 1:-1]) / float(imax * jmax * kmax)
     return p.at[1:-1, 1:-1, 1:-1].add(-avg)

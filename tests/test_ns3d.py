@@ -82,3 +82,16 @@ def test_vtk_roundtrip(tmp_path):
     idx = raw.index(b"LOOKUP_TABLE default\n") + len(b"LOOKUP_TABLE default\n")
     vals = np.frombuffer(raw[idx : idx + 8 * s.size], dtype=">f8")
     np.testing.assert_array_equal(vals.reshape(s.shape), s)
+
+
+def test_normalize_pressure_3d_interior_only():
+    import jax.numpy as jnp
+
+    from pampi_tpu.ops.ns3d import normalize_pressure_3d
+
+    p = jnp.arange(5 * 4 * 6, dtype=jnp.float64).reshape(5, 4, 6)
+    out = normalize_pressure_3d(p, imax=4, jmax=2, kmax=3)
+    interior = out[1:-1, 1:-1, 1:-1]
+    assert abs(float(interior.mean())) < 1e-12
+    # ghosts untouched
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(p[0]))
