@@ -1,0 +1,51 @@
+# PAMPI-TPU top-level build — native runtime layer + exe shim.
+#
+# Interface parity with the reference's out-of-tree Make build
+# (/root/reference/assignment-6/Makefile:9-34): objects land in
+# build/$(TAG)/, `make TAG=<tag>` switches toolchains via include_<TAG>.mk,
+# and the result is a runnable `./exe-$(TAG) <file.par>`. The compute path
+# is the JAX process; this builds the native layer around it
+# (native/src: parser, allocator, writers, shim).
+#
+# Targets:
+#   make            exe-$(TAG) + build/$(TAG)/libpampi_native.so
+#   make test       native smoke test (shim --dry-run on configs/)
+#   make clean      remove build/$(TAG) and exe-$(TAG)
+#   make distclean  remove build/ and all exes
+
+include config.mk
+include include_$(TAG).mk
+
+BUILD := build/$(TAG)
+SRC := native/src
+LIBSRCS := $(SRC)/param.c $(SRC)/alloc.c $(SRC)/writers.c
+LIBOBJS := $(patsubst $(SRC)/%.c,$(BUILD)/%.o,$(LIBSRCS))
+SHIMOBJ := $(BUILD)/shim_main.o
+
+CPPFLAGS := $(DEFINES) $(OPTIONS) -I$(SRC)
+
+all: exe-$(TAG) $(BUILD)/libpampi_native.so
+
+$(BUILD):
+	mkdir -p $(BUILD)
+
+$(BUILD)/%.o: $(SRC)/%.c $(SRC)/pampi.h | $(BUILD)
+	$(CC) $(CFLAGS) $(CPPFLAGS) -c -o $@ $<
+
+exe-$(TAG): $(SHIMOBJ) $(LIBOBJS)
+	$(CC) $(CFLAGS) -o $@ $^ -lm
+
+$(BUILD)/libpampi_native.so: $(LIBOBJS)
+	$(CC) $(CFLAGS) -shared -o $@ $^ -lm
+
+test: all
+	./exe-$(TAG) --dry-run configs/poisson.par
+	./exe-$(TAG) --dry-run configs/dcavity3d.par
+
+clean:
+	rm -rf $(BUILD) exe-$(TAG)
+
+distclean:
+	rm -rf build exe-*
+
+.PHONY: all test clean distclean

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from pampi_tpu.models.poisson import init_fields, make_rb_step
+from pampi_tpu.models.poisson import init_fields, make_rb_loop
 from pampi_tpu.utils.params import Parameter
 
 BASELINE_8RANK_UPDATES_PER_S = 1.32e9  # see module docstring
@@ -40,7 +40,9 @@ ITERS = 100
 def main() -> None:
     param = Parameter(imax=N, jmax=N, tpu_dtype="float32")
     p, rhs = init_fields(param, problem=2, dtype=jnp.float32)
-    step = make_rb_step(N, N, 1.0 / N, 1.0 / N, 1.9, jnp.float32)
+    # prep carries the pallas padded layout through the loop (identity on jnp)
+    step, prep, _post = make_rb_loop(N, N, 1.0 / N, 1.0 / N, 1.9, jnp.float32)
+    p, rhs = prep(p), prep(rhs)
 
     @jax.jit
     def run_iters(p, rhs):

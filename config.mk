@@ -1,0 +1,20 @@
+# PAMPI-TPU build configuration (capability parity with the reference's
+# config.mk switchboard, /root/reference/assignment-6/config.mk:72-84, with
+# the TPU backend in place of the MPI toolchain matrix).
+
+# Backend/toolchain tag: JAX (TPU backend via the python driver) or GCC
+# (native lib + shim only, no backend default). include_<TAG>.mk supplies
+# the toolchain specifics.
+TAG ?= JAX
+
+# Feature switches (≙ ENABLE_MPI/ENABLE_OPENMP): the TPU equivalents are
+# runtime .par keys (tpu_mesh, tpu_dtype); build-time switches below control
+# the native layer only.
+#
+# OPTIONS become -D defines in the native shim and PAMPI_* env vars for the
+# JAX process (≙ config.mk OPTIONS VERBOSE/DEBUG/...).
+#OPTIONS += -DVERBOSE
+#OPTIONS += -DDEBUG
+
+# Host array alignment for pampi_allocate callers
+OPTIONS += -DARRAY_ALIGNMENT=64

@@ -1,0 +1,5 @@
+# Plain-GCC native build (lib + shim; the shim still delegates to whatever
+# python is on PATH at run time).
+CC = gcc
+CFLAGS = -O3 -std=c99 -D_POSIX_C_SOURCE=200809L -Wall -Wextra -fPIC
+DEFINES =

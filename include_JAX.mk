@@ -1,0 +1,8 @@
+# TPU backend via the JAX process (≙ include_<TAG>.mk toolchain files,
+# e.g. /root/reference/assignment-6/include_CLANG.mk — here the "toolchain"
+# is the C host compiler for the native layer plus the Python interpreter
+# that owns the XLA/Pallas compute path).
+CC = gcc
+CFLAGS = -O3 -std=c99 -D_POSIX_C_SOURCE=200809L -Wall -Wextra -fPIC
+PAMPI_PYTHON ?= python
+DEFINES = -DPAMPI_PYTHON_DEFAULT=\"$(PAMPI_PYTHON)\"

@@ -1,0 +1,21 @@
+/* Aligned host allocation (capability parity with the reference's
+ * allocate.c posix_memalign wrapper, /root/reference/assignment-4/src/
+ * allocate.c:11-37 — same contract: aligned or die loudly). */
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "pampi.h"
+
+void *pampi_allocate(size_t alignment, size_t bytes) {
+    void *p = NULL;
+    int rc = posix_memalign(&p, alignment, bytes);
+    if (rc != 0 || p == NULL) {
+        fprintf(stderr, "pampi_allocate: %zu bytes @%zu failed: %s\n", bytes,
+                alignment, rc == EINVAL ? "bad alignment" : "out of memory");
+        exit(EXIT_FAILURE);
+    }
+    return p;
+}
+
+void pampi_deallocate(void *p) { free(p); }

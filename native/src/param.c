@@ -1,0 +1,188 @@
+/* Table-driven .par parser + config echo.
+ *
+ * Grammar parity with the reference's parameter.c (/root/reference/
+ * assignment-6/src/parameter.c:31-93): '#' starts a comment, the first two
+ * whitespace-separated tokens are key and value, keys are matched by PREFIX
+ * (a token `imaxFoo` still sets `imax`), unknown keys are ignored, every key
+ * has a default. The echo format matches printParameter (:95-126) and the
+ * Python twin pampi_tpu/utils/params.py `print_parameter`.
+ *
+ * Design is deliberately different from the reference's PARSE_* macro
+ * ladder: one descriptor table drives parsing, so adding a key is one line.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "pampi.h"
+
+typedef enum { T_DBL, T_LONG, T_STR } FieldType;
+
+typedef struct {
+    const char *key;
+    FieldType type;
+    size_t off;
+    size_t strcap;  /* for T_STR */
+    unsigned seenbit; /* 0 if untracked */
+} FieldDesc;
+
+#define F_DBL(k, m) {#k, T_DBL, offsetof(PampiParam, m), 0, 0}
+#define F_LONG(k, m, bit) {#k, T_LONG, offsetof(PampiParam, m), 0, bit}
+#define F_STR(k, m) {#k, T_STR, offsetof(PampiParam, m), sizeof(((PampiParam *)0)->m), 0}
+
+static const FieldDesc FIELDS[] = {
+    F_DBL(xlength, xlength),
+    F_DBL(ylength, ylength),
+    {"zlength", T_DBL, offsetof(PampiParam, zlength), 0, PAMPI_SEEN_ZLENGTH},
+    F_LONG(imax, imax, 0),
+    F_LONG(jmax, jmax, 0),
+    F_LONG(kmax, kmax, PAMPI_SEEN_KMAX),
+    F_LONG(itermax, itermax, 0),
+    F_DBL(eps, eps),
+    F_DBL(omg, omg),
+    F_DBL(rho, rho),
+    F_DBL(re, re),
+    F_DBL(tau, tau),
+    F_DBL(gamma, gamma),
+    F_DBL(dt, dt),
+    F_DBL(te, te),
+    F_DBL(gx, gx),
+    F_DBL(gy, gy),
+    F_DBL(gz, gz),
+    F_STR(name, name),
+    F_LONG(bcLeft, bcLeft, 0),
+    F_LONG(bcRight, bcRight, 0),
+    F_LONG(bcBottom, bcBottom, 0),
+    F_LONG(bcTop, bcTop, 0),
+    F_LONG(bcFront, bcFront, PAMPI_SEEN_BCFRONT),
+    F_LONG(bcBack, bcBack, PAMPI_SEEN_BCBACK),
+    F_DBL(u_init, u_init),
+    F_DBL(v_init, v_init),
+    F_DBL(w_init, w_init),
+    F_DBL(p_init, p_init),
+    F_STR(tpu_mesh, tpu_mesh),
+    F_STR(tpu_dtype, tpu_dtype),
+};
+enum { NFIELDS = sizeof(FIELDS) / sizeof(FIELDS[0]) };
+
+void pampi_param_init(PampiParam *p) {
+    memset(p, 0, sizeof(*p));
+    p->xlength = p->ylength = p->zlength = 1.0;
+    p->imax = p->jmax = 100;
+    p->kmax = 50;
+    p->itermax = 1000;
+    p->eps = 0.0001;
+    p->omg = 1.7;
+    p->rho = 0.99;
+    p->re = 100.0;
+    p->tau = 0.5;
+    p->gamma = 0.9;
+    p->dt = 0.02;
+    p->te = 10.0;
+    snprintf(p->name, sizeof(p->name), "poisson");
+    p->bcLeft = p->bcRight = p->bcBottom = p->bcTop = 1;
+    p->bcFront = p->bcBack = 1;
+    snprintf(p->tpu_mesh, sizeof(p->tpu_mesh), "auto");
+    snprintf(p->tpu_dtype, sizeof(p->tpu_dtype), "float64");
+}
+
+/* returns 0, or -1 on a malformed numeric value (parity: params.py
+ * read_parameter exits with "bad value ... for parameter ...") */
+static int assign(PampiParam *p, const FieldDesc *f, const char *val) {
+    char *base = (char *)p;
+    char *end = NULL;
+    switch (f->type) {
+    case T_DBL:
+        *(double *)(base + f->off) = strtod(val, &end);
+        break;
+    case T_LONG:
+        *(long *)(base + f->off) = strtol(val, &end, 10);
+        break;
+    case T_STR:
+        snprintf(base + f->off, f->strcap, "%s", val);
+        break;
+    }
+    if (end && (end == val || *end != '\0')) {
+        fprintf(stderr, "bad value '%s' for parameter %s\n", val, f->key);
+        return -1;
+    }
+    p->seen |= f->seenbit;
+    return 0;
+}
+
+int pampi_param_read(PampiParam *p, const char *path) {
+    FILE *fh = fopen(path, "r");
+    if (!fh) {
+        fprintf(stderr, "Could not open parameter file: %s\n", path);
+        return -1;
+    }
+    char line[1024];
+    while (fgets(line, sizeof(line), fh)) {
+        char *hash = strchr(line, '#');
+        if (hash)
+            *hash = '\0';
+        char *save = NULL;
+        char *tok = strtok_r(line, " \t\r\n", &save);
+        char *val = tok ? strtok_r(NULL, " \t\r\n", &save) : NULL;
+        if (!tok || !val)
+            continue;
+        /* reference semantics: every key that prefixes the token matches */
+        for (int i = 0; i < NFIELDS; i++)
+            if (strncmp(tok, FIELDS[i].key, strlen(FIELDS[i].key)) == 0)
+                if (assign(p, &FIELDS[i], val) != 0) {
+                    fclose(fh);
+                    return -1;
+                }
+    }
+    fclose(fh);
+    return 0;
+}
+
+int pampi_param_is3d(const PampiParam *p) {
+    size_t n = strlen(p->name);
+    if (n >= 2 && strcmp(p->name + n - 2, "3d") == 0)
+        return 1;
+    return (p->seen & (PAMPI_SEEN_KMAX | PAMPI_SEEN_ZLENGTH |
+                       PAMPI_SEEN_BCFRONT | PAMPI_SEEN_BCBACK)) != 0;
+}
+
+void pampi_param_print(const PampiParam *p, FILE *out) {
+    int d3 = pampi_param_is3d(p);
+    fprintf(out, "Parameters for %s\n", p->name);
+    if (d3)
+        fprintf(out,
+                "Boundary conditions Left:%ld Right:%ld Bottom:%ld Top:%ld "
+                "Front:%ld Back:%ld\n",
+                p->bcLeft, p->bcRight, p->bcBottom, p->bcTop, p->bcFront,
+                p->bcBack);
+    else
+        fprintf(out,
+                "Boundary conditions Left:%ld Right:%ld Bottom:%ld Top:%ld\n",
+                p->bcLeft, p->bcRight, p->bcBottom, p->bcTop);
+    fprintf(out, "\tReynolds number: %.2f\n", p->re);
+    if (d3)
+        fprintf(out, "\tInit arrays: U:%.2f V:%.2f W:%.2f P:%.2f\n", p->u_init,
+                p->v_init, p->w_init, p->p_init);
+    else
+        fprintf(out, "\tInit arrays: U:%.2f V:%.2f P:%.2f\n", p->u_init,
+                p->v_init, p->p_init);
+    fprintf(out, "Geometry data:\n");
+    if (d3) {
+        fprintf(out, "\tDomain box size (x, y, z): %.2f, %.2f, %.2f\n",
+                p->xlength, p->ylength, p->zlength);
+        fprintf(out, "\tCells (x, y, z): %ld, %ld, %ld\n", p->imax, p->jmax,
+                p->kmax);
+    } else {
+        fprintf(out, "\tDomain box size (x, y): %.2f, %.2f\n", p->xlength,
+                p->ylength);
+        fprintf(out, "\tCells (x, y): %ld, %ld\n", p->imax, p->jmax);
+    }
+    fprintf(out, "Timestep parameters:\n");
+    fprintf(out, "\tDefault stepsize: %.2f, Final time %.2f\n", p->dt, p->te);
+    fprintf(out, "\tTau factor: %.2f\n", p->tau);
+    fprintf(out, "Iterative solver parameters:\n");
+    fprintf(out, "\tMax iterations: %ld\n", p->itermax);
+    fprintf(out, "\tepsilon (stopping tolerance) : %f\n", p->eps);
+    fprintf(out, "\tgamma factor: %f\n", p->gamma);
+    fprintf(out, "\tomega (SOR relaxation): %f\n", p->omg);
+}

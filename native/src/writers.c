@@ -1,0 +1,163 @@
+/* Buffered .dat and legacy-VTK writers.
+ *
+ * Byte-compatible with the Python writers (pampi_tpu/utils/datio.py,
+ * vtkio.py), which themselves carry format parity with the reference's
+ * output layer (assignment-4/src/solver.c writeResult, assignment-5
+ * writeResult, assignment-6/src/vtkWriter.c). Used from Python via ctypes
+ * (pampi_tpu/utils/native.py) to take the per-value printf loop out of the
+ * interpreter for large fields.
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "pampi.h"
+
+#define IOBUF (1 << 20)
+
+static FILE *open_buffered(const char *path, char **buf) {
+    FILE *fh = fopen(path, "wb");
+    if (!fh)
+        return NULL;
+    *buf = malloc(IOBUF);
+    if (*buf)
+        setvbuf(fh, *buf, _IOFBF, IOBUF);
+    return fh;
+}
+
+/* close + error check: a short write (ENOSPC, quota) must NOT look like
+ * success to the Python caller */
+static int close_checked(FILE *fh, char *buf) {
+    int bad = ferror(fh);
+    int rc = fclose(fh);
+    free(buf);
+    return (bad || rc != 0) ? -1 : 0;
+}
+
+int pampi_write_matrix(const char *path, const double *a, long rows,
+                       long cols) {
+    char *buf = NULL;
+    FILE *fh = open_buffered(path, &buf);
+    if (!fh)
+        return -1;
+    for (long j = 0; j < rows; j++) {
+        for (long i = 0; i < cols; i++)
+            fprintf(fh, "%f ", a[j * cols + i]);
+        fputc('\n', fh);
+    }
+    return close_checked(fh, buf);
+}
+
+int pampi_write_pressure(const char *path, const double *p, long rows,
+                         long cols, double dx, double dy) {
+    char *buf = NULL;
+    FILE *fh = open_buffered(path, &buf);
+    if (!fh)
+        return -1;
+    long jmax = rows - 2, imax = cols - 2;
+    for (long j = 1; j <= jmax; j++) {
+        double y = (j - 0.5) * dy;
+        for (long i = 1; i <= imax; i++)
+            fprintf(fh, "%.2f %.2f %f\n", (i - 0.5) * dx, y, p[j * cols + i]);
+        fputc('\n', fh);
+    }
+    return close_checked(fh, buf);
+}
+
+int pampi_write_velocity(const char *path, const double *u, const double *v,
+                         long rows, long cols, double dx, double dy) {
+    char *buf = NULL;
+    FILE *fh = open_buffered(path, &buf);
+    if (!fh)
+        return -1;
+    long jmax = rows - 2, imax = cols - 2;
+    for (long j = 1; j <= jmax; j++) {
+        double y = dy * (j - 0.5);
+        for (long i = 1; i <= imax; i++) {
+            double uc = (u[j * cols + i] + u[j * cols + i - 1]) / 2.0;
+            double vc = (v[j * cols + i] + v[(j - 1) * cols + i]) / 2.0;
+            double ln = __builtin_sqrt(uc * uc + vc * vc);
+            fprintf(fh, "%.2f %.2f %f %f %f\n", dx * (i - 0.5), y, uc, vc, ln);
+        }
+    }
+    return close_checked(fh, buf);
+}
+
+/* ---- VTK ---- */
+
+struct PampiVtk {
+    FILE *fh;
+    char *buf;
+    int binary;
+};
+
+PampiVtk *pampi_vtk_open(const char *path, const char *title, long imax,
+                         long jmax, long kmax, double dx, double dy, double dz,
+                         int binary) {
+    PampiVtk *w = malloc(sizeof(*w));
+    if (!w)
+        return NULL;
+    w->binary = binary;
+    w->fh = open_buffered(path, &w->buf);
+    if (!w->fh) {
+        free(w);
+        return NULL;
+    }
+    fprintf(w->fh, "# vtk DataFile Version 3.0\n");
+    fprintf(w->fh, "%s\n", title);
+    fprintf(w->fh, "%s\n", binary ? "BINARY" : "ASCII");
+    fprintf(w->fh, "DATASET STRUCTURED_POINTS\n");
+    fprintf(w->fh, "DIMENSIONS %ld %ld %ld\n", imax, jmax, kmax);
+    fprintf(w->fh, "ORIGIN %f %f %f\n", dx * 0.5, dy * 0.5, dz * 0.5);
+    fprintf(w->fh, "SPACING %f %f %f\n", dx, dy, dz);
+    fprintf(w->fh, "POINT_DATA %ld\n", imax * jmax * kmax);
+    return w;
+}
+
+/* big-endian IEEE-754 double on the wire (parity: vtkWriter.c floatSwap) */
+static void put_be64(FILE *fh, double v) {
+    uint64_t bits;
+    memcpy(&bits, &v, 8);
+    unsigned char be[8];
+    for (int b = 0; b < 8; b++)
+        be[b] = (unsigned char)(bits >> (56 - 8 * b));
+    fwrite(be, 1, 8, fh);
+}
+
+int pampi_vtk_scalar(PampiVtk *w, const char *name, const double *s, long n) {
+    fprintf(w->fh, "SCALARS %s double 1\n", name);
+    fprintf(w->fh, "LOOKUP_TABLE default\n");
+    if (w->binary) {
+        for (long i = 0; i < n; i++)
+            put_be64(w->fh, s[i]);
+        fputc('\n', w->fh);
+    } else {
+        for (long i = 0; i < n; i++)
+            fprintf(w->fh, "%f\n", s[i]);
+    }
+    return ferror(w->fh) ? -1 : 0;
+}
+
+int pampi_vtk_vector(PampiVtk *w, const char *name, const double *u,
+                     const double *v, const double *wv, long n) {
+    fprintf(w->fh, "VECTORS %s double\n", name);
+    if (w->binary) {
+        for (long i = 0; i < n; i++) {
+            put_be64(w->fh, u[i]);
+            put_be64(w->fh, v[i]);
+            put_be64(w->fh, wv[i]);
+        }
+        fputc('\n', w->fh);
+    } else {
+        for (long i = 0; i < n; i++)
+            fprintf(w->fh, "%f %f %f\n", u[i], v[i], wv[i]);
+    }
+    return ferror(w->fh) ? -1 : 0;
+}
+
+int pampi_vtk_close(PampiVtk *w) {
+    int rc = close_checked(w->fh, w->buf);
+    free(w);
+    return rc;
+}

@@ -51,15 +51,78 @@ def init_fields(param: Parameter, problem: int = 2, dtype=jnp.float64):
     return jnp.asarray(p, dtype=dtype), jnp.asarray(rhs, dtype=dtype)
 
 
-def make_rb_step(imax, jmax, dx, dy, omega, dtype):
+def _use_pallas(backend: str) -> bool:
+    if backend == "pallas":
+        return True
+    if backend != "auto" or jax.default_backend() != "tpu":
+        return False
+    from ..ops import sor_pallas as sp
+
+    return sp.pltpu is not None  # pallas TPU backend importable
+
+
+def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
+    """Public dispatcher for loop-carried use: returns (step, prep, post)
+    where prep/post convert the loop-carried array at the boundary (padded
+    layout under pallas, identity under jnp). The single decision point for
+    the backend choice — bench.py and the solvers both go through here."""
+    if _use_pallas(backend):
+        return make_rb_step_padded(imax, jmax, dx, dy, omega, dtype)
+    step = make_rb_step(imax, jmax, dx, dy, omega, dtype, backend="jnp")
+    ident = lambda x: x  # noqa: E731
+    return step, ident, ident
+
+
+def make_rb_step_padded(imax, jmax, dx, dy, omega, dtype, interpret=None):
+    """Pallas-backed red-black iteration on the PADDED layout
+    (ops/sor_pallas.py): returns (step, pad, unpad) where step is
+    (p_pad, rhs_pad) -> (p_pad', normalized res) incl. the Neumann ghost
+    copy. The caller carries the padded array through its loop and converts
+    at the boundary only."""
+    from ..ops import sor_pallas as sp
+
+    rb_iter, block_rows = sp.make_rb_iter_pallas(
+        imax, jmax, dx, dy, omega, dtype, interpret=interpret
+    )
+    if rb_iter is None:
+        raise ValueError("pallas backend unavailable")
+    norm = float(imax * jmax)
+
+    def step(p_pad, rhs_pad):
+        p_pad, rsq = rb_iter(p_pad, rhs_pad)
+        return sp.neumann_bc_padded(p_pad, jmax, imax), rsq / norm
+
+    def pad(x):
+        return sp.pad_array(x, block_rows)
+
+    def unpad(xp):
+        return sp.unpad_array(xp, jmax)
+
+    return step, pad, unpad
+
+
+def make_rb_step(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
     """Build one red-black SOR iteration: red half-sweep, black half-sweep
-    (seeing red's updates), Neumann ghost copy, normalized residual."""
+    (seeing red's updates), Neumann ghost copy, normalized residual.
+
+    backend: "jnp" (masked fused-XLA passes), "pallas" (ops/sor_pallas.py
+    blocked in-place kernel, pad/unpad per call — for loop-carried use go
+    through make_rb_step_padded), or "auto" (pallas on TPU)."""
+    norm = float(imax * jmax)
+    if _use_pallas(backend):
+        pstep, pad, unpad = make_rb_step_padded(imax, jmax, dx, dy, omega, dtype)
+
+        def step(p, rhs):
+            p_pad, res = pstep(pad(p), pad(rhs))
+            return unpad(p_pad), res
+
+        return step
+
     dx2, dy2 = dx * dx, dy * dy
     idx2, idy2 = 1.0 / dx2, 1.0 / dy2
     factor = omega * 0.5 * (dx2 * dy2) / (dx2 + dy2)
     red = checkerboard_mask(jmax, imax, 0, dtype)
     black = checkerboard_mask(jmax, imax, 1, dtype)
-    norm = float(imax * jmax)
 
     def step(p, rhs):
         p, r0 = sor_pass(p, rhs, red, factor, idx2, idy2)
@@ -70,12 +133,17 @@ def make_rb_step(imax, jmax, dx, dy, omega, dtype):
     return step
 
 
-def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype):
-    """The full convergence loop as one jittable function (p0, rhs) -> (p, res, it)."""
-    step = make_rb_step(imax, jmax, dx, dy, omega, dtype)
+def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype, backend="auto"):
+    """The full convergence loop as one jittable function (p0, rhs) -> (p, res, it).
+
+    On the pallas backend the loop carries the PADDED array (one pad before,
+    one unpad after — no per-iteration layout conversion)."""
     epssq = eps * eps
+    step, prep, post = make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend)
 
     def solve(p0, rhs):
+        rhs = prep(rhs)
+
         def cond(carry):
             _, res, it = carry
             return jnp.logical_and(res >= epssq, it < itermax)
@@ -85,8 +153,9 @@ def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype):
             p, res = step(p, rhs)
             return p, res, it + 1
 
-        init = (p0, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
-        return jax.lax.while_loop(cond, body, init)
+        init = (prep(p0), jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        p, res, it = jax.lax.while_loop(cond, body, init)
+        return post(p), res, it
 
     return solve
 

@@ -1,0 +1,233 @@
+"""Red-black SOR as a Pallas TPU kernel — the framework's hot op.
+
+Capability parity: the reference's red-black Poisson kernels
+(/root/reference/assignment-4/src/solver.c: solveRB:179, solveRBA:240),
+re-designed for the TPU memory hierarchy instead of translated:
+
+- One `pallas_call` performs a FULL red-black iteration (both half-sweeps +
+  the residual reduction). The jnp fallback (`ops/sor.py`) issues two fused
+  XLA passes per iteration, each streaming p and rhs through HBM and
+  allocating a fresh output; this kernel streams row blocks HBM->VMEM with
+  explicit async DMA, updates p in place (input/output aliased), and
+  accumulates the residual in SMEM.
+- grid = (2, nblocks): the outer grid dimension is the color phase (0 = red,
+  1 = black; same cell ordering as the reference's isw/jsw stride-2 loops),
+  the inner is the row-block sweep. TPU grid steps execute sequentially, so
+  the black phase reads the red phase's in-place updates — the Gauss-Seidel
+  dependency the reference gets from its in-place double loop.
+- The checkerboard is branch-free: a parity mask from `broadcasted_iota` on
+  GLOBAL interior indices (i + j), applied to the update and the residual.
+- In-place halo safety: a half-sweep modifies only parity-`phase` cells, and
+  a block's halo rows contribute only opposite-parity neighbours, so the
+  value an adjacent block reads is the same whether its window DMA lands
+  before or after this block's write-back.
+
+Alignment: Mosaic requires DMA row slices aligned to the sublane tile (8 for
+f32), so the solver state lives in a PADDED layout — `pad` rows of dead cells
+above and below the logical (jmax+2, imax+2) array. Each block owns an
+aligned band of `block_rows` padded rows (ghost + out-of-range rows masked
+out of the update), loads the aligned window [band - pad, band + pad), and
+stores back exactly its band. `pad_array`/`unpad_array` convert at the loop
+boundary only — the convergence loop carries the padded array, so padding
+costs one copy per solve, not per iteration.
+
+Layout: arrays are (jmax+2, imax+2) row-major [j, i] — i is the lane
+dimension; padded shape ((nblocks*block_rows + 2*pad), imax+2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _align(dtype) -> int:
+    """Sublane tile for the dtype (f32: 8, bf16: 16); DMA row offsets and
+    lengths must be multiples of this."""
+    return max(8, 32 // jnp.dtype(dtype).itemsize)
+
+
+def pick_block_rows(jmax: int, imax: int, dtype=jnp.float32) -> int:
+    """Largest aligned block height keeping the two VMEM windows
+    ((BR+2A, W) + (BR, W)) under ~4 MiB, capped at one block per grid."""
+    a = _align(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    width = imax + 2
+    budget = (4 << 20) // (2 * itemsize * width)
+    whole = -(-(jmax + 2) // a) * a  # one block covering everything
+    br = max(a, min(budget // a * a, whole, 512))
+    return br
+
+
+def padded_rows(jmax: int, block_rows: int, dtype=jnp.float32) -> int:
+    a = _align(dtype)
+    nblocks = -(-(jmax + 2) // block_rows)
+    return nblocks * block_rows + 2 * a
+
+
+def pad_array(x, block_rows: int):
+    """(jmax+2, W) -> padded layout; dead rows are zero."""
+    jmax = x.shape[0] - 2
+    rp = padded_rows(jmax, block_rows, x.dtype)
+    a = _align(x.dtype)
+    out = jnp.zeros((rp, x.shape[1]), x.dtype)
+    return out.at[a : a + jmax + 2, :].set(x)
+
+
+def unpad_array(xp, jmax: int):
+    a = _align(xp.dtype)
+    return xp[a : a + jmax + 2, :]
+
+
+def _rb_kernel(
+    p_in,  # ANY (aliased to p_out) — unused; reads go through p_out
+    rhs,  # ANY, padded like p
+    p_out,  # ANY, aliased with p_in
+    res,  # SMEM (1, 1) accumulator
+    pw,  # VMEM (BR+2A, W) scratch: p window, owned band at rows [A, A+BR)
+    rw,  # VMEM (BR, W) scratch: rhs band
+    sem,  # DMA semaphores (2,)
+    *,
+    block_rows: int,
+    width: int,
+    jmax: int,
+    pad: int,
+    factor: float,
+    idx2: float,
+    idy2: float,
+):
+    del p_in
+    phase = pl.program_id(0)  # 0 = red, 1 = black
+    b = pl.program_id(1)
+    br = block_rows
+    a = pad
+    band0 = a + b * br  # first padded row of the owned band
+
+    ld_p = pltpu.make_async_copy(
+        p_out.at[pl.ds(band0 - a, br + 2 * a), :], pw, sem.at[0]
+    )
+    ld_r = pltpu.make_async_copy(rhs.at[pl.ds(band0, br), :], rw, sem.at[1])
+    ld_p.start()
+    ld_r.start()
+    ld_p.wait()
+    ld_r.wait()
+
+    c = pw[a : a + br, 1 : width - 1]
+    east = pw[a : a + br, 2:width]
+    west = pw[a : a + br, 0 : width - 2]
+    north = pw[a + 1 : a + br + 1, 1 : width - 1]
+    south = pw[a - 1 : a + br - 1, 1 : width - 1]
+    lap = (east - 2.0 * c + west) * idx2 + (north - 2.0 * c + south) * idy2
+    r = rw[:, 1 : width - 1] - lap
+
+    # logical row j of local row l is b*br + l (padded row band0+l minus pad);
+    # interior means 1 <= j <= jmax and the (i + j) checkerboard parity
+    jj = b * br + jax.lax.broadcasted_iota(jnp.int32, r.shape, 0)
+    ii = 1 + jax.lax.broadcasted_iota(jnp.int32, r.shape, 1)
+    live = jnp.logical_and(
+        ((ii + jj) % 2) == phase, jnp.logical_and(jj >= 1, jj <= jmax)
+    )
+    rm = jnp.where(live, r, jnp.zeros_like(r))
+
+    pw[a : a + br, 1 : width - 1] = c - factor * rm
+
+    @pl.when(jnp.logical_and(phase == 0, b == 0))
+    def _():
+        res[0, 0] = jnp.zeros((), rm.dtype)
+
+    res[0, 0] += jnp.sum(rm * rm)
+
+    st = pltpu.make_async_copy(
+        pw.at[pl.ds(a, br), :], p_out.at[pl.ds(band0, br), :], sem.at[0]
+    )
+    st.start()
+    st.wait()
+
+
+def neumann_bc_padded(p, jmax: int, imax: int):
+    """Homogeneous-Neumann ghost copy in the padded layout (parity with
+    ops/sor.py `neumann_bc`: walls only, corners untouched)."""
+    a = _align(p.dtype)
+    lo, hi = a, a + jmax + 1  # padded indices of the ghost rows
+    p = p.at[lo, 1 : imax + 1].set(p[lo + 1, 1 : imax + 1])
+    p = p.at[hi, 1 : imax + 1].set(p[hi - 1, 1 : imax + 1])
+    p = p.at[lo + 1 : hi, 0].set(p[lo + 1 : hi, 1])
+    p = p.at[lo + 1 : hi, imax + 1].set(p[lo + 1 : hi, imax])
+    return p
+
+
+def make_rb_iter_pallas(
+    imax: int,
+    jmax: int,
+    dx: float,
+    dy: float,
+    omega: float,
+    dtype,
+    *,
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+):
+    """Build `(p_padded, rhs_padded) -> (p_padded', res_sumsq)`: one full
+    red-black SOR iteration (red then black half-sweep) with the
+    un-normalized residual sum of r² over both sweeps. Operates on the padded
+    layout (`pad_array`/`unpad_array`); returns (rb_iter, block_rows)."""
+    if pltpu is None:
+        return None, 0
+    if block_rows is None:
+        block_rows = pick_block_rows(jmax, imax, dtype)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    dx2, dy2 = dx * dx, dy * dy
+    width = imax + 2
+    a = _align(dtype)
+    kernel = functools.partial(
+        _rb_kernel,
+        block_rows=block_rows,
+        width=width,
+        jmax=jmax,
+        pad=a,
+        factor=omega * 0.5 * (dx2 * dy2) / (dx2 + dy2),
+        idx2=1.0 / dx2,
+        idy2=1.0 / dy2,
+    )
+    nblocks = -(-(jmax + 2) // block_rows)
+    rp = nblocks * block_rows + 2 * a
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(2, nblocks),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, 1), lambda phase, b: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, width), dtype),
+            jax.ShapeDtypeStruct((1, 1), dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_rows + 2 * a, width), dtype),
+            pltpu.VMEM((block_rows, width), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )
+
+    def rb_iter(p_padded, rhs_padded):
+        p_padded, res = call(p_padded, rhs_padded)
+        return p_padded, res[0, 0]
+
+    return rb_iter, block_rows

@@ -17,6 +17,10 @@ import numpy as np
 def write_matrix(p, path: str) -> None:
     """Write the full (jmax+2, imax+2) array, `%f `-formatted, row per j."""
     arr = np.asarray(p, dtype=np.float64)
+    from . import native
+
+    if native.write_matrix(path, arr):
+        return
     with open(path, "w") as fh:
         for row in arr:
             fh.write("".join("%f " % v for v in row))
@@ -30,6 +34,10 @@ def read_matrix(path: str) -> np.ndarray:
 def write_pressure(p, dx: float, dy: float, path: str) -> None:
     """x y p triples at cell centers, blank line between j-rows (gnuplot splot)."""
     arr = np.asarray(p, dtype=np.float64)
+    from . import native
+
+    if native.write_pressure(path, arr, dx, dy):
+        return
     jmax, imax = arr.shape[0] - 2, arr.shape[1] - 2
     with open(path, "w") as fh:
         for j in range(1, jmax + 1):
@@ -44,6 +52,10 @@ def write_velocity(u, v, dx: float, dy: float, path: str) -> None:
     """x y u v |vel| at cell centers; u,v averaged from staggered faces."""
     ua = np.asarray(u, dtype=np.float64)
     va = np.asarray(v, dtype=np.float64)
+    from . import native
+
+    if native.write_velocity(path, ua, va, dx, dy):
+        return
     jmax, imax = ua.shape[0] - 2, ua.shape[1] - 2
     with open(path, "w") as fh:
         for j in range(1, jmax + 1):

@@ -1,0 +1,127 @@
+"""ctypes bridge to the native runtime layer (native/src, built by the
+top-level Makefile into build/<TAG>/libpampi_native.so).
+
+The native writers are byte-compatible with the pure-Python ones in
+datio.py/vtkio.py (tested in tests/test_native.py); the IO layer calls
+through here when the library is present and falls back to Python when not
+(PAMPI_NATIVE=0 disables explicitly). This mirrors the reference's split of
+math vs host plumbing: the compute path is XLA, the output plumbing is C
+(≙ vtkWriter.c / writeResult in /root/reference)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+
+import numpy as np
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def _find_lib():
+    if os.environ.get("PAMPI_NATIVE", "1") == "0":
+        return None
+    cand = [os.environ.get("PAMPI_NATIVE_LIB", "")]
+    cand += [str(p) for p in _REPO.glob("build/*/libpampi_native.so")]
+    for c in cand:
+        if c and os.path.exists(c):
+            try:
+                return ctypes.CDLL(c)
+            except OSError:
+                continue
+    return None
+
+
+_lib = _find_lib()
+
+if _lib is not None:
+    _D = ctypes.POINTER(ctypes.c_double)
+    _lib.pampi_write_matrix.argtypes = [
+        ctypes.c_char_p, _D, ctypes.c_long, ctypes.c_long]
+    _lib.pampi_write_matrix.restype = ctypes.c_int
+    _lib.pampi_write_pressure.argtypes = [
+        ctypes.c_char_p, _D, ctypes.c_long, ctypes.c_long,
+        ctypes.c_double, ctypes.c_double]
+    _lib.pampi_write_pressure.restype = ctypes.c_int
+    _lib.pampi_write_velocity.argtypes = [
+        ctypes.c_char_p, _D, _D, ctypes.c_long, ctypes.c_long,
+        ctypes.c_double, ctypes.c_double]
+    _lib.pampi_write_velocity.restype = ctypes.c_int
+    _lib.pampi_vtk_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+        ctypes.c_long, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int]
+    _lib.pampi_vtk_open.restype = ctypes.c_void_p
+    _lib.pampi_vtk_scalar.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, _D, ctypes.c_long]
+    _lib.pampi_vtk_scalar.restype = ctypes.c_int
+    _lib.pampi_vtk_vector.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, _D, _D, _D, ctypes.c_long]
+    _lib.pampi_vtk_vector.restype = ctypes.c_int
+    _lib.pampi_vtk_close.argtypes = [ctypes.c_void_p]
+    _lib.pampi_vtk_close.restype = ctypes.c_int
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def _cbuf(a):
+    arr = np.ascontiguousarray(a, dtype=np.float64)
+    return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def write_matrix(path: str, a) -> bool:
+    if _lib is None:
+        return False
+    arr, ptr = _cbuf(a)
+    return _lib.pampi_write_matrix(
+        path.encode(), ptr, arr.shape[0], arr.shape[1]) == 0
+
+
+def write_pressure(path: str, p, dx: float, dy: float) -> bool:
+    if _lib is None:
+        return False
+    arr, ptr = _cbuf(p)
+    return _lib.pampi_write_pressure(
+        path.encode(), ptr, arr.shape[0], arr.shape[1], dx, dy) == 0
+
+
+def write_velocity(path: str, u, v, dx: float, dy: float) -> bool:
+    if _lib is None:
+        return False
+    ua, up = _cbuf(u)
+    va, vp = _cbuf(v)
+    return _lib.pampi_write_velocity(
+        path.encode(), up, vp, ua.shape[0], ua.shape[1], dx, dy) == 0
+
+
+class NativeVtk:
+    """Native twin of vtkio.VtkWriter (same file layout, same call shape)."""
+
+    def __init__(self, path, title, imax, jmax, kmax, dx, dy, dz, binary):
+        self._h = _lib.pampi_vtk_open(
+            str(path).encode(), title.encode(), imax, jmax, kmax,
+            dx, dy, dz, 1 if binary else 0)
+        if not self._h:
+            raise OSError(f"pampi_vtk_open failed for {path}")
+
+    def scalar(self, name: str, s) -> None:
+        arr, ptr = _cbuf(np.asarray(s).ravel())
+        if _lib.pampi_vtk_scalar(self._h, name.encode(), ptr, arr.size) != 0:
+            raise OSError(f"vtk scalar write failed: {name}")
+
+    def vector(self, name: str, u, v, w) -> None:
+        ua, up = _cbuf(np.asarray(u).ravel())
+        va, vp = _cbuf(np.asarray(v).ravel())
+        wa, wp = _cbuf(np.asarray(w).ravel())
+        if _lib.pampi_vtk_vector(self._h, name.encode(), up, vp, wp,
+                                 ua.size) != 0:
+            raise OSError(f"vtk vector write failed: {name}")
+
+    def close(self) -> None:
+        if self._h:
+            h, self._h = self._h, None
+            if _lib.pampi_vtk_close(h) != 0:
+                raise OSError("vtk close failed (short write?)")

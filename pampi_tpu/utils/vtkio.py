@@ -15,13 +15,35 @@ from .grid import Grid
 
 
 class VtkWriter:
+    """Writes through the native C layer (same file bytes, C speed) when
+    build/*/libpampi_native.so is present, else pure Python — one class, one
+    attribute contract (.path/.grid/.fmt) either way. `.fh` is only open in
+    the Python path (None under native)."""
+
     def __init__(self, problem: str, grid: Grid, fmt: str = "ascii", path=None):
         assert fmt in ("ascii", "binary")
         self.grid = grid
         self.fmt = fmt
         self.path = path or f"{problem}.vtk"
-        self.fh = open(self.path, "wb")
-        self._header(problem)
+        self.fh = None
+        from . import native
+
+        if native.available():
+            self._impl = native.NativeVtk(
+                self.path,
+                "PAMPI cfd solver output",
+                grid.imax,
+                grid.jmax,
+                grid.kmax,
+                grid.dx,
+                grid.dy,
+                grid.dz,
+                fmt == "binary",
+            )
+        else:
+            self._impl = None
+            self.fh = open(self.path, "wb")
+            self._header(problem)
 
     def _w(self, s: str) -> None:
         self.fh.write(s.encode())
@@ -39,6 +61,9 @@ class VtkWriter:
 
     def scalar(self, name: str, s) -> None:
         """s: (kmax, jmax, imax) cell-centered array."""
+        if self._impl is not None:
+            self._impl.scalar(name, s)
+            return
         arr = np.asarray(s, dtype=np.float64)
         self._w("SCALARS %s double 1\n" % name)
         self._w("LOOKUP_TABLE default\n")
@@ -50,6 +75,9 @@ class VtkWriter:
 
     def vector(self, name: str, u, v, w) -> None:
         """u, v, w: (kmax, jmax, imax) cell-centered arrays."""
+        if self._impl is not None:
+            self._impl.vector(name, u, v, w)
+            return
         uu = np.asarray(u, dtype=np.float64).ravel()
         vv = np.asarray(v, dtype=np.float64).ravel()
         ww = np.asarray(w, dtype=np.float64).ravel()
@@ -66,7 +94,10 @@ class VtkWriter:
             self._w("\n")
 
     def close(self) -> None:
-        self.fh.close()
+        if self._impl is not None:
+            self._impl.close()
+        else:
+            self.fh.close()
 
 
 def read_vtk_ascii(path: str):
