@@ -19,6 +19,11 @@ def main(argv=None) -> int:
     if len(argv) < 2:
         print(f"Usage: {argv[0]} <configFile>  |  {argv[0]} <N> <iter>")
         return 0
+    if argv[1] == "--halo-test":
+        # halo-exchange debug dump (≙ assignment-6 test.c rank-id checker)
+        from .parallel.halo_debug import main as halo_main
+
+        return halo_main(argv)
     if argv[1].isdigit():
         # DMVM mode (≙ assignment-3a/3b CLI: ./exe <N> <iter>)
         from .models.dmvm import main as dmvm_main
@@ -69,9 +74,20 @@ def _run(argv) -> int:
         jax.config.update("jax_enable_x64", True)
     os.environ.setdefault("PAMPI_DTYPE", param.tpu_dtype)
 
-    from .utils.timing import get_timestamp
+    from .utils import profiling as prof
 
     print_parameter(param)
+    prof.init()
+    try:
+        return _dispatch(param, prof)
+    finally:
+        # always stop an open XProf trace and print the region table, even
+        # when the solver or a writer raises — that's the run worth profiling
+        prof.finalize()
+
+
+def _dispatch(param, prof) -> int:
+    from .utils.timing import get_timestamp
 
     if param.name.startswith("poisson"):
         from .models.poisson import PoissonSolver
@@ -88,11 +104,13 @@ def _run(argv) -> int:
         if solver is None:
             return 1
         start = get_timestamp()
-        it, res = solver.solve()
+        with prof.region("solve"):
+            it, res = solver.solve()
         end = get_timestamp()
         # parity: solver prints "%d " (no newline), main appends Walltime
         print(f"{it} ", end="")
-        solver.write_result("p.dat")
+        with prof.region("writeResult"):
+            solver.write_result("p.dat")
         print("Walltime %.2fs" % (end - start))
     elif param.name in ("dcavity", "canal", "dcavity3d", "canal3d"):
         from .utils.params import is_3d_config
@@ -121,14 +139,34 @@ def _run(argv) -> int:
         solver = _try_build(build)
         if solver is None:
             return 1
+        from .utils import checkpoint as ckpt
+
+        on_sync = None
+        if param.tpu_restart:
+            try:
+                ckpt.load_checkpoint(param.tpu_restart, solver)
+            except (OSError, ValueError, KeyError) as exc:
+                # config-class error: same one-line convention as _try_build
+                print(f"Error: cannot restart from {param.tpu_restart}: {exc}",
+                      file=sys.stderr)
+                return 1
+            print(f"Restarted from {param.tpu_restart} at t={solver.t:.4f}")
+        if param.tpu_checkpoint:
+            on_sync = ckpt.periodic_writer(
+                param.tpu_checkpoint, param.tpu_ckpt_every
+            )
         start = get_timestamp()
-        solver.run()
+        with prof.region("timeloop"):
+            solver.run(on_sync=on_sync)
         end = get_timestamp()
         print("Solution took %.2fs" % (end - start))
-        if is3d:
-            solver.write_result()
-        else:
-            solver.write_result("pressure.dat", "velocity.dat")
+        if param.tpu_checkpoint:
+            ckpt.save_checkpoint(param.tpu_checkpoint, solver)
+        with prof.region("writeResult"):
+            if is3d:
+                solver.write_result()
+            else:
+                solver.write_result("pressure.dat", "velocity.dat")
     else:
         print(f"Unknown problem name: {param.name}", file=sys.stderr)
         return 1

@@ -155,9 +155,10 @@ class NS2DSolver:
         return chunk_fn
 
     # -- driver API ----------------------------------------------------
-    def run(self, progress: bool = True) -> None:
+    def run(self, progress: bool = True, on_sync=None) -> None:
         """Advance from t to te (main.c:43-60 loop semantics: a step runs
-        whenever t <= te at its start)."""
+        whenever t <= te at its start). `on_sync(self)` fires at each host
+        sync (every CHUNK device steps) — the checkpoint hook point."""
         bar = Progress(self.param.te, enabled=progress)
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         t = jnp.asarray(self.t, time_dtype)
@@ -166,6 +167,10 @@ class NS2DSolver:
         while float(t) <= self.param.te:
             u, v, p, t, nt = self._chunk_fn(u, v, p, t, nt)
             bar.update(float(t))
+            if on_sync is not None:
+                self.u, self.v, self.p = u, v, p
+                self.t, self.nt = float(t), int(nt)
+                on_sync(self)
         bar.stop()
         self.u, self.v, self.p = u, v, p
         self.t, self.nt = float(t), int(nt)

@@ -310,7 +310,7 @@ class NS2DDistSolver:
         )
 
     # ------------------------------------------------------------------
-    def run(self, progress: bool = True) -> None:
+    def run(self, progress: bool = True, on_sync=None) -> None:
         bar = Progress(self.param.te, enabled=progress)
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         t = jnp.asarray(self.t, time_dtype)
@@ -319,6 +319,10 @@ class NS2DDistSolver:
         while float(t) <= self.param.te:
             u, v, p, t, nt = self._chunk_sm(u, v, p, t, nt)
             bar.update(float(t))
+            if on_sync is not None:
+                self.u, self.v, self.p = u, v, p
+                self.t, self.nt = float(t), int(nt)
+                on_sync(self)
         bar.stop()
         self.u, self.v, self.p = u, v, p
         self.t, self.nt = float(t), int(nt)

@@ -274,7 +274,7 @@ class NS3DDistSolver:
         )
 
     # ------------------------------------------------------------------
-    def run(self, progress: bool = True) -> None:
+    def run(self, progress: bool = True, on_sync=None) -> None:
         bar = Progress(self.param.te, enabled=progress)
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         t = jnp.asarray(self.t, time_dtype)
@@ -283,6 +283,10 @@ class NS3DDistSolver:
         while float(t) <= self.param.te:
             u, v, w, p, t, nt = self._chunk_sm(u, v, w, p, t, nt)
             bar.update(float(t))
+            if on_sync is not None:
+                self.u, self.v, self.w, self.p = u, v, w, p
+                self.t, self.nt = float(t), int(nt)
+                on_sync(self)
         bar.stop()
         self.u, self.v, self.w, self.p = u, v, w, p
         self.t, self.nt = float(t), int(nt)

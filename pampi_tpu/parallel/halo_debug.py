@@ -1,0 +1,94 @@
+"""Halo-exchange debug dump — the framework's version of the reference's
+manual exchange checker (/root/reference/assignment-6/src/test.c:125-228
+`testInit`/`testPrintHalo`, and printExchange/printShift in
+assignment-5/ex5-nazifkar/src/solver.c:34-124): fill every rank's local
+block with its rank id, run the real halo exchange, and dump each ghost
+face to `halo-<dir>-r<rank>.txt` so a human (or a test) can confirm the
+neighbour's id appears.
+
+Run via the driver: `python -m pampi_tpu --halo-test [2|3]`
+(on CPU set XLA_FLAGS=--xla_force_host_platform_device_count=8 to fake the
+mesh — SURVEY.md §4's "multi-node without a cluster").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .comm import CartComm, halo_exchange
+
+_DIR_2D = ("bottom", "top", "left", "right")
+_DIR_3D = ("front", "back", "bottom", "top", "left", "right")
+
+
+def _faces(block, ndims):
+    """(name, ghost-face array) pairs of the extended local block — low/high
+    face per array dim, ordered like the reference's Direction enum."""
+    if ndims == 2:
+        return [
+            ("bottom", block[0, :]),
+            ("top", block[-1, :]),
+            ("left", block[:, 0]),
+            ("right", block[:, -1]),
+        ]
+    return [
+        ("front", block[0, :, :]),
+        ("back", block[-1, :, :]),
+        ("bottom", block[:, 0, :]),
+        ("top", block[:, -1, :]),
+        ("left", block[:, :, 0]),
+        ("right", block[:, :, -1]),
+    ]
+
+
+def rank_id_blocks(comm: CartComm, local_interior):
+    """Fill each rank's extended block with its linear rank id, exchange all
+    halos, return host blocks indexed by mesh coordinates."""
+    ext = tuple(e + 2 for e in local_interior)
+
+    def kernel():
+        import jax.numpy as jnp
+
+        rid = 0
+        for ax in comm.axis_names:
+            rid = rid * comm.axis_size(ax) + lax.axis_index(ax)
+        blk = jnp.full(ext, rid, jnp.float32)
+        return halo_exchange(blk, comm)
+
+    out = comm.shard_map(kernel, in_specs=(), out_specs=P(*comm.axis_names))()
+    glob = np.asarray(out)
+    blocks = {}
+    for coords in np.ndindex(*comm.dims):
+        sl = tuple(
+            slice(c * e, (c + 1) * e) for c, e in zip(coords, ext)
+        )
+        blocks[coords] = glob[sl]
+    return blocks
+
+
+def dump_halos(comm: CartComm, local_interior=None, outdir=".") -> list[str]:
+    """Write halo-<dir>-r<rank>.txt per rank and ghost face; returns paths."""
+    if local_interior is None:
+        local_interior = (4,) * comm.ndims
+    blocks = rank_id_blocks(comm, local_interior)
+    paths = []
+    for coords, blk in blocks.items():
+        rid = 0
+        for c, d in zip(coords, comm.dims):
+            rid = rid * d + c
+        for name, face in _faces(blk, comm.ndims):
+            path = f"{outdir}/halo-{name}-r{rid}.txt"
+            np.savetxt(path, np.atleast_2d(face), fmt="%5.1f")
+            paths.append(path)
+    return paths
+
+
+def main(argv) -> int:
+    ndims = int(argv[2]) if len(argv) > 2 else 2
+    comm = CartComm(ndims=ndims)
+    comm.print_config()
+    paths = dump_halos(comm)
+    print(f"wrote {len(paths)} ghost-face dumps (halo-<dir>-r<rank>.txt)")
+    return 0

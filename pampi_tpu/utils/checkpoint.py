@@ -1,0 +1,89 @@
+"""Checkpoint / restart for the NS time-steppers.
+
+The reference has NO checkpoint subsystem (SURVEY.md §5: end-of-run output
+only; its .par te/dt schema would support restart files but none exist) —
+this closes that gap TPU-side. A checkpoint is a single .npz holding the
+solver's field arrays (u, v[, w], p), simulated time t, step count nt, and
+the grid extents for a shape sanity-check on load. Solvers expose host-sync
+points (their chunked device loops return to Python every CHUNK steps);
+the driver installs `periodic_writer` there, so checkpointing never forces
+an extra device sync of its own.
+
+.par keys (framework-only):
+  tpu_checkpoint        path to write (every tpu_ckpt_every syncs +
+                        once at the end); empty = off
+  tpu_ckpt_every  host syncs between writes (default 10)
+  tpu_restart           path to resume from before the run
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FIELDS = ("u", "v", "w", "p")
+
+
+def _mesh_dims(solver):
+    comm = getattr(solver, "comm", None)
+    return tuple(comm.dims) if comm is not None else ()
+
+
+def save_checkpoint(path: str, solver) -> None:
+    data = {
+        f: np.asarray(getattr(solver, f))
+        for f in _FIELDS
+        if hasattr(solver, f)
+    }
+    data["t"] = np.float64(solver.t)
+    data["nt"] = np.int64(solver.nt)
+    data["shape"] = np.asarray(data["p"].shape)
+    # distributed solvers carry stacked extended blocks, so the array layout
+    # is mesh-dependent; record the mesh so a mismatched restart errors
+    # clearly instead of with a confusing shape diff
+    data["mesh"] = np.asarray(_mesh_dims(solver), dtype=np.int64)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **data)
+    import os
+
+    os.replace(tmp, path)  # atomic: a crash mid-write never corrupts
+
+
+def load_checkpoint(path: str, solver) -> None:
+    with np.load(path) as z:
+        mesh_saved = tuple(z["mesh"]) if "mesh" in z else ()
+        mesh_now = _mesh_dims(solver)
+        if mesh_saved != mesh_now:
+            raise ValueError(
+                f"checkpoint was written under tpu_mesh {mesh_saved or '1'} "
+                f"but this run uses {mesh_now or '1'}; restart on the same "
+                f"mesh (field layout is mesh-dependent)"
+            )
+        shape = tuple(z["shape"])
+        if tuple(solver.p.shape) != shape:
+            raise ValueError(
+                f"checkpoint grid {shape} != solver grid {tuple(solver.p.shape)}"
+            )
+        import jax.numpy as jnp
+
+        for f in _FIELDS:
+            if f in z and hasattr(solver, f):
+                setattr(
+                    solver, f, jnp.asarray(z[f], dtype=getattr(solver, f).dtype)
+                )
+        solver.t = float(z["t"])
+        solver.nt = int(z["nt"])
+
+
+def periodic_writer(path: str, every: int = 10):
+    """on_sync callback: writes `path` every `every` host syncs (values < 1
+    mean every sync)."""
+    every = max(1, every)
+    count = {"n": 0}
+
+    def on_sync(solver) -> None:
+        count["n"] += 1
+        if count["n"] % every == 0:
+            save_checkpoint(path, solver)
+
+    return on_sync

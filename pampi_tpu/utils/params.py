@@ -62,6 +62,10 @@ class Parameter:
     # framework-only (TPU execution controls; not in the reference)
     tpu_mesh: str = "auto"
     tpu_dtype: str = "float64"
+    # checkpoint/restart (utils/checkpoint.py; the reference has none)
+    tpu_checkpoint: str = ""
+    tpu_ckpt_every: int = 10
+    tpu_restart: str = ""
     # keys explicitly present in the parsed file (not a .par key itself);
     # lets the driver tell a 3-D config (kmax/zlength/bcFront set) from a
     # 2-D one, since the reference distinguishes by binary instead

@@ -1,0 +1,83 @@
+"""Region-marker profiling hooks.
+
+Capability parity with the reference's LIKWID marker layer
+(/root/reference/assignment-4/src/likwid-marker.h:104-130: START/STOP region
+macros that compile to no-ops unless -DLIKWID_PERFMON) re-designed for the
+TPU stack: regions become `jax.profiler` trace annotations (visible in a
+TensorBoard/XProf trace) plus optional wall-clock accounting, and the no-op
+switch is the PAMPI_PROFILE environment variable instead of a compile flag.
+
+  PAMPI_PROFILE=0/unset  every call is a no-op (the likwid default)
+  PAMPI_PROFILE=1        region wall-clock accounting + trace annotations
+  PAMPI_PROFILE=<dir>    additionally jax.profiler.start_trace(<dir>) on
+                         init and stop on finalize (full XProf trace)
+
+Usage (mirrors LIKWID_MARKER_*):
+    prof.init(); with prof.region("solve"): ...; prof.finalize()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+from collections import defaultdict
+
+_MODE = os.environ.get("PAMPI_PROFILE", "0")
+_times: dict[str, float] = defaultdict(float)
+_counts: dict[str, int] = defaultdict(int)
+_tracing = False
+
+
+def enabled() -> bool:
+    return _MODE not in ("", "0")
+
+
+def init() -> None:
+    """≙ LIKWID_MARKER_INIT."""
+    global _tracing
+    if not enabled():
+        return
+    if _MODE != "1":
+        import jax
+
+        jax.profiler.start_trace(_MODE)
+        _tracing = True
+
+
+@contextlib.contextmanager
+def region(name: str):
+    """≙ LIKWID_MARKER_START/STOP pair. Also a jax.profiler annotation so the
+    region shows up on the device timeline."""
+    if not enabled():
+        yield
+        return
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    _times[name] += time.perf_counter() - t0
+    _counts[name] += 1
+
+
+def finalize(out=sys.stderr) -> None:
+    """≙ LIKWID_MARKER_CLOSE: stop the trace and print the region table."""
+    global _tracing
+    if not enabled():
+        return
+    if _tracing:
+        import jax
+
+        jax.profiler.stop_trace()
+        _tracing = False
+    if _times:
+        out.write("Region                    calls      time[s]\n")
+        for name in sorted(_times, key=_times.get, reverse=True):
+            out.write(f"{name:<24} {_counts[name]:>6} {_times[name]:>12.4f}\n")
+
+
+def reset() -> None:
+    _times.clear()
+    _counts.clear()

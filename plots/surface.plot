@@ -1,0 +1,9 @@
+# gnuplot: pressure surface from pressure.dat / p.dat triples
+# (viz parity with the reference's surface.plot committed next to the 2-D
+# solvers; drive with `gnuplot plots/surface.plot` after a run)
+set terminal png size 1024,768 enhanced font ,12
+set output 'p.png'
+set grid
+set hidden3d
+set dgrid3d 50,50 qnorm 2
+splot 'pressure.dat' using 1:2:3 with lines notitle

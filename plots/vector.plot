@@ -1,0 +1,8 @@
+# gnuplot: velocity quiver from velocity.dat rows `x y u v |vel|`
+# (viz parity with the reference's vector.plot; color by magnitude)
+set terminal png size 1200,600 enhanced font ,12
+set output 'velocity.png'
+set palette defined (0 "blue", 1 "red")
+set cbrange [*:*]
+plot 'velocity.dat' using 1:2:3:4:5 with vectors head size 0.01,20,60 \
+     filled lc palette notitle

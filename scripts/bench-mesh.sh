@@ -1,0 +1,24 @@
+#!/bin/bash
+# DMVM ring-scaling sweep over mesh sizes — the TPU-native analog of the
+# reference's rank sweeps (/root/reference/assignment-3a/"bash scripts"/
+# bench-cluster.sh: ranks 72..288; bench-memdomain.sh: 1..18). Without a
+# multi-chip slice this drives the ring matvec over an R-device VIRTUAL CPU
+# mesh (XLA_FLAGS=--xla_force_host_platform_device_count=R — the framework's
+# standard "multi-node without a cluster", SURVEY.md S4), exercising the real
+# ppermute ring; on a real slice drop JAX_PLATFORMS/XLA_FLAGS and the same
+# rows come from ICI. CSV schema matches the reference harness.
+#
+# Usage: scripts/bench-mesh.sh [outfile.csv] [N] [ITER]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-bench-mesh.csv}
+N=${2:-4000}
+ITER=${3:-100}
+
+echo "Ranks,NITER,N,MFlops,Time" > "$OUT"
+for R in 1 2 4 8; do
+    PAMPI_CSV="$OUT" JAX_PLATFORMS=cpu PYTHONPATH="${PYTHONPATH:-$PWD}" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=$R" \
+        python -m pampi_tpu "$N" "$ITER" || echo "R=$R failed" >&2
+done
+cat "$OUT"

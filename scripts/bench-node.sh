@@ -1,0 +1,26 @@
+#!/bin/bash
+# DMVM throughput sweep on the local accelerator — harness parity with the
+# reference's single-node SLURM sweep (/root/reference/assignment-3a/
+# "bash scripts"/bench-node.sh: CSV header `Ranks,NITER,N,MFlops,Time`, sweep
+# grid (N,iter) in {1000,4000,10000,20000} x {1e6,1e5,1e4,5e3}), TPU-first:
+# one chip replaces a node, and the rank sweep becomes the mesh sweep in
+# bench-mesh.sh. Iterations are divided by SCALE (default 100) to keep the
+# wall clock per point in seconds; MFLOP/s is iteration-count invariant.
+#
+# Usage: scripts/bench-node.sh [outfile.csv] [SCALE]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-bench-node.csv}
+SCALE=${2:-100}
+EXE="./exe-JAX"
+[ -x "$EXE" ] || EXE="python -m pampi_tpu"
+
+echo "Ranks,NITER,N,MFlops,Time" > "$OUT"
+for NI in "1000 1000000" "4000 100000" "10000 10000" "20000 5000"; do
+    set -- $NI
+    N=$1
+    ITER=$(( $2 / SCALE ))
+    [ "$ITER" -lt 1 ] && ITER=1
+    PAMPI_CSV="$OUT" $EXE "$N" "$ITER" || echo "N=$N failed" >&2
+done
+cat "$OUT"

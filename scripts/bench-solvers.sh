@@ -1,0 +1,23 @@
+#!/bin/bash
+# Solver wall-clock table — parity with the reference's per-assignment
+# timing prints ("Walltime %.2fs", assignment-4/src/main.c:38; "Solution
+# took %.2fs", assignment-5/sequential/src/main.c:63, assignment-6/src/
+# main.c:73) gathered into one CSV. Runs each committed .par config through
+# the driver on whatever backend jax selects (TPU chip if present; set
+# JAX_PLATFORMS=cpu PYTHONPATH=$PWD to force host CPU).
+#
+# Usage: scripts/bench-solvers.sh [outfile.csv] [config ...]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-bench-solvers.csv}
+shift 2>/dev/null || true
+CONFIGS=${*:-"configs/poisson.par configs/dcavity.par configs/canal.par"}
+EXE="./exe-JAX"
+[ -x "$EXE" ] || EXE="python -m pampi_tpu"
+
+echo "Config,Walltime" > "$OUT"
+for cfg in $CONFIGS; do
+    t=$($EXE "$cfg" | sed -n 's/.*\(Walltime\|Solution took\) \([0-9.]*\)s.*/\2/p' | tail -1)
+    echo "$(basename "$cfg" .par),${t:-FAIL}" >> "$OUT"
+done
+cat "$OUT"

@@ -1,0 +1,65 @@
+"""Checkpoint/restart round-trip: a run interrupted at a host sync and
+resumed from the .npz must finish bit-identical to an uninterrupted run
+(the subsystem the reference lacks, SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.utils import checkpoint as ckpt
+from pampi_tpu.utils.params import Parameter, read_parameter
+
+
+def _param(te):
+    return Parameter(
+        name="dcavity", imax=32, jmax=32, re=10.0, te=te, tau=0.5,
+        itermax=100, eps=1e-3, omg=1.8, gamma=0.9, tpu_dtype="float64",
+    )
+
+
+def test_roundtrip_bitwise(tmp_path):
+    path = str(tmp_path / "ck.npz")
+
+    # uninterrupted run
+    ref = NS2DSolver(_param(te=0.5))
+    ref.run(progress=False)
+
+    # interrupted: checkpoint at EVERY host sync, stop partway by using a
+    # shorter te, then restore into a fresh solver and continue to te
+    first = NS2DSolver(_param(te=0.2))
+    first.run(progress=False, on_sync=ckpt.periodic_writer(path, every=1))
+    ckpt.save_checkpoint(path, first)
+
+    second = NS2DSolver(_param(te=0.5))
+    ckpt.load_checkpoint(path, second)
+    assert second.t == first.t and second.nt == first.nt
+    second.run(progress=False)
+
+    assert ref.nt == second.nt
+    np.testing.assert_array_equal(np.asarray(ref.p), np.asarray(second.p))
+    np.testing.assert_array_equal(np.asarray(ref.u), np.asarray(second.u))
+    np.testing.assert_array_equal(np.asarray(ref.v), np.asarray(second.v))
+
+
+def test_shape_mismatch_rejected(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    s = NS2DSolver(_param(te=0.1))
+    ckpt.save_checkpoint(path, s)
+    other = NS2DSolver(
+        Parameter(name="dcavity", imax=16, jmax=16, re=10.0, te=0.1,
+                  tpu_dtype="float64")
+    )
+    with pytest.raises(ValueError, match="checkpoint grid"):
+        ckpt.load_checkpoint(path, other)
+
+
+def test_par_keys_parsed(tmp_path):
+    par = tmp_path / "r.par"
+    par.write_text(
+        "name dcavity\ntpu_checkpoint ck.npz\ntpu_ckpt_every 3\n"
+        "tpu_restart old.npz\n"
+    )
+    p = read_parameter(str(par))
+    assert p.tpu_checkpoint == "ck.npz"
+    assert p.tpu_ckpt_every == 3
+    assert p.tpu_restart == "old.npz"

@@ -1,0 +1,33 @@
+"""Profiling region hooks (utils/profiling.py — the LIKWID-marker parity
+layer): no-op when disabled, wall-clock accounting when enabled."""
+
+import io
+
+from pampi_tpu.utils import profiling as prof
+
+
+def test_disabled_is_noop(monkeypatch):
+    monkeypatch.setattr(prof, "_MODE", "0")
+    prof.reset()
+    prof.init()
+    with prof.region("solve"):
+        pass
+    out = io.StringIO()
+    prof.finalize(out)
+    assert out.getvalue() == ""
+
+
+def test_enabled_accounts_regions(monkeypatch):
+    monkeypatch.setattr(prof, "_MODE", "1")
+    prof.reset()
+    prof.init()
+    for _ in range(3):
+        with prof.region("solve"):
+            pass
+    with prof.region("writeResult"):
+        pass
+    out = io.StringIO()
+    prof.finalize(out)
+    txt = out.getvalue()
+    assert "solve" in txt and "writeResult" in txt
+    assert prof._counts["solve"] == 3
