@@ -96,7 +96,7 @@ def make_rb_step_padded(imax, jmax, dx, dy, omega, dtype, interpret=None):
         return sp.pad_array(x, block_rows)
 
     def unpad(xp):
-        return sp.unpad_array(xp, jmax)
+        return sp.unpad_array(xp, jmax, imax)
 
     return step, pad, unpad
 

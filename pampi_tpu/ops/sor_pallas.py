@@ -22,17 +22,20 @@ re-designed for the TPU memory hierarchy instead of translated:
   value an adjacent block reads is the same whether its window DMA lands
   before or after this block's write-back.
 
-Alignment: Mosaic requires DMA row slices aligned to the sublane tile (8 for
-f32), so the solver state lives in a PADDED layout — `pad` rows of dead cells
-above and below the logical (jmax+2, imax+2) array. Each block owns an
-aligned band of `block_rows` padded rows (ghost + out-of-range rows masked
-out of the update), loads the aligned window [band - pad, band + pad), and
-stores back exactly its band. `pad_array`/`unpad_array` convert at the loop
+Alignment: Mosaic requires DMA slices aligned to the tile — sublane (8 for
+f32) in dim 0, lane (128) in dim 1 — so the solver state lives in a PADDED
+layout: `pad` rows of dead cells above and below the logical
+(jmax+2, imax+2) array, and dead columns on the right up to the next lane
+multiple. Each block owns an aligned band of `block_rows` padded rows (ghost
++ out-of-range rows masked out of the update), loads the aligned window
+[band - pad, band + pad) at full padded width, and stores back exactly its
+band. Dead columns are zero on entry and never written, so round-tripping
+them through VMEM is harmless. `pad_array`/`unpad_array` convert at the loop
 boundary only — the convergence loop carries the padded array, so padding
 costs one copy per solve, not per iteration.
 
 Layout: arrays are (jmax+2, imax+2) row-major [j, i] — i is the lane
-dimension; padded shape ((nblocks*block_rows + 2*pad), imax+2).
+dimension; padded shape ((nblocks*block_rows + 2*pad), lane_round(imax+2)).
 """
 
 from __future__ import annotations
@@ -49,19 +52,27 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 
+LANE = 128  # lane tile; DMA slice widths must be multiples of this
+
+
 def _align(dtype) -> int:
     """Sublane tile for the dtype (f32: 8, bf16: 16); DMA row offsets and
     lengths must be multiples of this."""
     return max(8, 32 // jnp.dtype(dtype).itemsize)
 
 
+def padded_width(imax: int) -> int:
+    """Logical width imax+2 rounded up to the lane tile."""
+    return -(-(imax + 2) // LANE) * LANE
+
+
 def pick_block_rows(jmax: int, imax: int, dtype=jnp.float32) -> int:
     """Largest aligned block height keeping the two VMEM windows
-    ((BR+2A, W) + (BR, W)) under ~4 MiB, capped at one block per grid."""
+    ((BR+2A, Wp) + (BR, Wp)) under ~4 MiB, capped at one block per grid."""
     a = _align(dtype)
     itemsize = jnp.dtype(dtype).itemsize
-    width = imax + 2
-    budget = (4 << 20) // (2 * itemsize * width)
+    wp = padded_width(imax)
+    budget = (4 << 20) // (2 * itemsize * wp)
     whole = -(-(jmax + 2) // a) * a  # one block covering everything
     br = max(a, min(budget // a * a, whole, 512))
     return br
@@ -74,17 +85,18 @@ def padded_rows(jmax: int, block_rows: int, dtype=jnp.float32) -> int:
 
 
 def pad_array(x, block_rows: int):
-    """(jmax+2, W) -> padded layout; dead rows are zero."""
+    """(jmax+2, imax+2) -> padded layout; dead rows/columns are zero."""
     jmax = x.shape[0] - 2
     rp = padded_rows(jmax, block_rows, x.dtype)
     a = _align(x.dtype)
-    out = jnp.zeros((rp, x.shape[1]), x.dtype)
-    return out.at[a : a + jmax + 2, :].set(x)
+    out = jnp.zeros((rp, padded_width(x.shape[1] - 2)), x.dtype)
+    return out.at[a : a + jmax + 2, : x.shape[1]].set(x)
 
 
-def unpad_array(xp, jmax: int):
+def unpad_array(xp, jmax: int, imax: int | None = None):
     a = _align(xp.dtype)
-    return xp[a : a + jmax + 2, :]
+    w = xp.shape[1] if imax is None else imax + 2
+    return xp[a : a + jmax + 2, :w]
 
 
 def _rb_kernel(
@@ -188,6 +200,7 @@ def make_rb_iter_pallas(
 
     dx2, dy2 = dx * dx, dy * dy
     width = imax + 2
+    wp = padded_width(imax)
     a = _align(dtype)
     kernel = functools.partial(
         _rb_kernel,
@@ -214,12 +227,12 @@ def make_rb_iter_pallas(
             pl.BlockSpec((1, 1), lambda phase, b: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rp, width), dtype),
+            jax.ShapeDtypeStruct((rp, wp), dtype),
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_rows + 2 * a, width), dtype),
-            pltpu.VMEM((block_rows, width), dtype),
+            pltpu.VMEM((block_rows + 2 * a, wp), dtype),
+            pltpu.VMEM((block_rows, wp), dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         input_output_aliases={0: 0},

@@ -60,7 +60,7 @@ def test_rb_multiblock():
     p_p, rsq = rb16(pad_array(p0, 16), pad_array(rhs, 16))
     p_p = neumann_bc_padded(p_p, jmax, imax)
     np.testing.assert_allclose(
-        np.asarray(unpad_array(p_p, jmax)), np.asarray(p_j), atol=1e-13
+        np.asarray(unpad_array(p_p, jmax, imax)), np.asarray(p_j), atol=1e-13
     )
     np.testing.assert_allclose(float(rsq / imax / jmax), float(res_j), rtol=1e-12)
 
