@@ -51,11 +51,13 @@ def init_fields(param: Parameter, problem: int = 2, dtype=jnp.float64):
     return jnp.asarray(p, dtype=dtype), jnp.asarray(rhs, dtype=dtype)
 
 
-def _use_pallas(backend: str) -> bool:
+def _use_pallas(backend: str, dtype=jnp.float32) -> bool:
     if backend == "pallas":
         return True
     if backend != "auto" or jax.default_backend() != "tpu":
         return False
+    if jnp.dtype(dtype).itemsize > 4:
+        return False  # Mosaic has no f64; XLA emulates it, pallas can't
     from ..ops import sor_pallas as sp
 
     return sp.pltpu is not None  # pallas TPU backend importable
@@ -66,22 +68,28 @@ def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
     where prep/post convert the loop-carried array at the boundary (padded
     layout under pallas, identity under jnp). The single decision point for
     the backend choice — bench.py and the solvers both go through here."""
-    if _use_pallas(backend):
+    if _use_pallas(backend, dtype):
         return make_rb_step_padded(imax, jmax, dx, dy, omega, dtype)
     step = make_rb_step(imax, jmax, dx, dy, omega, dtype, backend="jnp")
     ident = lambda x: x  # noqa: E731
     return step, ident, ident
 
 
-def make_rb_step_padded(imax, jmax, dx, dy, omega, dtype, interpret=None):
+def make_rb_step_padded(imax, jmax, dx, dy, omega, dtype, interpret=None,
+                        kernel: str = "fused"):
     """Pallas-backed red-black iteration on the PADDED layout
     (ops/sor_pallas.py): returns (step, pad, unpad) where step is
     (p_pad, rhs_pad) -> (p_pad', normalized res) incl. the Neumann ghost
     copy. The caller carries the padded array through its loop and converts
-    at the boundary only."""
+    at the boundary only.
+
+    kernel: "fused" (one HBM sweep per iteration, double-buffered DMA) or
+    "blocked" (two phases, one sweep each — the simpler original)."""
     from ..ops import sor_pallas as sp
 
-    rb_iter, block_rows = sp.make_rb_iter_pallas(
+    make = (sp.make_rb_iter_fused if kernel == "fused"
+            else sp.make_rb_iter_pallas)
+    rb_iter, block_rows = make(
         imax, jmax, dx, dy, omega, dtype, interpret=interpret
     )
     if rb_iter is None:
@@ -109,7 +117,7 @@ def make_rb_step(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
     blocked in-place kernel, pad/unpad per call — for loop-carried use go
     through make_rb_step_padded), or "auto" (pallas on TPU)."""
     norm = float(imax * jmax)
-    if _use_pallas(backend):
+    if _use_pallas(backend, dtype):
         pstep, pad, unpad = make_rb_step_padded(imax, jmax, dx, dy, omega, dtype)
 
         def step(p, rhs):
@@ -172,21 +180,33 @@ class PoissonSolver:
         self.dx = param.xlength / param.imax
         self.dy = param.ylength / param.jmax
         self.p, self.rhs = init_fields(param, problem, dtype)
-        self._solve = jax.jit(
-            make_solver_fn(
-                self.imax,
-                self.jmax,
-                self.dx,
-                self.dy,
-                param.omg,
-                param.eps,
-                param.itermax,
-                dtype,
-            )
+        self._backend = "auto"
+        self._solve = jax.jit(self._make_solve(backend="auto"))
+
+    def _make_solve(self, backend: str):
+        return make_solver_fn(
+            self.imax,
+            self.jmax,
+            self.dx,
+            self.dy,
+            self.param.omg,
+            self.param.eps,
+            self.param.itermax,
+            self.dtype,
+            backend=backend,
         )
 
     def solve(self):
-        self.p, res, it = self._solve(self.p, self.rhs)
+        try:
+            self.p, res, it = self._solve(self.p, self.rhs)
+        except Exception:
+            if self._backend == "jnp":
+                raise
+            # pallas compile/runtime failure on this chip: fall back to the
+            # always-available jnp path (same arithmetic, slower)
+            self._backend = "jnp"
+            self._solve = jax.jit(self._make_solve(backend="jnp"))
+            self.p, res, it = self._solve(self.p, self.rhs)
         return int(it), float(res)
 
     def write_result(self, path: str = "p.dat") -> None:

@@ -66,6 +66,14 @@ def padded_width(imax: int) -> int:
     return -(-(imax + 2) // LANE) * LANE
 
 
+def _check_dtype(dtype, interpret: bool) -> None:
+    if not interpret and jnp.dtype(dtype).itemsize > 4:
+        raise ValueError(
+            f"Mosaic cannot lower {jnp.dtype(dtype).name} on TPU; use float32 "
+            "(or bfloat16), or the jnp backend for float64"
+        )
+
+
 def pick_block_rows(jmax: int, imax: int, dtype=jnp.float32) -> int:
     """Largest aligned block height keeping the two VMEM windows
     ((BR+2A, Wp) + (BR, Wp)) under ~4 MiB, capped at one block per grid."""
@@ -93,10 +101,9 @@ def pad_array(x, block_rows: int):
     return out.at[a : a + jmax + 2, : x.shape[1]].set(x)
 
 
-def unpad_array(xp, jmax: int, imax: int | None = None):
+def unpad_array(xp, jmax: int, imax: int):
     a = _align(xp.dtype)
-    w = xp.shape[1] if imax is None else imax + 2
-    return xp[a : a + jmax + 2, :w]
+    return xp[a : a + jmax + 2, : imax + 2]
 
 
 def _rb_kernel(
@@ -164,6 +171,201 @@ def _rb_kernel(
     st.wait()
 
 
+def _fused_kernel(
+    p_in,  # ANY: padded p, read-only
+    rhs,  # ANY, padded like p
+    p_out,  # ANY: fresh output (NOT aliased — out-of-place)
+    res,  # SMEM (1, 1) accumulator
+    pw2,  # VMEM (2, BR+2A, Wp): double-buffered p windows
+    rw2,  # VMEM (2, BR+2A, Wp): double-buffered rhs windows
+    ob2,  # VMEM (2, BR, Wp): double-buffered output bands
+    ld_sem,  # DMA semaphores (2, 2): [slot, p|rhs]
+    st_sem,  # DMA semaphores (2,): [slot]
+    *,
+    block_rows: int,
+    nblocks: int,
+    width: int,
+    jmax: int,
+    pad: int,
+    factor: float,
+    idx2: float,
+    idy2: float,
+):
+    """One FULL red-black iteration in a single HBM sweep.
+
+    Block b loads the window of padded rows [b·BR, b·BR + BR + 2A) (owned band
+    at window rows [A, A+BR)), recomputes the red half-sweep on the halo rows
+    it needs (redundant compute instead of a second HBM pass), applies the
+    black half-sweep on its owned band, and stores the band out-of-place.
+    Loads for block b+1 are issued before the block-b compute, so DMA overlaps
+    the VPU work (ping-pong slots); stores drain one block behind.
+    """
+    b = pl.program_id(0)
+    br = block_rows
+    a = pad
+    slot = b % 2
+    nslot = (b + 1) % 2
+
+    def load(k, s):
+        return (
+            pltpu.make_async_copy(
+                p_in.at[pl.ds(k * br, br + 2 * a), :], pw2.at[s], ld_sem.at[s, 0]
+            ),
+            pltpu.make_async_copy(
+                rhs.at[pl.ds(k * br, br + 2 * a), :], rw2.at[s], ld_sem.at[s, 1]
+            ),
+        )
+
+    def store(k, s):
+        return pltpu.make_async_copy(
+            ob2.at[s], p_out.at[pl.ds(a + k * br, br), :], st_sem.at[s]
+        )
+
+    @pl.when(b == 0)
+    def _():
+        res[0, 0] = jnp.zeros((), p_out.dtype)
+        for c in load(0, 0):
+            c.start()
+
+    @pl.when(b + 1 < nblocks)
+    def _():
+        for c in load(b + 1, nslot):
+            c.start()
+
+    for c in load(b, slot):
+        c.wait()
+
+    p = pw2[slot]
+    rw = rw2[slot]
+
+    def lap(x):
+        east = jnp.roll(x, -1, axis=1)
+        west = jnp.roll(x, 1, axis=1)
+        north = jnp.roll(x, -1, axis=0)
+        south = jnp.roll(x, 1, axis=0)
+        return (east - 2.0 * x + west) * idx2 + (north - 2.0 * x + south) * idy2
+
+    # logical (j, i) of window cell (w, c): j = b*br + w - a, i = c
+    jj = b * br - a + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+    ii = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+    interior = (
+        (jj >= 1) & (jj <= jmax) & (ii >= 1) & (ii <= width - 2)
+    )
+    parity = (ii + jj) % 2
+    owned = (jj >= b * br) & (jj < (b + 1) * br)
+
+    # red half-sweep: recomputed on halo rows too (their owners compute the
+    # identical values), so the black sweep sees red-updated neighbours
+    # without a second HBM pass
+    r_red = jnp.where(interior & (parity == 0), rw - lap(p), 0.0)
+    pr = p - factor * r_red
+    # black half-sweep: owned band only
+    r_blk = jnp.where(interior & (parity == 1) & owned, rw - lap(pr), 0.0)
+    pb = pr - factor * r_blk
+
+    @pl.when(b >= 2)
+    def _():
+        store(b - 2, slot).wait()
+
+    ob2[slot] = pb[a : a + br, :]
+    store(b, slot).start()
+
+    r_red_own = jnp.where(owned, r_red, 0.0)
+    res[0, 0] += jnp.sum(r_red_own * r_red_own) + jnp.sum(r_blk * r_blk)
+
+    @pl.when(b == nblocks - 1)
+    def _():
+        store(b, slot).wait()
+        if nblocks > 1:  # static: drain the previous slot's store too
+            store(b - 1, nslot).wait()
+
+
+def pick_block_rows_fused(jmax: int, imax: int, dtype=jnp.float32) -> int:
+    """Block height for the fused kernel: 6 buffers (2×p, 2×rhs windows of
+    BR+2A rows; 2 output bands of BR rows) under ~6 MiB of VMEM, leaving
+    headroom for the kernel's window-sized temporaries."""
+    a = _align(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    wp = padded_width(imax)
+    row_bytes = wp * itemsize
+    budget_rows = (6 << 20) // row_bytes
+    br = max(a, min((budget_rows - 4 * 2 * a) // 6 // a * a, 512))
+    whole = -(-(jmax + 2) // a) * a
+    return min(br, whole)
+
+
+def make_rb_iter_fused(
+    imax: int,
+    jmax: int,
+    dx: float,
+    dy: float,
+    omega: float,
+    dtype,
+    *,
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+):
+    """Fused single-sweep red-black iteration (see `_fused_kernel`): builds
+    `(p_padded, rhs_padded) -> (p_padded', res_sumsq)` on the same padded
+    layout as `make_rb_iter_pallas`; returns (rb_iter, block_rows)."""
+    if pltpu is None:
+        return None, 0
+    if block_rows is None:
+        block_rows = pick_block_rows_fused(jmax, imax, dtype)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _check_dtype(dtype, interpret)
+
+    dx2, dy2 = dx * dx, dy * dy
+    width = imax + 2
+    wp = padded_width(imax)
+    a = _align(dtype)
+    nblocks = -(-(jmax + 2) // block_rows)
+    rp = nblocks * block_rows + 2 * a
+    kernel = functools.partial(
+        _fused_kernel,
+        block_rows=block_rows,
+        nblocks=nblocks,
+        width=width,
+        jmax=jmax,
+        pad=a,
+        factor=omega * 0.5 * (dx2 * dy2) / (dx2 + dy2),
+        idx2=1.0 / dx2,
+        idy2=1.0 / dy2,
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, 1), lambda b: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, wp), dtype),
+            jax.ShapeDtypeStruct((1, 1), dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_rows + 2 * a, wp), dtype),
+            pltpu.VMEM((2, block_rows + 2 * a, wp), dtype),
+            pltpu.VMEM((2, block_rows, wp), dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )
+
+    def rb_iter(p_padded, rhs_padded):
+        p_padded, res = call(p_padded, rhs_padded)
+        return p_padded, res[0, 0]
+
+    return rb_iter, block_rows
+
+
 def neumann_bc_padded(p, jmax: int, imax: int):
     """Homogeneous-Neumann ghost copy in the padded layout (parity with
     ops/sor.py `neumann_bc`: walls only, corners untouched)."""
@@ -197,6 +399,7 @@ def make_rb_iter_pallas(
         block_rows = pick_block_rows(jmax, imax, dtype)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    _check_dtype(dtype, interpret)
 
     dx2, dy2 = dx * dx, dy * dy
     width = imax + 2

@@ -28,7 +28,7 @@ def test_rb_step_padded_matches_jnp(shape):
 
     step_jnp = make_rb_step(imax, jmax, dx, dy, 1.9, jnp.float64, backend="jnp")
     step_pal, pad, unpad = make_rb_step_padded(
-        imax, jmax, dx, dy, 1.9, jnp.float64, interpret=True
+        imax, jmax, dx, dy, 1.9, jnp.float64, interpret=True, kernel="blocked"
     )
 
     p_j = p0
@@ -85,6 +85,58 @@ def test_full_solve_matches_jnp():
 
 
 def test_pick_block_rows_aligned():
+    from pampi_tpu.ops.sor_pallas import pick_block_rows_fused
+
     for jmax, imax in [(4096, 4096), (100, 100), (8192, 8192), (30, 50)]:
-        br = pick_block_rows(jmax, imax, jnp.float32)
-        assert br % 8 == 0 and br >= 8
+        for pick in (pick_block_rows, pick_block_rows_fused):
+            br = pick(jmax, imax, jnp.float32)
+            assert br % 8 == 0 and br >= 8
+
+
+@pytest.mark.parametrize("shape", [(32, 32), (100, 100), (64, 32), (48, 96)])
+def test_fused_matches_jnp(shape):
+    """The fused single-sweep kernel must match the jnp half-sweep pair
+    cell-for-cell, including the residual, across several iterations."""
+    imax, jmax = shape
+    param = Parameter(imax=imax, jmax=jmax)
+    p0, rhs = init_fields(param, problem=2, dtype=jnp.float64)
+    dx, dy = 1.0 / imax, 1.0 / jmax
+
+    step_jnp = make_rb_step(imax, jmax, dx, dy, 1.9, jnp.float64, backend="jnp")
+    step_pal, pad, unpad = make_rb_step_padded(
+        imax, jmax, dx, dy, 1.9, jnp.float64, interpret=True, kernel="fused"
+    )
+
+    p_j = p0
+    p_p, rhs_p = pad(p0), pad(rhs)
+    for _ in range(3):
+        p_j, res_j = step_jnp(p_j, rhs)
+        p_p, res_p = step_pal(p_p, rhs_p)
+        np.testing.assert_allclose(
+            np.asarray(unpad(p_p)), np.asarray(p_j), atol=1e-13
+        )
+        np.testing.assert_allclose(float(res_p), float(res_j), rtol=1e-12)
+
+
+def test_fused_multiblock():
+    """Several row blocks: halo red-recompute, ragged tail masking, and the
+    double-buffered store drain across block boundaries."""
+    imax, jmax = 64, 100
+    param = Parameter(imax=imax, jmax=jmax)
+    p0, rhs = init_fields(param, problem=2, dtype=jnp.float64)
+    dx, dy = 1.0 / imax, 1.0 / jmax
+
+    from pampi_tpu.ops.sor_pallas import make_rb_iter_fused, neumann_bc_padded
+
+    step_jnp = make_rb_step(imax, jmax, dx, dy, 1.9, jnp.float64, backend="jnp")
+    rb16, br = make_rb_iter_fused(
+        imax, jmax, dx, dy, 1.9, jnp.float64, block_rows=16, interpret=True
+    )
+    assert br == 16
+    p_j, res_j = step_jnp(p0, rhs)
+    p_p, rsq = rb16(pad_array(p0, 16), pad_array(rhs, 16))
+    p_p = neumann_bc_padded(p_p, jmax, imax)
+    np.testing.assert_allclose(
+        np.asarray(unpad_array(p_p, jmax, imax)), np.asarray(p_j), atol=1e-13
+    )
+    np.testing.assert_allclose(float(rsq / imax / jmax), float(res_j), rtol=1e-12)
