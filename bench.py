@@ -56,12 +56,15 @@ def _timed_run(backend: str):
 
     out = run_iters(p, rhs)
     float(out[1])  # warm-up + compile; scalar readback forces completion
-    t0 = time.perf_counter()
-    out = run_iters(p, rhs)
-    # block_until_ready can return before completion under the axon tunnel;
-    # a host readback of the carried residual is the reliable fence
-    float(out[1])
-    return time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(3):  # best-of-3: the axon tunnel adds run-to-run jitter
+        t0 = time.perf_counter()
+        out = run_iters(p, rhs)
+        # block_until_ready can return before completion under the axon
+        # tunnel; a host readback of the carried residual is the fence
+        float(out[1])
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
 def main() -> None:

@@ -27,42 +27,24 @@ import numpy as np
 from jax import lax
 
 from ..ops import ns2d as ops
-from ..ops.sor import checkerboard_mask, neumann_bc, sor_pass
 from ..utils.datio import write_pressure, write_velocity
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
 from ..utils.progress import Progress
 
 
-def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype):
+def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
+                        backend: str = "auto"):
     """Pressure-Poisson red-black SOR loop (solve, solver.c:140-191): carry
-    (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep."""
-    dx2, dy2 = dx * dx, dy * dy
-    idx2, idy2 = 1.0 / dx2, 1.0 / dy2
-    factor = omega * 0.5 * (dx2 * dy2) / (dx2 + dy2)
-    red = checkerboard_mask(jmax, imax, 0, dtype)
-    black = checkerboard_mask(jmax, imax, 1, dtype)
-    norm = float(imax * jmax)
-    epssq = eps * eps
+    (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep.
 
-    def solve(p, rhs):
-        def cond(c):
-            _, res, it = c
-            return jnp.logical_and(res >= epssq, it < itermax)
+    Identical semantics to the Poisson convergence loop, so it IS that loop:
+    `make_solver_fn` dispatches to the fused Pallas kernel on TPU (f32/bf16),
+    converting to the padded layout once per pressure solve, not per sweep."""
+    from .poisson import make_solver_fn
 
-        def body(c):
-            p, _, it = c
-            p, r0 = sor_pass(p, rhs, red, factor, idx2, idy2)
-            p, r1 = sor_pass(p, rhs, black, factor, idx2, idy2)
-            p = neumann_bc(p)
-            return p, (r0 + r1) / norm, it + 1
-
-        p, res, it = lax.while_loop(
-            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
-        )
-        return p, res, it
-
-    return solve
+    return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
+                          backend=backend)
 
 
 class NS2DSolver:

@@ -60,7 +60,7 @@ def _use_pallas(backend: str, dtype=jnp.float32) -> bool:
         return False  # Mosaic has no f64; XLA emulates it, pallas can't
     from ..ops import sor_pallas as sp
 
-    return sp.pltpu is not None  # pallas TPU backend importable
+    return sp.pltpu is not None and sp.probe_pallas()
 
 
 def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
@@ -198,16 +198,21 @@ class PoissonSolver:
 
     def solve(self):
         try:
-            self.p, res, it = self._solve(self.p, self.rhs)
+            p, res, it = self._solve(self.p, self.rhs)
+            # dispatch is async: force completion inside the try so a pallas
+            # runtime fault surfaces here, not at the caller's readback
+            out = int(it), float(res)
         except Exception:
             if self._backend == "jnp":
                 raise
-            # pallas compile/runtime failure on this chip: fall back to the
-            # always-available jnp path (same arithmetic, slower)
+            # shape-specific pallas failure the dispatcher probe missed:
+            # fall back to the always-available jnp path (same arithmetic)
             self._backend = "jnp"
             self._solve = jax.jit(self._make_solve(backend="jnp"))
-            self.p, res, it = self._solve(self.p, self.rhs)
-        return int(it), float(res)
+            p, res, it = self._solve(self.p, self.rhs)
+            out = int(it), float(res)
+        self.p = p
+        return out
 
     def write_result(self, path: str = "p.dat") -> None:
         write_matrix(np.asarray(jax.device_get(self.p)), path)

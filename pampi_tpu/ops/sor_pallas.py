@@ -66,6 +66,38 @@ def padded_width(imax: int) -> int:
     return -(-(imax + 2) // LANE) * LANE
 
 
+_PROBE_OK: bool | None = None
+
+
+def probe_pallas() -> bool:
+    """One-time smoke test: compile and run the fused kernel on a tiny grid
+    on the real backend. Chip/toolchain-wide pallas failures (missing Mosaic
+    support, tunnel compile errors) surface here once, letting the dispatcher
+    fall back to the jnp path for every caller instead of crashing mid-run.
+    Memoized per process; the probe shape hits the jit cache afterwards."""
+    global _PROBE_OK
+    if _PROBE_OK is None:
+        try:
+            rb, br = make_rb_iter_fused(
+                126, 126, 1.0 / 126, 1.0 / 126, 1.9, jnp.float32,
+                interpret=False,
+            )
+            z = pad_array(jnp.zeros((128, 128), jnp.float32), br)
+            _, res = rb(z, z)
+            float(res)  # force completion: async errors surface here
+            _PROBE_OK = True
+        except Exception as exc:  # noqa: BLE001 — any failure means "don't"
+            import warnings
+
+            warnings.warn(
+                f"pallas TPU kernel unavailable ({type(exc).__name__}); "
+                "falling back to the jnp path",
+                stacklevel=2,
+            )
+            _PROBE_OK = False
+    return _PROBE_OK
+
+
 def _check_dtype(dtype, interpret: bool) -> None:
     if not interpret and jnp.dtype(dtype).itemsize > 4:
         raise ValueError(
