@@ -68,10 +68,11 @@ class NS2DSolver:
         self.dt_bound = 0.5 * param.re / inv_sqr_sum
         self.t = 0.0
         self.nt = 0
+        self._backend = "auto"
         self._chunk_fn = jax.jit(self._build_chunk())
 
     # -- one full timestep, traced ------------------------------------
-    def _build_step(self):
+    def _build_step(self, backend: str = "auto"):
         param = self.param
         dx, dy = self.dx, self.dy
         dtype = self.dtype
@@ -84,6 +85,7 @@ class NS2DSolver:
             param.eps,
             param.itermax,
             dtype,
+            backend=backend,
         )
         adaptive = param.tau > 0.0
         problem = param.name
@@ -114,8 +116,8 @@ class NS2DSolver:
 
         return step
 
-    def _build_chunk(self):
-        step = self._build_step()
+    def _build_chunk(self, backend: str = "auto"):
+        step = self._build_step(backend)
         te = self.param.te
         chunk = self.CHUNK
 
@@ -147,7 +149,19 @@ class NS2DSolver:
         nt = jnp.asarray(self.nt, jnp.int32)
         u, v, p = self.u, self.v, self.p
         while float(t) <= self.param.te:
-            u, v, p, t, nt = self._chunk_fn(u, v, p, t, nt)
+            try:
+                un, vn, pn, tn, ntn = self._chunk_fn(u, v, p, t, nt)
+                float(tn)  # force completion: async pallas faults surface here
+            except Exception:
+                if self._backend == "jnp":
+                    raise
+                # shape-specific pallas failure the dispatcher probe missed:
+                # rebuild the whole chunk on the jnp path (same arithmetic)
+                # and retry this chunk — inputs are unchanged (functional)
+                self._backend = "jnp"
+                self._chunk_fn = jax.jit(self._build_chunk(backend="jnp"))
+                continue
+            u, v, p, t, nt = un, vn, pn, tn, ntn
             bar.update(float(t))
             if on_sync is not None:
                 self.u, self.v, self.p = u, v, p

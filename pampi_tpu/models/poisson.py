@@ -63,38 +63,67 @@ def _use_pallas(backend: str, dtype=jnp.float32) -> bool:
     return sp.pltpu is not None and sp.probe_pallas()
 
 
-def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
+def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
+                 n_inner: int = 1):
     """Public dispatcher for loop-carried use: returns (step, prep, post)
     where prep/post convert the loop-carried array at the boundary (padded
     layout under pallas, identity under jnp). The single decision point for
-    the backend choice — bench.py and the solvers both go through here."""
+    the backend choice — bench.py and the solvers both go through here.
+
+    n_inner > 1 selects the temporal-blocked pallas kernel: one `step` call
+    performs n_inner red-black iterations (+BCs) in a single HBM sweep and
+    reports the residual of the last one. Ignored on the jnp path."""
     if _use_pallas(backend, dtype):
-        return make_rb_step_padded(imax, jmax, dx, dy, omega, dtype)
+        kernel = "tblock" if n_inner > 1 else "fused"
+        return make_rb_step_padded(imax, jmax, dx, dy, omega, dtype,
+                                   kernel=kernel, n_inner=n_inner)
     step = make_rb_step(imax, jmax, dx, dy, omega, dtype, backend="jnp")
     ident = lambda x: x  # noqa: E731
     return step, ident, ident
 
 
 def make_rb_step_padded(imax, jmax, dx, dy, omega, dtype, interpret=None,
-                        kernel: str = "fused"):
+                        kernel: str = "fused", n_inner: int = 4):
     """Pallas-backed red-black iteration on the PADDED layout
     (ops/sor_pallas.py): returns (step, pad, unpad) where step is
     (p_pad, rhs_pad) -> (p_pad', normalized res) incl. the Neumann ghost
     copy. The caller carries the padded array through its loop and converts
     at the boundary only.
 
-    kernel: "fused" (one HBM sweep per iteration, double-buffered DMA) or
-    "blocked" (two phases, one sweep each — the simpler original)."""
+    kernel: "tblock" (the production kernel: n_inner iterations per HBM
+    sweep, double-buffered DMA, BCs fused inside; "fused" is an alias for
+    n_inner=1) or "blocked" (two phases, one in-place sweep each — the
+    simple aliased-I/O reference kernel)."""
     from ..ops import sor_pallas as sp
 
-    make = (sp.make_rb_iter_fused if kernel == "fused"
-            else sp.make_rb_iter_pallas)
-    rb_iter, block_rows = make(
+    norm = float(imax * jmax)
+    if kernel == "fused":
+        kernel, n_inner = "tblock", 1
+    if kernel == "tblock":
+        rb_iter, block_rows, halo = sp.make_rb_iter_tblock(
+            imax, jmax, dx, dy, omega, dtype, n_inner=n_inner,
+            interpret=interpret,
+        )
+        if rb_iter is None:
+            raise ValueError("pallas backend unavailable")
+
+        def step(p_pad, rhs_pad):
+            p_pad, rsq = rb_iter(p_pad, rhs_pad)
+            return p_pad, rsq / norm
+
+        def pad(x):
+            return sp.pad_array(x, block_rows, halo)
+
+        def unpad(xp):
+            return sp.unpad_array(xp, jmax, imax, halo)
+
+        return step, pad, unpad
+
+    rb_iter, block_rows = sp.make_rb_iter_pallas(
         imax, jmax, dx, dy, omega, dtype, interpret=interpret
     )
     if rb_iter is None:
         raise ValueError("pallas backend unavailable")
-    norm = float(imax * jmax)
 
     def step(p_pad, rhs_pad):
         p_pad, rsq = rb_iter(p_pad, rhs_pad)

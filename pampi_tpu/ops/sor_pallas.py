@@ -54,6 +54,11 @@ except ImportError:  # pragma: no cover
 
 LANE = 128  # lane tile; DMA slice widths must be multiples of this
 
+# v5e has 128 MiB of VMEM; the default scoped-vmem compile limit is 16 MiB,
+# which caps the fused kernel at ~48-row blocks (one grid step per 48 rows —
+# per-step overhead then dominates). Raised per-kernel via CompilerParams.
+VMEM_LIMIT_BYTES = 100 << 20
+
 
 def _align(dtype) -> int:
     """Sublane tile for the dtype (f32: 8, bf16: 16); DMA row offsets and
@@ -78,11 +83,11 @@ def probe_pallas() -> bool:
     global _PROBE_OK
     if _PROBE_OK is None:
         try:
-            rb, br = make_rb_iter_fused(
+            rb, br, h = make_rb_iter_tblock(
                 126, 126, 1.0 / 126, 1.0 / 126, 1.9, jnp.float32,
-                interpret=False,
+                n_inner=1, interpret=False,
             )
-            z = pad_array(jnp.zeros((128, 128), jnp.float32), br)
+            z = pad_array(jnp.zeros((128, 128), jnp.float32), br, h)
             _, res = rb(z, z)
             float(res)  # force completion: async errors surface here
             _PROBE_OK = True
@@ -118,23 +123,25 @@ def pick_block_rows(jmax: int, imax: int, dtype=jnp.float32) -> int:
     return br
 
 
-def padded_rows(jmax: int, block_rows: int, dtype=jnp.float32) -> int:
-    a = _align(dtype)
+def padded_rows(jmax: int, block_rows: int, dtype=jnp.float32,
+                halo: int | None = None) -> int:
+    a = halo if halo is not None else _align(dtype)
     nblocks = -(-(jmax + 2) // block_rows)
     return nblocks * block_rows + 2 * a
 
 
-def pad_array(x, block_rows: int):
-    """(jmax+2, imax+2) -> padded layout; dead rows/columns are zero."""
+def pad_array(x, block_rows: int, halo: int | None = None):
+    """(jmax+2, imax+2) -> padded layout; dead rows/columns are zero.
+    `halo` rows of padding above/below (default: the sublane alignment)."""
     jmax = x.shape[0] - 2
-    rp = padded_rows(jmax, block_rows, x.dtype)
-    a = _align(x.dtype)
+    rp = padded_rows(jmax, block_rows, x.dtype, halo)
+    a = halo if halo is not None else _align(x.dtype)
     out = jnp.zeros((rp, padded_width(x.shape[1] - 2)), x.dtype)
     return out.at[a : a + jmax + 2, : x.shape[1]].set(x)
 
 
-def unpad_array(xp, jmax: int, imax: int):
-    a = _align(xp.dtype)
+def unpad_array(xp, jmax: int, imax: int, halo: int | None = None):
+    a = halo if halo is not None else _align(xp.dtype)
     return xp[a : a + jmax + 2, : imax + 2]
 
 
@@ -203,54 +210,65 @@ def _rb_kernel(
     st.wait()
 
 
-def _fused_kernel(
+def _tblock_kernel(
     p_in,  # ANY: padded p, read-only
     rhs,  # ANY, padded like p
-    p_out,  # ANY: fresh output (NOT aliased — out-of-place)
+    p_out,  # ANY: fresh output (out-of-place)
     res,  # SMEM (1, 1) accumulator
-    pw2,  # VMEM (2, BR+2A, Wp): double-buffered p windows
-    rw2,  # VMEM (2, BR+2A, Wp): double-buffered rhs windows
+    pw2,  # VMEM (2, BR+2H, Wp): double-buffered p windows
+    rw2,  # VMEM (2, BR+2H, Wp): double-buffered rhs windows
     ob2,  # VMEM (2, BR, Wp): double-buffered output bands
     ld_sem,  # DMA semaphores (2, 2): [slot, p|rhs]
     st_sem,  # DMA semaphores (2,): [slot]
     *,
+    n_inner: int,
     block_rows: int,
     nblocks: int,
     width: int,
     jmax: int,
-    pad: int,
+    halo: int,
     factor: float,
     idx2: float,
     idy2: float,
 ):
-    """One FULL red-black iteration in a single HBM sweep.
+    """`n_inner` FULL red-black iterations (each incl. the Neumann ghost
+    refresh) in a single HBM sweep — temporal blocking.
 
-    Block b loads the window of padded rows [b·BR, b·BR + BR + 2A) (owned band
-    at window rows [A, A+BR)), recomputes the red half-sweep on the halo rows
-    it needs (redundant compute instead of a second HBM pass), applies the
-    black half-sweep on its owned band, and stores the band out-of-place.
-    Loads for block b+1 are issued before the block-b compute, so DMA overlaps
-    the VPU work (ping-pong slots); stores drain one block behind.
+    One RB iteration consumes 2 rows of halo validity (red reads ±1 row,
+    black reads red-updated values ±1 row), so a window of the owned band
+    ±`halo` rows (halo ≥ 2·n_inner) yields a fully-converged owned band after
+    n_inner iterations with no second HBM pass: HBM traffic per iteration
+    drops to ~3/n_inner arrays. Halo rows are recomputed redundantly by both
+    neighbouring blocks (identical values — same data, same unrolled
+    arithmetic). The Neumann BC runs INSIDE the sweep between iterations
+    (mask form of `neumann_bc_padded`: ghost rows/cols only, corners and
+    dead padding untouched), because interior updates of iteration t+1 read
+    ghost values refreshed after iteration t.
+
+    Residual: accumulated for the LAST iteration only (static slice of the
+    owned band), so a convergence loop stepping this kernel observes the
+    residual of its final iteration — the same value a per-iteration loop
+    would see at that count.
     """
     b = pl.program_id(0)
     br = block_rows
-    a = pad
+    h = halo
     slot = b % 2
     nslot = (b + 1) % 2
 
     def load(k, s):
         return (
             pltpu.make_async_copy(
-                p_in.at[pl.ds(k * br, br + 2 * a), :], pw2.at[s], ld_sem.at[s, 0]
+                p_in.at[pl.ds(k * br, br + 2 * h), :], pw2.at[s], ld_sem.at[s, 0]
             ),
             pltpu.make_async_copy(
-                rhs.at[pl.ds(k * br, br + 2 * a), :], rw2.at[s], ld_sem.at[s, 1]
+                rhs.at[pl.ds(k * br, br + 2 * h), :], rw2.at[s], ld_sem.at[s, 1]
             ),
         )
 
     def store(k, s):
         return pltpu.make_async_copy(
-            ob2.at[s], p_out.at[pl.ds(a + k * br, br), :], st_sem.at[s]
+            ob2.at[s], p_out.at[pl.ds(h + k * br, br), :], st_sem.at[s]
         )
 
     @pl.when(b == 0)
@@ -277,33 +295,41 @@ def _fused_kernel(
         south = jnp.roll(x, 1, axis=0)
         return (east - 2.0 * x + west) * idx2 + (north - 2.0 * x + south) * idy2
 
-    # logical (j, i) of window cell (w, c): j = b*br + w - a, i = c
-    jj = b * br - a + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+    # logical (j, i) of window cell (w, c): j = b*br + w - h, i = c
+    jj = b * br - h + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
     ii = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
-    interior = (
-        (jj >= 1) & (jj <= jmax) & (ii >= 1) & (ii <= width - 2)
-    )
-    parity = (ii + jj) % 2
-    owned = (jj >= b * br) & (jj < (b + 1) * br)
+    interior = (jj >= 1) & (jj <= jmax) & (ii >= 1) & (ii <= width - 2)
+    red = interior & (((ii + jj) % 2) == 0)
+    black = interior & (((ii + jj) % 2) == 1)
+    row_ghost_lo = (jj == 0) & (ii >= 1) & (ii <= width - 2)
+    row_ghost_hi = (jj == jmax + 1) & (ii >= 1) & (ii <= width - 2)
+    row_int = (jj >= 1) & (jj <= jmax)
+    col_ghost_lo = (ii == 0) & row_int
+    col_ghost_hi = (ii == width - 1) & row_int
 
-    # red half-sweep: recomputed on halo rows too (their owners compute the
-    # identical values), so the black sweep sees red-updated neighbours
-    # without a second HBM pass
-    r_red = jnp.where(interior & (parity == 0), rw - lap(p), 0.0)
-    pr = p - factor * r_red
-    # black half-sweep: owned band only
-    r_blk = jnp.where(interior & (parity == 1) & owned, rw - lap(pr), 0.0)
-    pb = pr - factor * r_blk
+    r_red = r_blk = None
+    for t in range(n_inner):
+        r_red = jnp.where(red, rw - lap(p), 0.0)
+        p = p - factor * r_red
+        r_blk = jnp.where(black, rw - lap(p), 0.0)
+        p = p - factor * r_blk
+        # Neumann ghost refresh (walls only; corners/dead padding untouched)
+        p = jnp.where(row_ghost_lo, jnp.roll(p, -1, axis=0), p)
+        p = jnp.where(row_ghost_hi, jnp.roll(p, 1, axis=0), p)
+        p = jnp.where(col_ghost_lo, jnp.roll(p, -1, axis=1), p)
+        p = jnp.where(col_ghost_hi, jnp.roll(p, 1, axis=1), p)
 
     @pl.when(b >= 2)
     def _():
         store(b - 2, slot).wait()
 
-    ob2[slot] = pb[a : a + br, :]
+    ob2[slot] = p[h : h + br, :]
     store(b, slot).start()
 
-    r_red_own = jnp.where(owned, r_red, 0.0)
-    res[0, 0] += jnp.sum(r_red_own * r_red_own) + jnp.sum(r_blk * r_blk)
+    # residual of the final iteration, owned band only (static slice)
+    ro = r_red[h : h + br, :]
+    bo = r_blk[h : h + br, :]
+    res[0, 0] += jnp.sum(ro * ro) + jnp.sum(bo * bo)
 
     @pl.when(b == nblocks - 1)
     def _():
@@ -312,21 +338,30 @@ def _fused_kernel(
             store(b - 1, nslot).wait()
 
 
-def pick_block_rows_fused(jmax: int, imax: int, dtype=jnp.float32) -> int:
-    """Block height for the fused kernel: 6 buffers (2×p, 2×rhs windows of
-    BR+2A rows; 2 output bands of BR rows) under ~6 MiB of VMEM, leaving
-    headroom for the kernel's window-sized temporaries."""
+def tblock_halo(n_inner: int, dtype) -> int:
+    """Window halo for n_inner fused iterations: 2 rows per iteration,
+    rounded up to the DMA sublane alignment."""
     a = _align(dtype)
-    itemsize = jnp.dtype(dtype).itemsize
+    return max(a, -(-(2 * n_inner) // a) * a)
+
+
+def pick_block_rows_tblock(jmax: int, imax: int, dtype=jnp.float32,
+                           n_inner: int = 4) -> int:
+    """Block height for the temporal-blocked kernel. 256 rows at 4096-wide
+    f32 measured fastest on v5e (larger blocks push Mosaic's scoped-vmem
+    temporaries past the limit, smaller ones pay more redundant halo
+    recompute); scale the row count inversely with the padded width to hold
+    the window byte size roughly constant."""
+    a = _align(dtype)
+    h = tblock_halo(n_inner, dtype)
     wp = padded_width(imax)
-    row_bytes = wp * itemsize
-    budget_rows = (6 << 20) // row_bytes
-    br = max(a, min((budget_rows - 4 * 2 * a) // 6 // a * a, 512))
+    target = 256 * 4224 * 4  # bytes per window buffer that fit comfortably
+    br = target // (wp * jnp.dtype(dtype).itemsize) // a * a
     whole = -(-(jmax + 2) // a) * a
-    return min(br, whole)
+    return max(a, h, min(br, 512, whole))
 
 
-def make_rb_iter_fused(
+def make_rb_iter_tblock(
     imax: int,
     jmax: int,
     dx: float,
@@ -334,16 +369,20 @@ def make_rb_iter_fused(
     omega: float,
     dtype,
     *,
+    n_inner: int = 4,
     block_rows: int | None = None,
     interpret: bool | None = None,
 ):
-    """Fused single-sweep red-black iteration (see `_fused_kernel`): builds
-    `(p_padded, rhs_padded) -> (p_padded', res_sumsq)` on the same padded
-    layout as `make_rb_iter_pallas`; returns (rb_iter, block_rows)."""
+    """Temporal-blocked fused kernel (see `_tblock_kernel`): builds
+    `(p_padded, rhs_padded) -> (p_padded', res_sumsq_of_last_iter)` where one
+    call performs `n_inner` red-black iterations + Neumann BCs. The padded
+    layout uses `halo = tblock_halo(n_inner)` rows of padding (pass it to
+    `pad_array`/`unpad_array`). Returns (rb_iter, block_rows, halo)."""
     if pltpu is None:
-        return None, 0
+        return None, 0, 0
+    h = tblock_halo(n_inner, dtype)
     if block_rows is None:
-        block_rows = pick_block_rows_fused(jmax, imax, dtype)
+        block_rows = pick_block_rows_tblock(jmax, imax, dtype, n_inner)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     _check_dtype(dtype, interpret)
@@ -351,16 +390,16 @@ def make_rb_iter_fused(
     dx2, dy2 = dx * dx, dy * dy
     width = imax + 2
     wp = padded_width(imax)
-    a = _align(dtype)
     nblocks = -(-(jmax + 2) // block_rows)
-    rp = nblocks * block_rows + 2 * a
+    rp = nblocks * block_rows + 2 * h
     kernel = functools.partial(
-        _fused_kernel,
+        _tblock_kernel,
+        n_inner=n_inner,
         block_rows=block_rows,
         nblocks=nblocks,
         width=width,
         jmax=jmax,
-        pad=a,
+        halo=h,
         factor=omega * 0.5 * (dx2 * dy2) / (dx2 + dy2),
         idx2=1.0 / dx2,
         idy2=1.0 / dy2,
@@ -382,12 +421,15 @@ def make_rb_iter_fused(
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, block_rows + 2 * a, wp), dtype),
-            pltpu.VMEM((2, block_rows + 2 * a, wp), dtype),
+            pltpu.VMEM((2, block_rows + 2 * h, wp), dtype),
+            pltpu.VMEM((2, block_rows + 2 * h, wp), dtype),
             pltpu.VMEM((2, block_rows, wp), dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT_BYTES
+        ),
         interpret=interpret,
     )
 
@@ -395,7 +437,7 @@ def make_rb_iter_fused(
         p_padded, res = call(p_padded, rhs_padded)
         return p_padded, res[0, 0]
 
-    return rb_iter, block_rows
+    return rb_iter, block_rows, h
 
 
 def neumann_bc_padded(p, jmax: int, imax: int):
@@ -470,6 +512,9 @@ def make_rb_iter_pallas(
             pltpu.VMEM((block_rows, wp), dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT_BYTES
+        ),
         input_output_aliases={0: 0},
         interpret=interpret,
     )

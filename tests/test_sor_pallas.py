@@ -85,12 +85,14 @@ def test_full_solve_matches_jnp():
 
 
 def test_pick_block_rows_aligned():
-    from pampi_tpu.ops.sor_pallas import pick_block_rows_fused
+    from pampi_tpu.ops.sor_pallas import pick_block_rows_tblock, tblock_halo
 
     for jmax, imax in [(4096, 4096), (100, 100), (8192, 8192), (30, 50)]:
-        for pick in (pick_block_rows, pick_block_rows_fused):
-            br = pick(jmax, imax, jnp.float32)
-            assert br % 8 == 0 and br >= 8
+        br = pick_block_rows(jmax, imax, jnp.float32)
+        assert br % 8 == 0 and br >= 8
+        for n_inner in (1, 4, 8):
+            br = pick_block_rows_tblock(jmax, imax, jnp.float32, n_inner)
+            assert br % 8 == 0 and br >= tblock_halo(n_inner, jnp.float32)
 
 
 @pytest.mark.parametrize("shape", [(32, 32), (100, 100), (64, 32), (48, 96)])
@@ -118,6 +120,63 @@ def test_fused_matches_jnp(shape):
         np.testing.assert_allclose(float(res_p), float(res_j), rtol=1e-12)
 
 
+@pytest.mark.parametrize("shape", [(32, 32), (100, 100), (64, 32), (48, 96)])
+@pytest.mark.parametrize("n_inner", [1, 2, 4])
+def test_tblock_matches_jnp(shape, n_inner):
+    """The temporal-blocked kernel (n_inner RB iterations + Neumann BCs per
+    HBM sweep) must equal n_inner applications of the jnp step cell-for-cell,
+    and its residual must be the last iteration's."""
+    imax, jmax = shape
+    param = Parameter(imax=imax, jmax=jmax)
+    p0, rhs = init_fields(param, problem=2, dtype=jnp.float64)
+    dx, dy = 1.0 / imax, 1.0 / jmax
+
+    step_jnp = make_rb_step(imax, jmax, dx, dy, 1.9, jnp.float64, backend="jnp")
+    step_pal, pad, unpad = make_rb_step_padded(
+        imax, jmax, dx, dy, 1.9, jnp.float64, interpret=True,
+        kernel="tblock", n_inner=n_inner,
+    )
+
+    p_j = p0
+    p_p, rhs_p = pad(p0), pad(rhs)
+    for _ in range(2):  # two sweeps: ghost state carried across calls
+        for _ in range(n_inner):
+            p_j, res_j = step_jnp(p_j, rhs)
+        p_p, res_p = step_pal(p_p, rhs_p)
+        np.testing.assert_allclose(
+            np.asarray(unpad(p_p)), np.asarray(p_j), atol=1e-13
+        )
+        np.testing.assert_allclose(float(res_p), float(res_j), rtol=1e-12)
+
+
+def test_tblock_multiblock():
+    """Force several row blocks so the halo recompute depth (2 rows per inner
+    iteration) and the ragged tail are exercised across block boundaries."""
+    imax, jmax = 64, 100
+    param = Parameter(imax=imax, jmax=jmax)
+    p0, rhs = init_fields(param, problem=2, dtype=jnp.float64)
+    dx, dy = 1.0 / imax, 1.0 / jmax
+
+    from pampi_tpu.ops.sor_pallas import make_rb_iter_tblock, tblock_halo
+
+    step_jnp = make_rb_step(imax, jmax, dx, dy, 1.9, jnp.float64, backend="jnp")
+    rb, br, h = make_rb_iter_tblock(
+        imax, jmax, dx, dy, 1.9, jnp.float64, n_inner=3, block_rows=16,
+        interpret=True,
+    )
+    assert br == 16 and h == tblock_halo(3, jnp.float64)
+    p_j = p0
+    for _ in range(3):
+        p_j, res_j = step_jnp(p_j, rhs)
+    p_p, rsq = rb(pad_array(p0, 16, h), pad_array(rhs, 16, h))
+    np.testing.assert_allclose(
+        np.asarray(unpad_array(p_p, jmax, imax, h)), np.asarray(p_j),
+        atol=1e-13,
+    )
+    np.testing.assert_allclose(float(rsq / imax / jmax), float(res_j),
+                               rtol=1e-12)
+
+
 def test_fused_multiblock():
     """Several row blocks: halo red-recompute, ragged tail masking, and the
     double-buffered store drain across block boundaries."""
@@ -126,17 +185,17 @@ def test_fused_multiblock():
     p0, rhs = init_fields(param, problem=2, dtype=jnp.float64)
     dx, dy = 1.0 / imax, 1.0 / jmax
 
-    from pampi_tpu.ops.sor_pallas import make_rb_iter_fused, neumann_bc_padded
+    from pampi_tpu.ops.sor_pallas import make_rb_iter_tblock, tblock_halo
 
     step_jnp = make_rb_step(imax, jmax, dx, dy, 1.9, jnp.float64, backend="jnp")
-    rb16, br = make_rb_iter_fused(
-        imax, jmax, dx, dy, 1.9, jnp.float64, block_rows=16, interpret=True
+    rb16, br, h = make_rb_iter_tblock(
+        imax, jmax, dx, dy, 1.9, jnp.float64, n_inner=1, block_rows=16,
+        interpret=True,
     )
-    assert br == 16
+    assert br == 16 and h == tblock_halo(1, jnp.float64)
     p_j, res_j = step_jnp(p0, rhs)
-    p_p, rsq = rb16(pad_array(p0, 16), pad_array(rhs, 16))
-    p_p = neumann_bc_padded(p_p, jmax, imax)
+    p_p, rsq = rb16(pad_array(p0, 16, h), pad_array(rhs, 16, h))
     np.testing.assert_allclose(
-        np.asarray(unpad_array(p_p, jmax, imax)), np.asarray(p_j), atol=1e-13
+        np.asarray(unpad_array(p_p, jmax, imax, h)), np.asarray(p_j), atol=1e-13
     )
     np.testing.assert_allclose(float(rsq / imax / jmax), float(res_j), rtol=1e-12)
