@@ -10,6 +10,8 @@
 # Targets:
 #   make            exe-$(TAG) + build/$(TAG)/libpampi_native.so
 #   make test       native smoke test (shim --dry-run on configs/)
+#   make asm        assembly listings for the native sources (ref: `make asm`)
+#   make format     clang-format the native sources, if available
 #   make clean      remove build/$(TAG) and exe-$(TAG)
 #   make distclean  remove build/ and all exes
 
@@ -42,10 +44,21 @@ test: all
 	./exe-$(TAG) --dry-run configs/poisson.par
 	./exe-$(TAG) --dry-run configs/dcavity3d.par
 
+asm: | $(BUILD)
+	for f in $(LIBSRCS) $(SRC)/shim_main.c; do \
+	  $(CC) $(CFLAGS) $(CPPFLAGS) -S -o $(BUILD)/$$(basename $$f .c).s $$f \
+	    || exit 1; done
+	@echo "listings in $(BUILD)/"
+
+format:
+	@command -v clang-format >/dev/null 2>&1 \
+	  && clang-format -i $(SRC)/*.c $(SRC)/*.h \
+	  || echo "clang-format not installed; skipping"
+
 clean:
 	rm -rf $(BUILD) exe-$(TAG)
 
 distclean:
 	rm -rf build exe-*
 
-.PHONY: all test clean distclean
+.PHONY: all test asm format clean distclean

@@ -34,6 +34,7 @@ typedef struct {
     char name[128];
     long bcLeft, bcRight, bcBottom, bcTop, bcFront, bcBack;
     double u_init, v_init, w_init, p_init;
+    char obstacles[256]; /* ';'-separated "x0,y0,x1,y1" rects; "" = none */
     char tpu_mesh[64];
     char tpu_dtype[32];
     unsigned seen; /* bitmask over PAMPI_SEEN_* below */

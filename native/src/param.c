@@ -60,6 +60,7 @@ static const FieldDesc FIELDS[] = {
     F_DBL(v_init, v_init),
     F_DBL(w_init, w_init),
     F_DBL(p_init, p_init),
+    F_STR(obstacles, obstacles),
     F_STR(tpu_mesh, tpu_mesh),
     F_STR(tpu_dtype, tpu_dtype),
 };

@@ -5,6 +5,7 @@ assignment-6/src/main.c:21-110): parse argv -> read .par -> echo config ->
 run solver -> write outputs -> print walltime. Dispatch on the `name` key:
   poisson           -> 2-D Poisson red-black SOR      (assignment-4)
   dcavity / canal   -> NS-2D time-stepper             (assignment-5)
+  canal_obstacle    -> NS-2D canal + flag-masked obstacles (ops/obstacle.py)
   dcavity3d/canal3d -> NS-3D time-stepper             (assignment-6)
 """
 
@@ -89,6 +90,18 @@ def _run(argv) -> int:
 def _dispatch(param, prof) -> int:
     from .utils.timing import get_timestamp
 
+    if param.obstacles.strip():
+        from .utils.params import is_3d_config
+
+        if param.name.startswith("poisson") or is_3d_config(param):
+            # refuse rather than silently simulate an empty box
+            print(
+                "Error: the obstacles key is supported for 2-D NS problems "
+                "only (dcavity/canal/canal_obstacle)",
+                file=sys.stderr,
+            )
+            return 1
+
     if param.name.startswith("poisson"):
         from .models.poisson import PoissonSolver
 
@@ -112,7 +125,8 @@ def _dispatch(param, prof) -> int:
         with prof.region("writeResult"):
             solver.write_result("p.dat")
         print("Walltime %.2fs" % (end - start))
-    elif param.name in ("dcavity", "canal", "dcavity3d", "canal3d"):
+    elif param.name in ("dcavity", "canal", "canal_obstacle", "dcavity3d",
+                        "canal3d"):
         from .utils.params import is_3d_config
 
         is3d = is_3d_config(param)
@@ -132,6 +146,11 @@ def _dispatch(param, prof) -> int:
                 from .models.ns2d import NS2DSolver
 
                 return NS2DSolver(param)
+            if param.obstacles.strip():
+                raise ValueError(
+                    "obstacles are single-device NS-2D only for now; "
+                    "set tpu_mesh 1"
+                )
             from .models.ns2d_dist import NS2DDistSolver
 
             return NS2DDistSolver(param, comm)

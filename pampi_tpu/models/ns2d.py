@@ -34,7 +34,7 @@ from ..utils.progress import Progress
 
 
 def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
-                        backend: str = "auto"):
+                        backend: str = "auto", n_inner: int = 1):
     """Pressure-Poisson red-black SOR loop (solve, solver.c:140-191): carry
     (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep.
 
@@ -44,7 +44,7 @@ def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
     from .poisson import make_solver_fn
 
     return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
-                          backend=backend)
+                          backend=backend, n_inner=n_inner)
 
 
 class NS2DSolver:
@@ -69,24 +69,52 @@ class NS2DSolver:
         self.t = 0.0
         self.nt = 0
         self._backend = "auto"
+        # flag-field obstacles (ops/obstacle.py): static geometry -> static
+        # masks baked into the traced step as constants (branch-free)
+        if param.obstacles.strip():
+            from ..ops import obstacle as obst
+
+            fluid = obst.build_fluid(
+                param.imax, param.jmax, self.dx, self.dy, param.obstacles
+            )
+            self.masks = obst.make_masks(fluid, self.dx, self.dy, param.omg, dtype)
+        else:
+            self.masks = None
         self._chunk_fn = jax.jit(self._build_chunk())
+
+    def _uses_pallas(self) -> bool:
+        """Whether the current chunk's pressure solve dispatches to pallas
+        (obstacle solves and jnp-dispatched dtypes/backends never do)."""
+        from .poisson import _use_pallas
+
+        return self.masks is None and _use_pallas(self._backend, self.dtype)
 
     # -- one full timestep, traced ------------------------------------
     def _build_step(self, backend: str = "auto"):
         param = self.param
         dx, dy = self.dx, self.dy
         dtype = self.dtype
-        solve = make_pressure_solve(
-            param.imax,
-            param.jmax,
-            dx,
-            dy,
-            param.omg,
-            param.eps,
-            param.itermax,
-            dtype,
-            backend=backend,
-        )
+        masks = self.masks
+        if masks is None:
+            solve = make_pressure_solve(
+                param.imax,
+                param.jmax,
+                dx,
+                dy,
+                param.omg,
+                param.eps,
+                param.itermax,
+                dtype,
+                backend=backend,
+                n_inner=param.tpu_sor_inner,
+            )
+        else:
+            from ..ops import obstacle as obst
+
+            solve = obst.make_obstacle_solver_fn(
+                param.imax, param.jmax, dx, dy, param.eps, param.itermax,
+                masks, dtype,
+            )
         adaptive = param.tau > 0.0
         problem = param.name
 
@@ -100,15 +128,39 @@ class NS2DSolver:
             )
             if problem == "dcavity":
                 u = ops.set_special_bc_dcavity(u)
-            elif problem == "canal":
+            elif problem in ("canal", "canal_obstacle"):
                 u = ops.set_special_bc_canal(u, dy, param.ylength, dtype)
+            if masks is not None:
+                from ..ops.obstacle import (
+                    apply_obstacle_velocity_bc,
+                    mask_fg,
+                )
+
+                u, v = apply_obstacle_velocity_bc(u, v, masks)
             f, g = ops.compute_fg(
                 u, v, dt, param.re, param.gx, param.gy, param.gamma, dx, dy
             )
+            if masks is not None:
+                f, g = mask_fg(f, g, u, v, masks)
             rhs = ops.compute_rhs(f, g, dt, dx, dy)
-            p = lax.cond(nt % 100 == 0, ops.normalize_pressure, lambda q: q, p)
+            if masks is None:
+                p = lax.cond(nt % 100 == 0, ops.normalize_pressure, lambda q: q, p)
+            else:
+                from ..ops.obstacle import normalize_pressure_fluid
+
+                p = lax.cond(
+                    nt % 100 == 0,
+                    lambda q: normalize_pressure_fluid(q, masks),
+                    lambda q: q,
+                    p,
+                )
             p, _res, _it = solve(p, rhs)
-            u, v = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
+            if masks is None:
+                u, v = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
+            else:
+                from ..ops.obstacle import adapt_uv_obstacle
+
+                u, v = adapt_uv_obstacle(u, v, f, g, p, dt, dx, dy, masks)
             # t accumulates in high precision regardless of the field dtype
             # (bfloat16 would stall t once ulp/2 > dt and never reach te)
             time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -153,11 +205,17 @@ class NS2DSolver:
                 un, vn, pn, tn, ntn = self._chunk_fn(u, v, p, t, nt)
                 float(tn)  # force completion: async pallas faults surface here
             except Exception:
-                if self._backend == "jnp":
-                    raise
+                if self._backend == "jnp" or not self._uses_pallas():
+                    raise  # the failing chunk never ran pallas — genuine error
                 # shape-specific pallas failure the dispatcher probe missed:
                 # rebuild the whole chunk on the jnp path (same arithmetic)
                 # and retry this chunk — inputs are unchanged (functional)
+                import warnings
+
+                warnings.warn(
+                    "pallas pressure solve failed at runtime; retrying this "
+                    "chunk on the jnp path", stacklevel=2,
+                )
                 self._backend = "jnp"
                 self._chunk_fn = jax.jit(self._build_chunk(backend="jnp"))
                 continue

@@ -65,21 +65,27 @@ def _use_pallas(backend: str, dtype=jnp.float32) -> bool:
 
 def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
                  n_inner: int = 1):
-    """Public dispatcher for loop-carried use: returns (step, prep, post)
-    where prep/post convert the loop-carried array at the boundary (padded
-    layout under pallas, identity under jnp). The single decision point for
-    the backend choice — bench.py and the solvers both go through here.
+    """Public dispatcher for loop-carried use: returns
+    (step, prep, post, eff_inner) where prep/post convert the loop-carried
+    array at the boundary (padded layout under pallas, identity under jnp)
+    and eff_inner is the number of red-black iterations one `step` call
+    ACTUALLY performs. The single decision point for the backend choice —
+    bench.py and the solvers both go through here.
 
     n_inner > 1 selects the temporal-blocked pallas kernel: one `step` call
     performs n_inner red-black iterations (+BCs) in a single HBM sweep and
-    reports the residual of the last one. Ignored on the jnp path."""
+    reports the residual of the last one. The jnp path always steps one
+    iteration at a time — eff_inner tells the caller which happened, so
+    iteration accounting stays honest on both paths."""
     if _use_pallas(backend, dtype):
         kernel = "tblock" if n_inner > 1 else "fused"
-        return make_rb_step_padded(imax, jmax, dx, dy, omega, dtype,
-                                   kernel=kernel, n_inner=n_inner)
+        step, prep, post = make_rb_step_padded(
+            imax, jmax, dx, dy, omega, dtype, kernel=kernel, n_inner=n_inner
+        )
+        return step, prep, post, n_inner
     step = make_rb_step(imax, jmax, dx, dy, omega, dtype, backend="jnp")
     ident = lambda x: x  # noqa: E731
-    return step, ident, ident
+    return step, ident, ident, 1
 
 
 def make_rb_step_padded(imax, jmax, dx, dy, omega, dtype, interpret=None,
@@ -170,13 +176,21 @@ def make_rb_step(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
     return step
 
 
-def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype, backend="auto"):
+def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
+                   backend="auto", n_inner: int = 1):
     """The full convergence loop as one jittable function (p0, rhs) -> (p, res, it).
 
     On the pallas backend the loop carries the PADDED array (one pad before,
-    one unpad after — no per-iteration layout conversion)."""
+    one unpad after — no per-iteration layout conversion). With n_inner > 1
+    (pallas only) each loop step runs n_inner red-black iterations in one
+    HBM sweep; convergence is then checked every n_inner iterations, so the
+    solve may do up to n_inner-1 more iterations than a per-iteration check
+    would (the extra iterations only lower the residual further). `it`
+    reports the true iteration count on every path."""
     epssq = eps * eps
-    step, prep, post = make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend)
+    step, prep, post, eff = make_rb_loop(
+        imax, jmax, dx, dy, omega, dtype, backend, n_inner
+    )
 
     def solve(p0, rhs):
         rhs = prep(rhs)
@@ -188,7 +202,7 @@ def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype, backend="auto
         def body(carry):
             p, _, it = carry
             p, res = step(p, rhs)
-            return p, res, it + 1
+            return p, res, it + eff
 
         init = (prep(p0), jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
         p, res, it = jax.lax.while_loop(cond, body, init)
@@ -223,6 +237,7 @@ class PoissonSolver:
             self.param.itermax,
             self.dtype,
             backend=backend,
+            n_inner=self.param.tpu_sor_inner,
         )
 
     def solve(self):

@@ -1,0 +1,261 @@
+"""Flag-field obstacle cells for NS-2D — branch-free masks, TPU-first.
+
+The reference has no obstacle support (its canal is an empty channel); this
+implements the classic NaSt2D-style flag field (the BASELINE.json
+"channel-with-obstacle, flag-masked cells" config) as *precomputed static
+masks* instead of per-cell flag branches, so every op stays a fused
+whole-array pass:
+
+- geometry is static config (.par `obstacles` key: semicolon-separated
+  axis-aligned rectangles in physical coordinates), so all masks are
+  trace-time constants
+- velocity: normal components on obstacle faces are zeroed; tangential
+  components in obstacle boundary cells mirror the adjacent fluid value
+  (u_ghost = -u_fluid) so the interpolated wall velocity is zero (no-slip)
+- momentum fluxes: F/G carry U/V on obstacle faces (the same trick the
+  reference uses at domain walls, solver.c:425-435) so the pressure RHS sees
+  div = 0 across obstacle walls and the projection leaves them untouched
+- pressure: the SOR stencil uses per-direction fluid coefficients
+  (eps_E/W/N/S ∈ {0,1}) in both numerator and denominator — homogeneous
+  Neumann dp/dn = 0 on obstacle surfaces, with the cell's relaxation factor
+  ω / ((eps_E+eps_W)/dx² + (eps_N+eps_S)/dy²) precomputed as an array; away
+  from obstacles it reduces exactly to the uniform formula
+- residuals and the pressure normalization reduce over fluid cells only
+
+Obstacles must be at least 2 cells thick in each direction (an obstacle cell
+with fluid on two opposite sides has no well-defined mirror value); geometry
+violating this is rejected at setup, like NaSt2D's flag-consistency check.
+
+Layout matches ops/ns2d.py: arrays (jmax+2, imax+2), [j, i]; u on east
+faces, v on north faces, p at centers; the ghost ring counts as fluid so the
+domain-wall BCs (ops/ns2d.py) compose unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_obstacles(spec: str) -> list[tuple[float, float, float, float]]:
+    """Parse the .par `obstacles` value: "x0,y0,x1,y1[;x0,y0,x1,y1]...".
+
+    Empty/whitespace spec -> no obstacles."""
+    rects = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        vals = [float(v) for v in part.split(",")]
+        if len(vals) != 4:
+            raise ValueError(
+                f"obstacle rectangle needs 4 values x0,y0,x1,y1, got {part!r}"
+            )
+        x0, y0, x1, y1 = vals
+        rects.append((min(x0, x1), min(y0, y1), max(x0, x1), max(y0, y1)))
+    return rects
+
+
+def build_fluid(imax: int, jmax: int, dx: float, dy: float, spec: str):
+    """Boolean fluid mask (jmax+2, imax+2); True = fluid. A cell is obstacle
+    iff its center lies inside any rectangle. Ghost ring is always fluid
+    (domain walls are handled by the wall-BC code, not the flag field)."""
+    fluid = np.ones((jmax + 2, imax + 2), dtype=bool)
+    x = (np.arange(imax + 2) - 0.5) * dx  # center of cell column i
+    y = (np.arange(jmax + 2) - 0.5) * dy
+    for (x0, y0, x1, y1) in parse_obstacles(spec):
+        inside = (
+            (x[None, :] > x0) & (x[None, :] < x1)
+            & (y[:, None] > y0) & (y[:, None] < y1)
+        )
+        fluid &= ~inside
+    fluid[0, :] = fluid[-1, :] = True
+    fluid[:, 0] = fluid[:, -1] = True
+    _validate(fluid)
+    return fluid
+
+
+def _validate(fluid: np.ndarray) -> None:
+    obs = ~fluid[1:-1, 1:-1]
+    thin_h = obs & fluid[1:-1, :-2] & fluid[1:-1, 2:]
+    thin_v = obs & fluid[:-2, 1:-1] & fluid[2:, 1:-1]
+    if thin_h.any() or thin_v.any():
+        raise ValueError(
+            "obstacle cells with fluid on two opposite sides (1-cell-thin "
+            "walls) are not representable; make obstacles >= 2 cells thick"
+        )
+
+
+@dataclass(frozen=True)
+class ObstacleMasks:
+    """Static mask arrays for one geometry+grid (trace-time constants)."""
+
+    fluid: jnp.ndarray       # (J+2, I+2) 0/1 cell-is-fluid
+    u_face: jnp.ndarray      # (J+2, I+2) 1 where u[j,i] is a fluid-fluid face
+    v_face: jnp.ndarray      # (J+2, I+2) 1 where v[j,i] is a fluid-fluid face
+    p_mask: jnp.ndarray      # (J, I) interior fluid-cell mask for residuals
+    eps_e: jnp.ndarray       # (J, I) interior: east neighbour is fluid
+    eps_w: jnp.ndarray
+    eps_n: jnp.ndarray
+    eps_s: jnp.ndarray
+    factor: jnp.ndarray      # (J, I) per-cell omega / denom (0 in obstacles)
+    n_fluid: float           # number of interior fluid cells
+
+    @property
+    def any_obstacle(self) -> bool:
+        return float(self.n_fluid) < (self.p_mask.shape[0] * self.p_mask.shape[1])
+
+
+def make_masks(fluid_np: np.ndarray, dx: float, dy: float, omega: float,
+               dtype) -> ObstacleMasks:
+    f = fluid_np
+    u_face = f & np.roll(f, -1, axis=1)
+    u_face[:, -1] = True  # roll wrap on the ghost column; ghosts are fluid
+    v_face = f & np.roll(f, -1, axis=0)
+    v_face[-1, :] = True
+    fi = f[1:-1, 1:-1]
+    eps_e = (f[1:-1, 2:] & fi).astype(np.float64)
+    eps_w = (f[1:-1, :-2] & fi).astype(np.float64)
+    eps_n = (f[2:, 1:-1] & fi).astype(np.float64)
+    eps_s = (f[:-2, 1:-1] & fi).astype(np.float64)
+    idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
+    denom = (eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
+    with np.errstate(divide="ignore", invalid="ignore"):
+        factor = np.where(denom > 0, omega / denom, 0.0) * fi
+    return ObstacleMasks(
+        fluid=jnp.asarray(f, dtype),
+        u_face=jnp.asarray(u_face, dtype),
+        v_face=jnp.asarray(v_face, dtype),
+        p_mask=jnp.asarray(fi, dtype),
+        eps_e=jnp.asarray(eps_e, dtype),
+        eps_w=jnp.asarray(eps_w, dtype),
+        eps_n=jnp.asarray(eps_n, dtype),
+        eps_s=jnp.asarray(eps_s, dtype),
+        factor=jnp.asarray(factor, dtype),
+        n_fluid=float(fi.sum()),
+    )
+
+
+def apply_obstacle_velocity_bc(u, v, m: ObstacleMasks):
+    """No-slip on obstacle surfaces.
+
+    1) Normal components: u/v on any face touching an obstacle cell are
+       zeroed (the face mask).
+    2) Tangential ghosts: a u-face BETWEEN two obstacle cells that sits one
+       row below/above a fluid-fluid face mirrors it (u = -u_fluid), so the
+       velocity interpolated at the horizontal obstacle wall is zero — the
+       same -u ghost trick the domain-wall NOSLIP case uses
+       (ops/ns2d.py set_boundary_conditions). v symmetric with columns.
+       Faces deeper inside an obstacle stay 0.
+    """
+    one = jnp.ones((), u.dtype)
+    u = u * m.u_face
+    v = v * m.v_face
+    # u: faces with both cells obstacle; mirror across the nearer horizontal wall
+    both_obs_u = (one - m.fluid) * (one - jnp.roll(m.fluid, -1, axis=1))
+    uf_n = jnp.roll(m.u_face, -1, axis=0)  # fluid-fluid face one row north
+    uf_s = jnp.roll(m.u_face, 1, axis=0)
+    u_n = jnp.roll(u, -1, axis=0)
+    u_s = jnp.roll(u, 1, axis=0)
+    u = u + both_obs_u * (uf_n * (-u_n) + (one - uf_n) * uf_s * (-u_s))
+    # v: faces with both cells obstacle; mirror across the nearer vertical wall
+    both_obs_v = (one - m.fluid) * (one - jnp.roll(m.fluid, -1, axis=0))
+    vf_e = jnp.roll(m.v_face, -1, axis=1)
+    vf_w = jnp.roll(m.v_face, 1, axis=1)
+    v_e = jnp.roll(v, -1, axis=1)
+    v_w = jnp.roll(v, 1, axis=1)
+    v = v + both_obs_v * (vf_e * (-v_e) + (one - vf_e) * vf_w * (-v_w))
+    return u, v
+
+
+# -- pressure: eps-coefficient SOR -----------------------------------------
+
+def sor_pass_obstacle(p, rhs, color_mask, m: ObstacleMasks, idx2, idy2):
+    """One masked half-sweep with per-direction fluid coefficients.
+
+    r = rhs - [eps_e(pE - c) + eps_w(pW - c)]/dx² - [eps_n(pN - c) + eps_s(pS - c)]/dy²
+    p -= (omega/denom) * r      (denom per cell, precomputed in m.factor;
+                                 note m.factor already includes omega)
+    restricted to `color_mask` ∩ fluid. Returns (p, sum of masked r²)."""
+    c = p[1:-1, 1:-1]
+    lap = (
+        m.eps_e * (p[1:-1, 2:] - c) + m.eps_w * (p[1:-1, :-2] - c)
+    ) * idx2 + (
+        m.eps_n * (p[2:, 1:-1] - c) + m.eps_s * (p[:-2, 1:-1] - c)
+    ) * idy2
+    r = (rhs[1:-1, 1:-1] - lap) * color_mask * m.p_mask
+    p = p.at[1:-1, 1:-1].add(-m.factor * r)
+    return p, jnp.sum(r * r)
+
+
+def make_obstacle_solver_fn(imax, jmax, dx, dy, eps, itermax, m: ObstacleMasks,
+                            dtype):
+    """Full pressure-solve convergence loop with obstacle coefficients:
+    (p0, rhs) -> (p, res, it) as one jittable `lax.while_loop` — the obstacle
+    counterpart of models/poisson.make_solver_fn. The residual is normalized
+    by the number of FLUID cells (the reference's imax·jmax norm counts every
+    interior cell; obstacle cells carry no residual — documented deviation)."""
+    import jax
+
+    from .sor import checkerboard_mask, neumann_bc
+
+    idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
+    red = checkerboard_mask(jmax, imax, 0, dtype)
+    black = checkerboard_mask(jmax, imax, 1, dtype)
+    epssq = eps * eps
+    norm = m.n_fluid
+
+    def step(p, rhs):
+        p, r0 = sor_pass_obstacle(p, rhs, red, m, idx2, idy2)
+        p, r1 = sor_pass_obstacle(p, rhs, black, m, idx2, idy2)
+        return neumann_bc(p), (r0 + r1) / norm
+
+    def solve(p0, rhs):
+        def cond(carry):
+            _, res, it = carry
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(carry):
+            p, _, it = carry
+            p, res = step(p, rhs)
+            return p, res, it + 1
+
+        init = (p0, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        return jax.lax.while_loop(cond, body, init)
+
+    return solve
+
+
+def normalize_pressure_fluid(p, m: ObstacleMasks):
+    """Subtract the mean over fluid cells (interior+ghosts counted as in the
+    reference's full-array mean, but obstacle cells excluded — their p is
+    meaningless)."""
+    total = jnp.sum(p * m.fluid)
+    count = jnp.sum(m.fluid)
+    return p - total / count
+
+
+def mask_fg(f, g, u, v, m: ObstacleMasks):
+    """F carries U (and G carries V) on every non-fluid face — obstacle
+    analog of the reference's wall fixups (solver.c:425-435): the divergence
+    RHS then sees zero flux across obstacle walls and adaptUV leaves their
+    face velocities untouched."""
+    one = jnp.ones((), f.dtype)
+    f = m.u_face * f + (one - m.u_face) * u
+    g = m.v_face * g + (one - m.v_face) * v
+    return f, g
+
+
+def adapt_uv_obstacle(u, v, f, g, p, dt, dx, dy, m: ObstacleMasks):
+    """Projection restricted to fluid-fluid faces (with mask_fg applied the
+    unmasked projection is already a no-op on obstacle faces; the explicit
+    mask keeps them exactly zero against float drift)."""
+    fx = dt / dx
+    fy = dt / dy
+    u_new = f[1:-1, 1:-1] - (p[1:-1, 2:] - p[1:-1, 1:-1]) * fx
+    v_new = g[1:-1, 1:-1] - (p[2:, 1:-1] - p[1:-1, 1:-1]) * fy
+    u = u.at[1:-1, 1:-1].set(u_new * m.u_face[1:-1, 1:-1])
+    v = v.at[1:-1, 1:-1].set(v_new * m.v_face[1:-1, 1:-1])
+    return u, v

@@ -59,9 +59,18 @@ class Parameter:
     v_init: float = 0.0
     w_init: float = 0.0
     p_init: float = 0.0
+    # obstacle geometry (ops/obstacle.py; the reference's canal is an empty
+    # channel — this drives the flag-masked channel-with-obstacle config):
+    # semicolon-separated rectangles "x0,y0,x1,y1;..." in physical coords
+    obstacles: str = ""
     # framework-only (TPU execution controls; not in the reference)
     tpu_mesh: str = "auto"
     tpu_dtype: str = "float64"
+    # temporal-blocking depth of the pallas SOR kernel: red-black iterations
+    # fused per HBM sweep; convergence is checked every tpu_sor_inner
+    # iterations, so a solve may overshoot by up to tpu_sor_inner-1
+    # iterations (jnp paths always step singly). 4 measured fastest on v5e.
+    tpu_sor_inner: int = 4
     # checkpoint/restart (utils/checkpoint.py; the reference has none)
     tpu_checkpoint: str = ""
     tpu_ckpt_every: int = 10

@@ -1,0 +1,245 @@
+"""Flag-field obstacle cells (ops/obstacle.py).
+
+Tiers:
+1. geometry: flag building, thin-wall rejection, mask consistency
+2. reduction-to-reference: with an all-fluid flag the masked ops must equal
+   the unmasked ones bit-for-bit (same arithmetic), so the obstacle machinery
+   provably changes nothing when no obstacle is present
+3. physics invariants on a small channel-with-block run: zero velocity inside
+   the obstacle, bounded divergence in fluid cells, faster flow in the gaps
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.ops import ns2d as ops
+from pampi_tpu.ops import obstacle as obst
+from pampi_tpu.utils.params import Parameter
+
+
+def test_parse_obstacles():
+    assert obst.parse_obstacles("") == []
+    assert obst.parse_obstacles(" ; ") == []
+    assert obst.parse_obstacles("1,2,3,4") == [(1.0, 2.0, 3.0, 4.0)]
+    # corners given in any order are normalized
+    assert obst.parse_obstacles("3,4,1,2;0,0,1,1") == [
+        (1.0, 2.0, 3.0, 4.0),
+        (0.0, 0.0, 1.0, 1.0),
+    ]
+    with pytest.raises(ValueError):
+        obst.parse_obstacles("1,2,3")
+
+
+def test_build_fluid_geometry():
+    # 8x8 grid on the unit square: block covering centers in (0.25,0.75)^2
+    fluid = obst.build_fluid(8, 8, 1 / 8, 1 / 8, "0.25,0.25,0.75,0.75")
+    interior = fluid[1:-1, 1:-1]
+    # cell centers (i-0.5)/8: inside for i in {3..6}
+    expected = np.ones((8, 8), bool)
+    expected[2:6, 2:6] = False
+    np.testing.assert_array_equal(interior, expected)
+    # ghost ring always fluid
+    assert fluid[0].all() and fluid[-1].all()
+    assert fluid[:, 0].all() and fluid[:, -1].all()
+
+
+def test_thin_wall_rejected():
+    # 1-cell-thin vertical wall: x covers exactly one cell-center column
+    with pytest.raises(ValueError):
+        obst.build_fluid(8, 8, 1 / 8, 1 / 8, "0.28,0.2,0.35,0.8")
+
+
+def test_masks_consistency():
+    fluid = obst.build_fluid(8, 8, 1 / 8, 1 / 8, "0.25,0.25,0.75,0.75")
+    m = obst.make_masks(fluid, 1 / 8, 1 / 8, 1.7, jnp.float64)
+    assert m.any_obstacle
+    # u faces: zero wherever either side is obstacle
+    uf = np.asarray(m.u_face)
+    fl = np.asarray(m.fluid)
+    for j in range(1, 9):
+        for i in range(1, 8):
+            assert uf[j, i] == (fl[j, i] and fl[j, i + 1])
+    # factor is 0 exactly on obstacle cells, positive on fluid interior
+    fac = np.asarray(m.factor)
+    np.testing.assert_array_equal(fac > 0, np.asarray(m.p_mask) > 0)
+
+
+def _all_fluid_masks(imax, jmax, dx, dy, omg, dtype):
+    fluid = obst.build_fluid(imax, jmax, dx, dy, "")
+    return obst.make_masks(fluid, dx, dy, omg, dtype)
+
+
+def test_all_fluid_reduces_to_reference_ops():
+    """No obstacles -> every masked op equals its unmasked counterpart."""
+    rng = np.random.default_rng(0)
+    imax = jmax = 16
+    dx = dy = 1.0 / 16
+    m = _all_fluid_masks(imax, jmax, dx, dy, 1.7, jnp.float64)
+    assert not m.any_obstacle
+    shape = (jmax + 2, imax + 2)
+    u = jnp.asarray(rng.standard_normal(shape))
+    v = jnp.asarray(rng.standard_normal(shape))
+    p = jnp.asarray(rng.standard_normal(shape))
+    rhs = jnp.asarray(rng.standard_normal(shape))
+
+    # velocity BC is the identity
+    u2, v2 = obst.apply_obstacle_velocity_bc(u, v, m)
+    np.testing.assert_array_equal(np.asarray(u2), np.asarray(u))
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(v))
+
+    # masked F/G is the identity
+    f, g = ops.compute_fg(u, v, 0.01, 100.0, 0.0, 0.0, 0.9, dx, dy)
+    f2, g2 = obst.mask_fg(f, g, u, v, m)
+    np.testing.assert_array_equal(np.asarray(f2), np.asarray(f))
+    np.testing.assert_array_equal(np.asarray(g2), np.asarray(g))
+
+    # masked SOR pass equals the uniform pass
+    from pampi_tpu.ops.sor import checkerboard_mask, sor_pass
+
+    idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
+    red = checkerboard_mask(jmax, imax, 0, jnp.float64)
+    factor = 1.7 * 0.5 * (dx * dx * dy * dy) / (dx * dx + dy * dy)
+    p_a, r_a = sor_pass(p, rhs, red, factor, idx2, idy2)
+    p_b, r_b = obst.sor_pass_obstacle(p, rhs, red, m, idx2, idy2)
+    np.testing.assert_allclose(np.asarray(p_b), np.asarray(p_a), atol=1e-14)
+    np.testing.assert_allclose(float(r_b), float(r_a), rtol=1e-13)
+
+    # masked projection equals the reference projection
+    ua, va = ops.adapt_uv(u, v, f, g, p, 0.01, dx, dy)
+    ub, vb = obst.adapt_uv_obstacle(u, v, f, g, p, 0.01, dx, dy, m)
+    np.testing.assert_allclose(np.asarray(ub), np.asarray(ua), atol=1e-14)
+    np.testing.assert_allclose(np.asarray(vb), np.asarray(va), atol=1e-14)
+
+
+def test_obstacle_velocity_bc_mirrors():
+    """Tangential ghosts mirror the adjacent fluid value; normals are zero."""
+    fluid = obst.build_fluid(8, 8, 1 / 8, 1 / 8, "0.25,0.25,0.75,0.75")
+    m = obst.make_masks(fluid, 1 / 8, 1 / 8, 1.7, jnp.float64)
+    rng = np.random.default_rng(1)
+    u = jnp.asarray(rng.standard_normal((10, 10)))
+    v = jnp.asarray(rng.standard_normal((10, 10)))
+    u2, v2 = obst.apply_obstacle_velocity_bc(u, v, m)
+    u2, v2 = np.asarray(u2), np.asarray(v2)
+    fl = np.asarray(m.fluid) > 0
+    # obstacle interior cells {3..6}x{3..6} (1-based j,i)
+    # normal faces: u on the vertical obstacle walls is zero
+    for j in range(3, 7):
+        assert u2[j, 2] == 0.0 and u2[j, 6] == 0.0  # faces into the block
+    # tangential ghost one row below the top fluid region: mirrors row above
+    for i in range(3, 6):
+        np.testing.assert_allclose(u2[6, i], -u2[7, i])
+        np.testing.assert_allclose(u2[3, i], -u2[2, i])
+        np.testing.assert_allclose(v2[i, 6], -v2[i, 7])
+        np.testing.assert_allclose(v2[i, 3], -v2[i, 2])
+    # deep interior faces (both cells obstacle, no adjacent fluid face) are 0
+    assert u2[4, 4] == 0.0 and v2[4, 4] == 0.0
+
+
+def test_canal_obstacle_run_invariants():
+    """Small channel with a block: runs, stays finite, no flow through any
+    obstacle face, flow accelerates in the gaps beside the block.
+
+    (No tight divergence bound here: the canal's inflow/outflow startup makes
+    the all-Neumann pressure system incompatible, so its SOR stalls at the
+    incompatibility floor — the plain canal behaves identically, and the
+    reference's does too; the mass-closed divergence invariant is checked in
+    test_dcavity_obstacle_divergence below.)"""
+    param = Parameter(
+        name="canal_obstacle",
+        imax=64,
+        jmax=16,
+        xlength=8.0,
+        ylength=2.0,
+        re=100.0,
+        te=1.0,
+        tau=0.5,
+        itermax=500,
+        eps=1e-6,
+        omg=1.7,
+        gamma=0.9,
+        u_init=1.0,
+        bcLeft=3,
+        bcRight=3,
+        obstacles="2.0,0.75,3.0,1.25",
+        tpu_dtype="float64",
+    )
+    s = NS2DSolver(param)
+    assert s.masks is not None and s.masks.any_obstacle
+    s.run(progress=False)
+    u, v, p = np.asarray(s.u), np.asarray(s.v), np.asarray(s.p)
+    assert np.isfinite(u).all() and np.isfinite(v).all() and np.isfinite(p).all()
+
+    uf = np.asarray(s.masks.u_face) > 0
+    vf = np.asarray(s.masks.v_face) > 0
+    fl = np.asarray(s.masks.fluid) > 0
+    # no flow through obstacle-wall faces (faces between fluid and obstacle)
+    wall_u = (~uf) & (fl | np.roll(fl, -1, axis=1))
+    wall_v = (~vf) & (fl | np.roll(fl, -1, axis=0))
+    assert np.abs(u[wall_u]).max() < 1e-14
+    assert np.abs(v[wall_v]).max() < 1e-14
+
+    # continuity: flow squeezed through the gaps is faster than the inflow peak
+    dx = s.dx
+    inflow_peak = u[1:-1, 0].max()
+    # obstacle occupies x in (2,3): columns i where center in that range
+    icols = [i for i in range(1, 65) if 2.0 < (i - 0.5) * dx < 3.0]
+    gap_max = u[1:-1, icols].max()
+    assert gap_max > inflow_peak
+
+
+def test_dcavity_obstacle_divergence():
+    """Mass-closed box (lid-driven cavity) with a block: the pressure system
+    is compatible, so the projection must keep the fluid-cell divergence at
+    solver tolerance — the real correctness invariant of the eps-coefficient
+    obstacle SOR."""
+    param = Parameter(
+        name="dcavity",
+        imax=32,
+        jmax=32,
+        re=10.0,
+        te=0.5,
+        tau=0.5,
+        itermax=2000,
+        eps=1e-8,
+        omg=1.7,
+        gamma=0.9,
+        obstacles="0.3,0.3,0.6,0.6",
+        tpu_dtype="float64",
+    )
+    s = NS2DSolver(param)
+    assert s.masks is not None and s.masks.any_obstacle
+    s.run(progress=False)
+    u, v = np.asarray(s.u), np.asarray(s.v)
+    assert np.isfinite(u).all() and np.isfinite(v).all()
+    div = (u[1:-1, 1:-1] - u[1:-1, :-2]) / s.dx + (
+        v[1:-1, 1:-1] - v[:-2, 1:-1]
+    ) / s.dy
+    fl = np.asarray(s.masks.fluid)[1:-1, 1:-1] > 0
+    assert np.abs(div[fl]).max() < 1e-3
+    # the lid still drives a recirculation around the block
+    assert np.abs(u[1:-1, 1:-1]).max() > 1e-3
+
+
+def test_obstacle_solver_converges():
+    """The eps-coefficient SOR drives the masked residual below eps."""
+    imax = jmax = 32
+    dx = dy = 1.0 / 32
+    fluid = obst.build_fluid(imax, jmax, dx, dy, "0.4,0.4,0.7,0.7")
+    m = obst.make_masks(fluid, dx, dy, 1.7, jnp.float64)
+    solve = obst.make_obstacle_solver_fn(
+        imax, jmax, dx, dy, 1e-7, 5000, m, jnp.float64
+    )
+    rng = np.random.default_rng(2)
+    p0 = jnp.zeros((jmax + 2, imax + 2))
+    rhs = jnp.asarray(rng.standard_normal((jmax + 2, imax + 2)))
+    # Neumann-compatible rhs over the fluid region (zero fluid-mean)
+    flm = np.asarray(m.fluid) > 0
+    r = np.array(rhs)  # writable copy
+    r[1:-1, 1:-1] -= r[1:-1, 1:-1][flm[1:-1, 1:-1]].mean()
+    r[~flm] = 0.0
+    p, res, it = solve(p0, jnp.asarray(r))
+    assert float(res) < 1e-14  # eps^2
+    assert 0 < int(it) < 5000
+    assert np.isfinite(np.asarray(p)).all()

@@ -16,7 +16,7 @@ from pampi_tpu.ops import sor_pallas as sp
 from pampi_tpu.utils.params import Parameter
 
 N = 4096
-TOTAL = 96  # total RB iterations per timed run (divisible by all k below)
+TOTAL = 120  # total RB iterations per timed run (divisible by all k below)
 
 
 def timeit(fn, *args):
@@ -35,8 +35,8 @@ def main():
     param = Parameter(imax=N, jmax=N, tpu_dtype="float32")
     p, rhs = init_fields(param, problem=2, dtype=jnp.float32)
 
-    for k in (4, 6, 8, 12):
-        for br in (256, 384):
+    for k in (3, 4, 5, 6):
+        for br in (256,):
             try:
                 rb, brr, h = sp.make_rb_iter_tblock(
                     N, N, 1.0 / N, 1.0 / N, 1.9, jnp.float32,
