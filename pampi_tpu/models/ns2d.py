@@ -84,10 +84,11 @@ class NS2DSolver:
 
     def _uses_pallas(self) -> bool:
         """Whether the current chunk's pressure solve dispatches to pallas
-        (obstacle solves and jnp-dispatched dtypes/backends never do)."""
+        (both the uniform and the flag-masked solver go through the same
+        backend probe; jnp-dispatched dtypes/backends never do)."""
         from .poisson import _use_pallas
 
-        return self.masks is None and _use_pallas(self._backend, self.dtype)
+        return _use_pallas(self._backend, self.dtype)
 
     # -- one full timestep, traced ------------------------------------
     def _build_step(self, backend: str = "auto"):
@@ -113,7 +114,8 @@ class NS2DSolver:
 
             solve = obst.make_obstacle_solver_fn(
                 param.imax, param.jmax, dx, dy, param.eps, param.itermax,
-                masks, dtype,
+                masks, dtype, backend=backend,
+                n_inner=param.tpu_sor_inner,
             )
         adaptive = param.tau > 0.0
         problem = param.name

@@ -102,6 +102,7 @@ class ObstacleMasks:
     eps_s: jnp.ndarray
     factor: jnp.ndarray      # (J, I) per-cell omega / denom (0 in obstacles)
     n_fluid: float           # number of interior fluid cells
+    omega: float             # the relaxation factor baked into `factor`
 
     @property
     def any_obstacle(self) -> bool:
@@ -135,6 +136,7 @@ def make_masks(fluid_np: np.ndarray, dx: float, dy: float, omega: float,
         eps_s=jnp.asarray(eps_s, dtype),
         factor=jnp.asarray(factor, dtype),
         n_fluid=float(fi.sum()),
+        omega=float(omega),
     )
 
 
@@ -191,28 +193,63 @@ def sor_pass_obstacle(p, rhs, color_mask, m: ObstacleMasks, idx2, idy2):
 
 
 def make_obstacle_solver_fn(imax, jmax, dx, dy, eps, itermax, m: ObstacleMasks,
-                            dtype):
+                            dtype, backend: str = "auto", n_inner: int = 1):
     """Full pressure-solve convergence loop with obstacle coefficients:
     (p0, rhs) -> (p, res, it) as one jittable `lax.while_loop` — the obstacle
     counterpart of models/poisson.make_solver_fn. The residual is normalized
     by the number of FLUID cells (the reference's imax·jmax norm counts every
-    interior cell; obstacle cells carry no residual — documented deviation)."""
+    interior cell; obstacle cells carry no residual — documented deviation).
+
+    On TPU with a pallas-capable dtype the loop runs the flag-masked
+    temporal-blocked kernel (ops/sor_pallas.py `_tblock_kernel(masked=True)`,
+    n_inner iterations per HBM sweep — same overshoot semantics as
+    make_solver_fn); otherwise the jnp eps-coefficient passes. Both paths
+    relax with `m.omega` — the ω the masks were built with — so backends
+    cannot drift apart."""
     import jax
 
+    from ..models.poisson import _use_pallas
     from .sor import checkerboard_mask, neumann_bc
 
     idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
-    red = checkerboard_mask(jmax, imax, 0, dtype)
-    black = checkerboard_mask(jmax, imax, 1, dtype)
     epssq = eps * eps
     norm = m.n_fluid
 
-    def step(p, rhs):
-        p, r0 = sor_pass_obstacle(p, rhs, red, m, idx2, idy2)
-        p, r1 = sor_pass_obstacle(p, rhs, black, m, idx2, idy2)
-        return neumann_bc(p), (r0 + r1) / norm
+    if _use_pallas(backend, dtype):
+        from . import sor_pallas as sp
+
+        rb_iter, block_rows, halo = sp.make_rb_iter_tblock(
+            imax, jmax, dx, dy, m.omega, dtype, n_inner=max(1, n_inner),
+            fluid=np.asarray(m.fluid),
+        )
+        if rb_iter is None:
+            raise ValueError("pallas backend unavailable")
+        eff = max(1, n_inner)
+
+        def step(p_pad, rhs_pad):
+            p_pad, rsq = rb_iter(p_pad, rhs_pad)
+            return p_pad, rsq / norm
+
+        def prep(x):
+            return sp.pad_array(x, block_rows, halo)
+
+        def post(x):
+            return sp.unpad_array(x, jmax, imax, halo)
+    else:
+        red = checkerboard_mask(jmax, imax, 0, dtype)
+        black = checkerboard_mask(jmax, imax, 1, dtype)
+        eff = 1
+
+        def step(p, rhs):
+            p, r0 = sor_pass_obstacle(p, rhs, red, m, idx2, idy2)
+            p, r1 = sor_pass_obstacle(p, rhs, black, m, idx2, idy2)
+            return neumann_bc(p), (r0 + r1) / norm
+
+        prep = post = lambda x: x  # noqa: E731
 
     def solve(p0, rhs):
+        rhs = prep(rhs)
+
         def cond(carry):
             _, res, it = carry
             return jnp.logical_and(res >= epssq, it < itermax)
@@ -220,10 +257,11 @@ def make_obstacle_solver_fn(imax, jmax, dx, dy, eps, itermax, m: ObstacleMasks,
         def body(carry):
             p, _, it = carry
             p, res = step(p, rhs)
-            return p, res, it + 1
+            return p, res, it + eff
 
-        init = (p0, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
-        return jax.lax.while_loop(cond, body, init)
+        init = (prep(p0), jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        p, res, it = jax.lax.while_loop(cond, body, init)
+        return post(p), res, it
 
     return solve
 

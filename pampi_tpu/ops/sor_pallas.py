@@ -211,16 +211,7 @@ def _rb_kernel(
 
 
 def _tblock_kernel(
-    p_in,  # ANY: padded p, read-only
-    rhs,  # ANY, padded like p
-    p_out,  # ANY: fresh output (out-of-place)
-    res,  # SMEM (1, 1) accumulator
-    pw2,  # VMEM (2, BR+2H, Wp): double-buffered p windows
-    rw2,  # VMEM (2, BR+2H, Wp): double-buffered rhs windows
-    ob2,  # VMEM (2, BR, Wp): double-buffered output bands
-    ld_sem,  # DMA semaphores (2, 2): [slot, p|rhs]
-    st_sem,  # DMA semaphores (2,): [slot]
-    *,
+    *refs,
     n_inner: int,
     block_rows: int,
     nblocks: int,
@@ -228,8 +219,10 @@ def _tblock_kernel(
     jmax: int,
     halo: int,
     factor: float,
+    omega: float,
     idx2: float,
     idy2: float,
+    masked: bool,
 ):
     """`n_inner` FULL red-black iterations (each incl. the Neumann ghost
     refresh) in a single HBM sweep — temporal blocking.
@@ -245,11 +238,27 @@ def _tblock_kernel(
     dead padding untouched), because interior updates of iteration t+1 read
     ghost values refreshed after iteration t.
 
+    masked=True adds a fluid-flag input (padded 0/1 array, ops/obstacle.py
+    flag field) and switches the stencil to per-direction fluid coefficients
+    with a per-cell relaxation factor ω/denom — homogeneous Neumann on
+    obstacle surfaces, branch-free (the north-star requirement). The
+    eps/factor arrays are derived from the flags ONCE per block, outside the
+    iteration loop; arithmetic matches ops/obstacle.sor_pass_obstacle
+    term-for-term. Flags are static config, so the extra HBM traffic is one
+    array load per sweep (amortized over n_inner iterations).
+
     Residual: accumulated for the LAST iteration only (static slice of the
     owned band), so a convergence loop stepping this kernel observes the
     residual of its final iteration — the same value a per-iteration loop
     would see at that count.
     """
+    if masked:
+        (p_in, rhs, flg, p_out, res,
+         pw2, rw2, fw2, ob2, ld_sem, st_sem) = refs
+    else:
+        (p_in, rhs, p_out, res,
+         pw2, rw2, ob2, ld_sem, st_sem) = refs
+        flg = fw2 = None
     b = pl.program_id(0)
     br = block_rows
     h = halo
@@ -257,14 +266,22 @@ def _tblock_kernel(
     nslot = (b + 1) % 2
 
     def load(k, s):
-        return (
+        copies = [
             pltpu.make_async_copy(
                 p_in.at[pl.ds(k * br, br + 2 * h), :], pw2.at[s], ld_sem.at[s, 0]
             ),
             pltpu.make_async_copy(
                 rhs.at[pl.ds(k * br, br + 2 * h), :], rw2.at[s], ld_sem.at[s, 1]
             ),
-        )
+        ]
+        if masked:
+            copies.append(
+                pltpu.make_async_copy(
+                    flg.at[pl.ds(k * br, br + 2 * h), :], fw2.at[s],
+                    ld_sem.at[s, 2],
+                )
+            )
+        return copies
 
     def store(k, s):
         return pltpu.make_async_copy(
@@ -288,13 +305,6 @@ def _tblock_kernel(
     p = pw2[slot]
     rw = rw2[slot]
 
-    def lap(x):
-        east = jnp.roll(x, -1, axis=1)
-        west = jnp.roll(x, 1, axis=1)
-        north = jnp.roll(x, -1, axis=0)
-        south = jnp.roll(x, 1, axis=0)
-        return (east - 2.0 * x + west) * idx2 + (north - 2.0 * x + south) * idy2
-
     # logical (j, i) of window cell (w, c): j = b*br + w - h, i = c
     jj = b * br - h + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
     ii = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
@@ -307,12 +317,46 @@ def _tblock_kernel(
     col_ghost_lo = (ii == 0) & row_int
     col_ghost_hi = (ii == width - 1) & row_int
 
+    if masked:
+        # per-block constants (flags don't change across inner iterations):
+        # eps_d = "neighbour in direction d is fluid"; the update factor is
+        # ω/denom on fluid cells, 0 elsewhere (ops/obstacle.make_masks parity)
+        fl = fw2[slot]
+        red = red & (fl != 0)
+        black = black & (fl != 0)
+        eps_e = jnp.roll(fl, -1, axis=1)
+        eps_w = jnp.roll(fl, 1, axis=1)
+        eps_n = jnp.roll(fl, -1, axis=0)
+        eps_s = jnp.roll(fl, 1, axis=0)
+        denom = (eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
+        fac = jnp.where(denom > 0, omega / denom, 0.0) * fl
+
+        def lap(x):
+            east = jnp.roll(x, -1, axis=1)
+            west = jnp.roll(x, 1, axis=1)
+            north = jnp.roll(x, -1, axis=0)
+            south = jnp.roll(x, 1, axis=0)
+            return (eps_e * (east - x) + eps_w * (west - x)) * idx2 + (
+                eps_n * (north - x) + eps_s * (south - x)
+            ) * idy2
+    else:
+        fac = factor
+
+        def lap(x):
+            east = jnp.roll(x, -1, axis=1)
+            west = jnp.roll(x, 1, axis=1)
+            north = jnp.roll(x, -1, axis=0)
+            south = jnp.roll(x, 1, axis=0)
+            return (east - 2.0 * x + west) * idx2 + (
+                north - 2.0 * x + south
+            ) * idy2
+
     r_red = r_blk = None
     for t in range(n_inner):
         r_red = jnp.where(red, rw - lap(p), 0.0)
-        p = p - factor * r_red
+        p = p - fac * r_red
         r_blk = jnp.where(black, rw - lap(p), 0.0)
-        p = p - factor * r_blk
+        p = p - fac * r_blk
         # Neumann ghost refresh (walls only; corners/dead padding untouched)
         p = jnp.where(row_ghost_lo, jnp.roll(p, -1, axis=0), p)
         p = jnp.where(row_ghost_hi, jnp.roll(p, 1, axis=0), p)
@@ -372,12 +416,18 @@ def make_rb_iter_tblock(
     n_inner: int = 4,
     block_rows: int | None = None,
     interpret: bool | None = None,
+    fluid=None,
 ):
     """Temporal-blocked fused kernel (see `_tblock_kernel`): builds
     `(p_padded, rhs_padded) -> (p_padded', res_sumsq_of_last_iter)` where one
     call performs `n_inner` red-black iterations + Neumann BCs. The padded
     layout uses `halo = tblock_halo(n_inner)` rows of padding (pass it to
-    `pad_array`/`unpad_array`). Returns (rb_iter, block_rows, halo)."""
+    `pad_array`/`unpad_array`). Returns (rb_iter, block_rows, halo).
+
+    fluid: optional (jmax+2, imax+2) 0/1 flag field (ops/obstacle.py) —
+    switches to the obstacle stencil (per-direction fluid coefficients,
+    per-cell factor); the padded flag array is baked into the returned
+    closure as a constant."""
     if pltpu is None:
         return None, 0, 0
     h = tblock_halo(n_inner, dtype)
@@ -386,6 +436,7 @@ def make_rb_iter_tblock(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     _check_dtype(dtype, interpret)
+    masked = fluid is not None
 
     dx2, dy2 = dx * dx, dy * dy
     width = imax + 2
@@ -401,17 +452,28 @@ def make_rb_iter_tblock(
         jmax=jmax,
         halo=h,
         factor=omega * 0.5 * (dx2 * dy2) / (dx2 + dy2),
+        omega=omega,
         idx2=1.0 / dx2,
         idy2=1.0 / dy2,
+        masked=masked,
     )
 
+    n_in = 3 if masked else 2
+    scratch = [
+        pltpu.VMEM((2, block_rows + 2 * h, wp), dtype),
+        pltpu.VMEM((2, block_rows + 2 * h, wp), dtype),
+    ]
+    if masked:
+        scratch.append(pltpu.VMEM((2, block_rows + 2 * h, wp), dtype))
+    scratch += [
+        pltpu.VMEM((2, block_rows, wp), dtype),
+        pltpu.SemaphoreType.DMA((2, n_in)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
     call = pl.pallas_call(
         kernel,
         grid=(nblocks,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_in,
         out_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((1, 1), lambda b: (0, 0), memory_space=pltpu.SMEM),
@@ -420,22 +482,24 @@ def make_rb_iter_tblock(
             jax.ShapeDtypeStruct((rp, wp), dtype),
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((2, block_rows + 2 * h, wp), dtype),
-            pltpu.VMEM((2, block_rows + 2 * h, wp), dtype),
-            pltpu.VMEM((2, block_rows, wp), dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,
     )
 
-    def rb_iter(p_padded, rhs_padded):
-        p_padded, res = call(p_padded, rhs_padded)
-        return p_padded, res[0, 0]
+    if masked:
+        flg_padded = pad_array(jnp.asarray(fluid, dtype), block_rows, h)
+
+        def rb_iter(p_padded, rhs_padded):
+            p_padded, res = call(p_padded, rhs_padded, flg_padded)
+            return p_padded, res[0, 0]
+    else:
+
+        def rb_iter(p_padded, rhs_padded):
+            p_padded, res = call(p_padded, rhs_padded)
+            return p_padded, res[0, 0]
 
     return rb_iter, block_rows, h
 
