@@ -306,3 +306,25 @@ class NS3DDistSolver:
 
     def write_result(self, path=None, fmt: str = "ascii") -> None:
         write_vtk_result(self.param, self.grid, self.collect(), path, fmt)
+
+    def write_result_sharded(self, path=None) -> None:
+        """MPI-IO-pattern parallel write (binary VTK): the collect kernel's
+        output is a mesh-sharded global array, and every addressable shard's
+        slab goes straight to its byte offsets in the shared file — no global
+        gather to the host (≙ the reference's scaffolded MPI_File_set_view
+        path, vtkWriter.c:118-143, completed)."""
+        from ..utils.vtkio import ShardedVtkWriter, shards_of
+
+        ug, vg, wg, pg = self._collect_sm(self.u, self.v, self.w, self.p)
+        writer = ShardedVtkWriter(
+            self.param.name, self.grid,
+            path=path or f"{self.param.name}.vtk",
+        )
+        writer.scalar("pressure", shards_of(pg))
+        us, vs, ws = shards_of(ug), shards_of(vg), shards_of(wg)
+        vec = []
+        for (du, o1), (dv, o2), (dw, o3) in zip(us, vs, ws):
+            assert o1 == o2 == o3, "component shard layouts diverged"
+            vec.append((du, dv, dw, o1))
+        writer.vector("velocity", vec)
+        writer.close()

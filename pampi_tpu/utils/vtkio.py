@@ -9,6 +9,8 @@ Values are cell-centered (ORIGIN at dx/2), i fastest, then j, then k.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .grid import Grid
@@ -98,6 +100,124 @@ class VtkWriter:
             self._impl.close()
         else:
             self.fh.close()
+
+
+class ShardedVtkWriter:
+    """MPI-IO-pattern parallel VTK writer: each subdomain slab is written at
+    the exact byte ranges it owns inside one shared file (seek + write per
+    i-row — the subarray-filetype discipline of `MPI_File_set_view`), with no
+    global array ever materialized. This is the completed form of the
+    reference's scaffolded parallel-write path
+    (/root/reference/assignment-6/src/vtkWriter.c:15-22,118-143, the `// fill`
+    MPI-IO exercise), TPU-style: the natural producers of slabs are the
+    addressable shards of a distributed `jax.Array`, so a multi-host run can
+    have every host write exactly its own slabs.
+
+    BINARY format only — ASCII `%f` records are variable-width and therefore
+    not offset-addressable (the same restriction real MPI-IO writers have).
+    Output is byte-identical to `VtkWriter(fmt="binary")` (tested).
+
+    Usage (section order must match across participants, like collective IO):
+        w = ShardedVtkWriter("canal3d", grid, path="out.vtk")
+        w.scalar("pressure", [(slab, (k0, j0, i0)), ...])
+        w.vector("velocity", [(us, vs, ws, (k0, j0, i0)), ...])
+        w.close()
+    """
+
+    def __init__(self, problem: str, grid: Grid, path=None):
+        self.grid = grid
+        self.path = path or f"{problem}.vtk"
+        header = (
+            "# vtk DataFile Version 3.0\n"
+            "PAMPI cfd solver output\n"
+            "BINARY\n"
+            "DATASET STRUCTURED_POINTS\n"
+            "DIMENSIONS %d %d %d\n" % (grid.imax, grid.jmax, grid.kmax)
+            + "ORIGIN %f %f %f\n" % (grid.dx * 0.5, grid.dy * 0.5, grid.dz * 0.5)
+            + "SPACING %f %f %f\n" % (grid.dx, grid.dy, grid.dz)
+            + "POINT_DATA %d\n" % (grid.imax * grid.jmax * grid.kmax)
+        ).encode()
+        # Non-truncating open: several participants (hosts) may hold the same
+        # shared file concurrently, MPI-IO style. The header bytes are a pure
+        # function of the grid, so every participant writing them at offset 0
+        # is idempotent; O_TRUNC here would destroy slabs peers already wrote.
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        self.fh = os.fdopen(fd, "r+b")
+        self.fh.write(header)
+        self._offset = len(header)  # start of the next section
+        self._n = grid.imax * grid.jmax * grid.kmax
+
+    def _write_slab(self, data_base: int, vals: np.ndarray, origin,
+                    ncomp: int) -> None:
+        """vals: (dk, dj, di[, ncomp]) big-endian f8; seek+write one i-row at
+        a time — the contiguous runs a subarray filetype would describe."""
+        g = self.grid
+        dk, dj, di = vals.shape[0], vals.shape[1], vals.shape[2]
+        k0, j0, i0 = origin
+        if not (0 <= k0 and k0 + dk <= g.kmax and 0 <= j0
+                and j0 + dj <= g.jmax and 0 <= i0 and i0 + di <= g.imax):
+            raise ValueError(
+                f"slab {vals.shape[:3]} at {origin} exceeds the "
+                f"({g.kmax},{g.jmax},{g.imax}) domain"
+            )
+        del di
+        for k in range(dk):
+            for j in range(dj):
+                idx = ((k0 + k) * g.jmax + (j0 + j)) * g.imax + i0
+                self.fh.seek(data_base + idx * ncomp * 8)
+                self.fh.write(vals[k, j].tobytes())
+
+    def scalar(self, name: str, slabs) -> None:
+        """slabs: iterable of (array (dk,dj,di), origin (k0,j0,i0))."""
+        head = ("SCALARS %s double 1\nLOOKUP_TABLE default\n" % name).encode()
+        self.fh.seek(self._offset)
+        self.fh.write(head)
+        data_base = self._offset + len(head)
+        self.fh.seek(data_base + self._n * 8)
+        self.fh.write(b"\n")
+        for arr, origin in slabs:
+            vals = np.ascontiguousarray(np.asarray(arr, dtype=np.float64)
+                                        .astype(">f8"))
+            self._write_slab(data_base, vals, origin, 1)
+        self._offset = data_base + self._n * 8 + 1
+
+    def vector(self, name: str, slabs) -> None:
+        """slabs: iterable of (u, v, w arrays (dk,dj,di), origin)."""
+        head = ("VECTORS %s double\n" % name).encode()
+        self.fh.seek(self._offset)
+        self.fh.write(head)
+        data_base = self._offset + len(head)
+        self.fh.seek(data_base + self._n * 24)
+        self.fh.write(b"\n")
+        for u, v, w, origin in slabs:
+            inter = np.stack(
+                [np.asarray(u, np.float64), np.asarray(v, np.float64),
+                 np.asarray(w, np.float64)],
+                axis=-1,
+            ).astype(">f8")
+            self._write_slab(data_base, np.ascontiguousarray(inter), origin, 3)
+        self._offset = data_base + self._n * 24 + 1
+
+    def close(self) -> None:
+        # The final size is a pure function of the sections written, so every
+        # participant truncating to it is idempotent; this drops stale bytes
+        # when overwriting a larger file from an earlier run.
+        self.fh.truncate(self._offset)
+        self.fh.close()
+
+
+def shards_of(arr) -> list:
+    """(data, (k0, j0, i0)) for every addressable shard of a (possibly
+    distributed) jax array — the producer side of ShardedVtkWriter. Works for
+    3-D cell-centered arrays whose sharding tiles the array."""
+    out = []
+    for s in arr.addressable_shards:
+        idx = s.index
+        origin = tuple(
+            (sl.start or 0) if isinstance(sl, slice) else 0 for sl in idx
+        )
+        out.append((np.asarray(s.data), origin))
+    return out
 
 
 def read_vtk_ascii(path: str):
