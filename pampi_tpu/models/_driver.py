@@ -1,0 +1,60 @@
+"""Shared chunked time-loop driver for the NS solvers.
+
+Both NS-2D and NS-3D advance a carried state tuple through jitted chunk
+calls (CHUNK device steps per host sync) with the same runtime-retry
+protocol: a shape-specific pallas failure the dispatcher probe missed
+rebuilds the chunk on the jnp path (same arithmetic) and retries the chunk —
+inputs are unchanged because the loop is functional. This module is that
+protocol's single home; the solvers supply the state arity and rebuild hook.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None):
+    """Run `state = chunk_fn(*state)` while state[time_index] <= te
+    (main.c:43-60 loop semantics: a step runs whenever t <= te at its start).
+
+    retry() is called when a chunk raises: it returns a rebuilt chunk_fn to
+    retry with, or None to re-raise (the failure was not pallas's).
+    on_state(state) fires after every successful chunk — the host-sync /
+    checkpoint hook point. Returns the final state."""
+    while float(state[time_index]) <= te:
+        try:
+            new = chunk_fn(*state)
+            # force completion: async pallas faults surface here
+            float(new[time_index])
+        except Exception:
+            chunk_fn = retry()
+            if chunk_fn is None:
+                raise
+            continue
+        state = new
+        bar.update(float(state[time_index]))
+        if on_state is not None:
+            on_state(state)
+    bar.stop()
+    return state
+
+
+def pallas_retry(solver, what: str):
+    """The retry() hook for a solver with `_backend`/`_uses_pallas`/
+    `_build_chunk`/`_chunk_fn`: falls back to the jnp chunk exactly once; a
+    failure on the jnp path (or with pallas not even in play) re-raises."""
+
+    def retry():
+        if solver._backend == "jnp" or not solver._uses_pallas():
+            return None  # the failing chunk never ran pallas — genuine error
+        import warnings
+
+        warnings.warn(
+            f"pallas {what} failed at runtime; retrying this chunk on the "
+            "jnp path", stacklevel=2,
+        )
+        solver._backend = "jnp"
+        solver._chunk_fn = jax.jit(solver._build_chunk(backend="jnp"))
+        return solver._chunk_fn
+
+    return retry

@@ -194,42 +194,29 @@ class NS2DSolver:
 
     # -- driver API ----------------------------------------------------
     def run(self, progress: bool = True, on_sync=None) -> None:
-        """Advance from t to te (main.c:43-60 loop semantics: a step runs
-        whenever t <= te at its start). `on_sync(self)` fires at each host
-        sync (every CHUNK device steps) — the checkpoint hook point."""
+        """Advance from t to te. `on_sync(self)` fires at each host sync
+        (every CHUNK device steps) — the checkpoint hook point. Loop + retry
+        protocol live in models/_driver.py."""
+        from ._driver import drive_chunks, pallas_retry
+
         bar = Progress(self.param.te, enabled=progress)
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        t = jnp.asarray(self.t, time_dtype)
-        nt = jnp.asarray(self.nt, jnp.int32)
-        u, v, p = self.u, self.v, self.p
-        while float(t) <= self.param.te:
-            try:
-                un, vn, pn, tn, ntn = self._chunk_fn(u, v, p, t, nt)
-                float(tn)  # force completion: async pallas faults surface here
-            except Exception:
-                if self._backend == "jnp" or not self._uses_pallas():
-                    raise  # the failing chunk never ran pallas — genuine error
-                # shape-specific pallas failure the dispatcher probe missed:
-                # rebuild the whole chunk on the jnp path (same arithmetic)
-                # and retry this chunk — inputs are unchanged (functional)
-                import warnings
+        state = (self.u, self.v, self.p,
+                 jnp.asarray(self.t, time_dtype),
+                 jnp.asarray(self.nt, jnp.int32))
 
-                warnings.warn(
-                    "pallas pressure solve failed at runtime; retrying this "
-                    "chunk on the jnp path", stacklevel=2,
-                )
-                self._backend = "jnp"
-                self._chunk_fn = jax.jit(self._build_chunk(backend="jnp"))
-                continue
-            u, v, p, t, nt = un, vn, pn, tn, ntn
-            bar.update(float(t))
+        def publish(s):
+            self.u, self.v, self.p = s[0], s[1], s[2]
+            self.t, self.nt = float(s[3]), int(s[4])
+
+        def on_state(s):
             if on_sync is not None:
-                self.u, self.v, self.p = u, v, p
-                self.t, self.nt = float(t), int(nt)
+                publish(s)
                 on_sync(self)
-        bar.stop()
-        self.u, self.v, self.p = u, v, p
-        self.t, self.nt = float(t), int(nt)
+
+        state = drive_chunks(state, self._chunk_fn, self.param.te, 3, bar,
+                             pallas_retry(self, "pressure solve"), on_state)
+        publish(state)
 
     def write_result(
         self, pressure_path: str = "pressure.dat", velocity_path: str = "velocity.dat"

@@ -86,12 +86,74 @@ def write_vtk_result(param, grid, fields, path=None, fmt: str = "ascii") -> None
     writer.close()
 
 
-def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax, dtype):
+def _use_pallas_3d(backend: str, dtype) -> bool:
+    """models/poisson._use_pallas with the 3-D kernel's probe."""
+    from .poisson import _use_pallas
+
+    def probe():
+        from ..ops import sor3d_pallas as sp3
+
+        return sp3.pltpu is not None and sp3.probe_pallas_3d()
+
+    return _use_pallas(backend, dtype, probe=probe)
+
+
+def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
+                           dtype, backend: str = "auto", n_inner: int = 1):
+    """Convergence loop for the 3-D red-black pressure solve. backend="auto"
+    dispatches to the fused Pallas kernel (ops/sor3d_pallas.py) on a real TPU
+    chip and to the jnp half-sweep composition otherwise; both carry
+    (p, res, it) through a `lax.while_loop`. Under pallas the loop carries the
+    PADDED array (one pad before, one unpad after — no per-iteration layout
+    conversion); with n_inner > 1 each loop step runs n_inner red-black
+    iterations in one HBM sweep and observes the last one's residual, so `it`
+    advances by n_inner per step (honest iteration accounting)."""
+    norm = float(imax * jmax * kmax)
+    epssq = eps * eps
+
+    use_pallas = _use_pallas_3d(backend, dtype)
+    if use_pallas and backend != "pallas":
+        from ..ops import sor3d_pallas as sp3
+
+        # in-plane size so large the VMEM budget forces block_k below the
+        # halo depth: the kernel would recompute halos >3x over and likely
+        # overflow VMEM — the jnp path is the better program
+        bk = sp3.pick_block_k(kmax, jmax, imax, dtype, n_inner)
+        use_pallas = not sp3.block_k_degenerate(bk, kmax, n_inner)
+
+    if use_pallas:
+        from ..ops import sor3d_pallas as sp3
+
+        rb_iter, block_k = sp3.make_rb_iter_tblock_3d(
+            imax, jmax, kmax, dx, dy, dz, omega, dtype, n_inner=n_inner
+        )
+        if rb_iter is None:
+            raise ValueError("pallas 3-D backend unavailable")
+
+        def solve(p, rhs):
+            pp = sp3.pad_array_3d(p, block_k, n_inner)
+            rp = sp3.pad_array_3d(rhs, block_k, n_inner)
+
+            def cond(c):
+                _, res, it = c
+                return jnp.logical_and(res >= epssq, it < itermax)
+
+            def body(c):
+                pp, _, it = c
+                pp, rsq = rb_iter(pp, rp)
+                return pp, rsq / norm, it + n_inner
+
+            pp, res, it = lax.while_loop(
+                cond, body,
+                (pp, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
+            )
+            return sp3.unpad_array_3d(pp, kmax, jmax, imax, n_inner), res, it
+
+        return solve
+
     factor, idx2, idy2, idz2 = sor_coefficients_3d(dx, dy, dz, omega)
     odd = checkerboard_mask_3d(kmax, jmax, imax, 1, dtype)
     even = checkerboard_mask_3d(kmax, jmax, imax, 0, dtype)
-    norm = float(imax * jmax * kmax)
-    epssq = eps * eps
 
     def solve(p, rhs):
         def cond(c):
@@ -140,9 +202,13 @@ class NS3DSolver:
         self.dt_bound = 0.5 * param.re / inv_sqr_sum
         self.t = 0.0
         self.nt = 0
+        self._backend = "auto"
         self._chunk_fn = jax.jit(self._build_chunk())
 
-    def _build_step(self):
+    def _uses_pallas(self) -> bool:
+        return _use_pallas_3d(self._backend, self.dtype)
+
+    def _build_step(self, backend: str = "auto"):
         param = self.param
         g = self.grid
         dtype = self.dtype
@@ -150,6 +216,7 @@ class NS3DSolver:
         solve = make_pressure_solve_3d(
             g.imax, g.jmax, g.kmax, dx, dy, dz,
             param.omg, param.eps, param.itermax, dtype,
+            backend=backend, n_inner=param.tpu_sor_inner,
         )
         bcs = {
             "top": param.bcTop,
@@ -186,8 +253,8 @@ class NS3DSolver:
 
         return step
 
-    def _build_chunk(self):
-        step = self._build_step()
+    def _build_chunk(self, backend: str = "auto"):
+        step = self._build_step(backend)
         te = self.param.te
         chunk = self.CHUNK
 
@@ -212,17 +279,23 @@ class NS3DSolver:
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         t = jnp.asarray(self.t, time_dtype)
         nt = jnp.asarray(self.nt, jnp.int32)
-        u, v, w, p = self.u, self.v, self.w, self.p
-        while float(t) <= self.param.te:
-            u, v, w, p, t, nt = self._chunk_fn(u, v, w, p, t, nt)
-            bar.update(float(t))
+        from ._driver import drive_chunks, pallas_retry
+
+        state = (self.u, self.v, self.w, self.p, t, nt)
+
+        def publish(s):
+            self.u, self.v, self.w, self.p = s[0], s[1], s[2], s[3]
+            self.t, self.nt = float(s[4]), int(s[5])
+
+        def on_state(s):
             if on_sync is not None:
-                self.u, self.v, self.w, self.p = u, v, w, p
-                self.t, self.nt = float(t), int(nt)
+                publish(s)
                 on_sync(self)
-        bar.stop()
-        self.u, self.v, self.w, self.p = u, v, w, p
-        self.t, self.nt = float(t), int(nt)
+
+        state = drive_chunks(state, self._chunk_fn, self.param.te, 4, bar,
+                             pallas_retry(self, "3-D pressure solve"),
+                             on_state)
+        publish(state)
 
     def collect(self):
         """Cell-centered global fields (≙ commCollectResult's non-MPI path,

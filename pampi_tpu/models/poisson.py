@@ -51,16 +51,22 @@ def init_fields(param: Parameter, problem: int = 2, dtype=jnp.float64):
     return jnp.asarray(p, dtype=dtype), jnp.asarray(rhs, dtype=dtype)
 
 
-def _use_pallas(backend: str, dtype=jnp.float32) -> bool:
+def _use_pallas(backend: str, dtype=jnp.float32, probe=None) -> bool:
+    """Backend-decision contract shared by every pallas-dispatched solver:
+    explicit "pallas" forces, "auto" requires a real TPU, a Mosaic-lowerable
+    dtype, and a passing one-time probe. `probe` defaults to the 2-D kernel's
+    smoke test; the 3-D solver passes its own (models/ns3d._use_pallas_3d)."""
     if backend == "pallas":
         return True
     if backend != "auto" or jax.default_backend() != "tpu":
         return False
     if jnp.dtype(dtype).itemsize > 4:
         return False  # Mosaic has no f64; XLA emulates it, pallas can't
-    from ..ops import sor_pallas as sp
+    if probe is None:
+        from ..ops import sor_pallas as sp
 
-    return sp.pltpu is not None and sp.probe_pallas()
+        return sp.pltpu is not None and sp.probe_pallas()
+    return probe()
 
 
 def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",

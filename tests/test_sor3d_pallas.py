@@ -1,0 +1,194 @@
+"""3-D fused/temporal-blocked Pallas SOR kernel (ops/sor3d_pallas.py) vs the
+jnp half-sweep composition it replaces (models/ns3d.sor_pass_3d +
+neumann_faces_3d) — trajectory equality in interpret mode, plus end-to-end
+backend equivalence of the NS-3D pressure solve. float32 only (the kernel's
+dtype domain; f64 dispatches to jnp in production)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pampi_tpu.models.ns3d import (
+    checkerboard_mask_3d,
+    make_pressure_solve_3d,
+    neumann_faces_3d,
+    sor_coefficients_3d,
+    sor_pass_3d,
+)
+from pampi_tpu.ops.sor3d_pallas import (
+    make_rb_iter_tblock_3d,
+    pad_array_3d,
+    pick_block_k,
+    unpad_array_3d,
+)
+
+DT = jnp.float32
+
+
+def _fields(K, J, I, seed=0):
+    rng = np.random.default_rng(seed)
+    p = jnp.asarray(rng.standard_normal((K + 2, J + 2, I + 2)), DT)
+    rhs = jnp.asarray(rng.standard_normal((K + 2, J + 2, I + 2)), DT)
+    return p, rhs
+
+
+def _jnp_iter_fn(K, J, I, dx, dy, dz, omega):
+    factor, idx2, idy2, idz2 = sor_coefficients_3d(dx, dy, dz, omega)
+    odd = checkerboard_mask_3d(K, J, I, 1, DT)
+    even = checkerboard_mask_3d(K, J, I, 0, DT)
+
+    def one(p, rhs):
+        p, r0 = sor_pass_3d(p, rhs, odd, factor, idx2, idy2, idz2)
+        p, r1 = sor_pass_3d(p, rhs, even, factor, idx2, idy2, idz2)
+        return neumann_faces_3d(p), r0 + r1
+
+    return one
+
+
+@pytest.mark.parametrize("shape", [(10, 12, 14), (7, 9, 11), (16, 16, 16)])
+@pytest.mark.parametrize("n_inner", [1, 2])
+def test_kernel_matches_jnp_trajectory(shape, n_inner):
+    K, J, I = shape
+    dx, dy, dz, omega = 1.0 / I, 1.0 / J, 1.0 / K, 1.7
+    p0, rhs = _fields(K, J, I)
+    one = _jnp_iter_fn(K, J, I, dx, dy, dz, omega)
+
+    rb, bk = make_rb_iter_tblock_3d(
+        I, J, K, dx, dy, dz, omega, DT, n_inner=n_inner, interpret=True
+    )
+    pp = pad_array_3d(p0, bk, n_inner)
+    rp = pad_array_3d(rhs, bk, n_inner)
+
+    want = p0
+    for _outer in range(3):  # three kernel calls: halo logic must be stable
+        pp, res = rb(pp, rp)
+        wres = None
+        for _ in range(n_inner):
+            want, wres = one(want, rhs)
+        got = unpad_array_3d(pp, K, J, I, n_inner)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=5e-5)
+        assert float(res) == pytest.approx(float(wres), rel=1e-4)
+
+
+@pytest.mark.parametrize("block_k", [2, 3, 5, 64])
+def test_kernel_block_size_invariance(block_k):
+    """The owned-block/halo split must not affect the result (redundant halo
+    recompute produces identical values)."""
+    K, J, I = 12, 10, 18
+    dx, dy, dz, omega = 1.0 / I, 1.0 / J, 1.0 / K, 1.5
+    p0, rhs = _fields(K, J, I, seed=3)
+    one = _jnp_iter_fn(K, J, I, dx, dy, dz, omega)
+    want, wres = one(p0, rhs)
+
+    rb, bk = make_rb_iter_tblock_3d(
+        I, J, K, dx, dy, dz, omega, DT, n_inner=1, block_k=block_k,
+        interpret=True,
+    )
+    pp, res = rb(pad_array_3d(p0, bk, 1), pad_array_3d(rhs, bk, 1))
+    got = unpad_array_3d(pp, K, J, I, 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0, atol=5e-6)
+    assert float(res) == pytest.approx(float(wres), rel=1e-4)
+
+
+def test_block_k_degeneracy_guard():
+    """A budget-forced block_k below the halo depth must be flagged (huge
+    in-plane sizes), while small grids (grid-limited block_k) must not."""
+    from pampi_tpu.ops.sor3d_pallas import block_k_degenerate, pick_block_k
+
+    # huge plane: 4096x4096 f32 -> ~64 MiB/plane, bk collapses to 1
+    bk = pick_block_k(4096, 4096, 4096, DT, n_inner=4)
+    assert block_k_degenerate(bk, 4096, 4)
+    # tiny grid: bk is grid-limited, not budget-limited -> fine
+    bk = pick_block_k(4, 4, 4, DT, n_inner=4)
+    assert not block_k_degenerate(bk, 4, 4)
+    # headline shape: healthy block in the measured-fast range
+    bk = pick_block_k(128, 128, 128, DT, n_inner=4)
+    assert 8 <= bk <= 32 and not block_k_degenerate(bk, 128, 4)
+
+
+def test_padding_roundtrip_and_dead_cells():
+    K, J, I = 5, 6, 7
+    p0, _ = _fields(K, J, I, seed=1)
+    bk = pick_block_k(K, J, I, DT, 1)
+    pp = pad_array_3d(p0, bk, 1)
+    assert float(jnp.sum(jnp.abs(pp))) == pytest.approx(
+        float(jnp.sum(jnp.abs(p0))), rel=1e-6
+    )
+    back = unpad_array_3d(pp, K, J, I, 1)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(p0))
+
+
+def test_pressure_solve_backend_equivalence():
+    """make_pressure_solve_3d(backend='pallas'/interpret) must converge to the
+    same field and iteration count as the jnp backend."""
+    K = J = I = 12
+    dx, dy, dz = 1.0 / I, 1.0 / J, 1.0 / K
+    rng = np.random.default_rng(5)
+    p0 = jnp.zeros((K + 2, J + 2, I + 2), DT)
+    rhs_i = rng.standard_normal((K, J, I))
+    rhs_i -= rhs_i.mean()  # compatible RHS for the all-Neumann problem
+    rhs = jnp.zeros_like(p0).at[1:-1, 1:-1, 1:-1].set(jnp.asarray(rhs_i, DT))
+
+    s_jnp = make_pressure_solve_3d(I, J, K, dx, dy, dz, 1.7, 1e-4, 500, DT,
+                                   backend="jnp")
+    p_a, res_a, it_a = s_jnp(p0, rhs)
+
+    s_pl = make_pressure_solve_3d(I, J, K, dx, dy, dz, 1.7, 1e-4, 500, DT,
+                                  backend="pallas")
+    p_b, res_b, it_b = s_pl(p0, rhs)
+
+    assert int(it_a) == int(it_b)
+    assert float(res_b) == pytest.approx(float(res_a), rel=1e-3)
+    np.testing.assert_allclose(np.asarray(p_b), np.asarray(p_a),
+                               rtol=0, atol=1e-4)
+
+
+def test_pressure_solve_n_inner_accounting():
+    """With n_inner=2 the pallas loop advances `it` by 2 per step and stops at
+    the same convergence point (within one fused step's granularity)."""
+    K = J = I = 10
+    dx, dy, dz = 1.0 / I, 1.0 / J, 1.0 / K
+    rng = np.random.default_rng(6)
+    p0 = jnp.zeros((K + 2, J + 2, I + 2), DT)
+    rhs_i = rng.standard_normal((K, J, I))
+    rhs_i -= rhs_i.mean()
+    rhs = jnp.zeros_like(p0).at[1:-1, 1:-1, 1:-1].set(jnp.asarray(rhs_i, DT))
+
+    s1 = make_pressure_solve_3d(I, J, K, dx, dy, dz, 1.7, 1e-4, 500, DT,
+                                backend="jnp")
+    _, _, it1 = s1(p0, rhs)
+    s2 = make_pressure_solve_3d(I, J, K, dx, dy, dz, 1.7, 1e-4, 500, DT,
+                                backend="pallas", n_inner=2)
+    p2, res2, it2 = s2(p0, rhs)
+    assert int(it2) % 2 == 0
+    assert abs(int(it2) - int(it1)) <= 2
+    assert float(res2) < 1e-8  # eps² = 1e-8
+
+
+def test_ns3d_solver_backend_equivalence():
+    """Full NS-3D time loop: forcing the pallas (interpret) backend must
+    reproduce the auto/jnp run on CPU."""
+    from pampi_tpu.models.ns3d import NS3DSolver
+    from pampi_tpu.utils.params import Parameter
+
+    param = Parameter(
+        name="dcavity3d", imax=8, jmax=8, kmax=8,
+        re=10.0, te=0.06, tau=0.5, itermax=100, eps=1e-4, omg=1.7,
+        gamma=0.9, tpu_dtype="float32",
+    )
+    a = NS3DSolver(param, dtype=DT)
+    a.run(progress=False)
+
+    b = NS3DSolver(param, dtype=DT)
+    b._chunk_fn = __import__("jax").jit(b._build_chunk(backend="pallas"))
+    b._backend = "pallas"
+    b.run(progress=False)
+
+    np.testing.assert_allclose(np.asarray(b.p), np.asarray(a.p),
+                               rtol=0, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(b.u), np.asarray(a.u),
+                               rtol=0, atol=5e-4)
+    assert a.nt == b.nt

@@ -1,0 +1,93 @@
+"""Measure the 3-D red-black SOR iteration at NS-3D headline shapes on the
+real chip: jnp half-sweep composition vs the fused Pallas kernel across
+block_k / n_inner. Reports lattice-site updates/s (sites x RB-iterations /
+wall). Run on TPU: python tools/perf_sor3d.py [K J I]"""
+
+import functools
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pampi_tpu.models.ns3d import (
+    checkerboard_mask_3d,
+    neumann_faces_3d,
+    sor_coefficients_3d,
+    sor_pass_3d,
+)
+from pampi_tpu.ops import sor3d_pallas as sp3
+
+K, J, I = (int(a) for a in sys.argv[1:4]) if len(sys.argv) > 3 else (128, 128, 128)
+DT = jnp.float32
+ITERS = 200
+dx, dy, dz, omega = 1.0 / I, 1.0 / J, 1.0 / K, 1.8
+
+
+def timeit(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def report(tag, dt_s, rb_iters):
+    ups = K * J * I * rb_iters / dt_s
+    print(f"{tag:34s} {dt_s*1e3:8.1f} ms  {ups/1e9:7.2f} G updates/s")
+    return ups
+
+
+p0 = jnp.zeros((K + 2, J + 2, I + 2), DT)
+rhs = jnp.ones_like(p0)
+
+# --- jnp baseline ---
+factor, idx2, idy2, idz2 = sor_coefficients_3d(dx, dy, dz, omega)
+odd = checkerboard_mask_3d(K, J, I, 1, DT)
+even = checkerboard_mask_3d(K, J, I, 0, DT)
+
+
+@jax.jit
+def jnp_n(p):
+    def body(_, c):
+        p, _ = c
+        p, r0 = sor_pass_3d(p, rhs, odd, factor, idx2, idy2, idz2)
+        p, r1 = sor_pass_3d(p, rhs, even, factor, idx2, idy2, idz2)
+        return neumann_faces_3d(p), r0 + r1
+
+    return lax.fori_loop(0, ITERS, body, (p, jnp.zeros((), DT)))
+
+
+base = report("jnp fused-XLA", timeit(jnp_n, p0), ITERS)
+
+# --- pallas variants ---
+for n_inner in (1, 2, 4):
+    for bk in (8, 16, 32):
+        try:
+            rb, bk_ = sp3.make_rb_iter_tblock_3d(
+                I, J, K, dx, dy, dz, omega, DT,
+                n_inner=n_inner, block_k=bk, interpret=False,
+            )
+            pp = sp3.pad_array_3d(p0, bk_, n_inner)
+            rp = sp3.pad_array_3d(rhs, bk_, n_inner)
+            steps = ITERS // n_inner
+
+            @jax.jit
+            def pal_n(pp, rp, rb=rb, steps=steps):
+                def body(_, c):
+                    pp, _ = c
+                    return rb(pp, rp)
+
+                return lax.fori_loop(0, steps, body, (pp, jnp.zeros((), DT)))
+
+            dt_s = timeit(pal_n, pp, rp)
+            ups = report(f"pallas n_inner={n_inner} bk={bk_}", dt_s,
+                         steps * n_inner)
+            print(f"{'':34s} vs jnp: {ups/base:5.2f}x")
+        except Exception as exc:  # noqa: BLE001 — sweep past bad configs
+            print(f"pallas n_inner={n_inner} bk={bk}: FAILED "
+                  f"{type(exc).__name__}: {str(exc)[:120]}")
