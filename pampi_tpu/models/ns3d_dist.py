@@ -13,8 +13,10 @@ solver bitwise (mod reduction order) on any mesh shape.
 Exchange points per step (mirroring the reference's own calls where they
 exist): u/v/w at step start (maxElement ghost parity), u/v/w after BCs
 (≙ computeFG's commExchange, solver.c:635-637), F/G/H one-directional shift
-before RHS (≙ commShift, solver.c:161), p before each half-sweep and after
-the solve loop (≙ solve's per-pass commExchange :208 and trailing :288).
+before RHS (≙ commShift, solver.c:161), p once per n fused red-black
+iterations at halo depth 2n (communication-avoiding; ≙ solve's per-pass
+commExchange :208, traded latency-for-bandwidth the ICI way) and after the
+solve loop (≙ trailing :288).
 """
 
 from __future__ import annotations
@@ -26,17 +28,25 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops import ns3d as ops
-from .ns3d import sor_coefficients_3d, sor_pass_3d, write_vtk_result
+from .ns3d import sor_coefficients_3d, write_vtk_result
 from ..parallel.comm import (
     CartComm,
     halo_exchange,
     halo_shift,
     reduction,
 )
+from ..parallel.stencil2d import (
+    ca_halo,
+    ca_inner,
+    ca_supported,
+    embed_deep,
+    strip_deep,
+)
 from ..parallel.stencil3d import (
+    ca_masks_3d,
+    ca_rb_iters_3d,
     face_flags,
-    global_checkerboard_masks_3d,
-    neumann_faces,
+    rb_exchange_per_sweep_3d,
 )
 from ..utils.grid import Grid
 from ..utils.params import Parameter
@@ -153,29 +163,43 @@ class NS3DDistSolver:
         epssq = param.eps * param.eps
         norm = float(g.imax * g.jmax * g.kmax)
 
-        def half_sweep(p, rhs, mask):
-            return sor_pass_3d(p, rhs, mask, factor, idx2, idy2, idz2)
-
         def solve(p, rhs):
-            odd, even = global_checkerboard_masks_3d(kl, jl, il, dtype)
+            """Communication-avoiding red-black solve (stencil3d.ca_*): one
+            depth-2n halo exchange per n exact local iterations, n clamped by
+            the shard extents (tpu_ca_inner; n=1 still halves the per-
+            iteration message count vs exchange-per-half-sweep while keeping
+            the trajectory identical). Shards with an extent of 1 cannot ship
+            depth-2 strips from owned cells — they use the classic
+            exchange-per-half-sweep fallback."""
+            supported = ca_supported(kl, jl, il)
+            n = ca_inner(param, kl, jl, il) if supported else 1
+            H = ca_halo(n) if supported else 1
+            masks = ca_masks_3d(kl, jl, il, H, g.kmax, g.jmax, g.imax, dtype)
+            pd = embed_deep(p, H)
+            rd = halo_exchange(embed_deep(rhs, H), comm, depth=H)
 
             def cond(c):
                 return jnp.logical_and(c[1] >= epssq, c[2] < param.itermax)
 
             def body(c):
-                p, _, it = c
-                p = halo_exchange(p, comm)
-                p, r0 = half_sweep(p, rhs, odd)
-                p = halo_exchange(p, comm)
-                p, r1 = half_sweep(p, rhs, even)
-                p = neumann_faces(p, comm)
-                res = reduction(r0 + r1, comm, "sum") / norm
-                return p, res, it + 1
+                pd, _, it = c
+                if supported:
+                    pd = halo_exchange(pd, comm, depth=H)
+                    pd, r2 = ca_rb_iters_3d(
+                        pd, rd, n, masks, factor, idx2, idy2, idz2
+                    )
+                else:
+                    pd, r2 = rb_exchange_per_sweep_3d(
+                        pd, rd, masks, comm, factor, idx2, idy2, idz2
+                    )
+                res = reduction(r2, comm, "sum") / norm
+                return pd, res, it + n
 
-            p, res, it = lax.while_loop(
-                cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+            pd, res, it = lax.while_loop(
+                cond, body,
+                (pd, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
             )
-            return halo_exchange(p, comm), res, it
+            return halo_exchange(strip_deep(pd, H), comm), res, it
 
         def compute_dt(u, v, w):
             umax = reduction(jnp.max(jnp.abs(u)), comm, "max")

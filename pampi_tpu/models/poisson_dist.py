@@ -8,13 +8,16 @@ assignment-5/ex5-nazifkar/src/solver.c:406-660), TPU-first:
   the ("j","i") mesh. Ghost layers exist only INSIDE the kernel as an
   extended local block — there is no distributed assembly step at the end
   (commCollectResult is just reading the sharded array).
-- Halo refresh = `halo_exchange` (ppermute) BEFORE EACH half-sweep. That makes
-  the distributed red-black trajectory identical (up to reduction order) to
-  the sequential red-black solver: the black pass sees post-red neighbour
-  values exactly as the in-place sequential sweep does. The reference's 2-D
-  MPI solver exchanges once per lexicographic sweep and accepts a different,
-  block-hybrid trajectory (SURVEY.md §3.2); we keep exact RB equivalence and
-  get device-count-independent iteration counts.
+- Halo refresh is COMMUNICATION-AVOIDING (stencil2d.ca_*): one depth-2n
+  exchange per n red-black iterations computed locally on a deep-halo
+  extended block, with bitwise trajectory equality to the sequential
+  red-black solver (the black pass sees post-red neighbour values exactly as
+  the in-place sequential sweep does — redundant halo recompute yields
+  identical values). The reference's 2-D MPI solver exchanges once per
+  lexicographic sweep and accepts a different, block-hybrid trajectory
+  (SURVEY.md §3.2); we keep exact RB equivalence and get device-count- AND
+  n-independent trajectories. Extent-1 shards use the classic
+  exchange-per-half-sweep fallback (rb_exchange_per_sweep).
 - Residual: per-shard sum + `psum` (≙ MPI_Allreduce SUM, solver.c:651),
   normalized by global imax·jmax (solver.c:653 semantics).
 - Physical-wall ghosts are owned by BC code on boundary shards only
@@ -34,8 +37,15 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.comm import CartComm, get_offsets, halo_exchange, reduction
-from ..parallel.stencil2d import global_checkerboard_masks, neumann_walls
-from ..ops.sor import sor_pass
+from ..parallel.stencil2d import (
+    ca_halo,
+    ca_inner,
+    ca_masks,
+    ca_rb_iters,
+    ca_supported,
+    neumann_masked,
+    rb_exchange_per_sweep,
+)
 from ..utils.datio import write_matrix
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -85,38 +95,46 @@ class DistPoissonSolver:
         # compute dtype (bfloat16 rounds integers > 256); cast only the field
         idx_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
+        # communication-avoiding block size and halo depth (stencil2d.ca_*):
+        # the solve carries a (jl+2H, il+2H) deep-halo extended block and pays
+        # one depth-H exchange per n exact red-black iterations; extent-1
+        # shards fall back to the classic exchange-per-half-sweep form
+        supported = ca_supported(jl, il)
+        n_ca = ca_inner(param, jl, il) if supported else 1
+        H = ca_halo(n_ca) if supported else 1
+
         def offsets():
-            # extended-local index + block offset = global extended index
+            # local deep index a ↔ global extended index a - (H-1) + offset
             joff = get_offsets("j", jl)
             ioff = get_offsets("i", il)
             return joff, ioff
 
-        def analytic_ext():
-            """Analytic init of the extended block (initSolver:105-123):
-            p = sin(4π·i·dx)+sin(4π·j·dy) at the GLOBAL extended index —
-            identical values the sequential init places at every position,
-            including what are ghost positions here."""
+        def analytic_deep():
+            """Analytic init at the GLOBAL extended index over the deep block
+            (initSolver:105-123): p = sin(4π·i·dx)+sin(4π·j·dy) — identical
+            values the sequential init places at every position, including
+            what are ghost positions here (values at out-of-domain deep-halo
+            positions are dead: masked from every update and read)."""
             joff, ioff = offsets()
-            jj = (jnp.arange(jl + 2, dtype=idx_dtype) + joff) * dy
-            ii = (jnp.arange(il + 2, dtype=idx_dtype) + ioff) * dx
+            jj = (jnp.arange(jl + 2 * H, dtype=idx_dtype) - (H - 1) + joff) * dy
+            ii = (jnp.arange(il + 2 * H, dtype=idx_dtype) - (H - 1) + ioff) * dx
             ext = jnp.sin(4.0 * PI * ii)[None, :] + jnp.sin(4.0 * PI * jj)[:, None]
             return ext.astype(dtype)
 
         def init_kernel():
-            return analytic_ext()[1:-1, 1:-1]  # interior only
+            return analytic_deep()[H:-H, H:-H]  # interior only
 
-        def rhs_kernel():
+        def rhs_deep():
             joff, ioff = offsets()
-            ii = (jnp.arange(il + 2, dtype=idx_dtype) + ioff) * dx
+            ii = (jnp.arange(il + 2 * H, dtype=idx_dtype) - (H - 1) + ioff) * dx
             row = (
                 jnp.sin(2.0 * PI * ii)
                 if problem == 2
-                else jnp.zeros(il + 2, idx_dtype)
+                else jnp.zeros(il + 2 * H, idx_dtype)
             )
-            return jnp.broadcast_to(row[None, :], (jl + 2, il + 2)).astype(dtype)
-
-        def half_sweep(p, rhs, mask):
-            return sor_pass(p, rhs, mask, factor, idx2, idy2)
+            return jnp.broadcast_to(
+                row[None, :], (jl + 2 * H, il + 2 * H)
+            ).astype(dtype)
 
         def solve_kernel(p_int, first: bool):
             """(jl, il) interior block -> (solved block, res, it).
@@ -126,11 +144,11 @@ class DistPoissonSolver:
             initSolver:105); on a resumed solve the walls carry the Neumann
             copies the previous iteration ended with, which equal an edge
             copy of the interior."""
-            p = analytic_ext().at[1:-1, 1:-1].set(p_int)
+            m = ca_masks(jl, il, H, self.jmax, self.imax, dtype)
+            p = analytic_deep().at[H:-H, H:-H].set(p_int)
             if not first:
-                p = neumann_walls(p, comm)
-            rhs = rhs_kernel()
-            red, black = global_checkerboard_masks(jl, il, dtype)
+                p = neumann_masked(p, m)
+            rhs = rhs_deep()
 
             def cond(carry):
                 _, res, it = carry
@@ -138,17 +156,19 @@ class DistPoissonSolver:
 
             def body(carry):
                 p, _, it = carry
-                p = halo_exchange(p, comm)
-                p, r0 = half_sweep(p, rhs, red)
-                p = halo_exchange(p, comm)
-                p, r1 = half_sweep(p, rhs, black)
-                p = neumann_walls(p, comm)
-                res = reduction(r0 + r1, comm, "sum") / norm
-                return p, res, it + 1
+                if supported:
+                    p = halo_exchange(p, comm, depth=H)
+                    p, r2 = ca_rb_iters(p, rhs, n_ca, m, factor, idx2, idy2)
+                else:
+                    p, r2 = rb_exchange_per_sweep(
+                        p, rhs, m, comm, factor, idx2, idy2
+                    )
+                res = reduction(r2, comm, "sum") / norm
+                return p, res, it + n_ca
 
             init = (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
             p, res, it = lax.while_loop(cond, body, init)
-            return p[1:-1, 1:-1], res, it
+            return p[H:-H, H:-H], res, it
 
         spec = P("j", "i")
         self._init_sm = jax.jit(

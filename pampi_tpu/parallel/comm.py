@@ -206,37 +206,44 @@ def _nbr_perm(nper: int, up: bool, periodic: bool):
     ]
 
 
-def _exchange_axis(x, axis_name: str, nper: int, dim: int, periodic: bool):
-    """Fill both ghost strips of `x` along array dim `dim` from the ±1
-    neighbours on mesh axis `axis_name`. Physical-wall ghosts keep their
-    previous contents (MPI_PROC_NULL semantics)."""
+def _exchange_axis(x, axis_name: str, nper: int, dim: int, periodic: bool,
+                   depth: int = 1):
+    """Fill both `depth`-wide ghost strips of `x` along array dim `dim` from
+    the ±1 neighbours on mesh axis `axis_name`. Physical-wall ghosts keep
+    their previous contents (MPI_PROC_NULL semantics)."""
     if nper == 1 and not periodic:
         return x
     n = x.shape[dim]
-    hi_edge = lax.slice_in_dim(x, n - 2, n - 1, axis=dim)  # my high interior
-    lo_edge = lax.slice_in_dim(x, 1, 2, axis=dim)  # my low interior
+    d = depth
+    # my high/low OWNED strips (d innermost owned layers on each side)
+    hi_edge = lax.slice_in_dim(x, n - 2 * d, n - d, axis=dim)
+    lo_edge = lax.slice_in_dim(x, d, 2 * d, axis=dim)
     # strip travelling "up" (to +1 neighbour) fills their LOW ghost, and v.v.
     from_lo = lax.ppermute(hi_edge, axis_name, _nbr_perm(nper, True, periodic))
     from_hi = lax.ppermute(lo_edge, axis_name, _nbr_perm(nper, False, periodic))
     if not periodic:
         idx = lax.axis_index(axis_name)
-        old_lo = lax.slice_in_dim(x, 0, 1, axis=dim)
-        old_hi = lax.slice_in_dim(x, n - 1, n, axis=dim)
+        old_lo = lax.slice_in_dim(x, 0, d, axis=dim)
+        old_hi = lax.slice_in_dim(x, n - d, n, axis=dim)
         from_lo = jnp.where(idx > 0, from_lo, old_lo)
         from_hi = jnp.where(idx < nper - 1, from_hi, old_hi)
     x = lax.dynamic_update_slice_in_dim(x, from_lo, 0, axis=dim)
-    x = lax.dynamic_update_slice_in_dim(x, from_hi, n - 1, axis=dim)
+    x = lax.dynamic_update_slice_in_dim(x, from_hi, n - d, axis=dim)
     return x
 
 
-def halo_exchange(x, comm: CartComm, periodic=()):
+def halo_exchange(x, comm: CartComm, periodic=(), depth: int = 1):
     """commExchange (comm.c:184-195): refresh ALL ghost layers of the extended
-    local block `x` (one ghost layer per side, array dims ordered like the
-    mesh axes). Axis-by-axis with full strips ⇒ ghost corners are consistent
-    after the last axis."""
+    local block `x` (`depth` ghost layers per side, array dims ordered like
+    the mesh axes). Axis-by-axis with full strips ⇒ ghost corners are
+    consistent after the last axis. depth > 1 is the communication-avoiding
+    deep-halo exchange: one fat ppermute message replaces `depth` thin ones —
+    the right trade on latency-bound ICI hops (see parallel/stencil2d.py
+    `ca_rb_iters` for the local temporal blocking that consumes it)."""
     for dim, axis_name in enumerate(comm.axis_names):
         x = _exchange_axis(
-            x, axis_name, comm.axis_size(axis_name), dim, axis_name in periodic
+            x, axis_name, comm.axis_size(axis_name), dim,
+            axis_name in periodic, depth,
         )
     return x
 

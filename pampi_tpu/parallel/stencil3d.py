@@ -1,11 +1,15 @@
 """Shared 3-D distributed-stencil helpers for ("k","j","i")-mesh solvers
-(3-D twins of stencil2d; ≙ assignment-6's commIsBoundary-gated face loops)."""
+(3-D twins of stencil2d; ≙ assignment-6's commIsBoundary-gated face loops).
+The communication-avoiding pieces (ca_*) follow the design note in
+stencil2d: one depth-2n halo exchange per n exact red-black iterations,
+with the bitwise-parity arithmetic discipline (interior-sliced laplacian,
+float mask multiply, at[].add — op-for-op models/ns3d.sor_pass_3d)."""
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .comm import CartComm, get_offsets, is_boundary
+from .comm import CartComm, get_offsets, halo_exchange, is_boundary
 
 
 def face_flags(comm: CartComm):
@@ -24,38 +28,113 @@ def face_flags(comm: CartComm):
     }
 
 
-def neumann_faces(p, comm: CartComm):
-    """6-face pressure ghost copy, wall shards only (solver.c:233-279)."""
-    f = face_flags(comm)
-    p = p.at[0, 1:-1, 1:-1].set(
-        jnp.where(f["front"], p[1, 1:-1, 1:-1], p[0, 1:-1, 1:-1])
-    )
-    p = p.at[-1, 1:-1, 1:-1].set(
-        jnp.where(f["back"], p[-2, 1:-1, 1:-1], p[-1, 1:-1, 1:-1])
-    )
-    p = p.at[1:-1, 0, 1:-1].set(
-        jnp.where(f["bottom"], p[1:-1, 1, 1:-1], p[1:-1, 0, 1:-1])
-    )
-    p = p.at[1:-1, -1, 1:-1].set(
-        jnp.where(f["top"], p[1:-1, -2, 1:-1], p[1:-1, -1, 1:-1])
-    )
-    p = p.at[1:-1, 1:-1, 0].set(
-        jnp.where(f["left"], p[1:-1, 1:-1, 1], p[1:-1, 1:-1, 0])
-    )
-    p = p.at[1:-1, 1:-1, -1].set(
-        jnp.where(f["right"], p[1:-1, 1:-1, -2], p[1:-1, 1:-1, -1])
-    )
-    return p
-
-
-def global_checkerboard_masks_3d(kl: int, jl: int, il: int, dtype):
-    """(odd, even) interior masks by GLOBAL 1-based (i+j+k) parity — pass 0
-    of the reference's sweep is parity 1 (solver.c:203-231)."""
+def ca_masks_3d(kl: int, jl: int, il: int, halo: int,
+                kmax: int, jmax: int, imax: int, dtype):
+    """Mask set on the (kl+2H, jl+2H, il+2H) extended block from GLOBAL
+    coordinates (owned interior starts at local index H). odd/even follow the
+    reference's pass order (pass 0 = (i+j+k) parity 1, solver.c:203-231).
+    halo=1 degenerates to the classic 1-ghost-layer layout for the extent-1
+    fallback."""
+    H = halo
     koff = get_offsets("k", kl)
     joff = get_offsets("j", jl)
     ioff = get_offsets("i", il)
-    kk = jnp.arange(1, kl + 1, dtype=jnp.int32)[:, None, None] + koff
-    jj = jnp.arange(1, jl + 1, dtype=jnp.int32)[None, :, None] + joff
-    ii = jnp.arange(1, il + 1, dtype=jnp.int32)[None, None, :] + ioff
-    par = (ii + jj + kk) % 2
-    return (par == 1).astype(dtype), (par == 0).astype(dtype)
+    gk = jnp.arange(kl + 2 * H, dtype=jnp.int32)[:, None, None] - (H - 1) + koff
+    gj = jnp.arange(jl + 2 * H, dtype=jnp.int32)[None, :, None] - (H - 1) + joff
+    gi = jnp.arange(il + 2 * H, dtype=jnp.int32)[None, None, :] - (H - 1) + ioff
+    interior = (
+        (gk >= 1) & (gk <= kmax)
+        & (gj >= 1) & (gj <= jmax)
+        & (gi >= 1) & (gi <= imax)
+    )
+    par = (gi + gj + gk) % 2
+    lk = jnp.arange(kl + 2 * H, dtype=jnp.int32)[:, None, None]
+    lj = jnp.arange(jl + 2 * H, dtype=jnp.int32)[None, :, None]
+    li = jnp.arange(il + 2 * H, dtype=jnp.int32)[None, None, :]
+    owned = (
+        (lk >= H) & (lk < H + kl)
+        & (lj >= H) & (lj < H + jl)
+        & (li >= H) & (li < H + il)
+    )
+    tan_ji = (gj >= 1) & (gj <= jmax) & (gi >= 1) & (gi <= imax)
+    tan_ki = (gk >= 1) & (gk <= kmax) & (gi >= 1) & (gi <= imax)
+    tan_kj = (gk >= 1) & (gk <= kmax) & (gj >= 1) & (gj <= jmax)
+    # odd/even are FLOAT multiply-masks: the update is then op-for-op the
+    # single-device sor_pass_3d expression → bitwise trajectory parity
+    return {
+        "odd": (interior & (par == 1)).astype(dtype),
+        "even": (interior & (par == 0)).astype(dtype),
+        "owned": owned,
+        "wall_klo": (gk == 0) & tan_ji,
+        "wall_khi": (gk == kmax + 1) & tan_ji,
+        "wall_jlo": (gj == 0) & tan_ki,
+        "wall_jhi": (gj == jmax + 1) & tan_ki,
+        "wall_ilo": (gi == 0) & tan_kj,
+        "wall_ihi": (gi == imax + 1) & tan_kj,
+    }
+
+
+def ca_half_sweep_3d(p, rhs, mask_interior, factor, idx2, idy2, idz2):
+    """One masked half-sweep — the exact arithmetic of models/ns3d.sor_pass_3d
+    (bitwise-parity discipline). Returns (p, r)."""
+    x = p
+    lap = (
+        (x[1:-1, 1:-1, 2:] - 2.0 * x[1:-1, 1:-1, 1:-1] + x[1:-1, 1:-1, :-2])
+        * idx2
+        + (x[1:-1, 2:, 1:-1] - 2.0 * x[1:-1, 1:-1, 1:-1] + x[1:-1, :-2, 1:-1])
+        * idy2
+        + (x[2:, 1:-1, 1:-1] - 2.0 * x[1:-1, 1:-1, 1:-1] + x[:-2, 1:-1, 1:-1])
+        * idz2
+    )
+    r = (rhs[1:-1, 1:-1, 1:-1] - lap) * mask_interior
+    return p.at[1:-1, 1:-1, 1:-1].add(-factor * r), r
+
+
+def neumann_masked_3d(p, masks):
+    """6-face Neumann wall-ghost refresh via the ca_masks_3d wall masks."""
+    p = jnp.where(masks["wall_klo"], jnp.roll(p, -1, axis=0), p)
+    p = jnp.where(masks["wall_khi"], jnp.roll(p, 1, axis=0), p)
+    p = jnp.where(masks["wall_jlo"], jnp.roll(p, -1, axis=1), p)
+    p = jnp.where(masks["wall_jhi"], jnp.roll(p, 1, axis=1), p)
+    p = jnp.where(masks["wall_ilo"], jnp.roll(p, -1, axis=2), p)
+    p = jnp.where(masks["wall_ihi"], jnp.roll(p, 1, axis=2), p)
+    return p
+
+
+def _owned_r2_3d(r_odd, r_evn, masks):
+    return jnp.sum(
+        jnp.where(
+            masks["owned"][1:-1, 1:-1, 1:-1],
+            r_odd * r_odd + r_evn * r_evn,
+            0.0,
+        )
+    )
+
+
+def ca_rb_iters_3d(p, rhs, n: int, masks, factor, idx2, idy2, idz2):
+    """n full red-black iterations (odd pass, even pass, 6-face Neumann
+    refresh — the sequential loop order) on the deep-halo extended block;
+    returns the block and the owned-cells r² sum of the LAST iteration.
+    Requires a depth-ca_halo(n) exchange before the call."""
+    odd = masks["odd"][1:-1, 1:-1, 1:-1]
+    even = masks["even"][1:-1, 1:-1, 1:-1]
+    r_odd = r_evn = None
+    for _ in range(n):
+        p, r_odd = ca_half_sweep_3d(p, rhs, odd, factor, idx2, idy2, idz2)
+        p, r_evn = ca_half_sweep_3d(p, rhs, even, factor, idx2, idy2, idz2)
+        p = neumann_masked_3d(p, masks)
+    return p, _owned_r2_3d(r_odd, r_evn, masks)
+
+
+def rb_exchange_per_sweep_3d(p, rhs, masks, comm: CartComm,
+                             factor, idx2, idy2, idz2):
+    """Extent-1-safe fallback on the halo=1 layout (see
+    stencil2d.rb_exchange_per_sweep)."""
+    odd = masks["odd"][1:-1, 1:-1, 1:-1]
+    even = masks["even"][1:-1, 1:-1, 1:-1]
+    p = halo_exchange(p, comm)
+    p, r_odd = ca_half_sweep_3d(p, rhs, odd, factor, idx2, idy2, idz2)
+    p = halo_exchange(p, comm)
+    p, r_evn = ca_half_sweep_3d(p, rhs, even, factor, idx2, idy2, idz2)
+    p = neumann_masked_3d(p, masks)
+    return p, _owned_r2_3d(r_odd, r_evn, masks)

@@ -71,6 +71,13 @@ class Parameter:
     # iterations, so a solve may overshoot by up to tpu_sor_inner-1
     # iterations (jnp paths always step singly). 4 measured fastest on v5e.
     tpu_sor_inner: int = 4
+    # communication-avoiding depth of the DISTRIBUTED red-black solve
+    # (parallel/stencil2d.ca_rb_iters): n exact iterations computed locally
+    # per depth-2n halo exchange; convergence is checked every n iterations
+    # (same overshoot semantics as tpu_sor_inner). n is clamped so 2n never
+    # exceeds a shard extent; 1 keeps today's per-iteration trajectory
+    # granularity while still halving the message count.
+    tpu_ca_inner: int = 1
     # checkpoint/restart (utils/checkpoint.py; the reference has none)
     tpu_checkpoint: str = ""
     tpu_ckpt_every: int = 10

@@ -1,0 +1,159 @@
+"""Communication-avoiding distributed SOR (deep halos + local temporal
+blocking, parallel/stencil2d.ca_* / stencil3d.ca_*): depth-H exchange
+correctness, and exact trajectory parity with the single-device solvers for
+n > 1 local iterations per exchange."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pampi_tpu.models.poisson import PoissonSolver
+from pampi_tpu.models.poisson_dist import DistPoissonSolver
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+from pampi_tpu.models.ns3d import NS3DSolver
+from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+from pampi_tpu.parallel.comm import CartComm, halo_exchange
+from pampi_tpu.utils.params import Parameter
+
+
+def test_deep_halo_exchange_fills_depth_strips():
+    """Each shard's depth-H ghost strips must carry the neighbour's H
+    innermost OWNED layers (rank-id pattern, the test.c discipline)."""
+    H = 4
+    jl = il = 8
+    comm = CartComm(ndims=2, dims=(2, 4))
+
+    def kern():
+        j = jax.lax.axis_index("j")
+        i = jax.lax.axis_index("i")
+        rank = (j * 4 + i).astype(jnp.float32)
+        x = jnp.full((jl + 2 * H, il + 2 * H), rank)
+        return halo_exchange(x, comm, depth=H)
+
+    out = jax.jit(
+        comm.shard_map(kern, in_specs=(), out_specs=P("j", "i"))
+    )()
+    out = np.asarray(out)
+    for bj in range(2):
+        for bi in range(4):
+            blk = out[bj * (jl + 2 * H):(bj + 1) * (jl + 2 * H),
+                      bi * (il + 2 * H):(bi + 1) * (il + 2 * H)]
+            rank = bj * 4 + bi
+            own = blk[H:-H, H:-H]
+            np.testing.assert_array_equal(own, rank)
+            if bj > 0:
+                np.testing.assert_array_equal(
+                    blk[:H, H:-H], rank - 4
+                )  # low-j ghosts from the j-neighbour
+            else:
+                np.testing.assert_array_equal(blk[:H, H:-H], rank)
+            if bi < 3:
+                np.testing.assert_array_equal(blk[H:-H, -H:], rank + 1)
+            if bi > 0:
+                np.testing.assert_array_equal(blk[H:-H, :H], rank - 1)
+
+
+@pytest.mark.parametrize("n_ca", [2, 4])
+def test_poisson_ca_inner_exact_parity(n_ca):
+    """n local iterations per exchange: iteration-count-limited solve (the
+    convergence check granularity is n, so pick itermax % n == 0) must equal
+    the single-device trajectory bitwise."""
+    param = Parameter(imax=32, jmax=32, itermax=80, eps=1e-30, omg=1.8,
+                      tpu_ca_inner=n_ca)
+    single = PoissonSolver(param, problem=2)
+    it_s, res_s = single.solve()
+    dist = DistPoissonSolver(param, CartComm(ndims=2), problem=2)
+    it_d, res_d = dist.solve()
+    assert it_d == it_s == 80
+    assert res_d == pytest.approx(res_s, rel=1e-12)
+    np.testing.assert_allclose(
+        dist.full_field(), np.asarray(single.p), rtol=0, atol=1e-11
+    )
+
+
+def test_poisson_ca_inner_clamped_by_shard_extent():
+    """tpu_ca_inner too deep for the shards (2n > min local extent) must be
+    clamped, not crash: 8x1 mesh over jmax=16 → jl=2 → n capped at 1."""
+    param = Parameter(imax=16, jmax=16, itermax=50, eps=1e-30, omg=1.7,
+                      tpu_ca_inner=8)
+    single = PoissonSolver(param, problem=2)
+    single.solve()
+    dist = DistPoissonSolver(param, CartComm(ndims=2, dims=(8, 1)), problem=2)
+    it_d, _ = dist.solve()
+    assert it_d == 50
+    np.testing.assert_allclose(
+        dist.full_field(), np.asarray(single.p), rtol=0, atol=1e-11
+    )
+
+
+def test_poisson_extent1_shards_fall_back_correctly():
+    """A shard extent of 1 (jmax=8 over 8 shards) cannot ship depth-2 strips
+    from owned cells; the per-half-sweep fallback must keep exact parity
+    (regression: the CA path once ran here with H=2 and shipped ghost rows
+    as owned data)."""
+    param = Parameter(imax=8, jmax=8, itermax=60, eps=1e-30, omg=1.7)
+    single = PoissonSolver(param, problem=2)
+    it_s, res_s = single.solve()
+    dist = DistPoissonSolver(param, CartComm(ndims=2, dims=(8, 1)), problem=2)
+    it_d, res_d = dist.solve()
+    assert it_d == it_s == 60
+    assert res_d == pytest.approx(res_s, rel=1e-12)
+    np.testing.assert_allclose(
+        dist.full_field(), np.asarray(single.p), rtol=0, atol=1e-11
+    )
+
+
+def test_ns2d_ca_inner_exact_parity(reference_dir):
+    """Full NS-2D stepper with n=2: pressure solves are itermax-capped (eps
+    tiny, itermax % n == 0) so the whole run must equal single-device
+    bitwise."""
+    from pampi_tpu.utils.params import read_parameter
+
+    param = read_parameter(
+        str(reference_dir / "assignment-5" / "sequential" / "dcavity.par")
+    ).replace(te=0.002, imax=32, jmax=32, itermax=40, eps=1e-30,
+              tpu_ca_inner=2)
+    single = NS2DSolver(param)
+    single.run(progress=False)
+    dist = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 4)))
+    dist.run(progress=False)
+    ud, vd, pd = dist.fields()
+    assert dist.nt == single.nt
+    np.testing.assert_array_equal(np.asarray(single.u), ud)
+    np.testing.assert_array_equal(np.asarray(single.p), pd)
+
+
+def test_ns3d_ca_inner_exact_parity():
+    param = Parameter(
+        name="dcavity3d", imax=16, jmax=16, kmax=16,
+        re=10.0, te=0.03, tau=0.5, itermax=40, eps=1e-30, omg=1.7,
+        gamma=0.9, tpu_ca_inner=2,
+    )
+    single = NS3DSolver(param)
+    single.run(progress=False)
+    dist = NS3DDistSolver(param, CartComm(ndims=3))
+    dist.run(progress=False)
+    assert dist.nt == single.nt
+    for a, b in zip(single.collect(), dist.collect()):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_ns3d_ca_converged_parity():
+    """With a real eps the CA run may overshoot by < n iterations per solve;
+    the converged states must still agree to solver tolerance."""
+    param = Parameter(
+        name="dcavity3d", imax=16, jmax=16, kmax=16,
+        re=10.0, te=0.03, tau=0.5, itermax=100, eps=1e-4, omg=1.7,
+        gamma=0.9,
+    )
+    a = NS3DSolver(param)
+    a.run(progress=False)
+    b = NS3DDistSolver(param.replace(tpu_ca_inner=4), CartComm(ndims=3))
+    b.run(progress=False)
+    assert a.nt == b.nt
+    for x, y in zip(a.collect(), b.collect()):
+        np.testing.assert_allclose(x, y, rtol=0, atol=5e-4)
