@@ -130,6 +130,14 @@ def _dispatch(param, prof) -> int:
         from .utils.params import is_3d_config
 
         is3d = is_3d_config(param)
+        if is3d and param.tpu_vtk not in ("ascii", "binary", "sharded"):
+            # validate before the run, not in the writer after hours of solve
+            print(
+                f"Error: tpu_vtk must be ascii|binary|sharded, "
+                f"got {param.tpu_vtk!r}",
+                file=sys.stderr,
+            )
+            return 1
 
         def build():
             if is3d:
@@ -183,7 +191,13 @@ def _dispatch(param, prof) -> int:
             ckpt.save_checkpoint(param.tpu_checkpoint, solver)
         with prof.region("writeResult"):
             if is3d:
-                solver.write_result()
+                if param.tpu_vtk == "sharded":
+                    if hasattr(solver, "write_result_sharded"):
+                        solver.write_result_sharded()
+                    else:  # single device: binary writer = same bytes
+                        solver.write_result(fmt="binary")
+                else:
+                    solver.write_result(fmt=param.tpu_vtk)
             else:
                 solver.write_result("pressure.dat", "velocity.dat")
     else:

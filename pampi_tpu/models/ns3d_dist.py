@@ -340,10 +340,8 @@ class NS3DDistSolver:
         from ..utils.vtkio import ShardedVtkWriter, shards_of
 
         ug, vg, wg, pg = self._collect_sm(self.u, self.v, self.w, self.p)
-        writer = ShardedVtkWriter(
-            self.param.name, self.grid,
-            path=path or f"{self.param.name}.vtk",
-        )
+        problem = self.param.name.replace("3d", "")  # same naming as serial
+        writer = ShardedVtkWriter(problem, self.grid, path=path)
         writer.scalar("pressure", shards_of(pg))
         us, vs, ws = shards_of(ug), shards_of(vg), shards_of(wg)
         vec = []

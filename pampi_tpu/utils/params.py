@@ -78,6 +78,11 @@ class Parameter:
     # exceeds a shard extent; 1 keeps today's per-iteration trajectory
     # granularity while still halving the message count.
     tpu_ca_inner: int = 1
+    # 3-D VTK output mode: "ascii" (reference default), "binary", or
+    # "sharded" — the MPI-IO-pattern parallel write (utils/vtkio.py
+    # ShardedVtkWriter; binary, byte-identical to "binary"). On a
+    # single-device run "sharded" degrades to "binary" (same bytes).
+    tpu_vtk: str = "ascii"
     # checkpoint/restart (utils/checkpoint.py; the reference has none)
     tpu_checkpoint: str = ""
     tpu_ckpt_every: int = 10
