@@ -46,6 +46,11 @@ def _make_comm(param, ndims: int):
     )
     if ndev == 1 or (dims is not None and all(d == 1 for d in dims)):
         return None
+    if param.tpu_solver == "mg":
+        raise ValueError(
+            "tpu_solver mg is single-device for now; set tpu_mesh 1 "
+            "(or use tpu_solver sor on a mesh)"
+        )
     from .parallel.comm import CartComm
 
     comm = CartComm(ndims=ndims, dims=dims)
@@ -89,6 +94,11 @@ def _run(argv) -> int:
 
 def _dispatch(param, prof) -> int:
     from .utils.timing import get_timestamp
+
+    if param.tpu_solver not in ("sor", "mg"):
+        print(f"Error: tpu_solver must be sor|mg, got {param.tpu_solver!r}",
+              file=sys.stderr)
+        return 1
 
     if param.obstacles.strip():
         from .utils.params import is_3d_config

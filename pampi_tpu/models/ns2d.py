@@ -34,13 +34,21 @@ from ..utils.progress import Progress
 
 
 def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
-                        backend: str = "auto", n_inner: int = 1):
-    """Pressure-Poisson red-black SOR loop (solve, solver.c:140-191): carry
+                        backend: str = "auto", n_inner: int = 1,
+                        solver: str = "sor"):
+    """Pressure-Poisson solve loop (solve, solver.c:140-191): carry
     (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep.
 
-    Identical semantics to the Poisson convergence loop, so it IS that loop:
-    `make_solver_fn` dispatches to the fused Pallas kernel on TPU (f32/bf16),
-    converting to the padded layout once per pressure solve, not per sweep."""
+    solver="sor" (default, the reference's algorithm): identical semantics to
+    the Poisson convergence loop, so it IS that loop — `make_solver_fn`
+    dispatches to the fused Pallas kernel on TPU (f32/bf16), converting to
+    the padded layout once per pressure solve, not per sweep.
+    solver="mg": geometric multigrid V-cycles (ops/multigrid.py), same
+    stopping contract, `it` counts cycles."""
+    if solver == "mg":
+        from ..ops.multigrid import make_mg_solve_2d
+
+        return make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype)
     from .poisson import make_solver_fn
 
     return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
@@ -72,6 +80,11 @@ class NS2DSolver:
         # flag-field obstacles (ops/obstacle.py): static geometry -> static
         # masks baked into the traced step as constants (branch-free)
         if param.obstacles.strip():
+            if param.tpu_solver == "mg":
+                raise ValueError(
+                    "tpu_solver mg does not support obstacle flag fields; "
+                    "use tpu_solver sor"
+                )
             from ..ops import obstacle as obst
 
             fluid = obst.build_fluid(
@@ -85,7 +98,10 @@ class NS2DSolver:
     def _uses_pallas(self) -> bool:
         """Whether the current chunk's pressure solve dispatches to pallas
         (both the uniform and the flag-masked solver go through the same
-        backend probe; jnp-dispatched dtypes/backends never do)."""
+        backend probe; jnp-dispatched dtypes/backends never do; the mg
+        solver contains no pallas kernel at all)."""
+        if self.param.tpu_solver == "mg":
+            return False
         from .poisson import _use_pallas
 
         return _use_pallas(self._backend, self.dtype)
@@ -108,6 +124,7 @@ class NS2DSolver:
                 dtype,
                 backend=backend,
                 n_inner=param.tpu_sor_inner,
+                solver=param.tpu_solver,
             )
         else:
             from ..ops import obstacle as obst

@@ -54,14 +54,21 @@ def neumann_faces_3d(p):
     return p
 
 
-def sor_pass_3d(p, rhs, mask, factor, idx2, idy2, idz2):
-    """One masked half-sweep of the 7-point stencil (solver.c:210-229)."""
+def interior_residual_3d(p, rhs, idx2, idy2, idz2):
+    """Pointwise residual r = rhs - lap(p) on the interior — the single home
+    of the 7-point stencil expression (sor_pass_3d and ops/multigrid share
+    it)."""
     lap = (
         (p[1:-1, 1:-1, 2:] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[1:-1, 1:-1, :-2]) * idx2
         + (p[1:-1, 2:, 1:-1] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[1:-1, :-2, 1:-1]) * idy2
         + (p[2:, 1:-1, 1:-1] - 2.0 * p[1:-1, 1:-1, 1:-1] + p[:-2, 1:-1, 1:-1]) * idz2
     )
-    r = (rhs[1:-1, 1:-1, 1:-1] - lap) * mask
+    return rhs[1:-1, 1:-1, 1:-1] - lap
+
+
+def sor_pass_3d(p, rhs, mask, factor, idx2, idy2, idz2):
+    """One masked half-sweep of the 7-point stencil (solver.c:210-229)."""
+    r = interior_residual_3d(p, rhs, idx2, idy2, idz2) * mask
     p = p.at[1:-1, 1:-1, 1:-1].add(-factor * r)
     return p, jnp.sum(r * r)
 
@@ -99,15 +106,24 @@ def _use_pallas_3d(backend: str, dtype) -> bool:
 
 
 def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
-                           dtype, backend: str = "auto", n_inner: int = 1):
-    """Convergence loop for the 3-D red-black pressure solve. backend="auto"
-    dispatches to the fused Pallas kernel (ops/sor3d_pallas.py) on a real TPU
-    chip and to the jnp half-sweep composition otherwise; both carry
-    (p, res, it) through a `lax.while_loop`. Under pallas the loop carries the
-    PADDED array (one pad before, one unpad after — no per-iteration layout
-    conversion); with n_inner > 1 each loop step runs n_inner red-black
-    iterations in one HBM sweep and observes the last one's residual, so `it`
-    advances by n_inner per step (honest iteration accounting)."""
+                           dtype, backend: str = "auto", n_inner: int = 1,
+                           solver: str = "sor"):
+    """Convergence loop for the 3-D pressure solve. solver="sor" (default,
+    the reference's algorithm): backend="auto" dispatches to the fused Pallas
+    kernel (ops/sor3d_pallas.py) on a real TPU chip and to the jnp half-sweep
+    composition otherwise; both carry (p, res, it) through a
+    `lax.while_loop`. Under pallas the loop carries the PADDED array (one pad
+    before, one unpad after — no per-iteration layout conversion); with
+    n_inner > 1 each loop step runs n_inner red-black iterations in one HBM
+    sweep and observes the last one's residual, so `it` advances by n_inner
+    per step (honest iteration accounting). solver="mg": geometric multigrid
+    V-cycles (ops/multigrid.py), same stopping contract, `it` counts
+    cycles."""
+    if solver == "mg":
+        from ..ops.multigrid import make_mg_solve_3d
+
+        return make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
+                                dtype)
     norm = float(imax * jmax * kmax)
     epssq = eps * eps
 
@@ -206,6 +222,8 @@ class NS3DSolver:
         self._chunk_fn = jax.jit(self._build_chunk())
 
     def _uses_pallas(self) -> bool:
+        if self.param.tpu_solver == "mg":
+            return False  # the mg chunk contains no pallas kernel
         return _use_pallas_3d(self._backend, self.dtype)
 
     def _build_step(self, backend: str = "auto"):
@@ -217,6 +235,7 @@ class NS3DSolver:
             g.imax, g.jmax, g.kmax, dx, dy, dz,
             param.omg, param.eps, param.itermax, dtype,
             backend=backend, n_inner=param.tpu_sor_inner,
+            solver=param.tpu_solver,
         )
         bcs = {
             "top": param.bcTop,

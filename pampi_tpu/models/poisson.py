@@ -233,6 +233,13 @@ class PoissonSolver:
         self._solve = jax.jit(self._make_solve(backend="auto"))
 
     def _make_solve(self, backend: str):
+        if self.param.tpu_solver == "mg":
+            from ..ops.multigrid import make_mg_solve_2d
+
+            return make_mg_solve_2d(
+                self.imax, self.jmax, self.dx, self.dy,
+                self.param.eps, self.param.itermax, self.dtype,
+            )
         return make_solver_fn(
             self.imax,
             self.jmax,
@@ -253,8 +260,8 @@ class PoissonSolver:
             # runtime fault surfaces here, not at the caller's readback
             out = int(it), float(res)
         except Exception:
-            if self._backend == "jnp":
-                raise
+            if self._backend == "jnp" or self.param.tpu_solver == "mg":
+                raise  # no pallas in play — genuine error, don't re-run it
             # shape-specific pallas failure the dispatcher probe missed:
             # fall back to the always-available jnp path (same arithmetic)
             self._backend = "jnp"

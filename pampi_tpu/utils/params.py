@@ -78,6 +78,12 @@ class Parameter:
     # exceeds a shard extent; 1 keeps today's per-iteration trajectory
     # granularity while still halving the message count.
     tpu_ca_inner: int = 1
+    # pressure/elliptic solver: "sor" (the reference's algorithm; default,
+    # trajectory parity) or "mg" (geometric multigrid V-cycles,
+    # ops/multigrid.py — converges in O(1) cycles instead of O(N^1.17)
+    # sweeps; same eps-residual stopping contract, `it` counts cycles;
+    # single-device, no obstacles)
+    tpu_solver: str = "sor"
     # 3-D VTK output mode: "ascii" (reference default), "binary", or
     # "sharded" — the MPI-IO-pattern parallel write (utils/vtkio.py
     # ShardedVtkWriter; binary, byte-identical to "binary"). On a
