@@ -63,3 +63,38 @@ def test_par_keys_parsed(tmp_path):
     assert p.tpu_checkpoint == "ck.npz"
     assert p.tpu_ckpt_every == 3
     assert p.tpu_restart == "old.npz"
+
+
+def test_roundtrip_distributed(tmp_path):
+    """Dist solvers carry stacked extended blocks; save/restore on the same
+    mesh must continue bit-identical, and a mesh mismatch must be refused."""
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    def p3(te):
+        return Parameter(
+            name="dcavity3d", imax=8, jmax=8, kmax=8, re=10.0, te=te,
+            tau=0.5, itermax=50, eps=1e-3, omg=1.7, gamma=0.9,
+            tpu_dtype="float64",
+        )
+
+    path = str(tmp_path / "ck3d.npz")
+    dims = (2, 2, 2)
+    ref = NS3DDistSolver(p3(0.4), CartComm(ndims=3, dims=dims))
+    ref.run(progress=False)
+
+    first = NS3DDistSolver(p3(0.15), CartComm(ndims=3, dims=dims))
+    first.run(progress=False)
+    ckpt.save_checkpoint(path, first)
+
+    second = NS3DDistSolver(p3(0.4), CartComm(ndims=3, dims=dims))
+    ckpt.load_checkpoint(path, second)
+    assert second.t == first.t and second.nt == first.nt
+    second.run(progress=False)
+    assert ref.nt == second.nt
+    for a, b in zip(ref.collect(), second.collect()):
+        np.testing.assert_array_equal(a, b)
+
+    other = NS3DDistSolver(p3(0.4), CartComm(ndims=3, dims=(1, 2, 4)))
+    with pytest.raises(ValueError, match="mesh"):
+        ckpt.load_checkpoint(path, other)
