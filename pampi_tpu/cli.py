@@ -46,11 +46,6 @@ def _make_comm(param, ndims: int):
     )
     if ndev == 1 or (dims is not None and all(d == 1 for d in dims)):
         return None
-    if param.tpu_solver == "mg":
-        raise ValueError(
-            "tpu_solver mg is single-device for now; set tpu_mesh 1 "
-            "(or use tpu_solver sor on a mesh)"
-        )
     from .parallel.comm import CartComm
 
     comm = CartComm(ndims=ndims, dims=dims)

@@ -183,7 +183,7 @@ class NS2DDistSolver:
         epssq = param.eps * param.eps
         norm = float(self.imax * self.jmax)
 
-        def solve(p, rhs):
+        def _solve_sor(p, rhs):
             """Communication-avoiding red-black solve (stencil2d.ca_*): one
             depth-2n halo exchange per n exact local iterations (n =
             tpu_ca_inner clamped by shard extents; trajectory identical to
@@ -217,6 +217,16 @@ class NS2DDistSolver:
                 (pd, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
             )
             return halo_exchange(strip_deep(pd, H), comm), res, it
+
+        if param.tpu_solver == "mg":
+            from ..ops.multigrid import make_dist_mg_solve_2d
+
+            solve = make_dist_mg_solve_2d(
+                comm, self.imax, self.jmax, jl, il, dx, dy,
+                param.eps, param.itermax, dtype,
+            )
+        else:
+            solve = _solve_sor
 
         # -- weighted mean for normalizePressure ------------------------
         def wall_weight():

@@ -163,7 +163,7 @@ class NS3DDistSolver:
         epssq = param.eps * param.eps
         norm = float(g.imax * g.jmax * g.kmax)
 
-        def solve(p, rhs):
+        def _solve_sor(p, rhs):
             """Communication-avoiding red-black solve (stencil3d.ca_*): one
             depth-2n halo exchange per n exact local iterations, n clamped by
             the shard extents (tpu_ca_inner; n=1 still halves the per-
@@ -200,6 +200,16 @@ class NS3DDistSolver:
                 (pd, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
             )
             return halo_exchange(strip_deep(pd, H), comm), res, it
+
+        if param.tpu_solver == "mg":
+            from ..ops.multigrid import make_dist_mg_solve_3d
+
+            solve = make_dist_mg_solve_3d(
+                comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
+                param.eps, param.itermax, dtype,
+            )
+        else:
+            solve = _solve_sor
 
         def compute_dt(u, v, w):
             umax = reduction(jnp.max(jnp.abs(u)), comm, "max")

@@ -98,10 +98,19 @@ class DistPoissonSolver:
         # communication-avoiding block size and halo depth (stencil2d.ca_*):
         # the solve carries a (jl+2H, il+2H) deep-halo extended block and pays
         # one depth-H exchange per n exact red-black iterations; extent-1
-        # shards fall back to the classic exchange-per-half-sweep form
-        supported = ca_supported(jl, il)
+        # shards fall back to the classic exchange-per-half-sweep form; the
+        # mg solver works on the plain halo-1 layout
+        use_mg = param.tpu_solver == "mg"
+        supported = ca_supported(jl, il) and not use_mg
         n_ca = ca_inner(param, jl, il) if supported else 1
         H = ca_halo(n_ca) if supported else 1
+        if use_mg:
+            from ..ops.multigrid import make_dist_mg_solve_2d
+
+            mg_solve = make_dist_mg_solve_2d(
+                comm, self.imax, self.jmax, jl, il, dx, dy,
+                param.eps, itermax, dtype,
+            )
 
         def offsets():
             # local deep index a ↔ global extended index a - (H-1) + offset
@@ -149,6 +158,10 @@ class DistPoissonSolver:
             if not first:
                 p = neumann_masked(p, m)
             rhs = rhs_deep()
+
+            if use_mg:  # H == 1: plain extended blocks
+                p, res, it = mg_solve(p, rhs)
+                return p[1:-1, 1:-1], res, it
 
             def cond(carry):
                 _, res, it = carry

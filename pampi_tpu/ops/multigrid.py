@@ -314,3 +314,238 @@ def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
         )
 
     return solve
+
+
+# ----------------------------------------------------------------------
+# Distributed multigrid: V-cycles over a device mesh (call INSIDE shard_map)
+# ----------------------------------------------------------------------
+#
+# Level plan: coarsen DISTRIBUTED levels while every shard's local extents
+# stay even and >= _DIST_MIN (restriction/prolongation are then shard-local
+# reshapes); below that the coarse problem is small, so it is all_gather'd
+# and solved REDUNDANTLY on every shard with the single-device V-cycle —
+# the standard parallel-MG answer to the coarse-grid bottleneck (smoothing
+# a tiny grid through halo exchanges would need O(global extent) coupled
+# iterations; a replicated direct-ish solve needs none).
+#
+# Smoothing at distributed levels reuses the bitwise-parity half-sweep
+# choreography (stencil2d/3d rb_exchange_per_sweep with halo=1 masks), so
+# the distributed V-cycle applies the same per-element arithmetic as the
+# single-device cycle.
+
+# distributed levels coarsen while every LOCAL extent stays even and at
+# least 2*min_size — the same rule as the single-device plan, applied to the
+# shard-local extents (mg_levels is the single home of the coarsening rule)
+
+
+def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
+                          dtype, n_pre: int = 2, n_post: int = 2,
+                          n_bottom: int = 2):
+    """Distributed-MG convergence loop (shard_map kernel side): builds
+    `(p_ext, rhs_ext) -> (p_ext, res, it)` on the halo-1 extended local
+    block — the same contract as the distributed SOR solve; `it` counts
+    V-cycles. n_bottom = single-device V-cycles on the replicated coarse
+    problem per distributed cycle."""
+    from jax import lax as _lax
+
+    from ..parallel.comm import get_offsets, halo_exchange, reduction
+    from ..parallel.stencil2d import ca_masks, rb_exchange_per_sweep
+
+    Pj = comm.axis_size("j")
+    Pi = comm.axis_size("i")
+    levels = mg_levels(jl, il)
+    cfg = []
+    for lvl, (jll, ill) in enumerate(levels):
+        dxl, dyl = dx * (2 ** lvl), dy * (2 ** lvl)
+        dx2, dy2 = dxl * dxl, dyl * dyl
+        cfg.append(
+            dict(
+                jl=jll, il=ill,
+                jmax=jll * Pj, imax=ill * Pi,
+                idx2=1.0 / dx2, idy2=1.0 / dy2,
+                factor=0.5 * (dx2 * dy2) / (dx2 + dy2),  # ω=1 smoother
+            )
+        )
+    # replicated bottom: the single-device V-cycle on the global coarse grid
+    bl = cfg[-1]
+    lvl0 = len(levels) - 1
+    bottom_vcycle = make_mg_vcycle_2d(
+        bl["imax"], bl["jmax"], dx * (2 ** lvl0), dy * (2 ** lvl0), dtype
+    )
+
+    def masks_at(lvl):
+        c = cfg[lvl]
+        return ca_masks(c["jl"], c["il"], 1, c["jmax"], c["imax"], dtype)
+
+    def smooth(p, rhs, lvl, n):
+        c = cfg[lvl]
+        m = masks_at(lvl)
+        for _ in range(n):
+            p, _ = rb_exchange_per_sweep(
+                p, rhs, m, comm, c["factor"], c["idx2"], c["idy2"]
+            )
+        return p
+
+    def vcycle(p, rhs, lvl=0):
+        c = cfg[lvl]
+        p = smooth(p, rhs, lvl, n_pre)
+        p = halo_exchange(p, comm)  # residual reads shard-edge neighbours
+        r = _residual2(p, rhs, c["idx2"], c["idy2"])
+        if lvl == len(levels) - 1:
+            # replicated bottom solve: gather the DOWNSTREAM problem — here
+            # the residual of THIS level — and V-cycle it globally
+            rg = _lax.all_gather(r, "j", axis=0, tiled=True)
+            rg = _lax.all_gather(rg, "i", axis=1, tiled=True)
+            e = _embed2(jnp.zeros_like(rg))
+            rge = _embed2(rg)
+            for _ in range(n_bottom):
+                e = bottom_vcycle(e, rge)
+            joff = get_offsets("j", c["jl"])
+            ioff = get_offsets("i", c["il"])
+            e_own = _lax.dynamic_slice(
+                e[1:-1, 1:-1], (joff, ioff), (c["jl"], c["il"])
+            )
+            p = p.at[1:-1, 1:-1].add(e_own)
+        else:
+            r2 = _restrict2(r)
+            e2 = vcycle(_embed2(jnp.zeros_like(r2)), _embed2(r2), lvl + 1)
+            p = p.at[1:-1, 1:-1].add(_prolong2(e2[1:-1, 1:-1]))
+        from ..parallel.stencil2d import neumann_masked
+
+        p = neumann_masked(p, masks_at(lvl))
+        return smooth(p, rhs, lvl, n_post)
+
+    idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
+    norm = float(imax * jmax)
+    epssq = eps * eps
+
+    def solve(p, rhs):
+        def cond(c):
+            _, res, it = c
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(c):
+            p, _, it = c
+            p = vcycle(p, rhs)
+            p = halo_exchange(p, comm)
+            r = _residual2(p, rhs, idx2, idy2)
+            res = reduction(jnp.sum(r * r), comm, "sum") / norm
+            return p, res, it + 1
+
+        p, res, it = lax.while_loop(
+            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        )
+        # the body returns p freshly exchanged; this trailing exchange only
+        # matters on the zero-trip path (eps >= 1 skips the loop) and costs
+        # one ppermute round per SOLVE, not per cycle
+        return halo_exchange(p, comm), res, it
+
+    return solve
+
+
+def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
+                          eps, itermax, dtype, n_pre: int = 2,
+                          n_post: int = 2, n_bottom: int = 2):
+    """3-D twin of make_dist_mg_solve_2d."""
+    from jax import lax as _lax
+
+    from ..parallel.comm import get_offsets, halo_exchange, reduction
+    from ..parallel.stencil3d import (
+        ca_masks_3d,
+        neumann_masked_3d,
+        rb_exchange_per_sweep_3d,
+    )
+
+    Pk = comm.axis_size("k")
+    Pj = comm.axis_size("j")
+    Pi = comm.axis_size("i")
+    levels = mg_levels(kl, jl, il)
+    cfg = []
+    for lvl, (kll, jll, ill) in enumerate(levels):
+        dxl, dyl, dzl = dx * (2 ** lvl), dy * (2 ** lvl), dz * (2 ** lvl)
+        dx2, dy2, dz2 = dxl * dxl, dyl * dyl, dzl * dzl
+        cfg.append(
+            dict(
+                kl=kll, jl=jll, il=ill,
+                kmax=kll * Pk, jmax=jll * Pj, imax=ill * Pi,
+                idx2=1.0 / dx2, idy2=1.0 / dy2, idz2=1.0 / dz2,
+                factor=0.5 * (dx2 * dy2 * dz2)
+                / (dy2 * dz2 + dx2 * dz2 + dx2 * dy2),
+            )
+        )
+    bl = cfg[-1]
+    lvl0 = len(levels) - 1
+    bottom_vcycle = make_mg_vcycle_3d(
+        bl["imax"], bl["jmax"], bl["kmax"],
+        dx * (2 ** lvl0), dy * (2 ** lvl0), dz * (2 ** lvl0), dtype,
+    )
+
+    def masks_at(lvl):
+        c = cfg[lvl]
+        return ca_masks_3d(c["kl"], c["jl"], c["il"], 1,
+                           c["kmax"], c["jmax"], c["imax"], dtype)
+
+    def smooth(p, rhs, lvl, n):
+        c = cfg[lvl]
+        m = masks_at(lvl)
+        for _ in range(n):
+            p, _ = rb_exchange_per_sweep_3d(
+                p, rhs, m, comm, c["factor"],
+                c["idx2"], c["idy2"], c["idz2"],
+            )
+        return p
+
+    def vcycle(p, rhs, lvl=0):
+        c = cfg[lvl]
+        p = smooth(p, rhs, lvl, n_pre)
+        p = halo_exchange(p, comm)
+        r = _residual3(p, rhs, c["idx2"], c["idy2"], c["idz2"])
+        if lvl == len(levels) - 1:
+            rg = _lax.all_gather(r, "k", axis=0, tiled=True)
+            rg = _lax.all_gather(rg, "j", axis=1, tiled=True)
+            rg = _lax.all_gather(rg, "i", axis=2, tiled=True)
+            e = _embed3(jnp.zeros_like(rg))
+            rge = _embed3(rg)
+            for _ in range(n_bottom):
+                e = bottom_vcycle(e, rge)
+            koff = get_offsets("k", c["kl"])
+            joff = get_offsets("j", c["jl"])
+            ioff = get_offsets("i", c["il"])
+            e_own = _lax.dynamic_slice(
+                e[1:-1, 1:-1, 1:-1], (koff, joff, ioff),
+                (c["kl"], c["jl"], c["il"]),
+            )
+            p = p.at[1:-1, 1:-1, 1:-1].add(e_own)
+        else:
+            r2 = _restrict3(r)
+            e2 = vcycle(_embed3(jnp.zeros_like(r2)), _embed3(r2), lvl + 1)
+            p = p.at[1:-1, 1:-1, 1:-1].add(_prolong3(e2[1:-1, 1:-1, 1:-1]))
+        p = neumann_masked_3d(p, masks_at(lvl))
+        return smooth(p, rhs, lvl, n_post)
+
+    idx2 = 1.0 / (dx * dx)
+    idy2 = 1.0 / (dy * dy)
+    idz2 = 1.0 / (dz * dz)
+    norm = float(imax * jmax * kmax)
+    epssq = eps * eps
+
+    def solve(p, rhs):
+        def cond(c):
+            _, res, it = c
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(c):
+            p, _, it = c
+            p = vcycle(p, rhs)
+            p = halo_exchange(p, comm)
+            r = _residual3(p, rhs, idx2, idy2, idz2)
+            res = reduction(jnp.sum(r * r), comm, "sum") / norm
+            return p, res, it + 1
+
+        p, res, it = lax.while_loop(
+            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        )
+        # zero-trip safety; see the 2-D twin
+        return halo_exchange(p, comm), res, it
+
+    return solve

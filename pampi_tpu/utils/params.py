@@ -8,7 +8,7 @@ so a token `imaxFoo` still sets `imax` — we keep that tolerance), unknown keys
 are silently ignored, and every known key has a default.
 
 The parameter set is the union of all assignments:
-  A4  {xlength ylength imax jmax itermax eps omg levels presmooth postsmooth}
+  A4  {xlength ylength imax jmax itermax eps omg}
   A5 += {re tau gamma dt te gx gy name bcLeft/Right/Bottom/Top u_init v_init p_init}
   A6 += {zlength kmax gz bcFront bcBack w_init}
 plus framework-only keys (prefixed `tpu_`) controlling the TPU execution:
@@ -38,7 +38,7 @@ class Parameter:
     itermax: int = 1000
     eps: float = 0.0001
     omg: float = 1.7
-    rho: float = 0.99  # multigrid/extension reserve (unused by reference solvers)
+    rho: float = 0.99  # framework-reserve key (not in the reference schema)
     # flow
     re: float = 100.0
     tau: float = 0.5
@@ -82,7 +82,8 @@ class Parameter:
     # trajectory parity) or "mg" (geometric multigrid V-cycles,
     # ops/multigrid.py — converges in O(1) cycles instead of O(N^1.17)
     # sweeps; same eps-residual stopping contract, `it` counts cycles;
-    # single-device, no obstacles)
+    # works single-device and on a mesh [distributed smoothing + replicated
+    # bottom solve]; no obstacle flag fields)
     tpu_solver: str = "sor"
     # 3-D VTK output mode: "ascii" (reference default), "binary", or
     # "sharded" — the MPI-IO-pattern parallel write (utils/vtkio.py

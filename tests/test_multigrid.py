@@ -163,3 +163,83 @@ def test_mg_obstacles_rejected():
     )
     with pytest.raises(ValueError, match="obstacle"):
         NS2DSolver(param)
+
+
+# ---------------------------------------------------------------------
+# distributed multigrid
+# ---------------------------------------------------------------------
+
+
+def test_dist_mg_poisson_matches_single_device_mg():
+    """Distributed MG must converge to the single-device MG answer (same
+    algorithm: distributed smoothing + replicated bottom) on any mesh."""
+    from pampi_tpu.models.poisson import PoissonSolver
+    from pampi_tpu.models.poisson_dist import DistPoissonSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = Parameter(imax=64, jmax=64, itermax=100, eps=1e-10, omg=1.8,
+                      tpu_solver="mg")
+    single = PoissonSolver(param, problem=2)
+    it_s, res_s = single.solve()
+    assert it_s < 30
+    for dims in [(2, 4), (8, 1)]:
+        dist = DistPoissonSolver(param, CartComm(ndims=2, dims=dims),
+                                 problem=2)
+        it_d, res_d = dist.solve()
+        assert res_d < param.eps**2
+        assert abs(it_d - it_s) <= 3, (dims, it_d, it_s)
+        a = dist.full_field()[1:-1, 1:-1]
+        b = np.asarray(single.p)[1:-1, 1:-1]
+        diff = (a - a.mean()) - (b - b.mean())
+        assert np.sqrt((diff**2).mean()) < 1e-8, dims
+
+
+def test_dist_mg_ns3d_matches_sor_physics():
+    """NS-3D on a 3-D mesh with tpu_solver=mg: same converged physics as the
+    distributed SOR run (both solves reach the same eps)."""
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = Parameter(
+        name="dcavity3d", imax=16, jmax=16, kmax=16,
+        re=10.0, te=0.05, tau=0.5, itermax=500, eps=1e-6, omg=1.7,
+        gamma=0.9,
+    )
+    a = NS3DDistSolver(param, CartComm(ndims=3, dims=(2, 2, 2)))
+    a.run(progress=False)
+    b = NS3DDistSolver(param.replace(tpu_solver="mg"),
+                       CartComm(ndims=3, dims=(2, 2, 2)))
+    b.run(progress=False)
+    assert a.nt == b.nt
+    ua, va, wa, pa = a.collect()
+    ub, vb, wb, pb = b.collect()
+    np.testing.assert_allclose(ua, ub, rtol=0, atol=1e-4)
+    np.testing.assert_allclose(va, vb, rtol=0, atol=1e-4)
+    np.testing.assert_allclose(wa, wb, rtol=0, atol=1e-4)
+    # all-Neumann pressure is defined up to a constant; only ∇p is physical
+    np.testing.assert_allclose(pa - pa.mean(), pb - pb.mean(),
+                               rtol=0, atol=1e-4)
+
+
+def test_dist_mg_ns2d_matches_single_mg(reference_dir):
+    """NS-2D distributed mg vs single-device mg: both converge each solve to
+    eps; fields agree to solver tolerance on a 2-D mesh."""
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = read_parameter(
+        str(reference_dir / "assignment-5" / "sequential" / "dcavity.par")
+    ).replace(te=0.05, imax=32, jmax=32, eps=1e-6, tpu_solver="mg")
+    a = NS2DSolver(param)
+    a.run(progress=False)
+    b = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 4)))
+    b.run(progress=False)
+    ud, vd, pd = b.fields()
+    assert a.nt == b.nt
+    np.testing.assert_allclose(np.asarray(a.u), ud, rtol=0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(a.v), vd, rtol=0, atol=1e-4)
+    pa = np.asarray(a.p)[1:-1, 1:-1]
+    pi = pd[1:-1, 1:-1]
+    np.testing.assert_allclose(pa - pa.mean(), pi - pi.mean(),
+                               rtol=0, atol=1e-4)
