@@ -90,8 +90,8 @@ def _run(argv) -> int:
 def _dispatch(param, prof) -> int:
     from .utils.timing import get_timestamp
 
-    if param.tpu_solver not in ("sor", "mg"):
-        print(f"Error: tpu_solver must be sor|mg, got {param.tpu_solver!r}",
+    if param.tpu_solver not in ("sor", "mg", "fft"):
+        print(f"Error: tpu_solver must be sor|mg|fft, got {param.tpu_solver!r}",
               file=sys.stderr)
         return 1
 

@@ -44,11 +44,17 @@ def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
     dispatches to the fused Pallas kernel on TPU (f32/bf16), converting to
     the padded layout once per pressure solve, not per sweep.
     solver="mg": geometric multigrid V-cycles (ops/multigrid.py), same
-    stopping contract, `it` counts cycles."""
+    stopping contract, `it` counts cycles.
+    solver="fft": direct DCT-diagonalization solve (ops/dctpoisson.py) —
+    exact in one application, `it` reports 1."""
     if solver == "mg":
         from ..ops.multigrid import make_mg_solve_2d
 
         return make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype)
+    if solver == "fft":
+        from ..ops.dctpoisson import make_dct_solve_2d
+
+        return make_dct_solve_2d(imax, jmax, dx, dy, dtype)
     from .poisson import make_solver_fn
 
     return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
@@ -80,10 +86,10 @@ class NS2DSolver:
         # flag-field obstacles (ops/obstacle.py): static geometry -> static
         # masks baked into the traced step as constants (branch-free)
         if param.obstacles.strip():
-            if param.tpu_solver == "mg":
+            if param.tpu_solver in ("mg", "fft"):
                 raise ValueError(
-                    "tpu_solver mg does not support obstacle flag fields; "
-                    "use tpu_solver sor"
+                    f"tpu_solver {param.tpu_solver} does not support "
+                    "obstacle flag fields; use tpu_solver sor"
                 )
             from ..ops import obstacle as obst
 
@@ -98,9 +104,9 @@ class NS2DSolver:
     def _uses_pallas(self) -> bool:
         """Whether the current chunk's pressure solve dispatches to pallas
         (both the uniform and the flag-masked solver go through the same
-        backend probe; jnp-dispatched dtypes/backends never do; the mg
-        solver contains no pallas kernel at all)."""
-        if self.param.tpu_solver == "mg":
+        backend probe; jnp-dispatched dtypes/backends never do; the mg and
+        fft solvers contain no pallas kernel at all)."""
+        if self.param.tpu_solver in ("mg", "fft"):
             return False
         from .poisson import _use_pallas
 

@@ -218,6 +218,11 @@ class NS2DDistSolver:
             )
             return halo_exchange(strip_deep(pd, H), comm), res, it
 
+        if param.tpu_solver == "fft":
+            raise ValueError(
+                "tpu_solver fft is single-device only; use mg or sor on a "
+                "mesh (or tpu_mesh 1)"
+            )
         if param.tpu_solver == "mg":
             from ..ops.multigrid import make_dist_mg_solve_2d
 

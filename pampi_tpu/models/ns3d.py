@@ -124,6 +124,10 @@ def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
 
         return make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
                                 dtype)
+    if solver == "fft":
+        from ..ops.dctpoisson import make_dct_solve_3d
+
+        return make_dct_solve_3d(imax, jmax, kmax, dx, dy, dz, dtype)
     norm = float(imax * jmax * kmax)
     epssq = eps * eps
 
@@ -222,8 +226,8 @@ class NS3DSolver:
         self._chunk_fn = jax.jit(self._build_chunk())
 
     def _uses_pallas(self) -> bool:
-        if self.param.tpu_solver == "mg":
-            return False  # the mg chunk contains no pallas kernel
+        if self.param.tpu_solver in ("mg", "fft"):
+            return False  # mg/fft chunks contain no pallas kernel
         return _use_pallas_3d(self._backend, self.dtype)
 
     def _build_step(self, backend: str = "auto"):

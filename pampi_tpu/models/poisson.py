@@ -240,6 +240,12 @@ class PoissonSolver:
                 self.imax, self.jmax, self.dx, self.dy,
                 self.param.eps, self.param.itermax, self.dtype,
             )
+        if self.param.tpu_solver == "fft":
+            from ..ops.dctpoisson import make_dct_solve_2d
+
+            return make_dct_solve_2d(
+                self.imax, self.jmax, self.dx, self.dy, self.dtype
+            )
         return make_solver_fn(
             self.imax,
             self.jmax,
@@ -260,7 +266,7 @@ class PoissonSolver:
             # runtime fault surfaces here, not at the caller's readback
             out = int(it), float(res)
         except Exception:
-            if self._backend == "jnp" or self.param.tpu_solver == "mg":
+            if self._backend == "jnp" or self.param.tpu_solver in ("mg", "fft"):
                 raise  # no pallas in play — genuine error, don't re-run it
             # shape-specific pallas failure the dispatcher probe missed:
             # fall back to the always-available jnp path (same arithmetic)

@@ -100,6 +100,11 @@ class DistPoissonSolver:
         # one depth-H exchange per n exact red-black iterations; extent-1
         # shards fall back to the classic exchange-per-half-sweep form; the
         # mg solver works on the plain halo-1 layout
+        if param.tpu_solver == "fft":
+            raise ValueError(
+                "tpu_solver fft is single-device only; use mg or sor on a "
+                "mesh (or tpu_mesh 1)"
+            )
         use_mg = param.tpu_solver == "mg"
         supported = ca_supported(jl, il) and not use_mg
         n_ca = ca_inner(param, jl, il) if supported else 1

@@ -65,29 +65,26 @@ def _residual2(p, rhs, idx2, idy2):
     return _interior_residual(p, rhs, idx2, idy2)
 
 
-# smoothing passes above this count run as a lax.fori_loop instead of a
-# trace-time unroll (the coarse solve on a large odd bottom grid would
-# otherwise explode the compiled graph)
-_UNROLL_MAX = 8
+# Smoothing is ALWAYS unrolled at trace time (n is a small static count).
+# A lax.fori_loop variant for large coarse-solve iteration counts was tried
+# and caused hard TPU device faults (UNAVAILABLE kernel-fault class) when
+# nested inside the solve while_loop inside the NS chunk while_loop — a
+# pure-XLA program, reproducible at CHUNK >= 8, gone with the unrolled
+# form. The coarse level needs no iteration at all now: it is solved
+# exactly by DCT diagonalization (ops/dctpoisson.py).
 
 
 def _smooth2(p, rhs, masks, factor, idx2, idy2, n):
     """n red-black Gauss-Seidel iterations (sor_pass arithmetic, ω baked
     into factor) + Neumann refresh each."""
     red, black = masks
-
-    def one(p):
+    for _ in range(n):
         r = _residual2(p, rhs, idx2, idy2) * red
         p = p.at[1:-1, 1:-1].add(-factor * r)
         r = _residual2(p, rhs, idx2, idy2) * black
         p = p.at[1:-1, 1:-1].add(-factor * r)
-        return _neumann2(p)
-
-    if n <= _UNROLL_MAX:
-        for _ in range(n):
-            p = one(p)
-        return p
-    return lax.fori_loop(0, n, lambda _, p: one(p), p)
+        p = _neumann2(p)
+    return p
 
 
 def _restrict2(r):
@@ -107,40 +104,30 @@ def _embed2(interior):
     return jnp.zeros((J + 2, I + 2), interior.dtype).at[1:-1, 1:-1].set(interior)
 
 
-def _coarse_iters(*extents) -> int:
-    """Coarse-level solve effort: the hierarchy may bottom out on a grid
-    that is far from trivial (odd extents stop coarsening — e.g. 100² stops
-    at 25²), so scale the red-black SOR iteration count with the coarse
-    extent, capped so a pathological bottom grid (large odd extents) costs
-    bounded work per cycle — an inexact coarse solve just means a few more
-    outer cycles. Runs as a fori_loop when large (see _UNROLL_MAX)."""
-    return min(max(8, 4 * max(extents)), 256)
-
-
 def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
-                      n_pre: int = 2, n_post: int = 2,
-                      n_coarse: int | None = None):
+                      n_pre: int = 2, n_post: int = 2):
     """Build `vcycle(p_ext, rhs_ext) -> p_ext` on the fine extended grid.
-    Level geometry doubles the spacing each coarsening (cell-centered)."""
+    Level geometry doubles the spacing each coarsening (cell-centered).
+    The coarsest level is solved EXACTLY by DCT diagonalization
+    (ops/dctpoisson.py, MXU matmuls) — no unrolled coarse smoothing, and an
+    odd-extent bottom grid (e.g. 100² stops at 25²) costs the same handful
+    of matmuls as a tiny one."""
+    from .dctpoisson import poisson_dct_2d
     from .sor import checkerboard_mask
 
     levels = mg_levels(jmax, imax)
-    if n_coarse is None:
-        n_coarse = _coarse_iters(*levels[-1])
     cfg = []
     for lvl, (jl, il) in enumerate(levels):
         dxl, dyl = dx * (2 ** lvl), dy * (2 ** lvl)
         dx2, dy2 = dxl * dxl, dyl * dyl
-        coarsest = lvl == len(levels) - 1
-        # smoother ω=1 (red-black Gauss-Seidel); the coarsest level is a
-        # SOLVE, not a smoothing pass — over-relax it like the reference's
-        # production SOR so a non-trivial bottom grid converges
-        om = 1.8 if coarsest else 1.0
         cfg.append(
             dict(
+                dx=dxl,
+                dy=dyl,
                 idx2=1.0 / dx2,
                 idy2=1.0 / dy2,
-                factor=om * 0.5 * (dx2 * dy2) / (dx2 + dy2),
+                # ω=1 Gauss-Seidel smoothing factor
+                factor=0.5 * (dx2 * dy2) / (dx2 + dy2),
                 masks=(
                     checkerboard_mask(jl, il, 0, dtype),
                     checkerboard_mask(jl, il, 1, dtype),
@@ -151,8 +138,11 @@ def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
         if lvl == len(cfg) - 1:
-            return _smooth2(p, rhs, c["masks"], c["factor"],
-                            c["idx2"], c["idy2"], n_coarse)
+            # exact bottom solve; the incoming iterate is irrelevant (for
+            # error equations it is zeros; for a single-level hierarchy the
+            # direct solution simply replaces it, constants aside)
+            sol = poisson_dct_2d(rhs[1:-1, 1:-1], c["dx"], c["dy"])
+            return _neumann2(jnp.zeros_like(p).at[1:-1, 1:-1].set(sol))
         p = _smooth2(p, rhs, c["masks"], c["factor"],
                      c["idx2"], c["idy2"], n_pre)
         r = _residual2(p, rhs, c["idx2"], c["idy2"])
@@ -207,22 +197,17 @@ def _residual3(p, rhs, idx2, idy2, idz2):
 
 
 def _smooth3(p, rhs, masks, factor, idx2, idy2, idz2, n):
+    # always unrolled — see the fori_loop TPU-fault note above _smooth2
     from ..models.ns3d import neumann_faces_3d
 
     odd, even = masks
-
-    def one(p):
+    for _ in range(n):
         r = _residual3(p, rhs, idx2, idy2, idz2) * odd
         p = p.at[1:-1, 1:-1, 1:-1].add(-factor * r)
         r = _residual3(p, rhs, idx2, idy2, idz2) * even
         p = p.at[1:-1, 1:-1, 1:-1].add(-factor * r)
-        return neumann_faces_3d(p)
-
-    if n <= _UNROLL_MAX:
-        for _ in range(n):
-            p = one(p)
-        return p
-    return lax.fori_loop(0, n, lambda _, p: one(p), p)
+        p = neumann_faces_3d(p)
+    return p
 
 
 def _restrict3(r):
@@ -241,25 +226,25 @@ def _embed3(interior):
 
 
 def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
-                      n_pre: int = 2, n_post: int = 2,
-                      n_coarse: int | None = None):
-    from ..models.ns3d import checkerboard_mask_3d
+                      n_pre: int = 2, n_post: int = 2):
+    """3-D twin of make_mg_vcycle_2d (exact DCT bottom solve)."""
+    from ..models.ns3d import checkerboard_mask_3d, neumann_faces_3d
+    from .dctpoisson import poisson_dct_3d
 
     levels = mg_levels(kmax, jmax, imax)
-    if n_coarse is None:
-        n_coarse = _coarse_iters(*levels[-1])
     cfg = []
     for lvl, (kl, jl, il) in enumerate(levels):
         dxl, dyl, dzl = dx * (2 ** lvl), dy * (2 ** lvl), dz * (2 ** lvl)
         dx2, dy2, dz2 = dxl * dxl, dyl * dyl, dzl * dzl
-        coarsest = lvl == len(levels) - 1
-        om = 1.8 if coarsest else 1.0
         cfg.append(
             dict(
+                dx=dxl,
+                dy=dyl,
+                dz=dzl,
                 idx2=1.0 / dx2,
                 idy2=1.0 / dy2,
                 idz2=1.0 / dz2,
-                factor=om * 0.5 * (dx2 * dy2 * dz2)
+                factor=0.5 * (dx2 * dy2 * dz2)
                 / (dy2 * dz2 + dx2 * dz2 + dx2 * dy2),
                 masks=(
                     checkerboard_mask_3d(kl, jl, il, 1, dtype),
@@ -272,14 +257,16 @@ def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
         c = cfg[lvl]
         args = (c["masks"], c["factor"], c["idx2"], c["idy2"], c["idz2"])
         if lvl == len(cfg) - 1:
-            return _smooth3(p, rhs, *args, n_coarse)
+            sol = poisson_dct_3d(rhs[1:-1, 1:-1, 1:-1],
+                                 c["dx"], c["dy"], c["dz"])
+            return neumann_faces_3d(
+                jnp.zeros_like(p).at[1:-1, 1:-1, 1:-1].set(sol)
+            )
         p = _smooth3(p, rhs, *args, n_pre)
         r = _residual3(p, rhs, c["idx2"], c["idy2"], c["idz2"])
         r2 = _restrict3(r)
         e2 = vcycle(_embed3(jnp.zeros_like(r2)), _embed3(r2), lvl + 1)
         p = p.at[1:-1, 1:-1, 1:-1].add(_prolong3(e2[1:-1, 1:-1, 1:-1]))
-        from ..models.ns3d import neumann_faces_3d
-
         p = neumann_faces_3d(p)
         return _smooth3(p, rhs, *args, n_post)
 
@@ -321,12 +308,13 @@ def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
 # ----------------------------------------------------------------------
 #
 # Level plan: coarsen DISTRIBUTED levels while every shard's local extents
-# stay even and >= _DIST_MIN (restriction/prolongation are then shard-local
+# stay even and >= 2*min_size (restriction/prolongation are then shard-local
 # reshapes); below that the coarse problem is small, so it is all_gather'd
-# and solved REDUNDANTLY on every shard with the single-device V-cycle —
-# the standard parallel-MG answer to the coarse-grid bottleneck (smoothing
-# a tiny grid through halo exchanges would need O(global extent) coupled
-# iterations; a replicated direct-ish solve needs none).
+# and solved REDUNDANTLY and EXACTLY on every shard by DCT diagonalization
+# (ops/dctpoisson.py) — the standard parallel-MG answer to the coarse-grid
+# bottleneck (smoothing a tiny grid through halo exchanges would need
+# O(global extent) coupled iterations; the replicated direct solve needs
+# none).
 #
 # Smoothing at distributed levels reuses the bitwise-parity half-sweep
 # choreography (stencil2d/3d rb_exchange_per_sweep with halo=1 masks), so
@@ -339,17 +327,17 @@ def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
 
 
 def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
-                          dtype, n_pre: int = 2, n_post: int = 2,
-                          n_bottom: int = 2):
+                          dtype, n_pre: int = 2, n_post: int = 2):
     """Distributed-MG convergence loop (shard_map kernel side): builds
     `(p_ext, rhs_ext) -> (p_ext, res, it)` on the halo-1 extended local
     block — the same contract as the distributed SOR solve; `it` counts
-    V-cycles. n_bottom = single-device V-cycles on the replicated coarse
-    problem per distributed cycle."""
+    V-cycles. The replicated coarse problem is solved EXACTLY by DCT
+    diagonalization on every shard (ops/dctpoisson.py)."""
     from jax import lax as _lax
 
     from ..parallel.comm import get_offsets, halo_exchange, reduction
     from ..parallel.stencil2d import ca_masks, rb_exchange_per_sweep
+    from .dctpoisson import poisson_dct_2d
 
     Pj = comm.axis_size("j")
     Pi = comm.axis_size("i")
@@ -362,16 +350,11 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
             dict(
                 jl=jll, il=ill,
                 jmax=jll * Pj, imax=ill * Pi,
+                dx=dxl, dy=dyl,
                 idx2=1.0 / dx2, idy2=1.0 / dy2,
                 factor=0.5 * (dx2 * dy2) / (dx2 + dy2),  # ω=1 smoother
             )
         )
-    # replicated bottom: the single-device V-cycle on the global coarse grid
-    bl = cfg[-1]
-    lvl0 = len(levels) - 1
-    bottom_vcycle = make_mg_vcycle_2d(
-        bl["imax"], bl["jmax"], dx * (2 ** lvl0), dy * (2 ** lvl0), dtype
-    )
 
     def masks_at(lvl):
         c = cfg[lvl]
@@ -392,19 +375,14 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
         p = halo_exchange(p, comm)  # residual reads shard-edge neighbours
         r = _residual2(p, rhs, c["idx2"], c["idy2"])
         if lvl == len(levels) - 1:
-            # replicated bottom solve: gather the DOWNSTREAM problem — here
-            # the residual of THIS level — and V-cycle it globally
+            # replicated bottom solve: gather this level's residual and
+            # solve it EXACTLY (DCT) on every shard, then slice own block
             rg = _lax.all_gather(r, "j", axis=0, tiled=True)
             rg = _lax.all_gather(rg, "i", axis=1, tiled=True)
-            e = _embed2(jnp.zeros_like(rg))
-            rge = _embed2(rg)
-            for _ in range(n_bottom):
-                e = bottom_vcycle(e, rge)
+            e = poisson_dct_2d(rg, c["dx"], c["dy"])
             joff = get_offsets("j", c["jl"])
             ioff = get_offsets("i", c["il"])
-            e_own = _lax.dynamic_slice(
-                e[1:-1, 1:-1], (joff, ioff), (c["jl"], c["il"])
-            )
+            e_own = _lax.dynamic_slice(e, (joff, ioff), (c["jl"], c["il"]))
             p = p.at[1:-1, 1:-1].add(e_own)
         else:
             r2 = _restrict2(r)
@@ -445,7 +423,7 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
 
 def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
                           eps, itermax, dtype, n_pre: int = 2,
-                          n_post: int = 2, n_bottom: int = 2):
+                          n_post: int = 2):
     """3-D twin of make_dist_mg_solve_2d."""
     from jax import lax as _lax
 
@@ -455,6 +433,8 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
         neumann_masked_3d,
         rb_exchange_per_sweep_3d,
     )
+
+    from .dctpoisson import poisson_dct_3d
 
     Pk = comm.axis_size("k")
     Pj = comm.axis_size("j")
@@ -468,17 +448,12 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
             dict(
                 kl=kll, jl=jll, il=ill,
                 kmax=kll * Pk, jmax=jll * Pj, imax=ill * Pi,
+                dx=dxl, dy=dyl, dz=dzl,
                 idx2=1.0 / dx2, idy2=1.0 / dy2, idz2=1.0 / dz2,
                 factor=0.5 * (dx2 * dy2 * dz2)
                 / (dy2 * dz2 + dx2 * dz2 + dx2 * dy2),
             )
         )
-    bl = cfg[-1]
-    lvl0 = len(levels) - 1
-    bottom_vcycle = make_mg_vcycle_3d(
-        bl["imax"], bl["jmax"], bl["kmax"],
-        dx * (2 ** lvl0), dy * (2 ** lvl0), dz * (2 ** lvl0), dtype,
-    )
 
     def masks_at(lvl):
         c = cfg[lvl]
@@ -504,16 +479,12 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
             rg = _lax.all_gather(r, "k", axis=0, tiled=True)
             rg = _lax.all_gather(rg, "j", axis=1, tiled=True)
             rg = _lax.all_gather(rg, "i", axis=2, tiled=True)
-            e = _embed3(jnp.zeros_like(rg))
-            rge = _embed3(rg)
-            for _ in range(n_bottom):
-                e = bottom_vcycle(e, rge)
+            e = poisson_dct_3d(rg, c["dx"], c["dy"], c["dz"])
             koff = get_offsets("k", c["kl"])
             joff = get_offsets("j", c["jl"])
             ioff = get_offsets("i", c["il"])
             e_own = _lax.dynamic_slice(
-                e[1:-1, 1:-1, 1:-1], (koff, joff, ioff),
-                (c["kl"], c["jl"], c["il"]),
+                e, (koff, joff, ioff), (c["kl"], c["jl"], c["il"])
             )
             p = p.at[1:-1, 1:-1, 1:-1].add(e_own)
         else:

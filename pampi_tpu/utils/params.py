@@ -78,12 +78,15 @@ class Parameter:
     # exceeds a shard extent; 1 keeps today's per-iteration trajectory
     # granularity while still halving the message count.
     tpu_ca_inner: int = 1
-    # pressure/elliptic solver: "sor" (the reference's algorithm; default,
-    # trajectory parity) or "mg" (geometric multigrid V-cycles,
-    # ops/multigrid.py — converges in O(1) cycles instead of O(N^1.17)
-    # sweeps; same eps-residual stopping contract, `it` counts cycles;
-    # works single-device and on a mesh [distributed smoothing + replicated
-    # bottom solve]; no obstacle flag fields)
+    # pressure/elliptic solver:
+    #   "sor"  the reference's algorithm (default; trajectory parity)
+    #   "mg"   geometric multigrid V-cycles with an exact DCT bottom solve
+    #          (ops/multigrid.py) — O(1) cycles; same eps-residual stopping
+    #          contract, `it` counts cycles; single-device or on a mesh
+    #   "fft"  direct DCT-diagonalization solve (ops/dctpoisson.py, MXU
+    #          matmuls) — exact in ONE application, `it` reports 1;
+    #          single-device only
+    # mg/fft do not support obstacle flag fields
     tpu_solver: str = "sor"
     # 3-D VTK output mode: "ascii" (reference default), "binary", or
     # "sharded" — the MPI-IO-pattern parallel write (utils/vtkio.py

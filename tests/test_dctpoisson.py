@@ -1,0 +1,122 @@
+"""Direct DCT-diagonalization Poisson solve (ops/dctpoisson.py,
+tpu_solver=fft): machine-precision exactness of the discrete solve, the
+solve-contract wrapper, and NS physics parity with the iterative solvers."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pampi_tpu.ops.dctpoisson import (
+    dct2_matrix,
+    make_dct_solve_2d,
+    make_dct_solve_3d,
+    poisson_dct_2d,
+    poisson_dct_3d,
+)
+from pampi_tpu.utils.params import Parameter, read_parameter
+
+DT = jnp.float64
+
+
+def test_dct_matrix_orthonormal():
+    for N in (4, 25, 37):
+        D = dct2_matrix(N)
+        np.testing.assert_allclose(D @ D.T, np.eye(N), atol=1e-12)
+
+
+@pytest.mark.parametrize("shape", [(37, 52), (100, 100), (25, 100)])
+def test_dct2d_solves_exactly(shape):
+    J, I = shape
+    dx, dy = 1.0 / I, 1.0 / J
+    rng = np.random.default_rng(0)
+    r = rng.standard_normal((J, I))
+    r -= r.mean()
+    sol = make_dct_solve_2d(I, J, dx, dy, DT)
+    rhs = jnp.zeros((J + 2, I + 2), DT).at[1:-1, 1:-1].set(jnp.asarray(r, DT))
+    p, res, it = jax.jit(sol)(jnp.zeros_like(rhs), rhs)
+    assert int(it) == 1
+    assert float(res) < 1e-20  # machine-precision residual in f64
+
+
+def test_dct3d_solves_exactly():
+    K, J, I = 25, 25, 100  # the canal3d coarse shape
+    dx, dy, dz = 1.0 / I, 1.0 / J, 1.0 / K
+    rng = np.random.default_rng(1)
+    r = rng.standard_normal((K, J, I))
+    r -= r.mean()
+    sol = make_dct_solve_3d(I, J, K, dx, dy, dz, DT)
+    rhs = jnp.zeros((K + 2, J + 2, I + 2), DT)
+    rhs = rhs.at[1:-1, 1:-1, 1:-1].set(jnp.asarray(r, DT))
+    p, res, it = jax.jit(sol)(jnp.zeros_like(rhs), rhs)
+    assert int(it) == 1
+    assert float(res) < 1e-20
+
+
+def test_dct_matches_sor_solution():
+    from pampi_tpu.models.poisson import make_solver_fn
+
+    J = I = 48
+    dx = dy = 1.0 / I
+    rng = np.random.default_rng(2)
+    r = rng.standard_normal((J, I))
+    r -= r.mean()
+    rhs = jnp.zeros((J + 2, I + 2), DT).at[1:-1, 1:-1].set(jnp.asarray(r, DT))
+    p0 = jnp.zeros_like(rhs)
+    p_d, _, _ = jax.jit(make_dct_solve_2d(I, J, dx, dy, DT))(p0, rhs)
+    sor = jax.jit(make_solver_fn(I, J, dx, dy, 1.9, 1e-9, 100000, DT,
+                                 backend="jnp"))
+    p_s, _, _ = sor(p0, rhs)
+    a = np.asarray(p_d)[1:-1, 1:-1]
+    b = np.asarray(p_s)[1:-1, 1:-1]
+    diff = (a - a.mean()) - (b - b.mean())
+    assert np.sqrt((diff**2).mean()) < 1e-8
+
+
+def test_ns2d_fft_matches_sor_run(reference_dir):
+    from pampi_tpu.models.ns2d import NS2DSolver
+
+    param = read_parameter(
+        str(reference_dir / "assignment-5" / "sequential" / "dcavity.par")
+    ).replace(te=0.05, imax=32, jmax=32, eps=1e-8)
+    a = NS2DSolver(param)
+    a.run(progress=False)
+    b = NS2DSolver(param.replace(tpu_solver="fft"))
+    b.run(progress=False)
+    assert a.nt == b.nt
+    np.testing.assert_allclose(np.asarray(a.u), np.asarray(b.u),
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a.v), np.asarray(b.v),
+                               rtol=0, atol=1e-6)
+
+
+def test_ns3d_fft_matches_sor_run():
+    from pampi_tpu.models.ns3d import NS3DSolver
+
+    param = Parameter(
+        name="dcavity3d", imax=16, jmax=16, kmax=16,
+        re=10.0, te=0.05, tau=0.5, itermax=1000, eps=1e-8, omg=1.7,
+        gamma=0.9,
+    )
+    a = NS3DSolver(param)
+    a.run(progress=False)
+    b = NS3DSolver(param.replace(tpu_solver="fft"))
+    b.run(progress=False)
+    assert a.nt == b.nt
+    np.testing.assert_allclose(np.asarray(a.u), np.asarray(b.u),
+                               rtol=0, atol=1e-6)
+
+
+def test_fft_rejected_on_mesh():
+    from pampi_tpu.models.poisson_dist import DistPoissonSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = Parameter(imax=16, jmax=16, tpu_solver="fft")
+    with pytest.raises(ValueError, match="single-device"):
+        DistPoissonSolver(param, CartComm(ndims=2), problem=2)
+
+
+def test_fft_rejects_bfloat16():
+    with pytest.raises(ValueError, match="bfloat16|float32"):
+        make_dct_solve_2d(16, 16, 1 / 16, 1 / 16, jnp.bfloat16)
