@@ -219,11 +219,12 @@ class NS2DDistSolver:
             return halo_exchange(strip_deep(pd, H), comm), res, it
 
         if param.tpu_solver == "fft":
-            raise ValueError(
-                "tpu_solver fft is single-device only; use mg or sor on a "
-                "mesh (or tpu_mesh 1)"
+            from ..ops.dctpoisson import make_dist_dct_solve_2d
+
+            solve = make_dist_dct_solve_2d(
+                comm, self.imax, self.jmax, jl, il, dx, dy, dtype
             )
-        if param.tpu_solver == "mg":
+        elif param.tpu_solver == "mg":
             from ..ops.multigrid import make_dist_mg_solve_2d
 
             solve = make_dist_mg_solve_2d(

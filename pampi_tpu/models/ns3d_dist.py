@@ -202,11 +202,12 @@ class NS3DDistSolver:
             return halo_exchange(strip_deep(pd, H), comm), res, it
 
         if param.tpu_solver == "fft":
-            raise ValueError(
-                "tpu_solver fft is single-device only; use mg or sor on a "
-                "mesh (or tpu_mesh 1)"
+            from ..ops.dctpoisson import make_dist_dct_solve_3d
+
+            solve = make_dist_dct_solve_3d(
+                comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz, dtype
             )
-        if param.tpu_solver == "mg":
+        elif param.tpu_solver == "mg":
             from ..ops.multigrid import make_dist_mg_solve_3d
 
             solve = make_dist_mg_solve_3d(

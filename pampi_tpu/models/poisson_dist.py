@@ -99,22 +99,23 @@ class DistPoissonSolver:
         # the solve carries a (jl+2H, il+2H) deep-halo extended block and pays
         # one depth-H exchange per n exact red-black iterations; extent-1
         # shards fall back to the classic exchange-per-half-sweep form; the
-        # mg solver works on the plain halo-1 layout
-        if param.tpu_solver == "fft":
-            raise ValueError(
-                "tpu_solver fft is single-device only; use mg or sor on a "
-                "mesh (or tpu_mesh 1)"
-            )
-        use_mg = param.tpu_solver == "mg"
-        supported = ca_supported(jl, il) and not use_mg
+        # direct solvers (mg, fft) work on the plain halo-1 layout
+        use_direct = param.tpu_solver in ("mg", "fft")
+        supported = ca_supported(jl, il) and not use_direct
         n_ca = ca_inner(param, jl, il) if supported else 1
         H = ca_halo(n_ca) if supported else 1
-        if use_mg:
+        if param.tpu_solver == "mg":
             from ..ops.multigrid import make_dist_mg_solve_2d
 
-            mg_solve = make_dist_mg_solve_2d(
+            direct_solve = make_dist_mg_solve_2d(
                 comm, self.imax, self.jmax, jl, il, dx, dy,
                 param.eps, itermax, dtype,
+            )
+        elif param.tpu_solver == "fft":
+            from ..ops.dctpoisson import make_dist_dct_solve_2d
+
+            direct_solve = make_dist_dct_solve_2d(
+                comm, self.imax, self.jmax, jl, il, dx, dy, dtype
             )
 
         def offsets():
@@ -164,8 +165,8 @@ class DistPoissonSolver:
                 p = neumann_masked(p, m)
             rhs = rhs_deep()
 
-            if use_mg:  # H == 1: plain extended blocks
-                p, res, it = mg_solve(p, rhs)
+            if use_direct:  # H == 1: plain extended blocks
+                p, res, it = direct_solve(p, rhs)
                 return p[1:-1, 1:-1], res, it
 
             def cond(carry):

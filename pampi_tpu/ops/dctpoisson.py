@@ -155,3 +155,132 @@ def make_dct_solve_3d(imax, jmax, kmax, dx, dy, dz, dtype):
         return pn, jnp.sum(r * r) / norm, jnp.asarray(1, jnp.int32)
 
     return solve
+
+
+# ----------------------------------------------------------------------
+# Distributed direct solve (call INSIDE shard_map): the DCT along a sharded
+# axis is a COLLECTIVE MATMUL — each shard contracts its slice of the
+# orthogonal matrix with its local block (a full-length partial sum), and a
+# psum_scatter along the mesh axis both reduces the partials and hands every
+# shard exactly its block of the transformed array. Two collectives per
+# transform, O(N²/P) MXU work per shard — the canonical TPU sharded-matmul
+# pattern applied to a fast Poisson solver.
+# ----------------------------------------------------------------------
+
+
+def _dist_apply(mat, x, axis: int, axis_name: str, nper: int):
+    """Contract the (N, N) constant `mat` along `x`'s (possibly sharded)
+    array axis. nper == 1 falls back to the local matmul."""
+    from jax import lax
+
+    if nper == 1:
+        return _apply(mat, x, axis)
+    n_loc = x.shape[axis]
+    c = lax.axis_index(axis_name)
+    cols = lax.dynamic_slice_in_dim(mat, c * n_loc, n_loc, axis=1)
+    partial = jnp.moveaxis(
+        jnp.tensordot(cols, x, axes=[[1], [axis]]), 0, axis
+    )
+    return lax.psum_scatter(
+        partial, axis_name, scatter_dimension=axis, tiled=True
+    )
+
+
+def _own_eigs(eigs_np, n_loc: int, axis_name: str, nper: int, dtype):
+    from jax import lax
+
+    e = jnp.asarray(eigs_np, dtype)
+    if nper == 1:
+        return e
+    c = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(e, c * n_loc, n_loc, axis=0)
+
+
+def make_dist_dct_solve_2d(comm, imax, jmax, jl, il, dx, dy, dtype):
+    """Distributed fft solve (shard_map kernel side): same contract as the
+    distributed iterative solves — `(p_ext, rhs_ext) -> (p_ext, res, it)` on
+    halo-1 extended local blocks; it = 1."""
+    from ..parallel.comm import halo_exchange, reduction
+    from ..parallel.stencil2d import ca_masks, neumann_masked
+    from .sor import _interior_residual
+
+    _check_direct_dtype(dtype)
+    Pj = comm.axis_size("j")
+    Pi = comm.axis_size("i")
+    Dj = jnp.asarray(dct2_matrix(jmax), dtype)
+    Di = jnp.asarray(dct2_matrix(imax), dtype)
+    lj = neumann_eigenvalues(jmax, dy)
+    li = neumann_eigenvalues(imax, dx)
+    idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
+    norm = float(imax * jmax)
+
+    def solve(p, rhs):
+        del p
+        r = rhs[1:-1, 1:-1]
+        h = _dist_apply(Dj, r, 0, "j", Pj)
+        h = _dist_apply(Di, h, 1, "i", Pi)
+        denom = (
+            _own_eigs(lj, jl, "j", Pj, dtype)[:, None]
+            + _own_eigs(li, il, "i", Pi, dtype)[None, :]
+        )
+        ph = jnp.where(denom != 0, h / jnp.where(denom != 0, denom, 1.0), 0.0)
+        sol = _dist_apply(Dj.T, ph, 0, "j", Pj)
+        sol = _dist_apply(Di.T, sol, 1, "i", Pi)
+        pn = jnp.zeros((jl + 2, il + 2), dtype).at[1:-1, 1:-1].set(sol)
+        pn = halo_exchange(pn, comm)
+        pn = neumann_masked(pn, ca_masks(jl, il, 1, jmax, imax, dtype))
+        rr = _interior_residual(pn, rhs, idx2, idy2)
+        res = reduction(jnp.sum(rr * rr), comm, "sum") / norm
+        return pn, res, jnp.asarray(1, jnp.int32)
+
+    return solve
+
+
+def make_dist_dct_solve_3d(comm, imax, jmax, kmax, kl, jl, il,
+                           dx, dy, dz, dtype):
+    """3-D twin of make_dist_dct_solve_2d."""
+    from ..models.ns3d import interior_residual_3d
+    from ..parallel.comm import halo_exchange, reduction
+    from ..parallel.stencil3d import ca_masks_3d, neumann_masked_3d
+
+    _check_direct_dtype(dtype)
+    Pk = comm.axis_size("k")
+    Pj = comm.axis_size("j")
+    Pi = comm.axis_size("i")
+    Dk = jnp.asarray(dct2_matrix(kmax), dtype)
+    Dj = jnp.asarray(dct2_matrix(jmax), dtype)
+    Di = jnp.asarray(dct2_matrix(imax), dtype)
+    lk = neumann_eigenvalues(kmax, dz)
+    lj = neumann_eigenvalues(jmax, dy)
+    li = neumann_eigenvalues(imax, dx)
+    idx2 = 1.0 / (dx * dx)
+    idy2 = 1.0 / (dy * dy)
+    idz2 = 1.0 / (dz * dz)
+    norm = float(imax * jmax * kmax)
+
+    def solve(p, rhs):
+        del p
+        r = rhs[1:-1, 1:-1, 1:-1]
+        h = _dist_apply(Dk, r, 0, "k", Pk)
+        h = _dist_apply(Dj, h, 1, "j", Pj)
+        h = _dist_apply(Di, h, 2, "i", Pi)
+        denom = (
+            _own_eigs(lk, kl, "k", Pk, dtype)[:, None, None]
+            + _own_eigs(lj, jl, "j", Pj, dtype)[None, :, None]
+            + _own_eigs(li, il, "i", Pi, dtype)[None, None, :]
+        )
+        ph = jnp.where(denom != 0, h / jnp.where(denom != 0, denom, 1.0), 0.0)
+        sol = _dist_apply(Dk.T, ph, 0, "k", Pk)
+        sol = _dist_apply(Dj.T, sol, 1, "j", Pj)
+        sol = _dist_apply(Di.T, sol, 2, "i", Pi)
+        pn = jnp.zeros((kl + 2, jl + 2, il + 2), dtype)
+        pn = pn.at[1:-1, 1:-1, 1:-1].set(sol)
+        pn = halo_exchange(pn, comm)
+        pn = neumann_masked_3d(
+            pn, ca_masks_3d(kl, jl, il, 1, kmax, jmax, imax, dtype)
+        )
+        rr = interior_residual_3d(pn, rhs, idx2, idy2, idz2)
+        res = reduction(jnp.sum(rr * rr), comm, "sum") / norm
+        return pn, res, jnp.asarray(1, jnp.int32)
+
+    return solve

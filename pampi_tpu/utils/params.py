@@ -84,8 +84,8 @@ class Parameter:
     #          (ops/multigrid.py) — O(1) cycles; same eps-residual stopping
     #          contract, `it` counts cycles; single-device or on a mesh
     #   "fft"  direct DCT-diagonalization solve (ops/dctpoisson.py, MXU
-    #          matmuls) — exact in ONE application, `it` reports 1;
-    #          single-device only
+    #          matmuls; collective matmuls + psum_scatter on a mesh) —
+    #          exact in ONE application, `it` reports 1
     # mg/fft do not support obstacle flag fields
     tpu_solver: str = "sor"
     # 3-D VTK output mode: "ascii" (reference default), "binary", or

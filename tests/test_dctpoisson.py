@@ -108,15 +108,54 @@ def test_ns3d_fft_matches_sor_run():
                                rtol=0, atol=1e-6)
 
 
-def test_fft_rejected_on_mesh():
-    from pampi_tpu.models.poisson_dist import DistPoissonSolver
-    from pampi_tpu.parallel.comm import CartComm
-
-    param = Parameter(imax=16, jmax=16, tpu_solver="fft")
-    with pytest.raises(ValueError, match="single-device"):
-        DistPoissonSolver(param, CartComm(ndims=2), problem=2)
-
-
 def test_fft_rejects_bfloat16():
     with pytest.raises(ValueError, match="bfloat16|float32"):
         make_dct_solve_2d(16, 16, 1 / 16, 1 / 16, jnp.bfloat16)
+
+
+def test_dist_fft_matches_single_device():
+    """Distributed fft (collective-matmul DCT) vs single-device fft: same
+    exact solution on 2-D and 3-D meshes."""
+    from pampi_tpu.models.poisson import PoissonSolver
+    from pampi_tpu.models.poisson_dist import DistPoissonSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = Parameter(imax=64, jmax=64, itermax=10, eps=1e-12,
+                      tpu_solver="fft")
+    single = PoissonSolver(param, problem=2)
+    it_s, res_s = single.solve()
+    assert it_s == 1 and res_s < 1e-20
+    for dims in [(2, 4), (8, 1), (1, 8)]:
+        dist = DistPoissonSolver(param, CartComm(ndims=2, dims=dims),
+                                 problem=2)
+        it_d, res_d = dist.solve()
+        assert it_d == 1
+        assert res_d < 1e-20
+        a = dist.full_field()[1:-1, 1:-1]
+        b = np.asarray(single.p)[1:-1, 1:-1]
+        diff = (a - a.mean()) - (b - b.mean())
+        assert np.sqrt((diff**2).mean()) < 1e-10, dims
+
+
+def test_dist_fft_ns3d_matches_single():
+    from pampi_tpu.models.ns3d import NS3DSolver
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = Parameter(
+        name="dcavity3d", imax=16, jmax=16, kmax=16,
+        re=10.0, te=0.05, tau=0.5, itermax=100, eps=1e-8, omg=1.7,
+        gamma=0.9, tpu_solver="fft",
+    )
+    a = NS3DSolver(param)
+    a.run(progress=False)
+    b = NS3DDistSolver(param, CartComm(ndims=3, dims=(2, 2, 2)))
+    b.run(progress=False)
+    assert a.nt == b.nt
+    ua, va, wa, pa = a.collect()
+    ub, vb, wb, pb = b.collect()
+    np.testing.assert_allclose(ua, ub, rtol=0, atol=1e-9)
+    np.testing.assert_allclose(va, vb, rtol=0, atol=1e-9)
+    np.testing.assert_allclose(wa, wb, rtol=0, atol=1e-9)
+    np.testing.assert_allclose(pa - pa.mean(), pb - pb.mean(),
+                               rtol=0, atol=1e-9)
