@@ -13,23 +13,44 @@ from __future__ import annotations
 import jax
 
 
+def _is_transient_device_fault(exc) -> bool:
+    """The axon-tunnelled chip intermittently raises UNAVAILABLE device
+    errors on large programs that run fine on the next dispatch (measured:
+    the same jitted solve failing then succeeding 3x in a row). Those are
+    worth exactly one same-chunk retry; anything else is a real error."""
+    return type(exc).__name__ == "JaxRuntimeError" and "UNAVAILABLE" in str(exc)
+
+
 def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None):
     """Run `state = chunk_fn(*state)` while state[time_index] <= te
     (main.c:43-60 loop semantics: a step runs whenever t <= te at its start).
 
     retry() is called when a chunk raises: it returns a rebuilt chunk_fn to
-    retry with, or None to re-raise (the failure was not pallas's).
-    on_state(state) fires after every successful chunk — the host-sync /
-    checkpoint hook point. Returns the final state."""
+    retry with, or None if there is no alternative path (the failure was not
+    pallas's). In the None case a TRANSIENT device fault still gets one
+    same-chunk retry (inputs are unchanged — the loop is functional) before
+    re-raising. on_state(state) fires after every successful chunk — the
+    host-sync / checkpoint hook point. Returns the final state."""
+    transient_budget = 1
     while float(state[time_index]) <= te:
         try:
             new = chunk_fn(*state)
             # force completion: async pallas faults surface here
             float(new[time_index])
-        except Exception:
-            chunk_fn = retry()
-            if chunk_fn is None:
+        except Exception as exc:
+            new_fn = retry()
+            if new_fn is None:
+                if transient_budget > 0 and _is_transient_device_fault(exc):
+                    import warnings
+
+                    warnings.warn(
+                        "transient TPU device fault; retrying the chunk once",
+                        stacklevel=2,
+                    )
+                    transient_budget -= 1
+                    continue
                 raise
+            chunk_fn = new_fn
             continue
         state = new
         bar.update(float(state[time_index]))

@@ -67,11 +67,13 @@ def _residual2(p, rhs, idx2, idy2):
 
 # Smoothing is ALWAYS unrolled at trace time (n is a small static count).
 # A lax.fori_loop variant for large coarse-solve iteration counts was tried
-# and caused hard TPU device faults (UNAVAILABLE kernel-fault class) when
-# nested inside the solve while_loop inside the NS chunk while_loop — a
-# pure-XLA program, reproducible at CHUNK >= 8, gone with the unrolled
-# form. The coarse level needs no iteration at all now: it is solved
-# exactly by DCT diagonalization (ops/dctpoisson.py).
+# and correlated with TPU device faults (UNAVAILABLE class) when nested
+# inside the solve while_loop inside the NS chunk while_loop; later
+# investigation showed the fault class is partly TRANSIENT infra flakiness
+# on large programs (models/_driver._is_transient_device_fault), so the
+# causal story is uncertain — but the unrolled form is simpler and the
+# coarse level needs no iteration at all now: it is solved exactly by DCT
+# diagonalization (ops/dctpoisson.py).
 
 
 def _smooth2(p, rhs, masks, factor, idx2, idy2, n):
