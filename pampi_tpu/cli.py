@@ -159,11 +159,6 @@ def _dispatch(param, prof) -> int:
                 from .models.ns2d import NS2DSolver
 
                 return NS2DSolver(param)
-            if param.obstacles.strip():
-                raise ValueError(
-                    "obstacles are single-device NS-2D only for now; "
-                    "set tpu_mesh 1"
-                )
             from .models.ns2d_dist import NS2DDistSolver
 
             return NS2DDistSolver(param, comm)

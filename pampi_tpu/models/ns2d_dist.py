@@ -87,6 +87,24 @@ class NS2DDistSolver:
         self.dt_bound = 0.5 * param.re / inv_sqr_sum
         self.t = 0.0
         self.nt = 0
+        # flag-field obstacles: GLOBAL static geometry; every shard slices
+        # its mask blocks inside the kernel (ops/obstacle.shard_masks)
+        if param.obstacles.strip():
+            if param.tpu_solver in ("mg", "fft"):
+                raise ValueError(
+                    f"tpu_solver {param.tpu_solver} does not support "
+                    "obstacle flag fields; use tpu_solver sor"
+                )
+            from ..ops import obstacle as obst
+
+            fluid = obst.build_fluid(
+                param.imax, param.jmax, self.dx, self.dy, param.obstacles
+            )
+            self.masks = obst.make_masks(
+                fluid, self.dx, self.dy, param.omg, dtype
+            )
+        else:
+            self.masks = None
         self._build()
         # extended-block state, stacked over the mesh
         self.u, self.v, self.p = self._init_sm()
@@ -158,7 +176,7 @@ class NS2DDistSolver:
                 lid = 2.0 - u[-2, :]
                 new_row = jnp.where(colmask > 0, lid, u[-1, :])
                 u = u.at[-1, :].set(_sel(hi_j, new_row, u[-1, :]))
-            elif param.name == "canal":
+            elif param.name in ("canal", "canal_obstacle"):
                 # parabolic inflow at the left wall, global y coordinate
                 joff = get_offsets("j", jl)
                 jj = jnp.arange(1, jl + 1, dtype=idx_dtype) + joff
@@ -231,6 +249,13 @@ class NS2DDistSolver:
                 comm, self.imax, self.jmax, jl, il, dx, dy,
                 param.eps, param.itermax, dtype,
             )
+        elif self.masks is not None:
+            from ..ops.obstacle import make_dist_obstacle_solver
+
+            solve = make_dist_obstacle_solver(
+                comm, self.imax, self.jmax, jl, il, dx, dy,
+                param.eps, param.itermax, self.masks, dtype,
+            )
         else:
             solve = _solve_sor
 
@@ -247,8 +272,27 @@ class NS2DDistSolver:
             return rowv[:, None] * colv[None, :]
 
         nfull = float((self.imax + 2) * (self.jmax + 2))
+        gmasks = self.masks
+        if gmasks is not None:
+            from ..ops.obstacle import (
+                adapt_uv_obstacle,
+                apply_obstacle_velocity_bc,
+                mask_fg,
+                shard_masks,
+            )
+
+            def local_masks():
+                # must run INSIDE the shard_map trace (mesh offsets)
+                return shard_masks(gmasks, jl, il)
 
         def normalize_pressure(p):
+            if gmasks is not None:
+                # fluid-weighted mean (obstacle cells excluded), ghost ring
+                # counted once via the wall gate — ≙ normalize_pressure_fluid
+                w = wall_weight() * local_masks().fluid
+                total = reduction(jnp.sum(p * w), comm, "sum")
+                count = reduction(jnp.sum(w), comm, "sum")
+                return p - total / count
             s = reduction(jnp.sum(p * wall_weight()), comm, "sum")
             return p - s / nfull
 
@@ -281,10 +325,19 @@ class NS2DDistSolver:
             u = set_special_bc(u)
             u = halo_exchange(u, comm)
             v = halo_exchange(v, comm)
+            if gmasks is not None:
+                # needs the fully-exchanged post-BC state (the single-device
+                # op reads the whole array at once); its own halo-cell
+                # outputs are refreshed by one more exchange
+                u, v = apply_obstacle_velocity_bc(u, v, local_masks())
+                u = halo_exchange(u, comm)
+                v = halo_exchange(v, comm)
             f, g = ops.compute_fg_interior(
                 u, v, dt, param.re, param.gx, param.gy, param.gamma, dx, dy
             )
             f, g = fg_fixups(f, g, u, v)
+            if gmasks is not None:
+                f, g = mask_fg(f, g, u, v, local_masks())
             f = halo_shift(f, comm, "i")
             g = halo_shift(g, comm, "j")
             rhs = ops.compute_rhs(f, g, dt, dx, dy)
@@ -294,7 +347,12 @@ class NS2DDistSolver:
 
         def step(u, v, p, t, nt):
             u, v, f, g, _rhs, p, dt = step_phases(u, v, p, nt)
-            u, v = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
+            if gmasks is not None:
+                u, v = adapt_uv_obstacle(
+                    u, v, f, g, p, dt, dx, dy, local_masks()
+                )
+            else:
+                u, v = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
             # t accumulates in high precision regardless of the field dtype
             # (bfloat16 would stall t once ulp/2 > dt and never reach te)
             return u, v, p, t + dt.astype(idx_dtype), nt + 1

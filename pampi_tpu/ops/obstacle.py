@@ -297,3 +297,88 @@ def adapt_uv_obstacle(u, v, f, g, p, dt, dx, dy, m: ObstacleMasks):
     u = u.at[1:-1, 1:-1].set(u_new * m.u_face[1:-1, 1:-1])
     v = v.at[1:-1, 1:-1].set(v_new * m.v_face[1:-1, 1:-1])
     return u, v
+
+
+# ----------------------------------------------------------------------
+# Distributed obstacles (call INSIDE shard_map): the geometry is static and
+# GLOBAL, so every shard slices its own extended/interior mask blocks from
+# the global constants by mesh offsets — no flag exchange, ever.
+# ----------------------------------------------------------------------
+
+
+def shard_masks(m: ObstacleMasks, jl: int, il: int) -> ObstacleMasks:
+    """This shard's view of the global mask set: extended-block fields
+    (fluid/u_face/v_face) sliced at the extended origin, interior fields at
+    the interior origin. The sliced blocks agree across neighbouring shards
+    wherever they overlap (same global constants), which is what keeps the
+    distributed obstacle arithmetic bitwise-consistent."""
+    from jax import lax as _lax
+
+    from ..parallel.comm import get_offsets
+
+    joff = get_offsets("j", jl)
+    ioff = get_offsets("i", il)
+
+    def ext(a):
+        return _lax.dynamic_slice(a, (joff, ioff), (jl + 2, il + 2))
+
+    def inter(a):
+        return _lax.dynamic_slice(a, (joff, ioff), (jl, il))
+
+    return ObstacleMasks(
+        fluid=ext(m.fluid),
+        u_face=ext(m.u_face),
+        v_face=ext(m.v_face),
+        p_mask=inter(m.p_mask),
+        eps_e=inter(m.eps_e),
+        eps_w=inter(m.eps_w),
+        eps_n=inter(m.eps_n),
+        eps_s=inter(m.eps_s),
+        factor=inter(m.factor),
+        n_fluid=m.n_fluid,
+        omega=m.omega,
+    )
+
+
+def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
+                              m: ObstacleMasks, dtype):
+    """Distributed eps-coefficient pressure solve (shard_map kernel side):
+    exchange-per-half-sweep choreography with the shard's mask slices —
+    the same per-cell arithmetic as the single-device jnp path
+    (sor_pass_obstacle), so trajectories match exactly. Residual normalized
+    by the global fluid-cell count."""
+    from ..parallel.comm import halo_exchange, reduction
+    from ..parallel.stencil2d import ca_masks, neumann_masked
+
+    idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
+    epssq = eps * eps
+    norm = m.n_fluid
+
+    def solve(p, rhs):
+        ml = shard_masks(m, jl, il)
+        cm = ca_masks(jl, il, 1, jmax, imax, dtype)
+        red = cm["red"][1:-1, 1:-1]
+        black = cm["black"][1:-1, 1:-1]
+
+        def cond(c):
+            _, res, it = c
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(c):
+            p, _, it = c
+            p = halo_exchange(p, comm)
+            p, r0 = sor_pass_obstacle(p, rhs, red, ml, idx2, idy2)
+            p = halo_exchange(p, comm)
+            p, r1 = sor_pass_obstacle(p, rhs, black, ml, idx2, idy2)
+            p = neumann_masked(p, cm)
+            res = reduction(r0 + r1, comm, "sum") / norm
+            return p, res, it + 1
+
+        import jax as _jax
+
+        p, res, it = _jax.lax.while_loop(
+            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        )
+        return halo_exchange(p, comm), res, it
+
+    return solve
