@@ -163,9 +163,10 @@ def is_3d_config(p: Parameter) -> bool:
     )
 
 
-def print_parameter(p: Parameter, out=sys.stdout) -> None:
+def print_parameter(p: Parameter, out=None) -> None:
     """Echo the configuration (parity: A5 parameter.c:88-111 for 2-D configs,
     A6 parameter.c:95-126 — Front/Back, W, z-dims — for 3-D ones)."""
+    out = out if out is not None else sys.stdout
     w = out.write
     three_d = is_3d_config(p)
     w(f"Parameters for {p.name}\n")

@@ -62,9 +62,10 @@ def region(name: str):
     _counts[name] += 1
 
 
-def finalize(out=sys.stderr) -> None:
+def finalize(out=None) -> None:
     """≙ LIKWID_MARKER_CLOSE: stop the trace and print the region table."""
     global _tracing
+    out = out if out is not None else sys.stderr
     if not enabled():
         return
     if _tracing:

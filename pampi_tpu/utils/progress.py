@@ -10,10 +10,11 @@ import sys
 
 
 class Progress:
-    def __init__(self, end: float, out=sys.stdout, enabled: bool = True):
+    def __init__(self, end: float, out=None, enabled: bool = True):
         self._end = end
         self._current = 0
-        self._out = out
+        self._out = out if out is not None else sys.stdout
+        out = self._out
         self._enabled = enabled
         if enabled:
             out.write("[          ]")

@@ -1,0 +1,104 @@
+"""CLI driver dispatch (pampi_tpu/cli.py): the reference's L6 convention —
+parse argv -> read .par -> echo -> run -> write -> walltime — plus the
+framework keys' validation paths."""
+
+import numpy as np
+import pytest
+
+from pampi_tpu.cli import main
+
+
+def _par(tmp_path, text):
+    p = tmp_path / "run.par"
+    p.write_text(text)
+    return str(p)
+
+
+def _run(tmp_path, monkeypatch, text):
+    monkeypatch.chdir(tmp_path)
+    return main(["pampi", _par(tmp_path, text)])
+
+
+def test_poisson_dispatch_writes_pdat(tmp_path, monkeypatch, capsys):
+    rc = _run(tmp_path, monkeypatch, """
+name poisson
+imax 32
+jmax 32
+itermax 500
+eps 1e-6
+omg 1.8
+tpu_mesh 1
+""")
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Walltime" in out
+    assert (tmp_path / "p.dat").exists()
+    assert np.loadtxt(tmp_path / "p.dat").shape == (34, 34)
+
+
+def test_ns2d_dispatch_writes_dat_files(tmp_path, monkeypatch, capsys):
+    rc = _run(tmp_path, monkeypatch, """
+name dcavity
+imax 16
+jmax 16
+re 10.0
+te 0.02
+tau 0.5
+itermax 100
+eps 1e-4
+omg 1.8
+gamma 0.9
+tpu_mesh 1
+""")
+    assert rc == 0
+    assert "Solution took" in capsys.readouterr().out
+    assert (tmp_path / "pressure.dat").exists()
+    assert (tmp_path / "velocity.dat").exists()
+
+
+def test_ns3d_dispatch_writes_vtk(tmp_path, monkeypatch, capsys):
+    rc = _run(tmp_path, monkeypatch, """
+name dcavity3d
+imax 8
+jmax 8
+kmax 8
+re 10.0
+te 0.02
+tau 0.5
+itermax 50
+eps 1e-3
+omg 1.7
+gamma 0.9
+tpu_mesh 1
+tpu_vtk binary
+tpu_solver fft
+""")
+    assert rc == 0
+    data = (tmp_path / "dcavity.vtk").read_bytes()
+    assert b"BINARY" in data[:100]
+
+
+def test_bad_solver_and_vtk_rejected(tmp_path, monkeypatch, capsys):
+    rc = _run(tmp_path, monkeypatch, "name poisson\ntpu_solver gauss\n")
+    assert rc == 1
+    assert "tpu_solver" in capsys.readouterr().err
+    rc = _run(tmp_path, monkeypatch,
+              "name dcavity3d\nkmax 8\ntpu_vtk pdf\n")
+    assert rc == 1
+    assert "tpu_vtk" in capsys.readouterr().err
+
+
+def test_unknown_problem_rejected(tmp_path, monkeypatch, capsys):
+    rc = _run(tmp_path, monkeypatch, "name vortexstreet\n")
+    assert rc == 1
+    assert "Unknown problem" in capsys.readouterr().err
+
+
+def test_obstacles_rejected_for_poisson_and_3d(tmp_path, monkeypatch, capsys):
+    rc = _run(tmp_path, monkeypatch,
+              "name poisson\nobstacles 0.2,0.2,0.4,0.4\n")
+    assert rc == 1
+    assert "obstacle" in capsys.readouterr().err
+    rc = _run(tmp_path, monkeypatch,
+              "name dcavity3d\nkmax 8\nobstacles 0.2,0.2,0.4,0.4\n")
+    assert rc == 1
