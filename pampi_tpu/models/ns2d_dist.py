@@ -255,6 +255,7 @@ class NS2DDistSolver:
             solve = make_dist_obstacle_solver(
                 comm, self.imax, self.jmax, jl, il, dx, dy,
                 param.eps, param.itermax, self.masks, dtype,
+                ca_n=param.tpu_ca_inner,
             )
         else:
             solve = _solve_sor

@@ -341,44 +341,141 @@ def shard_masks(m: ObstacleMasks, jl: int, il: int) -> ObstacleMasks:
 
 
 def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
-                              m: ObstacleMasks, dtype):
-    """Distributed eps-coefficient pressure solve (shard_map kernel side):
-    exchange-per-half-sweep choreography with the shard's mask slices —
-    the same per-cell arithmetic as the single-device jnp path
-    (sor_pass_obstacle), so trajectories match exactly. Residual normalized
-    by the global fluid-cell count."""
+                              m: ObstacleMasks, dtype, ca_n: int = 1):
+    """Distributed eps-coefficient pressure solve (shard_map kernel side),
+    COMMUNICATION-AVOIDING like the uniform solve: one depth-2n halo
+    exchange buys n exact red-black iterations computed locally (the static
+    global masks make redundant halo updates bitwise-consistent). Same
+    per-cell arithmetic as the single-device jnp path (sor_pass_obstacle);
+    with ca_n > 1 convergence is checked every n iterations, so a solve may
+    overshoot by up to n-1 iterations vs the per-iteration single-device
+    loop (the tpu_ca_inner contract) — at n=1 trajectories match exactly.
+    Residual normalized by the global fluid-cell count. Extent-1 shards
+    fall back to exchange-per-half-sweep.
+    """
     from ..parallel.comm import halo_exchange, reduction
-    from ..parallel.stencil2d import ca_masks, neumann_masked
+    from ..parallel.stencil2d import (
+        ca_clamp,
+        ca_halo,
+        ca_masks,
+        ca_supported,
+        embed_deep,
+        neumann_masked,
+        strip_deep,
+    )
 
     idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
     epssq = eps * eps
     norm = m.n_fluid
+    supported = ca_supported(jl, il)
+    n = ca_clamp(ca_n, jl, il) if supported else 1
+    H = ca_halo(n) if supported else 1
 
     def solve(p, rhs):
-        ml = shard_masks(m, jl, il)
-        cm = ca_masks(jl, il, 1, jmax, imax, dtype)
-        red = cm["red"][1:-1, 1:-1]
-        black = cm["black"][1:-1, 1:-1]
+        cm = ca_masks(jl, il, H, jmax, imax, dtype)
+        om = deep_obstacle_masks(m, jl, il, H)
+        pd = embed_deep(p, H)
+        rd = halo_exchange(embed_deep(rhs, H), comm, depth=H)
 
         def cond(c):
             _, res, it = c
             return jnp.logical_and(res >= epssq, it < itermax)
 
         def body(c):
-            p, _, it = c
-            p = halo_exchange(p, comm)
-            p, r0 = sor_pass_obstacle(p, rhs, red, ml, idx2, idy2)
-            p = halo_exchange(p, comm)
-            p, r1 = sor_pass_obstacle(p, rhs, black, ml, idx2, idy2)
-            p = neumann_masked(p, cm)
-            res = reduction(r0 + r1, comm, "sum") / norm
-            return p, res, it + 1
+            pd, _, it = c
+            if supported:
+                pd = halo_exchange(pd, comm, depth=H)
+                pd, r2 = ca_rb_iters_obstacle(pd, rd, n, cm, om, idx2, idy2)
+            else:
+                red = cm["red"][1:-1, 1:-1] * om["p_mask"]
+                black = cm["black"][1:-1, 1:-1] * om["p_mask"]
+                pd2 = halo_exchange(pd, comm)
+                pd2, r_red = _obstacle_half(pd2, rd, red, om, idx2, idy2)
+                pd2 = halo_exchange(pd2, comm)
+                pd2, r_blk = _obstacle_half(pd2, rd, black, om, idx2, idy2)
+                pd = neumann_masked(pd2, cm)
+                r2 = jnp.sum(
+                    jnp.where(
+                        cm["owned"][1:-1, 1:-1],
+                        r_red * r_red + r_blk * r_blk,
+                        0.0,
+                    )
+                )
+            res = reduction(r2, comm, "sum") / norm
+            return pd, res, it + n
 
         import jax as _jax
 
-        p, res, it = _jax.lax.while_loop(
-            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        pd, res, it = _jax.lax.while_loop(
+            cond, body, (pd, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
         )
-        return halo_exchange(p, comm), res, it
+        return halo_exchange(strip_deep(pd, H), comm), res, it
 
     return solve
+
+
+def deep_obstacle_masks(m: ObstacleMasks, jl: int, il: int, halo: int):
+    """Interior-mask slices for the deep-halo CA layout (stencil2d.ca_*):
+    the update region of a (jl+2H, il+2H) block is its [1:-1] interior, and
+    its cell (a, b) sits at global interior index (a - (H-1) + joff, …) —
+    so pad the GLOBAL interior mask constants by H-1 (zeros: out-of-domain
+    cells update nothing and carry no residual) and slice at the plain mesh
+    offsets. Static geometry ⇒ identical values on every shard that sees a
+    cell ⇒ redundant halo updates stay bitwise-consistent."""
+    from jax import lax as _lax
+
+    from ..parallel.comm import get_offsets
+
+    H = halo
+    joff = get_offsets("j", jl)
+    ioff = get_offsets("i", il)
+    pad = [(H - 1, H - 1)] * 2
+    size = (jl + 2 * H - 2, il + 2 * H - 2)
+
+    def inter(a):
+        return _lax.dynamic_slice(jnp.pad(a, pad), (joff, ioff), size)
+
+    return {
+        "p_mask": inter(m.p_mask),
+        "eps_e": inter(m.eps_e),
+        "eps_w": inter(m.eps_w),
+        "eps_n": inter(m.eps_n),
+        "eps_s": inter(m.eps_s),
+        "factor": inter(m.factor),
+    }
+
+
+def _obstacle_half(p, rhs, color, om, idx2, idy2):
+    """One eps-coefficient half-sweep on an extended block — the SINGLE home
+    of the distributed obstacle stencil arithmetic (op-for-op
+    sor_pass_obstacle for bitwise parity with the single-device jnp path).
+    `color` is the precomputed (colour ∩ global interior ∩ fluid) mask on
+    the block's [1:-1] region."""
+    c = p[1:-1, 1:-1]
+    lap = (
+        om["eps_e"] * (p[1:-1, 2:] - c) + om["eps_w"] * (p[1:-1, :-2] - c)
+    ) * idx2 + (
+        om["eps_n"] * (p[2:, 1:-1] - c) + om["eps_s"] * (p[:-2, 1:-1] - c)
+    ) * idy2
+    r = (rhs[1:-1, 1:-1] - lap) * color
+    return p.at[1:-1, 1:-1].add(-om["factor"] * r), r
+
+
+def ca_rb_iters_obstacle(p, rhs, n: int, cm, om, idx2, idy2):
+    """n full red-black iterations of the eps-coefficient obstacle stencil
+    on the deep-halo extended block (the obstacle twin of
+    stencil2d.ca_rb_iters). cm = stencil2d.ca_masks set, om =
+    deep_obstacle_masks set. Returns (p, owned r² sum)."""
+    from ..parallel.stencil2d import neumann_masked
+
+    red = cm["red"][1:-1, 1:-1] * om["p_mask"]
+    black = cm["black"][1:-1, 1:-1] * om["p_mask"]
+    r_red = r_blk = None
+    for _ in range(n):
+        p, r_red = _obstacle_half(p, rhs, red, om, idx2, idy2)
+        p, r_blk = _obstacle_half(p, rhs, black, om, idx2, idy2)
+        p = neumann_masked(p, cm)
+    r2 = jnp.sum(
+        jnp.where(cm["owned"][1:-1, 1:-1], r_red * r_red + r_blk * r_blk, 0.0)
+    )
+    return p, r2

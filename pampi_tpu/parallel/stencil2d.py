@@ -163,12 +163,18 @@ def ca_supported(*local_extents) -> bool:
     return min(local_extents) >= 2
 
 
+def ca_clamp(n: int, *local_extents) -> int:
+    """Clamp a requested CA block size so the 2n-deep halo strips still come
+    from the shard's OWNED cells (2n <= min local extent) — the single home
+    of the clamp policy."""
+    cap = min(local_extents) // 2
+    return max(1, min(n, cap))
+
+
 def ca_inner(param, *local_extents) -> int:
     """Effective communication-avoiding block size: the .par knob
-    `tpu_ca_inner`, clamped so the 2n-deep halo strips still come from the
-    shard's OWNED cells (2n <= min local extent)."""
-    cap = min(local_extents) // 2
-    return max(1, min(param.tpu_ca_inner, cap))
+    `tpu_ca_inner` through ca_clamp."""
+    return ca_clamp(param.tpu_ca_inner, *local_extents)
 
 
 def embed_deep(x, halo: int):

@@ -330,3 +330,30 @@ def test_obstacle_dist_rejects_mg_fft():
     )
     with _pytest.raises(ValueError, match="obstacle"):
         NS2DDistSolver(param, CartComm(ndims=2))
+
+
+def test_canal_obstacle_dist_ca_inner2():
+    """Deep-halo CA with n=2 local iterations: iteration-capped run (itermax
+    even, eps tiny) must stay bitwise-equal to single device."""
+    import numpy as np
+
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.utils.params import Parameter
+
+    param = Parameter(
+        name="canal_obstacle", imax=64, jmax=32, xlength=4.0, ylength=1.0,
+        re=100.0, te=0.02, tau=0.5, itermax=40, eps=1e-30, omg=1.7,
+        gamma=0.9, bcLeft=3, bcRight=3, bcBottom=1, bcTop=1,
+        obstacles="1.0,0.3,1.5,0.7", tpu_ca_inner=2,
+    )
+    single = NS2DSolver(param)
+    single.run(progress=False)
+    dist = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 4)))
+    dist.run(progress=False)
+    ud, vd, pd = dist.fields()
+    assert dist.nt == single.nt
+    np.testing.assert_array_equal(np.asarray(single.u), ud)
+    np.testing.assert_array_equal(np.asarray(single.v), vd)
+    np.testing.assert_array_equal(np.asarray(single.p), pd)
