@@ -1,0 +1,57 @@
+"""Host-process CPU affinity (parity with the reference's L1 toolbox module
+`assignment-4/src/affinity.c:34-61`: affinity_getProcessorId /
+affinity_pinProcess / affinity_pinThread).
+
+TPU-first framing: XLA owns the accelerator cores, so pinning governs the
+HOST side only — the Python process that parses configs, dispatches jitted
+steps, and writes output. That is also faithful to the reference, where the
+module is plumbing no solver ever calls (SURVEY.md §1 L1). The reference
+compiles to nothing outside `__linux__ && _OPENMP`; here every function is a
+no-op (returning -1 where a value is expected) on platforms without
+`os.sched_setaffinity`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_HAVE_SCHED = hasattr(os, "sched_setaffinity")
+
+
+def get_processor_id() -> int:
+    """Lowest CPU in the calling thread's affinity mask — the reference's
+    first-set-bit scan (affinity.c:19-31, getProcessorID), not the CPU the
+    thread happens to be running on this instant."""
+    if not _HAVE_SCHED:
+        return -1
+    mask = os.sched_getaffinity(0)
+    return min(mask) if mask else -1
+
+
+def pin_process(processor_id: int) -> bool:
+    """≙ affinity_pinProcess: sched_setaffinity on pid 0, which on Linux pins
+    the CALLING thread (threads already running — e.g. XLA's host threadpool —
+    keep their masks; new threads inherit). The reference call has the same
+    kernel semantics. Returns False on unsupported platforms or invalid ids
+    instead of the reference's silent syscall failure."""
+    if not _HAVE_SCHED:
+        return False
+    try:
+        os.sched_setaffinity(0, {processor_id})
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def pin_thread(processor_id: int) -> bool:
+    """Pin the CALLING thread only (≙ affinity_pinThread,
+    pthread_setaffinity_np on pthread_self). Python exposes per-thread
+    affinity through the thread's native TID."""
+    if not _HAVE_SCHED:
+        return False
+    try:
+        os.sched_setaffinity(threading.get_native_id(), {processor_id})
+        return True
+    except (OSError, ValueError):
+        return False

@@ -1,0 +1,59 @@
+"""Affinity module tests (reference: assignment-4/src/affinity.c — a manual
+toolbox module there; exercised automatically here)."""
+
+import os
+import threading
+
+import pytest
+
+from pampi_tpu.utils import affinity
+
+needs_sched = pytest.mark.skipif(
+    not hasattr(os, "sched_setaffinity"), reason="no sched_setaffinity"
+)
+
+
+@needs_sched
+def test_get_processor_id_is_lowest_in_mask():
+    assert affinity.get_processor_id() == min(os.sched_getaffinity(0))
+
+
+@needs_sched
+def test_pin_process_round_trip():
+    original = os.sched_getaffinity(0)
+    target = min(original)
+    try:
+        assert affinity.pin_process(target)
+        assert os.sched_getaffinity(0) == {target}
+        assert affinity.get_processor_id() == target
+    finally:
+        os.sched_setaffinity(0, original)
+
+
+@needs_sched
+def test_pin_thread_affects_only_calling_thread():
+    original = os.sched_getaffinity(0)
+    if len(original) < 2:
+        pytest.skip("needs >=2 CPUs to observe a per-thread mask")
+    cpus = sorted(original)
+    seen = {}
+
+    def worker():
+        tid = threading.get_native_id()
+        seen["pinned"] = affinity.pin_thread(cpus[1])
+        seen["thread_mask"] = os.sched_getaffinity(tid)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    try:
+        assert seen["pinned"], "pin_thread refused the target CPU"
+        assert seen["thread_mask"] == {cpus[1]}
+        # the main thread's mask is untouched
+        assert os.sched_getaffinity(threading.get_native_id()) == original
+    finally:
+        os.sched_setaffinity(0, original)
+
+
+def test_invalid_cpu_returns_false():
+    assert affinity.pin_process(10**6) is False

@@ -69,6 +69,19 @@ def test_poisson_converges_default_config(reference_dir):
 
 
 @pytest.mark.golden
+def test_init_fields_matches_golden_initdat(reference_dir):
+    """The committed `assignment-4/init.dat` is writeResult applied to the
+    INITIAL field — a golden for the initSolver formula itself
+    (p = sin(4πi·dx)+sin(4πj·dy) incl. ghosts, solver.c:105-116). %f format
+    carries 6 decimals, so compare at 1e-6."""
+    param = read_parameter(str(reference_dir / "assignment-4" / "poisson.par"))
+    p0, _rhs = init_fields(param, problem=2)
+    golden = read_matrix(str(reference_dir / "assignment-4" / "init.dat"))
+    assert golden.shape == np.asarray(p0).shape
+    np.testing.assert_allclose(np.asarray(p0), golden, rtol=0, atol=1.1e-6)
+
+
+@pytest.mark.golden
 def test_poisson_matches_golden_pdat(reference_dir, tmp_path):
     """Converged field vs committed golden p.dat (produced by the reference's
     lexicographic `solve`). The all-Neumann problem is singular — solutions
