@@ -1,0 +1,38 @@
+#!/bin/bash
+# DMVM scaling sweep: mesh sizes x the FULL (N,NITER) grid — harness parity
+# with the reference's internode sweep (/root/reference/assignment-3a/
+# "bash scripts"/bench-cluster.sh: ranks {72,144,216,288} x
+# (N,NITER) in {(1000,1e6),(4000,1e5),(10000,1e4),(20000,5e3)}, SLURM on 4
+# nodes). TPU-first, the "nodes" axis is the device-mesh axis: each row runs
+# the ppermute ring matvec over an R-device mesh. Without a multi-chip slice
+# this uses the virtual CPU mesh (the framework's standard "multi-node
+# without a cluster"); on a real slice drop JAX_PLATFORMS/XLA_FLAGS and R
+# rides ICI. Iterations are divided by SCALE (default 1000) to keep each
+# point in seconds; MFLOP/s is iteration-count invariant.
+#
+# Usage: scripts/bench-cluster.sh [outfile.csv] [SCALE] [mesh sizes...]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-bench-cluster.csv}
+SCALE=${2:-1000}
+shift 2 2>/dev/null || shift $# 2>/dev/null || true
+MESHES=${@:-"2 4 8"}
+
+# PYTHONPATH is deliberately REPLACED, not extended: an inherited entry may
+# carry a sitecustomize that force-registers an accelerator plugin, which
+# defeats the JAX_PLATFORMS=cpu virtual mesh. Extra import roots go in
+# PAMPI_PYTHONPATH.
+echo "Ranks,NITER,N,MFlops,Time" > "$OUT"
+for R in $MESHES; do
+    for NI in "1000 1000000" "4000 100000" "10000 10000" "20000 5000"; do
+        set -- $NI
+        N=$1
+        ITER=$(( $2 / SCALE ))
+        [ "$ITER" -lt 1 ] && ITER=1
+        PAMPI_CSV="$OUT" JAX_PLATFORMS=cpu \
+            PYTHONPATH="$PWD${PAMPI_PYTHONPATH:+:$PAMPI_PYTHONPATH}" \
+            XLA_FLAGS="--xla_force_host_platform_device_count=$R" \
+            python -m pampi_tpu "$N" "$ITER" || echo "R=$R N=$N failed" >&2
+    done
+done
+cat "$OUT"

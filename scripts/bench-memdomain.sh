@@ -1,0 +1,31 @@
+#!/bin/bash
+# DMVM fine-grained device sweep 1..K within one host — harness parity with
+# the reference's memory-domain sweep (/root/reference/assignment-3a/
+# "bash scripts"/bench-memdomain.sh: ranks 1..18 inside one 18-core memory
+# domain, likwid-pinned). The TPU analog of "one memory domain" is the
+# single-host device set: sweep every mesh size 1..K and watch where ring
+# bandwidth saturates. Virtual CPU mesh by default; on a real slice drop
+# JAX_PLATFORMS/XLA_FLAGS.
+#
+# Usage: scripts/bench-memdomain.sh [outfile.csv] [K] [N] [ITER]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-bench-memdomain.csv}
+K=${2:-8}
+N=${3:-4000}
+ITER=${4:-100}
+
+# PYTHONPATH is deliberately REPLACED, not extended: an inherited entry may
+# carry a sitecustomize that force-registers an accelerator plugin, which
+# defeats the JAX_PLATFORMS=cpu virtual mesh. Extra import roots go in
+# PAMPI_PYTHONPATH.
+echo "Ranks,NITER,N,MFlops,Time" > "$OUT"
+R=1
+while [ "$R" -le "$K" ]; do
+    PAMPI_CSV="$OUT" JAX_PLATFORMS=cpu \
+        PYTHONPATH="$PWD${PAMPI_PYTHONPATH:+:$PAMPI_PYTHONPATH}" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=$R" \
+        python -m pampi_tpu "$N" "$ITER" || echo "R=$R failed" >&2
+    R=$(( R + 1 ))
+done
+cat "$OUT"

@@ -15,9 +15,13 @@ OUT=${1:-bench-mesh.csv}
 N=${2:-4000}
 ITER=${3:-100}
 
+# PYTHONPATH is deliberately REPLACED, not extended: an inherited entry may
+# carry a sitecustomize that force-registers an accelerator plugin, which
+# defeats the JAX_PLATFORMS=cpu virtual mesh. Extra import roots go in
+# PAMPI_PYTHONPATH.
 echo "Ranks,NITER,N,MFlops,Time" > "$OUT"
 for R in 1 2 4 8; do
-    PAMPI_CSV="$OUT" JAX_PLATFORMS=cpu PYTHONPATH="${PYTHONPATH:-$PWD}" \
+    PAMPI_CSV="$OUT" JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PAMPI_PYTHONPATH:+:$PAMPI_PYTHONPATH}" \
         XLA_FLAGS="--xla_force_host_platform_device_count=$R" \
         python -m pampi_tpu "$N" "$ITER" || echo "R=$R failed" >&2
 done
