@@ -69,6 +69,14 @@ def _run(argv) -> int:
 
     param = read_parameter(argv[1], Parameter())
 
+    # commInit before anything touches devices: under a PAMPI_COORDINATOR
+    # launch this joins the process group and makes jax.devices() global;
+    # single-process runs no-op (≙ the ENABLE_MPI=false build)
+    from .parallel import multihost
+
+    multihost.init_from_env()
+    multihost.mute_non_master()
+
     if param.tpu_dtype == "float64":
         import jax
 
@@ -85,6 +93,7 @@ def _run(argv) -> int:
         # always stop an open XProf trace and print the region table, even
         # when the solver or a writer raises — that's the run worth profiling
         prof.finalize()
+        multihost.shutdown()  # commFinalize
 
 
 def _dispatch(param, prof) -> int:

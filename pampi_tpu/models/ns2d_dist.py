@@ -425,7 +425,7 @@ class NS2DDistSolver:
         """Rebuild the (jmax+2, imax+2) array from stacked extended blocks:
         interiors everywhere, ghost strips taken from wall shards
         (≙ commCollectResult's ghost-strip + assembly, comm.c:246-427)."""
-        arr = np.asarray(jax.device_get(stacked))
+        arr = self.comm.collect(stacked)  # multihost-safe host gather
         Pj, Pi = self.comm.dims
         jl, il = self.jl, self.il
         full = np.zeros((self.jmax + 2, self.imax + 2))
@@ -462,6 +462,10 @@ class NS2DDistSolver:
     def write_result(
         self, pressure_path: str = "pressure.dat", velocity_path: str = "velocity.dat"
     ) -> None:
+        # fields() gathers collectively — all processes join; rank 0 writes
         u, v, p = self.fields()
-        write_pressure(p, self.dx, self.dy, pressure_path)
-        write_velocity(u, v, self.dx, self.dy, velocity_path)
+        from ..parallel import multihost
+
+        if multihost.is_master():
+            write_pressure(p, self.dx, self.dy, pressure_path)
+            write_velocity(u, v, self.dx, self.dy, velocity_path)

@@ -337,15 +337,16 @@ class NS3DDistSolver:
         assembled (kmax, jmax, imax) global array — no assembly code (the
         80-line subarray dance of assembleResult, comm.c:104-156, vanishes)."""
         ug, vg, wg, pg = self._collect_sm(self.u, self.v, self.w, self.p)
-        return (
-            np.asarray(jax.device_get(ug)),
-            np.asarray(jax.device_get(vg)),
-            np.asarray(jax.device_get(wg)),
-            np.asarray(jax.device_get(pg)),
-        )
+        fetch = self.comm.collect  # multihost-safe host gather
+        return (fetch(ug), fetch(vg), fetch(wg), fetch(pg))
 
     def write_result(self, path=None, fmt: str = "ascii") -> None:
-        write_vtk_result(self.param, self.grid, self.collect(), path, fmt)
+        # collect() is collective; only rank 0 writes the serial VTK file
+        fields = self.collect()
+        from ..parallel import multihost
+
+        if multihost.is_master():
+            write_vtk_result(self.param, self.grid, fields, path, fmt)
 
     def write_result_sharded(self, path=None) -> None:
         """MPI-IO-pattern parallel write (binary VTK): the collect kernel's

@@ -236,4 +236,10 @@ class DistPoissonSolver:
         return full
 
     def write_result(self, path: str = "p.dat") -> None:
-        write_matrix(self.full_field(), path)
+        # full_field's collect is collective — every process participates;
+        # only rank 0 touches the file (≙ rank0 writeResult, main.c)
+        full = self.full_field()
+        from ..parallel import multihost
+
+        if multihost.is_master():
+            write_matrix(full, path)

@@ -169,8 +169,15 @@ class CartComm:
     def collect(arr) -> np.ndarray:
         """Gather a sharded global array to the host. The reference needs 80
         lines of subarray datatypes + Isend/Irecv (assembleResult); here the
-        sharded array is already globally addressable."""
-        return np.asarray(jax.device_get(arr))
+        sharded array is already globally addressable. Under a multi-process
+        launch shards live on other hosts, so the fetch is a cross-process
+        allgather (every process gets the full array — the reference gathers
+        to rank 0 only, but its non-root ranks simply discard theirs)."""
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(jax.device_get(arr))
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
 # ----------------------------------------------------------------------

@@ -29,8 +29,12 @@ def _mesh_dims(solver):
 
 
 def save_checkpoint(path: str, solver) -> None:
+    from ..parallel.comm import CartComm
+
+    # CartComm.collect is a plain device_get when fully addressable and a
+    # cross-process allgather under a multi-process launch
     data = {
-        f: np.asarray(getattr(solver, f))
+        f: CartComm.collect(getattr(solver, f))
         for f in _FIELDS
         if hasattr(solver, f)
     }
@@ -41,6 +45,12 @@ def save_checkpoint(path: str, solver) -> None:
     # is mesh-dependent; record the mesh so a mismatched restart errors
     # clearly instead of with a confusing shape diff
     data["mesh"] = np.asarray(_mesh_dims(solver), dtype=np.int64)
+    # the fetches above are collective under a multi-process launch; the
+    # file itself is written by rank 0 only (all ranks re-read on restart)
+    from ..parallel import multihost
+
+    if not multihost.is_master():
+        return
     tmp = f"{path}.tmp"
     with open(tmp, "wb") as fh:
         np.savez(fh, **data)
@@ -64,13 +74,21 @@ def load_checkpoint(path: str, solver) -> None:
             raise ValueError(
                 f"checkpoint grid {shape} != solver grid {tuple(solver.p.shape)}"
             )
+        import jax
         import jax.numpy as jnp
 
         for f in _FIELDS:
             if f in z and hasattr(solver, f):
-                setattr(
-                    solver, f, jnp.asarray(z[f], dtype=getattr(solver, f).dtype)
-                )
+                cur = getattr(solver, f)
+                new = jnp.asarray(z[f], dtype=cur.dtype)
+                if getattr(cur, "sharding", None) is not None and not getattr(
+                    cur, "is_fully_addressable", True
+                ):
+                    # multi-process mesh: place the (host-replicated) loaded
+                    # array back on the global sharding the solver was built
+                    # with, or the next jitted step rejects a local array
+                    new = jax.device_put(new, cur.sharding)
+                setattr(solver, f, new)
         solver.t = float(z["t"])
         solver.nt = int(z["nt"])
 
