@@ -38,7 +38,9 @@ BASELINE_8RANK_UPDATES_PER_S = 1.32e9  # see module docstring
 
 N = 4096
 ITERS = 100
-N_INNER = 4  # temporal-blocking depth (pallas path); the timed loop runs
+N_INNER = 5  # temporal-blocking depth (pallas path; best of the measured
+# k=3..8 sweep at 4096^2 on v5e — see tools/perf_sweep_tblock.py); the
+# timed loop runs
 # (ITERS // eff) * eff iterations and divides by exactly that count
 
 
@@ -67,10 +69,10 @@ def _timed_run(backend: str):
     out = run_iters(p, rhs)
     float(out[1])  # warm-up + compile; scalar readback forces completion
     best = float("inf")
-    # best-of-10: the axon tunnel + chip sharing add up to ~50% run-to-run
+    # best-of-20: the axon tunnel + chip sharing add up to ~50% run-to-run
     # jitter (measured); min over many dispatches approximates the chip's
     # unthrottled rate
-    for _ in range(10):
+    for _ in range(20):
         t0 = time.perf_counter()
         out = run_iters(p, rhs)
         # block_until_ready can return before completion under the axon

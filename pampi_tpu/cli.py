@@ -45,6 +45,13 @@ def _make_comm(param, ndims: int):
         else tuple(int(t) for t in param.tpu_mesh.split("x"))
     )
     if ndev == 1 or (dims is not None and all(d == 1 for d in dims)):
+        if jax.process_count() > 1:
+            # every rank would run the full serial solver and race on the
+            # output files; a 1-cell mesh makes no sense distributed
+            raise ValueError(
+                "tpu_mesh 1 under a multi-process launch: drop the "
+                "PAMPI_COORDINATOR env (run single-process) or widen tpu_mesh"
+            )
         return None
     from .parallel.comm import CartComm
 

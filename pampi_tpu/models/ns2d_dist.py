@@ -464,8 +464,6 @@ class NS2DDistSolver:
     ) -> None:
         # fields() gathers collectively — all processes join; rank 0 writes
         u, v, p = self.fields()
-        from ..parallel import multihost
-
-        if multihost.is_master():
+        if self.comm.is_master:
             write_pressure(p, self.dx, self.dy, pressure_path)
             write_velocity(u, v, self.dx, self.dy, velocity_path)

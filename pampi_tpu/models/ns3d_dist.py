@@ -343,9 +343,7 @@ class NS3DDistSolver:
     def write_result(self, path=None, fmt: str = "ascii") -> None:
         # collect() is collective; only rank 0 writes the serial VTK file
         fields = self.collect()
-        from ..parallel import multihost
-
-        if multihost.is_master():
+        if self.comm.is_master:
             write_vtk_result(self.param, self.grid, fields, path, fmt)
 
     def write_result_sharded(self, path=None) -> None:

@@ -239,7 +239,5 @@ class DistPoissonSolver:
         # full_field's collect is collective — every process participates;
         # only rank 0 touches the file (≙ rank0 writeResult, main.c)
         full = self.full_field()
-        from ..parallel import multihost
-
-        if multihost.is_master():
+        if self.comm.is_master:
             write_matrix(full, path)

@@ -173,7 +173,10 @@ class CartComm:
         launch shards live on other hosts, so the fetch is a cross-process
         allgather (every process gets the full array — the reference gathers
         to rank 0 only, but its non-root ranks simply discard theirs)."""
-        if getattr(arr, "is_fully_addressable", True):
+        # branch on process_count, NOT per-array addressability: with a
+        # sub-mesh one process could own every shard and skip a collective
+        # the others enter — all processes must take the same path
+        if jax.process_count() == 1:
             return np.asarray(jax.device_get(arr))
         from jax.experimental import multihost_utils
 

@@ -46,7 +46,9 @@ def save_checkpoint(path: str, solver) -> None:
     # clearly instead of with a confusing shape diff
     data["mesh"] = np.asarray(_mesh_dims(solver), dtype=np.int64)
     # the fetches above are collective under a multi-process launch; the
-    # file itself is written by rank 0 only (all ranks re-read on restart)
+    # file itself is written by rank 0 only. Restart re-reads it on EVERY
+    # rank, so under a real multi-host launch the path must live on storage
+    # all hosts can see (the same contract MPI-IO restart files have)
     from ..parallel import multihost
 
     if not multihost.is_master():

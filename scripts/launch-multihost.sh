@@ -22,8 +22,8 @@ set -u
 # stay in the CALLER's directory (outputs and logs land there, like mpirun);
 # the repo root is only needed as an import root
 REPO=$(cd "$(dirname "$0")/.." && pwd)
+[ $# -ge 2 ] || { echo "usage: launch-multihost.sh N <cli args...>" >&2; exit 2; }
 N=$1; shift
-[ $# -ge 1 ] || { echo "usage: launch-multihost.sh N <cli args...>" >&2; exit 2; }
 
 PORT=$(python -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')
 COORD=${PAMPI_COORDINATOR:-127.0.0.1:$PORT}
