@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 
 from ..ops import ns2d as ops
+from ..utils import flags as _flags
 from ..utils.datio import write_pressure, write_velocity
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -189,6 +190,9 @@ class NS2DSolver:
             # t accumulates in high precision regardless of the field dtype
             # (bfloat16 would stall t once ulp/2 > dt and never reach te)
             time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            if _flags.verbose():
+                # ≙ -DVERBOSE "TIME %f , TIMESTEP %f" (A5 main.c:55-57)
+                jax.debug.print("TIME {} , TIMESTEP {}", t, dt)
             return u, v, p, t + dt.astype(time_dtype), nt + 1
 
         return step
@@ -222,7 +226,7 @@ class NS2DSolver:
         protocol live in models/_driver.py."""
         from ._driver import drive_chunks, pallas_retry
 
-        bar = Progress(self.param.te, enabled=progress)
+        bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         state = (self.u, self.v, self.p,
                  jnp.asarray(self.t, time_dtype),

@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops import ns2d as ops
 from ..parallel.comm import (
+    master_print,
     CartComm,
     get_offsets,
     halo_exchange,
@@ -56,6 +57,7 @@ from ..parallel.stencil2d import (
     strip_deep,
     wall_flags,
 )
+from ..utils import flags as _flags
 from ..utils.datio import write_pressure, write_velocity
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -228,6 +230,8 @@ class NS2DDistSolver:
                         pd, rd, masks, comm, factor, idx2, idy2
                     )
                 res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(comm, "{} Residuum: {}", it + (n - 1), res)
                 return pd, res, it + n
 
             pd, res, it = lax.while_loop(
@@ -356,6 +360,8 @@ class NS2DDistSolver:
                 u, v = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
             # t accumulates in high precision regardless of the field dtype
             # (bfloat16 would stall t once ulp/2 > dt and never reach te)
+            if _flags.verbose():
+                master_print(comm, "TIME {} , TIMESTEP {}", t, dt)
             return u, v, p, t + dt.astype(idx_dtype), nt + 1
 
         te = param.te
@@ -404,7 +410,7 @@ class NS2DDistSolver:
 
     # ------------------------------------------------------------------
     def run(self, progress: bool = True, on_sync=None) -> None:
-        bar = Progress(self.param.te, enabled=progress)
+        bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         t = jnp.asarray(self.t, time_dtype)
         nt = jnp.asarray(self.nt, jnp.int32)

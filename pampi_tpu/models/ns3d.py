@@ -26,6 +26,7 @@ import numpy as np
 from jax import lax
 
 from ..ops import ns3d as ops
+from ..utils import flags as _flags
 from ..utils.grid import Grid
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -161,6 +162,9 @@ def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
             def body(c):
                 pp, _, it = c
                 pp, rsq = rb_iter(pp, rp)
+                if _flags.debug():
+                    jax.debug.print("{} Residuum: {}", it + (n_inner - 1),
+                                    rsq / norm)
                 return pp, rsq / norm, it + n_inner
 
             pp, res, it = lax.while_loop(
@@ -185,6 +189,8 @@ def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
             p, r0 = sor_pass_3d(p, rhs, odd, factor, idx2, idy2, idz2)
             p, r1 = sor_pass_3d(p, rhs, even, factor, idx2, idy2, idz2)
             p = neumann_faces_3d(p)
+            if _flags.debug():
+                jax.debug.print("{} Residuum: {}", it, (r0 + r1) / norm)
             return p, (r0 + r1) / norm, it + 1
 
         return lax.while_loop(
@@ -272,6 +278,8 @@ class NS3DSolver:
             p, _res, _it = solve(p, rhs)
             u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
             time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            if _flags.verbose():
+                jax.debug.print("TIME {} , TIMESTEP {}", t, dt)
             return u, v, w, p, t + dt.astype(time_dtype), nt + 1
 
         return step
@@ -298,7 +306,7 @@ class NS3DSolver:
         return chunk_fn
 
     def run(self, progress: bool = True, on_sync=None) -> None:
-        bar = Progress(self.param.te, enabled=progress)
+        bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         t = jnp.asarray(self.t, time_dtype)
         nt = jnp.asarray(self.nt, jnp.int32)

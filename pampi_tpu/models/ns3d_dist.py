@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops import ns3d as ops
 from .ns3d import sor_coefficients_3d, write_vtk_result
 from ..parallel.comm import (
+    master_print,
     CartComm,
     halo_exchange,
     halo_shift,
@@ -48,6 +49,7 @@ from ..parallel.stencil3d import (
     face_flags,
     rb_exchange_per_sweep_3d,
 )
+from ..utils import flags as _flags
 from ..utils.grid import Grid
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -193,6 +195,8 @@ class NS3DDistSolver:
                         pd, rd, masks, comm, factor, idx2, idy2, idz2
                     )
                 res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(comm, "{} Residuum: {}", it + (n - 1), res)
                 return pd, res, it + n
 
             pd, res, it = lax.while_loop(
@@ -258,6 +262,8 @@ class NS3DDistSolver:
             rhs = ops.compute_rhs(f, g_, h, dt, dx, dy, dz)
             p, _res, _it = solve(p, rhs)
             u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
+            if _flags.verbose():
+                master_print(comm, "TIME {} , TIMESTEP {}", t, dt)
             return u, v, w, p, t + dt.astype(idx_dtype), nt + 1
 
         te = param.te
@@ -315,7 +321,7 @@ class NS3DDistSolver:
 
     # ------------------------------------------------------------------
     def run(self, progress: bool = True, on_sync=None) -> None:
-        bar = Progress(self.param.te, enabled=progress)
+        bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         t = jnp.asarray(self.t, time_dtype)
         nt = jnp.asarray(self.nt, jnp.int32)

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.sor import checkerboard_mask, neumann_bc, sor_pass
+from ..utils import flags as _flags
 from ..utils.datio import write_matrix
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -208,6 +209,10 @@ def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
         def body(carry):
             p, _, it = carry
             p, res = step(p, rhs)
+            if _flags.debug():
+                # ≙ -DDEBUG "%d Residuum: %e" (solver.c:169-171); 0-based
+                # index of the last completed iteration, like the reference
+                jax.debug.print("{} Residuum: {}", it + (eff - 1), res)
             return p, res, it + eff
 
         init = (prep(p0), jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))

@@ -36,7 +36,13 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.comm import CartComm, get_offsets, halo_exchange, reduction
+from ..parallel.comm import (
+    CartComm,
+    get_offsets,
+    halo_exchange,
+    master_print,
+    reduction,
+)
 from ..parallel.stencil2d import (
     ca_halo,
     ca_inner,
@@ -46,6 +52,7 @@ from ..parallel.stencil2d import (
     neumann_masked,
     rb_exchange_per_sweep,
 )
+from ..utils import flags as _flags
 from ..utils.datio import write_matrix
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -183,6 +190,8 @@ class DistPoissonSolver:
                         p, rhs, m, comm, factor, idx2, idy2
                     )
                 res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(comm, "{} Residuum: {}", it + (n_ca - 1), res)
                 return p, res, it + n_ca
 
             init = (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))

@@ -33,8 +33,11 @@ TPU-first design:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..utils import flags as _flags
 
 from .sor import _interior_residual
 
@@ -178,7 +181,10 @@ def make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype,
             p, _, it = c
             p = vcycle(p, rhs)
             r = _residual2(p, rhs, idx2, idy2)
-            return p, jnp.sum(r * r) / norm, it + 1
+            res = jnp.sum(r * r) / norm
+            if _flags.debug():
+                jax.debug.print("{} Residuum: {}", it, res)  # it = V-cycle
+            return p, res, it + 1
 
         return lax.while_loop(
             cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
@@ -296,7 +302,10 @@ def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
             p, _, it = c
             p = vcycle(p, rhs)
             r = _residual3(p, rhs, idx2, idy2, idz2)
-            return p, jnp.sum(r * r) / norm, it + 1
+            res = jnp.sum(r * r) / norm
+            if _flags.debug():
+                jax.debug.print("{} Residuum: {}", it, res)
+            return p, res, it + 1
 
         return lax.while_loop(
             cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))

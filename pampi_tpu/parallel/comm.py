@@ -56,6 +56,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS_NAMES = ("k", "j", "i")
 
 
+def master_print(comm: "CartComm", fmt: str, *args) -> None:
+    """`jax.debug.print` from the (0,...,0) mesh shard only — the rank-0
+    printing convention of the reference drivers, usable INSIDE shard_map
+    (plain is_master can't be: it's a host-side property). Values printed
+    after a `reduction` are identical on every shard, so one line loses
+    nothing."""
+    idx = jnp.int32(0)
+    for ax in comm.axis_names:
+        idx = idx + lax.axis_index(ax)
+    lax.cond(
+        idx == 0,
+        lambda: jax.debug.print(fmt, *args),
+        lambda: None,
+    )
+
+
 def dims_create(nranks: int, ndims: int) -> tuple[int, ...]:
     """Balanced factorization of nranks over ndims, non-increasing —
     MPI_Dims_create semantics (used by commPartition, and by

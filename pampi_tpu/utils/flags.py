@@ -1,0 +1,39 @@
+"""Runtime flags ≙ the reference's build-time `-D` OPTIONS
+(assignment-6/config.mk:72-84: VERBOSE, DEBUG, ...).
+
+The reference bakes these in at compile time; here they are environment
+variables read at trace time, so the same binary serves both. The native
+shim completes the chain: `make` with OPTIONS += -DVERBOSE/-DDEBUG exports
+PAMPI_VERBOSE/PAMPI_DEBUG to the JAX process (native/src/shim_main.c:43-46).
+
+  PAMPI_DEBUG    pressure residual per CONVERGENCE CHECK, `"%d Residuum: %e"`
+                 (≙ assignment-4/src/solver.c:169-171, A6 solver.c:283-287).
+                 One check per iteration on the jnp paths; every tpu_sor_inner
+                 iterations on the temporal-blocked kernels and the CA
+                 distributed solves (intermediate residuals don't exist
+                 there); per V-cycle under tpu_solver=mg; never under fft
+                 (a direct solve has no iteration to report). Distributed,
+                 the line is printed by the (0,..,0) shard only
+                 (comm.master_print — res is identical on all shards).
+  PAMPI_VERBOSE  per-timestep `"TIME %f , TIMESTEP %f"` instead of the
+                 progress bar (≙ assignment-5/sequential/src/main.c:33-57)
+
+The prints are `jax.debug.print` host callbacks inside the jitted loops —
+tracing bakes the flag in, so runs without the env pay zero cost.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _on(name: str) -> bool:
+    return os.environ.get(name, "") not in ("", "0")
+
+
+def debug() -> bool:
+    return _on("PAMPI_DEBUG")
+
+
+def verbose() -> bool:
+    return _on("PAMPI_VERBOSE")
