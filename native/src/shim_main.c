@@ -45,6 +45,9 @@ static void export_build_options(void) {
 #ifdef DEBUG
     setenv("PAMPI_DEBUG", "1", 0);
 #endif
+#ifdef CHECK
+    setenv("PAMPI_CHECK", "1", 0);
+#endif
 }
 
 int main(int argc, char **argv) {

@@ -38,8 +38,15 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import flags as _flags
 from ..utils.precision import resolve_dtype
 from ..utils.timing import get_timestamp
+
+
+def _print_sum(s):
+    import sys
+
+    print("Sum: %f" % float(s), file=sys.stderr)
 
 
 def init_ax(N: int, dtype):
@@ -57,6 +64,8 @@ class SequentialDMVM:
         self.dtype = dtype or resolve_dtype("float32")
         self.a, self.x = init_ax(N, self.dtype)
 
+        check = _flags.check()
+
         @jax.jit
         def run(a, x, iters):
             def body(_, y):
@@ -64,7 +73,13 @@ class SequentialDMVM:
                 # fold (0·y[0] is only provably 0 for finite y), so the
                 # loop-invariant A·x cannot be hoisted out of the timed loop
                 xdep = x * (1.0 + 0.0 * y[0])
-                return y + a @ xdep
+                y = y + a @ xdep
+                if check:
+                    # ≙ -DCHECK (dmvm.c:26-36): print the running sum of y
+                    # to stderr and zero y each iteration
+                    jax.debug.callback(_print_sum, jnp.sum(y))
+                    y = jnp.zeros_like(y)
+                return y
 
             return lax.fori_loop(0, iters, body, jnp.zeros((N,), self.dtype))
 
@@ -74,12 +89,19 @@ class SequentialDMVM:
         """Timed single-dispatch loop; completion is forced by a host
         readback of one element (block_until_ready under the axon tunnel can
         return before device completion for queued work)."""
-        y = self._run(self.a, self.x, 1)
-        _ = float(y[0])  # warm-up/compile
+        # warm-up compiles the loop but executes ZERO iterations (iters is a
+        # traced operand), so CHECK mode prints exactly `iters` Sum lines,
+        # matching the reference's count
+        y = self._run(self.a, self.x, 0)
+        _ = float(y[0])
         t0 = get_timestamp()
         y = self._run(self.a, self.x, iters)
         _ = float(y[0])
         walltime = get_timestamp() - t0
+        if _flags.check():
+            # debug callbacks are async; drain them before returning so no
+            # Sum line can be lost at process exit (and counts are exact)
+            jax.effects_barrier()
         return y, walltime
 
 

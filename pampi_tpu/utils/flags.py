@@ -17,6 +17,8 @@ PAMPI_VERBOSE/PAMPI_DEBUG to the JAX process (native/src/shim_main.c:43-46).
                  (comm.master_print — res is identical on all shards).
   PAMPI_VERBOSE  per-timestep `"TIME %f , TIMESTEP %f"` instead of the
                  progress bar (≙ assignment-5/sequential/src/main.c:33-57)
+  PAMPI_CHECK    DMVM self-check: per iteration, print `"Sum: %f"` of y to
+                 stderr and reset y (≙ -DCHECK, assignment-3a/src/dmvm.c:26-36)
 
 The prints are `jax.debug.print` host callbacks inside the jitted loops —
 tracing bakes the flag in, so runs without the env pay zero cost.
@@ -37,3 +39,7 @@ def debug() -> bool:
 
 def verbose() -> bool:
     return _on("PAMPI_VERBOSE")
+
+
+def check() -> bool:
+    return _on("PAMPI_CHECK")

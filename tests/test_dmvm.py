@@ -42,3 +42,20 @@ def test_sequential_matches_ring():
 def test_indivisible_ring_rejected():
     with pytest.raises(ValueError):
         RingDMVM(30)  # 30 % 8 != 0
+
+
+def test_check_flag_prints_sum_and_zeroes_y(monkeypatch, capfd):
+    """PAMPI_CHECK ≙ -DCHECK (assignment-3a/src/dmvm.c:26-36): per iteration
+    print `Sum: %f` of y to stderr, then reset y."""
+    monkeypatch.setenv("PAMPI_CHECK", "1")
+    N = 32
+    s = SequentialDMVM(N, dtype=jax.numpy.float64)
+    y, _ = s.run(2)
+    assert float(np.abs(np.asarray(y)).max()) == 0.0
+    err = capfd.readouterr().err
+    sums = [l for l in err.splitlines() if l.startswith("Sum: ")]
+    assert len(sums) == 2  # exactly one per timed iteration (reference count)
+    # closed form: sum(A@x) = N*sum(c^2) + (sum r)(sum c)
+    c = np.arange(N, dtype=np.float64)
+    expect = N * (c**2).sum() + c.sum() ** 2
+    assert abs(float(sums[0].split()[1]) - expect) < 1e-6
