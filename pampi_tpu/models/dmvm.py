@@ -28,6 +28,13 @@ TPU-native design — a ring-allgather matvec (the collective-matmul skeleton):
   construction: ppermute is functional).
 
 Init parity: a[i,j] = i+j, x[i] = i (main.c:45-50).
+
+Kernel choice (measured, v5e): XLA's own gemv streams A at ~260-380 GB/s
+at 8192² f32; hand-written Pallas alternatives (VPU lane-reduce over
+(rows, cols) blocks, and an MXU dot_general accumulating over column
+blocks) measured 0.5-0.75× that in the same session windows. The jnp
+matmul IS the right TPU kernel here — the framework keeps it and spends
+Pallas effort where it wins (the stencil kernels).
 """
 
 from __future__ import annotations

@@ -29,6 +29,34 @@ def _env(**extra):
     return base
 
 
+def _launch(par, tmp_path, n="2"):
+    """Run the multi-process launcher on a .par file; returns the process."""
+    proc = subprocess.run(
+        [str(LAUNCHER), n, str(par)],
+        cwd=tmp_path,
+        env=_env(PAMPI_LOCAL_DEVICES="2"),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc
+
+
+def _oracle(par, tmp_path):
+    """Single-process single-device run of the same config in oracle_dir."""
+    proc = subprocess.run(
+        ["python", "-m", "pampi_tpu", str(par)],
+        cwd=tmp_path / "oracle_dir",
+        env=_env(JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO)),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc
+
+
 POISSON_PAR = """\
 name       poisson
 xlength    1.0
@@ -52,15 +80,7 @@ def test_two_process_poisson_matches_single_process(tmp_path):
     par = tmp_path / "poisson.par"
     par.write_text(POISSON_PAR)
 
-    proc = subprocess.run(
-        [str(LAUNCHER), "2", str(par)],
-        cwd=tmp_path,
-        env=_env(PAMPI_LOCAL_DEVICES="2"),
-        capture_output=True,
-        text=True,
-        timeout=600,
-    )
-    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _launch(par, tmp_path)
     # rank-0 log is echoed to stdout: "<iterations> ... Walltime X.XXs"
     assert "Walltime" in proc.stdout
     # non-master must not print (rank-0-only convention)
@@ -68,15 +88,7 @@ def test_two_process_poisson_matches_single_process(tmp_path):
     assert "Walltime" not in r1
 
     # single-process oracle on one device, same config
-    oracle = subprocess.run(
-        ["python", "-m", "pampi_tpu", str(par)],
-        cwd=tmp_path / "oracle_dir",
-        env=_env(JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO)),
-        capture_output=True,
-        text=True,
-        timeout=600,
-    )
-    assert oracle.returncode == 0, oracle.stdout + oracle.stderr
+    oracle = _oracle(par, tmp_path)
 
     ours = np.loadtxt(tmp_path / "p.dat")
     ref = np.loadtxt(tmp_path / "oracle_dir" / "p.dat")
@@ -117,21 +129,54 @@ def test_two_process_ns2d_writes_outputs_and_checkpoint(tmp_path):
     par = tmp_path / "dcavity.par"
     par.write_text(DCAVITY_PAR)
 
-    proc = subprocess.run(
-        [str(LAUNCHER), "2", str(par)],
-        cwd=tmp_path,
-        env=_env(PAMPI_LOCAL_DEVICES="2"),
-        capture_output=True,
-        text=True,
-        timeout=600,
-    )
-    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _launch(par, tmp_path)
     assert "Solution took" in proc.stdout
     for out in ("pressure.dat", "velocity.dat", "ckpt.npz"):
         assert (tmp_path / out).exists(), out
     # the checkpoint holds the full (jmax+2, imax+2) global fields
     z = np.load(tmp_path / "ckpt.npz")
     assert z["p"].ndim >= 2 and z["nt"] > 0
+
+
+NS3D_PAR = """\
+name       dcavity3d
+xlength    1.0
+ylength    1.0
+zlength    1.0
+imax       8
+jmax       8
+kmax       8
+re         10.0
+te         0.05
+dt         0.02
+tau        0.5
+itermax    50
+eps        0.001
+omg        1.7
+gamma      0.9
+tpu_mesh   auto
+tpu_dtype  float64
+tpu_vtk    sharded
+"""
+
+
+@pytest.mark.slow
+def test_two_process_sharded_vtk_write(tmp_path):
+    """The MPI-IO exercise, for real: 2 OS processes, each writing ONLY its
+    own addressable shards' slabs at their byte offsets into one shared VTK
+    file — no global gather. The result must be byte-identical to the
+    single-process binary write."""
+    par = tmp_path / "dcavity3d.par"
+    par.write_text(NS3D_PAR)
+
+    _launch(par, tmp_path)
+    vtk = tmp_path / "dcavity.vtk"
+    assert vtk.exists()
+
+    _oracle(par, tmp_path)
+    ref = tmp_path / "oracle_dir" / "dcavity.vtk"
+    assert ref.exists()
+    assert vtk.read_bytes() == ref.read_bytes()
 
 
 def _mkdir_oracle(tmp_path):
