@@ -1,0 +1,35 @@
+#!/bin/bash
+# Strong-scaling sweep: FIXED problem (default configs/poisson8192.par),
+# growing device mesh — BASELINE.json config 5 and the TPU analog of the
+# reference's rank-scaling studies. Emits CSV `Ranks,N,Iterations,Time`.
+# Virtual CPU mesh by default (the framework's "multi-node without a
+# cluster"); on a real slice run each row with the ambient platform.
+#
+# Usage: scripts/bench-strong.sh [outfile.csv] [par-file] [mesh sizes...]
+set -u
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+OUT=${1:-bench-strong.csv}
+PAR=${2:-$REPO/configs/poisson8192.par}
+shift 2 2>/dev/null || shift $# 2>/dev/null || true
+MESHES=${@:-"1 2 4 8"}
+N=$(grep -E "^imax" "$PAR" | awk '{print $2}')
+
+# PYTHONPATH is deliberately REPLACED (an inherited sitecustomize can
+# force-register an accelerator plugin and defeat the cpu virtual mesh);
+# extra import roots go in PAMPI_PYTHONPATH.
+echo "Ranks,N,Iterations,Time" > "$OUT"
+# PAMPI_PLATFORM=axon (or tpu) runs rows on the ambient accelerator
+# instead of the virtual CPU mesh — then R must match the real device count.
+for R in $MESHES; do
+    if ! out=$(JAX_PLATFORMS="${PAMPI_PLATFORM:-cpu}" \
+          PYTHONPATH="$REPO${PAMPI_PYTHONPATH:+:$PAMPI_PYTHONPATH}" \
+          XLA_FLAGS="--xla_force_host_platform_device_count=$R" \
+          python -m pampi_tpu "$PAR"); then
+        echo "R=$R failed" >&2; continue
+    fi
+    row=$(echo "$out" | tail -1)
+    it=$(echo "$row" | awk '{print $1}')
+    tm=$(echo "$row" | awk '{print $3}' | tr -d 's')
+    echo "$R,$N,$it,$tm" >> "$OUT"
+done
+cat "$OUT"
