@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from pampi_tpu.models.poisson import init_fields, make_rb_loop
+from pampi_tpu.utils import xlacache
 from pampi_tpu.utils.params import Parameter
 
 BASELINE_8RANK_UPDATES_PER_S = 1.32e9  # see module docstring
@@ -83,6 +84,7 @@ def _timed_run(backend: str):
 
 
 def main() -> None:
+    xlacache.enable()
     backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
     try:
         dt, iters = _timed_run("auto")

@@ -84,6 +84,10 @@ def _run(argv) -> int:
     multihost.init_from_env()
     multihost.mute_non_master()
 
+    from .utils import xlacache
+
+    xlacache.enable()  # recompiles of unchanged programs become disk loads
+
     if param.tpu_dtype == "float64":
         import jax
 

@@ -97,3 +97,23 @@ def test_flags_work_distributed(tmp_path):
     assert res_lines and time_lines
     # rank-0-only: TIME lines are unique (no 8x duplicates)
     assert len(time_lines) == len(set(time_lines))
+
+
+def test_xla_cache_enable_and_disable(monkeypatch, tmp_path):
+    import jax
+
+    from pampi_tpu.utils import xlacache
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.setenv("PAMPI_XLA_CACHE", str(tmp_path / "c"))
+        assert xlacache.enable() == str(tmp_path / "c")
+        assert (tmp_path / "c").is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "c")
+        jax.config.update("jax_compilation_cache_dir", prev)
+        monkeypatch.setenv("PAMPI_XLA_CACHE", "0")
+        assert xlacache.enable() is None
+        # disabled means the config was left untouched
+        assert jax.config.jax_compilation_cache_dir == prev
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)

@@ -137,6 +137,17 @@ def test_two_process_ns2d_writes_outputs_and_checkpoint(tmp_path):
     z = np.load(tmp_path / "ckpt.npz")
     assert z["p"].ndim >= 2 and z["nt"] > 0
 
+    # restart across processes: every rank re-reads the checkpoint and
+    # re-places fields on the global sharding (the load-side device_put)
+    par2 = tmp_path / "dcavity_restart.par"
+    par2.write_text(
+        DCAVITY_PAR.replace("te         0.05", "te         0.08")
+        + "tpu_restart ckpt.npz\n"
+    )
+    proc2 = _launch(par2, tmp_path)
+    assert "Restarted from ckpt.npz" in proc2.stdout
+    assert "Solution took" in proc2.stdout
+
 
 NS3D_PAR = """\
 name       dcavity3d
