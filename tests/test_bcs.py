@@ -1,0 +1,227 @@
+"""Boundary-condition oracle tests: every BC kind on every wall/face,
+against literal numpy transcriptions of the reference switch ladders
+(assignment-5/sequential/src/solver.c:236-337 for 2-D,
+assignment-6/src/solver.c:364-577 for 3-D). The solver-level golden tests
+only exercise NOSLIP and OUTFLOW (dcavity/canal); these cover SLIP and
+PERIODIC too — uniform on all walls, all 4! distinct-kind orderings in 2-D,
+and randomized (repeats allowed) mixes in 2-D and 3-D."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pampi_tpu.ops.ns2d import set_boundary_conditions
+from pampi_tpu.ops.ns3d import set_boundary_conditions_3d
+
+NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
+KINDS = (NOSLIP, SLIP, OUTFLOW, PERIODIC)
+
+
+def ref_bcs_2d(u, v, bc_left, bc_right, bc_bottom, bc_top):
+    """Transcription of solver.c:236-337; arrays [j, i] (U(i,j) = u[j,i])."""
+    u, v = u.copy(), v.copy()
+    # left: U(0,j), V(0,j) for j in 1..jmax
+    if bc_left == NOSLIP:
+        u[1:-1, 0] = 0.0
+        v[1:-1, 0] = -v[1:-1, 1]
+    elif bc_left == SLIP:
+        u[1:-1, 0] = 0.0
+        v[1:-1, 0] = v[1:-1, 1]
+    elif bc_left == OUTFLOW:
+        u[1:-1, 0] = u[1:-1, 1]
+        v[1:-1, 0] = v[1:-1, 1]
+    # right: U(imax,j), V(imax+1,j)
+    if bc_right == NOSLIP:
+        u[1:-1, -2] = 0.0
+        v[1:-1, -1] = -v[1:-1, -2]
+    elif bc_right == SLIP:
+        u[1:-1, -2] = 0.0
+        v[1:-1, -1] = v[1:-1, -2]
+    elif bc_right == OUTFLOW:
+        u[1:-1, -2] = u[1:-1, -3]
+        v[1:-1, -1] = v[1:-1, -2]
+    # bottom: V(i,0), U(i,0)
+    if bc_bottom == NOSLIP:
+        v[0, 1:-1] = 0.0
+        u[0, 1:-1] = -u[1, 1:-1]
+    elif bc_bottom == SLIP:
+        v[0, 1:-1] = 0.0
+        u[0, 1:-1] = u[1, 1:-1]
+    elif bc_bottom == OUTFLOW:
+        u[0, 1:-1] = u[1, 1:-1]
+        v[0, 1:-1] = v[1, 1:-1]
+    # top: V(i,jmax), U(i,jmax+1)
+    if bc_top == NOSLIP:
+        v[-2, 1:-1] = 0.0
+        u[-1, 1:-1] = -u[-2, 1:-1]
+    elif bc_top == SLIP:
+        v[-2, 1:-1] = 0.0
+        u[-1, 1:-1] = u[-2, 1:-1]
+    elif bc_top == OUTFLOW:
+        u[-1, 1:-1] = u[-2, 1:-1]
+        v[-2, 1:-1] = v[-3, 1:-1]
+    return u, v
+
+
+def ref_bcs_3d(u, v, w, bc):
+    """Transcription of assignment-6 solver.c:364-577; arrays [k, j, i]
+    (U(i,j,k) = u[k,j,i]); same face order: top, bottom, left, right,
+    front, back."""
+    u, v, w = u.copy(), v.copy(), w.copy()
+    I = np.s_[1:-1]
+    k = bc["top"]
+    if k == NOSLIP:
+        u[I, -1, I] = -u[I, -2, I]
+        v[I, -2, I] = 0.0
+        w[I, -1, I] = -w[I, -2, I]
+    elif k == SLIP:
+        u[I, -1, I] = u[I, -2, I]
+        v[I, -2, I] = 0.0
+        w[I, -1, I] = w[I, -2, I]
+    elif k == OUTFLOW:
+        u[I, -1, I] = u[I, -2, I]
+        v[I, -2, I] = v[I, -3, I]
+        w[I, -1, I] = w[I, -2, I]
+    k = bc["bottom"]
+    if k == NOSLIP:
+        u[I, 0, I] = -u[I, 1, I]
+        v[I, 0, I] = 0.0
+        w[I, 0, I] = -w[I, 1, I]
+    elif k == SLIP:
+        u[I, 0, I] = u[I, 1, I]
+        v[I, 0, I] = 0.0
+        w[I, 0, I] = w[I, 1, I]
+    elif k == OUTFLOW:
+        u[I, 0, I] = u[I, 1, I]
+        v[I, 0, I] = v[I, 1, I]
+        w[I, 0, I] = w[I, 1, I]
+    k = bc["left"]
+    if k == NOSLIP:
+        u[I, I, 0] = 0.0
+        v[I, I, 0] = -v[I, I, 1]
+        w[I, I, 0] = -w[I, I, 1]
+    elif k == SLIP:
+        u[I, I, 0] = 0.0
+        v[I, I, 0] = v[I, I, 1]
+        w[I, I, 0] = w[I, I, 1]
+    elif k == OUTFLOW:
+        u[I, I, 0] = u[I, I, 1]
+        v[I, I, 0] = v[I, I, 1]
+        w[I, I, 0] = w[I, I, 1]
+    k = bc["right"]
+    if k == NOSLIP:
+        u[I, I, -2] = 0.0
+        v[I, I, -1] = -v[I, I, -2]
+        w[I, I, -1] = -w[I, I, -2]
+    elif k == SLIP:
+        u[I, I, -2] = 0.0
+        v[I, I, -1] = v[I, I, -2]
+        w[I, I, -1] = w[I, I, -2]
+    elif k == OUTFLOW:
+        u[I, I, -2] = u[I, I, -3]
+        v[I, I, -1] = v[I, I, -2]
+        w[I, I, -1] = w[I, I, -2]
+    k = bc["front"]
+    if k == NOSLIP:
+        u[0, I, I] = -u[1, I, I]
+        v[0, I, I] = -v[1, I, I]
+        w[0, I, I] = 0.0
+    elif k == SLIP:
+        u[0, I, I] = u[1, I, I]
+        v[0, I, I] = v[1, I, I]
+        w[0, I, I] = 0.0
+    elif k == OUTFLOW:
+        u[0, I, I] = u[1, I, I]
+        v[0, I, I] = v[1, I, I]
+        w[0, I, I] = w[1, I, I]
+    k = bc["back"]
+    if k == NOSLIP:
+        u[-1, I, I] = -u[-2, I, I]
+        v[-1, I, I] = -v[-2, I, I]
+        w[-2, I, I] = 0.0
+    elif k == SLIP:
+        u[-1, I, I] = u[-2, I, I]
+        v[-1, I, I] = v[-2, I, I]
+        w[-2, I, I] = 0.0
+    elif k == OUTFLOW:
+        u[-1, I, I] = u[-2, I, I]
+        v[-1, I, I] = v[-2, I, I]
+        w[-2, I, I] = w[-3, I, I]
+    return u, v, w
+
+
+def _rand2(shape, seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_2d_uniform_kind_all_walls(kind):
+    u0 = _rand2((9, 12), 0)
+    v0 = _rand2((9, 12), 1)
+    ur, vr = ref_bcs_2d(u0, v0, kind, kind, kind, kind)
+    uo, vo = set_boundary_conditions(
+        jnp.asarray(u0), jnp.asarray(v0), kind, kind, kind, kind
+    )
+    np.testing.assert_array_equal(np.asarray(uo), ur)
+    np.testing.assert_array_equal(np.asarray(vo), vr)
+
+
+@pytest.mark.parametrize(
+    "bcl,bcr,bcb,bct", list(itertools.permutations(KINDS))
+)
+def test_2d_mixed_kinds(bcl, bcr, bcb, bct):
+    u0 = _rand2((8, 10), 2)
+    v0 = _rand2((8, 10), 3)
+    ur, vr = ref_bcs_2d(u0, v0, bcl, bcr, bcb, bct)
+    uo, vo = set_boundary_conditions(
+        jnp.asarray(u0), jnp.asarray(v0), bcl, bcr, bcb, bct
+    )
+    np.testing.assert_array_equal(np.asarray(uo), ur)
+    np.testing.assert_array_equal(np.asarray(vo), vr)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_2d_random_repeated_kinds(seed):
+    rng = np.random.default_rng(200 + seed)
+    bcl, bcr, bcb, bct = (int(rng.integers(1, 5)) for _ in range(4))
+    u0 = _rand2((8, 10), 20 + seed)
+    v0 = _rand2((8, 10), 40 + seed)
+    ur, vr = ref_bcs_2d(u0, v0, bcl, bcr, bcb, bct)
+    uo, vo = set_boundary_conditions(
+        jnp.asarray(u0), jnp.asarray(v0), bcl, bcr, bcb, bct
+    )
+    np.testing.assert_array_equal(np.asarray(uo), ur)
+    np.testing.assert_array_equal(np.asarray(vo), vr)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_3d_uniform_kind_all_faces(kind):
+    shape = (7, 8, 9)
+    u0, v0, w0 = (_rand2(shape, s) for s in (4, 5, 6))
+    bc = {f: kind for f in ("top", "bottom", "left", "right", "front", "back")}
+    ur, vr, wr = ref_bcs_3d(u0, v0, w0, bc)
+    uo, vo, wo = set_boundary_conditions_3d(
+        jnp.asarray(u0), jnp.asarray(v0), jnp.asarray(w0), bc
+    )
+    np.testing.assert_array_equal(np.asarray(uo), ur)
+    np.testing.assert_array_equal(np.asarray(vo), vr)
+    np.testing.assert_array_equal(np.asarray(wo), wr)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_3d_random_mixed_kinds(seed):
+    rng = np.random.default_rng(100 + seed)
+    faces = ("top", "bottom", "left", "right", "front", "back")
+    bc = {f: int(rng.integers(1, 5)) for f in faces}
+    shape = (6, 7, 8)
+    u0, v0, w0 = (_rand2(shape, 10 * seed + s) for s in (0, 1, 2))
+    ur, vr, wr = ref_bcs_3d(u0, v0, w0, bc)
+    uo, vo, wo = set_boundary_conditions_3d(
+        jnp.asarray(u0), jnp.asarray(v0), jnp.asarray(w0), bc
+    )
+    np.testing.assert_array_equal(np.asarray(uo), ur)
+    np.testing.assert_array_equal(np.asarray(vo), vr)
+    np.testing.assert_array_equal(np.asarray(wo), wr)

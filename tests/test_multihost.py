@@ -140,10 +140,9 @@ def test_two_process_ns2d_writes_outputs_and_checkpoint(tmp_path):
     # restart across processes: every rank re-reads the checkpoint and
     # re-places fields on the global sharding (the load-side device_put)
     par2 = tmp_path / "dcavity_restart.par"
-    par2.write_text(
-        DCAVITY_PAR.replace("te         0.05", "te         0.08")
-        + "tpu_restart ckpt.npz\n"
-    )
+    text2 = DCAVITY_PAR.replace("te         0.05", "te         0.08")
+    assert "0.08" in text2  # guard the replace against format drift
+    par2.write_text(text2 + "tpu_restart ckpt.npz\n")
     proc2 = _launch(par2, tmp_path)
     assert "Restarted from ckpt.npz" in proc2.stdout
     assert "Solution took" in proc2.stdout
