@@ -115,17 +115,13 @@ def _dispatch(param, prof) -> int:
               file=sys.stderr)
         return 1
 
-    if param.obstacles.strip():
-        from .utils.params import is_3d_config
-
-        if param.name.startswith("poisson") or is_3d_config(param):
-            # refuse rather than silently simulate an empty box
-            print(
-                "Error: the obstacles key is supported for 2-D NS problems "
-                "only (dcavity/canal/canal_obstacle)",
-                file=sys.stderr,
-            )
-            return 1
+    if param.obstacles.strip() and param.name.startswith("poisson"):
+        # refuse rather than silently simulate an empty box
+        print(
+            "Error: the obstacles key is supported for NS problems only",
+            file=sys.stderr,
+        )
+        return 1
 
     if param.name.startswith("poisson"):
         from .models.poisson import PoissonSolver

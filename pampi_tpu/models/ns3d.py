@@ -229,11 +229,31 @@ class NS3DSolver:
         self.t = 0.0
         self.nt = 0
         self._backend = "auto"
+        # flag-field obstacles (ops/obstacle3d.py): static geometry -> static
+        # masks baked into the traced step as constants (branch-free)
+        if param.obstacles.strip():
+            if param.tpu_solver in ("mg", "fft"):
+                raise ValueError(
+                    f"tpu_solver {param.tpu_solver} does not support "
+                    "obstacle flag fields; use tpu_solver sor"
+                )
+            from ..ops import obstacle3d as obst3
+
+            fluid = obst3.build_fluid_3d(
+                g.imax, g.jmax, g.kmax, g.dx, g.dy, g.dz, param.obstacles
+            )
+            self.masks = obst3.make_masks_3d(
+                fluid, g.dx, g.dy, g.dz, param.omg, dtype
+            )
+        else:
+            self.masks = None
         self._chunk_fn = jax.jit(self._build_chunk())
 
     def _uses_pallas(self) -> bool:
         if self.param.tpu_solver in ("mg", "fft"):
             return False  # mg/fft chunks contain no pallas kernel
+        if self.masks is not None:
+            return False  # the 3-D obstacle solve is the jnp eps path
         return _use_pallas_3d(self._backend, self.dtype)
 
     def _build_step(self, backend: str = "auto"):
@@ -241,12 +261,21 @@ class NS3DSolver:
         g = self.grid
         dtype = self.dtype
         dx, dy, dz = g.dx, g.dy, g.dz
-        solve = make_pressure_solve_3d(
-            g.imax, g.jmax, g.kmax, dx, dy, dz,
-            param.omg, param.eps, param.itermax, dtype,
-            backend=backend, n_inner=param.tpu_sor_inner,
-            solver=param.tpu_solver,
-        )
+        masks = self.masks
+        if masks is not None:
+            from ..ops.obstacle3d import make_obstacle_solver_fn_3d
+
+            solve = make_obstacle_solver_fn_3d(
+                g.imax, g.jmax, g.kmax, dx, dy, dz,
+                param.eps, param.itermax, masks, dtype,
+            )
+        else:
+            solve = make_pressure_solve_3d(
+                g.imax, g.jmax, g.kmax, dx, dy, dz,
+                param.omg, param.eps, param.itermax, dtype,
+                backend=backend, n_inner=param.tpu_sor_inner,
+                solver=param.tpu_solver,
+            )
         bcs = {
             "top": param.bcTop,
             "bottom": param.bcBottom,
@@ -270,13 +299,28 @@ class NS3DSolver:
                 u = ops.set_special_bc_dcavity_3d(u)
             elif problem == "canal":
                 u = ops.set_special_bc_canal_3d(u)
+            if masks is not None:
+                from ..ops.obstacle3d import (
+                    adapt_uvw_obstacle,
+                    apply_obstacle_velocity_bc_3d,
+                    mask_fgh,
+                )
+
+                u, v, w = apply_obstacle_velocity_bc_3d(u, v, w, masks)
             f, g_, h = ops.compute_fgh(
                 u, v, w, dt, param.re, param.gx, param.gy, param.gz,
                 param.gamma, dx, dy, dz,
             )
+            if masks is not None:
+                f, g_, h = mask_fgh(f, g_, h, u, v, w, masks)
             rhs = ops.compute_rhs(f, g_, h, dt, dx, dy, dz)
             p, _res, _it = solve(p, rhs)
-            u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
+            if masks is not None:
+                u, v, w = adapt_uvw_obstacle(
+                    u, v, w, f, g_, h, p, dt, dx, dy, dz, masks
+                )
+            else:
+                u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
             time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
             if _flags.verbose():
                 jax.debug.print("TIME {} , TIMESTEP {}", t, dt)

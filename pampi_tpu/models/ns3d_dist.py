@@ -73,6 +73,11 @@ class NS3DDistSolver:
             dtype = resolve_dtype(param.tpu_dtype)
         self.param = param
         self.dtype = dtype
+        if param.obstacles.strip():
+            raise ValueError(
+                "3-D obstacles are single-device only for now; run with "
+                "tpu_mesh 1 (the 2-D obstacle solver runs distributed)"
+            )
         self.comm = comm if comm is not None else CartComm(ndims=3)
         self.grid = Grid(
             imax=param.imax,

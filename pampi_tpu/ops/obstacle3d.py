@@ -1,0 +1,288 @@
+"""Flag-field obstacle cells for NS-3D — the 3-D extension of
+ops/obstacle.py (NaSt3D-style boxes), branch-free masks, TPU-first.
+
+The reference has no obstacle support in 2-D or 3-D; the 2-D flag field
+implements the BASELINE.json channel-with-obstacle config, and this module
+carries the same design to the 3-D solver (assignment-6's model family):
+
+- geometry is static config (.par `obstacles` key: semicolon-separated
+  axis-aligned BOXES `x0,y0,z0,x1,y1,z1` in physical coordinates — the 2-D
+  form keeps its 4-value rectangles), so all masks are trace-time constants
+- velocity: normal components on obstacle faces are zeroed; tangential
+  components on faces buried in obstacles mirror the nearest fluid-fluid
+  face (priority j± then k± for u, i± then k± for v, i± then j± for w) so
+  the interpolated wall velocity is zero (no-slip)
+- momentum fluxes: F/G/H carry U/V/W on non-fluid faces (the wall-fixup
+  trick, assignment-6/src/solver.c:771-823) so div = 0 across obstacle
+  walls and the projection leaves them untouched
+- pressure: per-direction fluid coefficients eps_{e,w,n,s,f,b} ∈ {0,1} in
+  numerator and denominator — homogeneous Neumann on obstacle surfaces,
+  per-cell relaxation ω/denom precomputed; residual and normalization
+  reduce over fluid cells only
+- the pressure solve runs the jnp eps-coefficient path (the 3-D Pallas
+  kernel has no masked mode yet; the 2-D one does); mg/fft are rejected for
+  obstacle runs in 3-D exactly as in 2-D (non-constant-coefficient stencil)
+
+Obstacles must be >= 2 cells thick per axis (validated, like NaSt2D's
+flag-consistency check). Layout matches ops/ns3d.py: (kmax+2, jmax+2,
+imax+2) arrays [k, j, i]; u on east faces (i), v on north faces (j), w on
+back faces (k); the ghost shell counts as fluid so domain-wall BCs compose
+unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_obstacles_3d(spec: str) -> list[tuple[float, ...]]:
+    """Parse `obstacles` as 3-D boxes "x0,y0,z0,x1,y1,z1[;...]"."""
+    boxes = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        vals = [float(v) for v in part.split(",")]
+        if len(vals) != 6:
+            raise ValueError(
+                f"3-D obstacle box needs 6 values x0,y0,z0,x1,y1,z1, "
+                f"got {part!r}"
+            )
+        x0, y0, z0, x1, y1, z1 = vals
+        boxes.append((
+            min(x0, x1), min(y0, y1), min(z0, z1),
+            max(x0, x1), max(y0, y1), max(z0, z1),
+        ))
+    return boxes
+
+
+def build_fluid_3d(imax, jmax, kmax, dx, dy, dz, spec: str) -> np.ndarray:
+    """Boolean fluid mask (kmax+2, jmax+2, imax+2); True = fluid. A cell is
+    obstacle iff its center lies inside any box. Ghost shell is always
+    fluid (domain walls belong to the wall-BC code)."""
+    fluid = np.ones((kmax + 2, jmax + 2, imax + 2), dtype=bool)
+    x = (np.arange(imax + 2) - 0.5) * dx
+    y = (np.arange(jmax + 2) - 0.5) * dy
+    z = (np.arange(kmax + 2) - 0.5) * dz
+    for (x0, y0, z0, x1, y1, z1) in parse_obstacles_3d(spec):
+        inside = (
+            (x[None, None, :] > x0) & (x[None, None, :] < x1)
+            & (y[None, :, None] > y0) & (y[None, :, None] < y1)
+            & (z[:, None, None] > z0) & (z[:, None, None] < z1)
+        )
+        fluid &= ~inside
+    fluid[0], fluid[-1] = True, True
+    fluid[:, 0], fluid[:, -1] = True, True
+    fluid[:, :, 0], fluid[:, :, -1] = True, True
+    _validate_3d(fluid)
+    return fluid
+
+
+def _validate_3d(fluid: np.ndarray) -> None:
+    obs = ~fluid[1:-1, 1:-1, 1:-1]
+    thin_i = obs & fluid[1:-1, 1:-1, :-2] & fluid[1:-1, 1:-1, 2:]
+    thin_j = obs & fluid[1:-1, :-2, 1:-1] & fluid[1:-1, 2:, 1:-1]
+    thin_k = obs & fluid[:-2, 1:-1, 1:-1] & fluid[2:, 1:-1, 1:-1]
+    if thin_i.any() or thin_j.any() or thin_k.any():
+        raise ValueError(
+            "obstacle cells with fluid on two opposite sides (1-cell-thin "
+            "walls) are not representable; make obstacles >= 2 cells thick"
+        )
+
+
+@dataclass(frozen=True)
+class ObstacleMasks3D:
+    """Static mask arrays for one geometry+grid (trace-time constants)."""
+
+    fluid: jnp.ndarray    # (K+2, J+2, I+2) 0/1 cell-is-fluid
+    u_face: jnp.ndarray   # 1 where u[k,j,i] is a fluid-fluid face (i dir)
+    v_face: jnp.ndarray   # (j dir)
+    w_face: jnp.ndarray   # (k dir)
+    p_mask: jnp.ndarray   # (K, J, I) interior fluid-cell mask
+    eps_e: jnp.ndarray    # (K, J, I): neighbour in +i is fluid (and cell is)
+    eps_w: jnp.ndarray
+    eps_n: jnp.ndarray    # +j
+    eps_s: jnp.ndarray
+    eps_b: jnp.ndarray    # +k (back)
+    eps_f: jnp.ndarray    # -k (front)
+    factor: jnp.ndarray   # (K, J, I) per-cell omega / denom (0 in obstacles)
+    n_fluid: float
+    omega: float
+
+    @property
+    def any_obstacle(self) -> bool:
+        full = self.p_mask.shape[0] * self.p_mask.shape[1] * self.p_mask.shape[2]
+        return float(self.n_fluid) < full
+
+
+def make_masks_3d(fluid_np: np.ndarray, dx, dy, dz, omega, dtype
+                  ) -> ObstacleMasks3D:
+    f = fluid_np
+    u_face = f & np.roll(f, -1, axis=2)
+    u_face[:, :, -1] = True  # roll wrap on the ghost column; ghosts are fluid
+    v_face = f & np.roll(f, -1, axis=1)
+    v_face[:, -1, :] = True
+    w_face = f & np.roll(f, -1, axis=0)
+    w_face[-1, :, :] = True
+    fi = f[1:-1, 1:-1, 1:-1]
+    eps_e = (f[1:-1, 1:-1, 2:] & fi).astype(np.float64)
+    eps_w = (f[1:-1, 1:-1, :-2] & fi).astype(np.float64)
+    eps_n = (f[1:-1, 2:, 1:-1] & fi).astype(np.float64)
+    eps_s = (f[1:-1, :-2, 1:-1] & fi).astype(np.float64)
+    eps_b = (f[2:, 1:-1, 1:-1] & fi).astype(np.float64)
+    eps_f = (f[:-2, 1:-1, 1:-1] & fi).astype(np.float64)
+    idx2, idy2, idz2 = 1.0 / (dx * dx), 1.0 / (dy * dy), 1.0 / (dz * dz)
+    denom = ((eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
+             + (eps_b + eps_f) * idz2)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        factor = np.where(denom > 0, omega / denom, 0.0) * fi
+    return ObstacleMasks3D(
+        fluid=jnp.asarray(f, dtype),
+        u_face=jnp.asarray(u_face, dtype),
+        v_face=jnp.asarray(v_face, dtype),
+        w_face=jnp.asarray(w_face, dtype),
+        p_mask=jnp.asarray(fi, dtype),
+        eps_e=jnp.asarray(eps_e, dtype),
+        eps_w=jnp.asarray(eps_w, dtype),
+        eps_n=jnp.asarray(eps_n, dtype),
+        eps_s=jnp.asarray(eps_s, dtype),
+        eps_b=jnp.asarray(eps_b, dtype),
+        eps_f=jnp.asarray(eps_f, dtype),
+        factor=jnp.asarray(factor, dtype),
+        n_fluid=float(fi.sum()),
+        omega=float(omega),
+    )
+
+
+def _mirror(comp, both_obs, faces_and_vals):
+    """comp += both_obs * first-hit mirror of the neighbouring fluid-fluid
+    faces, in priority order [(face_mask, value), ...]."""
+    one = jnp.ones((), comp.dtype)
+    acc = jnp.zeros_like(comp)
+    remaining = jnp.ones_like(comp)
+    for fm, val in faces_and_vals:
+        acc = acc + remaining * fm * (-val)
+        remaining = remaining * (one - fm)
+    return comp + both_obs * acc
+
+
+def apply_obstacle_velocity_bc_3d(u, v, w, m: ObstacleMasks3D):
+    """No-slip on obstacle surfaces: zero normal components on any face
+    touching an obstacle; mirror tangential ghosts from the nearest
+    fluid-fluid face so interpolated wall velocities vanish (the 3-D form
+    of ops/obstacle.apply_obstacle_velocity_bc)."""
+    one = jnp.ones((), u.dtype)
+    u = u * m.u_face
+    v = v * m.v_face
+    w = w * m.w_face
+
+    # u-faces buried in obstacles mirror across the nearer tangential wall
+    both_u = (one - m.fluid) * (one - jnp.roll(m.fluid, -1, axis=2))
+    u = _mirror(u, both_u, [
+        (jnp.roll(m.u_face, -1, 1), jnp.roll(u, -1, 1)),   # north (j+1)
+        (jnp.roll(m.u_face, 1, 1), jnp.roll(u, 1, 1)),     # south (j-1)
+        (jnp.roll(m.u_face, -1, 0), jnp.roll(u, -1, 0)),   # back  (k+1)
+        (jnp.roll(m.u_face, 1, 0), jnp.roll(u, 1, 0)),     # front (k-1)
+    ])
+    both_v = (one - m.fluid) * (one - jnp.roll(m.fluid, -1, axis=1))
+    v = _mirror(v, both_v, [
+        (jnp.roll(m.v_face, -1, 2), jnp.roll(v, -1, 2)),   # east  (i+1)
+        (jnp.roll(m.v_face, 1, 2), jnp.roll(v, 1, 2)),     # west  (i-1)
+        (jnp.roll(m.v_face, -1, 0), jnp.roll(v, -1, 0)),   # back
+        (jnp.roll(m.v_face, 1, 0), jnp.roll(v, 1, 0)),     # front
+    ])
+    both_w = (one - m.fluid) * (one - jnp.roll(m.fluid, -1, axis=0))
+    w = _mirror(w, both_w, [
+        (jnp.roll(m.w_face, -1, 2), jnp.roll(w, -1, 2)),   # east
+        (jnp.roll(m.w_face, 1, 2), jnp.roll(w, 1, 2)),     # west
+        (jnp.roll(m.w_face, -1, 1), jnp.roll(w, -1, 1)),   # north
+        (jnp.roll(m.w_face, 1, 1), jnp.roll(w, 1, 1)),     # south
+    ])
+    return u, v, w
+
+
+# -- pressure: eps-coefficient SOR -----------------------------------------
+
+def sor_pass_obstacle_3d(p, rhs, color_mask, m: ObstacleMasks3D,
+                         idx2, idy2, idz2):
+    """One masked half-sweep with per-direction fluid coefficients
+    (3-D form of sor_pass_obstacle). Returns (p, sum of masked r²)."""
+    c = p[1:-1, 1:-1, 1:-1]
+    lap = (
+        m.eps_e * (p[1:-1, 1:-1, 2:] - c) + m.eps_w * (p[1:-1, 1:-1, :-2] - c)
+    ) * idx2 + (
+        m.eps_n * (p[1:-1, 2:, 1:-1] - c) + m.eps_s * (p[1:-1, :-2, 1:-1] - c)
+    ) * idy2 + (
+        m.eps_b * (p[2:, 1:-1, 1:-1] - c) + m.eps_f * (p[:-2, 1:-1, 1:-1] - c)
+    ) * idz2
+    r = (rhs[1:-1, 1:-1, 1:-1] - lap) * color_mask * m.p_mask
+    p = p.at[1:-1, 1:-1, 1:-1].add(-m.factor * r)
+    return p, jnp.sum(r * r)
+
+
+def make_obstacle_solver_fn_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
+                               m: ObstacleMasks3D, dtype):
+    """Pressure-solve convergence loop with 3-D obstacle coefficients (jnp
+    eps-coefficient path — the 3-D Pallas kernel has no masked mode yet).
+    Residual normalized by the FLUID cell count (documented deviation from
+    the reference's every-cell norm, as in 2-D)."""
+    import jax
+
+    from ..utils import flags as _flags
+    from ..models.ns3d import checkerboard_mask_3d, neumann_faces_3d
+
+    idx2, idy2, idz2 = 1.0 / (dx * dx), 1.0 / (dy * dy), 1.0 / (dz * dz)
+    epssq = eps * eps
+    norm = m.n_fluid
+    odd = checkerboard_mask_3d(kmax, jmax, imax, 1, dtype)
+    even = checkerboard_mask_3d(kmax, jmax, imax, 0, dtype)
+
+    def solve(p0, rhs):
+        def cond(c):
+            _, res, it = c
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(c):
+            p, _, it = c
+            p, r0 = sor_pass_obstacle_3d(p, rhs, odd, m, idx2, idy2, idz2)
+            p, r1 = sor_pass_obstacle_3d(p, rhs, even, m, idx2, idy2, idz2)
+            p = neumann_faces_3d(p)
+            res = (r0 + r1) / norm
+            if _flags.debug():
+                jax.debug.print("{} Residuum: {}", it, res)
+            return p, res, it + 1
+
+        return jax.lax.while_loop(
+            cond, body,
+            (p0, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
+        )
+
+    return solve
+
+
+def mask_fgh(f, g, h, u, v, w, m: ObstacleMasks3D):
+    """F/G/H carry U/V/W on every non-fluid face — obstacle analog of the
+    reference's 6-face wall fixups (solver.c:771-823): the divergence RHS
+    sees zero flux across obstacle walls."""
+    one = jnp.ones((), f.dtype)
+    f = m.u_face * f + (one - m.u_face) * u
+    g = m.v_face * g + (one - m.v_face) * v
+    h = m.w_face * h + (one - m.w_face) * w
+    return f, g, h
+
+
+def adapt_uvw_obstacle(u, v, w, f, g, h, p, dt, dx, dy, dz,
+                       m: ObstacleMasks3D):
+    """Projection restricted to fluid-fluid faces (3-D adapt_uv_obstacle)."""
+    fx, fy, fz = dt / dx, dt / dy, dt / dz
+    I = np.s_[1:-1]
+    u_new = f[I, I, I] - (p[I, I, 2:] - p[I, I, I]) * fx
+    v_new = g[I, I, I] - (p[I, 2:, I] - p[I, I, I]) * fy
+    w_new = h[I, I, I] - (p[2:, I, I] - p[I, I, I]) * fz
+    u = u.at[I, I, I].set(u_new * m.u_face[I, I, I])
+    v = v.at[I, I, I].set(v_new * m.v_face[I, I, I])
+    w = w.at[I, I, I].set(w_new * m.w_face[I, I, I])
+    return u, v, w
