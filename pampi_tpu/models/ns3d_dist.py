@@ -73,11 +73,6 @@ class NS3DDistSolver:
             dtype = resolve_dtype(param.tpu_dtype)
         self.param = param
         self.dtype = dtype
-        if param.obstacles.strip():
-            raise ValueError(
-                "3-D obstacles are single-device only for now; run with "
-                "tpu_mesh 1 (the 2-D obstacle solver runs distributed)"
-            )
         self.comm = comm if comm is not None else CartComm(ndims=3)
         self.grid = Grid(
             imax=param.imax,
@@ -95,6 +90,24 @@ class NS3DDistSolver:
         self.dt_bound = 0.5 * param.re / inv_sqr_sum
         self.t = 0.0
         self.nt = 0
+        # flag-field obstacles: GLOBAL static geometry; every shard slices
+        # its mask blocks inside the kernel (ops/obstacle3d.shard_masks_3d)
+        if param.obstacles.strip():
+            if param.tpu_solver in ("mg", "fft"):
+                raise ValueError(
+                    f"tpu_solver {param.tpu_solver} does not support "
+                    "obstacle flag fields; use tpu_solver sor"
+                )
+            from ..ops import obstacle3d as obst3
+
+            fluid = obst3.build_fluid_3d(
+                g.imax, g.jmax, g.kmax, g.dx, g.dy, g.dz, param.obstacles
+            )
+            self.masks = obst3.make_masks_3d(
+                fluid, g.dx, g.dy, g.dz, param.omg, dtype
+            )
+        else:
+            self.masks = None
         self._build()
         self.u, self.v, self.w, self.p = self._init_sm()
 
@@ -223,8 +236,29 @@ class NS3DDistSolver:
                 comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                 param.eps, param.itermax, dtype,
             )
+        elif self.masks is not None:
+            from ..ops.obstacle3d import make_dist_obstacle_solver_3d
+
+            solve = make_dist_obstacle_solver_3d(
+                comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
+                param.eps, param.itermax, self.masks, dtype,
+                ca_n=param.tpu_ca_inner,
+            )
         else:
             solve = _solve_sor
+
+        gmasks = self.masks
+        if gmasks is not None:
+            from ..ops.obstacle3d import (
+                adapt_uvw_obstacle,
+                apply_obstacle_velocity_bc_3d,
+                mask_fgh,
+                shard_masks_3d,
+            )
+
+            def local_masks():
+                # must run INSIDE the shard_map trace (mesh offsets)
+                return shard_masks_3d(gmasks, kl, jl, il)
 
         def compute_dt(u, v, w):
             umax = reduction(jnp.max(jnp.abs(u)), comm, "max")
@@ -256,17 +290,32 @@ class NS3DDistSolver:
             u = halo_exchange(u, comm)
             v = halo_exchange(v, comm)
             w = halo_exchange(w, comm)
+            if gmasks is not None:
+                # needs the fully-exchanged post-BC state (the single-device
+                # op reads the whole array at once); its own halo-cell
+                # outputs are refreshed by one more exchange
+                u, v, w = apply_obstacle_velocity_bc_3d(u, v, w, local_masks())
+                u = halo_exchange(u, comm)
+                v = halo_exchange(v, comm)
+                w = halo_exchange(w, comm)
             f, g_, h = ops.compute_fgh_interior(
                 u, v, w, dt, param.re, param.gx, param.gy, param.gz,
                 param.gamma, dx, dy, dz,
             )
             f, g_, h = fgh_fixups(f, g_, h, u, v, w)
+            if gmasks is not None:
+                f, g_, h = mask_fgh(f, g_, h, u, v, w, local_masks())
             f = halo_shift(f, comm, "i")
             g_ = halo_shift(g_, comm, "j")
             h = halo_shift(h, comm, "k")
             rhs = ops.compute_rhs(f, g_, h, dt, dx, dy, dz)
             p, _res, _it = solve(p, rhs)
-            u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
+            if gmasks is not None:
+                u, v, w = adapt_uvw_obstacle(
+                    u, v, w, f, g_, h, p, dt, dx, dy, dz, local_masks()
+                )
+            else:
+                u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
             if _flags.verbose():
                 master_print(comm, "TIME {} , TIMESTEP {}", t, dt)
             return u, v, w, p, t + dt.astype(idx_dtype), nt + 1

@@ -286,3 +286,197 @@ def adapt_uvw_obstacle(u, v, w, f, g, h, p, dt, dx, dy, dz,
     v = v.at[I, I, I].set(v_new * m.v_face[I, I, I])
     w = w.at[I, I, I].set(w_new * m.w_face[I, I, I])
     return u, v, w
+
+# ----------------------------------------------------------------------
+# Distributed obstacles (call INSIDE shard_map): the geometry is static and
+# GLOBAL, so every shard slices its own extended/interior mask blocks from
+# the global constants by mesh offsets — no flag exchange, ever (the 3-D
+# form of ops/obstacle.shard_masks and friends).
+# ----------------------------------------------------------------------
+
+
+def shard_masks_3d(m: ObstacleMasks3D, kl: int, jl: int, il: int
+                   ) -> ObstacleMasks3D:
+    """This shard's view of the global mask set: extended-block fields at
+    the extended origin, interior fields at the interior origin."""
+    from jax import lax as _lax
+
+    from ..parallel.comm import get_offsets
+
+    koff = get_offsets("k", kl)
+    joff = get_offsets("j", jl)
+    ioff = get_offsets("i", il)
+
+    def ext(a):
+        return _lax.dynamic_slice(a, (koff, joff, ioff), (kl + 2, jl + 2, il + 2))
+
+    def inter(a):
+        return _lax.dynamic_slice(a, (koff, joff, ioff), (kl, jl, il))
+
+    return ObstacleMasks3D(
+        fluid=ext(m.fluid),
+        u_face=ext(m.u_face),
+        v_face=ext(m.v_face),
+        w_face=ext(m.w_face),
+        p_mask=inter(m.p_mask),
+        eps_e=inter(m.eps_e),
+        eps_w=inter(m.eps_w),
+        eps_n=inter(m.eps_n),
+        eps_s=inter(m.eps_s),
+        eps_b=inter(m.eps_b),
+        eps_f=inter(m.eps_f),
+        factor=inter(m.factor),
+        n_fluid=m.n_fluid,
+        omega=m.omega,
+    )
+
+
+def deep_obstacle_masks_3d(m: ObstacleMasks3D, kl, jl, il, halo: int):
+    """Interior-mask slices for the deep-halo CA layout (3-D form of
+    deep_obstacle_masks): pad the GLOBAL interior constants by H-1 zeros and
+    slice at the plain mesh offsets — identical values on every shard that
+    sees a cell, so redundant halo updates stay bitwise-consistent."""
+    from jax import lax as _lax
+
+    from ..parallel.comm import get_offsets
+
+    H = halo
+    koff = get_offsets("k", kl)
+    joff = get_offsets("j", jl)
+    ioff = get_offsets("i", il)
+    pad = [(H - 1, H - 1)] * 3
+    size = (kl + 2 * H - 2, jl + 2 * H - 2, il + 2 * H - 2)
+
+    def inter(a):
+        return _lax.dynamic_slice(jnp.pad(a, pad), (koff, joff, ioff), size)
+
+    return {
+        "p_mask": inter(m.p_mask),
+        "eps_e": inter(m.eps_e),
+        "eps_w": inter(m.eps_w),
+        "eps_n": inter(m.eps_n),
+        "eps_s": inter(m.eps_s),
+        "eps_b": inter(m.eps_b),
+        "eps_f": inter(m.eps_f),
+        "factor": inter(m.factor),
+    }
+
+
+def _obstacle_half_3d(p, rhs, color, om, idx2, idy2, idz2):
+    """One eps-coefficient half-sweep on an extended block — op-for-op
+    sor_pass_obstacle_3d for bitwise parity with the single-device path."""
+    c = p[1:-1, 1:-1, 1:-1]
+    lap = (
+        om["eps_e"] * (p[1:-1, 1:-1, 2:] - c)
+        + om["eps_w"] * (p[1:-1, 1:-1, :-2] - c)
+    ) * idx2 + (
+        om["eps_n"] * (p[1:-1, 2:, 1:-1] - c)
+        + om["eps_s"] * (p[1:-1, :-2, 1:-1] - c)
+    ) * idy2 + (
+        om["eps_b"] * (p[2:, 1:-1, 1:-1] - c)
+        + om["eps_f"] * (p[:-2, 1:-1, 1:-1] - c)
+    ) * idz2
+    r = (rhs[1:-1, 1:-1, 1:-1] - lap) * color
+    return p.at[1:-1, 1:-1, 1:-1].add(-om["factor"] * r), r
+
+
+def ca_rb_iters_obstacle_3d(p, rhs, n: int, cm, om, idx2, idy2, idz2):
+    """n full red-black iterations of the 3-D eps-coefficient stencil on the
+    deep-halo extended block (obstacle twin of stencil3d.ca_rb_iters_3d).
+    cm = stencil3d.ca_masks_3d set, om = deep_obstacle_masks_3d set."""
+    from ..parallel.stencil3d import neumann_masked_3d
+
+    odd = cm["odd"][1:-1, 1:-1, 1:-1] * om["p_mask"]
+    even = cm["even"][1:-1, 1:-1, 1:-1] * om["p_mask"]
+    r_odd = r_evn = None
+    for _ in range(n):
+        p, r_odd = _obstacle_half_3d(p, rhs, odd, om, idx2, idy2, idz2)
+        p, r_evn = _obstacle_half_3d(p, rhs, even, om, idx2, idy2, idz2)
+        p = neumann_masked_3d(p, cm)
+    r2 = jnp.sum(
+        jnp.where(
+            cm["owned"][1:-1, 1:-1, 1:-1],
+            r_odd * r_odd + r_evn * r_evn,
+            0.0,
+        )
+    )
+    return p, r2
+
+
+def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
+                                 dx, dy, dz, eps, itermax,
+                                 m: ObstacleMasks3D, dtype, ca_n: int = 1):
+    """Distributed 3-D eps-coefficient pressure solve (shard_map kernel
+    side), communication-avoiding like the uniform solve: one depth-2n halo
+    exchange buys n exact local red-black iterations (static global masks
+    keep redundant halo updates bitwise-consistent). Residual normalized by
+    the global fluid-cell count; extent-1 shards fall back to
+    exchange-per-half-sweep."""
+    import jax as _jax
+
+    from ..parallel.comm import halo_exchange, master_print, reduction
+    from ..parallel.stencil2d import (
+        ca_clamp,
+        ca_halo,
+        ca_supported,
+        embed_deep,
+        strip_deep,
+    )
+    from ..parallel.stencil3d import (
+        ca_masks_3d,
+        neumann_masked_3d,
+    )
+    from ..utils import flags as _flags
+
+    idx2, idy2, idz2 = 1.0 / (dx * dx), 1.0 / (dy * dy), 1.0 / (dz * dz)
+    epssq = eps * eps
+    norm = m.n_fluid
+    supported = ca_supported(kl, jl, il)
+    n = ca_clamp(ca_n, kl, jl, il) if supported else 1
+    H = ca_halo(n) if supported else 1
+
+    def solve(p, rhs):
+        cm = ca_masks_3d(kl, jl, il, H, kmax, jmax, imax, dtype)
+        om = deep_obstacle_masks_3d(m, kl, jl, il, H)
+        pd = embed_deep(p, H)
+        rd = halo_exchange(embed_deep(rhs, H), comm, depth=H)
+
+        def cond(c):
+            _, res, it = c
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(c):
+            pd, _, it = c
+            if supported:
+                pd = halo_exchange(pd, comm, depth=H)
+                pd, r2 = ca_rb_iters_obstacle_3d(
+                    pd, rd, n, cm, om, idx2, idy2, idz2
+                )
+            else:
+                odd = cm["odd"][1:-1, 1:-1, 1:-1] * om["p_mask"]
+                even = cm["even"][1:-1, 1:-1, 1:-1] * om["p_mask"]
+                pd2 = halo_exchange(pd, comm)
+                pd2, r_odd = _obstacle_half_3d(pd2, rd, odd, om,
+                                               idx2, idy2, idz2)
+                pd2 = halo_exchange(pd2, comm)
+                pd2, r_evn = _obstacle_half_3d(pd2, rd, even, om,
+                                               idx2, idy2, idz2)
+                pd = neumann_masked_3d(pd2, cm)
+                r2 = jnp.sum(
+                    jnp.where(
+                        cm["owned"][1:-1, 1:-1, 1:-1],
+                        r_odd * r_odd + r_evn * r_evn,
+                        0.0,
+                    )
+                )
+            res = reduction(r2, comm, "sum") / norm
+            if _flags.debug():
+                master_print(comm, "{} Residuum: {}", it + (n - 1), res)
+            return pd, res, it + n
+
+        pd, res, it = _jax.lax.while_loop(
+            cond, body, (pd, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        )
+        return halo_exchange(strip_deep(pd, H), comm), res, it
+
+    return solve
