@@ -150,30 +150,10 @@ def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
         )
         if rb_iter is None:
             raise ValueError("pallas 3-D backend unavailable")
-
-        def solve(p, rhs):
-            pp = sp3.pad_array_3d(p, block_k, n_inner)
-            rp = sp3.pad_array_3d(rhs, block_k, n_inner)
-
-            def cond(c):
-                _, res, it = c
-                return jnp.logical_and(res >= epssq, it < itermax)
-
-            def body(c):
-                pp, _, it = c
-                pp, rsq = rb_iter(pp, rp)
-                if _flags.debug():
-                    jax.debug.print("{} Residuum: {}", it + (n_inner - 1),
-                                    rsq / norm)
-                return pp, rsq / norm, it + n_inner
-
-            pp, res, it = lax.while_loop(
-                cond, body,
-                (pp, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
-            )
-            return sp3.unpad_array_3d(pp, kmax, jmax, imax, n_inner), res, it
-
-        return solve
+        return sp3.make_tblock_solve_loop(
+            rb_iter, block_k, n_inner, norm, eps, itermax,
+            kmax, jmax, imax, dtype,
+        )
 
     factor, idx2, idy2, idz2 = sor_coefficients_3d(dx, dy, dz, omega)
     odd = checkerboard_mask_3d(kmax, jmax, imax, 1, dtype)
@@ -252,8 +232,6 @@ class NS3DSolver:
     def _uses_pallas(self) -> bool:
         if self.param.tpu_solver in ("mg", "fft"):
             return False  # mg/fft chunks contain no pallas kernel
-        if self.masks is not None:
-            return False  # the 3-D obstacle solve is the jnp eps path
         return _use_pallas_3d(self._backend, self.dtype)
 
     def _build_step(self, backend: str = "auto"):
@@ -268,6 +246,7 @@ class NS3DSolver:
             solve = make_obstacle_solver_fn_3d(
                 g.imax, g.jmax, g.kmax, dx, dy, dz,
                 param.eps, param.itermax, masks, dtype,
+                backend=backend, n_inner=param.tpu_sor_inner,
             )
         else:
             solve = make_pressure_solve_3d(

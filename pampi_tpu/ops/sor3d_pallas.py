@@ -69,19 +69,24 @@ def tblock3d_halo(n_inner: int) -> int:
 
 
 def pick_block_k(kmax: int, jmax: int, imax: int, dtype=jnp.float32,
-                 n_inner: int = 1) -> int:
+                 n_inner: int = 1, masked: bool = False) -> int:
     """Block depth (planes per grid step). The kernel's resident planes are
     2·(bk+2h) window + 2·bk store buffers = 6·bk + 8·h; budget them against
     ~half the raised VMEM limit (Mosaic temporaries take the rest), capped by
-    the whole grid and a per-step-overhead floor."""
+    the whole grid and a per-step-overhead floor.
+
+    masked adds a third double-buffered flag window (+2·(bk+2h) planes) AND
+    seven flag-derived full-window temporaries (eps_e..eps_f, fac) live
+    across the inner loop — budget 15·bk + 18·h resident planes there."""
     jp, ip = padded_ji(jmax, imax, dtype)
     plane = jp * ip * jnp.dtype(dtype).itemsize
     h = tblock3d_halo(n_inner)
     # ~4 MiB per window buffer measured fastest at 128³ on v5e (larger blocks
     # add VMEM pressure, smaller ones pay more per-grid-step overhead) ...
     bk = (4 << 20) // plane - 2 * h
-    # ... clamped to what the 6·bk + 8·h resident planes can actually hold
-    feasible = ((VMEM_LIMIT_BYTES // 2) // plane - 8 * h) // 6
+    # ... clamped to what the resident planes can actually hold
+    per_bk, per_h = (15, 18) if masked else (6, 8)
+    feasible = ((VMEM_LIMIT_BYTES // 2) // plane - per_h * h) // per_bk
     return max(1, min(bk, feasible, kmax + 2, 64))
 
 
@@ -115,17 +120,7 @@ def unpad_array_3d(xp, kmax: int, jmax: int, imax: int, n_inner: int = 1):
 
 
 def _tblock3d_kernel(
-    p_in,  # ANY, padded (Kp, Jp, Ip)
-    rhs,  # ANY, padded
-    p_out,  # ANY, padded
-    res,  # SMEM (1, 1)
-    pw2,  # VMEM (2, BK+2H, Jp, Ip) double-buffered p windows
-    rw2,  # VMEM (2, BK+2H, Jp, Ip) rhs windows
-    ob2,  # VMEM (2, BK, Jp, Ip) store buffers
-    vacc,  # VMEM (1, Ip) per-lane residual accumulator
-    ld_sem,  # DMA (2, 2)
-    st_sem,  # DMA (2,)
-    *,
+    *refs,  # see unpacking below: [p_in, rhs(, flg)] + [p_out, res] + scratch
     n_inner: int,
     block_k: int,
     nblocks: int,
@@ -134,10 +129,26 @@ def _tblock3d_kernel(
     imax: int,
     halo: int,
     factor: float,
+    omega: float,
     idx2: float,
     idy2: float,
     idz2: float,
+    masked: bool,
 ):
+    """masked=True adds a fluid-flag input (ops/obstacle3d.py flag field,
+    padded) and switches the stencil to per-direction fluid coefficients
+    with a per-cell relaxation ω/denom — the 3-D form of the 2-D kernel's
+    masked mode (_tblock_kernel); arithmetic matches
+    ops/obstacle3d.sor_pass_obstacle_3d term-for-term. Flag-derived
+    coefficient arrays are computed once per block, outside the iteration
+    loop."""
+    if masked:
+        (p_in, rhs, flg, p_out, res,
+         pw2, rw2, fw2, ob2, vacc, ld_sem, st_sem) = refs
+    else:
+        (p_in, rhs, p_out, res,
+         pw2, rw2, ob2, vacc, ld_sem, st_sem) = refs
+        flg = fw2 = None
     b = pl.program_id(0)
     bk = block_k
     h = halo
@@ -145,14 +156,22 @@ def _tblock3d_kernel(
     nslot = (b + 1) % 2
 
     def load(k, s):
-        return (
+        copies = [
             pltpu.make_async_copy(
                 p_in.at[pl.ds(k * bk, bk + 2 * h)], pw2.at[s], ld_sem.at[s, 0]
             ),
             pltpu.make_async_copy(
                 rhs.at[pl.ds(k * bk, bk + 2 * h)], rw2.at[s], ld_sem.at[s, 1]
             ),
-        )
+        ]
+        if masked:
+            copies.append(
+                pltpu.make_async_copy(
+                    flg.at[pl.ds(k * bk, bk + 2 * h)], fw2.at[s],
+                    ld_sem.at[s, 2],
+                )
+            )
+        return copies
 
     def store(k, s):
         return pltpu.make_async_copy(
@@ -200,25 +219,47 @@ def _tblock3d_kernel(
     left = (ii == 0) & tan_kj
     right = (ii == imax + 1) & tan_kj
 
-    def lap(x):
-        east = jnp.roll(x, -1, axis=2)
-        west = jnp.roll(x, 1, axis=2)
-        north = jnp.roll(x, -1, axis=1)
-        south = jnp.roll(x, 1, axis=1)
-        back_ = jnp.roll(x, -1, axis=0)
-        frnt = jnp.roll(x, 1, axis=0)
+    def _neighbours(x):
         return (
-            (east - 2.0 * x + west) * idx2
-            + (north - 2.0 * x + south) * idy2
-            + (back_ - 2.0 * x + frnt) * idz2
+            jnp.roll(x, -1, axis=2), jnp.roll(x, 1, axis=2),   # east, west
+            jnp.roll(x, -1, axis=1), jnp.roll(x, 1, axis=1),   # north, south
+            jnp.roll(x, -1, axis=0), jnp.roll(x, 1, axis=0),   # back, front
         )
+
+    if masked:
+        # per-block constants (flags don't change across inner iterations)
+        fl = fw2[slot]
+        odd = odd & (fl != 0)
+        even = even & (fl != 0)
+        eps_e, eps_w, eps_n, eps_s, eps_b, eps_f = _neighbours(fl)
+        denom = ((eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
+                 + (eps_b + eps_f) * idz2)
+        fac = jnp.where(denom > 0, omega / denom, 0.0) * fl
+
+        def lap(x):
+            east, west, north, south, back_, frnt = _neighbours(x)
+            return (
+                (eps_e * (east - x) + eps_w * (west - x)) * idx2
+                + (eps_n * (north - x) + eps_s * (south - x)) * idy2
+                + (eps_b * (back_ - x) + eps_f * (frnt - x)) * idz2
+            )
+    else:
+        fac = factor
+
+        def lap(x):
+            east, west, north, south, back_, frnt = _neighbours(x)
+            return (
+                (east - 2.0 * x + west) * idx2
+                + (north - 2.0 * x + south) * idy2
+                + (back_ - 2.0 * x + frnt) * idz2
+            )
 
     r_odd = r_evn = None
     for _t in range(n_inner):
         r_odd = jnp.where(odd, rw - lap(p), 0.0)
-        p = p - factor * r_odd
+        p = p - fac * r_odd
         r_evn = jnp.where(even, rw - lap(p), 0.0)
-        p = p - factor * r_evn
+        p = p - fac * r_evn
         # Neumann ghost refresh (faces only; edges/corners/dead cells untouched)
         p = jnp.where(front, jnp.roll(p, -1, axis=0), p)
         p = jnp.where(back, jnp.roll(p, 1, axis=0), p)
@@ -261,15 +302,22 @@ def make_rb_iter_tblock_3d(
     n_inner: int = 1,
     block_k: int | None = None,
     interpret: bool | None = None,
+    fluid=None,
 ):
     """Build `(p_padded, rhs_padded) -> (p_padded', res_sumsq_of_last_iter)`
     where one call performs `n_inner` 3-D red-black iterations + Neumann BCs.
     Returns (rb_iter, block_k); pad with `pad_array_3d(x, block_k, n_inner)`.
+
+    fluid: optional (kmax+2, jmax+2, imax+2) 0/1 flag field
+    (ops/obstacle3d.py) — switches to the obstacle stencil (per-direction
+    fluid coefficients, per-cell factor); the padded flag array is baked
+    into the returned closure as a constant.
     """
     if pltpu is None:
         return None, 0
     if block_k is None:
-        block_k = pick_block_k(kmax, jmax, imax, dtype, n_inner)
+        block_k = pick_block_k(kmax, jmax, imax, dtype, n_inner,
+                               masked=fluid is not None)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     _check_dtype(dtype, interpret)
@@ -278,6 +326,7 @@ def make_rb_iter_tblock_3d(
     from ..models.ns3d import sor_coefficients_3d
 
     factor, idx2, idy2, idz2 = sor_coefficients_3d(dx, dy, dz, omega)
+    masked = fluid is not None
     h = tblock3d_halo(n_inner)
     jp, ip = padded_ji(jmax, imax, dtype)
     nblocks = -(-(kmax + 2) // block_k)
@@ -292,17 +341,29 @@ def make_rb_iter_tblock_3d(
         imax=imax,
         halo=h,
         factor=factor,
+        omega=omega,
         idx2=idx2,
         idy2=idy2,
         idz2=idz2,
+        masked=masked,
     )
+    n_in = 3 if masked else 2
+    scratch = [
+        pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+        pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+    ]
+    if masked:
+        scratch.append(pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype))
+    scratch += [
+        pltpu.VMEM((2, block_k, jp, ip), dtype),
+        pltpu.VMEM((1, ip), dtype),
+        pltpu.SemaphoreType.DMA((2, n_in)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
     call = pl.pallas_call(
         kernel,
         grid=(nblocks,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_in,
         out_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((1, 1), lambda b: (0, 0), memory_space=pltpu.SMEM),
@@ -311,25 +372,64 @@ def make_rb_iter_tblock_3d(
             jax.ShapeDtypeStruct((kp, jp, ip), dtype),
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
-            pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
-            pltpu.VMEM((2, block_k, jp, ip), dtype),
-            pltpu.VMEM((1, ip), dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,
     )
 
-    def rb_iter(p_padded, rhs_padded):
-        p_padded, res = call(p_padded, rhs_padded)
-        return p_padded, res[0, 0]
+    if masked:
+        flg_padded = pad_array_3d(jnp.asarray(fluid, dtype), block_k, n_inner)
+
+        def rb_iter(p_padded, rhs_padded):
+            p_padded, res = call(p_padded, rhs_padded, flg_padded)
+            return p_padded, res[0, 0]
+    else:
+
+        def rb_iter(p_padded, rhs_padded):
+            p_padded, res = call(p_padded, rhs_padded)
+            return p_padded, res[0, 0]
 
     return rb_iter, block_k
+
+
+def make_tblock_solve_loop(rb_iter, block_k: int, eff: int, norm: float,
+                           eps: float, itermax: int,
+                           kmax: int, jmax: int, imax: int, dtype):
+    """The tblock convergence loop both pressure solvers share (uniform:
+    models/ns3d.make_pressure_solve_3d; masked:
+    ops/obstacle3d.make_obstacle_solver_fn_3d): carry the PADDED array, one
+    rb_iter call = eff fused iterations, convergence checked every eff
+    iterations (honest `it` accounting), optional PAMPI_DEBUG residual line
+    per check."""
+    from ..utils import flags as _flags
+
+    epssq = eps * eps
+
+    def solve(p, rhs):
+        pp = pad_array_3d(p, block_k, eff)
+        rp = pad_array_3d(rhs, block_k, eff)
+
+        def cond(c):
+            _, res, it = c
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(c):
+            pp, _, it = c
+            pp, rsq = rb_iter(pp, rp)
+            res = rsq / norm
+            if _flags.debug():
+                jax.debug.print("{} Residuum: {}", it + (eff - 1), res)
+            return pp, res, it + eff
+
+        pp, res, it = jax.lax.while_loop(
+            cond, body,
+            (pp, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
+        )
+        return unpad_array_3d(pp, kmax, jmax, imax, eff), res, it
+
+    return solve
 
 
 _PROBE3D_OK: bool | None = None

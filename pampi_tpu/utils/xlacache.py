@@ -20,10 +20,23 @@ import os
 
 def enable(path: str | None = None) -> str | None:
     """Turn the cache on; returns the directory, or None when disabled or
-    unavailable. Call before the first compilation."""
+    unavailable. Call before the first compilation.
+
+    Default-on for accelerator backends only: CPU compiles are cheap, and a
+    cached XLA:CPU AOT executable records the exact machine-feature set of
+    the compiling context — loading it from a context with different
+    XLA/compile flags fails ("+prefer-no-gather is not supported on the
+    host machine") and can wedge a multi-process run with one rank dead and
+    its peers blocked in a collective (observed). Set PAMPI_XLA_CACHE=<dir>
+    to opt a CPU run in anyway."""
     val = os.environ.get("PAMPI_XLA_CACHE", "")
     if val.lower() in ("0", "off", "none"):
         return None
+    if not val:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return None
     path = val or path or os.path.join(
         os.path.expanduser("~"), ".cache", "pampi_tpu", "xla"
     )

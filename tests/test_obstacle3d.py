@@ -212,3 +212,81 @@ def test_obstacle3d_dist_rejects_mg_fft():
     )
     with pytest.raises(ValueError, match="obstacle"):
         NS3DDistSolver(param, CartComm(ndims=3))
+
+
+@pytest.mark.parametrize("n_inner", [1, 2])
+def test_masked_kernel_matches_jnp_trajectory(n_inner):
+    """The flag-masked 3-D Pallas kernel (interpret mode) must reproduce the
+    jnp eps-coefficient trajectory — same structure as the uniform kernel's
+    parity test (tests/test_sor3d_pallas.py)."""
+    from pampi_tpu.models.ns3d import checkerboard_mask_3d, neumann_faces_3d
+    from pampi_tpu.ops.sor3d_pallas import (
+        make_rb_iter_tblock_3d,
+        pad_array_3d,
+        unpad_array_3d,
+    )
+
+    DT = jnp.float32
+    K, J, I = 10, 12, 14
+    dx, dy, dz, omega = 1.0 / I, 1.0 / J, 1.0 / K, 1.7
+    fluid = o3.build_fluid_3d(I, J, K, 1.0 / I, 1.0 / J, 1.0 / K,
+                              "0.2,0.2,0.2,0.6,0.6,0.6")
+    m = o3.make_masks_3d(fluid, dx, dy, dz, omega, DT)
+
+    rng = np.random.default_rng(7)
+    p0 = jnp.asarray(rng.standard_normal((K + 2, J + 2, I + 2)), DT)
+    rhs = jnp.asarray(rng.standard_normal((K + 2, J + 2, I + 2)), DT)
+
+    odd = checkerboard_mask_3d(K, J, I, 1, DT)
+    even = checkerboard_mask_3d(K, J, I, 0, DT)
+    idx2, idy2, idz2 = 1.0 / dx**2, 1.0 / dy**2, 1.0 / dz**2
+
+    def one(p, rhs):
+        p, r0 = o3.sor_pass_obstacle_3d(p, rhs, odd, m, idx2, idy2, idz2)
+        p, r1 = o3.sor_pass_obstacle_3d(p, rhs, even, m, idx2, idy2, idz2)
+        return neumann_faces_3d(p), r0 + r1
+
+    rb, bk = make_rb_iter_tblock_3d(
+        I, J, K, dx, dy, dz, omega, DT, n_inner=n_inner, interpret=True,
+        fluid=np.asarray(m.fluid),
+    )
+    pp = pad_array_3d(p0, bk, n_inner)
+    rp = pad_array_3d(rhs, bk, n_inner)
+
+    want = p0
+    for _outer in range(3):
+        pp, res = rb(pp, rp)
+        wres = None
+        for _ in range(n_inner):
+            want, wres = one(want, rhs)
+        got = unpad_array_3d(pp, K, J, I, n_inner)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=5e-5)
+        assert float(res) == pytest.approx(float(wres), rel=1e-4)
+
+
+def test_obstacle_solver_fn_pallas_backend_matches_jnp():
+    """make_obstacle_solver_fn_3d(backend='pallas', interpret via CPU) and
+    the jnp path must agree on the converged field at n_inner=1."""
+    n = 10
+    hh = 1.0 / n
+    fluid = o3.build_fluid_3d(n, n, n, hh, hh, hh, "0.3,0.3,0.3,0.7,0.7,0.7")
+    m = o3.make_masks_3d(fluid, hh, hh, hh, 1.7, jnp.float32)
+    rng = np.random.default_rng(3)
+    rhs = rng.standard_normal((n + 2, n + 2, n + 2)).astype(np.float32)
+    fi = np.asarray(m.p_mask, bool)
+    ri = rhs[1:-1, 1:-1, 1:-1]
+    ri[fi] -= ri[fi].mean()
+    ri[~fi] = 0.0
+    rhs[1:-1, 1:-1, 1:-1] = ri
+    p0 = jnp.zeros((n + 2, n + 2, n + 2), jnp.float32)
+    s_jnp = o3.make_obstacle_solver_fn_3d(n, n, n, hh, hh, hh, 1e-4, 500, m,
+                                          jnp.float32, backend="jnp")
+    s_pal = o3.make_obstacle_solver_fn_3d(n, n, n, hh, hh, hh, 1e-4, 500, m,
+                                          jnp.float32, backend="pallas",
+                                          n_inner=1)
+    pj, rj, ij = s_jnp(p0, jnp.asarray(rhs))
+    pp_, rp_, ip_ = s_pal(p0, jnp.asarray(rhs))
+    assert int(ij) == int(ip_)
+    np.testing.assert_allclose(np.asarray(pp_), np.asarray(pj),
+                               rtol=0, atol=1e-4)
