@@ -113,3 +113,31 @@ def test_constant_dt_when_tau_negative(reference_dir):
     # 6 steps of fixed dt=0.01 run while t<=te (t: 0,.01,...,.05 all <= te)
     assert s.nt == 6
     assert s.t == pytest.approx(0.06)
+
+
+def test_bfloat16_run_tracks_float64():
+    """tpu_dtype bfloat16 (the TPU-native low-precision mode) must complete
+    the same step count and stay within bf16-discretization distance of the
+    f64 run — time accumulates in high precision by design, so the step
+    count cannot stall (models/ns2d.py time_dtype note)."""
+    import jax.numpy as jnp
+
+    def run(dtype):
+        param = Parameter(
+            name="dcavity", imax=16, jmax=16, re=10.0, te=0.05, dt=0.02,
+            tau=0.5, itermax=50, eps=1e-3, omg=1.7, gamma=0.9,
+            tpu_dtype=dtype,
+        )
+        s = NS2DSolver(param)
+        s.run(progress=False)
+        return s
+
+    lo = run("bfloat16")
+    hi = run("float64")
+    assert lo.u.dtype == jnp.bfloat16
+    assert lo.nt == hi.nt
+    ulo = np.asarray(lo.u, np.float64)
+    uhi = np.asarray(hi.u)
+    assert np.isfinite(ulo).all()
+    # bf16 has ~3 decimal digits; the flow field is O(1)
+    assert np.abs(ulo - uhi).max() < 0.05
