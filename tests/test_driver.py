@@ -1,0 +1,153 @@
+"""Chunk-driver protocol tests (models/_driver.py) — the framework's
+failure-handling subsystem: the chunked time loop, the one-shot transient
+device-fault retry, and the pallas->jnp rebuild hook. The reference has no
+failure handling at all (SURVEY.md §5: fprintf+exit), so these paths only
+exist here — and they were previously exercised only implicitly."""
+
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+from pampi_tpu.models._driver import drive_chunks, pallas_retry
+
+
+class JaxRuntimeError(Exception):
+    """Name-alike of jax's runtime error: _is_transient_device_fault matches
+    on the type NAME, so tests can forge faults without touching jax."""
+
+
+class _Bar:
+    def __init__(self):
+        self.updates = []
+        self.stopped = False
+
+    def update(self, t):
+        self.updates.append(t)
+
+    def stop(self):
+        self.stopped = True
+
+
+def _advance(dt=1.0):
+    def chunk(t, n):
+        return (t + dt, n + 1)
+
+    return chunk
+
+
+def test_normal_loop_runs_until_te_and_syncs():
+    bar = _Bar()
+    seen = []
+    state = drive_chunks(
+        (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+        _advance(), te=2.5, time_index=0, bar=bar,
+        retry=lambda: None, on_state=seen.append,
+    )
+    # loop body runs while t <= te at chunk start: t = 0,1,2 -> 3 chunks
+    assert float(state[0]) == 3.0 and int(state[1]) == 3
+    assert len(seen) == 3
+    assert bar.stopped and bar.updates == [1.0, 2.0, 3.0]
+
+
+def test_transient_fault_retried_exactly_once():
+    calls = {"n": 0}
+
+    def flaky(t, n):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise JaxRuntimeError("UNAVAILABLE: TPU device error")
+        return (t + 1.0, n + 1)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state = drive_chunks(
+            (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+            flaky, te=1.5, time_index=0, bar=_Bar(), retry=lambda: None,
+        )
+    assert float(state[0]) == 2.0
+    assert any("transient" in str(x.message) for x in w)
+    # 2 successful chunks + 1 faulted attempt
+    assert calls["n"] == 3
+
+
+def test_second_transient_fault_reraises():
+    def always_faulty(t, n):
+        raise JaxRuntimeError("UNAVAILABLE: TPU device error")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(JaxRuntimeError):
+            drive_chunks(
+                (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+                always_faulty, te=1.0, time_index=0, bar=_Bar(),
+                retry=lambda: None,
+            )
+
+
+def test_non_transient_error_propagates():
+    def broken(t, n):
+        raise ValueError("genuine bug")
+
+    with pytest.raises(ValueError, match="genuine bug"):
+        drive_chunks(
+            (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+            broken, te=1.0, time_index=0, bar=_Bar(), retry=lambda: None,
+        )
+
+
+def test_retry_hook_swaps_chunk_fn():
+    """A failing chunk whose retry() supplies a rebuilt fn continues on the
+    new fn with UNCHANGED inputs (the loop is functional)."""
+    calls = {"old": 0, "new": 0}
+
+    def old_fn(t, n):
+        calls["old"] += 1
+        raise ValueError("pallas kernel exploded")
+
+    def new_fn(t, n):
+        calls["new"] += 1
+        return (t + 1.0, n + 1)
+
+    state = drive_chunks(
+        (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+        old_fn, te=1.5, time_index=0, bar=_Bar(), retry=lambda: new_fn,
+    )
+    assert calls["old"] == 1 and calls["new"] == 2
+    assert float(state[0]) == 2.0 and int(state[1]) == 2
+
+
+class _FakeSolver:
+    def __init__(self, backend="auto", uses_pallas=True):
+        self._backend = backend
+        self._uses = uses_pallas
+        self.rebuilds = []
+
+    def _uses_pallas(self):
+        return self._uses
+
+    def _build_chunk(self, backend):
+        self.rebuilds.append(backend)
+
+        def chunk(t, n):
+            return (t + 1.0, n + 1)
+
+        return chunk
+
+
+def test_pallas_retry_rebuilds_once_then_gives_up():
+    s = _FakeSolver()
+    retry = pallas_retry(s, "pressure solve")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        fn = retry()
+    assert fn is not None and s.rebuilds == ["jnp"]
+    assert s._backend == "jnp"
+    assert any("jnp path" in str(x.message) for x in w)
+    # a second failure now comes FROM the jnp path: no more retries
+    assert retry() is None
+
+
+def test_pallas_retry_none_when_pallas_not_in_play():
+    s = _FakeSolver(uses_pallas=False)
+    assert pallas_retry(s, "x")() is None
