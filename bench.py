@@ -108,7 +108,7 @@ def main() -> None:
         print(f"auto backend failed ({type(exc).__name__}); jnp fallback",
               file=sys.stderr)
         backend = "jnp-fallback"
-        dt, iters = _timed_run("jnp")
+        dt, iters = _run_with_retry("jnp")
     ups = N * N * iters / dt
     print(
         json.dumps(

@@ -182,6 +182,14 @@ def _dispatch(param, prof) -> int:
         solver = _try_build(build)
         if solver is None:
             return 1
+        if is3d:
+            from .utils import flags as _flags
+
+            if _flags.verbose():
+                # ≙ A6 main.c's VERBOSE-gated printConfig(solver)
+                from .utils.params import print_solver_config
+
+                print_solver_config(param, solver.grid, solver.dt_bound)
         from .utils import checkpoint as ckpt
 
         on_sync = None

@@ -207,3 +207,37 @@ def print_parameter(p: Parameter, out=None) -> None:
     w("\tepsilon (stopping tolerance) : %f\n" % p.eps)
     w("\tgamma factor: %f\n" % p.gamma)
     w("\tomega (SOR relaxation): %f\n" % p.omg)
+
+
+def print_solver_config(p, grid, dt_bound, out=None) -> None:
+    """The reference's -DVERBOSE solver-config block, 3-D driver only
+    (assignment-6/src/solver.c:36-73 printConfig, gated like main.c's
+    VERBOSE): computed grid spacings and the CFL dt bound, on top of the
+    always-printed parameter echo (print_parameter)."""
+    out = out or sys.stdout
+    w = out.write
+    w("Parameters for #%s#\n" % p.name)
+    w(
+        "BC Left:%d Right:%d Bottom:%d Top:%d Front:%d Back:%d\n"
+        % (p.bcLeft, p.bcRight, p.bcBottom, p.bcTop, p.bcFront, p.bcBack)
+    )
+    w("\tReynolds number: %.2f\n" % p.re)
+    w("\tGx Gy: %.2f %.2f %.2f\n" % (p.gx, p.gy, p.gz))
+    w("Geometry data:\n")
+    w(
+        "\tDomain box size (x, y, z): %.2f, %.2f, %.2f\n"
+        % (grid.xlength, grid.ylength, grid.zlength)
+    )
+    w("\tCells (x, y, z): %d, %d, %d\n" % (grid.imax, grid.jmax, grid.kmax))
+    w(
+        "\tCell size (dx, dy, dz): %f, %f, %f\n" % (grid.dx, grid.dy, grid.dz)
+    )
+    w("Timestep parameters:\n")
+    w("\tDefault stepsize: %.2f, Final time %.2f\n" % (p.dt, p.te))
+    w("\tdt bound: %.6f\n" % dt_bound)
+    w("\tTau factor: %.2f\n" % p.tau)
+    w("Iterative parameters:\n")
+    w("\tMax iterations: %d\n" % p.itermax)
+    w("\tepsilon (stopping tolerance) : %f\n" % p.eps)
+    w("\tgamma factor: %f\n" % p.gamma)
+    w("\tomega (SOR relaxation): %f\n" % p.omg)

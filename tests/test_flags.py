@@ -117,3 +117,34 @@ def test_xla_cache_enable_and_disable(monkeypatch, tmp_path):
         assert jax.config.jax_compilation_cache_dir == prev
     finally:
         jax.config.update("jax_compilation_cache_dir", prev)
+
+
+DCAVITY3D_PAR = """\
+name       dcavity3d
+imax       32
+jmax       32
+kmax       32
+re         1000.0
+te         0.02
+dt         0.02
+tau        0.5
+itermax    1000
+eps        0.001
+omg        1.8
+gamma      0.9
+tpu_dtype  float64
+tpu_mesh   1
+"""
+
+
+def test_verbose_prints_solver_config_block_3d(tmp_path):
+    """PAMPI_VERBOSE on a 3-D run emits the reference's printConfig block
+    (A6 solver.c:36-73) with COMPUTED values matching the captured
+    reference-run log (tests/fixtures/dc3b.log: same 32^3 dcavity grid)."""
+    out = _run(DCAVITY3D_PAR, tmp_path, PAMPI_VERBOSE="1")
+    assert "Parameters for #dcavity3d#" in out
+    assert "\tCell size (dx, dy, dz): 0.031250, 0.031250, 0.031250" in out
+    assert "\tdt bound: 0.162760" in out  # 0.5*Re/(3/dx^2), the fixture value
+    # and not there without the flag
+    out2 = _run(DCAVITY3D_PAR, tmp_path)
+    assert "Parameters for #" not in out2
