@@ -26,10 +26,13 @@ def main(argv=None) -> int:
 
         return halo_main(argv)
     if argv[1].isdigit():
-        # DMVM mode (≙ assignment-3a/3b CLI: ./exe <N> <iter>)
+        # DMVM mode (≙ assignment-3a/3b CLI: ./exe <N> <iter>); under a
+        # PAMPI_COORDINATOR launch the ring spans every process's devices
         from .models.dmvm import main as dmvm_main
+        from .parallel import multihost
 
-        return dmvm_main(argv)
+        with multihost.session():
+            return dmvm_main(argv)
     return _run(argv)
 
 
@@ -81,8 +84,8 @@ def _run(argv) -> int:
     # single-process runs no-op (≙ the ENABLE_MPI=false build)
     from .parallel import multihost
 
-    multihost.init_from_env()
-    multihost.mute_non_master()
+    ctx = multihost.session()
+    ctx.__enter__()
 
     from .utils import xlacache
 
@@ -104,7 +107,7 @@ def _run(argv) -> int:
         # always stop an open XProf trace and print the region table, even
         # when the solver or a writer raises — that's the run worth profiling
         prof.finalize()
-        multihost.shutdown()  # commFinalize
+        ctx.__exit__(None, None, None)  # commFinalize
 
 
 def _dispatch(param, prof) -> int:

@@ -56,6 +56,12 @@ def _print_sum(s):
     print("Sum: %f" % float(s), file=sys.stderr)
 
 
+def _fence(y) -> None:
+    """Force device completion via a host readback of one LOCAL element —
+    y[0] itself may live on another process under a multi-process launch."""
+    _ = np.asarray(y.addressable_data(0)).ravel()[0]
+
+
 def init_ax(N: int, dtype):
     """a[i,j] = i+j, x[i] = i (assignment-3a/src/main.c:45-50)."""
     i = np.arange(N, dtype=np.float64)
@@ -185,10 +191,10 @@ class RingDMVM:
         MFLOP/s = 2·N²·iter/walltime/1e6 (main.c:93-95) — for the blocked
         ring this counts exactly the executed flops."""
         y = self._pass(self.a, self.x, 1)
-        _ = float(y[0])  # warm-up/compile
+        _fence(y)  # warm-up/compile
         t0 = get_timestamp()
         y = self._pass(self.a, self.x, iters)
-        _ = float(y[0])
+        _fence(y)
         walltime = get_timestamp() - t0
         mflops = 1.0e-6 * 2.0 * self.N * self.N * iters / walltime
         return y, walltime, mflops
@@ -223,7 +229,10 @@ def main(argv) -> int:
     print("%d %d %.2f %.2f" % (iters, N, mflops, walltime))
     import os
 
-    if os.environ.get("PAMPI_CSV"):
+    from ..parallel import multihost
+
+    if os.environ.get("PAMPI_CSV") and multihost.is_master():
+        # one CSV row per RUN, not per process (rank-0 convention)
         with open(os.environ["PAMPI_CSV"], "a") as fh:
             fh.write("%d,%d,%d,%.2f,%.2f\n" % (ranks, iters, N, mflops, walltime))
     return 0

@@ -26,6 +26,7 @@ the reference's ENABLE_MPI=false build of the same API (comm.c:470-488).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import sys
 
@@ -71,6 +72,19 @@ def shutdown() -> None:
 
         jax.distributed.shutdown()
         _initialized = False
+
+
+@contextlib.contextmanager
+def session():
+    """The commInit/commFinalize bracket as a context manager: join the
+    process group (env-triggered no-op otherwise), mute non-master stdout,
+    and shut down on exit. Both CLI branches run inside one."""
+    init_from_env()
+    mute_non_master()
+    try:
+        yield
+    finally:
+        shutdown()
 
 
 def mute_non_master() -> None:

@@ -196,3 +196,28 @@ def _mkdir_oracle(tmp_path):
 @pytest.fixture(autouse=True)
 def _dirs(tmp_path):
     _mkdir_oracle(tmp_path)
+
+
+@pytest.mark.slow
+def test_two_process_dmvm_ring(tmp_path):
+    """DMVM CLI under the multi-process launcher: the ring spans both
+    processes' devices (4-device ring across 2 OS processes — the 3a/3b
+    multi-node run), rank 0 alone prints the result line and CSV row."""
+    proc = subprocess.run(
+        [str(LAUNCHER), "2", "512", "5"],
+        cwd=tmp_path,
+        env=_env(PAMPI_LOCAL_DEVICES="2", PAMPI_CSV="dmvm.csv"),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # result line: "iter N MFlops walltime"
+    line = [l for l in proc.stdout.splitlines()
+            if l.startswith("5 512 ")]
+    assert line, proc.stdout
+    rows = (tmp_path / "dmvm.csv").read_text().strip().splitlines()
+    assert len(rows) == 1  # rank-0 only, one row per RUN
+    assert rows[0].startswith("4,5,512,")  # Ranks=4: the ring spans processes
+    # non-master printed nothing
+    assert "512" not in (tmp_path / "multihost-r1.log").read_text()
