@@ -58,7 +58,7 @@ def rank_id_blocks(comm: CartComm, local_interior):
         return halo_exchange(blk, comm)
 
     out = comm.shard_map(kernel, in_specs=(), out_specs=P(*comm.axis_names))()
-    glob = np.asarray(out)
+    glob = CartComm.collect(out)  # multihost-safe host gather
     blocks = {}
     for coords in np.ndindex(*comm.dims):
         sl = tuple(
@@ -73,6 +73,8 @@ def dump_halos(comm: CartComm, local_interior=None, outdir=".") -> list[str]:
     if local_interior is None:
         local_interior = (4,) * comm.ndims
     blocks = rank_id_blocks(comm, local_interior)
+    if not comm.is_master:
+        return []  # collect was collective; rank 0 writes every file
     paths = []
     for coords, blk in blocks.items():
         rid = 0
@@ -86,9 +88,12 @@ def dump_halos(comm: CartComm, local_interior=None, outdir=".") -> list[str]:
 
 
 def main(argv) -> int:
-    ndims = int(argv[2]) if len(argv) > 2 else 2
-    comm = CartComm(ndims=ndims)
-    comm.print_config()
-    paths = dump_halos(comm)
-    print(f"wrote {len(paths)} ghost-face dumps (halo-<dir>-r<rank>.txt)")
+    from . import multihost
+
+    with multihost.session():
+        ndims = int(argv[2]) if len(argv) > 2 else 2
+        comm = CartComm(ndims=ndims)
+        comm.print_config()
+        paths = dump_halos(comm)
+        print(f"wrote {len(paths)} ghost-face dumps (halo-<dir>-r<rank>.txt)")
     return 0

@@ -221,3 +221,25 @@ def test_two_process_dmvm_ring(tmp_path):
     assert rows[0].startswith("4,5,512,")  # Ranks=4: the ring spans processes
     # non-master printed nothing
     assert "512" not in (tmp_path / "multihost-r1.log").read_text()
+
+
+@pytest.mark.slow
+def test_two_process_halo_test(tmp_path):
+    """--halo-test under the multi-process launcher: the rank-id exchange
+    runs over the cross-process mesh and rank 0 writes every dump file."""
+    proc = subprocess.run(
+        [str(LAUNCHER), "2", "--halo-test", "2"],
+        cwd=tmp_path,
+        env=_env(PAMPI_LOCAL_DEVICES="2"),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "wrote 16 ghost-face dumps" in proc.stdout  # 4 ranks x 4 faces
+    files = sorted(tmp_path.glob("halo-*-r*.txt"))
+    assert len(files) == 16
+    # neighbour's rank id must appear in the exchanged ghost face:
+    # 2x2 mesh, rank 0 at (0,0); its top ghost row comes from rank 2 (j+1)
+    top = np.loadtxt(tmp_path / "halo-top-r0.txt")
+    assert (top[1:-1] == 2.0).all()
