@@ -24,7 +24,6 @@ array incl. ghosts; rhs = sin(2π·i·dx) for problem 2, else 0.
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp
