@@ -15,6 +15,7 @@
  *
  * Flags:
  *   --dry-run   parse + echo the config and exit (no Python, no TPU)
+ *   --halo-test [ndims]  pass through to the halo-exchange debug dump
  */
 #include <libgen.h>
 #include <limits.h>
@@ -74,10 +75,16 @@ int main(int argc, char **argv) {
 
     export_build_options();
 
-    if (is_number(args[0])) {
-        /* DMVM benchmark mode: ./exe <N> <iter> */
+    int halo = strcmp(args[0], "--halo-test") == 0;
+    if (halo || is_number(args[0])) {
+        /* pass-through modes: DMVM benchmark (./exe <N> <iter>) and the
+         * halo-exchange debug dump (./exe --halo-test [ndims]) */
         if (dry) {
-            printf("DMVM N=%s iter=%s\n", args[0], nargs > 1 ? args[1] : "?");
+            if (halo)
+                printf("halo-test ndims=%s\n", nargs > 1 ? args[1] : "2");
+            else
+                printf("DMVM N=%s iter=%s\n", args[0],
+                       nargs > 1 ? args[1] : "?");
             return 0;
         }
         char *xargs[6] = {(char *)python, "-m", "pampi_tpu", args[0],
