@@ -84,30 +84,31 @@ def _run(argv) -> int:
     # single-process runs no-op (≙ the ENABLE_MPI=false build)
     from .parallel import multihost
 
-    ctx = multihost.session()
-    ctx.__enter__()
+    # the whole body runs inside the commInit/commFinalize bracket so a
+    # failure anywhere (cache setup, config echo, solver) still shuts the
+    # process group down instead of leaving peer ranks blocked
+    with multihost.session():
+        from .utils import xlacache
 
-    from .utils import xlacache
+        xlacache.enable()  # recompiles of unchanged programs become disk loads
 
-    xlacache.enable()  # recompiles of unchanged programs become disk loads
+        if param.tpu_dtype == "float64":
+            import jax
 
-    if param.tpu_dtype == "float64":
-        import jax
+            jax.config.update("jax_enable_x64", True)
+        os.environ.setdefault("PAMPI_DTYPE", param.tpu_dtype)
 
-        jax.config.update("jax_enable_x64", True)
-    os.environ.setdefault("PAMPI_DTYPE", param.tpu_dtype)
+        from .utils import profiling as prof
 
-    from .utils import profiling as prof
-
-    print_parameter(param)
-    prof.init()
-    try:
-        return _dispatch(param, prof)
-    finally:
-        # always stop an open XProf trace and print the region table, even
-        # when the solver or a writer raises — that's the run worth profiling
-        prof.finalize()
-        ctx.__exit__(None, None, None)  # commFinalize
+        print_parameter(param)
+        prof.init()
+        try:
+            return _dispatch(param, prof)
+        finally:
+            # always stop an open XProf trace and print the region table, even
+            # when the solver or a writer raises — that's the run worth
+            # profiling
+            prof.finalize()
 
 
 def _dispatch(param, prof) -> int:

@@ -190,10 +190,12 @@ class NS2DSolver:
             # t accumulates in high precision regardless of the field dtype
             # (bfloat16 would stall t once ulp/2 > dt and never reach te)
             time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            t_next = t + dt.astype(time_dtype)
             if _flags.verbose():
-                # ≙ -DVERBOSE "TIME %f , TIMESTEP %f" (A5 main.c:55-57)
-                jax.debug.print("TIME {} , TIMESTEP {}", t, dt)
-            return u, v, p, t + dt.astype(time_dtype), nt + 1
+                # ≙ -DVERBOSE "TIME %f , TIMESTEP %f" printed AFTER t += dt
+                # (A5 main.c:52-57)
+                jax.debug.print("TIME {} , TIMESTEP {}", t_next, dt)
+            return u, v, p, t_next, nt + 1
 
         return step
 

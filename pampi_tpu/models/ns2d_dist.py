@@ -360,9 +360,11 @@ class NS2DDistSolver:
                 u, v = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
             # t accumulates in high precision regardless of the field dtype
             # (bfloat16 would stall t once ulp/2 > dt and never reach te)
+            t_next = t + dt.astype(idx_dtype)
             if _flags.verbose():
-                master_print(comm, "TIME {} , TIMESTEP {}", t, dt)
-            return u, v, p, t + dt.astype(idx_dtype), nt + 1
+                # printed AFTER t += dt, matching A5 main.c:52-57
+                master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            return u, v, p, t_next, nt + 1
 
         te = param.te
         chunk = self.CHUNK

@@ -301,9 +301,11 @@ class NS3DSolver:
             else:
                 u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
             time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            t_next = t + dt.astype(time_dtype)
             if _flags.verbose():
-                jax.debug.print("TIME {} , TIMESTEP {}", t, dt)
-            return u, v, w, p, t + dt.astype(time_dtype), nt + 1
+                # printed AFTER t += dt, matching A6 main.c:58-62
+                jax.debug.print("TIME {} , TIMESTEP {}", t_next, dt)
+            return u, v, w, p, t_next, nt + 1
 
         return step
 

@@ -316,9 +316,11 @@ class NS3DDistSolver:
                 )
             else:
                 u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
+            t_next = t + dt.astype(idx_dtype)
             if _flags.verbose():
-                master_print(comm, "TIME {} , TIMESTEP {}", t, dt)
-            return u, v, w, p, t + dt.astype(idx_dtype), nt + 1
+                # printed AFTER t += dt, matching A6 main.c:58-62
+                master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            return u, v, w, p, t_next, nt + 1
 
         te = param.te
         chunk = self.CHUNK

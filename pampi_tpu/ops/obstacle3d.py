@@ -21,7 +21,8 @@ carries the same design to the 3-D solver (assignment-6's model family):
   reduce over fluid cells only
 - the pressure solve dispatches to the flag-masked temporal-blocked 3-D
   Pallas kernel on TPU (ops/sor3d_pallas.py `_tblock3d_kernel(masked=True)`;
-  measured 2.5× the jnp eps path at 96³ f32 on v5e) and to the jnp
+  measured 2.7× the jnp eps path at 96³ f32 on v5e — 257 ms → 96 ms,
+  the numbers in BASELINE.md/PARITY.md) and to the jnp
   eps-coefficient passes elsewhere; mg/fft are rejected for obstacle runs
   exactly as in 2-D (non-constant-coefficient stencil)
 

@@ -78,19 +78,28 @@ def shutdown() -> None:
 def session():
     """The commInit/commFinalize bracket as a context manager: join the
     process group (env-triggered no-op otherwise), mute non-master stdout,
-    and shut down on exit. Both CLI branches run inside one."""
+    and shut down on exit — restoring stdout so output after the bracket
+    (embedding/test use) isn't silently lost. Both CLI branches run inside
+    one."""
     init_from_env()
-    mute_non_master()
+    saved_stdout = sys.stdout
+    devnull = mute_non_master()
     try:
         yield
     finally:
+        if devnull is not None:
+            sys.stdout = saved_stdout
+            devnull.close()
         shutdown()
 
 
-def mute_non_master() -> None:
+def mute_non_master():
     """Rank-0-only printing, the reference driver convention
     (assignment-5/ex5-nazifkar/src/main.c: every print gated on rank 0).
     Redirects this process's stdout to /dev/null when not master; stderr
-    stays live so errors from any rank surface."""
+    stays live so errors from any rank surface. Returns the devnull handle
+    (None when master) so the caller can restore and close it."""
     if not is_master():
         sys.stdout = open(os.devnull, "w")
+        return sys.stdout
+    return None

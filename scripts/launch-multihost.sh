@@ -25,7 +25,28 @@ REPO=$(cd "$(dirname "$0")/.." && pwd)
 [ $# -ge 2 ] || { echo "usage: launch-multihost.sh N <cli args...>" >&2; exit 2; }
 N=$1; shift
 
-PORT=$(python -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')
+# Coordinator port: take a flock on a per-port lockfile and HOLD it for the
+# script's lifetime (fd 9), so concurrent launches on one host can never pick
+# the same port (bind-and-release alone is a TOCTOU race). The bind probe
+# only filters ports busied by unrelated processes.
+if [ -z "${PAMPI_COORDINATOR:-}" ]; then
+    if command -v flock >/dev/null 2>&1; then
+        PORT=""
+        for slot in $(seq 0 63); do
+            CAND=$(( 29500 + slot ))
+            exec 9> "${TMPDIR:-/tmp}/pampi-port-$CAND.lock"
+            if flock -n 9 && python -c "import socket; s=socket.socket(); s.bind(('127.0.0.1',$CAND)); s.close()" 2>/dev/null; then
+                PORT=$CAND; break
+            fi
+            exec 9>&-
+        done
+        [ -n "$PORT" ] || { echo "launch-multihost.sh: no free coordinator port in 29500-29563" >&2; exit 1; }
+    else
+        # no flock on this host: fall back to bind-and-release (racy only
+        # against concurrent launches in the same instant)
+        PORT=$(python -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')
+    fi
+fi
 COORD=${PAMPI_COORDINATOR:-127.0.0.1:$PORT}
 OFFSET=${PAMPI_PROC_OFFSET:-0}
 TOTAL=${PAMPI_TOTAL_PROCS:-$N}   # global count; defaults to single-host N

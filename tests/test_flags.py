@@ -67,10 +67,27 @@ def test_debug_off_prints_nothing(tmp_path):
     assert "Residuum:" not in out
 
 
+def _parse_time_lines(out):
+    """-> [(TIME, TIMESTEP)] from 'TIME <t> , TIMESTEP <dt>' lines."""
+    lines = [l for l in out.splitlines() if l.startswith("TIME ")]
+    return [(float(l.split()[1]), float(l.split()[4])) for l in lines]
+
+
+def _assert_time_is_post_increment(pairs):
+    """The reference prints TIME after `t += dt` (A5 main.c:52-57,
+    A6 main.c:58-62): line i carries the cumulative sum of TIMESTEPs
+    through step i — never a leading 0.0."""
+    acc = 0.0
+    for time_v, dt_v in pairs:
+        acc += dt_v
+        assert abs(time_v - acc) < 1e-9, (time_v, acc)
+
+
 def test_verbose_prints_time_per_step_and_no_progress_bar(tmp_path):
     out = _run(DCAVITY_PAR, tmp_path, PAMPI_VERBOSE="1")
     lines = [l for l in out.splitlines() if l.startswith("TIME ")]
     assert lines and ", TIMESTEP " in lines[0]
+    _assert_time_is_post_increment(_parse_time_lines(out))
     assert "[" not in out.split("Solution took")[0].split("omega")[-1]
 
 
@@ -97,6 +114,7 @@ def test_flags_work_distributed(tmp_path):
     assert res_lines and time_lines
     # rank-0-only: TIME lines are unique (no 8x duplicates)
     assert len(time_lines) == len(set(time_lines))
+    _assert_time_is_post_increment(_parse_time_lines(out))
 
 
 def test_xla_cache_enable_and_disable(monkeypatch, tmp_path):
@@ -145,6 +163,7 @@ def test_verbose_prints_solver_config_block_3d(tmp_path):
     assert "Parameters for #dcavity3d#" in out
     assert "\tCell size (dx, dy, dz): 0.031250, 0.031250, 0.031250" in out
     assert "\tdt bound: 0.162760" in out  # 0.5*Re/(3/dx^2), the fixture value
+    _assert_time_is_post_increment(_parse_time_lines(out))
     # and not there without the flag
     out2 = _run(DCAVITY3D_PAR, tmp_path)
     assert "Parameters for #" not in out2
