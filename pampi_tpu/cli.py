@@ -114,9 +114,23 @@ def _run(argv) -> int:
 def _dispatch(param, prof) -> int:
     from .utils.timing import get_timestamp
 
-    if param.tpu_solver not in ("sor", "mg", "fft"):
-        print(f"Error: tpu_solver must be sor|mg|fft, got {param.tpu_solver!r}",
-              file=sys.stderr)
+    if param.tpu_solver not in ("sor", "mg", "fft", "sor_lex", "sor_rba"):
+        print(
+            "Error: tpu_solver must be sor|mg|fft|sor_lex|sor_rba, "
+            f"got {param.tpu_solver!r}",
+            file=sys.stderr,
+        )
+        return 1
+
+    if param.tpu_solver in ("sor_lex", "sor_rba") and not param.name.startswith(
+        "poisson"
+    ):
+        # the assignment-4 oracle modes; NS pressure solves use sor/mg/fft
+        print(
+            f"Error: tpu_solver {param.tpu_solver} is a Poisson-only oracle "
+            "mode; NS problems take sor|mg|fft",
+            file=sys.stderr,
+        )
         return 1
 
     if param.obstacles.strip() and param.name.startswith("poisson"):

@@ -56,6 +56,11 @@ def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
         from ..ops.dctpoisson import make_dct_solve_2d
 
         return make_dct_solve_2d(imax, jmax, dx, dy, dtype)
+    if solver != "sor":
+        raise ValueError(
+            f"NS pressure solve supports sor|mg|fft, got {solver!r} "
+            "(sor_lex/sor_rba are Poisson-only oracle modes)"
+        )
     from .poisson import make_solver_fn
 
     return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,

@@ -129,6 +129,11 @@ def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
         from ..ops.dctpoisson import make_dct_solve_3d
 
         return make_dct_solve_3d(imax, jmax, kmax, dx, dy, dz, dtype)
+    if solver != "sor":
+        raise ValueError(
+            f"NS pressure solve supports sor|mg|fft, got {solver!r} "
+            "(sor_lex/sor_rba are Poisson-only oracle modes)"
+        )
     norm = float(imax * jmax * kmax)
     epssq = eps * eps
 

@@ -6,16 +6,19 @@ solveRB:179, solveRBA:240, writeResult:301) designed TPU-first:
 - The whole convergence loop is ONE jitted `lax.while_loop` — carry (p, res, it),
   condition `res >= eps² && it < itermax` — so XLA keeps the field in device
   memory across iterations and fuses stencil + mask + reduction per half-sweep.
-- The reference's lexicographic in-place Gauss-Seidel (`solve`) is inherently
-  serial; the parallel-legal ordering the reference itself provides (`solveRB`,
-  red-black checkerboard) is the scheme implemented here. Equivalence policy
-  (SURVEY.md §7): match the *red-black* iteration trajectory exactly (same
-  cells, same update order red→black, same residual accumulation & norm), and
-  validate the converged field against the committed golden `p.dat` (produced
-  by lexicographic `solve`) to discretization-level tolerance after removing
-  the Neumann nullspace (the all-Neumann problem fixes p only up to a constant).
-- `solveRBA` (ω applied separately, solver.c:240) is the same arithmetic with
-  factor split as ω·(0.5·dx²dy²/(dx²+dy²)); both map to `method="rb"`.
+- All THREE reference solver variants are selectable modes:
+  `tpu_solver sor` (default) → `solveRB`, the performance path (pallas on
+  TPU); `tpu_solver sor_lex` → lexicographic `solve` as a scan/
+  associative-scan oracle (`make_lex_step`; reproduces the committed golden
+  p.dat byte-identically); `tpu_solver sor_rba` → `solveRBA` (separable-ω
+  red-black, `make_rba_step`). All three converge in 2388 iterations on the
+  reference's poisson.par, exactly matching the C binary (each variant
+  compiled + run; see tests/test_poisson.py::test_solver_trio_iteration_parity).
+- Equivalence policy for the performance path (SURVEY.md §7): match the
+  *red-black* iteration trajectory exactly (same cells, same update order
+  red→black, same residual accumulation & norm), and validate the converged
+  field against the committed golden `p.dat` to discretization-level
+  tolerance after removing the Neumann nullspace.
 
 Init parity (initSolver:105-123): p = sin(4π·i·dx) + sin(4π·j·dy) on the FULL
 array incl. ghosts; rhs = sin(2π·i·dx) for problem 2, else 0.
@@ -29,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.sor import checkerboard_mask, neumann_bc, sor_pass
+from ..ops.sor import checkerboard_mask, lex_sweep, neumann_bc, sor_pass
 from ..utils import flags as _flags
 from ..utils.datio import write_matrix
 from ..utils.params import Parameter
@@ -150,15 +153,18 @@ def make_rb_step_padded(imax, jmax, dx, dy, omega, dtype, interpret=None,
     return step, pad, unpad
 
 
-def make_rb_step(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
+def make_rb_step(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
+                 factor=None):
     """Build one red-black SOR iteration: red half-sweep, black half-sweep
     (seeing red's updates), Neumann ghost copy, normalized residual.
 
     backend: "jnp" (masked fused-XLA passes), "pallas" (ops/sor_pallas.py
     blocked in-place kernel, pad/unpad per call — for loop-carried use go
-    through make_rb_step_padded), or "auto" (pallas on TPU)."""
+    through make_rb_step_padded), or "auto" (pallas on TPU).
+    factor: override for the relaxation factor (solveRBA's separable-ω
+    association, make_rba_step); default is solveRB's (ω·0.5·dx²dy²)/(dx²+dy²)."""
     norm = float(imax * jmax)
-    if _use_pallas(backend, dtype):
+    if factor is None and _use_pallas(backend, dtype):
         pstep, pad, unpad = make_rb_step_padded(imax, jmax, dx, dy, omega, dtype)
 
         def step(p, rhs):
@@ -169,7 +175,8 @@ def make_rb_step(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
 
     dx2, dy2 = dx * dx, dy * dy
     idx2, idy2 = 1.0 / dx2, 1.0 / dy2
-    factor = omega * 0.5 * (dx2 * dy2) / (dx2 + dy2)
+    if factor is None:
+        factor = omega * 0.5 * (dx2 * dy2) / (dx2 + dy2)
     red = checkerboard_mask(jmax, imax, 0, dtype)
     black = checkerboard_mask(jmax, imax, 1, dtype)
 
@@ -182,9 +189,42 @@ def make_rb_step(imax, jmax, dx, dy, omega, dtype, backend: str = "auto"):
     return step
 
 
+def make_lex_step(imax, jmax, dx, dy, omega, dtype):
+    """One lexicographic Gauss-Seidel SOR iteration + Neumann ghost copy —
+    the reference's `solve` (assignment-4/src/solver.c:126-176) as a
+    scan/associative-scan program (ops/sor.lex_sweep). Oracle-grade: always
+    the jnp path (f64-capable), iteration-count parity with the C binary."""
+    norm = float(imax * jmax)
+    dx2, dy2 = dx * dx, dy * dy
+    idx2, idy2 = 1.0 / dx2, 1.0 / dy2
+    factor = omega * 0.5 * (dx2 * dy2) / (dx2 + dy2)
+
+    def step(p, rhs):
+        p, rsq = lex_sweep(p, rhs, factor, idx2, idy2)
+        return neumann_bc(p), rsq / norm
+
+    return step
+
+
+def make_rba_step(imax, jmax, dx, dy, omega, dtype):
+    """Red-black SOR with ω applied separately — the reference's `solveRBA`
+    (assignment-4/src/solver.c:240-296). Identical cell visitation to
+    `solveRB`; the only difference is the factor's floating-point
+    association: ω·(0.5·dx²dy²/(dx²+dy²)) instead of (ω·0.5·dx²dy²)/(dx²+dy²).
+    Oracle-grade jnp path, sharing make_rb_step's sweep body."""
+    dx2, dy2 = dx * dx, dy * dy
+    factor = omega * (0.5 * (dx2 * dy2) / (dx2 + dy2))
+    return make_rb_step(imax, jmax, dx, dy, omega, dtype, backend="jnp",
+                        factor=factor)
+
+
 def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
-                   backend="auto", n_inner: int = 1):
+                   backend="auto", n_inner: int = 1, method: str = "rb"):
     """The full convergence loop as one jittable function (p0, rhs) -> (p, res, it).
+
+    method: "rb" (the performance path, pallas on TPU), "lex" (the
+    reference's lexicographic `solve` as an oracle mode), or "rba"
+    (`solveRBA`, separable-ω red-black). lex/rba always run the jnp path.
 
     On the pallas backend the loop carries the PADDED array (one pad before,
     one unpad after — no per-iteration layout conversion). With n_inner > 1
@@ -194,9 +234,18 @@ def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
     would (the extra iterations only lower the residual further). `it`
     reports the true iteration count on every path."""
     epssq = eps * eps
-    step, prep, post, eff = make_rb_loop(
-        imax, jmax, dx, dy, omega, dtype, backend, n_inner
-    )
+    if method == "lex":
+        step = make_lex_step(imax, jmax, dx, dy, omega, dtype)
+        prep = post = lambda x: x  # noqa: E731
+        eff = 1
+    elif method == "rba":
+        step = make_rba_step(imax, jmax, dx, dy, omega, dtype)
+        prep = post = lambda x: x  # noqa: E731
+        eff = 1
+    else:
+        step, prep, post, eff = make_rb_loop(
+            imax, jmax, dx, dy, omega, dtype, backend, n_inner
+        )
 
     def solve(p0, rhs):
         rhs = prep(rhs)
@@ -210,8 +259,14 @@ def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
             p, res = step(p, rhs)
             if _flags.debug():
                 # ≙ -DDEBUG "%d Residuum: %e" (solver.c:169-171); 0-based
-                # index of the last completed iteration, like the reference
-                jax.debug.print("{} Residuum: {}", it + (eff - 1), res)
+                # index of the last completed iteration, like the reference.
+                # solveRBA additionally echoes omega (solver.c:289-291).
+                if method == "rba":
+                    jax.debug.print(
+                        "{} Residuum: {} Omega: {}", it + (eff - 1), res, omega
+                    )
+                else:
+                    jax.debug.print("{} Residuum: {}", it + (eff - 1), res)
             return p, res, it + eff
 
         init = (prep(p0), jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
@@ -250,6 +305,11 @@ class PoissonSolver:
             return make_dct_solve_2d(
                 self.imax, self.jmax, self.dx, self.dy, self.dtype
             )
+        # the assignment-4 solver trio (solver.c:126/179/240): sor → solveRB
+        # (the performance path), sor_lex → solve, sor_rba → solveRBA
+        method = {"sor_lex": "lex", "sor_rba": "rba"}.get(
+            self.param.tpu_solver, "rb"
+        )
         return make_solver_fn(
             self.imax,
             self.jmax,
@@ -261,6 +321,7 @@ class PoissonSolver:
             self.dtype,
             backend=backend,
             n_inner=self.param.tpu_sor_inner,
+            method=method,
         )
 
     def solve(self):
@@ -270,7 +331,9 @@ class PoissonSolver:
             # runtime fault surfaces here, not at the caller's readback
             out = int(it), float(res)
         except Exception:
-            if self._backend == "jnp" or self.param.tpu_solver in ("mg", "fft"):
+            if self._backend == "jnp" or self.param.tpu_solver in (
+                "mg", "fft", "sor_lex", "sor_rba",
+            ):
                 raise  # no pallas in play — genuine error, don't re-run it
             # shape-specific pallas failure the dispatcher probe missed:
             # fall back to the always-available jnp path (same arithmetic)

@@ -68,6 +68,14 @@ class DistPoissonSolver:
     ):
         if dtype is None:
             dtype = resolve_dtype(param.tpu_dtype)
+        if param.tpu_solver in ("sor_lex", "sor_rba"):
+            # the assignment-4 oracle modes are sequential by definition;
+            # silently running the red-black path instead would defeat their
+            # iteration-parity purpose
+            raise ValueError(
+                f"tpu_solver {param.tpu_solver} is a single-device oracle "
+                "mode; distributed Poisson takes sor|mg|fft"
+            )
         self.param = param
         self.dtype = dtype
         self.comm = comm if comm is not None else CartComm(ndims=2)

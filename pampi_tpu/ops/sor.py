@@ -16,6 +16,7 @@ Arrays are (jmax+2, imax+2), layout [j, i] — j rows, i contiguous (lane dim).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -48,6 +49,57 @@ def sor_pass(p, rhs, mask, factor, idx2, idy2):
     r = _interior_residual(p, rhs, idx2, idy2) * mask
     p = p.at[1:-1, 1:-1].add(-factor * r)
     return p, jnp.sum(r * r)
+
+
+def lex_sweep(p, rhs, factor, idx2, idy2):
+    """One lexicographic Gauss-Seidel SOR sweep — the reference's `solve`
+    (assignment-4/src/solver.c:126-176): j-outer/i-inner, in-place, each cell
+    seeing the already-updated west and south neighbours.
+
+    TPU-legal formulation: the in-place double loop is a `lax.scan` over rows
+    (carry = the updated row below), and the within-row west dependency is the
+    first-order affine recurrence
+
+        p̂_i = c_i + m·p̂_{i-1},   m = factor·idx2,
+        c_i = p_i - factor·s_i,
+        s_i = rhs_i - [(p_{i+1} - 2p_i)·idx2 + (p̂below_i - 2p_i + pabove_i)·idy2]
+
+    solved with `associative_scan` (log-depth, vector-width work) instead of a
+    serial i-loop. The dependency structure — hence the iterate sequence and
+    iteration count — is the reference's exactly; only the floating-point
+    association inside the scan differs (rounding-level).
+
+    Returns (updated p incl. unchanged ghosts, sum of squared residuals), with
+    r_i recovered exactly in recurrence terms as r_i = s_i - idx2·p̂_{i-1}.
+    """
+    m = factor * idx2
+
+    def combine(lo, hi):
+        a1, b1 = lo
+        a2, b2 = hi
+        return a1 * a2, b2 + a2 * b1
+
+    def row_step(row_below, inputs):
+        row, row_above, rhs_row = inputs
+        s = rhs_row[1:-1] - (
+            (row[2:] - 2.0 * row[1:-1]) * idx2
+            + (row_below[1:-1] - 2.0 * row[1:-1] + row_above[1:-1]) * idy2
+        )
+        c = row[1:-1] - factor * s
+        # fold the left-ghost start value into element 0 so the scan output
+        # IS p̂ (a_0 = 0 kills the dependence on anything before the row)
+        a = jnp.full_like(c, m).at[0].set(0.0)
+        b = c.at[0].add(m * row[0])
+        _, x = jax.lax.associative_scan(combine, (a, b))
+        r = s - idx2 * jnp.concatenate([row[:1], x[:-1]])
+        new_row = jnp.concatenate([row[:1], x, row[-1:]])
+        return new_row, (new_row, jnp.sum(r * r))
+
+    _, (rows, row_res) = jax.lax.scan(
+        row_step, p[0], (p[1:-1], p[2:], rhs[1:-1])
+    )
+    p = p.at[1:-1].set(rows)
+    return p, jnp.sum(row_res)
 
 
 def residual_all(p, rhs, idx2, idy2):
