@@ -1,6 +1,15 @@
 """Distributed NS-3D: exact equality with the single-device solver on 3-D
 mesh shapes (the capability assignment-6 leaves as an unfinished skeleton,
-completed here; equivalence policy in models/ns3d_dist.py)."""
+completed here; equivalence policy in models/ns3d_dist.py).
+
+Property breadth mirrors the 2-D suite: balanced and extreme/degenerate
+meshes (single-axis 8-way splits ≙ the commShift/commExchange surfaces of
+assignment-6/src/comm.c:196-244 under maximal seam count), the
+communication-avoiding deep-halo knob (`tpu_ca_inner`), obstacles × mesh,
+checkpoint/restart × mesh, and canal outflow with the flow axis sharded.
+Every comparison is BITWISE (np.testing.assert_array_equal), stricter than
+the reference's own MPI parity.
+"""
 
 import numpy as np
 import pytest
@@ -8,25 +17,64 @@ import pytest
 from pampi_tpu.models.ns3d import NS3DSolver
 from pampi_tpu.models.ns3d_dist import NS3DDistSolver
 from pampi_tpu.parallel.comm import CartComm
-from pampi_tpu.utils.params import read_parameter
+from pampi_tpu.utils.params import Parameter, read_parameter
+
+# single-device runs are the oracle for several dist variants: cache them,
+# keyed on the FULL parameter set with dist-only knobs normalized away
+_single_cache = {}
 
 
-def _compare(param, dims):
-    single = NS3DSolver(param)
-    single.run(progress=False)
-    dist = NS3DDistSolver(param, CartComm(ndims=3, dims=dims))
+def _single(param):
+    import dataclasses
+
+    key = dataclasses.astuple(param.replace(tpu_ca_inner=1))
+    if key not in _single_cache:
+        s = NS3DSolver(param)
+        s.run(progress=False)
+        _single_cache[key] = (s.nt, s.collect())
+    return _single_cache[key]
+
+
+def _compare(param, dims, dist_param=None):
+    nt, fields = _single(param)
+    dist = NS3DDistSolver(dist_param or param, CartComm(ndims=3, dims=dims))
     dist.run(progress=False)
-    assert dist.nt == single.nt
-    for a, b in zip(single.collect(), dist.collect()):
+    assert dist.nt == nt
+    for a, b in zip(fields, dist.collect()):
         np.testing.assert_array_equal(a, b)
+
+
+def _dc16(reference_dir, **kw):
+    kw = {"imax": 16, "jmax": 16, "kmax": 16, "te": 0.5, "re": 100.0, **kw}
+    return read_parameter(
+        str(reference_dir / "assignment-6" / "dcavity.par")
+    ).replace(**kw)
 
 
 @pytest.mark.parametrize("dims", [(2, 2, 2), (1, 2, 4), (4, 2, 1)])
 def test_dcavity3d_dist_exact_vs_single(reference_dir, dims):
-    param = read_parameter(
-        str(reference_dir / "assignment-6" / "dcavity.par")
-    ).replace(imax=16, jmax=16, kmax=16, te=0.5, re=100.0)
-    _compare(param, dims)
+    _compare(_dc16(reference_dir), dims)
+
+
+@pytest.mark.parametrize("dims", [(8, 1, 1), (1, 1, 8), (2, 4, 1)])
+def test_dcavity3d_dist_extreme_meshes(reference_dir, dims):
+    """Single-axis 8-way and flat decompositions: the maximum seam count on
+    one axis plus degenerate axes whose both faces are physical walls —
+    the commIsBoundary/MPI_PROC_NULL edge cases of the 3-D topology."""
+    _compare(_dc16(reference_dir, te=0.2), dims)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_dcavity3d_dist_ca_inner_sweep(reference_dir, n):
+    """tpu_ca_inner ∈ {1,2,3}: n fused red-black iterations per depth-2n
+    halo exchange. Local extents 8×8×8 keep every n unclamped
+    (stencil2d.ca_clamp cap = 4). Bitwise parity requires itermax-capped
+    pressure solves with itermax % n == 0 (with a real eps the CA run may
+    legitimately stop up to n−1 iterations late — that envelope is covered
+    by test_ca_sor.py::test_ns3d_ca_converged_parity); itermax=36 is
+    divisible by 1, 2, and 3."""
+    base = _dc16(reference_dir, te=0.1, itermax=36, eps=1e-30)
+    _compare(base, (2, 2, 2), dist_param=base.replace(tpu_ca_inner=n))
 
 
 def test_canal3d_dist_exact_vs_single(reference_dir):
@@ -35,3 +83,68 @@ def test_canal3d_dist_exact_vs_single(reference_dir):
         str(reference_dir / "assignment-6" / "canal.par")
     ).replace(imax=48, jmax=16, kmax=16, te=0.5)
     _compare(param, (2, 2, 2))
+
+
+def test_canal3d_dist_flow_axis_fully_sharded(reference_dir):
+    """(1,1,8): all 8 shards in a line along the FLOW axis — inflow special
+    BC on the first shard only, outflow on the last only, 7 interior seams
+    that every F/G/H shift and exchange must cross."""
+    param = read_parameter(
+        str(reference_dir / "assignment-6" / "canal.par")
+    ).replace(imax=48, jmax=16, kmax=16, te=0.2)
+    _compare(param, (1, 1, 8))
+
+
+_OBST = Parameter(
+    name="dcavity3d", imax=16, jmax=8, kmax=8,
+    xlength=2.0, ylength=1.0, zlength=1.0,
+    re=50.0, te=0.06, dt=0.02, tau=0.5, itermax=100, eps=1e-5,
+    omg=1.7, gamma=0.9,
+    bcLeft=1, bcRight=1, bcBottom=1, bcTop=1, bcFront=1, bcBack=1,
+    obstacles="0.5,0.25,0.25,1.0,0.75,0.75",
+    tpu_dtype="float64",
+)
+
+
+@pytest.mark.parametrize("dims", [(1, 1, 8), (2, 1, 4)])
+def test_obstacle3d_dist_extreme_meshes(dims):
+    """Obstacle box spanning shard seams on extreme meshes (the balanced
+    meshes are covered in test_obstacle3d.py): shard-sliced global masks ×
+    maximal flow-axis seam count."""
+    _compare(_OBST, dims)
+
+
+def test_obstacle3d_dist_with_ca_inner():
+    """Obstacles × deep-halo CA blocks: the eps-coefficient masked sweep
+    fused n=2 per exchange must match single-device bitwise."""
+    _compare(_OBST, (1, 2, 4), dist_param=_OBST.replace(tpu_ca_inner=2))
+
+
+def test_restart_mid_run_matches_uninterrupted_extreme_mesh(tmp_path,
+                                                           reference_dir):
+    """Checkpoint at te=0.2, restore into a fresh solver on the SAME
+    (1,2,4) mesh with tpu_ca_inner=2, continue to te=0.5: the collected
+    fields must equal both the uninterrupted distributed run and the
+    single-device oracle bitwise (test_checkpoint.py covers (2,2,2))."""
+    from pampi_tpu.utils import checkpoint as ckpt
+
+    dims = (1, 2, 4)
+    # itermax-capped solves (itermax % 2 == 0, eps tiny) so the ca_inner=2
+    # trajectory is bitwise-reproducible against the single-device oracle
+    base = _dc16(reference_dir, itermax=40, eps=1e-30)  # te=0.5
+    knobbed = base.replace(tpu_ca_inner=2)
+
+    first = NS3DDistSolver(knobbed.replace(te=0.2),
+                           CartComm(ndims=3, dims=dims))
+    first.run(progress=False)
+    path = str(tmp_path / "ck.npz")
+    ckpt.save_checkpoint(path, first)
+
+    resumed = NS3DDistSolver(knobbed, CartComm(ndims=3, dims=dims))
+    ckpt.load_checkpoint(path, resumed)
+    resumed.run(progress=False)
+
+    nt, fields = _single(base)
+    assert resumed.nt == nt
+    for a, b in zip(fields, resumed.collect()):
+        np.testing.assert_array_equal(a, b)

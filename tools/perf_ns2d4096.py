@@ -1,0 +1,60 @@
+"""NS-2D steady-step timing at the north-star grid (4096^2 f32), all three
+pressure solvers under ONE protocol, so the BASELINE.md row compares like
+with like.
+
+Protocol: dcavity Re=1000, tau=0.5, eps=1e-3, itermax=100, f32. Build the
+jitted step, run 5 settle steps (compile + let dt/p leave the cold-start
+state), then best-of-10 single-step wall times (the axon tunnel jitters up
+to ~50%, so best-of is the stable statistic — see BASELINE.md).
+
+Run on the real chip:  python tools/perf_ns2d4096.py [solvers...]
+Defaults to: sor fft mg.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from pampi_tpu.utils.params import Parameter
+
+N = 4096
+SETTLE = 5
+REPS = 10
+
+
+def measure(solver: str) -> float:
+    from pampi_tpu.models.ns2d import NS2DSolver
+
+    param = Parameter(
+        name="dcavity", imax=N, jmax=N, re=1000.0, te=10.0, tau=0.5,
+        itermax=100, eps=1e-3, omg=1.7, gamma=0.9, tpu_dtype="float32",
+        tpu_solver=solver,
+    )
+    s = NS2DSolver(param, dtype=jnp.float32)
+    step = jax.jit(s._build_step())
+    u, v, p = s.u, s.v, s.p
+    t = jnp.asarray(0.0, jnp.float32)
+    nt = jnp.asarray(0, jnp.int32)
+    for _ in range(SETTLE):
+        u, v, p, t, nt = step(u, v, p, t, nt)
+    jax.block_until_ready(p)
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        u, v, p, t, nt = step(u, v, p, t, nt)
+        jax.block_until_ready(p)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+if __name__ == "__main__":
+    solvers = sys.argv[1:] or ["sor", "fft", "mg"]
+    print(f"backend={jax.default_backend()} N={N} itermax=100 eps=1e-3 f32")
+    for sv in solvers:
+        ms = measure(sv) * 1e3
+        print(f"{sv:4s}: {ms:8.2f} ms/step")

@@ -4,10 +4,11 @@ block_k / n_inner. Reports lattice-site updates/s (sites x RB-iterations /
 wall). Run on TPU: python tools/perf_sor3d.py [K J I]"""
 
 import functools
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

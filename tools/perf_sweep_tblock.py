@@ -2,10 +2,11 @@
 kernel on the real chip. Total RB iterations fixed so throughput numbers
 compare directly with bench.py."""
 
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -4,10 +4,11 @@ Throwaway measurement harness — numerics of the stripped variants are WRONG
 (no BC), only timings matter."""
 
 import functools
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

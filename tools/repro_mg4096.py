@@ -1,0 +1,94 @@
+"""Minimal repro for the mg device fault at 4096^2 NS-2D (BASELINE.md note).
+
+Round 1 recorded: `tpu_solver mg` inside the NS-2D chunk at 4096^2 f32 hits
+an XLA:TPU device fault (UNAVAILABLE class) on this chip, while fft and the
+Pallas SOR run fine. This script isolates the nesting level at which the
+fault appears:
+
+  stage 1  mg solve alone (PoissonSolver-shaped: one jitted while_loop of
+           V-cycles) at 4096^2
+  stage 2  one NS-2D timestep with the mg pressure solve (solve while_loop
+           nested in the step program)
+  stage 3  the production chunk driver (step while_loop nested in the chunk
+           while_loop) - the shape the original fault was recorded in
+
+Run on the real chip:  python tools/repro_mg4096.py [N] [stages]
+Prints PASS/FAULT per stage; exits nonzero on the first fault. Each stage
+re-runs once on a fault to separate the persistent failure from the
+transient-infra class (models/_driver._is_transient_device_fault).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from pampi_tpu.models._driver import _is_transient_device_fault
+from pampi_tpu.utils.params import Parameter
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+STAGES = sys.argv[2] if len(sys.argv) > 2 else "123"
+
+
+def _attempt(label, fn):
+    for attempt in (1, 2):
+        try:
+            fn()
+            print(f"{label}: PASS (attempt {attempt})")
+            return True
+        except Exception as e:  # noqa: BLE001 - we classify and report
+            transient = _is_transient_device_fault(e)
+            print(
+                f"{label}: FAULT attempt {attempt} "
+                f"(transient-class={transient}): {type(e).__name__}: "
+                f"{str(e)[:300]}"
+            )
+    return False
+
+
+def stage1():
+    from pampi_tpu.ops.multigrid import make_mg_solve_2d
+
+    solve = jax.jit(make_mg_solve_2d(N, N, 1.0 / N, 1.0 / N, 1e-3, 20, jnp.float32))
+    p = jnp.zeros((N + 2, N + 2), jnp.float32)
+    rhs = jnp.ones((N + 2, N + 2), jnp.float32)
+    out = solve(p, rhs)
+    jax.block_until_ready(out)
+
+
+def _param(te):
+    return Parameter(
+        name="dcavity", imax=N, jmax=N, re=1000.0, te=te, tau=0.5,
+        itermax=20, eps=1e-3, omg=1.7, gamma=0.9, tpu_dtype="float32",
+        tpu_solver="mg",
+    )
+
+
+def stage2():
+    from pampi_tpu.models.ns2d import NS2DSolver
+
+    s = NS2DSolver(_param(te=1.0), dtype=jnp.float32)
+    step = jax.jit(s._build_step())
+    out = step(s.u, s.v, s.p, jnp.asarray(0.0, jnp.float32), jnp.asarray(0, jnp.int32))
+    jax.block_until_ready(out)
+
+
+def stage3():
+    from pampi_tpu.models.ns2d import NS2DSolver
+
+    s = NS2DSolver(_param(te=1e-4), dtype=jnp.float32)  # a few steps, one chunk
+    s.run(progress=False)
+
+
+if __name__ == "__main__":
+    print(f"backend={jax.default_backend()} N={N}")
+    ok = True
+    for st, fn in (("1-mg-solve-alone", stage1), ("2-ns-step", stage2), ("3-ns-chunk-driver", stage3)):
+        if st[0] in STAGES:
+            ok = _attempt(st, fn) and ok
+            if not ok:
+                break
+    sys.exit(0 if ok else 1)
