@@ -407,17 +407,21 @@ def tblock_halo(n_inner: int, dtype) -> int:
 
 def pick_block_rows_tblock(jmax: int, imax: int, dtype=jnp.float32,
                            n_inner: int = 4) -> int:
-    """Block height for the temporal-blocked kernel. 256 rows at 4096-wide
-    f32 measured fastest on v5e (larger blocks push Mosaic's scoped-vmem
-    temporaries past the limit, smaller ones pay more redundant halo
-    recompute); scale the row count inversely with the padded width to hold
-    the window byte size roughly constant."""
+    """Block height for the temporal-blocked kernel. The round-2 sweep
+    (tools/perf_sweep_tblock.py, dispatch-latency-amortized: SWEEP_TOTAL=960,
+    k ∈ {3..8} × br ∈ {64..256} at 4096² f32, and the 8192² region harness)
+    measured a flat surface 36-41G updates/s with the optimum at 128 rows
+    for BOTH 4224- and 8320-lane widths — so large grids get a flat 128.
+    Small grids keep the single-block window (no redundant halo recompute;
+    the window fits VMEM outright)."""
     a = _align(dtype)
     h = tblock_halo(n_inner, dtype)
     wp = padded_width(imax)
+    whole = -(-(jmax + 2) // a) * a  # one block covering everything
+    if whole >= 1024:
+        return max(a, h, 128)
     target = 256 * 4224 * 4  # bytes per window buffer that fit comfortably
     br = target // (wp * jnp.dtype(dtype).itemsize) // a * a
-    whole = -(-(jmax + 2) // a) * a
     return max(a, h, min(br, 512, whole))
 
 

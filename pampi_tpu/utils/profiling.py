@@ -11,6 +11,15 @@ switch is the PAMPI_PROFILE environment variable instead of a compile flag.
   PAMPI_PROFILE=1        region wall-clock accounting + trace annotations
   PAMPI_PROFILE=<dir>    additionally jax.profiler.start_trace(<dir>) on
                          init and stop on finalize (full XProf trace)
+  PAMPI_PROFILE_CSV=<f>  finalize() additionally writes the region table as
+                         machine-readable CSV (region,calls,wall_s,device_s)
+                         — the counter-CSV surface of the reference's perl
+                         likwid-mpirun harness (assignment-3a/perl
+                         scripts/bench-node.pl:17-27). device_s rows come
+                         from add_device_time() (harnesses that time a
+                         region's device work to completion, e.g.
+                         tools/bench_regions.py); empty when only host-side
+                         wall clock was recorded.
 
 Usage (mirrors LIKWID_MARKER_*):
     prof.init(); with prof.region("solve"): ...; prof.finalize()
@@ -27,6 +36,7 @@ from collections import defaultdict
 _MODE = os.environ.get("PAMPI_PROFILE", "0")
 _times: dict[str, float] = defaultdict(float)
 _counts: dict[str, int] = defaultdict(int)
+_device_times: dict[str, float] = defaultdict(float)
 _tracing = False
 
 
@@ -62,8 +72,20 @@ def region(name: str):
     _counts[name] += 1
 
 
+def add_device_time(name: str, seconds: float, calls: int = 1) -> None:
+    """Record device-inclusive time for a region (the caller timed the work
+    to completion, e.g. around block_until_ready). Shows up as the device_s
+    CSV column; also counts as a region so harness-only regions appear in
+    the table."""
+    if not enabled():
+        return
+    _device_times[name] += seconds
+    _counts[name] += calls
+
+
 def finalize(out=None) -> None:
-    """≙ LIKWID_MARKER_CLOSE: stop the trace and print the region table."""
+    """≙ LIKWID_MARKER_CLOSE: stop the trace, print the region table, and
+    write the CSV twin when PAMPI_PROFILE_CSV is set."""
     global _tracing
     out = out if out is not None else sys.stderr
     if not enabled():
@@ -73,12 +95,31 @@ def finalize(out=None) -> None:
 
         jax.profiler.stop_trace()
         _tracing = False
-    if _times:
+    names = sorted(
+        set(_times) | set(_device_times),
+        key=lambda n: max(_times.get(n, 0.0), _device_times.get(n, 0.0)),
+        reverse=True,
+    )
+    if names:
         out.write("Region                    calls      time[s]\n")
-        for name in sorted(_times, key=_times.get, reverse=True):
-            out.write(f"{name:<24} {_counts[name]:>6} {_times[name]:>12.4f}\n")
+        for name in names:
+            t = _times.get(name) or _device_times.get(name, 0.0)
+            out.write(f"{name:<24} {_counts[name]:>6} {t:>12.4f}\n")
+    csv_path = os.environ.get("PAMPI_PROFILE_CSV", "")
+    if csv_path and names:
+        with open(csv_path, "w") as fh:
+            fh.write("region,calls,wall_s,device_s\n")
+            for name in names:
+                wall = f"{_times[name]:.6f}" if name in _times else ""
+                dev = (
+                    f"{_device_times[name]:.6f}"
+                    if name in _device_times
+                    else ""
+                )
+                fh.write(f"{name},{_counts[name]},{wall},{dev}\n")
 
 
 def reset() -> None:
     _times.clear()
     _counts.clear()
+    _device_times.clear()

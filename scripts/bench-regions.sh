@@ -1,0 +1,26 @@
+#!/bin/bash
+# Per-region counter sweep over the headline configs — the ≙ of the
+# reference's perl likwid-mpirun harnesses (assignment-3a/perl
+# scripts/bench-node.pl:17-27): one counter CSV per config, each region a
+# separately-timed device kernel (tools/bench_regions.py).
+#
+# Usage: scripts/bench-regions.sh [outdir]   (default results/regions)
+# Run on the real chip for the production numbers; runs anywhere.
+set -eu
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+OUT=${1:-"$REPO/results/regions"}
+mkdir -p "$OUT"
+
+run() { # run <tag> <par-file>
+    echo "== $1 ($2)"
+    PAMPI_PROFILE=1 PAMPI_PROFILE_CSV="$OUT/$1.csv" \
+        python "$REPO/tools/bench_regions.py" "$2"
+}
+
+run poisson8192   "$REPO/configs/poisson8192.par"   # 8192^2 strong-scaling grid
+run dcavity256    "$REPO/configs/dcavity256.par"
+run dcavity3d128  "$REPO/configs/dcavity3d.par"
+run canal3d       "$REPO/configs/canal3d.par"
+
+echo "CSVs in $OUT:"
+ls -l "$OUT"

@@ -16,8 +16,13 @@ from pampi_tpu.models.poisson import init_fields
 from pampi_tpu.ops import sor_pallas as sp
 from pampi_tpu.utils.params import Parameter
 
-N = 4096
-TOTAL = 120  # total RB iterations per timed run (divisible by all k below)
+N = int(os.environ.get("SWEEP_N", 4096))
+# total RB iterations per timed run (pick divisible by all k swept; raise it
+# when the tunnel's per-dispatch latency floor is high — the loop is ONE
+# dispatch, so iterations amortize the floor)
+TOTAL = int(os.environ.get("SWEEP_TOTAL", 120))
+KS = tuple(int(x) for x in os.environ.get("SWEEP_K", "3,4,5,6").split(","))
+BRS = tuple(int(x) for x in os.environ.get("SWEEP_BR", "256").split(","))
 
 
 def timeit(fn, *args):
@@ -36,8 +41,8 @@ def main():
     param = Parameter(imax=N, jmax=N, tpu_dtype="float32")
     p, rhs = init_fields(param, problem=2, dtype=jnp.float32)
 
-    for k in (3, 4, 5, 6):
-        for br in (256,):
+    for k in KS:
+        for br in BRS:
             try:
                 rb, brr, h = sp.make_rb_iter_tblock(
                     N, N, 1.0 / N, 1.0 / N, 1.9, jnp.float32,

@@ -3,7 +3,6 @@ vs with BC refresh removed vs with red-sweep only (halved stencil work).
 Throwaway measurement harness — numerics of the stripped variants are WRONG
 (no BC), only timings matter."""
 
-import functools
 import os
 import sys
 import time
@@ -20,13 +19,15 @@ from pampi_tpu.models.poisson import init_fields
 from pampi_tpu.ops import sor_pallas as sp
 from pampi_tpu.utils.params import Parameter
 
-N = 4096
-TOTAL = 96
-K = 4
-BR = 256
+N = int(os.environ.get("VAR_N", 4096))
+TOTAL = int(os.environ.get("VAR_TOTAL", 96))  # one dispatch; raise to
+# amortize a high tunnel latency floor
+K = int(os.environ.get("VAR_K", 4))
+BR = int(os.environ.get("VAR_BR", 256))
 
 
-def make_variant(no_bc=False, red_only=False, no_res=False):
+def make_variant(no_bc=False, red_only=False, no_res=False, inc_black=False,
+                 bc_cond=False):
     dtype = jnp.float32
     h = sp.tblock_halo(K, dtype)
     wp = sp.padded_width(N)
@@ -93,14 +94,39 @@ def make_variant(no_bc=False, red_only=False, no_res=False):
 
         r_red = r_blk = jnp.zeros_like(p)
         for t in range(K):
-            r_red = jnp.where(red, rw - lap(p), 0.0)
-            p = p - factor * r_red
-            if not red_only:
-                r_blk = jnp.where(black, rw - lap(p), 0.0)
+            if inc_black:
+                # one lap; black residual reconstructed from the red deltas
+                # (linear stencil: r_blk = r_all + factor*stencil(r_red))
+                r_all = rw - lap(p)
+                r_red = jnp.where(red, r_all, 0.0)
+                p = p - factor * r_red
+                corr = (
+                    jnp.roll(r_red, -1, 1) + jnp.roll(r_red, 1, 1)
+                    + jnp.roll(r_red, -1, 0) + jnp.roll(r_red, 1, 0)
+                ) * idx2
+                r_blk = jnp.where(black, r_all + factor * corr, 0.0)
                 p = p - factor * r_blk
+            else:
+                r_red = jnp.where(red, rw - lap(p), 0.0)
+                p = p - factor * r_red
+                if not red_only:
+                    r_blk = jnp.where(black, rw - lap(p), 0.0)
+                    p = p - factor * r_blk
             if not no_bc:
-                p = jnp.where(rgl, jnp.roll(p, -1, axis=0), p)
-                p = jnp.where(rgh, jnp.roll(p, 1, axis=0), p)
+                if bc_cond:
+                    # row-ghost refresh only in the blocks that contain a
+                    # ghost row (first/last) — scf.if at runtime
+                    p = jax.lax.cond(
+                        b == 0,
+                        lambda q: jnp.where(rgl, jnp.roll(q, -1, axis=0), q),
+                        lambda q: q, p)
+                    p = jax.lax.cond(
+                        b == nblocks - 1,
+                        lambda q: jnp.where(rgh, jnp.roll(q, 1, axis=0), q),
+                        lambda q: q, p)
+                else:
+                    p = jnp.where(rgl, jnp.roll(p, -1, axis=0), p)
+                    p = jnp.where(rgh, jnp.roll(p, 1, axis=0), p)
                 p = jnp.where(cgl, jnp.roll(p, -1, axis=1), p)
                 p = jnp.where(cgh, jnp.roll(p, 1, axis=1), p)
 
@@ -175,6 +201,9 @@ def main():
         ("no-res      ", dict(no_res=True)),
         ("red-only    ", dict(red_only=True)),
         ("red+nobc    ", dict(red_only=True, no_bc=True)),
+        ("inc-black   ", dict(inc_black=True)),
+        ("bc-cond     ", dict(bc_cond=True)),
+        ("inc+cond    ", dict(inc_black=True, bc_cond=True)),
     ]:
         call, h = make_variant(**kw)
         pp = sp.pad_array(p, BR, h)
