@@ -48,10 +48,13 @@ N = 4096
 # was ~44 ms of work and under-recorded the kernel 2.2x: 18.09G vs the
 # ~40G the same kernel measures latency-amortized).
 ITERS = 4800
-N_INNER = 4  # temporal-blocking depth (pallas path; best of the round-2
-# latency-amortized k x block_rows sweep at 4096^2 on v5e — see
-# tools/perf_sweep_tblock.py); the timed loop runs (ITERS // eff) * eff
-# iterations and divides by exactly that count
+N_INNER = 8  # temporal-blocking depth. The auto layout dispatches the
+# QUARTER-decomposition kernel (ops/sor_quarters.py — all lanes productive,
+# uniform shifts) at its shipped default of 64 quarter-rows (= 128 grid
+# rows) per block: 140.6G updates/s measured HERE, vs 67-107G across the
+# standalone k x brq sweep and the masked checkerboard's 47.5G; the timed
+# loop runs (ITERS // eff) * eff iterations and divides by exactly that
+# count
 
 
 def _timed_run(backend: str):

@@ -133,6 +133,14 @@ def _dispatch(param, prof) -> int:
         )
         return 1
 
+    if param.tpu_sor_layout not in ("auto", "checkerboard", "quarters"):
+        print(
+            "Error: tpu_sor_layout must be auto|checkerboard|quarters, "
+            f"got {param.tpu_sor_layout!r}",
+            file=sys.stderr,
+        )
+        return 1
+
     if param.obstacles.strip() and param.name.startswith("poisson"):
         # refuse rather than silently simulate an empty box
         print(

@@ -36,7 +36,7 @@ from ..utils.progress import Progress
 
 def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
                         backend: str = "auto", n_inner: int = 1,
-                        solver: str = "sor"):
+                        solver: str = "sor", layout: str = "auto"):
     """Pressure-Poisson solve loop (solve, solver.c:140-191): carry
     (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep.
 
@@ -64,7 +64,7 @@ def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
     from .poisson import make_solver_fn
 
     return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
-                          backend=backend, n_inner=n_inner)
+                          backend=backend, n_inner=n_inner, layout=layout)
 
 
 class NS2DSolver:
@@ -137,6 +137,7 @@ class NS2DSolver:
                 backend=backend,
                 n_inner=param.tpu_sor_inner,
                 solver=param.tpu_solver,
+                layout=param.tpu_sor_layout,
             )
         else:
             from ..ops import obstacle as obst

@@ -525,6 +525,271 @@ def make_rb_iter_tblock(
     return rb_iter, block_rows, h
 
 
+def _tblock_quarters_kernel(
+    p_in,   # ANY (4, rp, W2p) stacked quarters [R0, R1, B0, B1]
+    rhs,    # ANY (4, rp, W2p) stacked rhs quarters [F0, F1, G0, G1]
+    p_out,  # ANY (4, rp, W2p)
+    res,    # SMEM (1, 1)
+    pw2,    # VMEM (2, 4, brq+2h, W2p) p windows, double-buffered
+    rw2,    # VMEM (2, 4, brq+2h, W2p) rhs windows
+    ob2,    # VMEM (2, 4, brq, W2p) out bands
+    vacc,   # VMEM (1, W2p) per-lane residual accumulator
+    ld_sem,  # DMA (2, 8)
+    st_sem,  # DMA (2, 4)
+    *,
+    n_inner: int,
+    block_rows: int,  # quarter rows per block
+    nblocks: int,
+    j2: int,   # (jmax+2)//2 logical quarter rows
+    i2: int,   # (imax+2)//2 logical quarter lanes
+    halo: int,
+    factor: float,
+    idx2: float,
+    idy2: float,
+):
+    """Temporal-blocked red-black sweep in the QUARTER layout
+    (ops/sor_quarters.py derivation): every neighbour a uniform ±1 shift,
+    every lane productive, the Neumann refresh 8 same-index edge selects.
+    One iteration consumes ONE quarter-row of halo per side (= 2 grid rows,
+    matching the checkerboard kernel's 2·n_inner grid-row halo)."""
+    b = pl.program_id(0)
+    brq = block_rows
+    h = halo
+    slot = b % 2
+    nslot = (b + 1) % 2
+
+    def load(k, s):
+        copies = []
+        for qi in range(4):
+            copies.append(pltpu.make_async_copy(
+                p_in.at[qi, pl.ds(k * brq, brq + 2 * h), :],
+                pw2.at[s, qi], ld_sem.at[s, qi]))
+            copies.append(pltpu.make_async_copy(
+                rhs.at[qi, pl.ds(k * brq, brq + 2 * h), :],
+                rw2.at[s, qi], ld_sem.at[s, 4 + qi]))
+        return copies
+
+    def store(k, s):
+        return [pltpu.make_async_copy(
+            ob2.at[s, qi], p_out.at[qi, pl.ds(h + k * brq, brq), :],
+            st_sem.at[s, qi]) for qi in range(4)]
+
+    @pl.when(b == 0)
+    def _():
+        res[0, 0] = jnp.zeros((), p_out.dtype)
+        vacc[...] = jnp.zeros_like(vacc)
+        for c in load(0, 0):
+            c.start()
+
+    @pl.when(b + 1 < nblocks)
+    def _():
+        for c in load(b + 1, nslot):
+            c.start()
+
+    for c in load(b, slot):
+        c.wait()
+
+    R0, R1, B0, B1 = (pw2[slot, qi] for qi in range(4))
+    F0, F1, G0, G1 = (rw2[slot, qi] for qi in range(4))
+
+    # quarter-space coordinates of window cell (w, c): r = b*brq - h + w
+    rr = b * brq - h + jax.lax.broadcasted_iota(jnp.int32, R0.shape, 0)
+    cc = jax.lax.broadcasted_iota(jnp.int32, R0.shape, 1)
+    # rectangular interiors per quarter (module docstring of sor_quarters)
+    m_r0 = (rr >= 1) & (rr <= j2 - 1) & (cc >= 1) & (cc <= i2 - 1)
+    m_r1 = (rr >= 0) & (rr <= j2 - 2) & (cc <= i2 - 2)
+    m_b0 = (rr >= 1) & (rr <= j2 - 1) & (cc <= i2 - 2)
+    m_b1 = (rr >= 0) & (rr <= j2 - 2) & (cc >= 1) & (cc <= i2 - 1)
+    # Neumann edge-strip selects (same-index copies between quarters)
+    row_lo = rr == 0
+    row_hi = rr == j2 - 1
+    col_lo = cc == 0
+    col_hi_even = cc == i2 - 1   # i = imax (even-i quarters' last lane)
+    j_int_even = (rr >= 1) & (rr <= j2 - 1)
+    j_int_odd = (rr >= 0) & (rr <= j2 - 2)
+
+    def upd(center, rhs_q, w, e, s, n, mask):
+        r = rhs_q - ((e - 2.0 * center + w) * idx2
+                     + (n - 2.0 * center + s) * idy2)
+        rm = jnp.where(mask, r, 0.0)
+        return center - factor * rm, rm
+
+    def east(x):
+        return jnp.roll(x, -1, axis=1)
+
+    def west(x):
+        return jnp.roll(x, 1, axis=1)
+
+    def north(x):
+        return jnp.roll(x, -1, axis=0)
+
+    def south(x):
+        return jnp.roll(x, 1, axis=0)
+
+    r0 = r1 = r2 = r3 = None
+    for _ in range(n_inner):
+        # red pass (reads black)
+        R0, r0 = upd(R0, F0, west(B0), B0, south(B1), B1, m_r0)
+        R1, r1 = upd(R1, F1, B1, east(B1), B0, north(B0), m_r1)
+        # black pass (reads updated red)
+        B0, r2 = upd(B0, G0, R0, east(R0), south(R1), R1, m_b0)
+        B1, r3 = upd(B1, G1, west(R1), R1, R0, north(R0), m_b1)
+        # Neumann ghost refresh: 8 same-index edge selects
+        R0 = jnp.where(row_lo & (cc >= 1) & (cc <= i2 - 1), B1, R0)
+        B0 = jnp.where(row_lo & (cc <= i2 - 2), R1, B0)
+        R1 = jnp.where(row_hi & (cc <= i2 - 2), B0, R1)
+        B1 = jnp.where(row_hi & (cc >= 1) & (cc <= i2 - 1), R0, B1)
+        R0 = jnp.where(col_lo & j_int_even, B0, R0)
+        B1 = jnp.where(col_lo & j_int_odd, R1, B1)
+        B0 = jnp.where(col_hi_even & j_int_even, R0, B0)
+        R1 = jnp.where(col_hi_even & j_int_odd, B1, R1)
+
+    @pl.when(b >= 2)
+    def _():
+        for c in store(b - 2, slot):
+            c.wait()
+
+    for qi, arr in enumerate((R0, R1, B0, B1)):
+        ob2[slot, qi] = arr[h: h + brq, :]
+    for c in store(b, slot):
+        c.start()
+
+    # residual of the final iteration, owned bands only
+    acc = jnp.zeros_like(vacc[...])
+    for rq in (r0, r1, r2, r3):
+        band = rq[h: h + brq, :]
+        acc = acc + jnp.sum(band * band, axis=0, keepdims=True)
+    vacc[...] += acc
+
+    @pl.when(b == nblocks - 1)
+    def _():
+        res[0, 0] += jnp.sum(vacc[...])
+
+    @pl.when(b == nblocks - 1)
+    def _():
+        for c in store(b, slot):
+            c.wait()
+        if nblocks > 1:
+            for c in store(b - 1, nslot):
+                c.wait()
+
+
+def quarters_halo(n_inner: int, dtype) -> int:
+    """Quarter-row halo for n_inner fused iterations: 1 quarter row per
+    iteration per side, rounded to the sublane alignment."""
+    a = _align(dtype)
+    return max(a, -(-n_inner // a) * a)
+
+
+def pad_quarters(p, block_rows_q: int, halo: int):
+    """(jmax+2, imax+2) even-shaped array -> (4, rp, W2p) stacked padded
+    quarter layout [R0, R1, B0, B1]."""
+    from .sor_quarters import pack_quarters
+
+    quarters = pack_quarters(p)
+    j2, i2 = quarters[0].shape
+    nblocks = -(-j2 // block_rows_q)
+    rp = nblocks * block_rows_q + 2 * halo
+    w2p = -(-i2 // LANE) * LANE
+    out = jnp.zeros((4, rp, w2p), p.dtype)
+    for qi, q in enumerate(quarters):
+        out = out.at[qi, halo: halo + j2, :i2].set(q)
+    return out
+
+
+def unpad_quarters(xq, jmax: int, imax: int, halo: int):
+    """Inverse of pad_quarters -> (jmax+2, imax+2)."""
+    from .sor_quarters import unpack_quarters
+
+    j2, i2 = (jmax + 2) // 2, (imax + 2) // 2
+    qs = [xq[qi, halo: halo + j2, :i2] for qi in range(4)]
+    return unpack_quarters(*qs)
+
+
+def make_rb_iter_tblock_quarters(
+    imax: int,
+    jmax: int,
+    dx: float,
+    dy: float,
+    omega: float,
+    dtype,
+    *,
+    n_inner: int = 4,
+    block_rows_q: int | None = None,
+    interpret: bool | None = None,
+):
+    """Temporal-blocked QUARTER-layout kernel: builds
+    `(p_stacked, rhs_stacked) -> (p_stacked', res_sumsq_of_last_iter)`
+    on the (4, rp, W2p) layout of `pad_quarters`. Requires even imax/jmax.
+    Returns (rb_iter, block_rows_q, halo).
+
+    Numerics: per-cell arithmetic keeps the reference association and is
+    ulp-equivalent to the masked paths (compiler fma/fusion differences
+    only — ops/sor_quarters.py); the residual summation order differs."""
+    if pltpu is None:
+        return None, 0, 0
+    if imax % 2 or jmax % 2:
+        raise ValueError("quarter layout needs even imax and jmax")
+    h = quarters_halo(n_inner, dtype)
+    if block_rows_q is None:
+        # measured-optimal 128 grid rows (pick_block_rows_tblock) = 64
+        j2 = (jmax + 2) // 2
+        whole = -(-j2 // _align(dtype)) * _align(dtype)
+        block_rows_q = max(_align(dtype), h, min(64, whole))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _check_dtype(dtype, interpret)
+
+    dx2, dy2 = dx * dx, dy * dy
+    j2, i2 = (jmax + 2) // 2, (imax + 2) // 2
+    w2p = -(-i2 // LANE) * LANE
+    nblocks = -(-j2 // block_rows_q)
+    rp = nblocks * block_rows_q + 2 * h
+    kernel = functools.partial(
+        _tblock_quarters_kernel,
+        n_inner=n_inner,
+        block_rows=block_rows_q,
+        nblocks=nblocks,
+        j2=j2,
+        i2=i2,
+        halo=h,
+        factor=omega * 0.5 * (dx2 * dy2) / (dx2 + dy2),
+        idx2=1.0 / dx2,
+        idy2=1.0 / dy2,
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, 1), lambda b: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((4, rp, w2p), dtype),
+            jax.ShapeDtypeStruct((1, 1), dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, 4, block_rows_q + 2 * h, w2p), dtype),
+            pltpu.VMEM((2, 4, block_rows_q + 2 * h, w2p), dtype),
+            pltpu.VMEM((2, 4, block_rows_q, w2p), dtype),
+            pltpu.VMEM((1, w2p), dtype),
+            pltpu.SemaphoreType.DMA((2, 8)),
+            pltpu.SemaphoreType.DMA((2, 4)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT_BYTES
+        ),
+        interpret=interpret,
+    )
+
+    def rb_iter(p_stacked, rhs_stacked):
+        p_stacked, res = call(p_stacked, rhs_stacked)
+        return p_stacked, res[0, 0]
+
+    return rb_iter, block_rows_q, h
+
+
 def neumann_bc_padded(p, jmax: int, imax: int):
     """Homogeneous-Neumann ghost copy in the padded layout (parity with
     ops/sor.py `neumann_bc`: walls only, corners untouched)."""

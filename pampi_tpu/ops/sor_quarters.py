@@ -1,0 +1,120 @@
+"""Red-black SOR in the QUARTER decomposition — the compressed layout that
+works on TPU.
+
+Round 1 measured the obvious compressed red-black layout (two half-width
+arrays, one per color) 1.6× SLOWER than the masked checkerboard: packing by
+column parity makes the east/west neighbour index depend on ROW parity, and
+the per-row lane selects cost more than the masking they remove
+(ops/sor_pallas.py module docstring). The fix is to split by BOTH parities —
+four dense (J/2, I/2) arrays
+
+    R0[r,c] = p[2r,   2c  ]   red   on even rows
+    R1[r,c] = p[2r+1, 2c+1]   red   on odd rows
+    B0[r,c] = p[2r,   2c+1]   black on even rows
+    B1[r,c] = p[2r+1, 2c  ]   black on odd rows
+
+under which every 5-point neighbour is a UNIFORM shift, verified identities:
+
+    R0: W=B0[c-1] E=B0[c]   S=B1[r-1] N=B1[r]
+    R1: W=B1[c]   E=B1[c+1] S=B0[r]   N=B0[r+1]
+    B0: W=R0[c]   E=R0[c+1] S=R1[r-1] N=R1[r]
+    B1: W=R1[c-1] E=R1[c]   S=R0[r]   N=R0[r+1]
+
+so a half-sweep is two dense, unmasked, all-lanes-productive updates — half
+the arithmetic and a third of the shifts of the masked checkerboard (which
+computes both laps over every lane and throws half away). The Neumann ghost
+refresh becomes FOUR same-index edge-strip copies between quarters (no
+shifts): p[0,:]=p[1,:] ⇒ R0[0,:]=B1[0,:], B0[0,:]=R1[0,:]; the top row
+j=jmax+1 (odd) ⇒ R1[-1,:]=B0[-1,:], B1[-1,:]=R0[-1,:]; left i=0 ⇒
+R0[:,0]=B0[:,0], B1[:,0]=R1[:,0]; right i=imax+1 (odd) ⇒ B0[:,-1]=R0[:,-1],
+R1[:,-1]=B1[:,-1] — edge strips clipped to the interior range exactly like
+the reference's BC loops (corners untouched, solver.c:157-165).
+
+Requires imax and jmax EVEN (every production grid here is); the arithmetic
+keeps the reference's association (e − 2c + w)·idx2 + (n − 2c + s)·idy2
+term-for-term, but XLA contracts multiply-adds differently for
+differently-structured programs, so equality with the masked jnp path is
+ULP-LEVEL (f32 ~4e-7 on O(1) fields, f64 ~1e-15 — tests/test_sor_quarters.py),
+not bitwise; the residual summation order differs too. The checkerboard
+layout (`tpu_sor_layout checkerboard`) remains the bitwise-oracle mode.
+
+This module: layout transforms + the jnp oracle step. The production Pallas
+kernel lives in ops/sor_pallas.py (`make_rb_iter_tblock_quarters`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pack_quarters(p):
+    """(J, I) even-shaped array -> (R0, R1, B0, B1) quarter views."""
+    assert p.shape[0] % 2 == 0 and p.shape[1] % 2 == 0, p.shape
+    return p[0::2, 0::2], p[1::2, 1::2], p[0::2, 1::2], p[1::2, 0::2]
+
+
+def unpack_quarters(R0, R1, B0, B1):
+    J2, I2 = R0.shape
+    p = jnp.zeros((2 * J2, 2 * I2), R0.dtype)
+    p = p.at[0::2, 0::2].set(R0)
+    p = p.at[1::2, 1::2].set(R1)
+    p = p.at[0::2, 1::2].set(B0)
+    p = p.at[1::2, 0::2].set(B1)
+    return p
+
+
+def neumann_bc_quarters(R0, R1, B0, B1):
+    """Ghost refresh, quarter space (see module docstring derivation).
+    Interior clipping: bottom/top rows copy columns i ∈ [1, imax] — for the
+    even-i quarters (R0, B1) that is c ≥ 1, for odd-i (B0, R1) every c
+    except the last (i = imax+1); left/right copy rows j ∈ [1, jmax] —
+    even-j quarters (R0, B0) r ≥ 1, odd-j (R1, B1) every r but the last."""
+    R0 = R0.at[0, 1:].set(B1[0, 1:])          # p[0,i]=p[1,i], even i
+    B0 = B0.at[0, :-1].set(R1[0, :-1])        # p[0,i]=p[1,i], odd i
+    R1 = R1.at[-1, :-1].set(B0[-1, :-1])      # p[jmax+1,i]=p[jmax,i], odd i
+    B1 = B1.at[-1, 1:].set(R0[-1, 1:])        # p[jmax+1,i]=p[jmax,i], even i
+    R0 = R0.at[1:, 0].set(B0[1:, 0])          # p[j,0]=p[j,1], even j
+    B1 = B1.at[:-1, 0].set(R1[:-1, 0])        # p[j,0]=p[j,1], odd j
+    B0 = B0.at[1:, -1].set(R0[1:, -1])        # p[j,imax+1]=p[j,imax], even j
+    R1 = R1.at[:-1, -1].set(B1[:-1, -1])      # p[j,imax+1]=p[j,imax], odd j
+    return R0, R1, B0, B1
+
+
+def _upd(center, rhs, w, e, s, n, factor, idx2, idy2):
+    """Reference association (solver.c:205-212): r = rhs − lap; c −= factor·r.
+    Returns (updated, r)."""
+    r = rhs - ((e - 2.0 * center + w) * idx2 + (n - 2.0 * center + s) * idy2)
+    return center - factor * r, r
+
+
+def rb_iter_quarters(q, rhsq, factor, idx2, idy2):
+    """One FULL red-black iteration + Neumann refresh in quarter space.
+
+    q, rhsq: (R0, R1, B0, B1) tuples. Interior masks are rectangular slices
+    per quarter (jmax, imax even): R0 interior r≥1,c≥1; R1 r≤-2,c≤-2;
+    B0 r≥1,c≤-2; B1 r≤-2,c≥1. Returns (q', sum r² over both half-sweeps)."""
+    R0, R1, B0, B1 = q
+    F0, F1, G0, G1 = rhsq
+
+    def shift(a, dr, dc):
+        return jnp.roll(a, (-dr, -dc), (0, 1))  # out[r,c] = a[r+dr, c+dc]
+
+    # red pass (reads black only)
+    u0, r0 = _upd(R0, F0, shift(B0, 0, -1), B0, shift(B1, -1, 0), B1,
+                  factor, idx2, idy2)
+    R0n = R0.at[1:, 1:].set(u0[1:, 1:])
+    u1, r1 = _upd(R1, F1, B1, shift(B1, 0, 1), B0, shift(B0, 1, 0),
+                  factor, idx2, idy2)
+    R1n = R1.at[:-1, :-1].set(u1[:-1, :-1])
+    rsq = jnp.sum(r0[1:, 1:] ** 2) + jnp.sum(r1[:-1, :-1] ** 2)
+
+    # black pass (reads the red pass's updates)
+    u2, r2 = _upd(B0, G0, R0n, shift(R0n, 0, 1), shift(R1n, -1, 0), R1n,
+                  factor, idx2, idy2)
+    B0n = B0.at[1:, :-1].set(u2[1:, :-1])
+    u3, r3 = _upd(B1, G1, shift(R1n, 0, -1), R1n, R0n, shift(R0n, 1, 0),
+                  factor, idx2, idy2)
+    B1n = B1.at[:-1, 1:].set(u3[:-1, 1:])
+    rsq = rsq + jnp.sum(r2[1:, :-1] ** 2) + jnp.sum(r3[:-1, 1:] ** 2)
+
+    return neumann_bc_quarters(R0n, R1n, B0n, B1n), rsq

@@ -71,6 +71,15 @@ class Parameter:
     # iterations, so a solve may overshoot by up to tpu_sor_inner-1
     # iterations (jnp paths always step singly). 4 measured fastest on v5e.
     tpu_sor_inner: int = 4
+    # single-device pallas SOR layout:
+    #   "auto"         quarter decomposition when eligible (even imax/jmax —
+    #                  2.25× the checkerboard at 4096² f32 on v5e; per-cell
+    #                  arithmetic ulp-equivalent, ops/sor_quarters.py),
+    #                  else checkerboard
+    #   "checkerboard" the masked kernel (per-cell trajectory numerically
+    #                  IDENTICAL to the jnp reference path)
+    #   "quarters"     force quarters (error when ineligible)
+    tpu_sor_layout: str = "auto"
     # communication-avoiding depth of the DISTRIBUTED red-black solve
     # (parallel/stencil2d.ca_rb_iters): n exact iterations computed locally
     # per depth-2n halo exchange; convergence is checked every n iterations

@@ -4,8 +4,12 @@ with like.
 
 Protocol: dcavity Re=1000, tau=0.5, eps=1e-3, itermax=100, f32. Build the
 jitted step, run 5 settle steps (compile + let dt/p leave the cold-start
-state), then best-of-10 single-step wall times (the axon tunnel jitters up
-to ~50%, so best-of is the stable statistic — see BASELINE.md).
+state), then measure by TWO-POINT differencing of chained-step dispatches:
+per-step = (t(k_b) − t(k_a)) / (k_b − k_a), with k_b sized so the dispatch
+carries ≥ ~1 s of work. Single-dispatch timing is unusable here — the axon
+tunnel's per-dispatch latency floor swings 25 µs–100 ms (see BASELINE.md),
+which differencing cancels exactly. Steps chain through the loop carry, so
+they serialize naturally. Best-of-REPS on each term suppresses jitter.
 
 Run on the real chip:  python tools/perf_ns2d4096.py [solvers...]
 Defaults to: sor fft mg.
@@ -24,7 +28,7 @@ from pampi_tpu.utils.params import Parameter
 
 N = 4096
 SETTLE = 5
-REPS = 10
+REPS = 8
 
 
 def measure(solver: str) -> float:
@@ -36,20 +40,34 @@ def measure(solver: str) -> float:
         tpu_solver=solver,
     )
     s = NS2DSolver(param, dtype=jnp.float32)
-    step = jax.jit(s._build_step())
-    u, v, p = s.u, s.v, s.p
-    t = jnp.asarray(0.0, jnp.float32)
-    nt = jnp.asarray(0, jnp.int32)
-    for _ in range(SETTLE):
-        u, v, p, t, nt = step(u, v, p, t, nt)
-    jax.block_until_ready(p)
-    best = float("inf")
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        u, v, p, t, nt = step(u, v, p, t, nt)
-        jax.block_until_ready(p)
-        best = min(best, time.perf_counter() - t0)
-    return best
+    step = s._build_step()
+
+    def k_steps(k):
+        @jax.jit
+        def run(state):
+            return jax.lax.fori_loop(0, k, lambda _, c: step(*c), state)
+
+        return run
+
+    state = (s.u, s.v, s.p, jnp.asarray(0.0, jnp.float32),
+             jnp.asarray(0, jnp.int32))
+    state = k_steps(SETTLE)(state)
+    float(state[3])  # scalar fence
+
+    def timed(k):
+        run = k_steps(k)
+        float(run(state)[3])  # compile + warm
+        best = float("inf")
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            float(run(state)[3])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    ta = timed(1)
+    kb = 1 + max(2, min(64, int(1.0 / max(ta, 1e-3))))
+    tb = timed(kb)
+    return max((tb - ta) / (kb - 1), 1e-9)
 
 
 if __name__ == "__main__":
