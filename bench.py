@@ -4,7 +4,7 @@ SOR (the BASELINE.json metric).
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "updates/s", "vs_baseline": N}
 
-Method: 4096² grid, float32 (TPU-native), 4800 timed red-black iterations in
+Method: 4096² grid, float32 (TPU-native), 9600 timed red-black iterations in
 ONE dispatch (fixed count via fori_loop — steady-state throughput, no
 convergence check; the dispatch must carry seconds of device work because the
 tunnel's per-dispatch latency floor swings 25 µs–100 ms), best-of-12
@@ -46,8 +46,9 @@ N = 4096
 # swings 25 us .. 100 ms, so the timed fori_loop must carry seconds of
 # device work or the floor inflates the measurement (round 1's ITERS=100
 # was ~44 ms of work and under-recorded the kernel 2.2x: 18.09G vs the
-# ~40G the same kernel measures latency-amortized).
-ITERS = 4800
+# ~40G the same kernel measures latency-amortized). 9600 iterations of the
+# quarters kernel ≈ 1.2 s per dispatch — worst-case floor haircut < 9%.
+ITERS = 9600
 N_INNER = 8  # temporal-blocking depth. The auto layout dispatches the
 # QUARTER-decomposition kernel (ops/sor_quarters.py — all lanes productive,
 # uniform shifts) at its shipped default of 64 quarter-rows (= 128 grid
@@ -85,8 +86,8 @@ def _timed_run(backend: str):
     out = run_iters(p, rhs)
     float(out[1])  # warm-up + compile; scalar readback forces completion
     best = float("inf")
-    # best-of-12 dispatches of ~2 s each: the axon tunnel + chip sharing add
-    # up to ~50% run-to-run jitter (measured); min over many dispatches
+    # best-of-12 dispatches of ~1.2 s each: the axon tunnel + chip sharing
+    # add up to ~50% run-to-run jitter (measured); min over many dispatches
     # approximates the chip's unthrottled rate
     for _ in range(reps):
         t0 = time.perf_counter()
