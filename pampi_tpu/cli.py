@@ -133,10 +133,11 @@ def _dispatch(param, prof) -> int:
         )
         return 1
 
-    if param.tpu_sor_layout not in ("auto", "checkerboard", "quarters"):
+    if param.tpu_sor_layout not in ("auto", "checkerboard", "quarters",
+                                    "octants"):
         print(
-            "Error: tpu_sor_layout must be auto|checkerboard|quarters, "
-            f"got {param.tpu_sor_layout!r}",
+            "Error: tpu_sor_layout must be auto|checkerboard|quarters"
+            f"|octants, got {param.tpu_sor_layout!r}",
             file=sys.stderr,
         )
         return 1

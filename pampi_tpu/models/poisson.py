@@ -94,6 +94,11 @@ def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
     per-cell trajectory is numerically identical to the jnp path — quarters
     is ulp-equivalent, compiler fma/fusion differences only);
     "quarters" forces the quarter kernel (error if ineligible)."""
+    if layout not in ("auto", "checkerboard", "quarters"):
+        raise ValueError(
+            f"2-D SOR layout must be auto|checkerboard|quarters, got "
+            f"{layout!r} (octants is the 3-D layout)"
+        )
     if _use_pallas(backend, dtype):
         want_q = layout in ("auto", "quarters")
         even = imax % 2 == 0 and jmax % 2 == 0

@@ -394,22 +394,333 @@ def make_rb_iter_tblock_3d(
     return rb_iter, block_k
 
 
+def _tblock3d_octants_kernel(
+    p_in,   # ANY (8, sp, jp2, ip2) stacked octants, sor_octants.BITS order
+    rhs,    # ANY (8, sp, jp2, ip2)
+    p_out,  # ANY (8, sp, jp2, ip2)
+    res,    # SMEM (1, 1)
+    pw2,    # VMEM (16, bk+2h, jp2, ip2): slot*8 + octant (Mosaic wants ≤4-D)
+    rw2,    # VMEM (16, bk+2h, jp2, ip2)
+    ob2,    # VMEM (16, bk, jp2, ip2)
+    vacc,   # VMEM (1, ip2)
+    ld_sem,  # DMA (2, 16)
+    st_sem,  # DMA (2, 8)
+    *,
+    n_inner: int,
+    block_k: int,  # octant planes per block
+    nblocks: int,
+    k2: int,  # (kmax+2)//2 etc. — logical octant extents
+    j2: int,
+    i2: int,
+    halo: int,
+    factor: float,
+    idx2: float,
+    idy2: float,
+    idz2: float,
+):
+    """Temporal-blocked 3-D red-black sweep in the OCTANT layout
+    (ops/sor_octants.py): every 7-point neighbour a uniform shift, every
+    lane productive, the 6-face Neumann refresh 24 same-index plane
+    selects. One iteration consumes ONE octant plane of halo per side
+    (= 2 grid planes, matching the checkerboard kernel)."""
+    from .sor_octants import BITS, EVEN, ODD, _flip
+
+    b = pl.program_id(0)
+    bk = block_k
+    h = halo
+    slot = b % 2
+    nslot = (b + 1) % 2
+    qidx = {bits: i for i, bits in enumerate(BITS)}
+
+    def load(k, s):
+        copies = []
+        for qi in range(8):
+            copies.append(pltpu.make_async_copy(
+                p_in.at[qi, pl.ds(k * bk, bk + 2 * h)], pw2.at[s * 8 + qi],
+                ld_sem.at[s, qi]))
+            copies.append(pltpu.make_async_copy(
+                rhs.at[qi, pl.ds(k * bk, bk + 2 * h)], rw2.at[s * 8 + qi],
+                ld_sem.at[s, 8 + qi]))
+        return copies
+
+    def store(k, s):
+        return [pltpu.make_async_copy(
+            ob2.at[s * 8 + qi], p_out.at[qi, pl.ds(h + k * bk, bk)],
+            st_sem.at[s, qi]) for qi in range(8)]
+
+    @pl.when(b == 0)
+    def _():
+        res[0, 0] = jnp.zeros((), p_out.dtype)
+        vacc[...] = jnp.zeros_like(vacc)
+        for c in load(0, 0):
+            c.start()
+
+    @pl.when(b + 1 < nblocks)
+    def _():
+        for c in load(b + 1, nslot):
+            c.start()
+
+    for c in load(b, slot):
+        c.wait()
+
+    octs = {bits: pw2[slot * 8 + qidx[bits]] for bits in BITS}
+    rhs_o = {bits: rw2[slot * 8 + qidx[bits]] for bits in BITS}
+
+    shape = octs[(0, 0, 0)].shape
+    ss = b * bk - h + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    rr = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    cc = jax.lax.broadcasted_iota(jnp.int32, shape, 2)
+    coords = (ss, rr, cc)
+    extents = (k2, j2, i2)
+
+    def ax_interior(axis, par):
+        x, n = coords[axis], extents[axis]
+        if par == 0:
+            return (x >= 1) & (x <= n - 1)
+        return (x >= 0) & (x <= n - 2)
+
+    def interior(bits):
+        return (ax_interior(0, bits[0]) & ax_interior(1, bits[1])
+                & ax_interior(2, bits[2]))
+
+    masks = {bits: interior(bits) for bits in BITS}
+
+    def nbrs(bits):
+        def ax_pair(axis):
+            partner = octs[_flip(bits, axis)]
+            if bits[axis] == 0:
+                return jnp.roll(partner, 1, axis), partner
+            return partner, jnp.roll(partner, -1, axis)
+
+        f, bk_ = ax_pair(0)
+        s_, n = ax_pair(1)
+        w, e = ax_pair(2)
+        return w, e, s_, n, f, bk_
+
+    resids = {}
+    for _t in range(n_inner):
+        for group in (ODD, EVEN):
+            for bits in group:
+                c = octs[bits]
+                w, e, s_, n, f, bk_ = nbrs(bits)
+                r = rhs_o[bits] - (
+                    (e - 2.0 * c + w) * idx2
+                    + (n - 2.0 * c + s_) * idy2
+                    + (bk_ - 2.0 * c + f) * idz2
+                )
+                rm = jnp.where(masks[bits], r, 0.0)
+                octs[bits] = c - factor * rm
+                resids[bits] = rm
+        # Neumann refresh: 24 same-index plane selects
+        for axis in range(3):
+            for hi in (False, True):
+                x, nax = coords[axis], extents[axis]
+                plane = (x == nax - 1) if hi else (x == 0)
+                for bits in BITS:
+                    if bits[axis] != (1 if hi else 0):
+                        continue
+                    a2, a3 = [a for a in range(3) if a != axis]
+                    sel = (plane & ax_interior(a2, bits[a2])
+                           & ax_interior(a3, bits[a3]))
+                    octs[bits] = jnp.where(
+                        sel, octs[_flip(bits, axis)], octs[bits]
+                    )
+
+    @pl.when(b >= 2)
+    def _():
+        for c in store(b - 2, slot):
+            c.wait()
+
+    for bits in BITS:
+        ob2[slot * 8 + qidx[bits]] = octs[bits][h: h + bk]
+    for c in store(b, slot):
+        c.start()
+
+    acc = jnp.zeros_like(vacc[...])
+    for bits in BITS:
+        band = resids[bits][h: h + bk]
+        acc = acc + jnp.sum(band * band, axis=(0, 1))[None, :]
+    vacc[...] += acc
+
+    @pl.when(b == nblocks - 1)
+    def _():
+        res[0, 0] += jnp.sum(vacc[...])
+        for c in store(b, slot):
+            c.wait()
+        if nblocks > 1:
+            for c in store(b - 1, nslot):
+                c.wait()
+
+
+def octants_padded_ji(jmax: int, imax: int, dtype) -> tuple[int, int]:
+    """Octant in-plane padded shape: (jmax+2)/2 to the sublane tile,
+    (imax+2)/2 to the lane tile."""
+    a = _align(dtype)
+    jp2 = -(-((jmax + 2) // 2) // a) * a
+    ip2 = -(-((imax + 2) // 2) // LANE) * LANE
+    return jp2, ip2
+
+
+def pad_octants(p, block_k: int, n_inner: int):
+    """(kmax+2, jmax+2, imax+2) even-shaped -> (8, sp, jp2, ip2) stacked
+    padded octants in sor_octants.BITS order."""
+    from .sor_octants import BITS, pack_octants
+
+    octs = pack_octants(p)
+    k2, j2, i2 = octs[(0, 0, 0)].shape
+    jp2, ip2 = octants_padded_ji(p.shape[1] - 2, p.shape[2] - 2, p.dtype)
+    nblocks = -(-k2 // block_k)
+    sp = nblocks * block_k + 2 * n_inner
+    out = jnp.zeros((8, sp, jp2, ip2), p.dtype)
+    for qi, bits in enumerate(BITS):
+        out = out.at[qi, n_inner: n_inner + k2, :j2, :i2].set(octs[bits])
+    return out
+
+
+def unpad_octants(xo, kmax: int, jmax: int, imax: int, n_inner: int):
+    from .sor_octants import BITS, unpack_octants
+
+    k2, j2, i2 = (kmax + 2) // 2, (jmax + 2) // 2, (imax + 2) // 2
+    octs = {bits: xo[qi, n_inner: n_inner + k2, :j2, :i2]
+            for qi, bits in enumerate(BITS)}
+    return unpack_octants(octs)
+
+
+def pick_block_k_octants(kmax: int, jmax: int, imax: int, dtype,
+                         n_inner: int) -> int:
+    """Octant planes per block. Resident octant planes: p windows
+    16·(bk+2h) + rhs windows 16·(bk+2h) + store buffers 16·bk
+    = 48·bk + 64·h, budgeted against ~half the VMEM limit (Mosaic
+    temporaries — the 8 octant values and their rolls — take the rest).
+    Getting this wrong crashes the remote Mosaic compiler outright
+    (HTTP 500, no diagnostic), it does not error gracefully."""
+    jp2, ip2 = octants_padded_ji(jmax, imax, dtype)
+    plane = jp2 * ip2 * jnp.dtype(dtype).itemsize
+    h = n_inner
+    feasible = ((VMEM_LIMIT_BYTES // 2) // max(plane, 1) - 64 * h) // 48
+    return max(1, min(feasible, (kmax + 2) // 2, 64))
+
+
+def make_rb_iter_tblock_3d_octants(
+    imax: int,
+    jmax: int,
+    kmax: int,
+    dx: float,
+    dy: float,
+    dz: float,
+    omega: float,
+    dtype,
+    *,
+    n_inner: int = 1,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Temporal-blocked OCTANT-layout 3-D kernel: builds
+    `(p_stacked, rhs_stacked) -> (p_stacked', res_sumsq_of_last_iter)` on
+    the (8, sp, jp2, ip2) layout of `pad_octants`. Requires even
+    imax/jmax/kmax. Returns (rb_iter, block_k, halo=n_inner). Numerics:
+    ulp-equivalent to the masked paths (ops/sor_octants.py)."""
+    if pltpu is None:
+        return None, 0, 0
+    if imax % 2 or jmax % 2 or kmax % 2:
+        raise ValueError("octant layout needs even imax, jmax, kmax")
+    if block_k is None:
+        block_k = pick_block_k_octants(kmax, jmax, imax, dtype, n_inner)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _check_dtype(dtype, interpret)
+
+    from ..models.ns3d import sor_coefficients_3d
+
+    factor, idx2, idy2, idz2 = sor_coefficients_3d(dx, dy, dz, omega)
+    h = n_inner
+    k2, j2, i2 = (kmax + 2) // 2, (jmax + 2) // 2, (imax + 2) // 2
+    jp2, ip2 = octants_padded_ji(jmax, imax, dtype)
+    nblocks = -(-k2 // block_k)
+    sp = nblocks * block_k + 2 * h
+    kernel = functools.partial(
+        _tblock3d_octants_kernel,
+        n_inner=n_inner,
+        block_k=block_k,
+        nblocks=nblocks,
+        k2=k2,
+        j2=j2,
+        i2=i2,
+        halo=h,
+        factor=factor,
+        idx2=idx2,
+        idy2=idy2,
+        idz2=idz2,
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, 1), lambda b: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((8, sp, jp2, ip2), dtype),
+            jax.ShapeDtypeStruct((1, 1), dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((16, block_k + 2 * h, jp2, ip2), dtype),
+            pltpu.VMEM((16, block_k + 2 * h, jp2, ip2), dtype),
+            pltpu.VMEM((16, block_k, jp2, ip2), dtype),
+            pltpu.VMEM((1, ip2), dtype),
+            pltpu.SemaphoreType.DMA((2, 16)),
+            pltpu.SemaphoreType.DMA((2, 8)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT_BYTES
+        ),
+        interpret=interpret,
+    )
+
+    def rb_iter(p_stacked, rhs_stacked):
+        p_stacked, res = call(p_stacked, rhs_stacked)
+        return p_stacked, res[0, 0]
+
+    return rb_iter, block_k, h
+
+
+def make_octants_solve_loop(rb_iter, block_k: int, eff: int, norm: float,
+                            eps: float, itermax: int,
+                            kmax: int, jmax: int, imax: int, dtype):
+    """make_tblock_solve_loop on the stacked OCTANT layout: same convergence
+    contract, only the pad/unpad pair differs."""
+    return make_tblock_solve_loop(
+        rb_iter, block_k, eff, norm, eps, itermax, kmax, jmax, imax, dtype,
+        pad=lambda x: pad_octants(x, block_k, eff),
+        unpad=lambda xo: unpad_octants(xo, kmax, jmax, imax, eff),
+    )
+
+
 def make_tblock_solve_loop(rb_iter, block_k: int, eff: int, norm: float,
                            eps: float, itermax: int,
-                           kmax: int, jmax: int, imax: int, dtype):
-    """The tblock convergence loop both pressure solvers share (uniform:
-    models/ns3d.make_pressure_solve_3d; masked:
-    ops/obstacle3d.make_obstacle_solver_fn_3d): carry the PADDED array, one
-    rb_iter call = eff fused iterations, convergence checked every eff
-    iterations (honest `it` accounting), optional PAMPI_DEBUG residual line
-    per check."""
+                           kmax: int, jmax: int, imax: int, dtype,
+                           pad=None, unpad=None):
+    """The tblock convergence loop every 3-D pressure solver shares
+    (uniform: models/ns3d.make_pressure_solve_3d; masked:
+    ops/obstacle3d.make_obstacle_solver_fn_3d; octants:
+    make_octants_solve_loop via the pad/unpad overrides): carry the PADDED
+    array, one rb_iter call = eff fused iterations, convergence checked
+    every eff iterations (honest `it` accounting), optional PAMPI_DEBUG
+    residual line per check."""
     from ..utils import flags as _flags
 
     epssq = eps * eps
+    if pad is None:
+        def pad(x):
+            return pad_array_3d(x, block_k, eff)
+    if unpad is None:
+        def unpad(xp):
+            return unpad_array_3d(xp, kmax, jmax, imax, eff)
 
     def solve(p, rhs):
-        pp = pad_array_3d(p, block_k, eff)
-        rp = pad_array_3d(rhs, block_k, eff)
+        pp = pad(p)
+        rp = pad(rhs)
 
         def cond(c):
             _, res, it = c
@@ -427,7 +738,7 @@ def make_tblock_solve_loop(rb_iter, block_k: int, eff: int, norm: float,
             cond, body,
             (pp, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
         )
-        return unpad_array_3d(pp, kmax, jmax, imax, eff), res, it
+        return unpad(pp), res, it
 
     return solve
 

@@ -1,0 +1,144 @@
+"""3-D red-black SOR in the OCTANT decomposition — the 3-D form of the
+quarter layout (ops/sor_quarters.py).
+
+Split the (K, J, I) grid by the parity of ALL THREE indices into eight dense
+(K/2, J/2, I/2) arrays, keyed by bits (pk, pj, pi):
+
+    O[pk,pj,pi][s, r, c] = p[2s + pk, 2r + pj, 2c + pi]
+
+The 3-D checkerboard colour is (k + j + i) % 2 = (pk + pj + pi) % 2, so each
+colour is exactly four octants, and every 7-point neighbour lives in the
+octant with ONE parity bit flipped, at a row-parity-INDEPENDENT uniform
+index: along an axis with parity bit b,
+
+    b = 0:  coord−1 → partner[idx − 1],  coord+1 → partner[idx]
+    b = 1:  coord−1 → partner[idx],      coord+1 → partner[idx + 1]
+
+(the same identity as the 2-D quarters, once per axis). A half-sweep is four
+dense, unmasked (up to rectangular edge clipping), all-lanes-productive
+updates; per sub-update only the three "shifted" neighbours move data, so a
+full iteration does 12 one-eighth-size shifts (= 1.5 full-array
+equivalents) against the masked checkerboard kernel's 12 full-size laps
+rolls + 6 BC rolls, and none of the lanes compute thrown-away colour.
+
+The 6-face Neumann refresh becomes 24 SAME-INDEX plane copies between
+partner octants (no shifts): the ghost plane k=0 (even) lives in the four
+pk=0 octants at s=0 and copies from the pk=1 partners at s=0 (grid k=1);
+the hi face k=kmax+1 (odd, kmax even) lives in the pk=1 octants at s=−1 and
+copies from pk=0 at s=−1 (grid k=kmax); same per axis. Tangential clipping
+to the interior (reference solver.c BC loops): parity-0 axes drop index 0,
+parity-1 axes drop the last index — faces never write edges/corners, so
+the 24 copies are disjoint and order-free.
+
+Pass order matches the reference's 3-D sweep (assignment-6/src/solver.c:
+203-231 and models/ns3d.make_pressure_solve_3d): ODD parity first, then
+even. Requires even imax/jmax/kmax. Arithmetic keeps the reference
+association ((e−2c+w)·idx2 + (n−2c+s)·idy2 + (b−2c+f)·idz2) term-for-term;
+equality with the masked jnp path is ulp-level (compiler fma/fusion
+association — see ops/sor_quarters.py), with the checkerboard layout
+remaining the bitwise-oracle mode.
+
+This module: layout transforms + the jnp oracle. The Pallas kernel lives in
+ops/sor3d_pallas.py (`make_rb_iter_tblock_3d_octants`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+BITS = [(a, b, c) for a in (0, 1) for b in (0, 1) for c in (0, 1)]
+ODD = [b for b in BITS if sum(b) % 2 == 1]   # first half-sweep (reference)
+EVEN = [b for b in BITS if sum(b) % 2 == 0]  # second half-sweep
+
+
+def _flip(bits, axis):
+    out = list(bits)
+    out[axis] = 1 - out[axis]
+    return tuple(out)
+
+
+def pack_octants(p):
+    """(K, J, I) even-shaped array -> dict bits -> (K/2, J/2, I/2)."""
+    assert all(d % 2 == 0 for d in p.shape), p.shape
+    return {b: p[b[0]::2, b[1]::2, b[2]::2] for b in BITS}
+
+
+def unpack_octants(octs):
+    K2, J2, I2 = octs[(0, 0, 0)].shape
+    p = jnp.zeros((2 * K2, 2 * J2, 2 * I2), octs[(0, 0, 0)].dtype)
+    for b, q in octs.items():
+        p = p.at[b[0]::2, b[1]::2, b[2]::2].set(q)
+    return p
+
+
+def interior_slices(bits):
+    """Rectangular interior of an octant: parity-0 axes drop index 0 (the
+    ghost plane k/j/i = 0), parity-1 axes drop the last (ghost = max+1)."""
+    return tuple(slice(1, None) if b == 0 else slice(0, -1) for b in bits)
+
+
+def _shift(a, axis, d):
+    """out[idx] = a[idx + d] (zero wrap-around contributions are masked or
+    clipped away by the callers)."""
+    return jnp.roll(a, -d, axis)
+
+
+def neighbours(octs, bits):
+    """(w, e, s, n, f, bk) neighbour arrays for octant `bits` — uniform
+    shifts per the module-docstring identity."""
+
+    def ax_pair(axis):
+        partner = octs[_flip(bits, axis)]
+        if bits[axis] == 0:
+            return _shift(partner, axis, -1), partner   # coord−1, coord+1
+        return partner, _shift(partner, axis, 1)
+
+    f, bk = ax_pair(0)
+    s, n = ax_pair(1)
+    w, e = ax_pair(2)
+    return w, e, s, n, f, bk
+
+
+def neumann_bc_octants(octs):
+    """The 24 same-index ghost-plane copies (6 faces × 4 octants each)."""
+    out = dict(octs)
+    for axis in range(3):
+        for hi in (False, True):
+            plane = -1 if hi else 0
+            for bits in BITS:
+                if bits[axis] != (1 if hi else 0):
+                    continue
+                src = out[_flip(bits, axis)]
+                sl = list(interior_slices(bits))
+                sl[axis] = plane
+                sl = tuple(sl)
+                out[bits] = out[bits].at[sl].set(src[sl])
+    return out
+
+
+def rb_iter_octants(octs, rhs_octs, factor, idx2, idy2, idz2):
+    """One FULL 3-D red-black iteration (odd pass, even pass, Neumann
+    refresh) in octant space. Returns (octs', sum r² over both passes)."""
+
+    def half_pass(octs, group):
+        out = dict(octs)
+        rsq = jnp.zeros((), octs[(0, 0, 0)].dtype)
+        for bits in group:
+            c = octs[bits]
+            w, e, s, n, f, bk = neighbours(out, bits)
+            r = rhs_octs[bits] - (
+                (e - 2.0 * c + w) * idx2
+                + (n - 2.0 * c + s) * idy2
+                + (bk - 2.0 * c + f) * idz2
+            )
+            sl = interior_slices(bits)
+            out[bits] = c.at[sl].set((c - factor * r)[sl])
+            rsq = rsq + jnp.sum(r[sl] ** 2)
+        return out, rsq
+
+    # neighbours() must see the CURRENT state: within a half-sweep the
+    # updated octants are the OTHER colour's inputs only, so passing `out`
+    # (above) is safe — same-colour octants never read each other.
+    octs, r_odd = half_pass(octs, ODD)
+    octs, r_evn = half_pass(octs, EVEN)
+    return neumann_bc_octants(octs), r_odd + r_evn
