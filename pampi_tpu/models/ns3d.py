@@ -152,7 +152,9 @@ def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
         from ..ops import sor3d_pallas as sp3
 
         bko = sp3.pick_block_k_octants(kmax, jmax, imax, dtype, n_inner)
-        degenerate = bko < n_inner and bko < (kmax + 2) // 2
+        degenerate = sp3.block_k_octants_degenerate(
+            bko, kmax, jmax, imax, dtype, n_inner
+        )
         if not degenerate:
             rb_iter, bko, _h = sp3.make_rb_iter_tblock_3d_octants(
                 imax, jmax, kmax, dx, dy, dz, omega, dtype,
@@ -251,6 +253,14 @@ class NS3DSolver:
                 raise ValueError(
                     f"tpu_solver {param.tpu_solver} does not support "
                     "obstacle flag fields; use tpu_solver sor"
+                )
+            if param.tpu_sor_layout not in ("auto", "checkerboard"):
+                # the eps-coefficient masked kernel is checkerboard-only;
+                # silently ignoring a forced layout would be worse
+                raise ValueError(
+                    f"tpu_sor_layout {param.tpu_sor_layout} does not "
+                    "support obstacle flag fields; obstacle runs use the "
+                    "masked checkerboard kernel (auto|checkerboard)"
                 )
             from ..ops import obstacle3d as obst3
 

@@ -683,27 +683,31 @@ def quarters_halo(n_inner: int, dtype) -> int:
 
 def pad_quarters(p, block_rows_q: int, halo: int):
     """(jmax+2, imax+2) even-shaped array -> (4, rp, W2p) stacked padded
-    quarter layout [R0, R1, B0, B1]."""
-    from .sor_quarters import pack_quarters
+    quarter layout [R0, R1, B0, B1].
 
-    quarters = pack_quarters(p)
-    j2, i2 = quarters[0].shape
+    Packing is ONE reshape+transpose into (pj, pi)-lexicographic order
+    [R0, B0, B1, R1] plus a leading-dim permutation — stride-2 gathers are
+    lane shuffles and measured ~100 ms per solve call at large sizes (see
+    sor3d_pallas.pad_octants); the fused transpose is one cheap kernel."""
+    J, I = p.shape
+    j2, i2 = J // 2, I // 2
+    lex = p.reshape(j2, 2, i2, 2).transpose(1, 3, 0, 2).reshape(4, j2, i2)
+    stacked = lex[jnp.array([0, 3, 1, 2])]  # -> [R0, R1, B0, B1]
     nblocks = -(-j2 // block_rows_q)
     rp = nblocks * block_rows_q + 2 * halo
     w2p = -(-i2 // LANE) * LANE
     out = jnp.zeros((4, rp, w2p), p.dtype)
-    for qi, q in enumerate(quarters):
-        out = out.at[qi, halo: halo + j2, :i2].set(q)
-    return out
+    return out.at[:, halo: halo + j2, :i2].set(stacked)
 
 
 def unpad_quarters(xq, jmax: int, imax: int, halo: int):
     """Inverse of pad_quarters -> (jmax+2, imax+2)."""
-    from .sor_quarters import unpack_quarters
-
     j2, i2 = (jmax + 2) // 2, (imax + 2) // 2
-    qs = [xq[qi, halo: halo + j2, :i2] for qi in range(4)]
-    return unpack_quarters(*qs)
+    q = xq[:, halo: halo + j2, :i2]
+    lex = q[jnp.array([0, 2, 3, 1])]  # back to [R0, B0, B1, R1]
+    return (
+        lex.reshape(2, 2, j2, i2).transpose(2, 0, 3, 1).reshape(2 * j2, 2 * i2)
+    )
 
 
 def make_rb_iter_tblock_quarters(
