@@ -29,9 +29,21 @@ from jax import lax
 from ..ops import ns2d as ops
 from ..utils import flags as _flags
 from ..utils.datio import write_pressure, write_velocity
-from ..utils.params import Parameter
+from ..utils.params import Parameter, validate_obstacle_layout
 from ..utils.precision import resolve_dtype
 from ..utils.progress import Progress
+
+
+def resolve_sor_layout(layout: str) -> str:
+    """The NS-2D auto-layout resolution — single home, shared with the
+    region-counter harness (tools/bench_regions.py). Measured (v5e, 4096²
+    dcavity, itermax=100, chained-step differencing): the quarters layout
+    wins 3× in loop-carried use (bench.py, Poisson) but LOSES inside the NS
+    per-step solve cycle — 68 vs 39 ms/step vs checkerboard — so NS-2D
+    "auto" keeps checkerboard; an explicit `tpu_sor_layout quarters` still
+    forces it. (NS-3D is the opposite: octants win 4× at the step level,
+    models/ns3d.py.)"""
+    return "checkerboard" if layout == "auto" else layout
 
 
 def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
@@ -63,16 +75,9 @@ def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
         )
     from .poisson import make_solver_fn
 
-    # measured (v5e, 4096² dcavity, itermax=100, chained-step differencing):
-    # the quarters layout wins 3× in loop-carried use (bench.py, Poisson)
-    # but LOSES inside the NS per-step solve cycle — 68 vs 39 ms/step vs
-    # checkerboard — so NS-2D "auto" keeps checkerboard; an explicit
-    # `tpu_sor_layout quarters` still forces it. (NS-3D is the opposite:
-    # octants win 4× at the step level, models/ns3d.py.)
-    if layout == "auto":
-        layout = "checkerboard"
     return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
-                          backend=backend, n_inner=n_inner, layout=layout)
+                          backend=backend, n_inner=n_inner,
+                          layout=resolve_sor_layout(layout))
 
 
 class NS2DSolver:
@@ -105,14 +110,7 @@ class NS2DSolver:
                     f"tpu_solver {param.tpu_solver} does not support "
                     "obstacle flag fields; use tpu_solver sor"
                 )
-            if param.tpu_sor_layout not in ("auto", "checkerboard"):
-                # the eps-coefficient masked kernel is checkerboard-only;
-                # silently ignoring a forced layout would be worse
-                raise ValueError(
-                    f"tpu_sor_layout {param.tpu_sor_layout} does not "
-                    "support obstacle flag fields; obstacle runs use the "
-                    "masked checkerboard kernel (auto|checkerboard)"
-                )
+            validate_obstacle_layout(param.tpu_sor_layout)
             from ..ops import obstacle as obst
 
             fluid = obst.build_fluid(

@@ -28,7 +28,7 @@ from jax import lax
 from ..ops import ns3d as ops
 from ..utils import flags as _flags
 from ..utils.grid import Grid
-from ..utils.params import Parameter
+from ..utils.params import Parameter, validate_obstacle_layout
 from ..utils.precision import resolve_dtype
 from ..utils.progress import Progress
 from ..utils.vtkio import VtkWriter
@@ -254,14 +254,7 @@ class NS3DSolver:
                     f"tpu_solver {param.tpu_solver} does not support "
                     "obstacle flag fields; use tpu_solver sor"
                 )
-            if param.tpu_sor_layout not in ("auto", "checkerboard"):
-                # the eps-coefficient masked kernel is checkerboard-only;
-                # silently ignoring a forced layout would be worse
-                raise ValueError(
-                    f"tpu_sor_layout {param.tpu_sor_layout} does not "
-                    "support obstacle flag fields; obstacle runs use the "
-                    "masked checkerboard kernel (auto|checkerboard)"
-                )
+            validate_obstacle_layout(param.tpu_sor_layout)
             from ..ops import obstacle3d as obst3
 
             fluid = obst3.build_fluid_3d(

@@ -565,18 +565,26 @@ def pad_octants(p, block_k: int, n_inner: int):
     """(kmax+2, jmax+2, imax+2) even-shaped -> (8, sp, jp2, ip2) stacked
     padded octants in sor_octants.BITS order.
 
-    Packing is ONE reshape+transpose (sor_octants.BITS is lexicographic in
-    (pk, pj, pi), so octant q = 4·pk + 2·pj + pi falls out of the reshape
-    directly) — 8 stride-2 gathers measured ~100 ms per NS-3D step at 128³
-    on v5e (lane-dim stride-2 slicing is a shuffle); the fused transpose is
-    a single cheap kernel."""
+    Packing is STAGED single-axis stride-2 slices — one combined
+    all-axes stride-2 gather per octant measured ~100 ms per NS-3D solve
+    at 128³ on v5e, and the reshape-transpose alternative plans
+    intermediates with a size-2 minor dim whose 128-lane tile padding OOMs
+    the Mosaic/XLA compiler at large grids (f32[4097,2,4097,2] → 17 GB;
+    see sor_pallas.pad_quarters). Axis-at-a-time slices (major-dim k split
+    = strided DMA, then sublane j split, then lane i split on
+    eighth-sized slabs) keep every intermediate in a sane layout."""
     K, J, I = p.shape
     k2, j2, i2 = K // 2, J // 2, I // 2
-    stacked = (
-        p.reshape(k2, 2, j2, 2, i2, 2)
-        .transpose(1, 3, 5, 0, 2, 4)
-        .reshape(8, k2, j2, i2)
-    )
+    slabs = {}
+    for pk in (0, 1):
+        sk = p[pk::2]
+        for pj in (0, 1):
+            skj = sk[:, pj::2]
+            for pi in (0, 1):
+                slabs[(pk, pj, pi)] = skj[:, :, pi::2]
+    from .sor_octants import BITS
+
+    stacked = jnp.stack([slabs[bits] for bits in BITS])
     jp2, ip2 = octants_padded_ji(J - 2, I - 2, p.dtype)
     nblocks = -(-k2 // block_k)
     sp = nblocks * block_k + 2 * n_inner
@@ -585,14 +593,33 @@ def pad_octants(p, block_k: int, n_inner: int):
 
 
 def unpad_octants(xo, kmax: int, jmax: int, imax: int, n_inner: int):
-    """Inverse of pad_octants (same single-transpose formulation)."""
+    """Inverse of pad_octants, staged axis-at-a-time scatter form (lane
+    interleave per (pk, pj) slab, then sublane, then outer — same
+    layout-safety/perf constraint as pad_octants; a combined all-axes
+    stride-2 scatter per octant mirrors the gather the pack refactor
+    removed)."""
+    from .sor_octants import BITS
+
     k2, j2, i2 = (kmax + 2) // 2, (jmax + 2) // 2, (imax + 2) // 2
     stacked = xo[:, n_inner: n_inner + k2, :j2, :i2]
-    return (
-        stacked.reshape(2, 2, 2, k2, j2, i2)
-        .transpose(3, 0, 4, 1, 5, 2)
-        .reshape(2 * k2, 2 * j2, 2 * i2)
-    )
+    q = {bits: stacked[qi] for qi, bits in enumerate(BITS)}
+    kj = {}
+    for pk in (0, 1):
+        for pj in (0, 1):
+            m = jnp.zeros((k2, j2, 2 * i2), xo.dtype)
+            m = m.at[:, :, 0::2].set(q[(pk, pj, 0)])
+            m = m.at[:, :, 1::2].set(q[(pk, pj, 1)])
+            kj[(pk, pj)] = m
+    slabs = {}
+    for pk in (0, 1):
+        m = jnp.zeros((k2, 2 * j2, 2 * i2), xo.dtype)
+        m = m.at[:, 0::2].set(kj[(pk, 0)])
+        m = m.at[:, 1::2].set(kj[(pk, 1)])
+        slabs[pk] = m
+    p = jnp.zeros((2 * k2, 2 * j2, 2 * i2), xo.dtype)
+    p = p.at[0::2].set(slabs[0])
+    p = p.at[1::2].set(slabs[1])
+    return p
 
 
 def pick_block_k_octants(kmax: int, jmax: int, imax: int, dtype,
@@ -603,11 +630,18 @@ def pick_block_k_octants(kmax: int, jmax: int, imax: int, dtype,
     temporaries — the 8 octant values and their rolls — take the rest).
     Getting this wrong crashes the remote Mosaic compiler outright
     (HTTP 500, no diagnostic), it does not error gracefully."""
+    return max(1, min(_octants_feasible(jmax, imax, dtype, n_inner),
+                      (kmax + 2) // 2, 64))
+
+
+def _octants_feasible(jmax: int, imax: int, dtype, n_inner: int) -> int:
+    """Largest VMEM-feasible octant block depth — the single home of the
+    resident-plane accounting (pick_block_k_octants clamps it, the
+    degenerate guard checks it; diverging copies would let an infeasible
+    build through, which crashes the remote Mosaic compiler)."""
     jp2, ip2 = octants_padded_ji(jmax, imax, dtype)
     plane = jp2 * ip2 * jnp.dtype(dtype).itemsize
-    h = n_inner
-    feasible = ((VMEM_LIMIT_BYTES // 2) // max(plane, 1) - 64 * h) // 48
-    return max(1, min(feasible, (kmax + 2) // 2, 64))
+    return ((VMEM_LIMIT_BYTES // 2) // max(plane, 1) - 64 * n_inner) // 48
 
 
 def block_k_octants_degenerate(block_k: int, kmax: int, jmax: int, imax: int,
@@ -617,10 +651,7 @@ def block_k_octants_degenerate(block_k: int, kmax: int, jmax: int, imax: int,
     (feasible < 1 — pick clamps to 1, which n_inner=1 dispatch tests can't
     catch) or the block is thinner than the halo while the grid isn't.
     Mirrors block_k_degenerate for the checkerboard kernel."""
-    jp2, ip2 = octants_padded_ji(jmax, imax, dtype)
-    plane = jp2 * ip2 * jnp.dtype(dtype).itemsize
-    feasible = ((VMEM_LIMIT_BYTES // 2) // max(plane, 1) - 64 * n_inner) // 48
-    if feasible < 1:
+    if _octants_feasible(jmax, imax, dtype, n_inner) < 1:
         return True
     return block_k < n_inner and block_k < (kmax + 2) // 2
 

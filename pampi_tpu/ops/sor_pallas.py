@@ -685,14 +685,22 @@ def pad_quarters(p, block_rows_q: int, halo: int):
     """(jmax+2, imax+2) even-shaped array -> (4, rp, W2p) stacked padded
     quarter layout [R0, R1, B0, B1].
 
-    Packing is ONE reshape+transpose into (pj, pi)-lexicographic order
-    [R0, B0, B1, R1] plus a leading-dim permutation — stride-2 gathers are
-    lane shuffles and measured ~100 ms per solve call at large sizes (see
-    sor3d_pallas.pad_octants); the fused transpose is one cheap kernel."""
+    LAYOUT SAFETY: any intermediate with a size-2 dim in the minor-two
+    (tiled) positions explodes — [j2, 2, i2, 2] tiles the trailing 2 to a
+    128-lane tile, a 64× blowup that OOMs the compiler outright at 8192²
+    (f32[4097,2,4097,2] plans as 17 GB). Packing therefore uses staged
+    single-axis stride-2 slices (outer-dim row split is a strided DMA,
+    lane split a lane gather on the halved rows), which keep every
+    intermediate in a sane layout."""
     J, I = p.shape
     j2, i2 = J // 2, I // 2
-    lex = p.reshape(j2, 2, i2, 2).transpose(1, 3, 0, 2).reshape(4, j2, i2)
-    stacked = lex[jnp.array([0, 3, 1, 2])]  # -> [R0, R1, B0, B1]
+    r_even, r_odd = p[0::2], p[1::2]
+    stacked = jnp.stack([
+        r_even[:, 0::2],  # R0
+        r_odd[:, 1::2],   # R1
+        r_even[:, 1::2],  # B0
+        r_odd[:, 0::2],   # B1
+    ])
     nblocks = -(-j2 // block_rows_q)
     rp = nblocks * block_rows_q + 2 * halo
     w2p = -(-i2 // LANE) * LANE
@@ -701,13 +709,21 @@ def pad_quarters(p, block_rows_q: int, halo: int):
 
 
 def unpad_quarters(xq, jmax: int, imax: int, halo: int):
-    """Inverse of pad_quarters -> (jmax+2, imax+2)."""
+    """Inverse of pad_quarters -> (jmax+2, imax+2), staged axis-at-a-time
+    scatter form (lane interleave per row parity, then row interleave —
+    same layout-safety/perf constraint as pad_quarters)."""
     j2, i2 = (jmax + 2) // 2, (imax + 2) // 2
-    q = xq[:, halo: halo + j2, :i2]
-    lex = q[jnp.array([0, 2, 3, 1])]  # back to [R0, B0, B1, R1]
-    return (
-        lex.reshape(2, 2, j2, i2).transpose(2, 0, 3, 1).reshape(2 * j2, 2 * i2)
-    )
+    q = xq[:, halo: halo + j2, :i2]  # [R0, R1, B0, B1]
+    r_even = jnp.zeros((j2, 2 * i2), xq.dtype)
+    r_even = r_even.at[:, 0::2].set(q[0])  # R0
+    r_even = r_even.at[:, 1::2].set(q[2])  # B0
+    r_odd = jnp.zeros((j2, 2 * i2), xq.dtype)
+    r_odd = r_odd.at[:, 0::2].set(q[3])   # B1
+    r_odd = r_odd.at[:, 1::2].set(q[1])   # R1
+    p = jnp.zeros((2 * j2, 2 * i2), xq.dtype)
+    p = p.at[0::2].set(r_even)
+    p = p.at[1::2].set(r_odd)
+    return p
 
 
 def make_rb_iter_tblock_quarters(

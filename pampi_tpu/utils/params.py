@@ -250,3 +250,15 @@ def print_solver_config(p, grid, dt_bound, out=None) -> None:
     w("\tepsilon (stopping tolerance) : %f\n" % p.eps)
     w("\tgamma factor: %f\n" % p.gamma)
     w("\tomega (SOR relaxation): %f\n" % p.omg)
+
+
+def validate_obstacle_layout(layout: str) -> None:
+    """Obstacle flag fields run only on the masked checkerboard kernel
+    (2-D and 3-D alike); reject a forced compressed layout instead of
+    silently ignoring it. Shared by NS2DSolver and NS3DSolver."""
+    if layout not in ("auto", "checkerboard"):
+        raise ValueError(
+            f"tpu_sor_layout {layout} does not support obstacle flag "
+            "fields; obstacle runs use the masked checkerboard kernel "
+            "(auto|checkerboard)"
+        )
