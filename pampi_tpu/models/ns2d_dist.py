@@ -46,6 +46,12 @@ from ..parallel.comm import (
     halo_shift,
     reduction,
 )
+from ..parallel.quarters_dist import (
+    pack_ext_to_q,
+    q_exchange,
+    quarters_dispatch,
+    unpack_q_to_ext,
+)
 from ..parallel.stencil2d import (
     ca_halo,
     ca_inner,
@@ -57,6 +63,7 @@ from ..parallel.stencil2d import (
     strip_deep,
     wall_flags,
 )
+from ..utils import dispatch as _dispatch
 from ..utils import flags as _flags
 from ..utils.datio import write_pressure, write_velocity
 from ..utils.params import Parameter
@@ -240,6 +247,52 @@ class NS2DDistSolver:
             )
             return halo_exchange(strip_deep(pd, H), comm), res, it
 
+        # -- quarter-layout production pressure solve (the round-3 wiring of
+        # the headline Pallas kernel into the distributed path; same dispatch
+        # contract as models/poisson_dist) --------------------------------
+        plain_sor = param.tpu_solver not in ("mg", "fft") and self.masks is None
+        rb_q, qg, n_q, pallas_q = quarters_dispatch(
+            param, self.jmax, self.imax, jl, il, dx, dy, dtype,
+            "ns2d_dist", plain_sor=plain_sor,
+        )
+        if rb_q is None:
+            _dispatch.record(
+                "ns2d_dist",
+                "jnp_ca" if plain_sor else f"other_{param.tpu_solver}"
+                if self.masks is None else "obstacle_jnp",
+            )
+
+        def _solve_sor_quarters(p, rhs):
+            """Stacked-quarter CA solve on the halo-1 extended blocks the
+            time-stepper carries; returns the exchanged halo-1 block like
+            _solve_sor (adaptUV reads p across shard edges)."""
+            joff = get_offsets("j", jl)
+            ioff = get_offsets("i", il)
+            qoffs = jnp.stack(
+                [(joff // 2).astype(jnp.int32), (ioff // 2).astype(jnp.int32)]
+            )
+            rq = q_exchange(pack_ext_to_q(rhs, qg), comm, qg)
+            xq = pack_ext_to_q(p, qg)
+
+            def cond(c):
+                _, res, it = c
+                return jnp.logical_and(res >= epssq, it < param.itermax)
+
+            def body(c):
+                xq, _, it = c
+                xq = q_exchange(xq, comm, qg)
+                xq, r2 = rb_q(qoffs, xq, rq)
+                res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(comm, "{} Residuum: {}", it + (n_q - 1), res)
+                return xq, res, it + n_q
+
+            xq, res, it = lax.while_loop(
+                cond, body,
+                (xq, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
+            )
+            return halo_exchange(unpack_q_to_ext(xq, qg), comm), res, it
+
         if param.tpu_solver == "fft":
             from ..ops.dctpoisson import make_dist_dct_solve_2d
 
@@ -261,6 +314,8 @@ class NS2DDistSolver:
                 param.eps, param.itermax, self.masks, dtype,
                 ca_n=param.tpu_ca_inner,
             )
+        elif rb_q is not None:
+            solve = _solve_sor_quarters
         else:
             solve = _solve_sor
 
@@ -397,6 +452,7 @@ class NS2DDistSolver:
                 step_phases,
                 in_specs=(spec, spec, spec, P()),
                 out_specs=(spec,) * 6 + (P(),),
+                check_vma=not pallas_q,
             )
         )
         self._init_sm = jax.jit(
@@ -407,6 +463,7 @@ class NS2DDistSolver:
                 chunk_kernel,
                 in_specs=(spec, spec, spec, P(), P()),
                 out_specs=(spec, spec, spec, P(), P()),
+                check_vma=not pallas_q,
             )
         )
 

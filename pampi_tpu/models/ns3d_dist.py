@@ -43,12 +43,19 @@ from ..parallel.stencil2d import (
     embed_deep,
     strip_deep,
 )
+from ..parallel.octants_dist import (
+    o_exchange,
+    octants_dispatch,
+    pack_ext_to_o,
+    unpack_o_to_ext,
+)
 from ..parallel.stencil3d import (
     ca_masks_3d,
     ca_rb_iters_3d,
     face_flags,
     rb_exchange_per_sweep_3d,
 )
+from ..utils import dispatch as _dispatch
 from ..utils import flags as _flags
 from ..utils.grid import Grid
 from ..utils.params import Parameter
@@ -223,6 +230,57 @@ class NS3DDistSolver:
             )
             return halo_exchange(strip_deep(pd, H), comm), res, it
 
+        # -- octant-layout production pressure solve (the round-3 wiring of
+        # the 4.9x/iteration octant kernel into the distributed path; same
+        # dispatch contract as models/ns2d_dist's quarters) ---------------
+        plain_sor = param.tpu_solver not in ("mg", "fft") and self.masks is None
+        rb_o, og, n_o, pallas_o = octants_dispatch(
+            param, g.kmax, g.jmax, g.imax, kl, jl, il, dx, dy, dz, dtype,
+            "ns3d_dist", plain_sor=plain_sor,
+        )
+        if rb_o is None:
+            _dispatch.record(
+                "ns3d_dist",
+                "jnp_ca" if plain_sor else f"other_{param.tpu_solver}"
+                if self.masks is None else "obstacle_jnp",
+            )
+        self._pallas_o = pallas_o
+
+        def _solve_sor_octants(p, rhs):
+            """Stacked-octant CA solve on the halo-1 extended blocks; returns
+            the exchanged halo-1 block like _solve_sor (adaptUVW reads p
+            across shard edges, ≙ the trailing commExchange solver.c:288)."""
+            from ..parallel.comm import get_offsets
+
+            koff = get_offsets("k", kl)
+            joff = get_offsets("j", jl)
+            ioff = get_offsets("i", il)
+            qoffs = jnp.stack([
+                (koff // 2).astype(jnp.int32),
+                (joff // 2).astype(jnp.int32),
+                (ioff // 2).astype(jnp.int32),
+            ])
+            ro = o_exchange(pack_ext_to_o(rhs, og), comm, og)
+            xo = pack_ext_to_o(p, og)
+
+            def cond(c):
+                return jnp.logical_and(c[1] >= epssq, c[2] < param.itermax)
+
+            def body(c):
+                xo, _, it = c
+                xo = o_exchange(xo, comm, og)
+                xo, r2 = rb_o(qoffs, xo, ro)
+                res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(comm, "{} Residuum: {}", it + (n_o - 1), res)
+                return xo, res, it + n_o
+
+            xo, res, it = lax.while_loop(
+                cond, body,
+                (xo, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
+            )
+            return halo_exchange(unpack_o_to_ext(xo, og), comm), res, it
+
         if param.tpu_solver == "fft":
             from ..ops.dctpoisson import make_dist_dct_solve_3d
 
@@ -244,6 +302,8 @@ class NS3DDistSolver:
                 param.eps, param.itermax, self.masks, dtype,
                 ca_n=param.tpu_ca_inner,
             )
+        elif rb_o is not None:
+            solve = _solve_sor_octants
         else:
             solve = _solve_sor
 
@@ -369,6 +429,7 @@ class NS3DDistSolver:
                 chunk_kernel,
                 in_specs=(spec,) * 4 + (P(), P()),
                 out_specs=(spec,) * 4 + (P(), P()),
+                check_vma=not pallas_o,
             )
         )
         self._collect_sm = jax.jit(

@@ -43,6 +43,12 @@ from ..parallel.comm import (
     master_print,
     reduction,
 )
+from ..parallel.quarters_dist import (
+    pack_ext_to_q,
+    q_exchange,
+    quarters_dispatch,
+    unpack_q_to_ext,
+)
 from ..parallel.stencil2d import (
     ca_halo,
     ca_inner,
@@ -52,6 +58,7 @@ from ..parallel.stencil2d import (
     neumann_masked,
     rb_exchange_per_sweep,
 )
+from ..utils import dispatch as _dispatch
 from ..utils import flags as _flags
 from ..utils.datio import write_matrix
 from ..utils.params import Parameter
@@ -119,6 +126,21 @@ class DistPoissonSolver:
         supported = ca_supported(jl, il) and not use_direct
         n_ca = ca_inner(param, jl, il) if supported else 1
         H = ca_halo(n_ca) if supported else 1
+
+        # -- quarter-layout production path (parallel/quarters_dist.py):
+        # the single-chip headline kernel on every shard, one depth-n
+        # quarter exchange per n iterations. layout=quarters forces it
+        # (interpret-mode kernel off-TPU); auto takes it when pallas is live
+        rb_q, qg, n_q, pallas_q = quarters_dispatch(
+            param, self.jmax, self.imax, jl, il, dx, dy, dtype,
+            "poisson_dist", plain_sor=not use_direct,
+        )
+        if rb_q is None:
+            _dispatch.record(
+                "poisson_dist",
+                f"jnp_ca ca{n_ca}" if supported else "jnp_rb_fallback"
+                if not use_direct else f"direct_{param.tpu_solver}",
+            )
         if param.tpu_solver == "mg":
             from ..ops.multigrid import make_dist_mg_solve_2d
 
@@ -134,36 +156,36 @@ class DistPoissonSolver:
             )
 
         def offsets():
-            # local deep index a ↔ global extended index a - (H-1) + offset
+            # local deep index a ↔ global extended index a - (halo-1) + offset
             joff = get_offsets("j", jl)
             ioff = get_offsets("i", il)
             return joff, ioff
 
-        def analytic_deep():
-            """Analytic init at the GLOBAL extended index over the deep block
-            (initSolver:105-123): p = sin(4π·i·dx)+sin(4π·j·dy) — identical
-            values the sequential init places at every position, including
-            what are ghost positions here (values at out-of-domain deep-halo
-            positions are dead: masked from every update and read)."""
+        def analytic_ext(halo):
+            """Analytic init at the GLOBAL extended index over a halo-`halo`
+            block (initSolver:105-123): p = sin(4π·i·dx)+sin(4π·j·dy) —
+            identical values the sequential init places at every position,
+            including what are ghost positions here (values at out-of-domain
+            halo positions are dead: masked from every update and read)."""
             joff, ioff = offsets()
-            jj = (jnp.arange(jl + 2 * H, dtype=idx_dtype) - (H - 1) + joff) * dy
-            ii = (jnp.arange(il + 2 * H, dtype=idx_dtype) - (H - 1) + ioff) * dx
+            jj = (jnp.arange(jl + 2 * halo, dtype=idx_dtype) - (halo - 1) + joff) * dy
+            ii = (jnp.arange(il + 2 * halo, dtype=idx_dtype) - (halo - 1) + ioff) * dx
             ext = jnp.sin(4.0 * PI * ii)[None, :] + jnp.sin(4.0 * PI * jj)[:, None]
             return ext.astype(dtype)
 
         def init_kernel():
-            return analytic_deep()[H:-H, H:-H]  # interior only
+            return analytic_ext(1)[1:-1, 1:-1]  # interior only
 
-        def rhs_deep():
+        def rhs_ext(halo):
             joff, ioff = offsets()
-            ii = (jnp.arange(il + 2 * H, dtype=idx_dtype) - (H - 1) + ioff) * dx
+            ii = (jnp.arange(il + 2 * halo, dtype=idx_dtype) - (halo - 1) + ioff) * dx
             row = (
                 jnp.sin(2.0 * PI * ii)
                 if problem == 2
-                else jnp.zeros(il + 2 * H, idx_dtype)
+                else jnp.zeros(il + 2 * halo, idx_dtype)
             )
             return jnp.broadcast_to(
-                row[None, :], (jl + 2 * H, il + 2 * H)
+                row[None, :], (jl + 2 * halo, il + 2 * halo)
             ).astype(dtype)
 
         def solve_kernel(p_int, first: bool):
@@ -174,11 +196,13 @@ class DistPoissonSolver:
             initSolver:105); on a resumed solve the walls carry the Neumann
             copies the previous iteration ended with, which equal an edge
             copy of the interior."""
+            if rb_q is not None:
+                return solve_kernel_quarters(p_int, first)
             m = ca_masks(jl, il, H, self.jmax, self.imax, dtype)
-            p = analytic_deep().at[H:-H, H:-H].set(p_int)
+            p = analytic_ext(H).at[H:-H, H:-H].set(p_int)
             if not first:
                 p = neumann_masked(p, m)
-            rhs = rhs_deep()
+            rhs = rhs_ext(H)
 
             if use_direct:  # H == 1: plain extended blocks
                 p, res, it = direct_solve(p, rhs)
@@ -206,6 +230,40 @@ class DistPoissonSolver:
             p, res, it = lax.while_loop(cond, body, init)
             return p[H:-H, H:-H], res, it
 
+        def solve_kernel_quarters(p_int, first: bool):
+            """Quarter-layout production solve: the stacked stored plane of
+            parallel/quarters_dist carried through the while_loop, one
+            depth-n_q q_exchange per rb_q call (Pallas kernel on TPU, jnp
+            twin otherwise). Same ghost-reconstruction policy as the grid
+            path, on the halo-1 extended block before packing."""
+            m1 = ca_masks(jl, il, 1, self.jmax, self.imax, dtype)
+            ext = analytic_ext(1).at[1:-1, 1:-1].set(p_int)
+            if not first:
+                ext = neumann_masked(ext, m1)
+            joff, ioff = offsets()
+            qoffs = jnp.stack(
+                [(joff // 2).astype(jnp.int32), (ioff // 2).astype(jnp.int32)]
+            )
+            rq = q_exchange(pack_ext_to_q(rhs_ext(1), qg), comm, qg)
+            xq = pack_ext_to_q(ext, qg)
+
+            def cond(carry):
+                _, res, it = carry
+                return jnp.logical_and(res >= epssq, it < itermax)
+
+            def body(carry):
+                xq, _, it = carry
+                xq = q_exchange(xq, comm, qg)
+                xq, r2 = rb_q(qoffs, xq, rq)
+                res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(comm, "{} Residuum: {}", it + (n_q - 1), res)
+                return xq, res, it + n_q
+
+            init = (xq, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+            xq, res, it = lax.while_loop(cond, body, init)
+            return unpack_q_to_ext(xq, qg)[1:-1, 1:-1], res, it
+
         spec = P("j", "i")
         self._init_sm = jax.jit(
             comm.shard_map(init_kernel, in_specs=(), out_specs=spec)
@@ -213,12 +271,14 @@ class DistPoissonSolver:
         out = (spec, P(), P())
         self._solve_first = jax.jit(
             comm.shard_map(
-                lambda p: solve_kernel(p, True), in_specs=(spec,), out_specs=out
+                lambda p: solve_kernel(p, True), in_specs=(spec,),
+                out_specs=out, check_vma=not pallas_q,
             )
         )
         self._solve_resume = jax.jit(
             comm.shard_map(
-                lambda p: solve_kernel(p, False), in_specs=(spec,), out_specs=out
+                lambda p: solve_kernel(p, False), in_specs=(spec,),
+                out_specs=out, check_vma=not pallas_q,
             )
         )
 

@@ -164,9 +164,13 @@ class CartComm:
                 )
         return tuple(e // p for e, p in zip(global_shape, self.dims))
 
-    def shard_map(self, fn, in_specs, out_specs):
+    def shard_map(self, fn, in_specs, out_specs, check_vma: bool = True):
+        # check_vma=False is required when the body dispatches a pallas_call
+        # (its out_shape declares no varying-mesh-axes info — the standard
+        # composition form, validated bitwise on real TPU hardware)
         return jax.shard_map(
-            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs
+            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
         )
 
     # --- commPrintConfig (comm.c:429-462) ------------------------------

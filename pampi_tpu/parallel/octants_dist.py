@@ -1,0 +1,370 @@
+"""Distributed OCTANT-layout 3-D red-black SOR: geometry, packing, deep-halo
+exchange, and the jnp twin of the per-shard Pallas kernel.
+
+The 3-D form of parallel/quarters_dist.py (same derivation, one dimension
+up): the octant decomposition of ops/sor_octants.py — every 7-point
+neighbour a uniform shift, every lane productive (the 4.9×/RB-iteration
+NS-3D kernel) — carried ACROSS the distributed convergence loop of
+models/ns3d_dist.py with one communication-avoiding depth-n octant exchange
+per n red-black iterations.
+
+LAYOUT: all eight octants of a shard are GLOBALLY ALIGNED. Stored indices
+(s, r, c) of every slot hold global octant coords
+
+    go_k = (s - h) - n + qoff_k     (h = kernel k-window halo = n planes,
+    go_j = r - n + qoff_j            no alignment needed on the untiled k
+    go_i = c - n + qoff_i            axis; j/i pad to sublane/lane tiles)
+
+with qoff_* = shard offset / 2 (shard extents even ⇒ offsets even ⇒ the
+parity split is decomposition-invariant and the single-device neighbour/
+Neumann identities hold verbatim). Per parity bit b of an axis, owned
+stored indices start at base + (1 if b == 0 else 0) — static bounds.
+
+CA semantics match the 2-D module exactly: one iteration consumes one
+octant plane of validity per side per axis; the outermost stored ring is
+frozen (read-only — in grid space it IS the outermost grid ghost plane, so
+the proven depth-2n grid CA argument carries over); ghost cells are
+redundantly recomputed; residuals count owned cells only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.sor_octants import BITS, EVEN, ODD, _flip
+from .comm import CartComm, _nbr_perm
+
+# slot index per bits tuple (pk, pj, pi) in the stacked (8, ...) array
+QIDX = {bits: i for i, bits in enumerate(BITS)}
+# per axis: slots whose parity bit on that axis is 0 / 1
+AXIS_SLOTS = [
+    ([QIDX[b] for b in BITS if b[ax] == 0], [QIDX[b] for b in BITS if b[ax] == 1])
+    for ax in range(3)
+]
+
+
+@dataclass(frozen=True)
+class OGeom:
+    """Static geometry of the distributed stacked octant layout."""
+
+    kmax: int
+    jmax: int
+    imax: int
+    kl: int  # per-shard interior extents (even)
+    jl: int
+    il: int
+    n: int    # CA depth in octant planes = RB iterations per exchange
+    h: int    # kernel k-window halo (= n; untiled axis)
+    bk: int   # kernel block depth (octant planes)
+    kq: int   # logical stored k span: kl/2 + 2n + 1
+    jq: int
+    iq: int
+    sp: int   # padded stored k: nblocks*bk + 2h
+    jp2: int  # padded stored j (sublane multiple)
+    ip2: int  # padded stored i (lane multiple)
+    nblocks: int
+
+    @property
+    def base(self) -> tuple[int, int, int]:
+        """Stored index of global octant coord qoff_* per axis."""
+        return (self.h + self.n, self.n, self.n)
+
+    def gmax2(self, axis: int) -> int:
+        return (self.kmax, self.jmax, self.imax)[axis] // 2
+
+    def local2(self, axis: int) -> int:
+        return (self.kl, self.jl, self.il)[axis] // 2
+
+    def span(self, axis: int) -> int:
+        return (self.kq, self.jq, self.iq)[axis]
+
+
+def make_ogeom(kmax, jmax, imax, kl, jl, il, n, dtype,
+               bk: int | None = None) -> OGeom:
+    from ..ops import sor_pallas as sp
+
+    a = sp._align(dtype)
+    h = n  # k axis is untiled: halo needs no alignment rounding
+    kq = kl // 2 + 2 * n + 1
+    jq = jl // 2 + 2 * n + 1
+    iq = il // 2 + 2 * n + 1
+    jp2 = -(-jq // a) * a
+    ip2 = -(-iq // sp.LANE) * sp.LANE
+    if bk is None:
+        from ..ops.sor3d_pallas import VMEM_LIMIT_BYTES
+
+        plane = jp2 * ip2 * jnp.dtype(dtype).itemsize
+        feasible = ((VMEM_LIMIT_BYTES // 2) // max(plane, 1) - 64 * n) // 48
+        bk = max(1, min(feasible, kq, 64))
+    nblocks = -(-kq // bk)
+    sp_ = nblocks * bk + 2 * h
+    return OGeom(kmax, jmax, imax, kl, jl, il, n, h, bk, kq, jq, iq,
+                 sp_, jp2, ip2, nblocks)
+
+
+def odist_supported(kmax, jmax, imax, kl, jl, il) -> bool:
+    return (
+        kmax % 2 == 0 and jmax % 2 == 0 and imax % 2 == 0
+        and kl % 2 == 0 and jl % 2 == 0 and il % 2 == 0
+        and kl >= 4 and jl >= 4 and il >= 4
+    )
+
+
+def odist_clamp(n: int, kl: int, jl: int, il: int) -> int:
+    return max(1, min(n, min(kl, jl, il) // 2 - 1))
+
+
+def octants_dispatch(param, kmax, jmax, imax, kl, jl, il, dx, dy, dz, dtype,
+                     record_key: str, plain_sor: bool):
+    """3-D twin of quarters_dist.quarters_dispatch (models/ns3d_dist):
+    returns (rb_o, og, n_o, pallas_o); rb_o None -> grid-space jnp CA."""
+    from ..utils import dispatch as _dispatch
+
+    layout = param.tpu_sor_layout
+    osup = odist_supported(kmax, jmax, imax, kl, jl, il)
+    if layout == "octants" and not (osup and plain_sor):
+        raise ValueError(
+            "tpu_sor_layout octants needs even global and per-shard "
+            "extents (>= 4) and the plain tpu_solver sor path"
+        )
+    if not (plain_sor and osup and layout in ("auto", "octants")):
+        return None, None, 0, False
+    from ..models.ns3d import _use_pallas_3d
+
+    if not (layout == "octants" or _use_pallas_3d("auto", dtype)):
+        return None, None, 0, False
+    n_o = odist_clamp(
+        max(param.tpu_ca_inner, param.tpu_sor_inner), kl, jl, il
+    )
+    og = make_ogeom(kmax, jmax, imax, kl, jl, il, n_o, dtype)
+    try:
+        from ..ops.sor_odist import make_rb_iters_odist
+
+        rb_o = make_rb_iters_odist(og, dx, dy, dz, param.omg, dtype)
+    except ValueError:
+        rb_o = None
+    if rb_o is not None:
+        _dispatch.record(record_key, f"pallas_octants ca{n_o}")
+        return rb_o, og, n_o, True
+    if layout == "octants":
+        from ..models.ns3d import sor_coefficients_3d
+
+        factor, idx2, idy2, idz2 = sor_coefficients_3d(
+            dx, dy, dz, param.omg
+        )
+
+        def rb_o(qoffs, xo, ro):
+            m = o_masks(og, qoffs[0], qoffs[1], qoffs[2])
+            return rb_iters_o_jnp(xo, ro, og, m, factor, idx2, idy2, idz2)
+
+        _dispatch.record(record_key, f"jnp_octants ca{n_o}")
+        return rb_o, og, n_o, False
+    return None, None, 0, False
+
+
+def _owned_start(g: OGeom, axis: int, bit: int) -> int:
+    return g.base[axis] + (1 if bit == 0 else 0)
+
+
+# ----------------------------------------------------------------------
+# Packing: (kl+2, jl+2, il+2) extended block <-> stacked (8, sp, jp2, ip2)
+# ----------------------------------------------------------------------
+
+
+def pack_ext_to_o(ext, g: OGeom):
+    """Extended halo-1 block -> stacked octant layout (staged single-axis
+    stride-2 slices — the layout-safe form of sor3d_pallas.pad_octants)."""
+    slabs = {}
+    for pk in (0, 1):
+        sk = ext[pk::2]
+        for pj in (0, 1):
+            skj = sk[:, pj::2]
+            for pi in (0, 1):
+                slabs[(pk, pj, pi)] = skj[:, :, pi::2]
+    stacked = jnp.stack([slabs[bits] for bits in BITS])
+    bk_, bj, bi = g.base
+    out = jnp.zeros((8, g.sp, g.jp2, g.ip2), ext.dtype)
+    return out.at[
+        :,
+        bk_ : bk_ + g.kl // 2 + 1,
+        bj : bj + g.jl // 2 + 1,
+        bi : bi + g.il // 2 + 1,
+    ].set(stacked)
+
+
+def unpack_o_to_ext(xo, g: OGeom):
+    """Inverse of pack_ext_to_o, staged axis-at-a-time scatter."""
+    k2, j2, i2 = g.kl // 2 + 1, g.jl // 2 + 1, g.il // 2 + 1
+    bk_, bj, bi = g.base
+    stacked = xo[:, bk_ : bk_ + k2, bj : bj + j2, bi : bi + i2]
+    q = {bits: stacked[qi] for qi, bits in enumerate(BITS)}
+    kj = {}
+    for pk in (0, 1):
+        for pj in (0, 1):
+            m = jnp.zeros((k2, j2, 2 * i2), xo.dtype)
+            m = m.at[:, :, 0::2].set(q[(pk, pj, 0)])
+            m = m.at[:, :, 1::2].set(q[(pk, pj, 1)])
+            kj[(pk, pj)] = m
+    slabs = {}
+    for pk in (0, 1):
+        m = jnp.zeros((k2, 2 * j2, 2 * i2), xo.dtype)
+        m = m.at[:, 0::2].set(kj[(pk, 0)])
+        m = m.at[:, 1::2].set(kj[(pk, 1)])
+        slabs[pk] = m
+    p = jnp.zeros((2 * k2, 2 * j2, 2 * i2), xo.dtype)
+    p = p.at[0::2].set(slabs[0])
+    p = p.at[1::2].set(slabs[1])
+    return p
+
+
+# ----------------------------------------------------------------------
+# Deep-halo exchange in octant space
+# ----------------------------------------------------------------------
+
+
+def o_exchange(xo, comm: CartComm, g: OGeom):
+    """commExchange in octant space: depth-n ghost slabs per axis per parity
+    group, PROC_NULL at physical walls. 12 ppermutes total (3 axes × 2
+    directions × 2 parity groups), each carrying a stacked 4-slot strip."""
+    n = g.n
+    for axis, name in enumerate(("k", "j", "i")):
+        nper = comm.axis_size(name)
+        if nper == 1:
+            continue
+        adim = axis + 1  # array axis in the (8, s, r, c) stacked layout
+        l2 = g.local2(axis)
+        idx = lax.axis_index(name)
+        for bit in (0, 1):
+            slots = AXIS_SLOTS[axis][bit]
+            os = _owned_start(g, axis, bit)
+            grp = xo[jnp.asarray(slots)]
+            # low ghosts [os-n, os) <- -1 neighbour's owned top slab
+            strip = lax.slice_in_dim(grp, os + l2 - n, os + l2, axis=adim)
+            recv = lax.ppermute(strip, name, _nbr_perm(nper, True, False))
+            old = lax.slice_in_dim(grp, os - n, os, axis=adim)
+            recv = jnp.where(idx > 0, recv, old)
+            grp = lax.dynamic_update_slice_in_dim(grp, recv, os - n, axis=adim)
+            # high ghosts [os+l2, os+l2+n) <- +1 neighbour's owned bottom
+            strip = lax.slice_in_dim(grp, os, os + n, axis=adim)
+            recv = lax.ppermute(strip, name, _nbr_perm(nper, False, False))
+            old = lax.slice_in_dim(grp, os + l2, os + l2 + n, axis=adim)
+            recv = jnp.where(idx < nper - 1, recv, old)
+            grp = lax.dynamic_update_slice_in_dim(grp, recv, os + l2, axis=adim)
+            for gi, si in enumerate(slots):
+                xo = xo.at[si].set(grp[gi])
+    return xo
+
+
+# ----------------------------------------------------------------------
+# Masks + the jnp twin of the per-shard kernel
+# ----------------------------------------------------------------------
+
+
+def o_masks(g: OGeom, qoff_k, qoff_j, qoff_i):
+    """Per-slot masks on the full (sp, jp2, ip2) stored volume from GLOBAL
+    octant coordinates — keep in lockstep with ops/sor_odist.py."""
+    s = jnp.arange(g.sp, dtype=jnp.int32)[:, None, None]
+    r = jnp.arange(g.jp2, dtype=jnp.int32)[None, :, None]
+    c = jnp.arange(g.ip2, dtype=jnp.int32)[None, None, :]
+    lam = (s - g.h, r, c)
+    go = (lam[0] - g.n + qoff_k, lam[1] - g.n + qoff_j, lam[2] - g.n + qoff_i)
+    valid_upd_ax = [
+        (lam[a] >= 1) & (lam[a] <= g.span(a) - 2) for a in range(3)
+    ]
+    valid_upd = valid_upd_ax[0] & valid_upd_ax[1] & valid_upd_ax[2]
+
+    def ax_int(axis, bit):
+        if bit == 0:
+            return (go[axis] >= 1) & (go[axis] <= g.gmax2(axis))
+        return (go[axis] >= 0) & (go[axis] <= g.gmax2(axis) - 1)
+
+    def ax_own(axis, bit):
+        st = (s, r, c)[axis]
+        os = _owned_start(g, axis, bit)
+        return (st >= os) & (st < os + g.local2(axis))
+
+    m = {"upd": {}, "own": {}, "wall": {}}
+    for bits in BITS:
+        m["upd"][bits] = (
+            ax_int(0, bits[0]) & ax_int(1, bits[1]) & ax_int(2, bits[2])
+            & valid_upd
+        )
+        m["own"][bits] = (
+            ax_own(0, bits[0]) & ax_own(1, bits[1]) & ax_own(2, bits[2])
+        )
+    # 24 Neumann face selects: (axis, hi, bits) -> mask on the TARGET slot
+    valid_any = (
+        (lam[0] >= 0) & (lam[0] < g.kq)
+        & (lam[1] >= 0) & (lam[1] < g.jq)
+        & (lam[2] >= 0) & (lam[2] < g.iq)
+    )
+    for axis in range(3):
+        for hi in (False, True):
+            plane = (
+                go[axis] == (g.gmax2(axis) if hi else 0)
+            )
+            for bits in BITS:
+                if bits[axis] != (1 if hi else 0):
+                    continue
+                a2, a3 = [a for a in range(3) if a != axis]
+                m["wall"][(axis, hi, bits)] = (
+                    plane & ax_int(a2, bits[a2]) & ax_int(a3, bits[a3])
+                    & valid_any
+                )
+    return m
+
+
+def rb_iters_o_jnp(xo, rhso, g: OGeom, m, factor, idx2, idy2, idz2):
+    """g.n full 3-D red-black iterations + Neumann refresh on the stacked
+    stored volume — the jnp twin of ops/sor_odist's kernel (identical
+    neighbour identities, select masks, update order). Returns
+    (xo', owned sum of r² of the LAST iteration)."""
+    octs = {bits: xo[QIDX[bits]] for bits in BITS}
+    rhs_o = {bits: rhso[QIDX[bits]] for bits in BITS}
+
+    def nbrs(bits):
+        def ax_pair(axis):
+            partner = octs[_flip(bits, axis)]
+            if bits[axis] == 0:
+                return jnp.roll(partner, 1, axis), partner
+            return partner, jnp.roll(partner, -1, axis)
+
+        f, bk_ = ax_pair(0)
+        s_, n_ = ax_pair(1)
+        w, e = ax_pair(2)
+        return w, e, s_, n_, f, bk_
+
+    resids = {}
+    for _ in range(g.n):
+        for group in (ODD, EVEN):
+            for bits in group:
+                cen = octs[bits]
+                w, e, s_, n_, f, bk_ = nbrs(bits)
+                r = rhs_o[bits] - (
+                    (e - 2.0 * cen + w) * idx2
+                    + (n_ - 2.0 * cen + s_) * idy2
+                    + (bk_ - 2.0 * cen + f) * idz2
+                )
+                rm = jnp.where(m["upd"][bits], r, jnp.zeros_like(r))
+                octs[bits] = cen - factor * rm
+                resids[bits] = rm
+        for axis in range(3):
+            for hi in (False, True):
+                for bits in BITS:
+                    if bits[axis] != (1 if hi else 0):
+                        continue
+                    octs[bits] = jnp.where(
+                        m["wall"][(axis, hi, bits)],
+                        octs[_flip(bits, axis)], octs[bits],
+                    )
+
+    rsq = jnp.zeros((), xo.dtype)
+    for bits in BITS:
+        rq = resids[bits]
+        rsq = rsq + jnp.sum(
+            jnp.where(m["own"][bits], rq * rq, jnp.zeros_like(rq))
+        )
+    return jnp.stack([octs[bits] for bits in BITS]), rsq
