@@ -34,23 +34,20 @@ from ..utils.precision import resolve_dtype
 from ..utils.progress import Progress
 
 
-def resolve_sor_layout(layout: str) -> str:
-    """The NS-2D auto-layout resolution — single home, shared with the
-    region-counter harness (tools/bench_regions.py). Measured (v5e, 4096²
-    dcavity, itermax=100, chained-step differencing): the quarters layout
-    wins 3× in loop-carried use (bench.py, Poisson) but LOSES inside the NS
-    per-step solve cycle — 68 vs 39 ms/step vs checkerboard — so NS-2D
-    "auto" keeps checkerboard; an explicit `tpu_sor_layout quarters` still
-    forces it. (NS-3D is the opposite: octants win 4× at the step level,
-    models/ns3d.py.)"""
-    return "checkerboard" if layout == "auto" else layout
-
-
 def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
                         backend: str = "auto", n_inner: int = 1,
                         solver: str = "sor", layout: str = "auto"):
     """Pressure-Poisson solve loop (solve, solver.c:140-191): carry
     (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep.
+
+    Layout: `layout` goes straight to make_rb_loop's standard dispatch
+    (auto -> quarters when eligible, checkerboard otherwise). Measured
+    (v5e, 4096² dcavity, itermax=100, chained-step differencing, round 3):
+    quarters 22.2-22.5 ms/step vs checkerboard 36.9-39.6 — quarters wins
+    1.7× at the step level too. Round 2 had measured quarters LOSING (68 vs
+    39 ms/step) and pinned NS-2D auto to checkerboard; that loss predated
+    the staged single-transpose packing — the pack+unpad roundtrip now
+    measures 0.94 ms at 4096².
 
     solver="sor" (default, the reference's algorithm): identical semantics to
     the Poisson convergence loop, so it IS that loop — `make_solver_fn`
@@ -77,7 +74,7 @@ def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
 
     return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
                           backend=backend, n_inner=n_inner,
-                          layout=resolve_sor_layout(layout))
+                          layout=layout)
 
 
 class NS2DSolver:

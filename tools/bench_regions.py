@@ -162,13 +162,12 @@ def bench_ns2d(param: Parameter, dtype):
     _record("computeRHS",
             _time(lambda a, b: ops.compute_rhs(a, b, dt, dx, dy), f, g),
             sites)
-    # the layout the NS-2D pressure solve actually ships for this config
-    # (auto maps to checkerboard there; explicit values pass through)
-    from pampi_tpu.models.ns2d import resolve_sor_layout
-
+    # the layout the NS-2D pressure solve actually ships for this config:
+    # make_rb_loop's standard dispatch (auto -> quarters when eligible,
+    # checkerboard otherwise — models/ns2d.make_pressure_solve round 3)
     step, prep, post, eff = make_rb_loop(
         imax, jmax, dx, dy, param.omg, dtype, "auto", param.tpu_sor_inner,
-        layout=resolve_sor_layout(param.tpu_sor_layout),
+        layout=param.tpu_sor_layout,
     )
     _record("sor_iter",
             _time(lambda a, b: step(a, b)[0], prep(p), prep(rhs)),

@@ -34,10 +34,14 @@ REPS = 8
 def measure(solver: str) -> float:
     from pampi_tpu.models.ns2d import NS2DSolver
 
+    # "sor:quarters" / "sor:checkerboard" pins the SOR layout (default auto)
+    layout = "auto"
+    if ":" in solver:
+        solver, layout = solver.split(":", 1)
     param = Parameter(
         name="dcavity", imax=N, jmax=N, re=1000.0, te=10.0, tau=0.5,
         itermax=100, eps=1e-3, omg=1.7, gamma=0.9, tpu_dtype="float32",
-        tpu_solver=solver,
+        tpu_solver=solver, tpu_sor_layout=layout,
     )
     s = NS2DSolver(param, dtype=jnp.float32)
     step = s._build_step()
