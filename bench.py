@@ -49,13 +49,16 @@ N = 4096
 # ~40G the same kernel measures latency-amortized). 9600 iterations of the
 # quarters kernel ≈ 1.2 s per dispatch — worst-case floor haircut < 9%.
 ITERS = 9600
-N_INNER = 8  # temporal-blocking depth. The auto layout dispatches the
+N_INNER = 16  # temporal-blocking depth. The auto layout dispatches the
 # QUARTER-decomposition kernel (ops/sor_quarters.py — all lanes productive,
-# uniform shifts) at its shipped default of 64 quarter-rows (= 128 grid
-# rows) per block: 140.6G updates/s measured HERE, vs 67-107G across the
-# standalone k x brq sweep and the masked checkerboard's 47.5G; the timed
-# loop runs (ITERS // eff) * eff iterations and divides by exactly that
-# count
+# uniform shifts); at n_inner=16 the maker's default block height is 128
+# quarter-rows (= 256 grid rows). Round-3 depth sweep (same-session,
+# best-of-3 x ~1.2 s dispatches): n16/brq128 = 127-131G vs the round-2
+# default n8/brq64's 76-84G under identical conditions — the absolute
+# numbers swing ~2x session-to-session with tunnel weather (round 2's
+# driver run recorded 151.2G at n8), but the n16/n8 ratio was stable at
+# ~1.6x across three sweeps. The timed loop runs (ITERS // eff) * eff
+# iterations and divides by exactly that count
 
 
 def _timed_run(backend: str):

@@ -107,18 +107,27 @@ def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
         if want_q and even:
             from ..ops import sor_pallas as sp
 
-            # construction is cheap and raises only on pre-checked
-            # conditions (odd dims, f64); runtime kernel failures surface at
-            # first dispatch and are handled by the callers' jnp fallback
-            rb_iter, brq, h = sp.make_rb_iter_tblock_quarters(
-                imax, jmax, dx, dy, omega, dtype, n_inner=n_inner
-            )
+            # construction raises on pre-checked conditions (odd dims, f64)
+            # and on VMEM infeasibility (quarters_feasible): forced layout
+            # propagates the error, auto falls back to checkerboard; runtime
+            # kernel failures surface at first dispatch and are handled by
+            # the callers' jnp fallback
+            try:
+                rb_iter, brq, h = sp.make_rb_iter_tblock_quarters(
+                    imax, jmax, dx, dy, omega, dtype, n_inner=n_inner
+                )
+            except ValueError:
+                if layout == "quarters":
+                    raise
+                rb_iter = None
             if rb_iter is not None:
                 norm = float(imax * jmax)
 
                 def step(p_stacked, rhs_stacked):
                     p_stacked, rsq = rb_iter(p_stacked, rhs_stacked)
-                    return p_stacked, rsq / norm
+                    # bf16 storage accumulates the residual in f32; cast to
+                    # the carry dtype (identity for f32/f64)
+                    return p_stacked, (rsq / norm).astype(dtype)
 
                 def prep(x):
                     return sp.pad_quarters(x, brq, h)
@@ -128,10 +137,19 @@ def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
 
                 return step, prep, post, n_inner
         kernel = "tblock" if n_inner > 1 else "fused"
-        step, prep, post = make_rb_step_padded(
-            imax, jmax, dx, dy, omega, dtype, kernel=kernel, n_inner=n_inner
-        )
-        return step, prep, post, n_inner
+        try:
+            step, prep, post = make_rb_step_padded(
+                imax, jmax, dx, dy, omega, dtype, kernel=kernel,
+                n_inner=n_inner,
+            )
+            return step, prep, post, n_inner
+        except ValueError:
+            if backend == "pallas":
+                raise
+            # VMEM-infeasible on this grid (tblock_feasible): the safe
+            # fallback is jnp — the checkerboard kernel would crash Mosaic
+            # at first dispatch on the same grids that trip quarters
+            pass
     step = make_rb_step(imax, jmax, dx, dy, omega, dtype, backend="jnp")
     ident = lambda x: x  # noqa: E731
     return step, ident, ident, 1
@@ -205,13 +223,20 @@ def make_rb_step(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
     association, make_rba_step); default is solveRB's (ω·0.5·dx²dy²)/(dx²+dy²)."""
     norm = float(imax * jmax)
     if factor is None and _use_pallas(backend, dtype):
-        pstep, pad, unpad = make_rb_step_padded(imax, jmax, dx, dy, omega, dtype)
+        try:
+            pstep, pad, unpad = make_rb_step_padded(
+                imax, jmax, dx, dy, omega, dtype
+            )
+        except ValueError:
+            if backend == "pallas":
+                raise
+            pstep = None  # VMEM-infeasible grid: jnp fallback below
+        if pstep is not None:
+            def step(p, rhs):
+                p_pad, res = pstep(pad(p), pad(rhs))
+                return unpad(p_pad), res
 
-        def step(p, rhs):
-            p_pad, res = pstep(pad(p), pad(rhs))
-            return unpad(p_pad), res
-
-        return step
+            return step
 
     dx2, dy2 = dx * dx, dy * dy
     idx2, idy2 = 1.0 / dx2, 1.0 / dy2

@@ -425,6 +425,26 @@ def pick_block_rows_tblock(jmax: int, imax: int, dtype=jnp.float32,
     return max(a, h, min(br, 512, whole))
 
 
+def tblock_vmem_bytes(block_rows: int, h: int, wp: int, itemsize: int,
+                      masked: bool = False) -> int:
+    """Scratch bytes of the checkerboard tblock kernel: double-buffered p and
+    rhs (+ flag) windows, out bands, per-lane accumulator."""
+    nwin = 3 if masked else 2
+    win = 2 * (block_rows + 2 * h) * wp
+    return itemsize * (nwin * win + 2 * block_rows * wp + wp)
+
+
+def tblock_feasible(block_rows: int, h: int, wp: int, itemsize: int,
+                    masked: bool = False) -> bool:
+    """VMEM guard for the checkerboard kernel (same contract as
+    quarters_feasible — an infeasible build crashes Mosaic at first
+    dispatch, so the dispatcher must get a catchable error instead)."""
+    return (
+        tblock_vmem_bytes(block_rows, h, wp, itemsize, masked)
+        <= VMEM_LIMIT_BYTES // 2
+    )
+
+
 def make_rb_iter_tblock(
     imax: int,
     jmax: int,
@@ -457,6 +477,15 @@ def make_rb_iter_tblock(
         interpret = jax.default_backend() != "tpu"
     _check_dtype(dtype, interpret)
     masked = fluid is not None
+    itemsize = jnp.dtype(dtype).itemsize
+    if not tblock_feasible(block_rows, h, padded_width(imax), itemsize,
+                           masked):
+        raise ValueError(
+            f"tblock scratch {tblock_vmem_bytes(block_rows, h, padded_width(imax), itemsize, masked) >> 20} MiB "
+            f"exceeds the VMEM budget (block_rows={block_rows}, h={h}, "
+            f"wp={padded_width(imax)}); the grid is too wide for the fused "
+            "kernel — the jnp path is the fallback"
+        )
 
     dx2, dy2 = dx * dx, dy * dy
     width = imax + 2
@@ -546,12 +575,18 @@ def _tblock_quarters_kernel(
     factor: float,
     idx2: float,
     idy2: float,
+    compute_dtype=None,
 ):
     """Temporal-blocked red-black sweep in the QUARTER layout
     (ops/sor_quarters.py derivation): every neighbour a uniform ±1 shift,
     every lane productive, the Neumann refresh 8 same-index edge selects.
     One iteration consumes ONE quarter-row of halo per side (= 2 grid rows,
-    matching the checkerboard kernel's 2·n_inner grid-row halo)."""
+    matching the checkerboard kernel's 2·n_inner grid-row halo).
+
+    compute_dtype: when set (the bf16-storage mode), windows are loaded in
+    the storage dtype (half the HBM traffic and VMEM footprint), upcast once
+    per block, iterated in compute_dtype (f32), and downcast at the store —
+    bf16 touches only the HBM arrays, never the arithmetic."""
     b = pl.program_id(0)
     brq = block_rows
     h = halo
@@ -576,7 +611,7 @@ def _tblock_quarters_kernel(
 
     @pl.when(b == 0)
     def _():
-        res[0, 0] = jnp.zeros((), p_out.dtype)
+        res[0, 0] = jnp.zeros((), res.dtype)
         vacc[...] = jnp.zeros_like(vacc)
         for c in load(0, 0):
             c.start()
@@ -591,6 +626,9 @@ def _tblock_quarters_kernel(
 
     R0, R1, B0, B1 = (pw2[slot, qi] for qi in range(4))
     F0, F1, G0, G1 = (rw2[slot, qi] for qi in range(4))
+    if compute_dtype is not None:
+        R0, R1, B0, B1 = (x.astype(compute_dtype) for x in (R0, R1, B0, B1))
+        F0, F1, G0, G1 = (x.astype(compute_dtype) for x in (F0, F1, G0, G1))
 
     # quarter-space coordinates of window cell (w, c): r = b*brq - h + w
     rr = b * brq - h + jax.lax.broadcasted_iota(jnp.int32, R0.shape, 0)
@@ -650,7 +688,10 @@ def _tblock_quarters_kernel(
             c.wait()
 
     for qi, arr in enumerate((R0, R1, B0, B1)):
-        ob2[slot, qi] = arr[h: h + brq, :]
+        band = arr[h: h + brq, :]
+        if compute_dtype is not None:
+            band = band.astype(p_out.dtype)
+        ob2[slot, qi] = band
     for c in store(b, slot):
         c.start()
 
@@ -726,6 +767,23 @@ def unpad_quarters(xq, jmax: int, imax: int, halo: int):
     return p
 
 
+def quarters_vmem_bytes(brq: int, h: int, w2p: int, itemsize: int) -> int:
+    """Scratch bytes of the quarters kernels (single-device and distributed
+    share the buffer set): double-buffered p and rhs windows, out bands,
+    per-lane accumulator."""
+    win = 2 * 4 * (brq + 2 * h) * w2p
+    return itemsize * (2 * win + 2 * 4 * brq * w2p + w2p)
+
+
+def quarters_feasible(brq: int, h: int, w2p: int, itemsize: int) -> bool:
+    """VMEM-feasibility guard (mirrors the octant accounting of
+    sor3d_pallas._octants_feasible): the scratch set must fit the raised
+    compile limit with headroom for Mosaic's own temporaries. A forced
+    quarters layout on an extremely wide grid would otherwise crash the
+    Mosaic compiler at first dispatch."""
+    return quarters_vmem_bytes(brq, h, w2p, itemsize) <= VMEM_LIMIT_BYTES // 2
+
+
 def make_rb_iter_tblock_quarters(
     imax: int,
     jmax: int,
@@ -745,17 +803,28 @@ def make_rb_iter_tblock_quarters(
 
     Numerics: per-cell arithmetic keeps the reference association and is
     ulp-equivalent to the masked paths (compiler fma/fusion differences
-    only — ops/sor_quarters.py); the residual summation order differs."""
+    only — ops/sor_quarters.py); the residual summation order differs.
+
+    bfloat16 `dtype` selects the bf16-storage / f32-compute mode: the HBM
+    arrays and VMEM windows are bf16 (half the bytes on the roofline's HBM
+    wall), the per-block iteration runs in f32, and the residual is
+    accumulated and returned in f32 (bf16's 8-bit mantissa cannot hold a
+    meaningful sum of squares)."""
     if pltpu is None:
         return None, 0, 0
     if imax % 2 or jmax % 2:
         raise ValueError("quarter layout needs even imax and jmax")
     h = quarters_halo(n_inner, dtype)
     if block_rows_q is None:
-        # measured-optimal 128 grid rows (pick_block_rows_tblock) = 64
+        # round-2 optimum at n_inner<=8 was 64 quarter-rows (= 128 grid
+        # rows); the round-3 depth sweep (4096² f32, 3 same-session runs)
+        # found deeper blocking wants taller blocks to amortize the larger
+        # halo recompute: n16/brq128 measures 127-131G vs n8/brq64's
+        # 76-84G, with n20+ falling off again (h=24 recompute)
         j2 = (jmax + 2) // 2
         whole = -(-j2 // _align(dtype)) * _align(dtype)
-        block_rows_q = max(_align(dtype), h, min(64, whole))
+        base = 64 if n_inner < 12 else 128
+        block_rows_q = max(_align(dtype), h, min(base, whole))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     _check_dtype(dtype, interpret)
@@ -765,6 +834,18 @@ def make_rb_iter_tblock_quarters(
     w2p = -(-i2 // LANE) * LANE
     nblocks = -(-j2 // block_rows_q)
     rp = nblocks * block_rows_q + 2 * h
+    itemsize = jnp.dtype(dtype).itemsize
+    if not quarters_feasible(block_rows_q, h, w2p, itemsize):
+        raise ValueError(
+            f"quarters scratch {quarters_vmem_bytes(block_rows_q, h, w2p, itemsize) >> 20} MiB "
+            f"exceeds the VMEM budget (brq={block_rows_q}, h={h}, "
+            f"w2p={w2p}); reduce tpu_sor_inner or use tpu_sor_layout "
+            "checkerboard"
+        )
+    # bf16 storage iterates in f32 (see docstring); f32/f64 compute as stored
+    bf16 = jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16)
+    compute_dtype = jnp.float32 if bf16 else None
+    acc_dtype = jnp.float32 if bf16 else dtype
     kernel = functools.partial(
         _tblock_quarters_kernel,
         n_inner=n_inner,
@@ -776,6 +857,7 @@ def make_rb_iter_tblock_quarters(
         factor=omega * 0.5 * (dx2 * dy2) / (dx2 + dy2),
         idx2=1.0 / dx2,
         idy2=1.0 / dy2,
+        compute_dtype=compute_dtype,
     )
     call = pl.pallas_call(
         kernel,
@@ -787,13 +869,13 @@ def make_rb_iter_tblock_quarters(
         ],
         out_shape=[
             jax.ShapeDtypeStruct((4, rp, w2p), dtype),
-            jax.ShapeDtypeStruct((1, 1), dtype),
+            jax.ShapeDtypeStruct((1, 1), acc_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((2, 4, block_rows_q + 2 * h, w2p), dtype),
             pltpu.VMEM((2, 4, block_rows_q + 2 * h, w2p), dtype),
             pltpu.VMEM((2, 4, block_rows_q, w2p), dtype),
-            pltpu.VMEM((1, w2p), dtype),
+            pltpu.VMEM((1, w2p), acc_dtype),
             pltpu.SemaphoreType.DMA((2, 8)),
             pltpu.SemaphoreType.DMA((2, 4)),
         ],

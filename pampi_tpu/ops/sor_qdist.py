@@ -25,21 +25,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..parallel.quarters_dist import QGeom, SLOT_PARITY
-from .sor_pallas import VMEM_LIMIT_BYTES, _check_dtype, pltpu
-
-
-def quarters_vmem_bytes(brq: int, h: int, w2p: int, itemsize: int) -> int:
-    """Scratch bytes of the (distributed or single-device) quarters kernel:
-    double-buffered p and rhs windows, out bands, per-lane accumulator."""
-    win = 2 * 4 * (brq + 2 * h) * w2p
-    return itemsize * (2 * win + 2 * 4 * brq * w2p + w2p)
-
-
-def quarters_feasible(brq: int, h: int, w2p: int, itemsize: int) -> bool:
-    """VMEM-feasibility guard (mirrors the octant accounting the 3-D kernel
-    has): the scratch set must fit the raised compile limit with headroom
-    for Mosaic's own temporaries."""
-    return quarters_vmem_bytes(brq, h, w2p, itemsize) <= VMEM_LIMIT_BYTES // 2
+from .sor_pallas import (
+    VMEM_LIMIT_BYTES,
+    _check_dtype,
+    pltpu,
+    quarters_feasible,
+    quarters_vmem_bytes,
+)
 
 
 def _qdist_kernel(
