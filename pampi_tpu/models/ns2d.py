@@ -102,10 +102,10 @@ class NS2DSolver:
         # flag-field obstacles (ops/obstacle.py): static geometry -> static
         # masks baked into the traced step as constants (branch-free)
         if param.obstacles.strip():
-            if param.tpu_solver in ("mg", "fft"):
+            if param.tpu_solver == "fft":
                 raise ValueError(
-                    f"tpu_solver {param.tpu_solver} does not support "
-                    "obstacle flag fields; use tpu_solver sor"
+                    "tpu_solver fft cannot solve obstacle flag fields (the "
+                    "stencil is not constant-coefficient); use sor or mg"
                 )
             validate_obstacle_layout(param.tpu_sor_layout)
             from ..ops import obstacle as obst
@@ -149,6 +149,16 @@ class NS2DSolver:
                 n_inner=param.tpu_sor_inner,
                 solver=param.tpu_solver,
                 layout=param.tpu_sor_layout,
+            )
+        elif param.tpu_solver == "mg":
+            # obstacle-capable multigrid: rediscretized eps-coefficient
+            # operator per level (ops/multigrid.make_obstacle_mg_solve_2d) —
+            # the O(1)-cycles option fft cannot provide here
+            from ..ops.multigrid import make_obstacle_mg_solve_2d
+
+            solve = make_obstacle_mg_solve_2d(
+                param.imax, param.jmax, dx, dy, param.eps, param.itermax,
+                masks, dtype,
             )
         else:
             from ..ops import obstacle as obst

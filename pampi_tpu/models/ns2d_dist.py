@@ -102,7 +102,9 @@ class NS2DDistSolver:
             if param.tpu_solver in ("mg", "fft"):
                 raise ValueError(
                     f"tpu_solver {param.tpu_solver} does not support "
-                    "obstacle flag fields; use tpu_solver sor"
+                    "obstacle flag fields on a mesh; distributed obstacle "
+                    "runs use tpu_solver sor (obstacle multigrid is "
+                    "single-device, ops/multigrid.make_obstacle_mg_solve_2d)"
                 )
             from ..ops import obstacle as obst
 

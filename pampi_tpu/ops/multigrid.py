@@ -51,6 +51,57 @@ def mg_levels(*extents, min_size: int = 4):
     return levels
 
 
+# Relative-change stall tolerance for the MG convergence loops. Some
+# production solves CANNOT reach eps: the canal configs' outflow BCs make
+# the Neumann RHS inconsistent, so the residual floors at the inconsistency
+# (the reference's own canal solves are itermax-capped for the same reason,
+# tests/test_ns2d.py), and f32 runs floor at round-off. SOR creeps toward
+# such floors slowly enough that capping is the only option, but a V-cycle
+# CONTRACTS by ~10x per cycle until the floor and then flatlines — so a
+# stalled residual IS convergence-to-floor, and burning the remaining
+# itermax cycles (500 cycles x ~2 ms at 2048x512) is pure waste. The loop
+# stops when the residual changed less than MG_STALL_RTOL relative over one
+# cycle; a genuinely converging cycle changes it ~10x, so the detector
+# cannot mistake progress for a stall.
+MG_STALL_RTOL = 1e-4
+
+
+def _mg_converge_loop(vcycle, residual_of, norm, eps, itermax, dtype):
+    """The shared MG convergence loop: `(p, rhs) -> (p, res, it)` with the
+    SOR solve contract PLUS the stall detector above. `residual_of(p, rhs)`
+    returns the interior residual array of the fine level."""
+    epssq = eps * eps
+
+    def solve(p, rhs):
+        def cond(c):
+            p, res, prev, it = c
+            stalled = jnp.logical_and(
+                it >= 2, jnp.abs(prev - res) <= MG_STALL_RTOL * res
+            )
+            return jnp.logical_and(
+                jnp.logical_and(res >= epssq, it < itermax),
+                jnp.logical_not(stalled),
+            )
+
+        def body(c):
+            p, prev_res, _, it = c
+            p = vcycle(p, rhs)
+            r = residual_of(p, rhs)
+            res = jnp.sum(r * r) / norm
+            if _flags.debug():
+                jax.debug.print("{} Residuum: {}", it, res)  # it = V-cycle
+            return p, res, prev_res, it + 1
+
+        p, res, _, it = lax.while_loop(
+            cond, body,
+            (p, jnp.asarray(1.0, dtype), jnp.asarray(jnp.inf, dtype),
+             jnp.asarray(0, jnp.int32)),
+        )
+        return p, res, it
+
+    return solve
+
+
 # ----------------------------------------------------------------------
 # 2-D components (arrays are extended (j+2, i+2), ghosts included)
 # ----------------------------------------------------------------------
@@ -169,28 +220,10 @@ def make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype,
     and `it` counts V-cycles."""
     vcycle = make_mg_vcycle_2d(imax, jmax, dx, dy, dtype, n_pre, n_post)
     idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
-    norm = float(imax * jmax)
-    epssq = eps * eps
-
-    def solve(p, rhs):
-        def cond(c):
-            _, res, it = c
-            return jnp.logical_and(res >= epssq, it < itermax)
-
-        def body(c):
-            p, _, it = c
-            p = vcycle(p, rhs)
-            r = _residual2(p, rhs, idx2, idy2)
-            res = jnp.sum(r * r) / norm
-            if _flags.debug():
-                jax.debug.print("{} Residuum: {}", it, res)  # it = V-cycle
-            return p, res, it + 1
-
-        return lax.while_loop(
-            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
-        )
-
-    return solve
+    return _mg_converge_loop(
+        vcycle, lambda p, rhs: _residual2(p, rhs, idx2, idy2),
+        float(imax * jmax), eps, itermax, dtype,
+    )
 
 
 # ----------------------------------------------------------------------
@@ -290,28 +323,121 @@ def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
     idx2 = 1.0 / (dx * dx)
     idy2 = 1.0 / (dy * dy)
     idz2 = 1.0 / (dz * dz)
-    norm = float(imax * jmax * kmax)
-    epssq = eps * eps
+    return _mg_converge_loop(
+        vcycle, lambda p, rhs: _residual3(p, rhs, idx2, idy2, idz2),
+        float(imax * jmax * kmax), eps, itermax, dtype,
+    )
 
-    def solve(p, rhs):
-        def cond(c):
-            _, res, it = c
-            return jnp.logical_and(res >= epssq, it < itermax)
 
-        def body(c):
-            p, _, it = c
-            p = vcycle(p, rhs)
-            r = _residual3(p, rhs, idx2, idy2, idz2)
-            res = jnp.sum(r * r) / norm
-            if _flags.debug():
-                jax.debug.print("{} Residuum: {}", it, res)
-            return p, res, it + 1
+# ----------------------------------------------------------------------
+# Obstacle multigrid (2-D): the O(1)-cycles solver for the flag-masked
+# configs where the DCT direct solve is unavailable (non-constant
+# coefficients). Geometry coarsens by fluid-ANY (a coarse cell is fluid if
+# any of its 2x2 fine cells is), and every level REDISCRETIZES the obstacle
+# operator from its own flag field (ops/obstacle.make_masks with ω=1), so
+# smoothing, residual, and the bottom solve all run the same per-direction
+# eps-coefficient stencil as the fine-level SOR solver. The bottom level has
+# no DCT (obstacles!), so it is smoothed to death with an unrolled sweep
+# block — at the bottom extents (≤ ~2·min_size per axis) that is cheap and
+# exact enough for the V-cycle contract.
+# ----------------------------------------------------------------------
 
-        return lax.while_loop(
-            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+
+def coarsen_fluid(fluid: "np.ndarray") -> "np.ndarray":
+    """(J+2, I+2) bool fluid flags -> coarse (J/2+2, I/2+2): interior cell
+    fluid iff ANY of its 2x2 fine cells is fluid (keeps narrow channels
+    open — the conservative choice for convergence near blocky obstacles);
+    the ghost ring stays fluid like the fine level's."""
+    import numpy as np
+
+    fi = fluid[1:-1, 1:-1]
+    J, I = fi.shape
+    blocks = fi.reshape(J // 2, 2, I // 2, 2)
+    ci = blocks.any(axis=(1, 3))
+    out = np.ones((J // 2 + 2, I // 2 + 2), dtype=bool)
+    out[1:-1, 1:-1] = ci
+    return out
+
+
+def _obstacle_residual(p, rhs, m, idx2, idy2):
+    """Residual of the eps-coefficient operator over fluid interior cells
+    (sor_pass_obstacle arithmetic without the update)."""
+    c = p[1:-1, 1:-1]
+    lap = (
+        m.eps_e * (p[1:-1, 2:] - c) + m.eps_w * (p[1:-1, :-2] - c)
+    ) * idx2 + (
+        m.eps_n * (p[2:, 1:-1] - c) + m.eps_s * (p[:-2, 1:-1] - c)
+    ) * idy2
+    return (rhs[1:-1, 1:-1] - lap) * m.p_mask
+
+
+def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
+                              n_pre: int = 2, n_post: int = 2,
+                              n_coarse: int = 60):
+    """Obstacle-capable MG convergence loop:
+    `(p_ext, rhs_ext) -> (p_ext, res, it)`, `it` counting V-cycles, residual
+    normalized by the FLUID cell count (the contract of
+    ops/obstacle.make_obstacle_solver_fn). `masks` is the fine-level
+    ObstacleMasks built with the run's ω — smoothing rebuilds every level at
+    ω=1 from the coarsened flags."""
+    import numpy as np
+
+    from .obstacle import make_masks
+    from .sor import checkerboard_mask
+
+    levels = mg_levels(jmax, imax)
+    fine_fluid = np.asarray(masks.fluid).astype(bool)
+    cfg = []
+    fluid = fine_fluid
+    for lvl, (jl, il) in enumerate(levels):
+        dxl, dyl = dx * (2 ** lvl), dy * (2 ** lvl)
+        if lvl > 0:
+            fluid = coarsen_fluid(fluid)
+        cfg.append(
+            dict(
+                m=make_masks(fluid, dxl, dyl, 1.0, dtype),  # ω=1 smoother
+                idx2=1.0 / (dxl * dxl),
+                idy2=1.0 / (dyl * dyl),
+                red=checkerboard_mask(jl, il, 0, dtype),
+                black=checkerboard_mask(jl, il, 1, dtype),
+            )
         )
 
-    return solve
+    from .obstacle import sor_pass_obstacle
+
+    def smooth(p, rhs, lvl, n):
+        c = cfg[lvl]
+        for _ in range(n):
+            p, _ = sor_pass_obstacle(
+                p, rhs, c["red"], c["m"], c["idx2"], c["idy2"]
+            )
+            p, _ = sor_pass_obstacle(
+                p, rhs, c["black"], c["m"], c["idx2"], c["idy2"]
+            )
+            p = _neumann2(p)
+        return p
+
+    def vcycle(p, rhs, lvl=0):
+        c = cfg[lvl]
+        if lvl == len(cfg) - 1:
+            return smooth(p, rhs, lvl, n_coarse)
+        p = smooth(p, rhs, lvl, n_pre)
+        r = _obstacle_residual(p, rhs, c["m"], c["idx2"], c["idy2"])
+        r2 = _restrict2(r)
+        e2 = vcycle(_embed2(jnp.zeros_like(r2)), _embed2(r2), lvl + 1)
+        # inject into fluid cells only (obstacle cells stay untouched)
+        p = p.at[1:-1, 1:-1].add(_prolong2(e2[1:-1, 1:-1]) * c["m"].p_mask)
+        p = _neumann2(p)
+        return smooth(p, rhs, lvl, n_post)
+
+    fine = cfg[0]
+    return _mg_converge_loop(
+        vcycle,
+        lambda p, rhs: _obstacle_residual(
+            p, rhs, fine["m"], fine["idx2"], fine["idy2"]
+        ),
+        float(fine["m"].n_fluid), eps, itermax, dtype,
+    )
 
 
 # ----------------------------------------------------------------------
@@ -410,19 +536,30 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
 
     def solve(p, rhs):
         def cond(c):
-            _, res, it = c
-            return jnp.logical_and(res >= epssq, it < itermax)
+            _, res, prev, it = c
+            # same stall detector as _mg_converge_loop (MG_STALL_RTOL):
+            # floored residuals mean convergence-to-floor, stop burning
+            # cycles — identical stopping contract to the single-device loop
+            stalled = jnp.logical_and(
+                it >= 2, jnp.abs(prev - res) <= MG_STALL_RTOL * res
+            )
+            return jnp.logical_and(
+                jnp.logical_and(res >= epssq, it < itermax),
+                jnp.logical_not(stalled),
+            )
 
         def body(c):
-            p, _, it = c
+            p, prev, _, it = c
             p = vcycle(p, rhs)
             p = halo_exchange(p, comm)
             r = _residual2(p, rhs, idx2, idy2)
             res = reduction(jnp.sum(r * r), comm, "sum") / norm
-            return p, res, it + 1
+            return p, res, prev, it + 1
 
-        p, res, it = lax.while_loop(
-            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        p, res, _, it = lax.while_loop(
+            cond, body,
+            (p, jnp.asarray(1.0, dtype), jnp.asarray(jnp.inf, dtype),
+             jnp.asarray(0, jnp.int32)),
         )
         # the body returns p freshly exchanged; this trailing exchange only
         # matters on the zero-trip path (eps >= 1 skips the loop) and costs
@@ -513,19 +650,27 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
 
     def solve(p, rhs):
         def cond(c):
-            _, res, it = c
-            return jnp.logical_and(res >= epssq, it < itermax)
+            _, res, prev, it = c
+            stalled = jnp.logical_and(
+                it >= 2, jnp.abs(prev - res) <= MG_STALL_RTOL * res
+            )
+            return jnp.logical_and(
+                jnp.logical_and(res >= epssq, it < itermax),
+                jnp.logical_not(stalled),
+            )
 
         def body(c):
-            p, _, it = c
+            p, prev, _, it = c
             p = vcycle(p, rhs)
             p = halo_exchange(p, comm)
             r = _residual3(p, rhs, idx2, idy2, idz2)
             res = reduction(jnp.sum(r * r), comm, "sum") / norm
-            return p, res, it + 1
+            return p, res, prev, it + 1
 
-        p, res, it = lax.while_loop(
-            cond, body, (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        p, res, _, it = lax.while_loop(
+            cond, body,
+            (p, jnp.asarray(1.0, dtype), jnp.asarray(jnp.inf, dtype),
+             jnp.asarray(0, jnp.int32)),
         )
         # zero-trip safety; see the 2-D twin
         return halo_exchange(p, comm), res, it

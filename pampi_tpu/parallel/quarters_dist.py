@@ -87,8 +87,12 @@ def make_qgeom(jmax, imax, jl, il, n, dtype, brq: int | None = None) -> QGeom:
     jq = jl // 2 + 2 * n + 1
     iq = il // 2 + 2 * n + 1
     if brq is None:
+        # same depth-aware policy as the single-device maker: deeper
+        # temporal blocking wants taller blocks to amortize halo recompute
+        # (sor_pallas.make_rb_iter_tblock_quarters round-3 sweep)
         whole = -(-jq // a) * a
-        brq = max(a, h, min(64, whole))
+        base = 64 if n < 12 else 128
+        brq = max(a, h, min(base, whole))
     nblocks = -(-jq // brq)
     rp = nblocks * brq + 2 * h
     w2p = -(-iq // sp.LANE) * sp.LANE

@@ -71,21 +71,26 @@ class Parameter:
     # iterations, so a solve may overshoot by up to tpu_sor_inner-1
     # iterations (jnp paths always step singly). 4 measured fastest on v5e.
     tpu_sor_inner: int = 4
-    # single-device pallas SOR layout:
-    #   "auto"         quarter decomposition when eligible (even imax/jmax —
-    #                  2.25× the checkerboard at 4096² f32 on v5e; per-cell
-    #                  arithmetic ulp-equivalent, ops/sor_quarters.py),
-    #                  else checkerboard
+    # pallas SOR layout (single-device AND per-shard distributed):
+    #   "auto"         quarter (2-D) / octant (3-D) decomposition when
+    #                  eligible (even extents — ~3× the checkerboard kernel
+    #                  at 4096² f32 on v5e; per-cell arithmetic
+    #                  ulp-equivalent, ops/sor_quarters.py/sor_octants.py),
+    #                  else checkerboard. The distributed solvers dispatch
+    #                  the same kernels per shard between CA exchanges
+    #                  (parallel/quarters_dist.py, octants_dist.py).
     #   "checkerboard" the masked kernel (per-cell trajectory numerically
     #                  IDENTICAL to the jnp reference path)
-    #   "quarters"     force quarters (error when ineligible)
+    #   "quarters"/"octants"  force the compressed layout (error when
+    #                  ineligible; off-TPU runs the interpret kernel/twin)
     tpu_sor_layout: str = "auto"
     # communication-avoiding depth of the DISTRIBUTED red-black solve
     # (parallel/stencil2d.ca_rb_iters): n exact iterations computed locally
     # per depth-2n halo exchange; convergence is checked every n iterations
     # (same overshoot semantics as tpu_sor_inner). n is clamped so 2n never
     # exceeds a shard extent; 1 keeps today's per-iteration trajectory
-    # granularity while still halving the message count.
+    # granularity while still halving the message count. The distributed
+    # quarters/octants kernel paths use max(tpu_ca_inner, tpu_sor_inner).
     tpu_ca_inner: int = 1
     # pressure/elliptic solver:
     #   "sor"  the reference's algorithm (default; trajectory parity)

@@ -29,18 +29,23 @@ N=$1; shift
 # script's lifetime (fd 9), so concurrent launches on one host can never pick
 # the same port (bind-and-release alone is a TOCTOU race). The bind probe
 # only filters ports busied by unrelated processes.
+# Base port/range overridable for operators who must move off the
+# contended default (29500 is also torch.distributed's well-known default):
+# PAMPI_PORT_BASE=<port> [PAMPI_PORT_RANGE=<n>] (round-2 advisor finding)
+PORT_BASE=${PAMPI_PORT_BASE:-29500}
+PORT_RANGE=${PAMPI_PORT_RANGE:-64}
 if [ -z "${PAMPI_COORDINATOR:-}" ]; then
     if command -v flock >/dev/null 2>&1; then
         PORT=""
-        for slot in $(seq 0 63); do
-            CAND=$(( 29500 + slot ))
+        for slot in $(seq 0 $(( PORT_RANGE - 1 ))); do
+            CAND=$(( PORT_BASE + slot ))
             exec 9> "${TMPDIR:-/tmp}/pampi-port-$CAND.lock"
             if flock -n 9 && python -c "import socket; s=socket.socket(); s.bind(('127.0.0.1',$CAND)); s.close()" 2>/dev/null; then
                 PORT=$CAND; break
             fi
             exec 9>&-
         done
-        [ -n "$PORT" ] || { echo "launch-multihost.sh: no free coordinator port in 29500-29563" >&2; exit 1; }
+        [ -n "$PORT" ] || { echo "launch-multihost.sh: no free coordinator port in $PORT_BASE-$(( PORT_BASE + PORT_RANGE - 1 )) (override with PAMPI_PORT_BASE/PAMPI_PORT_RANGE)" >&2; exit 1; }
     else
         # no flock on this host: fall back to bind-and-release (racy only
         # against concurrent launches in the same instant)

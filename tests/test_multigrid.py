@@ -154,15 +154,17 @@ def test_ns3d_mg_matches_sor_run():
                                rtol=0, atol=1e-4)
 
 
-def test_mg_obstacles_rejected():
+def test_obstacle_solver_dispatch_rules():
+    """fft structurally cannot solve flag fields; mg now can (round 3)."""
     from pampi_tpu.models.ns2d import NS2DSolver
 
     param = Parameter(
         name="canal", imax=32, jmax=16, re=100.0, te=1.0,
-        obstacles="0.3,0.2,0.5,0.4", tpu_solver="mg",
+        obstacles="0.3,0.2,0.5,0.4", tpu_solver="fft",
     )
     with pytest.raises(ValueError, match="obstacle"):
         NS2DSolver(param)
+    NS2DSolver(param.replace(tpu_solver="mg"))  # builds
 
 
 # ---------------------------------------------------------------------
@@ -243,3 +245,70 @@ def test_dist_mg_ns2d_matches_single_mg(reference_dir):
     pi = pd[1:-1, 1:-1]
     np.testing.assert_allclose(pa - pa.mean(), pi - pi.mean(),
                                rtol=0, atol=1e-4)
+
+
+def test_obstacle_mg_matches_sor_and_converges_fast():
+    """Obstacle-capable MG (make_obstacle_mg_solve_2d): rediscretized
+    eps-coefficient operator per level, fluid-ANY flag coarsening. Must
+    agree with the obstacle SOR solver's converged field and get there in
+    O(10) cycles where SOR needs O(10^4) sweeps (VERDICT r2 item 5)."""
+    import jax
+
+    from pampi_tpu.ops import obstacle as obst
+    from pampi_tpu.ops.multigrid import make_obstacle_mg_solve_2d
+
+    imax, jmax = 128, 64
+    xl, yl = 16.0, 4.0
+    dx, dy = xl / imax, yl / jmax
+    fluid = obst.build_fluid(imax, jmax, dx, dy, "3.0,1.5,4.0,2.5")
+    m = obst.make_masks(fluid, dx, dy, 1.7, jnp.float64)
+    rng = np.random.default_rng(0)
+    rhs_i = rng.standard_normal((jmax, imax)) * np.asarray(m.p_mask)
+    rhs_i -= rhs_i.sum() / m.n_fluid * np.asarray(m.p_mask)  # compatible
+    rhs = jnp.zeros((jmax + 2, imax + 2)).at[1:-1, 1:-1].set(
+        jnp.asarray(rhs_i)
+    )
+    p0 = jnp.zeros((jmax + 2, imax + 2))
+
+    mg = jax.jit(make_obstacle_mg_solve_2d(
+        imax, jmax, dx, dy, 1e-8, 100, m, jnp.float64
+    ))
+    p_mg, res_mg, it_mg = mg(p0, rhs)
+    assert int(it_mg) <= 30, int(it_mg)
+    assert float(res_mg) < 1e-16
+
+    sor = jax.jit(obst.make_obstacle_solver_fn(
+        imax, jmax, dx, dy, 1e-8, 200000, m, jnp.float64, backend="jnp"
+    ))
+    p_s, _, it_s = sor(p0, rhs)
+    # the O(1)-cycles claim with fixed floors (a coupled ratio would fail
+    # on a one-cycle platform difference): MG O(10), SOR O(10^4)
+    assert int(it_s) > 10_000
+
+    pm = np.asarray(p_mg)[1:-1, 1:-1]
+    ps = np.asarray(p_s)[1:-1, 1:-1]
+    mask = np.asarray(m.p_mask) > 0
+    d = (pm - pm[mask].mean()) - (ps - ps[mask].mean())
+    assert np.abs(d[mask]).max() < 1e-6
+
+
+def test_obstacle_mg_in_ns2d_step():
+    """tpu_solver mg accepts obstacle configs in the NS-2D model. The
+    comparison config must have CONVERGING pressure solves (canal's floor
+    above eps would leave both paths itermax-capped and incomparable), so:
+    an obstructed lid-driven cavity at eps=1e-3."""
+    from pampi_tpu.models.ns2d import NS2DSolver
+
+    param = Parameter(
+        name="dcavity", imax=64, jmax=64, re=10.0, te=0.05, tau=0.5,
+        itermax=500, eps=1e-3, omg=1.7, gamma=0.9,
+        obstacles="0.35,0.35,0.65,0.65",
+    )
+    s_mg = NS2DSolver(param.replace(tpu_solver="mg"))
+    s_mg.run(progress=False)
+    s_sor = NS2DSolver(param.replace(tpu_solver="sor"))
+    s_sor.run(progress=False)
+    assert s_mg.nt == s_sor.nt > 1
+    np.testing.assert_allclose(
+        np.asarray(s_mg.u), np.asarray(s_sor.u), atol=2e-4, rtol=0
+    )

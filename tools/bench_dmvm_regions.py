@@ -1,0 +1,76 @@
+"""DMVM per-region counter sweeps — the TPU twin of the reference's perl
+likwid-mpirun scripts' ACTUAL job (assignment-3a/perl scripts/
+bench-node.pl:17-27, bench-cluster.pl, bench-memdomain.pl: hardware-counter
+runs of the DMVM region over the (N, NITER) grids at several rank counts).
+
+Emits results/regions/dmvm-node.csv (single device, SequentialDMVM — the
+per-node counter run) and, when more than one device is visible,
+results/regions/dmvm-mesh.csv (RingDMVM over all devices — the cluster
+twin), with COMPLETE columns:
+
+    Ranks,NITER,N,region,calls,wall_s,device_s,MFlops
+
+wall_s is the dispatch wall time to completion (scalar-fenced), device_s the
+same quantity (the measurement is device-inclusive by construction — the
+meaning the reference's likwid wall/counter pair degenerates to on a TPU),
+MFlops = 2 N^2 iter / wall / 1e6 (main.c:93-95).
+
+NITER is divided by SCALE (default 10; iteration-invariant metric) like the
+bash twins' convention (scripts/bench-node.sh).
+
+Usage: python tools/bench_dmvm_regions.py [SCALE] [outdir]
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+GRID = [(1000, 1_000_000), (4000, 100_000), (10000, 10_000), (20000, 5_000)]
+
+
+def sweep(kind: str, scale: int):
+    from pampi_tpu.models.dmvm import RingDMVM, SequentialDMVM
+
+    rows = []
+    for n, niter in GRID:
+        iters = max(1, niter // scale)
+        if kind == "node":
+            ranks = 1
+            model = SequentialDMVM(n)
+            _y, wall = model.run(iters)
+            mflops = 1e-6 * 2.0 * n * n * iters / wall
+        else:
+            ranks = len(jax.devices())
+            model = RingDMVM(n, overlap=True)
+            _y, wall, mflops = model.run(iters)
+        rows.append((ranks, iters, n, "dmvm", 1, wall, wall, mflops))
+        print(f"{kind}: N={n} iters={iters} ranks={ranks} "
+              f"wall={wall:.3f}s {mflops:.0f} MFlops")
+    return rows
+
+
+def write_csv(path: str, rows) -> None:
+    with open(path, "w") as fh:
+        fh.write("Ranks,NITER,N,region,calls,wall_s,device_s,MFlops\n")
+        for r in rows:
+            fh.write(
+                f"{r[0]},{r[1]},{r[2]},{r[3]},{r[4]},"
+                f"{r[5]:.6f},{r[6]:.6f},{r[7]:.2f}\n"
+            )
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    outdir = sys.argv[2] if len(sys.argv) > 2 else os.path.join(
+        REPO, "results", "regions"
+    )
+    os.makedirs(outdir, exist_ok=True)
+    write_csv(os.path.join(outdir, "dmvm-node.csv"), sweep("node", scale))
+    if len(jax.devices()) > 1:
+        write_csv(os.path.join(outdir, "dmvm-mesh.csv"),
+                  sweep("mesh", scale))

@@ -94,6 +94,17 @@ def _time(fn, *args):
         if kc > kb * 2:
             tc = _loop_timer(fn, kc, *args)
             per = max((tc - ta) / (kc - ka), 1e-9)
+        else:
+            # the refinement could not run (the wall cap already bounds the
+            # chain): the estimate comes from a < 0.3 s two-point difference
+            # the code itself classifies as jitter-dominated — say so
+            # instead of recording it silently (round-2 advisor finding)
+            print(
+                f"# WARNING: low-confidence estimate "
+                f"(jitter-dominated {per * (kb - ka):.3f}s difference, "
+                f"refinement infeasible at kc={kc} <= 2*kb={2 * kb})",
+                file=sys.stderr,
+            )
     return per
 
 
