@@ -130,7 +130,16 @@ class NS2DSolver:
         return _use_pallas(self._backend, self.dtype)
 
     # -- one full timestep, traced ------------------------------------
-    def _build_step(self, backend: str = "auto"):
+    def _build_step(self, backend: str = "auto", instrumented: bool = False):
+        """One traced timestep. instrumented=True returns the SAME pipeline
+        with the pressure solve's discarded outputs exposed —
+        (u, v, p, t, nt, res, it, dt) — so measurement tools
+        (tools/northstar.py, tools/perf_obstacle_mg.py) can sample solver
+        iteration counts without hand-copying the step wiring (which would
+        silently diverge when this pipeline changes)."""
+        return self._build_step_impl(backend, instrumented)
+
+    def _build_step_impl(self, backend: str, instrumented: bool):
         param = self.param
         dx, dy = self.dx, self.dy
         dtype = self.dtype
@@ -207,7 +216,7 @@ class NS2DSolver:
                     lambda q: q,
                     p,
                 )
-            p, _res, _it = solve(p, rhs)
+            p, res, it = solve(p, rhs)
             if masks is None:
                 u, v = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
             else:
@@ -222,6 +231,8 @@ class NS2DSolver:
                 # ≙ -DVERBOSE "TIME %f , TIMESTEP %f" printed AFTER t += dt
                 # (A5 main.c:52-57)
                 jax.debug.print("TIME {} , TIMESTEP {}", t_next, dt)
+            if instrumented:
+                return u, v, p, t_next, nt + 1, res, it, dt
             return u, v, p, t_next, nt + 1
 
         return step

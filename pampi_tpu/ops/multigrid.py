@@ -66,6 +66,14 @@ def mg_levels(*extents, min_size: int = 4):
 MG_STALL_RTOL = 1e-4
 
 
+def _stalled(prev, res, it):
+    """The stall predicate — single home; the single-device and distributed
+    loops share it so their stopping contracts cannot drift."""
+    return jnp.logical_and(
+        it >= 2, jnp.abs(prev - res) <= MG_STALL_RTOL * res
+    )
+
+
 def _mg_converge_loop(vcycle, residual_of, norm, eps, itermax, dtype):
     """The shared MG convergence loop: `(p, rhs) -> (p, res, it)` with the
     SOR solve contract PLUS the stall detector above. `residual_of(p, rhs)`
@@ -75,12 +83,9 @@ def _mg_converge_loop(vcycle, residual_of, norm, eps, itermax, dtype):
     def solve(p, rhs):
         def cond(c):
             p, res, prev, it = c
-            stalled = jnp.logical_and(
-                it >= 2, jnp.abs(prev - res) <= MG_STALL_RTOL * res
-            )
             return jnp.logical_and(
                 jnp.logical_and(res >= epssq, it < itermax),
-                jnp.logical_not(stalled),
+                jnp.logical_not(_stalled(prev, res, it)),
             )
 
         def body(c):
@@ -360,15 +365,11 @@ def coarsen_fluid(fluid: "np.ndarray") -> "np.ndarray":
 
 
 def _obstacle_residual(p, rhs, m, idx2, idy2):
-    """Residual of the eps-coefficient operator over fluid interior cells
-    (sor_pass_obstacle arithmetic without the update)."""
-    c = p[1:-1, 1:-1]
-    lap = (
-        m.eps_e * (p[1:-1, 2:] - c) + m.eps_w * (p[1:-1, :-2] - c)
-    ) * idx2 + (
-        m.eps_n * (p[2:, 1:-1] - c) + m.eps_s * (p[:-2, 1:-1] - c)
-    ) * idy2
-    return (rhs[1:-1, 1:-1] - lap) * m.p_mask
+    """The shared eps-coefficient residual (ops/obstacle.obstacle_residual —
+    one home for the stencil, the smoother updates with the same values)."""
+    from .obstacle import obstacle_residual
+
+    return obstacle_residual(p, rhs, m, idx2, idy2)
 
 
 def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
@@ -537,15 +538,10 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
     def solve(p, rhs):
         def cond(c):
             _, res, prev, it = c
-            # same stall detector as _mg_converge_loop (MG_STALL_RTOL):
-            # floored residuals mean convergence-to-floor, stop burning
-            # cycles — identical stopping contract to the single-device loop
-            stalled = jnp.logical_and(
-                it >= 2, jnp.abs(prev - res) <= MG_STALL_RTOL * res
-            )
+            # _stalled: identical stopping contract to the single-device loop
             return jnp.logical_and(
                 jnp.logical_and(res >= epssq, it < itermax),
-                jnp.logical_not(stalled),
+                jnp.logical_not(_stalled(prev, res, it)),
             )
 
         def body(c):
@@ -651,12 +647,9 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
     def solve(p, rhs):
         def cond(c):
             _, res, prev, it = c
-            stalled = jnp.logical_and(
-                it >= 2, jnp.abs(prev - res) <= MG_STALL_RTOL * res
-            )
             return jnp.logical_and(
                 jnp.logical_and(res >= epssq, it < itermax),
-                jnp.logical_not(stalled),
+                jnp.logical_not(_stalled(prev, res, it)),
             )
 
         def body(c):

@@ -174,6 +174,19 @@ def apply_obstacle_velocity_bc(u, v, m: ObstacleMasks):
 
 # -- pressure: eps-coefficient SOR -----------------------------------------
 
+def obstacle_residual(p, rhs, m: ObstacleMasks, idx2, idy2):
+    """Interior residual of the eps-coefficient operator over fluid cells —
+    the single home of the obstacle stencil (sor_pass_obstacle updates with
+    it; ops/multigrid's obstacle V-cycle restricts it)."""
+    c = p[1:-1, 1:-1]
+    lap = (
+        m.eps_e * (p[1:-1, 2:] - c) + m.eps_w * (p[1:-1, :-2] - c)
+    ) * idx2 + (
+        m.eps_n * (p[2:, 1:-1] - c) + m.eps_s * (p[:-2, 1:-1] - c)
+    ) * idy2
+    return (rhs[1:-1, 1:-1] - lap) * m.p_mask
+
+
 def sor_pass_obstacle(p, rhs, color_mask, m: ObstacleMasks, idx2, idy2):
     """One masked half-sweep with per-direction fluid coefficients.
 
@@ -181,13 +194,7 @@ def sor_pass_obstacle(p, rhs, color_mask, m: ObstacleMasks, idx2, idy2):
     p -= (omega/denom) * r      (denom per cell, precomputed in m.factor;
                                  note m.factor already includes omega)
     restricted to `color_mask` ∩ fluid. Returns (p, sum of masked r²)."""
-    c = p[1:-1, 1:-1]
-    lap = (
-        m.eps_e * (p[1:-1, 2:] - c) + m.eps_w * (p[1:-1, :-2] - c)
-    ) * idx2 + (
-        m.eps_n * (p[2:, 1:-1] - c) + m.eps_s * (p[:-2, 1:-1] - c)
-    ) * idy2
-    r = (rhs[1:-1, 1:-1] - lap) * color_mask * m.p_mask
+    r = obstacle_residual(p, rhs, m, idx2, idy2) * color_mask
     p = p.at[1:-1, 1:-1].add(-m.factor * r)
     return p, jnp.sum(r * r)
 

@@ -45,6 +45,12 @@ def sweep(kind: str, scale: int):
             mflops = 1e-6 * 2.0 * n * n * iters / wall
         else:
             ranks = len(jax.devices())
+            if n % ranks:
+                # the ring block-shards x: skip non-divisible N (the CLI
+                # guards the same case, models/dmvm.main) instead of
+                # crashing the sweep after dmvm-node.csv is written
+                print(f"mesh: N={n} skipped (not divisible by R={ranks})")
+                continue
             model = RingDMVM(n, overlap=True)
             _y, wall, mflops = model.run(iters)
         rows.append((ranks, iters, n, "dmvm", 1, wall, wall, mflops))

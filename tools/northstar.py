@@ -121,8 +121,7 @@ def run4096(te: float = 0.15) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from pampi_tpu.models.ns2d import NS2DSolver, make_pressure_solve
-    from pampi_tpu.ops import ns2d as ops
+    from pampi_tpu.models.ns2d import NS2DSolver
     from pampi_tpu.utils.params import Parameter
 
     N = 4096
@@ -137,35 +136,17 @@ def run4096(te: float = 0.15) -> dict:
     steps = s.nt
     sites = N * N
 
-    # sampled window from the FINAL state: same ops pipeline, but the solve's
-    # iteration count and residual are kept (the production chunk loop
-    # discards them) — this measures, not assumes, iterations/step
-    solve = make_pressure_solve(
-        N, N, s.dx, s.dy, param.omg, param.eps, param.itermax, jnp.float32,
-        n_inner=param.tpu_sor_inner, solver=param.tpu_solver,
-        layout=param.tpu_sor_layout,
-    )
-
-    @jax.jit
-    def one(u, v, p):
-        dt = ops.compute_timestep(u, v, s.dt_bound, s.dx, s.dy, param.tau)
-        u, v = ops.set_boundary_conditions(
-            u, v, param.bcLeft, param.bcRight, param.bcBottom, param.bcTop
-        )
-        u = ops.set_special_bc_dcavity(u)
-        f, g = ops.compute_fg(
-            u, v, dt, param.re, param.gx, param.gy, param.gamma, s.dx, s.dy
-        )
-        rhs = ops.compute_rhs(f, g, dt, s.dx, s.dy)
-        p, res, it = solve(p, rhs)
-        u, v = ops.adapt_uv(u, v, f, g, p, dt, s.dx, s.dy)
-        return u, v, p, res, it, dt
-
+    # sampled window from the FINAL state: the PRODUCTION step with the
+    # solve's discarded outputs exposed (NS2DSolver._build_step
+    # instrumented=True) — measures, not assumes, iterations/step
+    step_i = jax.jit(s._build_step(instrumented=True))
     u, v, p = s.u, s.v, s.p
+    t = jnp.asarray(s.t, jnp.float32)
+    nt = jnp.asarray(s.nt, jnp.int32)
     iters, dts = [], []
     res = None
     for _ in range(20):
-        u, v, p, res, it, dt = one(u, v, p)
+        u, v, p, t, nt, res, it, dt = step_i(u, v, p, t, nt)
         iters.append(int(it))
         dts.append(float(dt))
     mean_it = sum(iters) / len(iters)

@@ -76,46 +76,18 @@ def measure_step_ms(solver: str) -> float:
 
 
 def sample_cycles() -> dict:
-    """Per-solve V-cycle counts and residuals over sampled steps (the
-    production chunk loop discards the solve's `it`)."""
-    from pampi_tpu.ops import ns2d as ops
-    from pampi_tpu.ops.multigrid import make_obstacle_mg_solve_2d
-    from pampi_tpu.ops.obstacle import (
-        adapt_uv_obstacle,
-        apply_obstacle_velocity_bc,
-        mask_fg,
-    )
-
+    """Per-solve V-cycle counts and residuals over sampled steps — the
+    PRODUCTION step with the solve's discarded outputs exposed
+    (NS2DSolver._build_step instrumented=True), so the record describes the
+    trajectory the shipped solver actually runs."""
     s, param = _build("mg")
-    solve = jax.jit(make_obstacle_mg_solve_2d(
-        param.imax, param.jmax, s.dx, s.dy, param.eps, param.itermax,
-        s.masks, jnp.float32,
-    ))
-
-    @jax.jit
-    def one(u, v, p):
-        dt = ops.compute_timestep(u, v, s.dt_bound, s.dx, s.dy, param.tau)
-        u, v = ops.set_boundary_conditions(
-            u, v, param.bcLeft, param.bcRight, param.bcBottom, param.bcTop
-        )
-        u = ops.set_special_bc_canal(u, s.dy, param.ylength, jnp.float32)
-        u, v = apply_obstacle_velocity_bc(u, v, s.masks)
-        f, g = ops.compute_fg(
-            u, v, dt, param.re, param.gx, param.gy, param.gamma, s.dx, s.dy
-        )
-        f, g = mask_fg(f, g, u, v, s.masks)
-        rhs = ops.compute_rhs(f, g, dt, s.dx, s.dy)
-        p, res, it = solve(p, rhs)
-        # the production projection for flag fields (models/ns2d.py) — the
-        # plain adapt_uv would write spurious obstacle-face velocities and
-        # skew the sampled dt/RHS trajectory
-        u, v = adapt_uv_obstacle(u, v, f, g, p, dt, s.dx, s.dy, s.masks)
-        return u, v, p, res, it
-
+    step_i = jax.jit(s._build_step(instrumented=True))
     u, v, p = s.u, s.v, s.p
+    t = jnp.asarray(0.0, jnp.float32)
+    nt = jnp.asarray(0, jnp.int32)
     cycles, residuals = [], []
     for _ in range(10):
-        u, v, p, res, it = one(u, v, p)
+        u, v, p, t, nt, res, it, _dt = step_i(u, v, p, t, nt)
         cycles.append(int(it))
         residuals.append(float(res))
     return {"cycles_per_solve": cycles, "final_residual": residuals[-1],
