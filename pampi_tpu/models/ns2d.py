@@ -137,9 +137,6 @@ class NS2DSolver:
         (tools/northstar.py, tools/perf_obstacle_mg.py) can sample solver
         iteration counts without hand-copying the step wiring (which would
         silently diverge when this pipeline changes)."""
-        return self._build_step_impl(backend, instrumented)
-
-    def _build_step_impl(self, backend: str, instrumented: bool):
         param = self.param
         dx, dy = self.dx, self.dy
         dtype = self.dtype

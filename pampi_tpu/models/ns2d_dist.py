@@ -261,7 +261,7 @@ class NS2DDistSolver:
             _dispatch.record(
                 "ns2d_dist",
                 "jnp_ca" if plain_sor else f"other_{param.tpu_solver}"
-                if self.masks is None else "obstacle_jnp",
+                if self.masks is None else "obstacle (see obstacle_dist)",
             )
 
         def _solve_sor_quarters(p, rhs):
@@ -314,7 +314,12 @@ class NS2DDistSolver:
             solve = make_dist_obstacle_solver(
                 comm, self.imax, self.jmax, jl, il, dx, dy,
                 param.eps, param.itermax, self.masks, dtype,
-                ca_n=param.tpu_ca_inner,
+                ca_n=param.tpu_ca_inner, sor_inner=param.tpu_sor_inner,
+            )
+            # the obstacle solver may have dispatched its per-shard Pallas
+            # kernel (recorded at build time): relax check_vma then
+            pallas_q = pallas_q or (
+                (_dispatch.last("obstacle_dist") or "").startswith("pallas")
             )
         elif rb_q is not None:
             solve = _solve_sor_quarters

@@ -333,21 +333,7 @@ def _tblock_kernel(
         fl = fw2[slot]
         red = red & (fl != 0)
         black = black & (fl != 0)
-        eps_e = jnp.roll(fl, -1, axis=1)
-        eps_w = jnp.roll(fl, 1, axis=1)
-        eps_n = jnp.roll(fl, -1, axis=0)
-        eps_s = jnp.roll(fl, 1, axis=0)
-        denom = (eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
-        fac = jnp.where(denom > 0, omega / denom, 0.0) * fl
-
-        def lap(x):
-            east = jnp.roll(x, -1, axis=1)
-            west = jnp.roll(x, 1, axis=1)
-            north = jnp.roll(x, -1, axis=0)
-            south = jnp.roll(x, 1, axis=0)
-            return (eps_e * (east - x) + eps_w * (west - x)) * idx2 + (
-                eps_n * (north - x) + eps_s * (south - x)
-            ) * idy2
+        fac, lap = masked_stencil_ops(fl, idx2, idy2, omega)
     else:
         fac = factor
 
@@ -360,17 +346,10 @@ def _tblock_kernel(
                 north - 2.0 * x + south
             ) * idy2
 
-    r_red = r_blk = None
-    for t in range(n_inner):
-        r_red = jnp.where(red, rw - lap(p), 0.0)
-        p = p - fac * r_red
-        r_blk = jnp.where(black, rw - lap(p), 0.0)
-        p = p - fac * r_blk
-        # Neumann ghost refresh (walls only; corners/dead padding untouched)
-        p = jnp.where(row_ghost_lo, jnp.roll(p, -1, axis=0), p)
-        p = jnp.where(row_ghost_hi, jnp.roll(p, 1, axis=0), p)
-        p = jnp.where(col_ghost_lo, jnp.roll(p, -1, axis=1), p)
-        p = jnp.where(col_ghost_hi, jnp.roll(p, 1, axis=1), p)
+    p, r_red, r_blk = rb_inner_sweeps(
+        p, rw, n_inner, red, black, fac, lap,
+        (row_ghost_lo, row_ghost_hi, col_ghost_lo, col_ghost_hi),
+    )
 
     @pl.when(b >= 2)
     def _():
@@ -403,6 +382,52 @@ def tblock_halo(n_inner: int, dtype) -> int:
     rounded up to the DMA sublane alignment."""
     a = _align(dtype)
     return max(a, -(-(2 * n_inner) // a) * a)
+
+
+def masked_stencil_ops(fl, idx2, idy2, omega):
+    """(fac, lap) for the flag-masked (obstacle) stencil, derived from a
+    0/1 flag window — the SINGLE home of the eps-coefficient kernel math
+    (used by _tblock_kernel's masked mode and the distributed
+    ops/sor_obsdist kernel; flag values are identical on every shard that
+    sees a cell, so sharing this keeps the two term-for-term identical).
+    Arithmetic matches ops/obstacle.sor_pass_obstacle."""
+    eps_e = jnp.roll(fl, -1, axis=1)
+    eps_w = jnp.roll(fl, 1, axis=1)
+    eps_n = jnp.roll(fl, -1, axis=0)
+    eps_s = jnp.roll(fl, 1, axis=0)
+    denom = (eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
+    fac = jnp.where(denom > 0, omega / denom, 0.0) * fl
+
+    def lap(x):
+        east = jnp.roll(x, -1, axis=1)
+        west = jnp.roll(x, 1, axis=1)
+        north = jnp.roll(x, -1, axis=0)
+        south = jnp.roll(x, 1, axis=0)
+        return (eps_e * (east - x) + eps_w * (west - x)) * idx2 + (
+            eps_n * (north - x) + eps_s * (south - x)
+        ) * idy2
+
+    return fac, lap
+
+
+def rb_inner_sweeps(p, rw, n_inner, red, black, fac, lap, ghosts):
+    """The fused red-black inner loop + per-iteration Neumann ghost refresh
+    shared by every 2-D checkerboard-layout kernel (single-device
+    _tblock_kernel and distributed _obsdist_kernel — one home so the two
+    cannot drift). `ghosts` = (row_lo, row_hi, col_lo, col_hi) select
+    masks. Returns (p, r_red, r_blk) of the LAST iteration."""
+    r_red = r_blk = None
+    row_lo, row_hi, col_lo, col_hi = ghosts
+    for _t in range(n_inner):
+        r_red = jnp.where(red, rw - lap(p), 0.0)
+        p = p - fac * r_red
+        r_blk = jnp.where(black, rw - lap(p), 0.0)
+        p = p - fac * r_blk
+        p = jnp.where(row_lo, jnp.roll(p, -1, axis=0), p)
+        p = jnp.where(row_hi, jnp.roll(p, 1, axis=0), p)
+        p = jnp.where(col_lo, jnp.roll(p, -1, axis=1), p)
+        p = jnp.where(col_hi, jnp.roll(p, 1, axis=1), p)
+    return p, r_red, r_blk
 
 
 def pick_block_rows_tblock(jmax: int, imax: int, dtype=jnp.float32,

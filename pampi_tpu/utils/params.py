@@ -69,7 +69,11 @@ class Parameter:
     # temporal-blocking depth of the pallas SOR kernel: red-black iterations
     # fused per HBM sweep; convergence is checked every tpu_sor_inner
     # iterations, so a solve may overshoot by up to tpu_sor_inner-1
-    # iterations (jnp paths always step singly). 4 measured fastest on v5e.
+    # iterations (jnp paths always step singly). Default 4 keeps overshoot
+    # small for CONVERGING solves (a 5-iteration solve at n=16 would run
+    # 16); itermax-CAPPED workloads want 16 — measured 12.7 vs 21.3 ms/step
+    # at dcavity 4096² (round-3 depth sweep, quarters kernel; bench.py uses
+    # n_inner=16 for the same reason).
     tpu_sor_inner: int = 4
     # pallas SOR layout (single-device AND per-shard distributed):
     #   "auto"         quarter (2-D) / octant (3-D) decomposition when

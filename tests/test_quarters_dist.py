@@ -162,3 +162,107 @@ def test_qdist_clamp_and_eligibility():
             _param(imax=72, jmax=72),
             comm=CartComm(ndims=2, dims=(8, 1)),
         )
+
+
+def test_obstacle_dist_pallas_bitwise_matches_jnp():
+    """The per-shard flag-masked Pallas kernel (ops/sor_obsdist, interpret
+    on CPU) is the same program as the jnp CA obstacle path — bitwise, on
+    the 8-device mesh, at matched CA depth (f64: the kernel computes
+    omega/denom exactly as make_masks does)."""
+    from jax.sharding import PartitionSpec as P
+
+    from pampi_tpu.ops import obstacle as obst
+    from pampi_tpu.parallel.comm import halo_exchange
+
+    imax, jmax = 64, 32
+    dx, dy = 16.0 / imax, 4.0 / jmax
+    fluid = obst.build_fluid(imax, jmax, dx, dy, "6.0,1.5,10.0,2.5")
+    m = obst.make_masks(fluid, dx, dy, 1.7, jnp.float64)
+    comm = CartComm(ndims=2, dims=(2, 4))
+    jl, il = jmax // 2, imax // 4
+    rng = np.random.default_rng(1)
+    p0 = jnp.asarray(rng.standard_normal((jmax + 2, imax + 2)))
+    rhs = jnp.asarray(rng.standard_normal((jmax + 2, imax + 2)))
+
+    outs = {}
+    for backend in ("auto", "pallas"):  # auto on CPU = jnp CA
+        solve = obst.make_dist_obstacle_solver(
+            comm, imax, jmax, jl, il, dx, dy, 1e-12, 60, m, jnp.float64,
+            ca_n=2, sor_inner=2, backend=backend,
+        )
+        expect = "jnp_ca ca2" if backend == "auto" else "pallas ca2"
+        assert dispatch.last("obstacle_dist") == expect
+
+        def kern(p_int, rhs_int, _solve=solve):
+            pe = halo_exchange(jnp.pad(p_int, 1), comm)
+            re = halo_exchange(jnp.pad(rhs_int, 1), comm)
+            p, res, it = _solve(pe, re)
+            return p[1:-1, 1:-1], res, it
+
+        spec = P("j", "i")
+        f = jax.jit(comm.shard_map(
+            kern, in_specs=(spec, spec), out_specs=(spec, P(), P()),
+            check_vma=False,
+        ))
+        p_out, res, it = f(p0[1:-1, 1:-1], rhs[1:-1, 1:-1])
+        outs[backend] = (np.asarray(p_out), int(it))
+
+    assert outs["auto"][1] == outs["pallas"][1] == 60
+    np.testing.assert_array_equal(outs["auto"][0], outs["pallas"][0])
+
+
+def test_obsdist_kernel_multiblock_matches_jnp_twin():
+    """The multi-block DMA pipeline (nblocks >= 3: double-buffer slot
+    rotation, b>=2 store drains, cross-block owned-residual accumulation)
+    against ca_rb_iters_obstacle directly — plane bitwise AND residual
+    parity (the mesh-level test's convergence counts are cap-bound, so it
+    never checks res)."""
+    from pampi_tpu.ops import obstacle as obst
+    from pampi_tpu.ops import sor_pallas as sp
+    from pampi_tpu.ops.sor_obsdist import make_rb_iters_obsdist
+    from pampi_tpu.parallel.stencil2d import ca_masks
+
+    imax, jmax = 64, 32
+    dx, dy = 16.0 / imax, 4.0 / jmax
+    fluid = obst.build_fluid(imax, jmax, dx, dy, "6.0,1.5,10.0,2.5")
+    m = obst.make_masks(fluid, dx, dy, 1.7, jnp.float64)
+    jl, il = jmax, imax  # single shard: offsets 0, full domain
+    n = 2
+    H = 2 * n
+    rb, br, h = make_rb_iters_obsdist(
+        jmax, imax, jl, il, n, dx, dy, 1.7, jnp.float64,
+        interpret=True, block_rows=8,  # ext_j=40 -> nblocks=5
+    )
+    assert -(-(jl + 2 * H) // br) >= 3
+
+    rng = np.random.default_rng(3)
+    pd = jnp.asarray(rng.standard_normal((jl + 2 * H, il + 2 * H)))
+    rd = jnp.asarray(rng.standard_normal((jl + 2 * H, il + 2 * H)))
+    offs = jnp.asarray([0, 0], jnp.int32)
+    k_p, k_r = rb(offs, sp.pad_array(pd, br, h), sp.pad_array(rd, br, h),
+                  sp.pad_array(
+                      jnp.pad(m.fluid, [(H - 1, H - 1)] * 2).astype(
+                          jnp.float64
+                      ), br, h))
+    k_p = sp.unpad_array(k_p, jl + 2 * H - 2, il + 2 * H - 2, h)
+
+    # the jnp twin's deep masks use get_offsets (axis_index), so it must
+    # run under a (1,1)-mesh shard_map
+    import jax as _j
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), ("j", "i"))
+
+    def kern(pd, rd):
+        cm = ca_masks(jl, il, H, jmax, imax, jnp.float64)
+        om = obst.deep_obstacle_masks(m, jl, il, H)
+        return obst.ca_rb_iters_obstacle(
+            pd, rd, n, cm, om, 1.0 / (dx * dx), 1.0 / (dy * dy)
+        )
+
+    t_p, t_r = _j.jit(_j.shard_map(
+        kern, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+        check_vma=False,
+    ))(pd, rd)
+    np.testing.assert_array_equal(np.asarray(k_p), np.asarray(t_p))
+    np.testing.assert_allclose(float(k_r), float(t_r), rtol=1e-12)
