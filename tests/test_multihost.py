@@ -280,3 +280,45 @@ def test_two_process_halo_test(tmp_path):
     # 2x2 mesh, rank 0 at (0,0); its top ghost row comes from rank 2 (j+1)
     top = np.loadtxt(tmp_path / "halo-top-r0.txt")
     assert (top[1:-1] == 2.0).all()
+
+
+QUARTERS_PAR = """\
+name       poisson
+xlength    1.0
+ylength    1.0
+imax       32
+jmax       32
+itermax    120
+eps        0.0000000001
+omg        1.9
+tpu_mesh   auto
+tpu_dtype  float64
+tpu_sor_layout quarters
+tpu_ca_inner 2
+tpu_sor_inner 2
+"""
+
+
+@pytest.mark.slow
+def test_two_process_poisson_quarters_kernel(tmp_path):
+    """The round-3 production path ACROSS OS PROCESSES: forced quarters
+    dispatches the per-shard kernel (interpret on CPU) with the
+    quarter-space deep exchange riding cross-process ppermutes. The
+    converged field must match the single-process jnp oracle (checkerboard)
+    to f64 roundoff."""
+    par = tmp_path / "poisson.par"
+    par.write_text(QUARTERS_PAR)
+    proc = _launch(par, tmp_path)
+    assert "Walltime" in proc.stdout
+
+    oracle_par = tmp_path / "oracle.par"
+    oracle_par.write_text(
+        QUARTERS_PAR.replace("tpu_sor_layout quarters",
+                             "tpu_sor_layout checkerboard")
+        .replace("tpu_mesh   auto", "tpu_mesh   1")
+    )
+    _oracle(oracle_par, tmp_path)
+
+    ours = np.loadtxt(tmp_path / "p.dat")
+    ref = np.loadtxt(tmp_path / "oracle_dir" / "p.dat")
+    np.testing.assert_allclose(ours, ref, rtol=0, atol=1e-11)
