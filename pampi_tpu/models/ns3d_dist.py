@@ -300,8 +300,14 @@ class NS3DDistSolver:
             solve = make_dist_obstacle_solver_3d(
                 comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                 param.eps, param.itermax, self.masks, dtype,
-                ca_n=param.tpu_ca_inner,
+                ca_n=param.tpu_ca_inner, sor_inner=param.tpu_sor_inner,
             )
+            # relax check_vma when the obstacle solver dispatched its
+            # per-shard Pallas kernel (recorded at build time)
+            pallas_o = pallas_o or (
+                (_dispatch.last("obstacle3d_dist") or "").startswith("pallas")
+            )
+            self._pallas_o = pallas_o
         elif rb_o is not None:
             solve = _solve_sor_octants
         else:

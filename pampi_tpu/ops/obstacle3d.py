@@ -441,7 +441,8 @@ def ca_rb_iters_obstacle_3d(p, rhs, n: int, cm, om, idx2, idy2, idz2):
 
 def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
                                  dx, dy, dz, eps, itermax,
-                                 m: ObstacleMasks3D, dtype, ca_n: int = 1):
+                                 m: ObstacleMasks3D, dtype, ca_n: int = 1,
+                                 sor_inner: int = 1, backend: str = "auto"):
     """Distributed 3-D eps-coefficient pressure solve (shard_map kernel
     side), communication-avoiding like the uniform solve: one depth-2n halo
     exchange buys n exact local red-black iterations (static global masks
@@ -469,6 +470,34 @@ def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
     norm = m.n_fluid
     supported = ca_supported(kl, jl, il)
     n = ca_clamp(ca_n, kl, jl, il) if supported else 1
+    # per-shard Pallas kernel dispatch (round 3, mirrors the 2-D
+    # make_dist_obstacle_solver): production path on TPU, interpret with
+    # backend="pallas" for tests; the jnp CA path keeps ca_n
+    rb_k = None
+    if supported:
+        from ..models.ns3d import _use_pallas_3d
+
+        if backend == "pallas" or _use_pallas_3d("auto", dtype):
+            n_k = ca_clamp(max(ca_n, sor_inner), kl, jl, il)
+            try:
+                from .sor_obsdist3d import make_rb_iters_obsdist_3d
+
+                rb_k, bk_k = make_rb_iters_obsdist_3d(
+                    kmax, jmax, imax, kl, jl, il, n_k, dx, dy, dz,
+                    m.omega, dtype,
+                )
+            except ValueError:
+                rb_k = None
+    from ..utils import dispatch as _dispatch
+
+    if rb_k is not None:
+        n = n_k
+        _dispatch.record("obstacle3d_dist", f"pallas ca{n}")
+    else:
+        _dispatch.record(
+            "obstacle3d_dist",
+            f"jnp_ca ca{n}" if supported else "jnp_rb_fallback",
+        )
     H = ca_halo(n) if supported else 1
 
     def solve(p, rhs):
@@ -476,6 +505,51 @@ def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
         om = deep_obstacle_masks_3d(m, kl, jl, il, H)
         pd = embed_deep(p, H)
         rd = halo_exchange(embed_deep(rhs, H), comm, depth=H)
+        if rb_k is not None:
+            # pad once, carry the padded layout, exchange at padded offsets
+            from ..parallel.comm import get_offsets
+            from .sor3d_pallas import pad_array_3d, unpad_array_3d
+            from .sor_obsdist3d import padded_deep_exchange_3d
+
+            koff = get_offsets("k", kl)
+            joff = get_offsets("j", jl)
+            ioff = get_offsets("i", il)
+            offs = jnp.stack([
+                koff.astype(jnp.int32), joff.astype(jnp.int32),
+                ioff.astype(jnp.int32),
+            ])
+            rd_p = pad_array_3d(rd, bk_k, n)
+            flg_p = pad_array_3d(
+                _jax.lax.dynamic_slice(
+                    jnp.pad(m.fluid, [(H - 1, H - 1)] * 3),
+                    (koff, joff, ioff),
+                    (kl + 2 * H, jl + 2 * H, il + 2 * H),
+                ),
+                bk_k, n,
+            )
+            ext = (kl + 2 * H, jl + 2 * H, il + 2 * H)
+            h3 = 2 * n  # pad_array_3d's k halo (tblock3d_halo)
+
+            def cond_k(c):
+                _, res, it = c
+                return jnp.logical_and(res >= epssq, it < itermax)
+
+            def body_k(c):
+                pp, _, it = c
+                pp = padded_deep_exchange_3d(pp, comm, H, h3, *ext)
+                pp, r2 = rb_k(offs, pp, rd_p, flg_p)
+                res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(comm, "{} Residuum: {}", it + (n - 1), res)
+                return pp, res, it + n
+
+            pp, res, it = _jax.lax.while_loop(
+                cond_k, body_k,
+                (pad_array_3d(pd, bk_k, n), jnp.asarray(1.0, dtype),
+                 jnp.asarray(0, jnp.int32)),
+            )
+            pd = unpad_array_3d(pp, ext[0] - 2, ext[1] - 2, ext[2] - 2, n)
+            return halo_exchange(strip_deep(pd, H), comm), res, it
 
         def cond(c):
             _, res, it = c

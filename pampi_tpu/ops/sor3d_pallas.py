@@ -68,6 +68,58 @@ def tblock3d_halo(n_inner: int) -> int:
     return 2 * n_inner
 
 
+def _neighbours3(x):
+    return (
+        jnp.roll(x, -1, axis=2), jnp.roll(x, 1, axis=2),   # east, west
+        jnp.roll(x, -1, axis=1), jnp.roll(x, 1, axis=1),   # north, south
+        jnp.roll(x, -1, axis=0), jnp.roll(x, 1, axis=0),   # back, front
+    )
+
+
+def masked_stencil_ops_3d(fl, idx2, idy2, idz2, omega):
+    """(fac, lap) for the 3-D flag-masked (obstacle) stencil — the single
+    home of the eps-coefficient kernel math, shared by _tblock3d_kernel's
+    masked mode and the distributed ops/sor_obsdist3d kernel (same
+    discipline as sor_pallas.masked_stencil_ops). Arithmetic matches
+    ops/obstacle3d.sor_pass_obstacle_3d."""
+    eps_e, eps_w, eps_n, eps_s, eps_b, eps_f = _neighbours3(fl)
+    denom = ((eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
+             + (eps_b + eps_f) * idz2)
+    fac = jnp.where(denom > 0, omega / denom, 0.0) * fl
+
+    def lap(x):
+        east, west, north, south, back_, frnt = _neighbours3(x)
+        return (
+            (eps_e * (east - x) + eps_w * (west - x)) * idx2
+            + (eps_n * (north - x) + eps_s * (south - x)) * idy2
+            + (eps_b * (back_ - x) + eps_f * (frnt - x)) * idz2
+        )
+
+    return fac, lap
+
+
+def rb_inner_sweeps_3d(p, rw, n_inner, odd, even, fac, lap, faces):
+    """The fused 3-D red-black inner loop (ODD parity first — the
+    reference's sweep order) + per-iteration 6-face Neumann refresh, shared
+    by _tblock3d_kernel and the distributed obstacle kernel. `faces` =
+    (front, back, bottom, top, left, right) select masks. Returns
+    (p, r_odd, r_evn) of the LAST iteration."""
+    front, back, bottom, top, left, right = faces
+    r_odd = r_evn = None
+    for _t in range(n_inner):
+        r_odd = jnp.where(odd, rw - lap(p), 0.0)
+        p = p - fac * r_odd
+        r_evn = jnp.where(even, rw - lap(p), 0.0)
+        p = p - fac * r_evn
+        p = jnp.where(front, jnp.roll(p, -1, axis=0), p)
+        p = jnp.where(back, jnp.roll(p, 1, axis=0), p)
+        p = jnp.where(bottom, jnp.roll(p, -1, axis=1), p)
+        p = jnp.where(top, jnp.roll(p, 1, axis=1), p)
+        p = jnp.where(left, jnp.roll(p, -1, axis=2), p)
+        p = jnp.where(right, jnp.roll(p, 1, axis=2), p)
+    return p, r_odd, r_evn
+
+
 def pick_block_k(kmax: int, jmax: int, imax: int, dtype=jnp.float32,
                  n_inner: int = 1, masked: bool = False) -> int:
     """Block depth (planes per grid step). The kernel's resident planes are
@@ -219,54 +271,27 @@ def _tblock3d_kernel(
     left = (ii == 0) & tan_kj
     right = (ii == imax + 1) & tan_kj
 
-    def _neighbours(x):
-        return (
-            jnp.roll(x, -1, axis=2), jnp.roll(x, 1, axis=2),   # east, west
-            jnp.roll(x, -1, axis=1), jnp.roll(x, 1, axis=1),   # north, south
-            jnp.roll(x, -1, axis=0), jnp.roll(x, 1, axis=0),   # back, front
-        )
-
     if masked:
         # per-block constants (flags don't change across inner iterations)
         fl = fw2[slot]
         odd = odd & (fl != 0)
         even = even & (fl != 0)
-        eps_e, eps_w, eps_n, eps_s, eps_b, eps_f = _neighbours(fl)
-        denom = ((eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
-                 + (eps_b + eps_f) * idz2)
-        fac = jnp.where(denom > 0, omega / denom, 0.0) * fl
-
-        def lap(x):
-            east, west, north, south, back_, frnt = _neighbours(x)
-            return (
-                (eps_e * (east - x) + eps_w * (west - x)) * idx2
-                + (eps_n * (north - x) + eps_s * (south - x)) * idy2
-                + (eps_b * (back_ - x) + eps_f * (frnt - x)) * idz2
-            )
+        fac, lap = masked_stencil_ops_3d(fl, idx2, idy2, idz2, omega)
     else:
         fac = factor
 
         def lap(x):
-            east, west, north, south, back_, frnt = _neighbours(x)
+            east, west, north, south, back_, frnt = _neighbours3(x)
             return (
                 (east - 2.0 * x + west) * idx2
                 + (north - 2.0 * x + south) * idy2
                 + (back_ - 2.0 * x + frnt) * idz2
             )
 
-    r_odd = r_evn = None
-    for _t in range(n_inner):
-        r_odd = jnp.where(odd, rw - lap(p), 0.0)
-        p = p - fac * r_odd
-        r_evn = jnp.where(even, rw - lap(p), 0.0)
-        p = p - fac * r_evn
-        # Neumann ghost refresh (faces only; edges/corners/dead cells untouched)
-        p = jnp.where(front, jnp.roll(p, -1, axis=0), p)
-        p = jnp.where(back, jnp.roll(p, 1, axis=0), p)
-        p = jnp.where(bottom, jnp.roll(p, -1, axis=1), p)
-        p = jnp.where(top, jnp.roll(p, 1, axis=1), p)
-        p = jnp.where(left, jnp.roll(p, -1, axis=2), p)
-        p = jnp.where(right, jnp.roll(p, 1, axis=2), p)
+    p, r_odd, r_evn = rb_inner_sweeps_3d(
+        p, rw, n_inner, odd, even, fac, lap,
+        (front, back, bottom, top, left, right),
+    )
 
     @pl.when(b >= 2)
     def _():
