@@ -366,7 +366,12 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
     at depth max(ca_n, sor_inner); the jnp CA path keeps ca_n so its
     trajectory granularity is unchanged. Dispatch recorded under
     "obstacle_dist"."""
-    from ..parallel.comm import get_offsets, halo_exchange, reduction
+    from ..parallel.comm import (
+        get_offsets,
+        halo_exchange,
+        master_print,
+        reduction,
+    )
     from ..parallel.stencil2d import (
         ca_clamp,
         ca_halo,
@@ -377,6 +382,7 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
         strip_deep,
     )
     from ..utils import dispatch as _dispatch
+    from ..utils import flags as _flags
 
     idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
     epssq = eps * eps
@@ -453,6 +459,10 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                 pp = padded_deep_exchange(pp, comm, H, h_k, ext_j, ext_i)
                 pp, r2 = rb_k(offs, pp, rd_p, flg_p)
                 res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(
+                        comm, "{} Residuum: {}", it + (n - 1), res
+                    )
                 return pp, res, it + n
 
             import jax as _jax2
@@ -490,6 +500,8 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                     )
                 )
             res = reduction(r2, comm, "sum") / norm
+            if _flags.debug():
+                master_print(comm, "{} Residuum: {}", it + (n - 1), res)
             return pd, res, it + n
 
         import jax as _jax
