@@ -236,7 +236,7 @@ class NS3DDistSolver:
         plain_sor = param.tpu_solver not in ("mg", "fft") and self.masks is None
         rb_o, og, n_o, pallas_o = octants_dispatch(
             param, g.kmax, g.jmax, g.imax, kl, jl, il, dx, dy, dz, dtype,
-            "ns3d_dist", plain_sor=plain_sor,
+            "ns3d_dist", plain_sor=plain_sor, dims=comm.dims,
         )
         if rb_o is None:
             _dispatch.record(

@@ -56,7 +56,10 @@ def _odist_kernel(
     slot = b % 2
     nslot = (b + 1) % 2
     qidx = QIDX
-    qoff = (sref[0], sref[1], sref[2])
+    # axes without a deep halo (mesh size 1) have a statically-zero shard
+    # offset: substituting the constant lets Mosaic fold their masks to
+    # static iota compares, as in the single-device octant kernel
+    qoff = tuple(sref[a] if g.d[a] > 0 else 0 for a in range(3))
 
     def load(k, s):
         copies = []
@@ -99,12 +102,17 @@ def _odist_kernel(
     st_c = jax.lax.broadcasted_iota(jnp.int32, shape, 2)
     stored = (st_s, st_r, st_c)
     lam = (st_s - h, st_r, st_c)
-    go = tuple(lam[a] - g.n + qoff[a] for a in range(3))
-    valid_upd = (
-        (lam[0] >= 1) & (lam[0] <= g.kq - 2)
-        & (lam[1] >= 1) & (lam[1] <= g.jq - 2)
-        & (lam[2] >= 1) & (lam[2] <= g.iq - 2)
-    )
+    go = tuple(lam[a] - g.d[a] + qoff[a] for a in range(3))
+    # frozen-ring clip only on deep-halo axes (octants_dist.o_masks)
+    spans = (g.kq, g.jq, g.iq)
+    valid_upd = None
+    for a in range(3):
+        if g.d[a] == 0:
+            continue
+        term = (lam[a] >= 1) & (lam[a] <= spans[a] - 2)
+        valid_upd = term if valid_upd is None else (valid_upd & term)
+    if valid_upd is None:
+        valid_upd = jnp.ones_like(lam[0], dtype=bool)
     valid_any = (
         (lam[0] >= 0) & (lam[0] < g.kq)
         & (lam[1] >= 0) & (lam[1] < g.jq)
@@ -122,6 +130,11 @@ def _odist_kernel(
         os = _owned_start(g, axis, bit)
         return (stored[axis] >= os) & (stored[axis] < os + g.local2(axis))
 
+    # ownership differs from the update interior only on deep-halo axes
+    # (redundantly-recomputed ghost cells); on d_ax = 0 axes rm is already
+    # zero outside owned cells, so those ax_own terms (and, on an all-owned
+    # shard, the whole residual select) drop out
+    own_axes = [a for a in range(3) if g.d[a] > 0]
     m_upd = {}
     m_own = {}
     for bits in BITS:
@@ -129,9 +142,11 @@ def _odist_kernel(
             ax_int(0, bits[0]) & ax_int(1, bits[1]) & ax_int(2, bits[2])
             & valid_upd
         )
-        m_own[bits] = (
-            ax_own(0, bits[0]) & ax_own(1, bits[1]) & ax_own(2, bits[2])
-        )
+        own = None
+        for a in own_axes:
+            term = ax_own(a, bits[a])
+            own = term if own is None else (own & term)
+        m_own[bits] = own
 
     def nbrs(bits):
         def ax_pair(axis):
@@ -186,7 +201,10 @@ def _odist_kernel(
     acc = jnp.zeros_like(vacc[...])
     for bits in BITS:
         rq = resids[bits]
-        rq_own = jnp.where(m_own[bits], rq * rq, jnp.zeros_like(rq))
+        if m_own[bits] is None:
+            rq_own = rq * rq
+        else:
+            rq_own = jnp.where(m_own[bits], rq * rq, jnp.zeros_like(rq))
         acc = acc + jnp.sum(rq_own[h: h + bk], axis=(0, 1))[None, :]
     vacc[...] += acc
 

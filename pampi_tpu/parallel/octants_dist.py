@@ -11,20 +11,31 @@ per n red-black iterations.
 LAYOUT: all eight octants of a shard are GLOBALLY ALIGNED. Stored indices
 (s, r, c) of every slot hold global octant coords
 
-    go_k = (s - h) - n + qoff_k     (h = kernel k-window halo = n planes,
-    go_j = r - n + qoff_j            no alignment needed on the untiled k
-    go_i = c - n + qoff_i            axis; j/i pad to sublane/lane tiles)
+    go_k = (s - h) - d_k + qoff_k   (h = kernel k-window halo = n planes,
+    go_j = r - d_j + qoff_j          no alignment needed on the untiled k
+    go_i = c - d_i + qoff_i          axis; j/i pad to sublane/lane tiles)
 
 with qoff_* = shard offset / 2 (shard extents even ⇒ offsets even ⇒ the
 parity split is decomposition-invariant and the single-device neighbour/
 Neumann identities hold verbatim). Per parity bit b of an axis, owned
 stored indices start at base + (1 if b == 0 else 0) — static bounds.
 
-CA semantics match the 2-D module exactly: one iteration consumes one
-octant plane of validity per side per axis; the outermost stored ring is
-frozen (read-only — in grid space it IS the outermost grid ghost plane, so
-the proven depth-2n grid CA argument carries over); ghost cells are
-redundantly recomputed; residuals count owned cells only.
+d_ax is the PER-AXIS stored deep-halo depth: n on mesh axes that actually
+exchange (size > 1), 0 on axes the shard fully owns. A size-1 axis has
+physical walls on both sides whose ghosts the in-kernel Neumann refresh
+maintains every iteration — exactly the single-device kernel's situation —
+so storing 2n CA ghost planes there would only inflate the window with
+redundantly-recomputed cells (measured 32% per-iteration cost at 128^3 on
+a (1,1,1) mesh, round 4; with d=(0,0,0) the kernel is geometrically the
+single-device octant kernel).
+
+CA semantics match the 2-D module exactly on exchanged axes: one iteration
+consumes one octant plane of validity per side; the outermost stored ring
+is frozen (read-only — in grid space it IS the outermost grid ghost plane,
+so the proven depth-2n grid CA argument carries over); ghost cells are
+redundantly recomputed; residuals count owned cells only. On d_ax = 0 axes
+there is no frozen ring and no consumption — the per-parity global-index
+bounds alone clip the updates, as in ops/sor3d_pallas's octant kernel.
 """
 
 from __future__ import annotations
@@ -56,21 +67,27 @@ class OGeom:
     kl: int  # per-shard interior extents (even)
     jl: int
     il: int
-    n: int    # CA depth in octant planes = RB iterations per exchange
+    n: int    # RB iterations per exchange (temporal depth)
     h: int    # kernel k-window halo (= n; untiled axis)
     bk: int   # kernel block depth (octant planes)
-    kq: int   # logical stored k span: kl/2 + 2n + 1
+    kq: int   # logical stored k span: kl/2 + 2*d_k + 1
     jq: int
     iq: int
     sp: int   # padded stored k: nblocks*bk + 2h
     jp2: int  # padded stored j (sublane multiple)
     ip2: int  # padded stored i (lane multiple)
     nblocks: int
+    d: tuple[int, int, int] = None  # stored deep-halo depth per axis
+    #   (n on exchanged mesh axes, 0 on fully-owned ones; None -> (n,n,n))
+
+    def __post_init__(self):
+        if self.d is None:
+            object.__setattr__(self, "d", (self.n, self.n, self.n))
 
     @property
     def base(self) -> tuple[int, int, int]:
         """Stored index of global octant coord qoff_* per axis."""
-        return (self.h + self.n, self.n, self.n)
+        return (self.h + self.d[0], self.d[1], self.d[2])
 
     def gmax2(self, axis: int) -> int:
         return (self.kmax, self.jmax, self.imax)[axis] // 2
@@ -83,14 +100,21 @@ class OGeom:
 
 
 def make_ogeom(kmax, jmax, imax, kl, jl, il, n, dtype,
-               bk: int | None = None) -> OGeom:
+               bk: int | None = None,
+               dims: tuple[int, int, int] | None = None) -> OGeom:
+    """dims = mesh sizes per ("k","j","i") axis; axes of size 1 store no
+    deep halo (see the module docstring). dims=None keeps d=(n,n,n) — the
+    conservative all-halo layout (used by geometry unit tests)."""
     from ..ops import sor_pallas as sp
 
     a = sp._align(dtype)
     h = n  # k axis is untiled: halo needs no alignment rounding
-    kq = kl // 2 + 2 * n + 1
-    jq = jl // 2 + 2 * n + 1
-    iq = il // 2 + 2 * n + 1
+    d = (n, n, n) if dims is None else tuple(
+        n if sz > 1 else 0 for sz in dims
+    )
+    kq = kl // 2 + 2 * d[0] + 1
+    jq = jl // 2 + 2 * d[1] + 1
+    iq = il // 2 + 2 * d[2] + 1
     jp2 = -(-jq // a) * a
     ip2 = -(-iq // sp.LANE) * sp.LANE
     if bk is None:
@@ -102,7 +126,7 @@ def make_ogeom(kmax, jmax, imax, kl, jl, il, n, dtype,
     nblocks = -(-kq // bk)
     sp_ = nblocks * bk + 2 * h
     return OGeom(kmax, jmax, imax, kl, jl, il, n, h, bk, kq, jq, iq,
-                 sp_, jp2, ip2, nblocks)
+                 sp_, jp2, ip2, nblocks, d)
 
 
 def odist_supported(kmax, jmax, imax, kl, jl, il) -> bool:
@@ -113,12 +137,25 @@ def odist_supported(kmax, jmax, imax, kl, jl, il) -> bool:
     )
 
 
-def odist_clamp(n: int, kl: int, jl: int, il: int) -> int:
-    return max(1, min(n, min(kl, jl, il) // 2 - 1))
+def odist_clamp(n: int, kl: int, jl: int, il: int,
+                dims: tuple[int, int, int] | None = None) -> int:
+    """CA-depth clamp: owned strips must be able to ship depth-n ghost
+    slabs, so n is bounded by the EXCHANGED axes' extents — a fully-owned
+    j/i axis (mesh size 1) stores no deep halo and imposes no bound. The k
+    axis always bounds n regardless of its mesh size: the kernel's k-window
+    temporal halo is n planes whatever d_k is, and n >> kl/2 would be
+    mostly redundant recompute (and can blow the VMEM feasibility check)."""
+    exts = [kl]
+    if dims is None:
+        exts = [kl, jl, il]
+    else:
+        exts += [e for e, sz in zip((kl, jl, il), dims) if sz > 1]
+    return max(1, min(n, min(exts) // 2 - 1))
 
 
 def octants_dispatch(param, kmax, jmax, imax, kl, jl, il, dx, dy, dz, dtype,
-                     record_key: str, plain_sor: bool):
+                     record_key: str, plain_sor: bool,
+                     dims: tuple[int, int, int] | None = None):
     """3-D twin of quarters_dist.quarters_dispatch (models/ns3d_dist):
     returns (rb_o, og, n_o, pallas_o); rb_o None -> grid-space jnp CA."""
     from ..utils import dispatch as _dispatch
@@ -137,9 +174,9 @@ def octants_dispatch(param, kmax, jmax, imax, kl, jl, il, dx, dy, dz, dtype,
     if not (layout == "octants" or _use_pallas_3d("auto", dtype)):
         return None, None, 0, False
     n_o = odist_clamp(
-        max(param.tpu_ca_inner, param.tpu_sor_inner), kl, jl, il
+        max(param.tpu_ca_inner, param.tpu_sor_inner), kl, jl, il, dims
     )
-    og = make_ogeom(kmax, jmax, imax, kl, jl, il, n_o, dtype)
+    og = make_ogeom(kmax, jmax, imax, kl, jl, il, n_o, dtype, dims=dims)
     try:
         from ..ops.sor_odist import make_rb_iters_odist
 
@@ -226,12 +263,19 @@ def unpack_o_to_ext(xo, g: OGeom):
 
 
 def o_exchange(xo, comm: CartComm, g: OGeom):
-    """commExchange in octant space: depth-n ghost slabs per axis per parity
-    group, PROC_NULL at physical walls. 12 ppermutes total (3 axes × 2
-    directions × 2 parity groups), each carrying a stacked 4-slot strip."""
-    n = g.n
+    """commExchange in octant space: depth-d_ax ghost slabs per axis per
+    parity group, PROC_NULL at physical walls. 12 ppermutes total (3 axes ×
+    2 directions × 2 parity groups), each carrying a stacked 4-slot strip.
+    Axes with mesh size 1 store no deep halo and are skipped."""
     for axis, name in enumerate(("k", "j", "i")):
         nper = comm.axis_size(name)
+        n = g.d[axis]
+        if nper > 1 and n == 0:
+            raise ValueError(
+                f"OGeom stores no deep halo on axis {name!r} but the mesh "
+                f"has {nper} shards there — the geometry was built for a "
+                "different mesh (pass dims=comm.dims to make_ogeom)"
+            )
         if nper == 1:
             continue
         adim = axis + 1  # array axis in the (8, s, r, c) stacked layout
@@ -265,14 +309,23 @@ def o_exchange(xo, comm: CartComm, g: OGeom):
 
 def o_masks(g: OGeom, qoff_k, qoff_j, qoff_i):
     """Per-slot masks on the full (sp, jp2, ip2) stored volume from GLOBAL
-    octant coordinates — keep in lockstep with ops/sor_odist.py."""
+    octant coordinates — keep in lockstep with ops/sor_odist.py. One
+    DELIBERATE asymmetry: this twin keeps all three ax_own terms in
+    m["own"] while the kernel drops the d_ax = 0 terms — equivalent
+    because on a fully-owned axis ax_own equals the ax_int interior where
+    rm is already zero, so the owned residual sums are identical."""
     s = jnp.arange(g.sp, dtype=jnp.int32)[:, None, None]
     r = jnp.arange(g.jp2, dtype=jnp.int32)[None, :, None]
     c = jnp.arange(g.ip2, dtype=jnp.int32)[None, None, :]
     lam = (s - g.h, r, c)
-    go = (lam[0] - g.n + qoff_k, lam[1] - g.n + qoff_j, lam[2] - g.n + qoff_i)
+    go = (lam[0] - g.d[0] + qoff_k, lam[1] - g.d[1] + qoff_j,
+          lam[2] - g.d[2] + qoff_i)
+    # the frozen-outermost-ring clip exists only on deep-halo axes; on
+    # d_ax = 0 axes the per-parity global bounds (ax_int) are the full clip
     valid_upd_ax = [
-        (lam[a] >= 1) & (lam[a] <= g.span(a) - 2) for a in range(3)
+        (lam[a] >= 1) & (lam[a] <= g.span(a) - 2) if g.d[a] > 0
+        else jnp.ones_like(lam[a], dtype=bool)
+        for a in range(3)
     ]
     valid_upd = valid_upd_ax[0] & valid_upd_ax[1] & valid_upd_ax[2]
 

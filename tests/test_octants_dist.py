@@ -16,14 +16,24 @@ from pampi_tpu.utils.params import read_parameter
 DC3 = "assignment-6/dcavity.par"
 
 
-def test_twin_bitwise_matches_interpret_kernel():
+@pytest.mark.parametrize(
+    "dims,kl,jl,il,offs",
+    [
+        # conservative all-halo geometry (dims unknown): any shard offsets
+        (None, 8, 8, 8, ((0, 0, 0), (4, 0, 4), (0, 4, 0))),
+        # size-1 mesh axes store no deep halo; their offsets are 0
+        ((1, 2, 2), 16, 8, 8, ((0, 0, 0), (0, 4, 4), (0, 0, 4))),
+        ((1, 1, 1), 16, 16, 16, ((0, 0, 0),)),
+    ],
+)
+def test_twin_bitwise_matches_interpret_kernel(dims, kl, jl, il, offs):
     from pampi_tpu.models.ns3d import sor_coefficients_3d
     from pampi_tpu.ops.sor_odist import make_rb_iters_odist
 
     rng = np.random.default_rng(3)
     kmax = jmax = imax = 16
-    kl, jl, il = 8, 8, 8
-    g = od.make_ogeom(kmax, jmax, imax, kl, jl, il, 2, jnp.float64)
+    g = od.make_ogeom(kmax, jmax, imax, kl, jl, il, 2, jnp.float64,
+                      dims=dims)
     ext = jnp.asarray(rng.standard_normal((kl + 2, jl + 2, il + 2)))
     rhse = jnp.asarray(rng.standard_normal((kl + 2, jl + 2, il + 2)))
     xo = od.pack_ext_to_o(ext, g)
@@ -34,7 +44,7 @@ def test_twin_bitwise_matches_interpret_kernel():
     factor, idx2, idy2, idz2 = sor_coefficients_3d(
         1 / 16, 1 / 16, 1 / 16, 1.7
     )
-    for off in ((0, 0, 0), (4, 0, 4), (0, 4, 0)):
+    for off in offs:
         m = od.o_masks(g, *off)
         tx, tr = jax.jit(od.rb_iters_o_jnp, static_argnums=2)(
             xo, ro, g, m, factor, idx2, idy2, idz2
