@@ -58,7 +58,13 @@ def _make_comm(param, ndims: int):
         return None
     from .parallel.comm import CartComm
 
-    comm = CartComm(ndims=ndims, dims=dims)
+    # grid extents in mesh-axis order make `auto` prefer factorizations the
+    # grid actually divides (e.g. canal.par 200x50 on 8 devices -> (2,4))
+    extents = (
+        (param.jmax, param.imax) if ndims == 2
+        else (param.kmax, param.jmax, param.imax)
+    )
+    comm = CartComm(ndims=ndims, dims=dims, extents=extents)
     comm.print_config()
     return comm
 

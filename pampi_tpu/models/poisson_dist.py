@@ -85,11 +85,27 @@ class DistPoissonSolver:
             )
         self.param = param
         self.dtype = dtype
-        self.comm = comm if comm is not None else CartComm(ndims=2)
+        self.comm = comm if comm is not None else CartComm(
+            ndims=2, extents=(param.jmax, param.imax)
+        )
         self.imax, self.jmax = param.imax, param.jmax
         self.dx = param.xlength / param.imax
         self.dy = param.ylength / param.jmax
-        self.jl, self.il = self.comm.local_shape((self.jmax, self.imax))
+        # ragged pad-with-mask decomposition (≙ sizeOfRank remainder spread,
+        # assignment-6/src/comm.c:19-22): ceil-divided uniform blocks whose
+        # trailing dead cells the global-coordinate ca_masks already exclude
+        # from updates, walls and residuals — any grid runs on any mesh
+        self.jl, self.il = self.comm.local_shape(
+            (self.jmax, self.imax), ragged=True
+        )
+        Pj, Pi = self.comm.dims
+        self.ragged = (self.jl * Pj != self.jmax) or (self.il * Pi != self.imax)
+        if self.ragged and param.tpu_solver in ("mg", "fft"):
+            raise ValueError(
+                f"tpu_solver {param.tpu_solver} needs a divisible grid/mesh "
+                f"(grid {self.jmax}x{self.imax} on {self.comm.dims}); ragged "
+                "pad-with-mask runs use tpu_solver sor"
+            )
         self.problem = problem
         self._build()
         # interior-only sharded global field, initialized on-device
@@ -125,7 +141,7 @@ class DistPoissonSolver:
         use_direct = param.tpu_solver in ("mg", "fft")
         supported = ca_supported(jl, il) and not use_direct
         n_ca = ca_inner(param, jl, il) if supported else 1
-        H = ca_halo(n_ca) if supported else 1
+        H = ca_halo(n_ca, ragged=self.ragged) if supported else 1
 
         # -- quarter-layout production path (parallel/quarters_dist.py):
         # the single-chip headline kernel on every shard, one depth-n
@@ -133,14 +149,15 @@ class DistPoissonSolver:
         # (interpret-mode kernel off-TPU); auto takes it when pallas is live
         rb_q, qg, n_q, pallas_q = quarters_dispatch(
             param, self.jmax, self.imax, jl, il, dx, dy, dtype,
-            "poisson_dist", plain_sor=not use_direct,
+            "poisson_dist", plain_sor=not use_direct and not self.ragged,
         )
         if rb_q is None:
-            _dispatch.record(
-                "poisson_dist",
+            tag = (
                 f"jnp_ca ca{n_ca}" if supported else "jnp_rb_fallback"
-                if not use_direct else f"direct_{param.tpu_solver}",
-            )
+            ) if not use_direct else f"direct_{param.tpu_solver}"
+            if self.ragged:
+                tag += " ragged"
+            _dispatch.record("poisson_dist", tag)
         if param.tpu_solver == "mg":
             from ..ops.multigrid import make_dist_mg_solve_2d
 
@@ -219,7 +236,8 @@ class DistPoissonSolver:
                     p, r2 = ca_rb_iters(p, rhs, n_ca, m, factor, idx2, idy2)
                 else:
                     p, r2 = rb_exchange_per_sweep(
-                        p, rhs, m, comm, factor, idx2, idy2
+                        p, rhs, m, comm, factor, idx2, idy2,
+                        ragged=self.ragged,
                     )
                 res = reduction(r2, comm, "sum") / norm
                 if _flags.debug():
@@ -300,7 +318,8 @@ class DistPoissonSolver:
         interior = self.comm.collect(self.p)
         jmax, imax = self.jmax, self.imax
         full = np.zeros((jmax + 2, imax + 2))
-        full[1:-1, 1:-1] = interior
+        # ragged decompositions carry trailing dead cells — strip them
+        full[1:-1, 1:-1] = interior[:jmax, :imax]
         full[0, 1:-1] = full[1, 1:-1]
         full[-1, 1:-1] = full[-2, 1:-1]
         full[1:-1, 0] = full[1:-1, 1]

@@ -1,0 +1,185 @@
+"""Ragged (pad-with-mask) NS-2D wall handling: global-coordinate masked
+boundary conditions for ceil-divided meshes.
+
+On a divisible mesh every physical wall coincides with an array edge of a
+wall shard, so models/ns2d_dist.py applies the reference's BC strip writes
+(solver.c:236-337) wall-gated at the array edges. A ragged decomposition
+breaks that coincidence on the HI sides: the wall row gi == imax (and the
+ghost row gi == imax+1) can sit anywhere inside the trailing shard — or
+open a fully-dead shard. These variants express the SAME arithmetic as
+select-by-global-index: a wall write `x[wall] = g(x[src])` becomes
+`where(mask_wall, g(roll(x)), x)`, where the roll reads the +-1 neighbour
+in the local block (fresh after the preceding halo exchange; models call
+these right after exchanging u and v).
+
+Lo-side walls always sit at shard-0 array edges (padding is trailing), but
+the masked forms handle them uniformly — one code path, every wall.
+
+The value arithmetic mirrors ops/ns2d.py exactly (NOSLIP mirror, SLIP
+copy, OUTFLOW copy-from-interior, PERIODIC no-op), so a ragged run tracks
+the single-device trajectory to reduction order.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .comm import CartComm, get_offsets
+
+NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
+
+
+def global_index_vectors(comm: CartComm, jl: int, il: int):
+    """(gj[col-vector], gi[row-vector]) of the (jl+2, il+2) extended block:
+    ext index a maps to global extended index offset + a (interior cell 1
+    is global 1 on the first shard)."""
+    joff = get_offsets("j", jl)
+    ioff = get_offsets("i", il)
+    gj = (jnp.arange(jl + 2, dtype=jnp.int32) + joff)[:, None]
+    gi = (jnp.arange(il + 2, dtype=jnp.int32) + ioff)[None, :]
+    return gj, gi
+
+
+def live_masks(comm: CartComm, jl: int, il: int, jmax: int, imax: int, dtype):
+    """Multiply-mask zeroing DEAD cells (beyond the global ghost ring) of
+    the extended block — applied to u/v after the projection so pad-cell
+    garbage never reaches maxElement's CFL scan (the reference's ghost-
+    inclusive maxElement quirk makes every stored cell scan-relevant)."""
+    gj, gi = global_index_vectors(comm, jl, il)
+    live = (gj <= jmax + 1) & (gi <= imax + 1)
+    return live.astype(dtype)
+
+
+def set_bcs_ragged(u, v, param, comm: CartComm, jl: int, il: int,
+                   jmax: int, imax: int):
+    """setBoundaryConditions (solver.c:236-337) as global-index selects."""
+    gj, gi = global_index_vectors(comm, jl, il)
+    tan_j = (gj >= 1) & (gj <= jmax)
+    tan_i = (gi >= 1) & (gi <= imax)
+
+    def sel(mask, new, old):
+        return jnp.where(mask, new, old)
+
+    # east/west/north/south reads as local rolls (halos fresh by contract)
+    def w_of(x):   # value one column west
+        return jnp.roll(x, 1, axis=1)
+
+    def e_of(x):
+        return jnp.roll(x, -1, axis=1)
+
+    def s_of(x):   # value one row south
+        return jnp.roll(x, 1, axis=0)
+
+    def n_of(x):
+        return jnp.roll(x, -1, axis=0)
+
+    # left wall: U(0,j) on the wall, V(0,j) ghost mirrors V(1,j)
+    m_u = (gi == 0) & tan_j
+    if param.bcLeft == NOSLIP:
+        u = sel(m_u, jnp.zeros_like(u), u)
+        v = sel(m_u, -e_of(v), v)
+    elif param.bcLeft == SLIP:
+        u = sel(m_u, jnp.zeros_like(u), u)
+        v = sel(m_u, e_of(v), v)
+    elif param.bcLeft == OUTFLOW:
+        u = sel(m_u, e_of(u), u)
+        v = sel(m_u, e_of(v), v)
+    # right wall: U(imax,j) ON the wall, V(imax+1,j) ghost
+    m_w = (gi == imax) & tan_j
+    m_g = (gi == imax + 1) & tan_j
+    if param.bcRight == NOSLIP:
+        u = sel(m_w, jnp.zeros_like(u), u)
+        v = sel(m_g, -w_of(v), v)
+    elif param.bcRight == SLIP:
+        u = sel(m_w, jnp.zeros_like(u), u)
+        v = sel(m_g, w_of(v), v)
+    elif param.bcRight == OUTFLOW:
+        u = sel(m_w, w_of(u), u)
+        v = sel(m_g, w_of(v), v)
+    # bottom wall: V(i,0) on the wall, U(i,0) ghost
+    m_v = (gj == 0) & tan_i
+    if param.bcBottom == NOSLIP:
+        v = sel(m_v, jnp.zeros_like(v), v)
+        u = sel(m_v, -n_of(u), u)
+    elif param.bcBottom == SLIP:
+        v = sel(m_v, jnp.zeros_like(v), v)
+        u = sel(m_v, n_of(u), u)
+    elif param.bcBottom == OUTFLOW:
+        u = sel(m_v, n_of(u), u)
+        v = sel(m_v, n_of(v), v)
+    # top wall: V(i,jmax) ON the wall, U(i,jmax+1) ghost
+    m_vw = (gj == jmax) & tan_i
+    m_ug = (gj == jmax + 1) & tan_i
+    if param.bcTop == NOSLIP:
+        v = sel(m_vw, jnp.zeros_like(v), v)
+        u = sel(m_ug, -s_of(u), u)
+    elif param.bcTop == SLIP:
+        v = sel(m_vw, jnp.zeros_like(v), v)
+        u = sel(m_ug, s_of(u), u)
+    elif param.bcTop == OUTFLOW:
+        u = sel(m_ug, s_of(u), u)
+        v = sel(m_vw, s_of(v), v)
+    return u, v
+
+
+def set_special_bc_ragged(u, param, comm: CartComm, jl: int, il: int,
+                          jmax: int, imax: int, dy, idx_dtype):
+    """setSpecialBoundaryCondition (solver.c:339-357) masked by global
+    index; replicates the reference's dcavity lid loop-bound quirk (skips
+    i == imax)."""
+    gj, gi = global_index_vectors(comm, jl, il)
+    if param.name == "dcavity":
+        m = (gj == jmax + 1) & (gi >= 1) & (gi <= imax - 1)
+        return jnp.where(m, 2.0 - jnp.roll(u, 1, axis=0), u)
+    if param.name in ("canal", "canal_obstacle"):
+        joff = get_offsets("j", jl)
+        jj = jnp.arange(jl + 2, dtype=idx_dtype) + joff
+        y = ((jj - 0.5) * dy).astype(u.dtype)
+        prof = (y * (param.ylength - y) * 4.0 / (param.ylength**2))[:, None]
+        m = (gi == 0) & (gj >= 1) & (gj <= jmax)
+        return jnp.where(m, jnp.broadcast_to(prof, u.shape), u)
+    return u
+
+
+def fg_fixups_ragged(f, g, u, v, comm: CartComm, jl: int, il: int,
+                     jmax: int, imax: int):
+    """F/G wall fixups (solver.c:425-435): same-position copies from u/v,
+    masked by global index."""
+    gj, gi = global_index_vectors(comm, jl, il)
+    tan_j = (gj >= 1) & (gj <= jmax)
+    tan_i = (gi >= 1) & (gi <= imax)
+    f = jnp.where(((gi == 0) | (gi == imax)) & tan_j, u, f)
+    g = jnp.where(((gj == 0) | (gj == jmax)) & tan_i, v, g)
+    return f, g
+
+
+def wall_weight_ragged(comm: CartComm, jl: int, il: int,
+                       jmax: int, imax: int, dtype):
+    """normalizePressure weighting: count every global position of the full
+    (jmax+2)x(imax+2) array exactly once across the stacked extended blocks.
+    Owned interior rows carry gj in [1, jmax+1] (the global hi ghost row is
+    interior-stored when ragged); the array-edge ghost rows count only where
+    they ARE the global ghost rows (gj == 0 / jmax+1), which covers the
+    divisible case and zeroes dead trailing edges."""
+    gj, gi = global_index_vectors(comm, jl, il)
+    lj = jnp.arange(jl + 2, dtype=jnp.int32)[:, None]
+    li = jnp.arange(il + 2, dtype=jnp.int32)[None, :]
+    # the global hi ghost row is interior-stored exactly when the axis is
+    # ragged; count it at the array edge only when it is NOT (else the next
+    # shard's lo edge would double-count it) — static per axis
+    Pj = comm.axis_size("j")
+    Pi = comm.axis_size("i")
+    edge_j = [0] if jmax + 1 <= Pj * jl else [0, jmax + 1]
+    edge_i = [0] if imax + 1 <= Pi * il else [0, imax + 1]
+
+    def axis_own(l, g, loc_n, gmax, edges):
+        owned = (l >= 1) & (l <= loc_n) & (g <= gmax + 1)
+        at_edge = (l == 0) | (l == loc_n + 1)
+        edge_ok = jnp.zeros_like(owned)
+        for e in edges:
+            edge_ok = edge_ok | (g == e)
+        return owned | (at_edge & edge_ok)
+
+    own_j = axis_own(lj, gj, jl, jmax, edge_j)
+    own_i = axis_own(li, gi, il, imax, edge_i)
+    return (own_j & own_i).astype(dtype)

@@ -1,0 +1,127 @@
+"""Ragged (pad-with-mask) NS-3D wall handling — the 3-D twin of
+parallel/ragged2d.py.
+
+Global-index masked forms of the 6-face BC application
+(ops/ns3d.set_boundary_conditions_3d), the special BCs, and the F/G/H wall
+fixups, for ceil-divided ("k","j","i") meshes where the HI walls may sit
+anywhere inside (or before) trailing shards. Value arithmetic mirrors
+ops/ns3d.py exactly (same face application order, same staggered write
+positions, same tangential clips), so a ragged run tracks the
+single-device trajectory to reduction order.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.ns3d import FACES, NOSLIP, OUTFLOW, PERIODIC, SLIP
+from .comm import CartComm, get_offsets
+
+AXIS_NAMES = ("k", "j", "i")
+
+
+def global_index_grids(comm: CartComm, kl: int, jl: int, il: int):
+    """Broadcastable (gk, gj, gi) of the (kl+2, jl+2, il+2) extended block."""
+    koff = get_offsets("k", kl)
+    joff = get_offsets("j", jl)
+    ioff = get_offsets("i", il)
+    gk = (jnp.arange(kl + 2, dtype=jnp.int32) + koff)[:, None, None]
+    gj = (jnp.arange(jl + 2, dtype=jnp.int32) + joff)[None, :, None]
+    gi = (jnp.arange(il + 2, dtype=jnp.int32) + ioff)[None, None, :]
+    return gk, gj, gi
+
+
+def live_masks_3d(comm: CartComm, kl, jl, il, kmax, jmax, imax, dtype):
+    """Multiply-mask zeroing DEAD cells beyond the global ghost ring."""
+    gk, gj, gi = global_index_grids(comm, kl, jl, il)
+    live = (gk <= kmax + 1) & (gj <= jmax + 1) & (gi <= imax + 1)
+    return live.astype(dtype)
+
+
+def set_bcs_3d_ragged(u, v, w, bcs, comm: CartComm, kl, jl, il,
+                      kmax, jmax, imax):
+    """set_boundary_conditions_3d as global-index selects; same face
+    iteration order and staggered positions (wall normal at g == gmax on HI
+    faces, tangential ghosts at g == gmax+1; both at 0 on LO faces)."""
+    g = global_index_grids(comm, kl, jl, il)
+    gmaxes = (kmax, jmax, imax)
+    fields = {0: w, 1: v, 2: u}
+
+    def tan_clip(axis):
+        m = True
+        for a in (0, 1, 2):
+            if a == axis:
+                continue
+            m = m & (g[a] >= 1) & (g[a] <= gmaxes[a])
+        return m
+
+    for face, kind in bcs.items():
+        axis, side = FACES[face]
+        if side == "lo":
+            wall = ghost = g[axis] == 0
+            step = -1  # inner neighbour is one index up -> roll by -1
+        else:
+            wall = g[axis] == gmaxes[axis]
+            ghost = g[axis] == gmaxes[axis] + 1
+            step = 1
+        clip = tan_clip(axis)
+        m_wall = wall & clip
+        m_ghost = ghost & clip
+        normal = fields[axis]
+        t_axes = [a for a in (0, 1, 2) if a != axis]
+
+        def inner(arr):
+            return jnp.roll(arr, step, axis=axis)
+
+        if kind == NOSLIP:
+            fields[axis] = jnp.where(m_wall, jnp.zeros_like(normal), normal)
+            for a in t_axes:
+                fields[a] = jnp.where(m_ghost, -inner(fields[a]), fields[a])
+        elif kind == SLIP:
+            fields[axis] = jnp.where(m_wall, jnp.zeros_like(normal), normal)
+            for a in t_axes:
+                fields[a] = jnp.where(m_ghost, inner(fields[a]), fields[a])
+        elif kind == OUTFLOW:
+            fields[axis] = jnp.where(m_wall, inner(normal), normal)
+            for a in t_axes:
+                fields[a] = jnp.where(m_ghost, inner(fields[a]), fields[a])
+        elif kind == PERIODIC:
+            pass
+    return fields[2], fields[1], fields[0]
+
+
+def set_special_bc_3d_ragged(u, problem, comm: CartComm, kl, jl, il,
+                             kmax, jmax, imax):
+    """setSpecialBoundaryCondition (solver.c:579-602) masked by global
+    index, replicating the reference's dcavity loop-bound quirk (skips the
+    last interior i and k)."""
+    gk, gj, gi = global_index_grids(comm, kl, jl, il)
+    if problem == "dcavity":
+        m = (
+            (gj == jmax + 1)
+            & (gk >= 1) & (gk <= kmax - 1)
+            & (gi >= 1) & (gi <= imax - 1)
+        )
+        return jnp.where(m, 2.0 - jnp.roll(u, 1, axis=1), u)
+    if problem == "canal":
+        m = (
+            (gi == 0)
+            & (gk >= 1) & (gk <= kmax)
+            & (gj >= 1) & (gj <= jmax)
+        )
+        return jnp.where(m, jnp.full_like(u, 2.0), u)
+    return u
+
+
+def fgh_fixups_ragged(f, g_, h, u, v, w, comm: CartComm, kl, jl, il,
+                      kmax, jmax, imax):
+    """F/G/H wall fixups (solver.c:771-823): same-position copies from
+    u/v/w on both walls of each axis, tangentially clipped."""
+    gk, gj, gi = global_index_grids(comm, kl, jl, il)
+    tan_ji = (gj >= 1) & (gj <= jmax) & (gi >= 1) & (gi <= imax)
+    tan_ki = (gk >= 1) & (gk <= kmax) & (gi >= 1) & (gi <= imax)
+    tan_kj = (gk >= 1) & (gk <= kmax) & (gj >= 1) & (gj <= jmax)
+    f = jnp.where(((gi == 0) | (gi == imax)) & tan_kj, u, f)
+    g_ = jnp.where(((gj == 0) | (gj == jmax)) & tan_ki, v, g_)
+    h = jnp.where(((gk == 0) | (gk == kmax)) & tan_ji, w, h)
+    return f, g_, h

@@ -135,25 +135,37 @@ def ca_rb_iters(p, rhs, n: int, masks, factor, idx2, idy2):
     return p, _owned_r2(r_red, r_blk, masks)
 
 
-def rb_exchange_per_sweep(p, rhs, masks, comm: CartComm, factor, idx2, idy2):
+def rb_exchange_per_sweep(p, rhs, masks, comm: CartComm, factor, idx2, idy2,
+                          ragged: bool = False):
     """Extent-1-safe fallback: one red-black iteration with the classic
     exchange-per-half-sweep choreography on the halo=1 layout (a depth-2
     strip structurally needs neighbour-of-neighbour data a single ppermute
     cannot provide when a shard extent is 1). Same arithmetic pieces as
-    ca_rb_iters — bitwise parity holds on this path too."""
+    ca_rb_iters — bitwise parity holds on this path too. Ragged layouts
+    refresh the halos once more before the wall copy: the wall-ghost row
+    can open a dead shard whose Neumann source is a neighbour's row (see
+    ca_halo)."""
     red = masks["red"][1:-1, 1:-1]
     black = masks["black"][1:-1, 1:-1]
     p = halo_exchange(p, comm)
     p, r_red = ca_half_sweep(p, rhs, red, factor, idx2, idy2)
     p = halo_exchange(p, comm)
     p, r_blk = ca_half_sweep(p, rhs, black, factor, idx2, idy2)
+    if ragged:
+        p = halo_exchange(p, comm)
     p = neumann_masked(p, masks)
     return p, _owned_r2(r_red, r_blk, masks)
 
 
-def ca_halo(n: int) -> int:
-    """Halo depth consumed by n fused red-black iterations."""
-    return 2 * n
+def ca_halo(n: int, ragged: bool = False) -> int:
+    """Halo depth consumed by n fused red-black iterations. Ragged
+    decompositions need ONE extra layer: the wall-ghost row gj == jmax+1
+    can start a fully-dead shard, so its Neumann refresh (after 2n
+    half-sweeps) reads the INNERMOST halo cell — that cell must carry a
+    validity budget of 2n half-sweeps, i.e. sit at halo depth 2n+1. In
+    divisible layouts the refresh only ever reads owned cells (the wall
+    shard's own interior edge) and 2n suffices."""
+    return 2 * n + (1 if ragged else 0)
 
 
 def ca_supported(*local_extents) -> bool:

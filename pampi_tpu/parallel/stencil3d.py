@@ -127,14 +127,17 @@ def ca_rb_iters_3d(p, rhs, n: int, masks, factor, idx2, idy2, idz2):
 
 
 def rb_exchange_per_sweep_3d(p, rhs, masks, comm: CartComm,
-                             factor, idx2, idy2, idz2):
+                             factor, idx2, idy2, idz2, ragged: bool = False):
     """Extent-1-safe fallback on the halo=1 layout (see
-    stencil2d.rb_exchange_per_sweep)."""
+    stencil2d.rb_exchange_per_sweep; ragged refreshes halos once more
+    before the wall copy — the wall ghost plane can open a dead shard)."""
     odd = masks["odd"][1:-1, 1:-1, 1:-1]
     even = masks["even"][1:-1, 1:-1, 1:-1]
     p = halo_exchange(p, comm)
     p, r_odd = ca_half_sweep_3d(p, rhs, odd, factor, idx2, idy2, idz2)
     p = halo_exchange(p, comm)
     p, r_evn = ca_half_sweep_3d(p, rhs, even, factor, idx2, idy2, idz2)
+    if ragged:
+        p = halo_exchange(p, comm)
     p = neumann_masked_3d(p, masks)
     return p, _owned_r2_3d(r_odd, r_evn, masks)

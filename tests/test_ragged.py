@@ -1,0 +1,221 @@
+"""Ragged (non-divisible) decomposition: grid-aware dims_create + the
+pad-with-mask policy (round-4 capability close of VERDICT item 2).
+
+The reference runs ANY grid on ANY rank count via the remainder-spread
+sizeOfRank (assignment-6/src/comm.c:19-22); uniform XLA shardings instead
+(a) pick a factorization the grid divides when one exists (grid-aware
+dims_create) and (b) otherwise ceil-divide into uniform blocks whose
+trailing dead cells the global-coordinate masks exclude from updates,
+residuals, walls and collection."""
+
+import numpy as np
+import pytest
+
+from pampi_tpu.models.poisson import PoissonSolver
+from pampi_tpu.models.poisson_dist import DistPoissonSolver
+from pampi_tpu.parallel.comm import CartComm, dims_create
+from pampi_tpu.utils.params import Parameter
+
+
+def test_dims_create_grid_aware():
+    # blind MPI_Dims_create stays non-increasing-balanced
+    assert dims_create(8, 2) == (4, 2)
+    assert dims_create(8, 3) == (2, 2, 2)
+    # the reference's own canal.par (200x50) on 8 devices: the blind (4,2)
+    # would need 50 % 4 == 0 — grid-aware picks the feasible (2,4)
+    assert dims_create(8, 2, (50, 200)) == (2, 4)
+    # canal3d.par (200x50x50): a fully-divisible factorization is chosen
+    dims = dims_create(8, 3, (50, 50, 200))
+    assert all(e % p == 0 for e, p in zip((50, 50, 200), dims))
+    # perfect ties keep the round-3 ordering (no churn on square grids)
+    assert dims_create(8, 2, (4096, 4096)) == (4, 2)
+    assert dims_create(8, 3, (128, 128, 128)) == (2, 2, 2)
+
+
+def test_local_shape_ragged_ceil():
+    comm = CartComm(ndims=2, dims=(4, 2))
+    assert comm.local_shape((52, 52)) == (13, 26)
+    with pytest.raises(ValueError):
+        comm.local_shape((50, 50))
+    assert comm.local_shape((50, 50), ragged=True) == (13, 25)
+
+
+@pytest.mark.parametrize("dims,shape", [
+    ((4, 2), (50, 50)),   # ragged along j (13*4 = 52 > 50)
+    ((2, 4), (50, 54)),   # ragged along i (14*4 = 56 > 54)
+    ((8, 1), (18, 16)),   # ragged 1-D rows incl. a nearly-dead last shard
+])
+def test_ragged_poisson_matches_single_device(dims, shape):
+    jmax, imax = shape
+    param = Parameter(imax=imax, jmax=jmax, itermax=120, eps=1e-30, omg=1.8)
+    single = PoissonSolver(param, problem=2)
+    it_s, res_s = single.solve()
+    dist = DistPoissonSolver(param, CartComm(ndims=2, dims=dims), problem=2)
+    assert dist.ragged
+    it_d, res_d = dist.solve()
+    assert it_d == it_s
+    assert res_d == pytest.approx(res_s, rel=1e-12)
+    np.testing.assert_allclose(
+        dist.full_field(), np.asarray(single.p), rtol=0, atol=1e-11
+    )
+
+
+def test_ragged_resume_matches_one_long_solve():
+    param = dict(imax=18, jmax=18, eps=1e-30, omg=1.7)
+    long = DistPoissonSolver(
+        Parameter(itermax=60, **param), CartComm(ndims=2, dims=(4, 2))
+    )
+    long.solve()
+    short = DistPoissonSolver(
+        Parameter(itermax=30, **param), CartComm(ndims=2, dims=(4, 2))
+    )
+    short.solve()
+    short.solve()
+    np.testing.assert_array_equal(long.full_field(), short.full_field())
+
+
+def test_ragged_refuses_structured_direct_solvers():
+    with pytest.raises(ValueError, match="ragged"):
+        DistPoissonSolver(
+            Parameter(imax=50, jmax=50, tpu_solver="mg"),
+            CartComm(ndims=2, dims=(4, 2)),
+        )
+
+
+@pytest.mark.parametrize("dims,shape", [
+    ((4, 2), (18, 20)),   # ragged along j
+    ((2, 4), (20, 18)),   # ragged along i
+    ((8, 1), (18, 16)),   # wall ghost row opens a fully-dead shard
+])
+def test_ragged_ns2d_dcavity_matches_single(reference_dir, dims, shape):
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.utils.params import read_parameter
+
+    jmax, imax = shape
+    param = read_parameter(
+        str(reference_dir / "assignment-5" / "sequential" / "dcavity.par")
+    ).replace(te=0.02, imax=imax, jmax=jmax, itermax=60)
+    single = NS2DSolver(param)
+    single.run(progress=False)
+    dist = NS2DDistSolver(param, CartComm(ndims=2, dims=dims))
+    assert dist.ragged
+    dist.run(progress=False)
+    assert dist.nt == single.nt > 1
+    ud, vd, pd = dist.fields()
+    np.testing.assert_array_equal(np.asarray(single.u), ud)
+    np.testing.assert_array_equal(np.asarray(single.v), vd)
+    np.testing.assert_array_equal(np.asarray(single.p), pd)
+
+
+def test_ragged_ns2d_canal_matches_single(reference_dir):
+    """Canal exercises OUTFLOW walls + the global-y parabolic inflow on a
+    ragged mesh (50 rows over 4 j-shards)."""
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.utils.params import read_parameter
+
+    param = read_parameter(
+        str(reference_dir / "assignment-5" / "sequential" / "canal.par")
+    ).replace(te=0.2, itermax=40)
+    single = NS2DSolver(param)
+    single.run(progress=False)
+    dist = NS2DDistSolver(param, CartComm(ndims=2, dims=(4, 2)))
+    assert dist.ragged  # 50 % 4 != 0
+    dist.run(progress=False)
+    assert dist.nt == single.nt > 1
+    ud, vd, pd = dist.fields()
+    np.testing.assert_array_equal(np.asarray(single.u), ud)
+    np.testing.assert_array_equal(np.asarray(single.v), vd)
+    np.testing.assert_array_equal(np.asarray(single.p), pd)
+
+
+def test_ragged_ns2d_refuses_obstacles_and_direct_solvers(reference_dir):
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.utils.params import read_parameter
+
+    param = read_parameter(
+        str(reference_dir / "assignment-5" / "sequential" / "dcavity.par")
+    ).replace(imax=18, jmax=18, tpu_solver="fft")
+    with pytest.raises(ValueError, match="ragged"):
+        NS2DDistSolver(param, CartComm(ndims=2, dims=(4, 2)))
+
+
+@pytest.mark.parametrize("dims,shape", [
+    ((4, 2, 1), (10, 10, 12)),  # ragged along k
+    ((1, 2, 4), (10, 10, 18)),  # ragged along i
+])
+def test_ragged_ns3d_dcavity_matches_single(reference_dir, dims, shape):
+    from pampi_tpu.models.ns3d import NS3DSolver
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.utils.params import read_parameter
+
+    kmax, jmax, imax = shape
+    param = read_parameter(
+        str(reference_dir / "assignment-6" / "dcavity.par")
+    ).replace(te=2.5, imax=imax, jmax=jmax, kmax=kmax, itermax=40)
+    single = NS3DSolver(param)
+    single.run(progress=False)
+    dist = NS3DDistSolver(param, CartComm(ndims=3, dims=dims))
+    assert dist.ragged
+    dist.run(progress=False)
+    assert dist.nt == single.nt > 1
+    for a, b in zip(single.collect(), dist.collect()):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-11, rtol=0
+        )
+
+
+def test_ragged_ns3d_canal_matches_single(reference_dir):
+    from pampi_tpu.models.ns3d import NS3DSolver
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.utils.params import read_parameter
+
+    param = read_parameter(
+        str(reference_dir / "assignment-6" / "canal.par")
+    ).replace(te=1.0, imax=18, jmax=10, kmax=10, itermax=30)
+    single = NS3DSolver(param)
+    single.run(progress=False)
+    dist = NS3DDistSolver(param, CartComm(ndims=3, dims=(2, 1, 4)))
+    assert dist.ragged
+    dist.run(progress=False)
+    assert dist.nt == single.nt > 1
+    for a, b in zip(single.collect(), dist.collect()):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-11, rtol=0
+        )
+
+
+def test_canal3d_par_runs_under_auto_mesh(reference_dir):
+    """canal3d.par (200x50x50) auto-meshes to a feasible factorization on
+    the 8-device pool (VERDICT round-3 'Done' criterion)."""
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.utils.params import read_parameter
+
+    param = read_parameter(str(reference_dir / "assignment-6" / "canal.par"))
+    solver = NS3DDistSolver(param.replace(te=0.0))
+    assert all(
+        e % p == 0
+        for e, p in zip((50, 50, 200), solver.comm.dims)
+    ), solver.comm.dims
+    assert not solver.ragged
+
+
+def test_canal_par_runs_under_auto_mesh(reference_dir):
+    """The VERDICT round-3 repro: the reference's committed canal.par
+    (200x50) failed under tpu_mesh auto on 8 devices. Grid-aware auto now
+    picks a feasible mesh and the run proceeds."""
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.utils.params import read_parameter
+
+    param = read_parameter(
+        str(reference_dir / "assignment-5" / "sequential" / "canal.par")
+    ).replace(te=0.05, itermax=20)
+    solver = NS2DDistSolver(param)  # auto mesh from the 8-device CPU pool
+    assert all(
+        e % p == 0 for e, p in zip((50, 200), solver.comm.dims)
+    ), solver.comm.dims
+    solver.run(progress=False)
+    assert solver.nt > 0
