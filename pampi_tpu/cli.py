@@ -128,13 +128,31 @@ def _dispatch(param, prof) -> int:
         )
         return 1
 
-    if param.tpu_solver in ("sor_lex", "sor_rba") and not param.name.startswith(
-        "poisson"
-    ):
-        # the assignment-4 oracle modes; NS pressure solves use sor/mg/fft
+    from .utils.params import is_3d_config
+
+    ns3d = is_3d_config(param)
+    if param.tpu_solver == "sor_rba" and not param.name.startswith("poisson"):
+        # the assignment-4 separable-ω oracle; NS pressure solves don't
+        # have it (sor_lex IS available on NS-2D — the capped-trajectory
+        # ordering oracle, tools/northstar.py match4096)
         print(
-            f"Error: tpu_solver {param.tpu_solver} is a Poisson-only oracle "
-            "mode; NS problems take sor|mg|fft",
+            "Error: tpu_solver sor_rba is a Poisson-only oracle mode; "
+            "NS problems take sor|sor_lex|mg|fft",
+            file=sys.stderr,
+        )
+        return 1
+    if param.tpu_solver == "sor_lex" and ns3d:
+        print(
+            "Error: tpu_solver sor_lex is 2-D only (Poisson and NS-2D); "
+            "NS-3D takes sor|mg|fft",
+            file=sys.stderr,
+        )
+        return 1
+
+    if param.tpu_chunk < 0 or param.tpu_lookahead < 0:
+        print(
+            "Error: tpu_chunk and tpu_lookahead must be >= 0 "
+            f"(got {param.tpu_chunk}, {param.tpu_lookahead})",
             file=sys.stderr,
         )
         return 1

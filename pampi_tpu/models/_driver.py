@@ -21,7 +21,8 @@ def _is_transient_device_fault(exc) -> bool:
     return type(exc).__name__ == "JaxRuntimeError" and "UNAVAILABLE" in str(exc)
 
 
-def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None):
+def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
+                 lookahead: int = 0):
     """Run `state = chunk_fn(*state)` while state[time_index] <= te
     (main.c:43-60 loop semantics: a step runs whenever t <= te at its start).
 
@@ -30,14 +31,46 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None):
     pallas's). In the None case a TRANSIENT device fault still gets one
     same-chunk retry (inputs are unchanged — the loop is functional) before
     re-raising. on_state(state) fires after every successful chunk — the
-    host-sync / checkpoint hook point. Returns the final state."""
+    host-sync / checkpoint hook point. Returns the final state (the first
+    whose time exceeds te).
+
+    lookahead > 0 pipelines the dispatch: up to lookahead+1 chunks stay in
+    flight (the one being confirmed plus `lookahead` queued behind it — so
+    lookahead=0 is one in flight, the serial case) and the host reads the
+    loop time only from the OLDEST of them,
+    so the per-chunk host<->device round trip (the dominant end-to-end cost
+    under a high-latency tunnel — measured 27.7 vs the chip's 12.7 ms/step
+    at dcavity 4096^2) overlaps the younger chunks' device execution. Safe
+    by construction: a chunk dispatched past te is a device no-op (its own
+    while-cond sees t > te and passes the state through), so speculative
+    overshoot never advances the simulation, and the (undonated) input
+    buffers stay alive for the retry path. On any failure the pipeline
+    resets to the last CONFIRMED state — the one-shot retry protocol is
+    unchanged, it just may re-dispatch the speculative tail. lookahead=0 is
+    exactly the historical dispatch-then-sync loop."""
     transient_budget = 1
-    while float(state[time_index]) <= te:
+    if float(state[time_index]) > te:
+        bar.stop()
+        return state
+    from collections import deque
+
+    pending = deque()  # in-flight states, oldest first
+    confirmed = state  # last state whose time read succeeded
+    newest = state
+    final = None
+    while final is None:
         try:
-            new = chunk_fn(*state)
-            # force completion: async pallas faults surface here
-            float(new[time_index])
+            if len(pending) <= lookahead:
+                newest = chunk_fn(*newest)
+                pending.append(newest)
+                continue
+            old = pending.popleft()
+            # force completion of the oldest in-flight chunk: async pallas
+            # faults surface here, overlapped with the younger dispatches
+            t_old = float(old[time_index])
         except Exception as exc:
+            pending.clear()
+            newest = confirmed
             new_fn = retry()
             if new_fn is None:
                 if transient_budget > 0 and _is_transient_device_fault(exc):
@@ -52,12 +85,14 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None):
                 raise
             chunk_fn = new_fn
             continue
-        state = new
-        bar.update(float(state[time_index]))
+        confirmed = old
+        bar.update(t_old)
         if on_state is not None:
-            on_state(state)
+            on_state(old)
+        if t_old > te:
+            final = old
     bar.stop()
-    return state
+    return final
 
 
 def pallas_retry(solver, what: str):

@@ -36,7 +36,8 @@ from ..utils.progress import Progress
 
 def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
                         backend: str = "auto", n_inner: int = 1,
-                        solver: str = "sor", layout: str = "auto"):
+                        solver: str = "sor", layout: str = "auto",
+                        stall_rtol=None):
     """Pressure-Poisson solve loop (solve, solver.c:140-191): carry
     (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep.
 
@@ -60,15 +61,27 @@ def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
     if solver == "mg":
         from ..ops.multigrid import make_mg_solve_2d
 
-        return make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype)
+        return make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype,
+                                stall_rtol=stall_rtol, backend=backend)
     if solver == "fft":
         from ..ops.dctpoisson import make_dct_solve_2d
 
         return make_dct_solve_2d(imax, jmax, dx, dy, dtype)
+    if solver == "sor_lex":
+        # the reference's LEXICOGRAPHIC solve (assignment-5/sequential/src/
+        # solver.c:159-176) as an oracle mode: on itermax-capped configs the
+        # capped trajectory depends on the sweep ORDERING, so C-vs-framework
+        # field comparisons at fixed step count need this path, not rb
+        # (tools/northstar.py match4096). Always the jnp scan program
+        # (ops/sor.lex_sweep), f64-capable, never pallas.
+        from .poisson import make_solver_fn
+
+        return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax,
+                              dtype, backend="jnp", method="lex")
     if solver != "sor":
         raise ValueError(
-            f"NS pressure solve supports sor|mg|fft, got {solver!r} "
-            "(sor_lex/sor_rba are Poisson-only oracle modes)"
+            f"NS pressure solve supports sor|sor_lex|mg|fft, got {solver!r} "
+            "(sor_rba is a Poisson-only oracle mode)"
         )
     from .poisson import make_solver_fn
 
@@ -102,10 +115,12 @@ class NS2DSolver:
         # flag-field obstacles (ops/obstacle.py): static geometry -> static
         # masks baked into the traced step as constants (branch-free)
         if param.obstacles.strip():
-            if param.tpu_solver == "fft":
+            if param.tpu_solver in ("fft", "sor_lex"):
                 raise ValueError(
-                    "tpu_solver fft cannot solve obstacle flag fields (the "
-                    "stencil is not constant-coefficient); use sor or mg"
+                    f"tpu_solver {param.tpu_solver} cannot solve obstacle "
+                    "flag fields (fft: non-constant coefficients; sor_lex: "
+                    "the lex oracle has no eps-coefficient form); use sor "
+                    "or mg"
                 )
             validate_obstacle_layout(param.tpu_sor_layout)
             from ..ops import obstacle as obst
@@ -120,10 +135,11 @@ class NS2DSolver:
 
     def _uses_pallas(self) -> bool:
         """Whether the current chunk's pressure solve dispatches to pallas
-        (both the uniform and the flag-masked solver go through the same
-        backend probe; jnp-dispatched dtypes/backends never do; the mg and
-        fft solvers contain no pallas kernel at all)."""
-        if self.param.tpu_solver in ("mg", "fft"):
+        (the uniform solver, the flag-masked solver, and mg's fine-level
+        smoother all go through the same backend probe; jnp-dispatched
+        dtypes/backends never do; fft and the always-jnp sor_lex oracle
+        contain no pallas kernel at all)."""
+        if self.param.tpu_solver in ("fft", "sor_lex"):
             return False
         from .poisson import _use_pallas
 
@@ -155,6 +171,7 @@ class NS2DSolver:
                 n_inner=param.tpu_sor_inner,
                 solver=param.tpu_solver,
                 layout=param.tpu_sor_layout,
+                stall_rtol=param.tpu_mg_stall_rtol,
             )
         elif param.tpu_solver == "mg":
             # obstacle-capable multigrid: rediscretized eps-coefficient
@@ -165,6 +182,7 @@ class NS2DSolver:
             solve = make_obstacle_mg_solve_2d(
                 param.imax, param.jmax, dx, dy, param.eps, param.itermax,
                 masks, dtype,
+                stall_rtol=param.tpu_mg_stall_rtol, backend=backend,
             )
         else:
             from ..ops import obstacle as obst
@@ -237,7 +255,7 @@ class NS2DSolver:
     def _build_chunk(self, backend: str = "auto"):
         step = self._build_step(backend)
         te = self.param.te
-        chunk = self.CHUNK
+        chunk = self.param.tpu_chunk or self.CHUNK
 
         def chunk_fn(u, v, p, t, nt):
             def cond(c):
@@ -279,7 +297,8 @@ class NS2DSolver:
                 on_sync(self)
 
         state = drive_chunks(state, self._chunk_fn, self.param.te, 3, bar,
-                             pallas_retry(self, "pressure solve"), on_state)
+                             pallas_retry(self, "pressure solve"), on_state,
+                             lookahead=self.param.tpu_lookahead)
         publish(state)
 
     def write_result(

@@ -108,7 +108,8 @@ def _use_pallas_3d(backend: str, dtype) -> bool:
 
 def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
                            dtype, backend: str = "auto", n_inner: int = 1,
-                           solver: str = "sor", layout: str = "auto"):
+                           solver: str = "sor", layout: str = "auto",
+                           stall_rtol=None):
     """Convergence loop for the 3-D pressure solve. solver="sor" (default,
     the reference's algorithm): backend="auto" dispatches to the fused Pallas
     kernel (ops/sor3d_pallas.py) on a real TPU chip and to the jnp half-sweep
@@ -124,7 +125,7 @@ def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
         from ..ops.multigrid import make_mg_solve_3d
 
         return make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
-                                dtype)
+                                dtype, stall_rtol=stall_rtol)
     if solver == "fft":
         from ..ops.dctpoisson import make_dct_solve_3d
 
@@ -293,6 +294,7 @@ class NS3DSolver:
                 backend=backend, n_inner=param.tpu_sor_inner,
                 solver=param.tpu_solver,
                 layout=param.tpu_sor_layout,
+                stall_rtol=param.tpu_mg_stall_rtol,
             )
         bcs = {
             "top": param.bcTop,
@@ -351,7 +353,7 @@ class NS3DSolver:
     def _build_chunk(self, backend: str = "auto"):
         step = self._build_step(backend)
         te = self.param.te
-        chunk = self.CHUNK
+        chunk = self.param.tpu_chunk or self.CHUNK
 
         def chunk_fn(u, v, w, p, t, nt):
             def cond(c):
@@ -389,7 +391,7 @@ class NS3DSolver:
 
         state = drive_chunks(state, self._chunk_fn, self.param.te, 4, bar,
                              pallas_retry(self, "3-D pressure solve"),
-                             on_state)
+                             on_state, lookahead=self.param.tpu_lookahead)
         publish(state)
 
     def collect(self):

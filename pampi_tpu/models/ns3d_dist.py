@@ -339,20 +339,19 @@ class NS3DDistSolver:
             solve = make_dist_mg_solve_3d(
                 comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                 param.eps, param.itermax, dtype,
+                stall_rtol=param.tpu_mg_stall_rtol,
             )
         elif self.masks is not None:
             from ..ops.obstacle3d import make_dist_obstacle_solver_3d
 
-            solve = make_dist_obstacle_solver_3d(
+            solve, obs_pallas = make_dist_obstacle_solver_3d(
                 comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                 param.eps, param.itermax, self.masks, dtype,
                 ca_n=param.tpu_ca_inner, sor_inner=param.tpu_sor_inner,
             )
-            # relax check_vma when the obstacle solver dispatched its
-            # per-shard Pallas kernel (recorded at build time)
-            pallas_o = pallas_o or (
-                (_dispatch.last("obstacle3d_dist") or "").startswith("pallas")
-            )
+            # relax check_vma when the obstacle solver reports it
+            # dispatched its per-shard Pallas kernel
+            pallas_o = pallas_o or obs_pallas
             self._pallas_o = pallas_o
         elif rb_o is not None:
             solve = _solve_sor_octants

@@ -125,9 +125,11 @@ def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
 
                 def step(p_stacked, rhs_stacked):
                     p_stacked, rsq = rb_iter(p_stacked, rhs_stacked)
-                    # bf16 storage accumulates the residual in f32; cast to
-                    # the carry dtype (identity for f32/f64)
-                    return p_stacked, (rsq / norm).astype(dtype)
+                    # bf16 storage accumulates the residual in f32 — keep
+                    # it there: the convergence scalar must not be
+                    # re-quantized to 8 mantissa bits on its way to the
+                    # res >= eps² check (the loop carries res at >= f32)
+                    return p_stacked, rsq / norm
 
                 def prep(x):
                     return sp.pad_quarters(x, brq, h)
@@ -300,6 +302,7 @@ def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
     would (the extra iterations only lower the residual further). `it`
     reports the true iteration count on every path."""
     epssq = eps * eps
+    res_dtype = jnp.promote_types(dtype, jnp.float32)
     if method == "lex":
         step = make_lex_step(imax, jmax, dx, dy, omega, dtype)
         prep = post = lambda x: x  # noqa: E731
@@ -323,6 +326,10 @@ def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
         def body(carry):
             p, _, it = carry
             p, res = step(p, rhs)
+            # carry the convergence scalar at f32 or wider regardless of the
+            # storage dtype (a scalar costs nothing; bf16 would re-quantize
+            # the kernels' deliberately-f32 residual accumulation)
+            res = res.astype(res_dtype)
             if _flags.debug():
                 # ≙ -DDEBUG "%d Residuum: %e" (solver.c:169-171); 0-based
                 # index of the last completed iteration, like the reference.
@@ -335,7 +342,8 @@ def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
                     jax.debug.print("{} Residuum: {}", it + (eff - 1), res)
             return p, res, it + eff
 
-        init = (prep(p0), jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32))
+        init = (prep(p0), jnp.asarray(1.0, res_dtype),
+                jnp.asarray(0, jnp.int32))
         p, res, it = jax.lax.while_loop(cond, body, init)
         return post(p), res, it
 
@@ -364,6 +372,7 @@ class PoissonSolver:
             return make_mg_solve_2d(
                 self.imax, self.jmax, self.dx, self.dy,
                 self.param.eps, self.param.itermax, self.dtype,
+                stall_rtol=self.param.tpu_mg_stall_rtol, backend=backend,
             )
         if self.param.tpu_solver == "fft":
             from ..ops.dctpoisson import make_dct_solve_2d

@@ -164,6 +164,7 @@ class DistPoissonSolver:
             direct_solve = make_dist_mg_solve_2d(
                 comm, self.imax, self.jmax, jl, il, dx, dy,
                 param.eps, itermax, dtype,
+                stall_rtol=param.tpu_mg_stall_rtol,
             )
         elif param.tpu_solver == "fft":
             from ..ops.dctpoisson import make_dist_dct_solve_2d

@@ -62,21 +62,33 @@ def mg_levels(*extents, min_size: int = 4):
 # itermax cycles (500 cycles x ~2 ms at 2048x512) is pure waste. The loop
 # stops when the residual changed less than MG_STALL_RTOL relative over one
 # cycle; a genuinely converging cycle changes it ~10x, so the detector
-# cannot mistake progress for a stall.
+# cannot mistake progress for a stall. Overridable per run via the .par key
+# `tpu_mg_stall_rtol` (0 disables the detector entirely — itermax-parity
+# with the reference's capped solves — every make_*_mg_solve factory takes
+# the value as `stall_rtol`).
 MG_STALL_RTOL = 1e-4
 
 
-def _stalled(prev, res, it):
+def _stalled(prev, res, it, rtol=MG_STALL_RTOL):
     """The stall predicate — single home; the single-device and distributed
-    loops share it so their stopping contracts cannot drift."""
+    loops share it so their stopping contracts cannot drift. `rtol` is
+    static at trace time; rtol<=0 compiles the detector away; None means
+    the module default (callers plumbing a .par key pass it verbatim)."""
+    if rtol is None:
+        rtol = MG_STALL_RTOL
+    if rtol <= 0:
+        return jnp.full((), False)
     return jnp.logical_and(
-        it >= 2, jnp.abs(prev - res) <= MG_STALL_RTOL * res
+        it >= 2, jnp.abs(prev - res) <= rtol * res
     )
 
 
-def _mg_converge_loop(vcycle, residual_of, norm, eps, itermax, dtype):
+def _mg_converge_loop(vcycle, residual_of, norm, eps, itermax, dtype,
+                      stall_rtol=MG_STALL_RTOL):
     """The shared MG convergence loop: `(p, rhs) -> (p, res, it)` with the
-    SOR solve contract PLUS the stall detector above. `residual_of(p, rhs)`
+    SOR solve contract PLUS the stall detector above — a solve may return
+    res > eps² before itermax when the residual flatlines (stall_rtol
+    relative change per cycle; <=0 disables). `residual_of(p, rhs)`
     returns the interior residual array of the fine level."""
     epssq = eps * eps
 
@@ -85,7 +97,7 @@ def _mg_converge_loop(vcycle, residual_of, norm, eps, itermax, dtype):
             p, res, prev, it = c
             return jnp.logical_and(
                 jnp.logical_and(res >= epssq, it < itermax),
-                jnp.logical_not(_stalled(prev, res, it)),
+                jnp.logical_not(_stalled(prev, res, it, stall_rtol)),
             )
 
         def body(c):
@@ -148,6 +160,51 @@ def _smooth2(p, rhs, masks, factor, idx2, idy2, n):
     return p
 
 
+# Fine-level smoothing dominates MG cost (round-3 measurement: plain MG at
+# 4096^2 f32 322.7 ms/step, obstacle MG at 2048x512 90.9 — jnp sweeps), so
+# levels at least this many interior cells dispatch the temporal-blocked
+# Pallas kernel instead (same arithmetic, n sweeps per HBM round trip).
+# Below it the jnp sweeps are already cheap and the kernel's pad/unpad
+# envelope would dominate.
+_PALLAS_SMOOTH_MIN_CELLS = 512 * 256
+
+
+def _pallas_smoother_2d(il, jl, dxl, dyl, dtype, n, fluid=None,
+                        backend="auto"):
+    """Build `smooth(p_ext, rhs_ext) -> p_ext`: n ω=1 red-black sweeps via
+    the temporal-blocked Pallas kernel (ops/sor_pallas.make_rb_iter_tblock;
+    fluid!=None switches to the flag-masked obstacle stencil) — the same
+    per-iteration arithmetic as _smooth2 / sor_pass_obstacle with the
+    Neumann refresh fused. Returns None whenever ineligible (no TPU, wide
+    dtype, VMEM-infeasible, or a level too small to pay the pad/unpad
+    envelope) — callers keep the jnp sweeps then. backend="pallas" forces
+    (interpret off-TPU: the test mode) and skips the size threshold."""
+    from ..models.poisson import _use_pallas
+
+    if n < 1 or not _use_pallas(backend, dtype):
+        return None
+    if backend != "pallas" and il * jl < _PALLAS_SMOOTH_MIN_CELLS:
+        return None
+    from . import sor_pallas as sp
+
+    try:
+        # interpret resolves inside the maker (real kernel on TPU,
+        # interpret elsewhere — the forced-backend test mode)
+        rb, br, h = sp.make_rb_iter_tblock(
+            il, jl, dxl, dyl, 1.0, dtype, n_inner=n, fluid=fluid,
+        )
+    except ValueError:
+        return None
+    if rb is None:
+        return None
+
+    def smooth(p, rhs):
+        pp, _ = rb(sp.pad_array(p, br, h), sp.pad_array(rhs, br, h))
+        return sp.unpad_array(pp, jl, il, h)
+
+    return smooth
+
+
 def _restrict2(r):
     """Full-weighting for cell-centered grids: mean of each 2x2 block."""
     J, I = r.shape
@@ -166,13 +223,17 @@ def _embed2(interior):
 
 
 def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
-                      n_pre: int = 2, n_post: int = 2):
+                      n_pre: int = 2, n_post: int = 2,
+                      backend: str = "auto"):
     """Build `vcycle(p_ext, rhs_ext) -> p_ext` on the fine extended grid.
     Level geometry doubles the spacing each coarsening (cell-centered).
     The coarsest level is solved EXACTLY by DCT diagonalization
     (ops/dctpoisson.py, MXU matmuls) — no unrolled coarse smoothing, and an
     odd-extent bottom grid (e.g. 100² stops at 25²) costs the same handful
-    of matmuls as a tiny one."""
+    of matmuls as a tiny one. Large levels smooth through the
+    temporal-blocked Pallas kernel when eligible (_pallas_smoother_2d: same
+    red-black ω=1 arithmetic, n sweeps per HBM round trip); small levels
+    and non-TPU runs keep the jnp sweeps."""
     from .dctpoisson import poisson_dct_2d
     from .sor import checkerboard_mask
 
@@ -193,8 +254,21 @@ def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
                     checkerboard_mask(jl, il, 0, dtype),
                     checkerboard_mask(jl, il, 1, dtype),
                 ),
+                sm={
+                    n: _pallas_smoother_2d(il, jl, dxl, dyl, dtype, n,
+                                           backend=backend)
+                    for n in {n_pre, n_post} if n
+                },
             )
         )
+
+    def smooth(p, rhs, lvl, n):
+        c = cfg[lvl]
+        k = c["sm"].get(n)
+        if k is not None:
+            return k(p, rhs)
+        return _smooth2(p, rhs, c["masks"], c["factor"],
+                        c["idx2"], c["idy2"], n)
 
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
@@ -204,30 +278,32 @@ def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
             # direct solution simply replaces it, constants aside)
             sol = poisson_dct_2d(rhs[1:-1, 1:-1], c["dx"], c["dy"])
             return _neumann2(jnp.zeros_like(p).at[1:-1, 1:-1].set(sol))
-        p = _smooth2(p, rhs, c["masks"], c["factor"],
-                     c["idx2"], c["idy2"], n_pre)
+        p = smooth(p, rhs, lvl, n_pre)
         r = _residual2(p, rhs, c["idx2"], c["idy2"])
         r2 = _restrict2(r)
         e2 = vcycle(_embed2(jnp.zeros_like(r2)), _embed2(r2), lvl + 1)
         p = p.at[1:-1, 1:-1].add(_prolong2(e2[1:-1, 1:-1]))
         p = _neumann2(p)
-        return _smooth2(p, rhs, c["masks"], c["factor"],
-                        c["idx2"], c["idy2"], n_post)
+        return smooth(p, rhs, lvl, n_post)
 
     return vcycle
 
 
 def make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype,
-                     n_pre: int = 2, n_post: int = 2):
+                     n_pre: int = 2, n_post: int = 2,
+                     stall_rtol=MG_STALL_RTOL, backend: str = "auto"):
     """Convergence loop with the SOR solve contract:
     `(p_ext, rhs_ext) -> (p_ext, res, it)` where res = Σr²/(imax·jmax) of
     the state BEFORE the last cycle's smoothing — evaluated fresh per cycle —
-    and `it` counts V-cycles."""
-    vcycle = make_mg_vcycle_2d(imax, jmax, dx, dy, dtype, n_pre, n_post)
+    and `it` counts V-cycles. NOTE the contract addition over SOR: the loop
+    also stops when the residual stalls (`stall_rtol` relative change per
+    cycle, .par key tpu_mg_stall_rtol; 0 restores pure eps/itermax)."""
+    vcycle = make_mg_vcycle_2d(imax, jmax, dx, dy, dtype, n_pre, n_post,
+                               backend)
     idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
     return _mg_converge_loop(
         vcycle, lambda p, rhs: _residual2(p, rhs, idx2, idy2),
-        float(imax * jmax), eps, itermax, dtype,
+        float(imax * jmax), eps, itermax, dtype, stall_rtol,
     )
 
 
@@ -320,9 +396,11 @@ def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
 
 
 def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
-                     n_pre: int = 2, n_post: int = 2):
+                     n_pre: int = 2, n_post: int = 2,
+                     stall_rtol=MG_STALL_RTOL):
     """3-D twin of make_mg_solve_2d (same solve contract as
-    models/ns3d.make_pressure_solve_3d; `it` counts V-cycles)."""
+    models/ns3d.make_pressure_solve_3d; `it` counts V-cycles; stalls stop
+    the loop early per `stall_rtol` — see make_mg_solve_2d)."""
     vcycle = make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
                                n_pre, n_post)
     idx2 = 1.0 / (dx * dx)
@@ -330,7 +408,7 @@ def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
     idz2 = 1.0 / (dz * dz)
     return _mg_converge_loop(
         vcycle, lambda p, rhs: _residual3(p, rhs, idx2, idy2, idz2),
-        float(imax * jmax * kmax), eps, itermax, dtype,
+        float(imax * jmax * kmax), eps, itermax, dtype, stall_rtol,
     )
 
 
@@ -374,13 +452,18 @@ def _obstacle_residual(p, rhs, m, idx2, idy2):
 
 def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
                               n_pre: int = 2, n_post: int = 2,
-                              n_coarse: int = 60):
+                              n_coarse: int = 60,
+                              stall_rtol=MG_STALL_RTOL,
+                              backend: str = "auto"):
     """Obstacle-capable MG convergence loop:
     `(p_ext, rhs_ext) -> (p_ext, res, it)`, `it` counting V-cycles, residual
     normalized by the FLUID cell count (the contract of
     ops/obstacle.make_obstacle_solver_fn). `masks` is the fine-level
     ObstacleMasks built with the run's ω — smoothing rebuilds every level at
-    ω=1 from the coarsened flags."""
+    ω=1 from the coarsened flags, and large levels dispatch the flag-masked
+    temporal-blocked Pallas kernel (_pallas_smoother_2d — the round-3
+    obstacle headline kernel, now also the MG smoother). Stalled residuals
+    stop the loop early per `stall_rtol` — see make_mg_solve_2d."""
     import numpy as np
 
     from .obstacle import make_masks
@@ -401,6 +484,11 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
                 idy2=1.0 / (dyl * dyl),
                 red=checkerboard_mask(jl, il, 0, dtype),
                 black=checkerboard_mask(jl, il, 1, dtype),
+                sm={
+                    n: _pallas_smoother_2d(il, jl, dxl, dyl, dtype, n,
+                                           fluid=fluid, backend=backend)
+                    for n in {n_pre, n_post} if n
+                },
             )
         )
 
@@ -408,6 +496,9 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
 
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
+        k = c["sm"].get(n)
+        if k is not None:
+            return k(p, rhs)
         for _ in range(n):
             p, _ = sor_pass_obstacle(
                 p, rhs, c["red"], c["m"], c["idx2"], c["idy2"]
@@ -437,7 +528,7 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
         lambda p, rhs: _obstacle_residual(
             p, rhs, fine["m"], fine["idx2"], fine["idy2"]
         ),
-        float(fine["m"].n_fluid), eps, itermax, dtype,
+        float(fine["m"].n_fluid), eps, itermax, dtype, stall_rtol,
     )
 
 
@@ -465,12 +556,14 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
 
 
 def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
-                          dtype, n_pre: int = 2, n_post: int = 2):
+                          dtype, n_pre: int = 2, n_post: int = 2,
+                          stall_rtol=MG_STALL_RTOL):
     """Distributed-MG convergence loop (shard_map kernel side): builds
     `(p_ext, rhs_ext) -> (p_ext, res, it)` on the halo-1 extended local
     block — the same contract as the distributed SOR solve; `it` counts
     V-cycles. The replicated coarse problem is solved EXACTLY by DCT
-    diagonalization on every shard (ops/dctpoisson.py)."""
+    diagonalization on every shard (ops/dctpoisson.py). Stalled residuals
+    stop the loop early per `stall_rtol` — see make_mg_solve_2d."""
     from jax import lax as _lax
 
     from ..parallel.comm import get_offsets, halo_exchange, reduction
@@ -541,7 +634,7 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
             # _stalled: identical stopping contract to the single-device loop
             return jnp.logical_and(
                 jnp.logical_and(res >= epssq, it < itermax),
-                jnp.logical_not(_stalled(prev, res, it)),
+                jnp.logical_not(_stalled(prev, res, it, stall_rtol)),
             )
 
         def body(c):
@@ -567,8 +660,8 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
 
 def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
                           eps, itermax, dtype, n_pre: int = 2,
-                          n_post: int = 2):
-    """3-D twin of make_dist_mg_solve_2d."""
+                          n_post: int = 2, stall_rtol=MG_STALL_RTOL):
+    """3-D twin of make_dist_mg_solve_2d (same stall_rtol contract)."""
     from jax import lax as _lax
 
     from ..parallel.comm import get_offsets, halo_exchange, reduction
@@ -649,7 +742,7 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
             _, res, prev, it = c
             return jnp.logical_and(
                 jnp.logical_and(res >= epssq, it < itermax),
-                jnp.logical_not(_stalled(prev, res, it)),
+                jnp.logical_not(_stalled(prev, res, it, stall_rtol)),
             )
 
         def body(c):
@@ -666,6 +759,159 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
              jnp.asarray(0, jnp.int32)),
         )
         # zero-trip safety; see the 2-D twin
+        return halo_exchange(p, comm), res, it
+
+    return solve
+
+
+def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
+                                   itermax, masks, dtype, n_pre: int = 2,
+                                   n_post: int = 2, n_coarse: int = 60,
+                                   stall_rtol=MG_STALL_RTOL):
+    """Distributed obstacle-capable MG (shard_map kernel side): the
+    composition VERDICT r3 item 6 asked for — the dist-MG skeleton
+    (make_dist_mg_solve_2d) with the obstacle coarsening/rediscretization of
+    make_obstacle_mg_solve_2d. Builds `(p_ext, rhs_ext) -> (p_ext, res, it)`
+    on the halo-1 extended local block; `it` counts V-cycles; residual
+    normalized by the GLOBAL fluid-cell count (the distributed obstacle
+    solve contract, ops/obstacle.make_dist_obstacle_solver).
+
+    Geometry: the GLOBAL flag field coarsens by fluid-ANY per level
+    (coarsen_fluid) and every level rediscretizes the eps-coefficient
+    operator from its own global flags at ω=1 (ops/obstacle.make_masks);
+    each shard slices its block inside the trace (shard_masks), so the
+    distributed smoothing applies the exact single-device sor_pass_obstacle
+    arithmetic between halo exchanges (exchange per half-sweep — the
+    bitwise-parity discipline of stencil2d.ca_masks).
+
+    Bottom level: obstacles rule out the DCT direct solve, so the bottom
+    problem is all_gather'd and smoothed to death REDUNDANTLY on every
+    shard with the single-device bottom arithmetic (n_coarse ω=1 sweeps on
+    the global bottom grid — the same replicated-coarse-solve answer as the
+    uniform dist MG, with smoothing standing in for DCT), then each shard
+    slices its own block back out. Stalled residuals stop the loop early
+    per `stall_rtol` — see make_mg_solve_2d."""
+    import numpy as np
+
+    from jax import lax as _lax
+
+    from ..parallel.comm import get_offsets, halo_exchange, reduction
+    from ..parallel.stencil2d import ca_masks, neumann_masked
+    from .obstacle import (
+        make_masks,
+        obstacle_residual,
+        shard_masks,
+        sor_pass_obstacle,
+    )
+    from .sor import checkerboard_mask
+
+    Pj = comm.axis_size("j")
+    Pi = comm.axis_size("i")
+    levels = mg_levels(jl, il)
+    fine_fluid = np.asarray(masks.fluid).astype(bool)
+    cfg = []
+    fluid = fine_fluid
+    for lvl, (jll, ill) in enumerate(levels):
+        dxl, dyl = dx * (2 ** lvl), dy * (2 ** lvl)
+        if lvl > 0:
+            fluid = coarsen_fluid(fluid)
+        gj, gi = jll * Pj, ill * Pi
+        cfg.append(
+            dict(
+                jl=jll, il=ill, jmax=gj, imax=gi,
+                idx2=1.0 / (dxl * dxl), idy2=1.0 / (dyl * dyl),
+                # GLOBAL ω=1 masks; shards slice inside the trace
+                m=make_masks(fluid, dxl, dyl, 1.0, dtype),
+            )
+        )
+    # global checkerboard for the replicated bottom smoothing — ONLY the
+    # bottom level ever smooths globally, so only its (small) masks exist
+    cb = cfg[-1]
+    cb["red_g"] = checkerboard_mask(cb["jmax"], cb["imax"], 0, dtype)
+    cb["black_g"] = checkerboard_mask(cb["jmax"], cb["imax"], 1, dtype)
+
+    def smooth(p, rhs, lvl, n):
+        c = cfg[lvl]
+        cm = ca_masks(c["jl"], c["il"], 1, c["jmax"], c["imax"], dtype)
+        ml = shard_masks(c["m"], c["jl"], c["il"])
+        red = cm["red"][1:-1, 1:-1]
+        black = cm["black"][1:-1, 1:-1]
+        for _ in range(n):
+            p = halo_exchange(p, comm)
+            p, _ = sor_pass_obstacle(p, rhs, red, ml, c["idx2"], c["idy2"])
+            p = halo_exchange(p, comm)
+            p, _ = sor_pass_obstacle(p, rhs, black, ml, c["idx2"], c["idy2"])
+            p = neumann_masked(p, cm)
+        return p
+
+    def bottom(p, rhs, lvl):
+        # replicated bottom: gather interiors, smooth the global problem on
+        # every shard (identical constants -> identical results), slice own
+        c = cfg[lvl]
+        pg = _lax.all_gather(p[1:-1, 1:-1], "j", axis=0, tiled=True)
+        pg = _lax.all_gather(pg, "i", axis=1, tiled=True)
+        rg = _lax.all_gather(rhs[1:-1, 1:-1], "j", axis=0, tiled=True)
+        rg = _lax.all_gather(rg, "i", axis=1, tiled=True)
+        pe = _neumann2(_embed2(pg))
+        re = _embed2(rg)
+        for _ in range(n_coarse):
+            pe, _ = sor_pass_obstacle(
+                pe, re, c["red_g"], c["m"], c["idx2"], c["idy2"]
+            )
+            pe, _ = sor_pass_obstacle(
+                pe, re, c["black_g"], c["m"], c["idx2"], c["idy2"]
+            )
+            pe = _neumann2(pe)
+        joff = get_offsets("j", c["jl"])
+        ioff = get_offsets("i", c["il"])
+        return _lax.dynamic_slice(
+            pe, (joff, ioff), (c["jl"] + 2, c["il"] + 2)
+        )
+
+    def vcycle(p, rhs, lvl=0):
+        c = cfg[lvl]
+        if lvl == len(levels) - 1:
+            return bottom(p, rhs, lvl)
+        p = smooth(p, rhs, lvl, n_pre)
+        p = halo_exchange(p, comm)  # residual reads shard-edge neighbours
+        ml = shard_masks(c["m"], c["jl"], c["il"])
+        r = obstacle_residual(p, rhs, ml, c["idx2"], c["idy2"])
+        r2 = _restrict2(r)
+        e2 = vcycle(_embed2(jnp.zeros_like(r2)), _embed2(r2), lvl + 1)
+        # inject into fluid cells only (obstacle cells stay untouched)
+        p = p.at[1:-1, 1:-1].add(_prolong2(e2[1:-1, 1:-1]) * ml.p_mask)
+        cm = ca_masks(c["jl"], c["il"], 1, c["jmax"], c["imax"], dtype)
+        p = neumann_masked(p, cm)
+        return smooth(p, rhs, lvl, n_post)
+
+    fine = cfg[0]
+    norm = fine["m"].n_fluid
+    epssq = eps * eps
+
+    def solve(p, rhs):
+        ml = shard_masks(fine["m"], fine["jl"], fine["il"])
+
+        def cond(c):
+            _, res, prev, it = c
+            return jnp.logical_and(
+                jnp.logical_and(res >= epssq, it < itermax),
+                jnp.logical_not(_stalled(prev, res, it, stall_rtol)),
+            )
+
+        def body(c):
+            p, prev, _, it = c
+            p = vcycle(p, rhs)
+            p = halo_exchange(p, comm)
+            r = obstacle_residual(p, rhs, ml, fine["idx2"], fine["idy2"])
+            res = reduction(jnp.sum(r * r), comm, "sum") / norm
+            return p, res, prev, it + 1
+
+        p, res, _, it = lax.while_loop(
+            cond, body,
+            (p, jnp.asarray(1.0, dtype), jnp.asarray(jnp.inf, dtype),
+             jnp.asarray(0, jnp.int32)),
+        )
+        # zero-trip safety; see make_dist_mg_solve_2d
         return halo_exchange(p, comm), res, it
 
     return solve

@@ -364,8 +364,12 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
     On TPU (or backend="pallas": interpret off-TPU, the test mode) the loop
     dispatches the per-shard flag-masked Pallas kernel (ops/sor_obsdist.py)
     at depth max(ca_n, sor_inner); the jnp CA path keeps ca_n so its
-    trajectory granularity is unchanged. Dispatch recorded under
-    "obstacle_dist"."""
+    trajectory granularity is unchanged.
+
+    Returns `(solve, used_pallas)` — callers that need the dispatch
+    decision (e.g. to relax shard_map's check_vma around the pallas_call)
+    read it from the return value; the "obstacle_dist" _dispatch.record is
+    informational only (driver artifacts, tests)."""
     from ..parallel.comm import (
         get_offsets,
         halo_exchange,
@@ -511,7 +515,7 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
         )
         return halo_exchange(strip_deep(pd, H), comm), res, it
 
-    return solve
+    return solve, rb_k is not None
 
 
 def deep_obstacle_masks(m: ObstacleMasks, jl: int, il: int, halo: int):

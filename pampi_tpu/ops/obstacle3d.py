@@ -448,7 +448,11 @@ def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
     exchange buys n exact local red-black iterations (static global masks
     keep redundant halo updates bitwise-consistent). Residual normalized by
     the global fluid-cell count; extent-1 shards fall back to
-    exchange-per-half-sweep."""
+    exchange-per-half-sweep.
+
+    Returns `(solve, used_pallas)` like the 2-D twin — the dispatch
+    decision travels in the return value; the "obstacle3d_dist"
+    _dispatch.record is informational only."""
     import jax as _jax
 
     from ..parallel.comm import halo_exchange, master_print, reduction
@@ -589,4 +593,4 @@ def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
         )
         return halo_exchange(strip_deep(pd, H), comm), res, it
 
-    return solve
+    return solve, rb_k is not None

@@ -221,9 +221,19 @@ class CartComm:
         return tuple(e // p for e, p in zip(global_shape, self.dims))
 
     def shard_map(self, fn, in_specs, out_specs, check_vma: bool = True):
-        # check_vma=False is required when the body dispatches a pallas_call
-        # (its out_shape declares no varying-mesh-axes info — the standard
-        # composition form, validated bitwise on real TPU hardware)
+        """Wrap `jax.shard_map` over this comm's mesh.
+
+        check_vma=False is required ONLY when the traced body dispatches a
+        pallas_call (its out_shape declares no varying-mesh-axes info — the
+        standard composition form, validated bitwise on real TPU hardware).
+        The relaxation is necessarily step-wide (JAX scopes the check per
+        shard_map, not per region), which disables varying-mesh-axes
+        validation for EVERY collective in that body — so callers must NOT
+        widen its use beyond the pallas-dispatch case: every solver keeps a
+        jnp twin of the same step that runs with check_vma=True on the CPU
+        test meshes (test_ns2d_dist/test_ns3d_dist/test_poisson_dist), which
+        is what catches out_spec/ppermute mistakes the relaxed production
+        trace would hide."""
         return jax.shard_map(
             fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=check_vma,

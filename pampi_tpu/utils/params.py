@@ -98,6 +98,10 @@ class Parameter:
     tpu_ca_inner: int = 1
     # pressure/elliptic solver:
     #   "sor"  the reference's algorithm (default; trajectory parity)
+    #   "sor_lex"  the reference's LEXICOGRAPHIC sweep ordering as an
+    #          oracle (NS-2D + Poisson): capped solves then follow the C
+    #          binary's exact iterate sequence — the C-vs-framework field
+    #          comparison mode (tools/northstar.py match4096); jnp-only
     #   "mg"   geometric multigrid V-cycles with an exact DCT bottom solve
     #          (ops/multigrid.py) — O(1) cycles; same eps-residual stopping
     #          contract, `it` counts cycles; single-device or on a mesh
@@ -106,6 +110,25 @@ class Parameter:
     #          exact in ONE application, `it` reports 1
     # mg/fft do not support obstacle flag fields
     tpu_solver: str = "sor"
+    # MG stall detector (tpu_solver mg only): a V-cycle whose residual
+    # changed less than this RELATIVE tolerance is treated as floored and
+    # the solve returns early (ops/multigrid.MG_STALL_RTOL rationale). Set 0
+    # to disable and burn itermax like the reference's capped solves do.
+    tpu_mg_stall_rtol: float = 1e-4
+    # time-loop dispatch pipelining (models/_driver.drive_chunks): up to
+    # this many chunk dispatches queued BEYOND the one the host is
+    # confirming (so lookahead+1 states in flight), hiding the per-chunk
+    # host<->device round trip (under the axon tunnel: 19.4 -> 17.7 ms/step
+    # at dcavity 4096^2 = the latency-cancelled protocol rate). 0 restores
+    # dispatch-then-sync. Progress/checkpoint hooks see every chunk, just
+    # this many chunks late. Cost: lookahead extra state copies on device.
+    tpu_lookahead: int = 2
+    # device steps per chunk dispatch (0 = the model default: 64 2-D, 32
+    # 3-D). An escape hatch for programs the TPU runtime mishandles when
+    # the step is wrapped in a multi-trip chunk loop (observed: 4096^2 f64
+    # sor_lex crashes the TPU worker at any chunk > 1 — scan-in-while f64
+    # at size — while tpu_chunk 1 runs; f32 production runs keep 64).
+    tpu_chunk: int = 0
     # 3-D VTK output mode: "ascii" (reference default), "binary", or
     # "sharded" — the MPI-IO-pattern parallel write (utils/vtkio.py
     # ShardedVtkWriter; binary, byte-identical to "binary"). On a

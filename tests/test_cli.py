@@ -102,3 +102,21 @@ def test_obstacles_rejected_for_poisson_and_3d(tmp_path, monkeypatch, capsys):
     rc = _run(tmp_path, monkeypatch,
               "name dcavity3d\nkmax 8\nobstacles 0.2,0.2,0.4,0.4\n")
     assert rc == 1
+
+
+def test_cli_rejects_negative_chunk_and_lookahead(tmp_path, monkeypatch, capsys):
+    """Negative tpu_chunk would make every chunk dispatch a no-op (the
+    while-cond k < chunk is false from k=0) and spin the driver forever;
+    the CLI validates both keys up front like every other tpu_* key."""
+    for key in ("tpu_chunk", "tpu_lookahead"):
+        rc = _run(tmp_path, monkeypatch, f"""
+name poisson
+imax 8
+jmax 8
+itermax 10
+eps 0.001
+omg 1.7
+{key} -1
+""")
+        assert rc == 1
+        assert "tpu_chunk and tpu_lookahead" in capsys.readouterr().err

@@ -151,3 +151,82 @@ def test_pallas_retry_rebuilds_once_then_gives_up():
 def test_pallas_retry_none_when_pallas_not_in_play():
     s = _FakeSolver(uses_pallas=False)
     assert pallas_retry(s, "x")() is None
+
+
+def test_pipelined_loop_same_results_and_hooks():
+    """lookahead > 0 must not change WHAT runs — same final state, every
+    chunk's state still reaches bar/on_state in order — only WHEN the host
+    syncs. Overshoot chunks past te must be no-ops for the returned state
+    (the real chunk_fn's while-cond guarantees it; the fake honors te)."""
+    te = 2.5
+
+    def chunk(t, n):  # te-guarded like the real device chunk
+        import jax.numpy as jnp
+
+        adv = t <= te
+        return (jnp.where(adv, t + 1.0, t),
+                jnp.where(adv, n + 1, n))
+
+    for la in (1, 2, 5):
+        bar = _Bar()
+        seen = []
+        state = drive_chunks(
+            (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+            chunk, te=te, time_index=0, bar=bar,
+            retry=lambda: None, on_state=seen.append, lookahead=la,
+        )
+        assert float(state[0]) == 3.0 and int(state[1]) == 3
+        assert bar.updates == [1.0, 2.0, 3.0] and bar.stopped
+        assert [float(s[0]) for s in seen] == [1.0, 2.0, 3.0]
+
+
+def test_pipelined_transient_fault_resets_to_confirmed():
+    """A fault inside the pipeline rewinds to the last CONFIRMED state:
+    the simulation replays the unconfirmed tail, never skips or doubles a
+    step (state is t itself, so doubling would show as t jumping)."""
+    te = 3.5
+    calls = {"n": 0}
+
+    def flaky(t, n):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise JaxRuntimeError("UNAVAILABLE: TPU device error")
+        adv = float(t) <= te
+        return (t + 1.0, n + 1) if adv else (t, n)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state = drive_chunks(
+            (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+            flaky, te=te, time_index=0, bar=_Bar(), retry=lambda: None,
+            lookahead=2,
+        )
+    assert float(state[0]) == 4.0 and int(state[1]) == 4
+    assert any("transient" in str(x.message) for x in w)
+
+
+def test_pipelined_zero_trip_returns_initial_state():
+    s0 = (jnp.asarray(9.0), jnp.asarray(7, jnp.int32))
+    bar = _Bar()
+    out = drive_chunks(s0, _advance(), te=2.0, time_index=0, bar=bar,
+                       retry=lambda: None, lookahead=3)
+    assert out is s0 and bar.stopped and bar.updates == []
+
+
+def test_tpu_chunk_override_preserves_results():
+    """tpu_chunk overrides the per-dispatch step count (watchdog escape for
+    slow-step configs) without changing what is computed."""
+    import numpy as np
+
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.utils.params import Parameter
+
+    param = Parameter(name="dcavity", imax=16, jmax=16, re=10.0, te=0.05,
+                      tau=0.5, itermax=200, eps=1e-6, omg=1.7, gamma=0.9)
+    a = NS2DSolver(param)
+    a.run(progress=False)
+    b = NS2DSolver(param.replace(tpu_chunk=3))
+    b.run(progress=False)
+    assert a.nt == b.nt > 3
+    np.testing.assert_array_equal(np.asarray(a.u), np.asarray(b.u))
+    np.testing.assert_array_equal(np.asarray(a.p), np.asarray(b.p))

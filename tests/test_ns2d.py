@@ -141,3 +141,28 @@ def test_bfloat16_run_tracks_float64():
     assert np.isfinite(ulo).all()
     # bf16 has ~3 decimal digits; the flow field is O(1)
     assert np.abs(ulo - uhi).max() < 0.05
+
+
+def test_sor_lex_matches_sor_physics_and_rejects_obstacles():
+    """tpu_solver sor_lex (the C binary's lexicographic ordering as an
+    oracle, tools/northstar.py match4096): on a CONVERGING config the
+    ordering washes out at the solve tolerance, so the physics matches the
+    rb run; obstacle flag fields are rejected (no eps-coefficient form)."""
+    import pytest as _pytest
+
+    param = Parameter(
+        name="dcavity", imax=32, jmax=32, re=10.0, te=0.05, tau=0.5,
+        itermax=2000, eps=1e-6, omg=1.7, gamma=0.9,
+    )
+    a = NS2DSolver(param)
+    a.run(progress=False)
+    b = NS2DSolver(param.replace(tpu_solver="sor_lex"))
+    b.run(progress=False)
+    assert a.nt == b.nt > 1
+    np.testing.assert_allclose(np.asarray(a.u), np.asarray(b.u),
+                               rtol=0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(a.v), np.asarray(b.v),
+                               rtol=0, atol=1e-5)
+    with _pytest.raises(ValueError, match="sor_lex"):
+        NS2DSolver(param.replace(tpu_solver="sor_lex",
+                                 obstacles="0.3,0.3,0.6,0.6"))

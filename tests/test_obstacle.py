@@ -317,7 +317,9 @@ def test_canal_obstacle_dist_matches_single():
         np.testing.assert_array_equal(np.asarray(single.p), pd)
 
 
-def test_obstacle_dist_rejects_mg_fft():
+def test_obstacle_dist_rejects_fft_accepts_mg():
+    """fft structurally cannot solve flag fields on a mesh either; mg now
+    can (make_dist_obstacle_mg_solve_2d, round 4)."""
     import pytest as _pytest
 
     from pampi_tpu.models.ns2d_dist import NS2DDistSolver
@@ -326,10 +328,11 @@ def test_obstacle_dist_rejects_mg_fft():
 
     param = Parameter(
         name="canal_obstacle", imax=32, jmax=16, re=100.0, te=1.0,
-        obstacles="0.3,0.2,0.5,0.4", tpu_solver="mg",
+        obstacles="0.3,0.2,0.5,0.4", tpu_solver="fft",
     )
     with _pytest.raises(ValueError, match="obstacle"):
         NS2DDistSolver(param, CartComm(ndims=2))
+    NS2DDistSolver(param.replace(tpu_solver="mg"), CartComm(ndims=2))  # builds
 
 
 def test_canal_obstacle_dist_ca_inner2():

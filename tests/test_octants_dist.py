@@ -121,12 +121,13 @@ def test_obstacle3d_dist_pallas_bitwise_matches_jnp():
 
     outs = {}
     for backend in ("auto", "pallas"):  # auto on CPU = jnp CA
-        solve = o3.make_dist_obstacle_solver_3d(
+        solve, used_pallas = o3.make_dist_obstacle_solver_3d(
             comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz, 1e-12, 40, m,
             jnp.float64, ca_n=2, sor_inner=2, backend=backend,
         )
         expect = "jnp_ca ca2" if backend == "auto" else "pallas ca2"
         assert dispatch.last("obstacle3d_dist") == expect
+        assert used_pallas == (backend == "pallas")
 
         def kern(p_int, rhs_int, _solve=solve):
             pe = halo_exchange(jnp.pad(p_int, 1), comm)

@@ -186,12 +186,13 @@ def test_obstacle_dist_pallas_bitwise_matches_jnp():
 
     outs = {}
     for backend in ("auto", "pallas"):  # auto on CPU = jnp CA
-        solve = obst.make_dist_obstacle_solver(
+        solve, used_pallas = obst.make_dist_obstacle_solver(
             comm, imax, jmax, jl, il, dx, dy, 1e-12, 60, m, jnp.float64,
             ca_n=2, sor_inner=2, backend=backend,
         )
         expect = "jnp_ca ca2" if backend == "auto" else "pallas ca2"
         assert dispatch.last("obstacle_dist") == expect
+        assert used_pallas == (backend == "pallas")
 
         def kern(p_int, rhs_int, _solve=solve):
             pe = halo_exchange(jnp.pad(p_int, 1), comm)

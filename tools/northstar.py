@@ -117,6 +117,145 @@ def match() -> dict:
     return rec
 
 
+def match4096(steps: int = 50) -> dict:
+    """Field-level C-vs-TPU comparison AT THE NORTH-STAR GRID (VERDICT r3
+    item 3): both drivers run the same generated dcavity 4096^2 .par for a
+    fixed ~`steps`-step interval, f64 both sides, and the .dat fields are
+    held to the `match` artifact's bars. The pressure solves are
+    itermax-capped at this size for ANY solver the reference ships (measured:
+    residual ~1e5 after 20000 sweeps at step 0 — eps is unreachable), so the
+    capped trajectory depends on the sweep ORDERING; the framework side
+    therefore runs `tpu_solver sor_lex` — the reference's lexicographic
+    `solve` (assignment-5/sequential/src/solver.c:159-176) as the oracle
+    mode — so both sides walk the SAME iterate sequence and the comparison
+    is meaningful at the format floor. The SPEED claim stays with the rb
+    quarters path (run4096); this artifact establishes that the framework
+    advances the same physics as the C binary at this size."""
+    import numpy as np
+
+    from pampi_tpu.utils.datio import read_pressure, read_velocity
+
+    N = 4096
+    reynolds, tau = 1000.0, 0.5
+    dx = 1.0 / N
+    dt0 = tau * 0.5 * reynolds / (2.0 / (dx * dx))  # viscous-CFL dt
+    te = (steps + 0.5) * dt0
+    rec = {
+        "artifact": "northstar_field_match_4096",
+        "config": f"dcavity {N}^2, Re=1000, tau=0.5, itermax=100, eps=1e-3,"
+                  f" omg=1.7, te={te:.6e} (~{steps} steps at the"
+                  " viscous-bound dt), float64 BOTH sides",
+        "solver_note": (
+            "both sides run LEXICOGRAPHIC SOR: the C binary natively "
+            "(solver.c:159-176), the framework via tpu_solver sor_lex "
+            "(ops/sor.lex_sweep — the same dependency structure as a "
+            "row-scan + associative within-row recurrence; only the "
+            "floating-point association differs, at rounding level). "
+            "Solves are itermax-capped at this size on both sides, so "
+            "ordering-parity is what makes the capped trajectories "
+            "comparable."
+        ),
+    }
+    base = open(os.path.join(REF_SRC, "dcavity.par")).read()
+
+    def patch(txt, key, val):
+        return re.sub(rf"(?m)^({key}\s+)\S+", rf"\g<1>{val}", txt)
+
+    for key, val in (("imax", N), ("jmax", N), ("re", reynolds),
+                     ("te", f"{te:.9e}"), ("itermax", 100),
+                     ("eps", 0.001), ("omg", 1.7), ("tau", tau)):
+        base = patch(base, key, val)
+    # framework-only keys (prefix-matched C parser skips them). tpu_chunk 1:
+    # the f64 lex-scan step inside a MULTI-trip chunk while_loop crashes the
+    # TPU worker at this size (probed: chunk=4 and 64 crash, a single-trip
+    # chunk and the bare step run fine), so each dispatch carries one step.
+    base += "\ntpu_solver sor_lex\ntpu_dtype float64\ntpu_chunk 1\n"
+
+    # the C side is a ~30-min single-core run: keep its outputs in a cache
+    # dir keyed by the generated .par, so a framework-side failure (or a
+    # rerun) never repeats it. The cache is gitignored scratch, not an
+    # artifact.
+    cache = os.path.join(REPO, ".cache_match4096")
+    os.makedirs(cache, exist_ok=True)
+    par = os.path.join(cache, "dcavity4096.par")
+
+    def c_view(txt):
+        # the C parser ignores tpu_* keys, so framework-only knob changes
+        # must not invalidate the ~30-min cached C run
+        return "".join(ln for ln in txt.splitlines(True)
+                       if not ln.startswith("tpu_"))
+
+    stale = not (os.path.exists(par)
+                 and c_view(open(par).read()) == c_view(base))
+    if stale:
+        with open(par, "w") as f:
+            f.write(base)
+    elif open(par).read() != base:
+        with open(par, "w") as f:
+            f.write(base)
+    cdir = os.path.join(cache, "c")
+    have_c = (not stale
+              and os.path.exists(os.path.join(cdir, "pressure.dat"))
+              and os.path.exists(os.path.join(cdir, "velocity.dat")))
+    with tempfile.TemporaryDirectory() as td:
+        if not have_c:
+            exe = os.path.join(td, "exe-ref")
+            subprocess.run(
+                ["gcc", "-O3", "-std=c99", "-D_GNU_SOURCE", "-o", exe]
+                + sorted(
+                    os.path.join(REF_SRC, "src", f)
+                    for f in os.listdir(os.path.join(REF_SRC, "src"))
+                    if f.endswith(".c")
+                )
+                + ["-lm"],
+                check=True, capture_output=True, text=True,
+            )
+            os.makedirs(cdir, exist_ok=True)
+            t0 = time.perf_counter()
+            cp = subprocess.run([exe, par], cwd=cdir, check=True,
+                                capture_output=True, text=True,
+                                timeout=7200)
+            with open(os.path.join(cdir, "wall.txt"), "w") as f:
+                f.write(f"{time.perf_counter() - t0:.2f}\n"
+                        f"{_solution_took(cp.stdout)}\n")
+        walls = open(os.path.join(cdir, "wall.txt")).read().split()
+        rec["c_wall_s"] = float(walls[0])
+        rec["c_solution_took_s"] = float(walls[1])
+        jdir = os.path.join(td, "j")
+        os.makedirs(jdir)
+
+        # PREPEND the repo (unlike `match`, which replaces PYTHONPATH to
+        # force cpu): the ambient path carries the accelerator plugin's
+        # sitecustomize, and this artifact runs on the real chip
+        inherited = os.environ.get("PYTHONPATH", "")
+        env = {**os.environ,
+               "PYTHONPATH": REPO + (":" + inherited if inherited else "")}
+        t0 = time.perf_counter()
+        jp = subprocess.run([sys.executable, "-m", "pampi_tpu", par],
+                            cwd=jdir, check=True, env=env,
+                            capture_output=True, text=True, timeout=7200)
+        rec["jax_wall_s"] = round(time.perf_counter() - t0, 2)
+        rec["jax_solution_took_s"] = _solution_took(jp.stdout)
+
+        pc = read_pressure(os.path.join(cdir, "pressure.dat"))
+        uc, vc = read_velocity(os.path.join(cdir, "velocity.dat"))
+        pj = read_pressure(os.path.join(jdir, "pressure.dat"))
+        uj, vj = read_velocity(os.path.join(jdir, "velocity.dat"))
+        dp = (pj - pj.mean()) - (pc - pc.mean())
+        rec["max_abs_du"] = float(np.abs(uj - uc).max())
+        rec["max_abs_dv"] = float(np.abs(vj - vc).max())
+        rec["max_abs_dp_mean_adjusted"] = float(np.abs(dp).max())
+        # same bars as `match` (the .dat format floor; see that artifact)
+        rec["bar_uv"] = 1e-6
+        rec["bar_p"] = 5e-6
+        rec["pass"] = bool(
+            round(rec["max_abs_du"], 10) <= 1e-6
+            and round(rec["max_abs_dv"], 10) <= 1e-6
+            and round(rec["max_abs_dp_mean_adjusted"], 10) < 5e-6
+        )
+    return rec
+
+
 def run4096(te: float = 0.15) -> dict:
     import jax
     import jax.numpy as jnp
@@ -135,6 +274,14 @@ def run4096(te: float = 0.15) -> dict:
         tpu_sor_inner=16,
     )
     s = NS2DSolver(param, dtype=jnp.float32)
+    # compile OUTSIDE the timed window (refconfig precedent: the C side's
+    # 'Solution took' is a solver-only timer, main.c:63): one chunk call
+    # from the pristine state, result discarded
+    warm = s._chunk_fn(
+        s.u, s.v, s.p, jnp.asarray(0.0, jnp.float32),
+        jnp.asarray(0, jnp.int32),
+    )
+    float(warm[3])
     t0 = time.perf_counter()
     s.run(progress=True)
     wall = time.perf_counter() - t0
@@ -244,6 +391,10 @@ if __name__ == "__main__":
     if mode == "match":
         rec = match()
         out = os.path.join(RESULTS, "northstar_residual_match.json")
+    elif mode == "match4096":
+        steps = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+        rec = match4096(steps)
+        out = os.path.join(RESULTS, "northstar_field_match_4096.json")
     elif mode == "run4096":
         te = float(sys.argv[2]) if len(sys.argv) > 2 else 0.15
         rec = run4096(te)
@@ -252,7 +403,9 @@ if __name__ == "__main__":
         rec = refconfig()
         out = os.path.join(RESULTS, "northstar_refconfig.json")
     else:
-        raise SystemExit(f"unknown mode {mode!r} (match|run4096|refconfig)")
+        raise SystemExit(
+            f"unknown mode {mode!r} (match|match4096|run4096|refconfig)"
+        )
     with open(out, "w") as fh:
         json.dump(rec, fh, indent=2)
         fh.write("\n")

@@ -1,0 +1,122 @@
+"""Distributed obstacle kernel at PRODUCTION shard size (VERDICT r3 item 4).
+
+Round 3 measured the per-shard flag-masked Pallas kernel
+(ops/sor_obsdist.py) at 4.2G site-updates/s on a 2048x512 shard — 36x off
+the single-device masked kernel — and attributed the gap to per-block fixed
+cost without measuring alternatives. This tool measures, on the real chip
+at the canal_obstacle2048 geometry (2048x512 f32, one shard of a 1x1 mesh —
+the same per-shard workload a v5e-8 run gives each chip):
+
+- the single-device masked tblock kernel (make_obstacle_solver_fn) at
+  several depths — the per-shard ceiling,
+- the distributed solve (make_dist_obstacle_solver auto->pallas) at several
+  CA depths — what the mesh path actually delivers per shard,
+
+using fixed-iteration solves (eps below reach, itermax = ITS) timed
+best-of-REPS after a warm call, so the numbers are comparable like for
+like. Writes results/obsdist2048.json.
+
+Run on the real chip:  python tools/perf_obsdist.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pampi_tpu.utils.params import read_parameter
+
+ITS = 512
+REPS = 5
+PAR = os.path.join(REPO, "configs", "canal_obstacle2048.par")
+
+
+def main() -> dict:
+    from pampi_tpu.ops import obstacle as obst
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.utils import dispatch as _dispatch
+
+    param = read_parameter(PAR)
+    imax, jmax = param.imax, param.jmax
+    dx, dy = param.xlength / imax, param.ylength / jmax
+    DT = jnp.float32
+    fluid = obst.build_fluid(imax, jmax, dx, dy, param.obstacles)
+    m = obst.make_masks(fluid, dx, dy, param.omg, DT)
+    rng = np.random.default_rng(0)
+    p0 = jnp.asarray(rng.standard_normal((jmax + 2, imax + 2)), DT)
+    rhs = jnp.asarray(rng.standard_normal((jmax + 2, imax + 2)), DT)
+    sites = jmax * imax
+
+    def bench(fn):
+        out = fn(p0, rhs)
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            out = fn(p0, rhs)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    rec = {
+        "artifact": "obsdist2048",
+        "config": f"canal_obstacle geometry {jmax}x{imax} f32, fixed "
+                  f"{ITS}-iteration solves, one chip (= one shard's "
+                  "workload), best-of-%d" % REPS,
+        "backend": jax.default_backend(),
+        "single_device": {},
+        "dist_one_shard": {},
+    }
+    for n in (8, 16):
+        solve = jax.jit(obst.make_obstacle_solver_fn(
+            imax, jmax, dx, dy, 1e-30, ITS, m, DT, n_inner=n))
+        t = bench(solve)
+        rec["single_device"][f"n{n}"] = {
+            "s": round(t, 4),
+            "gups": round(sites * ITS / t / 1e9, 1),
+        }
+        print(f"single n{n}: {t*1e3:.1f} ms "
+              f"{rec['single_device'][f'n{n}']['gups']}G", flush=True)
+
+    P = jax.sharding.PartitionSpec
+    for can in (8, 16):
+        comm = CartComm(ndims=2, dims=(1, 1))
+        solve_d, used = obst.make_dist_obstacle_solver(
+            comm, imax, jmax, jmax, imax, dx, dy, 1e-30, ITS, m, DT,
+            ca_n=can, sor_inner=can)
+        tag = _dispatch.last("obstacle_dist")
+
+        def kern(p, r, _s=solve_d):
+            return _s(p, r)
+
+        sm = jax.jit(comm.shard_map(
+            kern, in_specs=(P(), P()), out_specs=(P(), P(), P()),
+            check_vma=not used,
+        ))
+        t = bench(sm)
+        rec["dist_one_shard"][f"ca{can}"] = {
+            "s": round(t, 4),
+            "gups": round(sites * ITS / t / 1e9, 1),
+            "dispatch": tag,
+        }
+        print(f"dist ca{can} [{tag}]: {t*1e3:.1f} ms "
+              f"{rec['dist_one_shard'][f'ca{can}']['gups']}G", flush=True)
+    return rec
+
+
+if __name__ == "__main__":
+    rec = main()
+    out = os.path.join(REPO, "results", "obsdist2048.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=2)
+    print("wrote", out)
