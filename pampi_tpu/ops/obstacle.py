@@ -399,17 +399,24 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
         from ..models.poisson import _use_pallas
 
         if backend == "pallas" or _use_pallas("auto", dtype):
-            n_k = ca_clamp(max(ca_n, sor_inner), jl, il)
-            try:
-                from .sor_obsdist import make_rb_iters_obsdist
+            from .sor_obsdist import make_rb_iters_obsdist
 
-                # interpret resolves off the backend inside the maker
-                # (real kernel on TPU, interpret elsewhere — the test mode)
-                rb_k, br_k, h_k = make_rb_iters_obsdist(
-                    jmax, imax, jl, il, n_k, dx, dy, m.omega, dtype
-                )
-            except ValueError:
-                rb_k = None
+            # back off the depth on VMEM infeasibility (deep n inflates
+            # the kernel's unrolled-sweep stack): a shallower pallas
+            # kernel beats the jnp fallback at any depth
+            n_k = ca_clamp(max(ca_n, sor_inner), jl, il)
+            while n_k >= 1:
+                try:
+                    # interpret resolves off the backend inside the maker
+                    # (real kernel on TPU, interpret elsewhere — the test
+                    # mode)
+                    rb_k, br_k, h_k = make_rb_iters_obsdist(
+                        jmax, imax, jl, il, n_k, dx, dy, m.omega, dtype
+                    )
+                    break
+                except ValueError:
+                    rb_k = None
+                    n_k //= 2
     if rb_k is not None:
         n = n_k
         _dispatch.record("obstacle_dist", f"pallas ca{n}")

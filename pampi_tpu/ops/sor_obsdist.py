@@ -200,6 +200,20 @@ def make_rb_iters_obsdist(jmax, imax, jl, il, n, dx, dy, omega, dtype, *,
             f"exceeds the VMEM budget (block_rows={block_rows}, h={h}, "
             f"wp={wp}); reduce tpu_ca_inner or the shard width"
         )
+    # Mosaic's STACK for the unrolled n-sweep body scales with n in a way
+    # the declared-scratch formula cannot see (each unrolled sweep keeps
+    # window-sized temporaries live). Empirical anchor on v5e f32 at a
+    # 512x2048 shard: n=16 OOMs the scoped vmem at compile (122.05M vs
+    # 117.53M) while n=8 compiles and runs; ~(n+8) live window-sized
+    # buffers reproduces both points. Raise a CATCHABLE error so the
+    # dispatcher can back off the depth instead of crashing at compile.
+    window = (block_rows + 2 * h) * wp * itemsize
+    if window * (n + 8) > VMEM_LIMIT_BYTES:
+        raise ValueError(
+            f"obstacle-dist unrolled-sweep stack estimate "
+            f"{(window * (n + 8)) >> 20} MiB exceeds the VMEM budget at "
+            f"depth n={n} (window {window >> 20} MiB); reduce the depth"
+        )
     nblocks = -(-ext_j // block_rows)
     rp = nblocks * block_rows + 2 * h
     kernel = functools.partial(

@@ -267,3 +267,35 @@ def test_obsdist_kernel_multiblock_matches_jnp_twin():
     ))(pd, rd)
     np.testing.assert_array_equal(np.asarray(k_p), np.asarray(t_p))
     np.testing.assert_allclose(float(k_r), float(t_r), rtol=1e-12)
+
+
+def test_obsdist_depth_backoff_keeps_pallas(monkeypatch):
+    """VMEM infeasibility at deep n must back the depth off (halving) and
+    keep the pallas kernel, not fall to jnp: a shallower kernel beats the
+    jnp CA path at any depth (round-4 anchor: n=16 OOMs Mosaic's unrolled-
+    sweep stack at a 512x2048 shard while n=8 runs at 22.9G)."""
+    from pampi_tpu.ops import obstacle as obst
+    from pampi_tpu.ops import sor_obsdist as so
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.utils import dispatch
+
+    real = so.make_rb_iters_obsdist
+
+    def shallow_only(jmax, imax, jl, il, n, *a, **k):
+        if n > 2:
+            raise ValueError("forced infeasible at deep n")
+        return real(jmax, imax, jl, il, n, *a, **k)
+
+    monkeypatch.setattr(so, "make_rb_iters_obsdist", shallow_only)
+
+    imax = jmax = 32
+    dx = dy = 1.0 / 32
+    fluid = obst.build_fluid(imax, jmax, dx, dy, "0.3,0.3,0.6,0.6")
+    m = obst.make_masks(fluid, dx, dy, 1.7, jnp.float64)
+    comm = CartComm(ndims=2, dims=(1, 1))
+    solve, used = obst.make_dist_obstacle_solver(
+        comm, imax, jmax, jmax, imax, dx, dy, 1e-12, 8, m, jnp.float64,
+        ca_n=8, sor_inner=8, backend="pallas",
+    )
+    assert used
+    assert dispatch.last("obstacle_dist") == "pallas ca2"

@@ -12,9 +12,11 @@ the same per-shard workload a v5e-8 run gives each chip):
 - the distributed solve (make_dist_obstacle_solver auto->pallas) at several
   CA depths — what the mesh path actually delivers per shard,
 
-using fixed-iteration solves (eps below reach, itermax = ITS) timed
-best-of-REPS after a warm call, so the numbers are comparable like for
-like. Writes results/obsdist2048.json.
+using fixed-iteration solves (eps below reach, itermax = ITS) under the
+tunnel timing protocol (SKILL.md): chained solve dispatches fenced by a
+SCALAR readback, per-solve cost by two-point differencing so the
+per-dispatch latency floor (measured up to ~100 ms here) cancels. Writes
+results/obsdist2048.json.
 
 Run on the real chip:  python tools/perf_obsdist.py
 """
@@ -45,6 +47,10 @@ def main() -> dict:
     from pampi_tpu.ops import obstacle as obst
     from pampi_tpu.parallel.comm import CartComm
     from pampi_tpu.utils import dispatch as _dispatch
+    from pampi_tpu.utils import xlacache
+
+    xlacache.enable()  # the big-halo kernels cost ~25 min/compile
+                       # through the remote-compile tunnel
 
     param = read_parameter(PAR)
     imax, jmax = param.imax, param.jmax
@@ -57,16 +63,29 @@ def main() -> dict:
     rhs = jnp.asarray(rng.standard_normal((jmax + 2, imax + 2)), DT)
     sites = jmax * imax
 
+    KA, KB = 1, 9
+
     def bench(fn):
+        # warm (compile) + two-point differencing over chained solves:
+        # per-solve = (t(KB) - t(KA)) / (KB - KA); solves chain through the
+        # p carry so they serialize on device, the scalar fence avoids
+        # transferring the field, and the dispatch-latency floor cancels
         out = fn(p0, rhs)
-        jax.block_until_ready(out)
-        best = float("inf")
-        for _ in range(REPS):
-            t0 = time.perf_counter()
-            out = fn(p0, rhs)
-            jax.block_until_ready(out)
-            best = min(best, time.perf_counter() - t0)
-        return best
+        float(out[1])
+
+        def timed(k):
+            best = float("inf")
+            for _ in range(REPS):
+                t0 = time.perf_counter()
+                p = p0
+                for _ in range(k):
+                    p, res, it = fn(p, rhs)
+                float(res)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        ta, tb = timed(KA), timed(KB)
+        return max((tb - ta) / (KB - KA), 1e-9)
 
     rec = {
         "artifact": "obsdist2048",
@@ -103,7 +122,15 @@ def main() -> dict:
             kern, in_specs=(P(), P()), out_specs=(P(), P(), P()),
             check_vma=not used,
         ))
-        t = bench(sm)
+        try:
+            t = bench(sm)
+        except Exception as e:  # record, don't lose the finished rows
+            msg = str(e).splitlines()[0][:200] if str(e) else type(e).__name__
+            rec["dist_one_shard"][f"ca{can}"] = {
+                "error": msg, "dispatch": tag,
+            }
+            print(f"dist ca{can} [{tag}]: FAILED {e}"[:160], flush=True)
+            continue
         rec["dist_one_shard"][f"ca{can}"] = {
             "s": round(t, 4),
             "gups": round(sites * ITS / t / 1e9, 1),
