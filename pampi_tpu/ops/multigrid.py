@@ -51,6 +51,27 @@ def mg_levels(*extents, min_size: int = 4):
     return levels
 
 
+# The DCT bottom solve is EXACT at any size (a few MXU matmuls), so plain
+# grids stop coarsening once a level fits this budget — each extra tiny
+# level below it buys nothing and costs a chain of launch-bound small ops
+# (the same lesson the obstacle bottom taught at 59x: see
+# _DENSE_BOTTOM_MAX_CELLS). 65536 = 256^2: the DCT matmuls there are
+# negligible next to one fine-grid sweep.
+_DCT_BOTTOM_MAX_CELLS = 65536
+
+
+def _truncate_levels(levels, max_cells, scale: int = 1):
+    """Cut the level plan at the first level whose cell count (×scale — the
+    mesh size for distributed plans, where levels carry LOCAL extents but
+    the bottom is solved globally) fits the bottom budget."""
+    import math
+
+    for idx, ext in enumerate(levels):
+        if math.prod(ext) * scale <= max_cells:
+            return levels[: idx + 1]
+    return levels
+
+
 # Relative-change stall tolerance for the MG convergence loops. Some
 # production solves CANNOT reach eps: the canal configs' outflow BCs make
 # the Neumann RHS inconsistent, so the residual floors at the inconsistency
@@ -237,7 +258,7 @@ def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
     from .dctpoisson import poisson_dct_2d
     from .sor import checkerboard_mask
 
-    levels = mg_levels(jmax, imax)
+    levels = _truncate_levels(mg_levels(jmax, imax), _DCT_BOTTOM_MAX_CELLS)
     cfg = []
     for lvl, (jl, il) in enumerate(levels):
         dxl, dyl = dx * (2 ** lvl), dy * (2 ** lvl)
@@ -353,7 +374,8 @@ def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
     from ..models.ns3d import checkerboard_mask_3d, neumann_faces_3d
     from .dctpoisson import poisson_dct_3d
 
-    levels = mg_levels(kmax, jmax, imax)
+    levels = _truncate_levels(mg_levels(kmax, jmax, imax),
+                              _DCT_BOTTOM_MAX_CELLS)
     cfg = []
     for lvl, (kl, jl, il) in enumerate(levels):
         dxl, dyl, dzl = dx * (2 ** lvl), dy * (2 ** lvl), dz * (2 ** lvl)
@@ -450,6 +472,68 @@ def _obstacle_residual(p, rhs, m, idx2, idy2):
     return obstacle_residual(p, rhs, m, idx2, idy2)
 
 
+# The obstacle MG bottom is solved EXACTLY by a dense pseudo-inverse (the
+# obstacle analog of the uniform MG's DCT bottom — obstacles rule the DCT
+# out, but at the coarsest extents the eps-coefficient operator is a small
+# matrix). Levels stop coarsening once a level fits this budget: measured on
+# v5e at canal_obstacle 2048x512, the previous smooth-to-death bottom (60
+# unrolled sweeps on a 4x16 grid = ~300 launch-bound tiny ops) cost 3.5 of
+# the 5.7 ms/cycle; the pinv matmul replaces it outright, and stopping at
+# <=1024 cells also trims the deepest tiny-op hierarchy levels. pinv cost
+# is trace-time-only (N^3 at N<=1024: seconds, once).
+_DENSE_BOTTOM_MAX_CELLS = 1024
+
+
+def _dense_obstacle_bottom(fluid, dxl, dyl, dtype):
+    """Trace-time pinv of the eps-coefficient all-Neumann operator on the
+    (small) bottom grid: returns `solve_exact(rhs_ext) -> e_ext` computing
+    lap(e) = rhs on fluid cells, e = 0 on obstacle cells, via one matmul.
+    Wall ghosts drop out (Neumann cancels the term — p_ghost = p_edge);
+    the singular all-Neumann system takes the pinv's minimum-norm answer
+    (constants-per-component nullspace, same semantics as the smoothed
+    bottom it replaces)."""
+    import numpy as np
+
+    fl = np.asarray(fluid)[1:-1, 1:-1].astype(bool)
+    J, I = fl.shape
+    N = J * I
+    idx2, idy2 = 1.0 / (dxl * dxl), 1.0 / (dyl * dyl)
+    A = np.zeros((N, N))
+
+    def k(j, i):
+        return j * I + i
+
+    for j in range(J):
+        for i in range(I):
+            kk = k(j, i)
+            if not fl[j, i]:
+                A[kk, kk] = 1.0  # obstacle cell: e stays 0 (rhs is 0 there)
+                continue
+            for dj, di, w in ((0, 1, idx2), (0, -1, idx2),
+                              (1, 0, idy2), (-1, 0, idy2)):
+                jj, ii = j + dj, i + di
+                if not (0 <= jj < J and 0 <= ii < I):
+                    continue  # wall ghost: the Neumann mirror cancels it
+                if not fl[jj, ii]:
+                    continue  # obstacle neighbour: eps coefficient is 0
+                A[kk, k(jj, ii)] += w
+                A[kk, kk] -= w
+    Apinv = jnp.asarray(np.linalg.pinv(A), dtype)
+    # zero the obstacle COLUMNS of the input: the identity rows would
+    # otherwise copy any nonzero rhs at obstacle cells straight into e
+    # (restricted residuals are masked to 0 there, but a single-level plan
+    # hands this solver the caller's RAW rhs)
+    fl_mask = jnp.asarray(fl.reshape(-1), dtype)
+
+    def solve_exact(p, rhs):
+        e = (Apinv @ (rhs[1:-1, 1:-1].reshape(-1) * fl_mask)).reshape(J, I)
+        # the incoming iterate is irrelevant — the direct solution replaces
+        # it (constants aside), exactly like the uniform MG's DCT bottom
+        return _neumann2(jnp.zeros_like(p).at[1:-1, 1:-1].set(e))
+
+    return solve_exact
+
+
 def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
                               n_pre: int = 2, n_post: int = 2,
                               n_coarse: int = 60,
@@ -462,14 +546,21 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
     ObstacleMasks built with the run's ω — smoothing rebuilds every level at
     ω=1 from the coarsened flags, and large levels dispatch the flag-masked
     temporal-blocked Pallas kernel (_pallas_smoother_2d — the round-3
-    obstacle headline kernel, now also the MG smoother). Stalled residuals
+    obstacle headline kernel, now also the MG smoother). The bottom level
+    is solved EXACTLY by the dense pinv (_dense_obstacle_bottom) and the
+    level plan stops at _DENSE_BOTTOM_MAX_CELLS; `n_coarse` smoothing is
+    the fallback only when the pinv is unavailable. Stalled residuals
     stop the loop early per `stall_rtol` — see make_mg_solve_2d."""
     import numpy as np
 
     from .obstacle import make_masks
     from .sor import checkerboard_mask
 
-    levels = mg_levels(jmax, imax)
+    # the dense bottom replaces coarsening below its budget: a bigger exact
+    # bottom AND fewer launch-bound tiny levels (the 60-sweep smoothed
+    # bottom was 3.5 of 5.7 ms/cycle at 2048x512 — ~300 tiny ops)
+    levels = _truncate_levels(mg_levels(jmax, imax),
+                              _DENSE_BOTTOM_MAX_CELLS)
     fine_fluid = np.asarray(masks.fluid).astype(bool)
     cfg = []
     fluid = fine_fluid
@@ -494,6 +585,16 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
 
     from .obstacle import sor_pass_obstacle
 
+    jl_b, il_b = levels[-1]
+    lvl_b = len(levels) - 1
+    bottom_exact = (
+        _dense_obstacle_bottom(
+            cfg[-1]["m"].fluid, dx * 2 ** lvl_b, dy * 2 ** lvl_b, dtype,
+        )
+        if jl_b * il_b <= _DENSE_BOTTOM_MAX_CELLS
+        else None  # plan could not coarsen into budget: smoothed fallback
+    )
+
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
         k = c["sm"].get(n)
@@ -512,6 +613,8 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
         if lvl == len(cfg) - 1:
+            if bottom_exact is not None:
+                return bottom_exact(p, rhs)
             return smooth(p, rhs, lvl, n_coarse)
         p = smooth(p, rhs, lvl, n_pre)
         r = _obstacle_residual(p, rhs, c["m"], c["idx2"], c["idy2"])
@@ -572,7 +675,8 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
 
     Pj = comm.axis_size("j")
     Pi = comm.axis_size("i")
-    levels = mg_levels(jl, il)
+    levels = _truncate_levels(mg_levels(jl, il), _DCT_BOTTOM_MAX_CELLS,
+                              Pj * Pi)
     cfg = []
     for lvl, (jll, ill) in enumerate(levels):
         dxl, dyl = dx * (2 ** lvl), dy * (2 ** lvl)
@@ -676,7 +780,8 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
     Pk = comm.axis_size("k")
     Pj = comm.axis_size("j")
     Pi = comm.axis_size("i")
-    levels = mg_levels(kl, jl, il)
+    levels = _truncate_levels(mg_levels(kl, jl, il), _DCT_BOTTOM_MAX_CELLS,
+                              Pk * Pj * Pi)
     cfg = []
     for lvl, (kll, jll, ill) in enumerate(levels):
         dxl, dyl, dzl = dx * (2 ** lvl), dy * (2 ** lvl), dz * (2 ** lvl)
@@ -785,12 +890,12 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
     bitwise-parity discipline of stencil2d.ca_masks).
 
     Bottom level: obstacles rule out the DCT direct solve, so the bottom
-    problem is all_gather'd and smoothed to death REDUNDANTLY on every
-    shard with the single-device bottom arithmetic (n_coarse ω=1 sweeps on
-    the global bottom grid — the same replicated-coarse-solve answer as the
-    uniform dist MG, with smoothing standing in for DCT), then each shard
-    slices its own block back out. Stalled residuals stop the loop early
-    per `stall_rtol` — see make_mg_solve_2d."""
+    problem is all_gather'd and solved REDUNDANTLY on every shard — exactly
+    via the dense pinv of the global bottom operator
+    (_dense_obstacle_bottom, one small matmul; n_coarse ω=1 sweeps only as
+    the fallback when the global bottom exceeds the pinv budget) — then
+    each shard slices its own block back out. Stalled residuals stop the
+    loop early per `stall_rtol` — see make_mg_solve_2d."""
     import numpy as np
 
     from jax import lax as _lax
@@ -807,7 +912,10 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
 
     Pj = comm.axis_size("j")
     Pi = comm.axis_size("i")
-    levels = mg_levels(jl, il)
+    # stop coarsening once the GLOBAL bottom fits the dense-pinv budget
+    # (same reasoning as the single-device plan truncation)
+    levels = _truncate_levels(mg_levels(jl, il), _DENSE_BOTTOM_MAX_CELLS,
+                              Pj * Pi)
     fine_fluid = np.asarray(masks.fluid).astype(bool)
     cfg = []
     fluid = fine_fluid
@@ -824,11 +932,17 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
                 m=make_masks(fluid, dxl, dyl, 1.0, dtype),
             )
         )
-    # global checkerboard for the replicated bottom smoothing — ONLY the
-    # bottom level ever smooths globally, so only its (small) masks exist
+    # replicated bottom machinery — ONLY the bottom level works globally
     cb = cfg[-1]
-    cb["red_g"] = checkerboard_mask(cb["jmax"], cb["imax"], 0, dtype)
-    cb["black_g"] = checkerboard_mask(cb["jmax"], cb["imax"], 1, dtype)
+    lvl_b = len(levels) - 1
+    if cb["jmax"] * cb["imax"] <= _DENSE_BOTTOM_MAX_CELLS:
+        bottom_exact = _dense_obstacle_bottom(
+            cb["m"].fluid, dx * 2 ** lvl_b, dy * 2 ** lvl_b, dtype,
+        )
+    else:
+        bottom_exact = None  # smoothed fallback needs the checkerboards
+        cb["red_g"] = checkerboard_mask(cb["jmax"], cb["imax"], 0, dtype)
+        cb["black_g"] = checkerboard_mask(cb["jmax"], cb["imax"], 1, dtype)
 
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
@@ -845,7 +959,7 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
         return p
 
     def bottom(p, rhs, lvl):
-        # replicated bottom: gather interiors, smooth the global problem on
+        # replicated bottom: gather interiors, solve the global problem on
         # every shard (identical constants -> identical results), slice own
         c = cfg[lvl]
         pg = _lax.all_gather(p[1:-1, 1:-1], "j", axis=0, tiled=True)
@@ -854,14 +968,17 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
         rg = _lax.all_gather(rg, "i", axis=1, tiled=True)
         pe = _neumann2(_embed2(pg))
         re = _embed2(rg)
-        for _ in range(n_coarse):
-            pe, _ = sor_pass_obstacle(
-                pe, re, c["red_g"], c["m"], c["idx2"], c["idy2"]
-            )
-            pe, _ = sor_pass_obstacle(
-                pe, re, c["black_g"], c["m"], c["idx2"], c["idy2"]
-            )
-            pe = _neumann2(pe)
+        if bottom_exact is not None:
+            pe = bottom_exact(pe, re)
+        else:
+            for _ in range(n_coarse):
+                pe, _ = sor_pass_obstacle(
+                    pe, re, c["red_g"], c["m"], c["idx2"], c["idy2"]
+                )
+                pe, _ = sor_pass_obstacle(
+                    pe, re, c["black_g"], c["m"], c["idx2"], c["idy2"]
+                )
+                pe = _neumann2(pe)
         joff = get_offsets("j", c["jl"])
         ioff = get_offsets("i", c["il"])
         return _lax.dynamic_slice(
