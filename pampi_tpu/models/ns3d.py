@@ -250,10 +250,10 @@ class NS3DSolver:
         # flag-field obstacles (ops/obstacle3d.py): static geometry -> static
         # masks baked into the traced step as constants (branch-free)
         if param.obstacles.strip():
-            if param.tpu_solver in ("mg", "fft"):
+            if param.tpu_solver == "fft":
                 raise ValueError(
-                    f"tpu_solver {param.tpu_solver} does not support "
-                    "obstacle flag fields; use tpu_solver sor"
+                    "tpu_solver fft cannot solve obstacle flag fields (the "
+                    "stencil is not constant-coefficient); use sor or mg"
                 )
             validate_obstacle_layout(param.tpu_sor_layout)
             from ..ops import obstacle3d as obst3
@@ -279,7 +279,17 @@ class NS3DSolver:
         dtype = self.dtype
         dx, dy, dz = g.dx, g.dy, g.dz
         masks = self.masks
-        if masks is not None:
+        if masks is not None and param.tpu_solver == "mg":
+            # 3-D obstacle multigrid (round 4): rediscretized
+            # eps-coefficient operator per level, exact dense bottom
+            from ..ops.multigrid import make_obstacle_mg_solve_3d
+
+            solve = make_obstacle_mg_solve_3d(
+                g.imax, g.jmax, g.kmax, dx, dy, dz,
+                param.eps, param.itermax, masks, dtype,
+                stall_rtol=param.tpu_mg_stall_rtol,
+            )
+        elif masks is not None:
             from ..ops.obstacle3d import make_obstacle_solver_fn_3d
 
             solve = make_obstacle_solver_fn_3d(

@@ -1032,3 +1032,170 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
         return halo_exchange(p, comm), res, it
 
     return solve
+
+
+# ----------------------------------------------------------------------
+# Obstacle multigrid (3-D): the same design as the 2-D obstacle MG —
+# fluid-ANY flag coarsening, per-level rediscretized eps-coefficient
+# operators at ω=1, dense exact bottom — with the 3-D stencil machinery
+# (ops/obstacle3d.py) and 2×2×2 transfer operators.
+# ----------------------------------------------------------------------
+
+
+def coarsen_fluid_3d(fluid: "np.ndarray") -> "np.ndarray":
+    """(K+2, J+2, I+2) bool flags -> coarse: interior cell fluid iff ANY of
+    its 2x2x2 fine cells is (the conservative choice, as in 2-D); the ghost
+    shell stays fluid."""
+    import numpy as np
+
+    fi = fluid[1:-1, 1:-1, 1:-1]
+    K, J, I = fi.shape
+    ci = fi.reshape(K // 2, 2, J // 2, 2, I // 2, 2).any(axis=(1, 3, 5))
+    out = np.ones((K // 2 + 2, J // 2 + 2, I // 2 + 2), dtype=bool)
+    out[1:-1, 1:-1, 1:-1] = ci
+    return out
+
+
+def _dense_obstacle_bottom_3d(fluid, dxl, dyl, dzl, dtype):
+    """3-D twin of _dense_obstacle_bottom: trace-time pinv of the 6-point
+    eps-coefficient all-Neumann operator on the (small) bottom grid."""
+    import numpy as np
+
+    fl = np.asarray(fluid)[1:-1, 1:-1, 1:-1].astype(bool)
+    K, J, I = fl.shape
+    N = K * J * I
+    idx2 = 1.0 / (dxl * dxl)
+    idy2 = 1.0 / (dyl * dyl)
+    idz2 = 1.0 / (dzl * dzl)
+    A = np.zeros((N, N))
+
+    def idx(k, j, i):
+        return (k * J + j) * I + i
+
+    for k in range(K):
+        for j in range(J):
+            for i in range(I):
+                kk = idx(k, j, i)
+                if not fl[k, j, i]:
+                    A[kk, kk] = 1.0
+                    continue
+                for dk, dj, di, w in (
+                    (0, 0, 1, idx2), (0, 0, -1, idx2),
+                    (0, 1, 0, idy2), (0, -1, 0, idy2),
+                    (1, 0, 0, idz2), (-1, 0, 0, idz2),
+                ):
+                    k2, j2, i2 = k + dk, j + dj, i + di
+                    if not (0 <= k2 < K and 0 <= j2 < J and 0 <= i2 < I):
+                        continue  # wall ghost: Neumann cancels the term
+                    if not fl[k2, j2, i2]:
+                        continue  # obstacle neighbour: eps is 0
+                    A[kk, idx(k2, j2, i2)] += w
+                    A[kk, kk] -= w
+    Apinv = jnp.asarray(np.linalg.pinv(A), dtype)
+    fl_mask = jnp.asarray(fl.reshape(-1), dtype)
+
+    def solve_exact(p, rhs):
+        e = (Apinv @ (rhs[1:-1, 1:-1, 1:-1].reshape(-1) * fl_mask))
+        e = e.reshape(K, J, I)
+        from ..models.ns3d import neumann_faces_3d
+
+        return neumann_faces_3d(
+            jnp.zeros_like(p).at[1:-1, 1:-1, 1:-1].set(e)
+        )
+
+    return solve_exact
+
+
+def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
+                              masks, dtype, n_pre: int = 2, n_post: int = 2,
+                              n_coarse: int = 60,
+                              stall_rtol=MG_STALL_RTOL):
+    """3-D obstacle-capable MG convergence loop
+    `(p_ext, rhs_ext) -> (p_ext, res, it)` — the 3-D twin of
+    make_obstacle_mg_solve_2d: fluid-ANY coarsening (coarsen_fluid_3d),
+    every level rediscretized at ω=1 from its own flags
+    (ops/obstacle3d.make_masks_3d), residual normalized by the FLUID cell
+    count, exact dense bottom (_dense_obstacle_bottom_3d; `n_coarse`
+    smoothing only as the over-budget fallback). `it` counts V-cycles;
+    stalls stop the loop early per `stall_rtol` — see make_mg_solve_2d."""
+    import numpy as np
+
+    from ..models.ns3d import checkerboard_mask_3d, neumann_faces_3d
+    from .obstacle3d import (
+        make_masks_3d,
+        obstacle_residual_3d,
+        sor_pass_obstacle_3d,
+    )
+
+    levels = _truncate_levels(mg_levels(kmax, jmax, imax),
+                              _DENSE_BOTTOM_MAX_CELLS)
+    fine_fluid = np.asarray(masks.fluid).astype(bool)
+    cfg = []
+    fluid = fine_fluid
+    for lvl, (kl, jl, il) in enumerate(levels):
+        dxl, dyl, dzl = dx * 2 ** lvl, dy * 2 ** lvl, dz * 2 ** lvl
+        if lvl > 0:
+            fluid = coarsen_fluid_3d(fluid)
+        cfg.append(
+            dict(
+                m=make_masks_3d(fluid, dxl, dyl, dzl, 1.0, dtype),
+                idx2=1.0 / (dxl * dxl),
+                idy2=1.0 / (dyl * dyl),
+                idz2=1.0 / (dzl * dzl),
+                # odd-then-even: the sweep order of the 3-D obstacle SOR
+                # solver (make_obstacle_solver_fn_3d)
+                odd=checkerboard_mask_3d(kl, jl, il, 1, dtype),
+                even=checkerboard_mask_3d(kl, jl, il, 0, dtype),
+            )
+        )
+
+    kl_b, jl_b, il_b = levels[-1]
+    lvl_b = len(levels) - 1
+    bottom_exact = (
+        _dense_obstacle_bottom_3d(
+            cfg[-1]["m"].fluid, dx * 2 ** lvl_b, dy * 2 ** lvl_b,
+            dz * 2 ** lvl_b, dtype,
+        )
+        if kl_b * jl_b * il_b <= _DENSE_BOTTOM_MAX_CELLS
+        else None
+    )
+
+    def smooth(p, rhs, lvl, n):
+        c = cfg[lvl]
+        for _ in range(n):
+            p, _ = sor_pass_obstacle_3d(
+                p, rhs, c["odd"], c["m"], c["idx2"], c["idy2"], c["idz2"]
+            )
+            p, _ = sor_pass_obstacle_3d(
+                p, rhs, c["even"], c["m"], c["idx2"], c["idy2"], c["idz2"]
+            )
+            p = neumann_faces_3d(p)
+        return p
+
+    def vcycle(p, rhs, lvl=0):
+        c = cfg[lvl]
+        if lvl == len(cfg) - 1:
+            if bottom_exact is not None:
+                return bottom_exact(p, rhs)
+            return smooth(p, rhs, lvl, n_coarse)
+        p = smooth(p, rhs, lvl, n_pre)
+        r = obstacle_residual_3d(
+            p, rhs, c["m"], c["idx2"], c["idy2"], c["idz2"]
+        )
+        r2 = _restrict3(r)
+        e2 = vcycle(_embed3(jnp.zeros_like(r2)), _embed3(r2), lvl + 1)
+        # inject into fluid cells only
+        p = p.at[1:-1, 1:-1, 1:-1].add(
+            _prolong3(e2[1:-1, 1:-1, 1:-1]) * c["m"].p_mask
+        )
+        p = neumann_faces_3d(p)
+        return smooth(p, rhs, lvl, n_post)
+
+    fine = cfg[0]
+    return _mg_converge_loop(
+        vcycle,
+        lambda p, rhs: obstacle_residual_3d(
+            p, rhs, fine["m"], fine["idx2"], fine["idy2"], fine["idz2"]
+        ),
+        float(fine["m"].n_fluid), eps, itermax, dtype, stall_rtol,
+    )

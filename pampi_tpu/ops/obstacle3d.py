@@ -209,10 +209,10 @@ def apply_obstacle_velocity_bc_3d(u, v, w, m: ObstacleMasks3D):
 
 # -- pressure: eps-coefficient SOR -----------------------------------------
 
-def sor_pass_obstacle_3d(p, rhs, color_mask, m: ObstacleMasks3D,
-                         idx2, idy2, idz2):
-    """One masked half-sweep with per-direction fluid coefficients
-    (3-D form of sor_pass_obstacle). Returns (p, sum of masked r²)."""
+def obstacle_residual_3d(p, rhs, m: ObstacleMasks3D, idx2, idy2, idz2):
+    """Interior residual of the 3-D eps-coefficient operator over fluid
+    cells — the single home of the obstacle stencil (sor_pass_obstacle_3d
+    updates with it; ops/multigrid's 3-D obstacle V-cycle restricts it)."""
     c = p[1:-1, 1:-1, 1:-1]
     lap = (
         m.eps_e * (p[1:-1, 1:-1, 2:] - c) + m.eps_w * (p[1:-1, 1:-1, :-2] - c)
@@ -221,7 +221,14 @@ def sor_pass_obstacle_3d(p, rhs, color_mask, m: ObstacleMasks3D,
     ) * idy2 + (
         m.eps_b * (p[2:, 1:-1, 1:-1] - c) + m.eps_f * (p[:-2, 1:-1, 1:-1] - c)
     ) * idz2
-    r = (rhs[1:-1, 1:-1, 1:-1] - lap) * color_mask * m.p_mask
+    return (rhs[1:-1, 1:-1, 1:-1] - lap) * m.p_mask
+
+
+def sor_pass_obstacle_3d(p, rhs, color_mask, m: ObstacleMasks3D,
+                         idx2, idy2, idz2):
+    """One masked half-sweep with per-direction fluid coefficients
+    (3-D form of sor_pass_obstacle). Returns (p, sum of masked r²)."""
+    r = obstacle_residual_3d(p, rhs, m, idx2, idy2, idz2) * color_mask
     p = p.at[1:-1, 1:-1, 1:-1].add(-m.factor * r)
     return p, jnp.sum(r * r)
 

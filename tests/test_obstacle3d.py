@@ -162,7 +162,9 @@ def test_dcavity3d_with_box_runs_and_is_divergence_free():
     assert np.sqrt((div[interior_fluid] ** 2).mean()) < 1e-3
 
 
-def test_mg_fft_rejected_with_obstacles():
+def test_fft_rejected_mg_accepted_with_obstacles():
+    """fft structurally cannot solve flag fields; mg can since round 4
+    (make_obstacle_mg_solve_3d)."""
     from pampi_tpu.models.ns3d import NS3DSolver
 
     param = Parameter(
@@ -171,6 +173,7 @@ def test_mg_fft_rejected_with_obstacles():
     )
     with pytest.raises(ValueError):
         NS3DSolver(param, dtype=jnp.float64)
+    NS3DSolver(param.replace(tpu_solver="mg"), dtype=jnp.float64)  # builds
 
 
 @pytest.mark.slow
@@ -290,3 +293,62 @@ def test_obstacle_solver_fn_pallas_backend_matches_jnp():
     assert int(ij) == int(ip_)
     np.testing.assert_allclose(np.asarray(pp_), np.asarray(pj),
                                rtol=0, atol=1e-4)
+
+
+def test_obstacle_mg_3d_matches_sor_physics():
+    """tpu_solver mg on a 3-D obstacle config reproduces the obstacle-SOR
+    run's physics (both converge each pressure solve to the same eps) —
+    the 3-D twin of test_obstacle_mg_in_ns2d_step."""
+    from pampi_tpu.models.ns3d import NS3DSolver
+
+    param = Parameter(
+        name="dcavity3d", imax=16, jmax=16, kmax=16, re=10.0, te=0.05,
+        tau=0.5, itermax=500, eps=1e-3, omg=1.7, gamma=0.9,
+        obstacles="0.35,0.35,0.35,0.65,0.65,0.65",
+    )
+    a = NS3DSolver(param)
+    a.run(progress=False)
+    b = NS3DSolver(param.replace(tpu_solver="mg"))
+    b.run(progress=False)
+    assert a.nt == b.nt > 1
+    np.testing.assert_allclose(np.asarray(a.u), np.asarray(b.u),
+                               rtol=0, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(a.w), np.asarray(b.w),
+                               rtol=0, atol=2e-4)
+
+
+def test_obstacle_mg_3d_converges_fast():
+    """The 3-D obstacle V-cycle with the exact dense bottom reaches the
+    residual floor in O(few) cycles where obstacle SOR needs O(10^3)
+    sweeps."""
+    import jax
+
+    from pampi_tpu.ops import obstacle3d as o3
+    from pampi_tpu.ops.multigrid import make_obstacle_mg_solve_3d
+
+    K = J = I = 32
+    dx = dy = dz = 1.0 / I
+    fluid = o3.build_fluid_3d(I, J, K, dx, dy, dz, "0.3,0.3,0.3,0.6,0.6,0.6")
+    m = o3.make_masks_3d(fluid, dx, dy, dz, 1.7, jnp.float64)
+    rng = np.random.default_rng(3)
+    fl = np.asarray(m.p_mask) > 0
+    r = rng.standard_normal((K, J, I)) * fl
+    r[fl] -= r[fl].mean()  # Neumann-compatible over the (connected) fluid
+    rhs = jnp.zeros((K + 2, J + 2, I + 2), jnp.float64)
+    rhs = rhs.at[1:-1, 1:-1, 1:-1].set(jnp.asarray(r, jnp.float64))
+    p0 = jnp.zeros_like(rhs)
+    mg = jax.jit(make_obstacle_mg_solve_3d(I, J, K, dx, dy, dz, 1e-8, 100,
+                                           m, jnp.float64))
+    p, res, it = mg(p0, rhs)
+    assert float(res) < 1e-16 or int(it) < 40
+    assert int(it) <= 40
+
+    solve_sor = jax.jit(o3.make_obstacle_solver_fn_3d(
+        I, J, K, dx, dy, dz, 1e-8, 100000, m, jnp.float64, backend="jnp"))
+    ps, _, it_s = solve_sor(p0, rhs)
+    assert int(it_s) > 20 * int(it)
+    mask = np.asarray(m.p_mask) > 0
+    a = np.asarray(p)[1:-1, 1:-1, 1:-1]
+    b = np.asarray(ps)[1:-1, 1:-1, 1:-1]
+    d = (a - a[mask].mean()) - (b - b[mask].mean())
+    assert np.abs(d[mask]).max() < 1e-6
