@@ -118,10 +118,10 @@ class NS3DDistSolver:
         # flag-field obstacles: GLOBAL static geometry; every shard slices
         # its mask blocks inside the kernel (ops/obstacle3d.shard_masks_3d)
         if param.obstacles.strip():
-            if param.tpu_solver in ("mg", "fft"):
+            if param.tpu_solver == "fft":
                 raise ValueError(
-                    f"tpu_solver {param.tpu_solver} does not support "
-                    "obstacle flag fields; use tpu_solver sor"
+                    "tpu_solver fft cannot solve obstacle flag fields (the "
+                    "stencil is not constant-coefficient); use sor or mg"
                 )
             from ..ops import obstacle3d as obst3
 
@@ -334,13 +334,23 @@ class NS3DDistSolver:
                 comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz, dtype
             )
         elif param.tpu_solver == "mg":
-            from ..ops.multigrid import make_dist_mg_solve_3d
+            if self.masks is not None:
+                # 3-D obstacle multigrid on a mesh (round 4)
+                from ..ops.multigrid import make_dist_obstacle_mg_solve_3d
 
-            solve = make_dist_mg_solve_3d(
-                comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
-                param.eps, param.itermax, dtype,
-                stall_rtol=param.tpu_mg_stall_rtol,
-            )
+                solve = make_dist_obstacle_mg_solve_3d(
+                    comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
+                    param.eps, param.itermax, self.masks, dtype,
+                    stall_rtol=param.tpu_mg_stall_rtol,
+                )
+            else:
+                from ..ops.multigrid import make_dist_mg_solve_3d
+
+                solve = make_dist_mg_solve_3d(
+                    comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
+                    param.eps, param.itermax, dtype,
+                    stall_rtol=param.tpu_mg_stall_rtol,
+                )
         elif self.masks is not None:
             from ..ops.obstacle3d import make_dist_obstacle_solver_3d
 

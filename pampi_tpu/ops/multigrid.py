@@ -669,7 +669,12 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
     stop the loop early per `stall_rtol` — see make_mg_solve_2d."""
     from jax import lax as _lax
 
-    from ..parallel.comm import get_offsets, halo_exchange, reduction
+    from ..parallel.comm import (
+        get_offsets,
+        halo_exchange,
+        master_print,
+        reduction,
+    )
     from ..parallel.stencil2d import ca_masks, rb_exchange_per_sweep
     from .dctpoisson import poisson_dct_2d
 
@@ -747,6 +752,10 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
             p = halo_exchange(p, comm)
             r = _residual2(p, rhs, idx2, idy2)
             res = reduction(jnp.sum(r * r), comm, "sum") / norm
+            if _flags.debug():
+                # ≙ -DDEBUG Residuum per V-cycle, rank-0 shard only (the
+                # -single-device _mg_converge_loop's print, distributed)
+                master_print(comm, "{} Residuum: {}", it, res)
             return p, res, prev, it + 1
 
         p, res, _, it = lax.while_loop(
@@ -768,7 +777,12 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
     """3-D twin of make_dist_mg_solve_2d (same stall_rtol contract)."""
     from jax import lax as _lax
 
-    from ..parallel.comm import get_offsets, halo_exchange, reduction
+    from ..parallel.comm import (
+        get_offsets,
+        halo_exchange,
+        master_print,
+        reduction,
+    )
     from ..parallel.stencil3d import (
         ca_masks_3d,
         neumann_masked_3d,
@@ -856,6 +870,10 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
             p = halo_exchange(p, comm)
             r = _residual3(p, rhs, idx2, idy2, idz2)
             res = reduction(jnp.sum(r * r), comm, "sum") / norm
+            if _flags.debug():
+                # ≙ -DDEBUG Residuum per V-cycle, rank-0 shard only (the
+                # -single-device _mg_converge_loop's print, distributed)
+                master_print(comm, "{} Residuum: {}", it, res)
             return p, res, prev, it + 1
 
         p, res, _, it = lax.while_loop(
@@ -900,7 +918,12 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
 
     from jax import lax as _lax
 
-    from ..parallel.comm import get_offsets, halo_exchange, reduction
+    from ..parallel.comm import (
+        get_offsets,
+        halo_exchange,
+        master_print,
+        reduction,
+    )
     from ..parallel.stencil2d import ca_masks, neumann_masked
     from .obstacle import (
         make_masks,
@@ -1021,6 +1044,10 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
             p = halo_exchange(p, comm)
             r = obstacle_residual(p, rhs, ml, fine["idx2"], fine["idy2"])
             res = reduction(jnp.sum(r * r), comm, "sum") / norm
+            if _flags.debug():
+                # ≙ -DDEBUG Residuum per V-cycle, rank-0 shard only (the
+                # -single-device _mg_converge_loop's print, distributed)
+                master_print(comm, "{} Residuum: {}", it, res)
             return p, res, prev, it + 1
 
         p, res, _, it = lax.while_loop(
@@ -1199,3 +1226,182 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
         ),
         float(fine["m"].n_fluid), eps, itermax, dtype, stall_rtol,
     )
+
+
+def make_dist_obstacle_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il,
+                                   dx, dy, dz, eps, itermax, masks, dtype,
+                                   n_pre: int = 2, n_post: int = 2,
+                                   n_coarse: int = 60,
+                                   stall_rtol=MG_STALL_RTOL):
+    """Distributed 3-D obstacle-capable MG (shard_map kernel side) — the
+    3-D twin of make_dist_obstacle_mg_solve_2d: GLOBAL flags coarsen by
+    fluid-ANY per level, every level rediscretizes at ω=1 from its own
+    global flags (shards slice inside the trace, shard_masks_3d), smoothing
+    is exchange-per-half-sweep with the exact single-device
+    sor_pass_obstacle_3d arithmetic, and the bottom problem is all_gather'd
+    and solved exactly on every shard by the dense 3-D pinv
+    (_dense_obstacle_bottom_3d; `n_coarse` global sweeps only as the
+    over-budget fallback). Residual normalized by the GLOBAL fluid count;
+    `it` counts V-cycles; stalls stop the loop early per `stall_rtol`."""
+    import numpy as np
+
+    from jax import lax as _lax
+
+    from ..models.ns3d import checkerboard_mask_3d, neumann_faces_3d
+    from ..parallel.comm import (
+        get_offsets,
+        halo_exchange,
+        master_print,
+        reduction,
+    )
+    from ..parallel.stencil3d import ca_masks_3d, neumann_masked_3d
+    from .obstacle3d import (
+        make_masks_3d,
+        obstacle_residual_3d,
+        shard_masks_3d,
+        sor_pass_obstacle_3d,
+    )
+
+    Pk = comm.axis_size("k")
+    Pj = comm.axis_size("j")
+    Pi = comm.axis_size("i")
+    levels = _truncate_levels(mg_levels(kl, jl, il), _DENSE_BOTTOM_MAX_CELLS,
+                              Pk * Pj * Pi)
+    fine_fluid = np.asarray(masks.fluid).astype(bool)
+    cfg = []
+    fluid = fine_fluid
+    for lvl, (kll, jll, ill) in enumerate(levels):
+        dxl, dyl, dzl = dx * 2 ** lvl, dy * 2 ** lvl, dz * 2 ** lvl
+        if lvl > 0:
+            fluid = coarsen_fluid_3d(fluid)
+        cfg.append(
+            dict(
+                kl=kll, jl=jll, il=ill,
+                kmax=kll * Pk, jmax=jll * Pj, imax=ill * Pi,
+                idx2=1.0 / (dxl * dxl),
+                idy2=1.0 / (dyl * dyl),
+                idz2=1.0 / (dzl * dzl),
+                m=make_masks_3d(fluid, dxl, dyl, dzl, 1.0, dtype),  # GLOBAL
+            )
+        )
+    cb = cfg[-1]
+    lvl_b = len(levels) - 1
+    if cb["kmax"] * cb["jmax"] * cb["imax"] <= _DENSE_BOTTOM_MAX_CELLS:
+        bottom_exact = _dense_obstacle_bottom_3d(
+            cb["m"].fluid, dx * 2 ** lvl_b, dy * 2 ** lvl_b,
+            dz * 2 ** lvl_b, dtype,
+        )
+    else:
+        bottom_exact = None  # smoothed fallback needs global checkerboards
+        cb["odd_g"] = checkerboard_mask_3d(
+            cb["kmax"], cb["jmax"], cb["imax"], 1, dtype)
+        cb["even_g"] = checkerboard_mask_3d(
+            cb["kmax"], cb["jmax"], cb["imax"], 0, dtype)
+
+    def smooth(p, rhs, lvl, n):
+        c = cfg[lvl]
+        cm = ca_masks_3d(c["kl"], c["jl"], c["il"], 1,
+                         c["kmax"], c["jmax"], c["imax"], dtype)
+        ml = shard_masks_3d(c["m"], c["kl"], c["jl"], c["il"])
+        # odd-then-even: the single-device 3-D obstacle sweep order
+        odd = cm["odd"][1:-1, 1:-1, 1:-1]
+        even = cm["even"][1:-1, 1:-1, 1:-1]
+        for _ in range(n):
+            p = halo_exchange(p, comm)
+            p, _ = sor_pass_obstacle_3d(
+                p, rhs, odd, ml, c["idx2"], c["idy2"], c["idz2"]
+            )
+            p = halo_exchange(p, comm)
+            p, _ = sor_pass_obstacle_3d(
+                p, rhs, even, ml, c["idx2"], c["idy2"], c["idz2"]
+            )
+            p = neumann_masked_3d(p, cm)
+        return p
+
+    def bottom(p, rhs, lvl):
+        c = cfg[lvl]
+        pg = _lax.all_gather(p[1:-1, 1:-1, 1:-1], "k", axis=0, tiled=True)
+        pg = _lax.all_gather(pg, "j", axis=1, tiled=True)
+        pg = _lax.all_gather(pg, "i", axis=2, tiled=True)
+        rg = _lax.all_gather(rhs[1:-1, 1:-1, 1:-1], "k", axis=0, tiled=True)
+        rg = _lax.all_gather(rg, "j", axis=1, tiled=True)
+        rg = _lax.all_gather(rg, "i", axis=2, tiled=True)
+        pe = neumann_faces_3d(_embed3(pg))
+        re = _embed3(rg)
+        if bottom_exact is not None:
+            pe = bottom_exact(pe, re)
+        else:
+            for _ in range(n_coarse):
+                pe, _ = sor_pass_obstacle_3d(
+                    pe, re, c["odd_g"], c["m"],
+                    c["idx2"], c["idy2"], c["idz2"],
+                )
+                pe, _ = sor_pass_obstacle_3d(
+                    pe, re, c["even_g"], c["m"],
+                    c["idx2"], c["idy2"], c["idz2"],
+                )
+                pe = neumann_faces_3d(pe)
+        koff = get_offsets("k", c["kl"])
+        joff = get_offsets("j", c["jl"])
+        ioff = get_offsets("i", c["il"])
+        return _lax.dynamic_slice(
+            pe, (koff, joff, ioff), (c["kl"] + 2, c["jl"] + 2, c["il"] + 2)
+        )
+
+    def vcycle(p, rhs, lvl=0):
+        c = cfg[lvl]
+        if lvl == len(levels) - 1:
+            return bottom(p, rhs, lvl)
+        p = smooth(p, rhs, lvl, n_pre)
+        p = halo_exchange(p, comm)  # residual reads shard-edge neighbours
+        ml = shard_masks_3d(c["m"], c["kl"], c["jl"], c["il"])
+        r = obstacle_residual_3d(
+            p, rhs, ml, c["idx2"], c["idy2"], c["idz2"]
+        )
+        r2 = _restrict3(r)
+        e2 = vcycle(_embed3(jnp.zeros_like(r2)), _embed3(r2), lvl + 1)
+        p = p.at[1:-1, 1:-1, 1:-1].add(
+            _prolong3(e2[1:-1, 1:-1, 1:-1]) * ml.p_mask
+        )
+        cm = ca_masks_3d(c["kl"], c["jl"], c["il"], 1,
+                         c["kmax"], c["jmax"], c["imax"], dtype)
+        p = neumann_masked_3d(p, cm)
+        return smooth(p, rhs, lvl, n_post)
+
+    fine = cfg[0]
+    norm = fine["m"].n_fluid
+    epssq = eps * eps
+
+    def solve(p, rhs):
+        ml = shard_masks_3d(fine["m"], fine["kl"], fine["jl"], fine["il"])
+
+        def cond(c):
+            _, res, prev, it = c
+            return jnp.logical_and(
+                jnp.logical_and(res >= epssq, it < itermax),
+                jnp.logical_not(_stalled(prev, res, it, stall_rtol)),
+            )
+
+        def body(c):
+            p, prev, _, it = c
+            p = vcycle(p, rhs)
+            p = halo_exchange(p, comm)
+            r = obstacle_residual_3d(
+                p, rhs, ml, fine["idx2"], fine["idy2"], fine["idz2"]
+            )
+            res = reduction(jnp.sum(r * r), comm, "sum") / norm
+            if _flags.debug():
+                # ≙ -DDEBUG Residuum per V-cycle, rank-0 shard only (the
+                # -single-device _mg_converge_loop's print, distributed)
+                master_print(comm, "{} Residuum: {}", it, res)
+            return p, res, prev, it + 1
+
+        p, res, _, it = lax.while_loop(
+            cond, body,
+            (p, jnp.asarray(1.0, dtype), jnp.asarray(jnp.inf, dtype),
+             jnp.asarray(0, jnp.int32)),
+        )
+        # zero-trip safety; see make_dist_mg_solve_2d
+        return halo_exchange(p, comm), res, it
+
+    return solve

@@ -108,8 +108,8 @@ class Parameter:
     #   "fft"  direct DCT-diagonalization solve (ops/dctpoisson.py, MXU
     #          matmuls; collective matmuls + psum_scatter on a mesh) —
     #          exact in ONE application, `it` reports 1
-    # fft does not support obstacle flag fields; mg does (2-D and 3-D
-    # single-device, 2-D distributed — per-level rediscretized
+    # fft does not support obstacle flag fields; mg does (2-D and 3-D,
+    # single-device AND distributed — per-level rediscretized
     # eps-coefficient operators with an exact dense bottom)
     tpu_solver: str = "sor"
     # MG stall detector (tpu_solver mg only): a V-cycle whose residual
