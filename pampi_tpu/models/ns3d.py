@@ -125,7 +125,8 @@ def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
         from ..ops.multigrid import make_mg_solve_3d
 
         return make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
-                                dtype, stall_rtol=stall_rtol)
+                                dtype, stall_rtol=stall_rtol,
+                                backend=backend)
     if solver == "fft":
         from ..ops.dctpoisson import make_dct_solve_3d
 
@@ -269,8 +270,10 @@ class NS3DSolver:
         self._chunk_fn = jax.jit(self._build_chunk())
 
     def _uses_pallas(self) -> bool:
-        if self.param.tpu_solver in ("mg", "fft"):
-            return False  # mg/fft chunks contain no pallas kernel
+        if self.param.tpu_solver == "fft":
+            return False  # fft chunks contain no pallas kernel
+        # sor AND mg go through the probe: mg's fine-level smoother
+        # dispatches the 3-D tblock kernel on large levels (round 4)
         return _use_pallas_3d(self._backend, self.dtype)
 
     def _build_step(self, backend: str = "auto"):
@@ -287,7 +290,7 @@ class NS3DSolver:
             solve = make_obstacle_mg_solve_3d(
                 g.imax, g.jmax, g.kmax, dx, dy, dz,
                 param.eps, param.itermax, masks, dtype,
-                stall_rtol=param.tpu_mg_stall_rtol,
+                stall_rtol=param.tpu_mg_stall_rtol, backend=backend,
             )
         elif masks is not None:
             from ..ops.obstacle3d import make_obstacle_solver_fn_3d

@@ -368,9 +368,50 @@ def _embed3(interior):
     return out.at[1:-1, 1:-1, 1:-1].set(interior)
 
 
+def _pallas_smoother_3d(il, jl, kl, dxl, dyl, dzl, dtype, n, fluid=None,
+                        backend="auto"):
+    """3-D twin of _pallas_smoother_2d: n ω=1 red-black sweeps via the
+    temporal-blocked 3-D kernel (ops/sor3d_pallas.make_rb_iter_tblock_3d;
+    fluid!=None switches to the flag-masked obstacle stencil). Returns None
+    whenever ineligible — callers keep the jnp sweeps then."""
+    from ..models.ns3d import _use_pallas_3d
+
+    if n < 1 or not _use_pallas_3d(backend, dtype):
+        return None
+    if backend != "pallas" and il * jl * kl < _PALLAS_SMOOTH_MIN_CELLS:
+        return None
+    import numpy as np
+
+    from . import sor3d_pallas as sp3
+
+    masked = fluid is not None
+    bk = sp3.pick_block_k(kl, jl, il, dtype, n, masked=masked)
+    if backend != "pallas" and sp3.block_k_degenerate(bk, kl, n):
+        return None
+    try:
+        # pass the checked block depth through so the degeneracy guard and
+        # the kernel can never validate different values
+        rb, bk = sp3.make_rb_iter_tblock_3d(
+            il, jl, kl, dxl, dyl, dzl, 1.0, dtype, n_inner=n, block_k=bk,
+            fluid=None if fluid is None else np.asarray(fluid),
+        )
+    except ValueError:
+        return None
+    if rb is None:
+        return None
+
+    def smooth(p, rhs):
+        pp, _ = rb(sp3.pad_array_3d(p, bk, n), sp3.pad_array_3d(rhs, bk, n))
+        return sp3.unpad_array_3d(pp, kl, jl, il, n)
+
+    return smooth
+
+
 def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
-                      n_pre: int = 2, n_post: int = 2):
-    """3-D twin of make_mg_vcycle_2d (exact DCT bottom solve)."""
+                      n_pre: int = 2, n_post: int = 2,
+                      backend: str = "auto"):
+    """3-D twin of make_mg_vcycle_2d (exact DCT bottom solve; large levels
+    smooth through the temporal-blocked 3-D Pallas kernel when eligible)."""
     from ..models.ns3d import checkerboard_mask_3d, neumann_faces_3d
     from .dctpoisson import poisson_dct_3d
 
@@ -394,37 +435,49 @@ def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
                     checkerboard_mask_3d(kl, jl, il, 1, dtype),
                     checkerboard_mask_3d(kl, jl, il, 0, dtype),
                 ),
+                sm={
+                    n: _pallas_smoother_3d(il, jl, kl, dxl, dyl, dzl,
+                                           dtype, n, backend=backend)
+                    for n in {n_pre, n_post} if n
+                },
             )
         )
 
+    def smooth(p, rhs, lvl, n):
+        c = cfg[lvl]
+        k = c["sm"].get(n)
+        if k is not None:
+            return k(p, rhs)
+        return _smooth3(p, rhs, c["masks"], c["factor"],
+                        c["idx2"], c["idy2"], c["idz2"], n)
+
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
-        args = (c["masks"], c["factor"], c["idx2"], c["idy2"], c["idz2"])
         if lvl == len(cfg) - 1:
             sol = poisson_dct_3d(rhs[1:-1, 1:-1, 1:-1],
                                  c["dx"], c["dy"], c["dz"])
             return neumann_faces_3d(
                 jnp.zeros_like(p).at[1:-1, 1:-1, 1:-1].set(sol)
             )
-        p = _smooth3(p, rhs, *args, n_pre)
+        p = smooth(p, rhs, lvl, n_pre)
         r = _residual3(p, rhs, c["idx2"], c["idy2"], c["idz2"])
         r2 = _restrict3(r)
         e2 = vcycle(_embed3(jnp.zeros_like(r2)), _embed3(r2), lvl + 1)
         p = p.at[1:-1, 1:-1, 1:-1].add(_prolong3(e2[1:-1, 1:-1, 1:-1]))
         p = neumann_faces_3d(p)
-        return _smooth3(p, rhs, *args, n_post)
+        return smooth(p, rhs, lvl, n_post)
 
     return vcycle
 
 
 def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
                      n_pre: int = 2, n_post: int = 2,
-                     stall_rtol=MG_STALL_RTOL):
+                     stall_rtol=MG_STALL_RTOL, backend: str = "auto"):
     """3-D twin of make_mg_solve_2d (same solve contract as
     models/ns3d.make_pressure_solve_3d; `it` counts V-cycles; stalls stop
     the loop early per `stall_rtol` — see make_mg_solve_2d)."""
     vcycle = make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
-                               n_pre, n_post)
+                               n_pre, n_post, backend)
     idx2 = 1.0 / (dx * dx)
     idy2 = 1.0 / (dy * dy)
     idz2 = 1.0 / (dz * dz)
@@ -1136,7 +1189,8 @@ def _dense_obstacle_bottom_3d(fluid, dxl, dyl, dzl, dtype):
 def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
                               masks, dtype, n_pre: int = 2, n_post: int = 2,
                               n_coarse: int = 60,
-                              stall_rtol=MG_STALL_RTOL):
+                              stall_rtol=MG_STALL_RTOL,
+                              backend: str = "auto"):
     """3-D obstacle-capable MG convergence loop
     `(p_ext, rhs_ext) -> (p_ext, res, it)` — the 3-D twin of
     make_obstacle_mg_solve_2d: fluid-ANY coarsening (coarsen_fluid_3d),
@@ -1173,6 +1227,12 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
                 # solver (make_obstacle_solver_fn_3d)
                 odd=checkerboard_mask_3d(kl, jl, il, 1, dtype),
                 even=checkerboard_mask_3d(kl, jl, il, 0, dtype),
+                sm={
+                    n: _pallas_smoother_3d(il, jl, kl, dxl, dyl, dzl,
+                                           dtype, n, fluid=fluid,
+                                           backend=backend)
+                    for n in {n_pre, n_post} if n
+                },
             )
         )
 
@@ -1189,6 +1249,9 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
 
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
+        k = c["sm"].get(n)
+        if k is not None:
+            return k(p, rhs)
         for _ in range(n):
             p, _ = sor_pass_obstacle_3d(
                 p, rhs, c["odd"], c["m"], c["idx2"], c["idy2"], c["idz2"]
