@@ -48,6 +48,11 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
     resets to the last CONFIRMED state — the one-shot retry protocol is
     unchanged, it just may re-dispatch the speculative tail. lookahead=0 is
     exactly the historical dispatch-then-sync loop."""
+    if lookahead < 0:
+        # cli.py validates the .par key; programmatic callers land here (a
+        # negative value would popleft an empty deque and surface an
+        # IndexError through the device-fault retry path)
+        raise ValueError(f"lookahead must be >= 0 (got {lookahead})")
     transient_budget = 1
     if float(state[time_index]) > te:
         bar.stop()

@@ -28,7 +28,12 @@ TPU-first design:
 - All-Neumann pressure BCs at every level (ghost copies, walls only). The
   system is singular (constants in the nullspace) exactly as in the
   reference's solver; the smoother leaves the nullspace component untouched
-  and convergence is on the residual, matching the SOR semantics.
+  and convergence is on the residual, matching the SOR semantics. The exact
+  DCT bottoms of the PLAIN plans are ADDITIVE residual corrections
+  (p += zero-mean e), so the nullspace survives even when a small grid's
+  plan is a single level — the solve stays exact in one cycle without
+  resetting the mean. (The obstacle plans' dense bottoms replace the
+  iterate; a single-level obstacle plan resets the mean, fft-like.)
 """
 
 from __future__ import annotations
@@ -63,7 +68,12 @@ _DCT_BOTTOM_MAX_CELLS = 65536
 def _truncate_levels(levels, max_cells, scale: int = 1):
     """Cut the level plan at the first level whose cell count (×scale — the
     mesh size for distributed plans, where levels carry LOCAL extents but
-    the bottom is solved globally) fits the bottom budget."""
+    the bottom is solved globally) fits the bottom budget. A plan may be a
+    single level (grids under the budget): the PLAIN plans' DCT bottoms are
+    ADDITIVE residual corrections, so even then the incoming iterate's
+    mean/nullspace component survives. The OBSTACLE plans' dense bottoms
+    replace the iterate instead — a single-level obstacle plan (grid at or
+    under _DENSE_BOTTOM_MAX_CELLS) resets the mean, fft-like."""
     import math
 
     for idx, ext in enumerate(levels):
@@ -294,11 +304,15 @@ def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
         if lvl == len(cfg) - 1:
-            # exact bottom solve; the incoming iterate is irrelevant (for
-            # error equations it is zeros; for a single-level hierarchy the
-            # direct solution simply replaces it, constants aside)
-            sol = poisson_dct_2d(rhs[1:-1, 1:-1], c["dx"], c["dy"])
-            return _neumann2(jnp.zeros_like(p).at[1:-1, 1:-1].set(sol))
+            # exact ADDITIVE bottom solve: correct p by the zero-mean DCT
+            # solution of its residual equation. For error equations
+            # (p = zeros) this equals the direct solve; for a single-level
+            # hierarchy it preserves the incoming iterate's mean/nullspace
+            # component — the smoother semantics the module contract
+            # promises — while staying exact in one application.
+            r = _residual2(p, rhs, c["idx2"], c["idy2"])
+            e = poisson_dct_2d(r, c["dx"], c["dy"])
+            return _neumann2(p.at[1:-1, 1:-1].add(e))
         p = smooth(p, rhs, lvl, n_pre)
         r = _residual2(p, rhs, c["idx2"], c["idy2"])
         r2 = _restrict2(r)
@@ -454,11 +468,10 @@ def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
         if lvl == len(cfg) - 1:
-            sol = poisson_dct_3d(rhs[1:-1, 1:-1, 1:-1],
-                                 c["dx"], c["dy"], c["dz"])
-            return neumann_faces_3d(
-                jnp.zeros_like(p).at[1:-1, 1:-1, 1:-1].set(sol)
-            )
+            # exact ADDITIVE bottom solve — see the 2-D twin's rationale
+            r = _residual3(p, rhs, c["idx2"], c["idy2"], c["idz2"])
+            e = poisson_dct_3d(r, c["dx"], c["dy"], c["dz"])
+            return neumann_faces_3d(p.at[1:-1, 1:-1, 1:-1].add(e))
         p = smooth(p, rhs, lvl, n_pre)
         r = _residual3(p, rhs, c["idx2"], c["idy2"], c["idz2"])
         r2 = _restrict3(r)

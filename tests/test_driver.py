@@ -213,6 +213,14 @@ def test_pipelined_zero_trip_returns_initial_state():
     assert out is s0 and bar.stopped and bar.updates == []
 
 
+def test_negative_lookahead_rejected():
+    """Programmatic callers bypass cli.py's .par validation; the driver
+    itself must refuse (a negative value would popleft an empty deque)."""
+    with pytest.raises(ValueError, match="lookahead"):
+        drive_chunks((jnp.asarray(0.0),), _advance(), te=2.0, time_index=0,
+                     bar=_Bar(), retry=lambda: None, lookahead=-1)
+
+
 def test_tpu_chunk_override_preserves_results():
     """tpu_chunk overrides the per-dispatch step count (watchdog escape for
     slow-step configs) without changing what is computed."""

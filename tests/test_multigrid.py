@@ -348,12 +348,23 @@ def test_mg_stall_rtol_par_key_roundtrip(tmp_path):
     assert Parameter().tpu_mg_stall_rtol == pytest.approx(1e-4)
 
 
-def test_pallas_smoother_matches_jnp_plain_mg():
+def test_pallas_smoother_matches_jnp_plain_mg(monkeypatch):
     """backend="pallas" (interpret off-TPU) routes MG smoothing through the
     temporal-blocked kernel; the smoother arithmetic is the same red-black
-    ω=1 sweep, so the V-cycle trajectory must match the jnp smoother's."""
+    ω=1 sweep, so the V-cycle trajectory must match the jnp smoother's.
+
+    The production bottom budget would collapse 64² to a DCT-only plan
+    (neither smoother would execute — a vacuous test), so the budget is
+    shrunk to force a multi-level plan through the smoothing path."""
+    from pampi_tpu.ops import multigrid as mgmod
+    from pampi_tpu.ops.multigrid import _truncate_levels, mg_levels
+
+    monkeypatch.setattr(mgmod, "_DCT_BOTTOM_MAX_CELLS", 1024)
+
     J = I = 64
     dx = dy = 1.0 / I
+    # vacuity guard: the plan must carry a smoothed level above the bottom
+    assert len(_truncate_levels(mg_levels(J, I), 1024)) > 1
     rhs = _compatible_rhs_2d(J, I)
     p0 = jnp.zeros((J + 2, I + 2), DT)
     mg_j = jax.jit(make_mg_solve_2d(I, J, dx, dy, 1e-7, 50, DT))
@@ -412,15 +423,27 @@ def test_dist_obstacle_mg_matches_single_device_obstacle_mg():
         np.testing.assert_allclose(np.asarray(a.v), vd, rtol=0, atol=2e-4)
 
 
-def test_pallas_smoother_matches_jnp_3d():
+def test_pallas_smoother_matches_jnp_3d(monkeypatch):
     """backend="pallas" (interpret off-TPU) routes 3-D MG smoothing through
     the temporal-blocked kernel; trajectory must match the jnp smoother's
-    (plain and obstacle variants)."""
+    (plain and obstacle variants). The plain budget is shrunk so 16³ keeps
+    a smoothed level (see the 2-D twin's vacuity note); the obstacle plan's
+    1024-cell dense budget already leaves one."""
+    from pampi_tpu.ops import multigrid as mgmod
     from pampi_tpu.ops import obstacle3d as o3
-    from pampi_tpu.ops.multigrid import make_obstacle_mg_solve_3d
+    from pampi_tpu.ops.multigrid import (
+        _truncate_levels, make_obstacle_mg_solve_3d, mg_levels,
+    )
+
+    monkeypatch.setattr(mgmod, "_DCT_BOTTOM_MAX_CELLS", 512)
 
     K = J = I = 16
     dx = dy = dz = 1.0 / I
+    # vacuity guards: both plans must carry a smoothed level above the
+    # bottom
+    assert len(_truncate_levels(mg_levels(K, J, I), 512)) > 1
+    assert len(_truncate_levels(mg_levels(K, J, I),
+                                mgmod._DENSE_BOTTOM_MAX_CELLS)) > 1
     rng = np.random.default_rng(4)
     r = rng.standard_normal((K, J, I))
     r -= r.mean()
