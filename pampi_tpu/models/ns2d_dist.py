@@ -358,19 +358,23 @@ class NS2DDistSolver:
                 # configs, now also on a mesh (VERDICT r3 item 6)
                 from ..ops.multigrid import make_dist_obstacle_mg_solve_2d
 
-                solve = make_dist_obstacle_mg_solve_2d(
+                solve, mg_pallas = make_dist_obstacle_mg_solve_2d(
                     comm, self.imax, self.jmax, jl, il, dx, dy,
                     param.eps, param.itermax, self.masks, dtype,
                     stall_rtol=param.tpu_mg_stall_rtol,
                 )
+                # the MG factory reports per-shard Pallas smoothing the
+                # same way the obstacle SOR solver does: relax check_vma
+                pallas_q = pallas_q or mg_pallas
             else:
                 from ..ops.multigrid import make_dist_mg_solve_2d
 
-                solve = make_dist_mg_solve_2d(
+                solve, mg_pallas = make_dist_mg_solve_2d(
                     comm, self.imax, self.jmax, jl, il, dx, dy,
                     param.eps, param.itermax, dtype,
                     stall_rtol=param.tpu_mg_stall_rtol,
                 )
+                pallas_q = pallas_q or mg_pallas
         elif self.masks is not None:
             from ..ops.obstacle import make_dist_obstacle_solver
 

@@ -338,19 +338,25 @@ class NS3DDistSolver:
                 # 3-D obstacle multigrid on a mesh (round 4)
                 from ..ops.multigrid import make_dist_obstacle_mg_solve_3d
 
-                solve = make_dist_obstacle_mg_solve_3d(
+                solve, mg_pallas = make_dist_obstacle_mg_solve_3d(
                     comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                     param.eps, param.itermax, self.masks, dtype,
                     stall_rtol=param.tpu_mg_stall_rtol,
                 )
+                # the MG factory reports per-shard Pallas smoothing:
+                # relax check_vma (the obstacle-solver contract)
+                pallas_o = pallas_o or mg_pallas
+                self._pallas_o = pallas_o
             else:
                 from ..ops.multigrid import make_dist_mg_solve_3d
 
-                solve = make_dist_mg_solve_3d(
+                solve, mg_pallas = make_dist_mg_solve_3d(
                     comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                     param.eps, param.itermax, dtype,
                     stall_rtol=param.tpu_mg_stall_rtol,
                 )
+                pallas_o = pallas_o or mg_pallas
+                self._pallas_o = pallas_o
         elif self.masks is not None:
             from ..ops.obstacle3d import make_dist_obstacle_solver_3d
 

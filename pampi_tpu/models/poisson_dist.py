@@ -161,11 +161,14 @@ class DistPoissonSolver:
         if param.tpu_solver == "mg":
             from ..ops.multigrid import make_dist_mg_solve_2d
 
-            direct_solve = make_dist_mg_solve_2d(
+            direct_solve, mg_pallas = make_dist_mg_solve_2d(
                 comm, self.imax, self.jmax, jl, il, dx, dy,
                 param.eps, itermax, dtype,
                 stall_rtol=param.tpu_mg_stall_rtol,
             )
+            # per-shard Pallas smoothing needs check_vma relaxed, like the
+            # quarters kernel
+            pallas_q = pallas_q or mg_pallas
         elif param.tpu_solver == "fft":
             from ..ops.dctpoisson import make_dist_dct_solve_2d
 

@@ -470,3 +470,165 @@ def test_pallas_smoother_matches_jnp_3d(monkeypatch):
     assert int(itj) == int(itp)
     np.testing.assert_allclose(np.asarray(pp), np.asarray(pj),
                                rtol=0, atol=1e-11)
+
+
+# ---------------------------------------------------------------------
+# distributed Pallas smoothers (round 5: VERDICT r4 item 1 — the dist MG
+# factories smooth through the per-shard flag-masked kernel at eligible
+# levels; backend="pallas" forces interpret mode off-TPU)
+# ---------------------------------------------------------------------
+
+
+def _shard_solve_2d(comm, dims, solve, p0, rhs):
+    from jax.sharding import PartitionSpec as P
+
+    from pampi_tpu.parallel.comm import halo_exchange
+
+    def kern(p_int, rhs_int):
+        pe = halo_exchange(jnp.pad(p_int, 1), comm)
+        re = halo_exchange(jnp.pad(rhs_int, 1), comm)
+        p, res, it = solve(pe, re)
+        return p[1:-1, 1:-1], res, it
+
+    spec = P("j", "i")
+    f = jax.jit(comm.shard_map(
+        kern, in_specs=(spec, spec), out_specs=(spec, P(), P()),
+        check_vma=False,
+    ))
+    p_out, res, it = f(p0[1:-1, 1:-1], rhs[1:-1, 1:-1])
+    return np.asarray(p_out), float(res), int(it)
+
+
+def test_dist_obstacle_mg_pallas_smoother_matches_jnp():
+    """backend="pallas" routes the dist obstacle-MG's eligible-level
+    smoothing through the per-shard flag-masked kernel (one deep exchange
+    per n sweeps). Same CA discipline as the dist obstacle SOR -> the
+    trajectory must be BITWISE-equal to the exchange-per-half-sweep jnp
+    smoothing."""
+    from pampi_tpu.ops import obstacle as obst
+    from pampi_tpu.ops.multigrid import make_dist_obstacle_mg_solve_2d
+    from pampi_tpu.parallel.comm import CartComm
+
+    jmax, imax = 32, 64
+    dx, dy = 4.0 / imax, 2.0 / jmax
+    fluid = obst.build_fluid(imax, jmax, dx, dy, "1.2,0.5,2.0,1.1")
+    m = obst.make_masks(fluid, dx, dy, 1.0, DT)
+    dims = (2, 4)
+    comm = CartComm(ndims=2, dims=dims)
+    jl, il = jmax // dims[0], imax // dims[1]
+    rng = np.random.default_rng(7)
+    p0 = jnp.asarray(rng.standard_normal((jmax + 2, imax + 2)))
+    rhs = jnp.asarray(rng.standard_normal((jmax + 2, imax + 2)))
+
+    outs = {}
+    for backend in ("auto", "pallas"):  # auto on CPU = jnp sweeps
+        solve, used = make_dist_obstacle_mg_solve_2d(
+            comm, imax, jmax, jl, il, dx, dy, 1e-8, 30, m, DT,
+            backend=backend,
+        )
+        assert used == (backend == "pallas")
+        outs[backend] = _shard_solve_2d(comm, dims, solve, p0, rhs)
+
+    assert outs["auto"][2] == outs["pallas"][2]
+    np.testing.assert_array_equal(outs["auto"][0], outs["pallas"][0])
+
+
+def test_dist_plain_mg_pallas_smoother_matches_jnp():
+    """Plain dist MG smooths through the same kernel with an ALL-FLUID flag
+    field: every eps coefficient is 1, so the arithmetic is the plain
+    stencil up to fp association — ulp-equivalent, not bitwise (the
+    quarters-layout precedent)."""
+    from pampi_tpu.ops.multigrid import make_dist_mg_solve_2d
+    from pampi_tpu.parallel.comm import CartComm
+
+    jmax = imax = 32
+    dx = dy = 1.0 / imax
+    dims = (2, 4)
+    comm = CartComm(ndims=2, dims=dims)
+    jl, il = jmax // dims[0], imax // dims[1]
+    rng = np.random.default_rng(8)
+    r = rng.standard_normal((jmax, imax))
+    r -= r.mean()
+    rhs = jnp.zeros((jmax + 2, imax + 2), DT).at[1:-1, 1:-1].set(
+        jnp.asarray(r, DT))
+    p0 = jnp.zeros_like(rhs)
+
+    outs = {}
+    for backend in ("auto", "pallas"):
+        solve, used = make_dist_mg_solve_2d(
+            comm, imax, jmax, jl, il, dx, dy, 1e-8, 30, DT,
+            backend=backend,
+        )
+        assert used == (backend == "pallas")
+        outs[backend] = _shard_solve_2d(comm, dims, solve, p0, rhs)
+
+    assert abs(outs["auto"][2] - outs["pallas"][2]) <= 1
+    np.testing.assert_allclose(outs["auto"][0], outs["pallas"][0],
+                               rtol=0, atol=1e-11)
+
+
+def test_dist_mg_pallas_smoother_matches_jnp_3d():
+    """3-D twins: obstacle (bitwise) and plain (ulp) dist-MG Pallas
+    smoothing on a (2,2,2) mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    from pampi_tpu.ops import obstacle3d as o3
+    from pampi_tpu.ops.multigrid import (
+        make_dist_mg_solve_3d,
+        make_dist_obstacle_mg_solve_3d,
+    )
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.parallel.comm import halo_exchange
+
+    kmax = jmax = imax = 16
+    dx = dy = dz = 1.0 / imax
+    dims = (2, 2, 2)
+    comm = CartComm(ndims=3, dims=dims)
+    kl, jl, il = kmax // dims[0], jmax // dims[1], imax // dims[2]
+    rng = np.random.default_rng(9)
+    r = rng.standard_normal((kmax, jmax, imax))
+    r -= r.mean()
+    rhs = jnp.zeros((kmax + 2, jmax + 2, imax + 2), DT)
+    rhs = rhs.at[1:-1, 1:-1, 1:-1].set(jnp.asarray(r, DT))
+    p0 = jnp.zeros_like(rhs)
+
+    def run(solve):
+        def kern(p_int, rhs_int):
+            pe = halo_exchange(jnp.pad(p_int, 1), comm)
+            re = halo_exchange(jnp.pad(rhs_int, 1), comm)
+            p, res, it = solve(pe, re)
+            return p[1:-1, 1:-1, 1:-1], res, it
+
+        spec = P("k", "j", "i")
+        f = jax.jit(comm.shard_map(
+            kern, in_specs=(spec, spec), out_specs=(spec, P(), P()),
+            check_vma=False,
+        ))
+        p_out, res, it = f(p0[1:-1, 1:-1, 1:-1], rhs[1:-1, 1:-1, 1:-1])
+        return np.asarray(p_out), float(res), int(it)
+
+    fluid = o3.build_fluid_3d(imax, jmax, kmax, dx, dy, dz,
+                              "0.3,0.3,0.3,0.6,0.6,0.6")
+    m = o3.make_masks_3d(fluid, dx, dy, dz, 1.0, DT)
+    outs = {}
+    for backend in ("auto", "pallas"):
+        solve, used = make_dist_obstacle_mg_solve_3d(
+            comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz, 1e-8, 20, m,
+            DT, backend=backend,
+        )
+        assert used == (backend == "pallas")
+        outs[backend] = run(solve)
+    assert outs["auto"][2] == outs["pallas"][2]
+    np.testing.assert_array_equal(outs["auto"][0], outs["pallas"][0])
+
+    outs = {}
+    for backend in ("auto", "pallas"):
+        solve, used = make_dist_mg_solve_3d(
+            comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz, 1e-8, 20, DT,
+            backend=backend,
+        )
+        assert used == (backend == "pallas")
+        outs[backend] = run(solve)
+    assert abs(outs["auto"][2] - outs["pallas"][2]) <= 1
+    np.testing.assert_allclose(outs["auto"][0], outs["pallas"][0],
+                               rtol=0, atol=1e-11)
