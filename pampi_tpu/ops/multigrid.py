@@ -724,6 +724,21 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
 # shard-local extents (mg_levels is the single home of the coarsening rule)
 
 
+def _record_mg_dispatch(key: str, sm: dict, n_levels: int) -> None:
+    """Observability twin of the SOR solvers' dispatch records: which MG
+    levels smooth through the per-shard Pallas kernel (informational —
+    driver artifacts, tests)."""
+    from ..utils import dispatch as _dispatch
+
+    if sm:
+        lvls = sorted({lvl for (lvl, _) in sm})
+        _dispatch.record(
+            key, f"pallas_sm L{','.join(map(str, lvls))}/{n_levels}"
+        )
+    else:
+        _dispatch.record(key, "jnp_sm")
+
+
 def _pallas_dist_smoother_2d(comm, gjmax, gimax, jl, il, dxl, dyl, dtype, n,
                              fluid=None, backend="auto"):
     """Distributed twin of _pallas_smoother_2d: build
@@ -946,6 +961,7 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                 )
                 if k is not None:
                     sm[(lvl, nn)] = k
+    _record_mg_dispatch("mg_dist", sm, len(levels))
 
     def masks_at(lvl):
         c = cfg[lvl]
@@ -1079,6 +1095,7 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
                 )
                 if k is not None:
                     sm[(lvl, nn)] = k
+    _record_mg_dispatch("mg_dist_3d", sm, len(levels))
 
     def masks_at(lvl):
         c = cfg[lvl]
@@ -1260,6 +1277,7 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
                 )
                 if k is not None:
                     sm[(lvl, nn)] = k
+    _record_mg_dispatch("obstacle_dist_mg", sm, len(levels))
 
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
@@ -1625,6 +1643,7 @@ def make_dist_obstacle_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il,
                 )
                 if k is not None:
                     sm[(lvl, nn)] = k
+    _record_mg_dispatch("obstacle_dist_mg_3d", sm, len(levels))
 
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
