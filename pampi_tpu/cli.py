@@ -120,9 +120,10 @@ def _run(argv) -> int:
 def _dispatch(param, prof) -> int:
     from .utils.timing import get_timestamp
 
-    if param.tpu_solver not in ("sor", "mg", "fft", "sor_lex", "sor_rba"):
+    if param.tpu_solver not in ("sor", "mg", "fft", "sor_lex", "sor_rba",
+                                "auto"):
         print(
-            "Error: tpu_solver must be sor|mg|fft|sor_lex|sor_rba, "
+            "Error: tpu_solver must be auto|sor|mg|fft|sor_lex|sor_rba, "
             f"got {param.tpu_solver!r}",
             file=sys.stderr,
         )

@@ -96,6 +96,9 @@ class NS2DSolver:
     CHUNK = 64  # device steps per host sync
 
     def __init__(self, param: Parameter, dtype=None):
+        from ..utils.dispatch import resolve_solver
+
+        param = resolve_solver(param, obstacles=bool(param.obstacles.strip()))
         if dtype is None:
             dtype = resolve_dtype(param.tpu_dtype)
         self.param = param

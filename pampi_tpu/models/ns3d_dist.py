@@ -102,6 +102,11 @@ class NS3DDistSolver:
             self.kl * Pk != g.kmax or self.jl * Pj != g.jmax
             or self.il * Pi != g.imax
         )
+        param = _dispatch.resolve_solver(
+            param, obstacles=bool(param.obstacles.strip()),
+            ragged=self.ragged,
+        )
+        self.param = param
         if self.ragged and (param.tpu_solver in ("mg", "fft")
                             or param.obstacles.strip()):
             what = ("obstacle flag fields" if param.obstacles.strip()

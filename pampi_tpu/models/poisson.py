@@ -354,6 +354,9 @@ class PoissonSolver:
     """Driver-facing wrapper (parity: the Solver struct + init/solve/writeResult)."""
 
     def __init__(self, param: Parameter, problem: int = 2, dtype=None):
+        from ..utils.dispatch import resolve_solver
+
+        param = resolve_solver(param, obstacles=False)
         if dtype is None:
             dtype = resolve_dtype(param.tpu_dtype)
         self.param = param

@@ -100,6 +100,10 @@ class DistPoissonSolver:
         )
         Pj, Pi = self.comm.dims
         self.ragged = (self.jl * Pj != self.jmax) or (self.il * Pi != self.imax)
+        param = _dispatch.resolve_solver(
+            param, obstacles=False, ragged=self.ragged,
+        )
+        self.param = param
         if self.ragged and param.tpu_solver in ("mg", "fft"):
             raise ValueError(
                 f"tpu_solver {param.tpu_solver} needs a divisible grid/mesh "

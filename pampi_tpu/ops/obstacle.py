@@ -313,24 +313,30 @@ def adapt_uv_obstacle(u, v, f, g, p, dt, dx, dy, m: ObstacleMasks):
 # ----------------------------------------------------------------------
 
 
-def shard_masks(m: ObstacleMasks, jl: int, il: int) -> ObstacleMasks:
+def shard_masks(m: ObstacleMasks, jl: int, il: int,
+                over_j: int = 0, over_i: int = 0) -> ObstacleMasks:
     """This shard's view of the global mask set: extended-block fields
     (fluid/u_face/v_face) sliced at the extended origin, interior fields at
     the interior origin. The sliced blocks agree across neighbouring shards
     wherever they overlap (same global constants), which is what keeps the
-    distributed obstacle arithmetic bitwise-consistent."""
+    distributed obstacle arithmetic bitwise-consistent. `over_j`/`over_i`
+    zero-pad the HI sides by the ragged ceil-division overhang so
+    trailing-shard slices never clamp (dead cells read zero masks: no
+    updates, no faces, no residual)."""
     from jax import lax as _lax
 
     from ..parallel.comm import get_offsets
 
     joff = get_offsets("j", jl)
     ioff = get_offsets("i", il)
+    pad = [(0, over_j), (0, over_i)]
 
     def ext(a):
-        return _lax.dynamic_slice(a, (joff, ioff), (jl + 2, il + 2))
+        return _lax.dynamic_slice(jnp.pad(a, pad), (joff, ioff),
+                                  (jl + 2, il + 2))
 
     def inter(a):
-        return _lax.dynamic_slice(a, (joff, ioff), (jl, il))
+        return _lax.dynamic_slice(jnp.pad(a, pad), (joff, ioff), (jl, il))
 
     return ObstacleMasks(
         fluid=ext(m.fluid),
@@ -349,7 +355,9 @@ def shard_masks(m: ObstacleMasks, jl: int, il: int) -> ObstacleMasks:
 
 def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                               m: ObstacleMasks, dtype, ca_n: int = 1,
-                              sor_inner: int = 1, backend: str = "auto"):
+                              sor_inner: int = 1, backend: str = "auto",
+                              ragged: bool = False,
+                              record_key: str = "obstacle_dist"):
     """Distributed eps-coefficient pressure solve (shard_map kernel side),
     COMMUNICATION-AVOIDING like the uniform solve: one depth-2n halo
     exchange buys n exact red-black iterations computed locally (the static
@@ -369,7 +377,16 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
     Returns `(solve, used_pallas)` — callers that need the dispatch
     decision (e.g. to relax shard_map's check_vma around the pallas_call)
     read it from the return value; the "obstacle_dist" _dispatch.record is
-    informational only (driver artifacts, tests)."""
+    informational only (driver artifacts, tests).
+
+    `ragged=True` (round 5, VERDICT r4 item 2): the grid is ceil-divided
+    with trailing dead cells — the same per-shard kernel runs with halo
+    depth 2n+1 (stencil2d.ca_halo's ragged layer) and overhang-safe global
+    constant padding (deep_pad_widths); dead cells carry zero flags, so the
+    global-coordinate gating already excludes them from updates, walls and
+    residuals. The reference's remainder ranks run the identical optimized
+    solver (assignment-6/src/comm.c:19-22 sizeOfRank) — this is that
+    property for the flag-masked kernel."""
     from ..parallel.comm import (
         get_offsets,
         halo_exchange,
@@ -391,7 +408,11 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
     idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
     epssq = eps * eps
     norm = m.n_fluid
-    supported = ca_supported(jl, il)
+    # ragged CA consumes one extra halo layer (ca_halo), so the deep
+    # strips fit the owned extents only from min extent 3
+    supported = ca_supported(jl, il) and (
+        not ragged or ca_halo(1, True) <= min(jl, il)
+    )
 
     # per-shard Pallas kernel dispatch (round 3): production path on TPU
     rb_k = None
@@ -405,13 +426,16 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
             # the kernel's unrolled-sweep stack): a shallower pallas
             # kernel beats the jnp fallback at any depth
             n_k = ca_clamp(max(ca_n, sor_inner), jl, il)
+            while ragged and n_k > 1 and ca_halo(n_k, True) > min(jl, il):
+                n_k -= 1
             while n_k >= 1:
                 try:
                     # interpret resolves off the backend inside the maker
                     # (real kernel on TPU, interpret elsewhere — the test
                     # mode)
                     rb_k, br_k, h_k = make_rb_iters_obsdist(
-                        jmax, imax, jl, il, n_k, dx, dy, m.omega, dtype
+                        jmax, imax, jl, il, n_k, dx, dy, m.omega, dtype,
+                        ragged=ragged,
                     )
                     break
                 except ValueError:
@@ -419,18 +443,33 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                     n_k //= 2
     if rb_k is not None:
         n = n_k
-        _dispatch.record("obstacle_dist", f"pallas ca{n}")
+        _dispatch.record(
+            record_key, f"pallas ca{n}" + (" ragged" if ragged else "")
+        )
     else:
         n = ca_clamp(ca_n, jl, il) if supported else 1
+        if supported and ragged:
+            while n > 1 and ca_halo(n, True) > min(jl, il):
+                n -= 1
         _dispatch.record(
-            "obstacle_dist",
-            f"jnp_ca ca{n}" if supported else "jnp_rb_fallback",
+            record_key,
+            (f"jnp_ca ca{n}" if supported else "jnp_rb_fallback")
+            + (" ragged" if ragged else ""),
         )
-    H = ca_halo(n) if supported else 1
+    H = ca_halo(n, ragged) if supported else 1
+
+    # ragged ceil-division overhang per axis (0 when divisible): global
+    # constants pad their HI side by it so trailing-shard slices never
+    # clamp (stencil2d.deep_pad_widths)
+    from ..parallel.stencil2d import deep_pad_widths
+
+    pw_j = deep_pad_widths(H, jl, comm.axis_size("j"), jmax)
+    pw_i = deep_pad_widths(H, il, comm.axis_size("i"), imax)
 
     def solve(p, rhs):
         cm = ca_masks(jl, il, H, jmax, imax, dtype)
-        om = deep_obstacle_masks(m, jl, il, H)
+        om = deep_obstacle_masks(m, jl, il, H, over_j=pw_j[1] - pw_j[0],
+                                 over_i=pw_i[1] - pw_i[0])
         pd = embed_deep(p, H)
         rd = halo_exchange(embed_deep(rhs, H), comm, depth=H)
         if rb_k is not None:
@@ -447,14 +486,15 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                 [joff.astype(jnp.int32), ioff.astype(jnp.int32)]
             )
             rd_p = sp.pad_array(rd, br_k, h_k)
-            # the deep fluid block: global flags padded by H-1 dead cells,
-            # shard slice at the plain mesh offsets (deep_obstacle_masks
-            # convention, full extended block)
+            # the deep fluid block: global flags padded by H-1 dead cells
+            # (hi side absorbs the ragged overhang), shard slice at the
+            # plain mesh offsets (deep_obstacle_masks convention, full
+            # extended block)
             import jax as _jx
 
             flg_p = sp.pad_array(
                 _jx.lax.dynamic_slice(
-                    jnp.pad(m.fluid, [(H - 1, H - 1)] * 2),
+                    jnp.pad(m.fluid, [pw_j, pw_i]),
                     (joff, ioff), (jl + 2 * H, il + 2 * H),
                 ),
                 br_k, h_k,
@@ -502,6 +542,10 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                 pd2, r_red = _obstacle_half(pd2, rd, red, om, idx2, idy2)
                 pd2 = halo_exchange(pd2, comm)
                 pd2, r_blk = _obstacle_half(pd2, rd, black, om, idx2, idy2)
+                if ragged:
+                    # the wall-ghost row can open a dead shard whose
+                    # Neumann source lives on a neighbour (ca_halo)
+                    pd2 = halo_exchange(pd2, comm)
                 pd = neumann_masked(pd2, cm)
                 r2 = jnp.sum(
                     jnp.where(
@@ -525,14 +569,18 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
     return solve, rb_k is not None
 
 
-def deep_obstacle_masks(m: ObstacleMasks, jl: int, il: int, halo: int):
+def deep_obstacle_masks(m: ObstacleMasks, jl: int, il: int, halo: int,
+                        over_j: int = 0, over_i: int = 0):
     """Interior-mask slices for the deep-halo CA layout (stencil2d.ca_*):
     the update region of a (jl+2H, il+2H) block is its [1:-1] interior, and
     its cell (a, b) sits at global interior index (a - (H-1) + joff, …) —
     so pad the GLOBAL interior mask constants by H-1 (zeros: out-of-domain
     cells update nothing and carry no residual) and slice at the plain mesh
-    offsets. Static geometry ⇒ identical values on every shard that sees a
-    cell ⇒ redundant halo updates stay bitwise-consistent."""
+    offsets. `over_j`/`over_i` extend the HI-side pad by the ragged
+    ceil-division overhang so trailing-shard slices never clamp
+    (stencil2d.deep_pad_widths rationale). Static geometry ⇒ identical
+    values on every shard that sees a cell ⇒ redundant halo updates stay
+    bitwise-consistent."""
     from jax import lax as _lax
 
     from ..parallel.comm import get_offsets
@@ -540,7 +588,7 @@ def deep_obstacle_masks(m: ObstacleMasks, jl: int, il: int, halo: int):
     H = halo
     joff = get_offsets("j", jl)
     ioff = get_offsets("i", il)
-    pad = [(H - 1, H - 1)] * 2
+    pad = [(H - 1, H - 1 + over_j), (H - 1, H - 1 + over_i)]
     size = (jl + 2 * H - 2, il + 2 * H - 2)
 
     def inter(a):

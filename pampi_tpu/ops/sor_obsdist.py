@@ -173,23 +173,36 @@ def _obsdist_kernel(
 
 def make_rb_iters_obsdist(jmax, imax, jl, il, n, dx, dy, omega, dtype, *,
                           interpret: bool | None = None,
-                          block_rows: int | None = None):
+                          block_rows: int | None = None,
+                          ragged: bool = False):
     """Build `(offs_i32[2], p_padded, rhs_padded, flg_padded) ->
     (p_padded', owned res sum of last iter)` performing n red-black
     eps-coefficient iterations on the padded (jl+2H, il+2H) deep block
-    (H = 2n; pad with sor_pallas.pad_array(x, block_rows, halo)). Returns
-    (rb_iters, block_rows, halo). offs = [joff, ioff] grid offsets.
-    block_rows overrides the picker (tests use it to force the multi-block
-    DMA pipeline on small geometries)."""
+    (H = ca_halo(n, ragged) = 2n, or 2n+1 on ragged decompositions — the
+    wall-ghost refresh of a trailing/dead shard consumes one extra layer,
+    parallel/stencil2d.ca_halo; pad with sor_pallas.pad_array(x,
+    block_rows, halo)). The kernel body is global-coordinate gated
+    throughout, so ragged layouts need no body change — dead cells beyond
+    the global ghost ring sit outside `interior` and carry zero flags.
+    Returns (rb_iters, block_rows, halo). offs = [joff, ioff] grid
+    offsets. block_rows overrides the picker (tests use it to force the
+    multi-block DMA pipeline on small geometries)."""
+    from ..parallel.stencil2d import ca_halo
+
     if pltpu is None:
         return None, 0, 0
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     _check_dtype(dtype, interpret)
-    H = 2 * n
+    H = ca_halo(n, ragged)
     ext_j = jl + 2 * H  # logical rows of the deep block incl. its "+2"
     ext_i = il + 2 * H
     h = tblock_halo(n, dtype)
+    if h < H:  # ragged's +1 layer crossed a sublane-alignment boundary
+        from .sor_pallas import _align
+
+        a = _align(dtype)
+        h = -(-H // a) * a
     if block_rows is None:
         block_rows = pick_block_rows_tblock(ext_j - 2, ext_i - 2, dtype, n)
     wp = padded_width(ext_i - 2)

@@ -189,6 +189,17 @@ def ca_inner(param, *local_extents) -> int:
     return ca_clamp(param.tpu_ca_inner, *local_extents)
 
 
+def deep_pad_widths(halo: int, local: int, nper: int, gmax: int):
+    """Per-axis pad widths for slicing a GLOBAL (gmax+2)-extent constant
+    into (local + 2*halo)-extent deep shard blocks at the plain mesh
+    offsets: lo side halo-1 as always; the HI side additionally absorbs the
+    ragged ceil-division overhang (nper*local - gmax > 0), without which
+    the trailing shard's dynamic_slice would CLAMP its start index and
+    silently read shifted values into what must be dead-zero cells."""
+    over = max(0, nper * local - gmax)
+    return (halo - 1, halo - 1 + over)
+
+
 def embed_deep(x, halo: int):
     """Grow a 1-ghost-layer extended block into the deep-halo layout (any
     rank): along each axis of owned extent L, the old ghost layers land at

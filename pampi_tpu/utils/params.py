@@ -84,7 +84,11 @@ class Parameter:
     #                  the same kernels per shard between CA exchanges
     #                  (parallel/quarters_dist.py, octants_dist.py).
     #   "checkerboard" the masked kernel (per-cell trajectory numerically
-    #                  IDENTICAL to the jnp reference path)
+    #                  IDENTICAL to the jnp reference path). In DISTRIBUTED
+    #                  context it also FORCES the per-shard masked kernel
+    #                  (ops/sor_obsdist; interpret off-TPU) for obstacle
+    #                  and ragged runs — the dryrun/test force mode, since
+    #                  that kernel IS the dist masked-checkerboard layout
     #   "quarters"/"octants"  force the compressed layout (error when
     #                  ineligible; off-TPU runs the interpret kernel/twin)
     tpu_sor_layout: str = "auto"
@@ -111,6 +115,11 @@ class Parameter:
     # fft does not support obstacle flag fields; mg does (2-D and 3-D,
     # single-device AND distributed — per-level rediscretized
     # eps-coefficient operators with an exact dense bottom)
+    #   "auto" picks the measured-best solver for the run's structure
+    #          (utils/dispatch.resolve_solver: plain -> fft; obstacles ->
+    #          mg; ragged -> sor) and records the decision under the
+    #          "solver_auto" dispatch key. The default stays "sor" for
+    #          reference-trajectory parity.
     tpu_solver: str = "sor"
     # MG stall detector (tpu_solver mg only): a V-cycle whose residual
     # changed less than this RELATIVE tolerance is treated as floored and

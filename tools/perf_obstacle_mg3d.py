@@ -1,0 +1,219 @@
+"""3-D obstacle-MG at 96^3: cost decomposition + same-session comparator
+(VERDICT r4 item 3: mg measured 169.1 ms/step vs capped SOR 18.9 in round
+4, with no committed artifact and no decomposition — the 2-D twin's
+ablation is what found its 59x).
+
+Workload: dcavity3d 96^3 f32, Re=1000, box obstacle 0.3..0.6 on every
+axis, eps=1e-3, itermax=1000 — the "96^3 box dcavity step" of BASELINE.md.
+
+Measures (all in ONE session — cross-session comparators are the
+documented pitfall):
+- ms/step for tpu_solver mg and sor (capped smoother), two-point
+  chained-step differencing;
+- V-cycles per solve at the SETTLED production state (the solve's own it);
+- per-CYCLE cost via fixed-cycle solves (eps=0, stall off, itermax=k;
+  k=2 vs k=8 differenced), with ablations: no smoothing (n_pre=n_post=0:
+  transfers + dense bottom only) and jnp smoothing (Pallas smoothers
+  ablated) — splits cycle count x smoothing x hierarchy.
+
+Run on the real chip:  python tools/perf_obstacle_mg3d.py
+Writes results/obstacle_mg3d_96.json (merge-preserving curated keys).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+from pampi_tpu.utils.params import Parameter
+
+SETTLE = 3
+REPS = 5
+N = 96
+OBST = "0.3,0.3,0.3,0.6,0.6,0.6"
+
+
+def make_param(solver: str) -> Parameter:
+    return Parameter(
+        name="dcavity3d", imax=N, jmax=N, kmax=N,
+        xlength=1.0, ylength=1.0, zlength=1.0,
+        re=1000.0, te=1e9, tau=0.5, itermax=1000, eps=1e-3, omg=1.8,
+        gamma=0.9, obstacles=OBST, tpu_dtype="float32", tpu_solver=solver,
+    )
+
+
+def _build(solver: str):
+    from pampi_tpu.models.ns3d import NS3DSolver
+
+    s = NS3DSolver(make_param(solver), dtype=jnp.float32)
+    return s
+
+
+def _settled_state(s):
+    step = s._build_step()
+
+    def k_steps(k):
+        @jax.jit
+        def run(state):
+            return jax.lax.fori_loop(0, k, lambda _, c: step(*c), state)
+
+        return run
+
+    state = (s.u, s.v, s.w, s.p, jnp.asarray(0.0, jnp.float32),
+             jnp.asarray(0, jnp.int32))
+    state = k_steps(SETTLE)(state)
+    float(state[4])
+    return state, k_steps
+
+
+def measure_step_ms(solver: str) -> float:
+    s = _build(solver)
+    state, k_steps = _settled_state(s)
+
+    def timed(k):
+        run = k_steps(k)
+        float(run(state)[4])
+        best = float("inf")
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            float(run(state)[4])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    ta = timed(1)
+    kb = 1 + max(2, min(64, int(1.0 / max(ta, 1e-3))))
+    tb = timed(kb)
+    return max((tb - ta) / (kb - 1), 1e-9) * 1e3
+
+
+def settled_p_rhs(s, state):
+    """Rebuild the production solve inputs (p, rhs) at the settled state —
+    the step's own pre-solve chain (models/ns3d._build_step)."""
+    from pampi_tpu.ops import ns3d as ops
+    from pampi_tpu.ops.obstacle3d import (
+        apply_obstacle_velocity_bc_3d,
+        mask_fgh,
+    )
+
+    param = s.param
+    g = s.grid
+    u, v, w, p = state[:4]
+
+    @jax.jit
+    def prep(u, v, w, p):
+        dt = ops.compute_timestep_3d(
+            u, v, w, jnp.asarray(s.dt_bound, jnp.float32),
+            g.dx, g.dy, g.dz, param.tau,
+        )
+        bcs = {"top": param.bcTop, "bottom": param.bcBottom,
+               "left": param.bcLeft, "right": param.bcRight,
+               "front": param.bcFront, "back": param.bcBack}
+        u, v, w = ops.set_boundary_conditions_3d(u, v, w, bcs)
+        u = ops.set_special_bc_dcavity_3d(u)
+        u, v, w = apply_obstacle_velocity_bc_3d(u, v, w, s.masks)
+        f, g_, h = ops.compute_fgh(
+            u, v, w, dt, param.re, param.gx, param.gy, param.gz,
+            param.gamma, g.dx, g.dy, g.dz,
+        )
+        f, g_, h = mask_fgh(f, g_, h, u, v, w, s.masks)
+        rhs = ops.compute_rhs(f, g_, h, dt, g.dx, g.dy, g.dz)
+        return p, rhs
+
+    return prep(u, v, w, p)
+
+
+def fixed_cycle_solve_ms(s, p, rhs, n_pre=2, n_post=2,
+                         jnp_smoothing=False) -> float:
+    """Per-cycle cost: eps=0 + stall off burns exactly itermax cycles;
+    two-point differencing between k=2 and k=8."""
+    import pampi_tpu.ops.multigrid as mg
+
+    g = s.grid
+    saved = mg._PALLAS_SMOOTH_MIN_CELLS
+    if jnp_smoothing:
+        mg._PALLAS_SMOOTH_MIN_CELLS = 1 << 60
+    try:
+        def solve_k(k):
+            fn, _used = None, None
+            fn = mg.make_obstacle_mg_solve_3d(
+                g.imax, g.jmax, g.kmax, g.dx, g.dy, g.dz,
+                0.0, k, s.masks, jnp.float32,
+                n_pre=n_pre, n_post=n_post, stall_rtol=0.0,
+            )
+            return jax.jit(fn)
+
+        def timed(k):
+            fn = solve_k(k)
+            out = fn(p, rhs)
+            assert int(out[2]) == k
+            float(out[1])
+            best = float("inf")
+            for _ in range(REPS):
+                t0 = time.perf_counter()
+                float(fn(p, rhs)[1])
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        ta = timed(2)
+        tb = timed(8)
+        return max(tb - ta, 1e-9) / 6 * 1e3
+    finally:
+        mg._PALLAS_SMOOTH_MIN_CELLS = saved
+
+
+def production_cycles(s, p, rhs) -> dict:
+    import pampi_tpu.ops.multigrid as mg
+
+    g = s.grid
+    param = s.param
+    fn = jax.jit(mg.make_obstacle_mg_solve_3d(
+        g.imax, g.jmax, g.kmax, g.dx, g.dy, g.dz,
+        param.eps, param.itermax, s.masks, jnp.float32,
+        stall_rtol=param.tpu_mg_stall_rtol,
+    ))
+    pp, res, it = fn(p, rhs)
+    return {"cycles": int(it), "residual": float(res),
+            "eps_sq": param.eps ** 2}
+
+
+if __name__ == "__main__":
+    rec = {
+        "artifact": "obstacle_mg3d_96",
+        "config": f"dcavity3d {N}^3 f32, Re=1000, box obstacle {OBST}, "
+                  "eps=1e-3, itermax=1000, omg=1.8",
+        "protocol": "settled 3 steps; ms/step: chained-step two-point "
+                    "differencing best-of-%d; per-cycle: fixed-cycle "
+                    "solves (eps=0, stall off) k=2 vs k=8 differenced; "
+                    "tool: tools/perf_obstacle_mg3d.py" % REPS,
+        "backend": jax.default_backend(),
+    }
+    s = _build("mg")
+    state, _ = _settled_state(s)
+    p, rhs = settled_p_rhs(s, state)
+    rec["production_solve"] = production_cycles(s, p, rhs)
+    rec["ms_per_cycle"] = round(fixed_cycle_solve_ms(s, p, rhs), 3)
+    rec["ms_per_cycle_jnp_smoothing"] = round(
+        fixed_cycle_solve_ms(s, p, rhs, jnp_smoothing=True), 3)
+    rec["ms_per_cycle_no_smoothing"] = round(
+        fixed_cycle_solve_ms(s, p, rhs, n_pre=0, n_post=0), 3)
+    rec["mg_ms_per_step"] = round(measure_step_ms("mg"), 2)
+    rec["sor_capped_ms_per_step"] = round(measure_step_ms("sor"), 2)
+
+    out = os.path.join(REPO, "results", "obstacle_mg3d_96.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    if os.path.exists(out):
+        with open(out) as fh:
+            old = json.load(fh)
+        old.update(rec)
+        rec = old
+    with open(out, "w") as fh:
+        json.dump(rec, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(rec, indent=2))
+    print(f"wrote {out}")
