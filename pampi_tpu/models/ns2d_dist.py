@@ -329,25 +329,25 @@ class NS2DDistSolver:
         if self.ragged and plain_sor:
             from ..models.poisson import _use_pallas
             from ..ops import obstacle as obst
-        if (self.ragged and plain_sor
-                and (force_masked or _use_pallas("auto", dtype))):
-            # the dispatch predicate gates the BUILD too: the all-fluid
-            # masks are host-side global-sized arrays — off-TPU unforced
-            # runs keep _solve_sor without paying for them
-            m_live = obst.make_masks(
-                np.ones((self.jmax + 2, self.imax + 2), bool),
-                dx, dy, param.omg, dtype,
-            )
-            cand, used_k = obst.make_dist_obstacle_solver(
-                comm, self.imax, self.jmax, jl, il, dx, dy, param.eps,
-                param.itermax, m_live, dtype, ca_n=param.tpu_ca_inner,
-                sor_inner=param.tpu_sor_inner, ragged=True,
-                record_key="ns2d_dist",
-                backend="pallas" if force_masked else "auto",
-            )
-            if used_k:
-                solve_ragged_k = cand
-                pallas_q = True
+
+            if force_masked or _use_pallas("auto", dtype):
+                # the dispatch predicate gates the BUILD too: the all-fluid
+                # masks are host-side global-sized arrays — off-TPU
+                # unforced runs keep _solve_sor without paying for them
+                m_live = obst.make_masks(
+                    np.ones((self.jmax + 2, self.imax + 2), bool),
+                    dx, dy, param.omg, dtype,
+                )
+                cand, used_k = obst.make_dist_obstacle_solver(
+                    comm, self.imax, self.jmax, jl, il, dx, dy, param.eps,
+                    param.itermax, m_live, dtype, ca_n=param.tpu_ca_inner,
+                    sor_inner=param.tpu_sor_inner, ragged=True,
+                    record_key="ns2d_dist",
+                    backend="pallas" if force_masked else "auto",
+                )
+                if used_k:
+                    solve_ragged_k = cand
+                    pallas_q = True
         if rb_q is None and solve_ragged_k is None:
             tag = (
                 "jnp_ca" if plain_sor else f"other_{param.tpu_solver}"

@@ -140,7 +140,6 @@ def fixed_cycle_solve_ms(s, p, rhs, n_pre=2, n_post=2,
         mg._PALLAS_SMOOTH_MIN_CELLS = 1 << 60
     try:
         def solve_k(k):
-            fn, _used = None, None
             fn = mg.make_obstacle_mg_solve_3d(
                 g.imax, g.jmax, g.kmax, g.dx, g.dy, g.dz,
                 0.0, k, s.masks, jnp.float32,
