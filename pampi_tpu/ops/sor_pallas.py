@@ -410,15 +410,24 @@ def masked_stencil_ops(fl, idx2, idy2, omega):
     return fac, lap
 
 
-def rb_inner_sweeps(p, rw, n_inner, red, black, fac, lap, ghosts):
+def rb_inner_sweeps(p, rw, n_inner, red, black, fac, lap, ghosts,
+                    loop: bool = False):
     """The fused red-black inner loop + per-iteration Neumann ghost refresh
     shared by every 2-D checkerboard-layout kernel (single-device
     _tblock_kernel and distributed _obsdist_kernel — one home so the two
     cannot drift). `ghosts` = (row_lo, row_hi, col_lo, col_hi) select
-    masks. Returns (p, r_red, r_blk) of the LAST iteration."""
-    r_red = r_blk = None
+    masks. Returns (p, r_red, r_blk) of the LAST iteration.
+
+    `loop=True` runs the sweeps through a `lax.fori_loop` (scf.for in
+    Mosaic) instead of unrolling: Mosaic's STACK for the unrolled body
+    scales with n (each unrolled sweep keeps window-sized temporaries
+    live — the ca16-at-512-wide-shards OOM of round 4), while the looped
+    body's live set is one sweep's. Same op sequence per sweep -> bitwise
+    identical results; the default stays unrolled (the tuned headline
+    kernels' codegen is untouched)."""
     row_lo, row_hi, col_lo, col_hi = ghosts
-    for _t in range(n_inner):
+
+    def sweep(p):
         r_red = jnp.where(red, rw - lap(p), 0.0)
         p = p - fac * r_red
         r_blk = jnp.where(black, rw - lap(p), 0.0)
@@ -427,6 +436,16 @@ def rb_inner_sweeps(p, rw, n_inner, red, black, fac, lap, ghosts):
         p = jnp.where(row_hi, jnp.roll(p, 1, axis=0), p)
         p = jnp.where(col_lo, jnp.roll(p, -1, axis=1), p)
         p = jnp.where(col_hi, jnp.roll(p, 1, axis=1), p)
+        return p, r_red, r_blk
+
+    if loop:
+        return jax.lax.fori_loop(
+            0, n_inner, lambda _t, c: sweep(c[0]),
+            (p, jnp.zeros_like(p), jnp.zeros_like(p)),
+        )
+    r_red = r_blk = None
+    for _t in range(n_inner):
+        p, r_red, r_blk = sweep(p)
     return p, r_red, r_blk
 
 

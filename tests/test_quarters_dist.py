@@ -299,3 +299,45 @@ def test_obsdist_depth_backoff_keeps_pallas(monkeypatch):
     )
     assert used
     assert dispatch.last("obstacle_dist") == "pallas ca2"
+
+
+def test_obsdist_windowed_sweeps_bitwise():
+    """rb_inner_sweeps(loop=True) — the scf.for sweep windowing — is
+    bitwise-equal to the unrolled form (same per-sweep op sequence).
+    Round-5 outcome (VERDICT r4 item 7): the looped kernel is an EXPLICIT
+    opt-in only — it crashes the production Mosaic compiler at any depth
+    on the current toolchain (documented in make_rb_iters_obsdist), so
+    auto mode keeps the unrolled form + depth backoff; this test pins the
+    windowed variant's correctness for when the toolchain allows it."""
+    from pampi_tpu.ops import obstacle as obst
+    from pampi_tpu.ops import sor_obsdist as so
+    from pampi_tpu.ops import sor_pallas as sp
+
+    imax, jmax = 64, 32
+    dx, dy = 16.0 / imax, 4.0 / jmax
+    fluid = obst.build_fluid(imax, jmax, dx, dy, "6.0,1.5,10.0,2.5")
+    m = obst.make_masks(fluid, dx, dy, 1.7, jnp.float64)
+    jl, il = jmax, imax
+    n = 4
+    H = 2 * n
+
+    def build(loop):
+        return so.make_rb_iters_obsdist(
+            jmax, imax, jl, il, n, dx, dy, 1.7, jnp.float64,
+            interpret=True, loop_sweeps=loop,
+        )
+
+    rb_u, br, h = build(False)
+    rb_l, br2, h2 = build(True)
+    assert (br, h) == (br2, h2)
+
+    rng = np.random.default_rng(9)
+    pd = jnp.asarray(rng.standard_normal((jl + 2 * H, il + 2 * H)))
+    rd = jnp.asarray(rng.standard_normal((jl + 2 * H, il + 2 * H)))
+    flg = sp.pad_array(
+        jnp.pad(m.fluid, [(H - 1, H - 1)] * 2).astype(jnp.float64), br, h)
+    offs = jnp.asarray([0, 0], jnp.int32)
+    pu, ru = rb_u(offs, sp.pad_array(pd, br, h), sp.pad_array(rd, br, h), flg)
+    plp, rl = rb_l(offs, sp.pad_array(pd, br, h), sp.pad_array(rd, br, h), flg)
+    np.testing.assert_array_equal(np.asarray(pu), np.asarray(plp))
+    np.testing.assert_array_equal(float(ru), float(rl))

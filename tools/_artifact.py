@@ -1,0 +1,21 @@
+"""Shared artifact-write helper for the perf tools: merge-preserving JSON
+(the committed artifacts carry curated analysis fields the tools do not
+produce — a re-run refreshes the measured keys without deleting those)."""
+
+import json
+import os
+
+
+def write_merged(path: str, rec: dict) -> dict:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if os.path.exists(path):
+        with open(path) as fh:
+            old = json.load(fh)
+        old.update(rec)
+        rec = old
+    with open(path, "w") as fh:
+        json.dump(rec, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(rec, indent=2))
+    print(f"wrote {path}")
+    return rec

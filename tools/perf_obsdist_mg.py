@@ -118,19 +118,6 @@ if __name__ == "__main__":
     rec["single_mg_jnp_smoothing_ms_per_step"] = round(
         _with_jnp_smoothing(single_ms, "mg"), 3
     )
-    out = os.path.join(REPO, "results", "obsdist_mg2048.json")
-    os.makedirs(os.path.dirname(out), exist_ok=True)
-    # merge-preserve: the committed artifact carries curated analysis
-    # fields (session_findings, cross_session_anchors, ...) this tool does
-    # not produce — a re-run refreshes the measured keys without deleting
-    # the curated ones
-    if os.path.exists(out):
-        with open(out) as fh:
-            old = json.load(fh)
-        old.update(rec)
-        rec = old
-    with open(out, "w") as fh:
-        json.dump(rec, fh, indent=2)
-        fh.write("\n")
-    print(json.dumps(rec, indent=2))
-    print(f"wrote {out}")
+    from tools._artifact import write_merged
+
+    write_merged(os.path.join(REPO, "results", "obsdist_mg2048.json"), rec)

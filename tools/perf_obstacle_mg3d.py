@@ -204,15 +204,6 @@ if __name__ == "__main__":
     rec["mg_ms_per_step"] = round(measure_step_ms("mg"), 2)
     rec["sor_capped_ms_per_step"] = round(measure_step_ms("sor"), 2)
 
-    out = os.path.join(REPO, "results", "obstacle_mg3d_96.json")
-    os.makedirs(os.path.dirname(out), exist_ok=True)
-    if os.path.exists(out):
-        with open(out) as fh:
-            old = json.load(fh)
-        old.update(rec)
-        rec = old
-    with open(out, "w") as fh:
-        json.dump(rec, fh, indent=2)
-        fh.write("\n")
-    print(json.dumps(rec, indent=2))
-    print(f"wrote {out}")
+    from tools._artifact import write_merged
+
+    write_merged(os.path.join(REPO, "results", "obstacle_mg3d_96.json"), rec)

@@ -121,12 +121,18 @@ def masked_kernel_rate(gj, gi, jl, il, ragged: bool) -> dict:
             best = min(best, time.perf_counter() - t0)
         return best
 
-    ka, kb = 40, 120
-    ta, tb = timed(ka), timed(kb)
+    # adaptive spans: the differential must be >= ~0.5 s or it sits inside
+    # the tunnel's latency jitter (measurement pitfall; a 30 ms
+    # differential once read 11.8G for a 21.0G kernel)
+    ka = 40
+    ta = timed(ka)
+    kb = ka + max(80, int(0.6 / max(ta / ka, 1e-6)))
+    tb = timed(kb)
     iters = (kb - ka) * N_INNER
     ups = jl * il * iters / max(tb - ta, 1e-9)
     return {"updates_per_sec": round(ups / 1e9, 2), "unit": "G",
-            "halo_depth": H, "shard": [jl, il], "n_inner": N_INNER}
+            "halo_depth": H, "shard": [jl, il], "n_inner": N_INNER,
+            "spans": [ka, kb]}
 
 
 def jnp_ca_ragged_rate(gj, gi, jl, il) -> dict:
@@ -174,10 +180,14 @@ def jnp_ca_ragged_rate(gj, gi, jl, il) -> dict:
             best = min(best, time.perf_counter() - t0)
         return best
 
-    ta, tb = timed(40), timed(120)
-    ups = jl * il * 80 * n / max(tb - ta, 1e-9)
+    ka = 40
+    ta = timed(ka)
+    kb = ka + max(80, int(0.6 / max(ta / ka, 1e-6)))  # >= ~0.5 s differential
+    tb = timed(kb)
+    ups = jl * il * (kb - ka) * n / max(tb - ta, 1e-9)
     return {"updates_per_sec": round(ups / 1e9, 2), "unit": "G",
-            "halo_depth": H, "shard": [jl, il], "n_inner": n}
+            "halo_depth": H, "shard": [jl, il], "n_inner": n,
+            "spans": [ka, kb]}
 
 
 if __name__ == "__main__":
@@ -194,15 +204,7 @@ if __name__ == "__main__":
     rec["masked_ragged_4095"] = masked_kernel_rate(
         4095, 4095, 2048, 2048, ragged=True)
     rec["jnp_ca_ragged_4095"] = jnp_ca_ragged_rate(4095, 4095, 2048, 2048)
-    out = os.path.join(REPO, "results", "ragged_throughput.json")
-    os.makedirs(os.path.dirname(out), exist_ok=True)
-    if os.path.exists(out):
-        with open(out) as fh:
-            old = json.load(fh)
-        old.update(rec)
-        rec = old
-    with open(out, "w") as fh:
-        json.dump(rec, fh, indent=2)
-        fh.write("\n")
-    print(json.dumps(rec, indent=2))
-    print(f"wrote {out}")
+    from tools._artifact import write_merged
+
+    write_merged(os.path.join(REPO, "results", "ragged_throughput.json"),
+                 rec)
