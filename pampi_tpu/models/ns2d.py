@@ -37,7 +37,7 @@ from ..utils.progress import Progress
 def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
                         backend: str = "auto", n_inner: int = 1,
                         solver: str = "sor", layout: str = "auto",
-                        stall_rtol=None):
+                        stall_rtol=None, flat: bool = False):
     """Pressure-Poisson solve loop (solve, solver.c:140-191): carry
     (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep.
 
@@ -87,7 +87,7 @@ def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
 
     return make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
                           backend=backend, n_inner=n_inner,
-                          layout=layout)
+                          layout=layout, flat=flat)
 
 
 class NS2DSolver:
@@ -175,6 +175,7 @@ class NS2DSolver:
                 solver=param.tpu_solver,
                 layout=param.tpu_sor_layout,
                 stall_rtol=param.tpu_mg_stall_rtol,
+                flat=bool(param.tpu_flat_solve),
             )
         elif param.tpu_solver == "mg":
             # obstacle-capable multigrid: rediscretized eps-coefficient

@@ -468,8 +468,10 @@ class NS2DDistSolver:
             # ragged ceil-division overhang (0 when divisible): the HI-side
             # zero-pad that keeps trailing-shard mask slices from clamping
             # (dead cells read zero masks)
-            over_j = max(0, Pj * jl - self.jmax)
-            over_i = max(0, Pi * il - self.imax)
+            from ..parallel.stencil2d import ceil_overhang
+
+            over_j = ceil_overhang(Pj, jl, self.jmax)
+            over_i = ceil_overhang(Pi, il, self.imax)
 
             def local_masks():
                 # must run INSIDE the shard_map trace (mesh offsets)

@@ -107,14 +107,17 @@ class NS3DDistSolver:
             ragged=self.ragged,
         )
         self.param = param
-        if self.ragged and (param.tpu_solver in ("mg", "fft")
-                            or param.obstacles.strip()):
-            what = ("obstacle flag fields" if param.obstacles.strip()
-                    else f"tpu_solver {param.tpu_solver}")
+        # round 5 (VERDICT r4 item 2): obstacles compose with ragged
+        # decompositions in 3-D too (the jnp CA path; the 3-D kernel stays
+        # divisible-only — obstacle3d.make_dist_obstacle_solver_3d).
+        # mg/fft stay divisible-only (coarsening/diagonalization need
+        # exact extents).
+        if self.ragged and param.tpu_solver in ("mg", "fft"):
             raise ValueError(
-                f"{what} needs a divisible grid/mesh (grid "
-                f"{g.kmax}x{g.jmax}x{g.imax} on {self.comm.dims}); ragged "
-                "pad-with-mask runs use tpu_solver sor without obstacles"
+                f"tpu_solver {param.tpu_solver} needs a divisible grid/mesh "
+                f"(grid {g.kmax}x{g.jmax}x{g.imax} on {self.comm.dims}); "
+                "ragged pad-with-mask runs use tpu_solver sor (obstacles "
+                "compose)"
             )
         inv_sqr_sum = 1.0 / g.dx**2 + 1.0 / g.dy**2 + 1.0 / g.dz**2
         self.dt_bound = 0.5 * param.re / inv_sqr_sum
@@ -369,6 +372,7 @@ class NS3DDistSolver:
                 comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                 param.eps, param.itermax, self.masks, dtype,
                 ca_n=param.tpu_ca_inner, sor_inner=param.tpu_sor_inner,
+                ragged=self.ragged,
             )
             # relax check_vma when the obstacle solver reports it
             # dispatched its per-shard Pallas kernel
@@ -388,9 +392,18 @@ class NS3DDistSolver:
                 shard_masks_3d,
             )
 
+            # ragged ceil-division overhang (0 when divisible): HI-side
+            # zero-pad so trailing-shard mask slices never clamp
+            from ..parallel.stencil2d import ceil_overhang
+
+            over_k = ceil_overhang(comm.axis_size("k"), kl, g.kmax)
+            over_j = ceil_overhang(comm.axis_size("j"), jl, g.jmax)
+            over_i = ceil_overhang(comm.axis_size("i"), il, g.imax)
+
             def local_masks():
                 # must run INSIDE the shard_map trace (mesh offsets)
-                return shard_masks_3d(gmasks, kl, jl, il)
+                return shard_masks_3d(gmasks, kl, jl, il,
+                                      over_k, over_j, over_i)
 
         def compute_dt(u, v, w):
             umax = reduction(jnp.max(jnp.abs(u)), comm, "max")
@@ -442,16 +455,20 @@ class NS3DDistSolver:
             h = halo_shift(h, comm, "k")
             rhs = ops.compute_rhs(f, g_, h, dt, dx, dy, dz)
             p, _res, _it = solve(p, rhs)
-            if gmasks is not None:
-                u, v, w = adapt_uvw_obstacle(
-                    u, v, w, f, g_, h, p, dt, dx, dy, dz, local_masks()
-                )
-            elif not self.ragged:
-                u, v, w = ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
+            def adapt(u, v, w):
+                if gmasks is not None:
+                    return adapt_uvw_obstacle(
+                        u, v, w, f, g_, h, p, dt, dx, dy, dz, local_masks()
+                    )
+                return ops.adapt_uvw(u, v, w, f, g_, h, p, dt, dx, dy, dz)
+
+            if not self.ragged:
+                u, v, w = adapt(u, v, w)
             else:
                 # ragged projection: only the true global interior updates;
                 # interior-stored ghost planes keep their BC-era values and
-                # dead cells are zeroed (see models/ns2d_dist.py)
+                # dead cells are zeroed (see models/ns2d_dist.py). One
+                # gating block for the plain AND obstacle projections.
                 from ..parallel import ragged3d as rg3
 
                 gk, gj, gi = rg3.global_index_grids(comm, kl, jl, il)
@@ -463,9 +480,7 @@ class NS3DDistSolver:
                 live = rg3.live_masks_3d(
                     comm, kl, jl, il, g.kmax, g.jmax, g.imax, dtype
                 )
-                ua, va, wa = ops.adapt_uvw(
-                    u, v, w, f, g_, h, p, dt, dx, dy, dz
-                )
+                ua, va, wa = adapt(u, v, w)
                 u = jnp.where(interior, ua, u) * live
                 v = jnp.where(interior, va, v) * live
                 w = jnp.where(interior, wa, w) * live

@@ -30,26 +30,42 @@ def compute_fg(u, v, dt, re, gx, gy, gamma, dx, dy):
     return apply_fg_wall_fixups(f, g, u, v)
 
 
+def _interior_mask(shape):
+    """Static interior-select mask: True on [1:-1, 1:-1]. The full-array
+    roll+where formulation below replaces interior dynamic-update-slices —
+    profiled at 4096² each DUS costs a full HBM pass (~0.57 ms) that the
+    where-select fuses into the producer for free; values at interior
+    cells are BITWISE identical (same operands, same op order), edges keep
+    the old array (or zero) exactly as the at[].set forms did."""
+    j = jnp.zeros((shape[0], 1), bool).at[1:-1].set(True)
+    i = jnp.zeros((1, shape[1]), bool).at[:, 1:-1].set(True)
+    return j & i
+
+
 def compute_fg_interior(u, v, dt, re, gx, gy, gamma, dx, dy):
     """Momentum predictor interior only (computeFG, solver.c:360-423): central
     + γ-blended donor-cell convective fluxes, viscous Laplacian, body force.
     Distributed callers gate the wall fixups to wall-owning shards (an ungated
-    local fixup would clobber F/G at interior shard edges)."""
+    local fixup would clobber F/G at interior shard edges).
+
+    Full-array formulation: every neighbour is a roll of the whole array
+    (wrap values land outside the interior mask), so each output is ONE
+    fused elementwise pass — no interior DUS (see _interior_mask)."""
     idx, idy = 1.0 / dx, 1.0 / dy
     inv_re = 1.0 / re
 
-    uc = u[1:-1, 1:-1]
-    ue = u[1:-1, 2:]
-    uw = u[1:-1, :-2]
-    un = u[2:, 1:-1]
-    us = u[:-2, 1:-1]
-    unw = u[2:, :-2]
-    vc = v[1:-1, 1:-1]
-    ve = v[1:-1, 2:]
-    vw = v[1:-1, :-2]
-    vn = v[2:, 1:-1]
-    vs = v[:-2, 1:-1]
-    vse = v[:-2, 2:]
+    uc = u
+    ue = jnp.roll(u, -1, axis=1)
+    uw = jnp.roll(u, 1, axis=1)
+    un = jnp.roll(u, -1, axis=0)
+    us = jnp.roll(u, 1, axis=0)
+    unw = jnp.roll(u, (-1, 1), axis=(0, 1))
+    vc = v
+    ve = jnp.roll(v, -1, axis=1)
+    vw = jnp.roll(v, 1, axis=1)
+    vn = jnp.roll(v, -1, axis=0)
+    vs = jnp.roll(v, 1, axis=0)
+    vse = jnp.roll(v, (1, -1), axis=(0, 1))
 
     du2dx = idx * 0.25 * (
         (uc + ue) * (uc + ue) - (uc + uw) * (uc + uw)
@@ -77,8 +93,9 @@ def compute_fg_interior(u, v, dt, re, gx, gy, gamma, dx, dy):
     lap_v = idx * idx * (ve - 2.0 * vc + vw) + idy * idy * (vn - 2.0 * vc + vs)
     g_int = vc + dt * (inv_re * lap_v - duvdx - dv2dy + gy)
 
-    f = jnp.zeros_like(u).at[1:-1, 1:-1].set(f_int)
-    g = jnp.zeros_like(v).at[1:-1, 1:-1].set(g_int)
+    m = _interior_mask(u.shape)
+    f = jnp.where(m, f_int, 0.0)
+    g = jnp.where(m, g_int, 0.0)
     return f, g
 
 
@@ -93,24 +110,26 @@ def apply_fg_wall_fixups(f, g, u, v):
 
 
 def compute_rhs(f, g, dt, dx, dy):
-    """Pressure-Poisson RHS = div(F,G)/dt (computeRHS, solver.c:122-138)."""
-    rhs_int = (1.0 / dt) * (
-        (f[1:-1, 1:-1] - f[1:-1, :-2]) / dx + (g[1:-1, 1:-1] - g[:-2, 1:-1]) / dy
+    """Pressure-Poisson RHS = div(F,G)/dt (computeRHS, solver.c:122-138).
+    Full-array roll form — one fused pass, no interior DUS
+    (_interior_mask)."""
+    rhs_full = (1.0 / dt) * (
+        (f - jnp.roll(f, 1, axis=1)) / dx + (g - jnp.roll(g, 1, axis=0)) / dy
     )
-    return jnp.zeros_like(f).at[1:-1, 1:-1].set(rhs_int)
+    return jnp.where(_interior_mask(f.shape), rhs_full, 0.0)
 
 
 def adapt_uv(u, v, f, g, p, dt, dx, dy):
-    """Projection / velocity correction (adaptUV, solver.c:438-455)."""
+    """Projection / velocity correction (adaptUV, solver.c:438-455).
+    Full-array roll form — the interior select fuses into the producer
+    (_interior_mask); edge cells keep the incoming u/v exactly as the
+    at[].set form did."""
     fx = dt / dx
     fy = dt / dy
-    u = u.at[1:-1, 1:-1].set(
-        f[1:-1, 1:-1] - (p[1:-1, 2:] - p[1:-1, 1:-1]) * fx
-    )
-    v = v.at[1:-1, 1:-1].set(
-        g[1:-1, 1:-1] - (p[2:, 1:-1] - p[1:-1, 1:-1]) * fy
-    )
-    return u, v
+    m = _interior_mask(u.shape)
+    u_new = f - (jnp.roll(p, -1, axis=1) - p) * fx
+    v_new = g - (jnp.roll(p, -1, axis=0) - p) * fy
+    return jnp.where(m, u_new, u), jnp.where(m, v_new, v)
 
 
 def set_boundary_conditions(u, v, bc_left, bc_right, bc_bottom, bc_top):

@@ -338,10 +338,14 @@ def adapt_uvw_obstacle(u, v, w, f, g, h, p, dt, dx, dy, dz,
 # ----------------------------------------------------------------------
 
 
-def shard_masks_3d(m: ObstacleMasks3D, kl: int, jl: int, il: int
+def shard_masks_3d(m: ObstacleMasks3D, kl: int, jl: int, il: int,
+                   over_k: int = 0, over_j: int = 0, over_i: int = 0
                    ) -> ObstacleMasks3D:
     """This shard's view of the global mask set: extended-block fields at
-    the extended origin, interior fields at the interior origin."""
+    the extended origin, interior fields at the interior origin. `over_*`
+    zero-pad the HI sides by the ragged ceil-division overhang so
+    trailing-shard slices never clamp (dead cells read zero masks — the
+    2-D shard_masks convention)."""
     from jax import lax as _lax
 
     from ..parallel.comm import get_offsets
@@ -349,12 +353,15 @@ def shard_masks_3d(m: ObstacleMasks3D, kl: int, jl: int, il: int
     koff = get_offsets("k", kl)
     joff = get_offsets("j", jl)
     ioff = get_offsets("i", il)
+    pad = [(0, over_k), (0, over_j), (0, over_i)]
 
     def ext(a):
-        return _lax.dynamic_slice(a, (koff, joff, ioff), (kl + 2, jl + 2, il + 2))
+        return _lax.dynamic_slice(jnp.pad(a, pad), (koff, joff, ioff),
+                                  (kl + 2, jl + 2, il + 2))
 
     def inter(a):
-        return _lax.dynamic_slice(a, (koff, joff, ioff), (kl, jl, il))
+        return _lax.dynamic_slice(jnp.pad(a, pad), (koff, joff, ioff),
+                                  (kl, jl, il))
 
     return ObstacleMasks3D(
         fluid=ext(m.fluid),
@@ -374,11 +381,15 @@ def shard_masks_3d(m: ObstacleMasks3D, kl: int, jl: int, il: int
     )
 
 
-def deep_obstacle_masks_3d(m: ObstacleMasks3D, kl, jl, il, halo: int):
+def deep_obstacle_masks_3d(m: ObstacleMasks3D, kl, jl, il, halo: int,
+                           over_k: int = 0, over_j: int = 0,
+                           over_i: int = 0):
     """Interior-mask slices for the deep-halo CA layout (3-D form of
     deep_obstacle_masks): pad the GLOBAL interior constants by H-1 zeros and
     slice at the plain mesh offsets — identical values on every shard that
-    sees a cell, so redundant halo updates stay bitwise-consistent."""
+    sees a cell, so redundant halo updates stay bitwise-consistent.
+    `over_*` extend the HI pads by the ragged overhang (deep_pad_widths
+    rationale)."""
     from jax import lax as _lax
 
     from ..parallel.comm import get_offsets
@@ -387,7 +398,8 @@ def deep_obstacle_masks_3d(m: ObstacleMasks3D, kl, jl, il, halo: int):
     koff = get_offsets("k", kl)
     joff = get_offsets("j", jl)
     ioff = get_offsets("i", il)
-    pad = [(H - 1, H - 1)] * 3
+    pad = [(H - 1, H - 1 + over_k), (H - 1, H - 1 + over_j),
+           (H - 1, H - 1 + over_i)]
     size = (kl + 2 * H - 2, jl + 2 * H - 2, il + 2 * H - 2)
 
     def inter(a):
@@ -449,7 +461,8 @@ def ca_rb_iters_obstacle_3d(p, rhs, n: int, cm, om, idx2, idy2, idz2):
 def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
                                  dx, dy, dz, eps, itermax,
                                  m: ObstacleMasks3D, dtype, ca_n: int = 1,
-                                 sor_inner: int = 1, backend: str = "auto"):
+                                 sor_inner: int = 1, backend: str = "auto",
+                                 ragged: bool = False):
     """Distributed 3-D eps-coefficient pressure solve (shard_map kernel
     side), communication-avoiding like the uniform solve: one depth-2n halo
     exchange buys n exact local red-black iterations (static global masks
@@ -479,13 +492,24 @@ def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
     idx2, idy2, idz2 = 1.0 / (dx * dx), 1.0 / (dy * dy), 1.0 / (dz * dz)
     epssq = eps * eps
     norm = m.n_fluid
-    supported = ca_supported(kl, jl, il)
+    # ragged CA consumes one extra halo layer (ca_halo): supported from
+    # min extent 3
+    supported = ca_supported(kl, jl, il) and (
+        not ragged or ca_halo(1, True) <= min(kl, jl, il)
+    )
     n = ca_clamp(ca_n, kl, jl, il) if supported else 1
+    if supported and ragged:
+        while n > 1 and ca_halo(n, True) > min(kl, jl, il):
+            n -= 1
     # per-shard Pallas kernel dispatch (round 3, mirrors the 2-D
     # make_dist_obstacle_solver): production path on TPU, interpret with
-    # backend="pallas" for tests; the jnp CA path keeps ca_n
+    # backend="pallas" for tests; the jnp CA path keeps ca_n. RAGGED runs
+    # stay on the jnp CA path in 3-D: the 3-D padded layout's k-halo is
+    # exactly 2n planes (sor3d_pallas.tblock3d_halo), so the ragged 2n+1
+    # depth would need the whole padded-k accounting retrofitted — the
+    # 2-D kernel has the ragged mode (sor_obsdist ca_halo layout).
     rb_k = None
-    if supported:
+    if supported and not ragged:
         from ..models.ns3d import _use_pallas_3d
 
         if backend == "pallas" or _use_pallas_3d("auto", dtype):
@@ -507,13 +531,23 @@ def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
     else:
         _dispatch.record(
             "obstacle3d_dist",
-            f"jnp_ca ca{n}" if supported else "jnp_rb_fallback",
+            (f"jnp_ca ca{n}" if supported else "jnp_rb_fallback")
+            + (" ragged" if ragged else ""),
         )
-    H = ca_halo(n) if supported else 1
+    H = ca_halo(n, ragged) if supported else 1
+
+    # ragged ceil-division overhang per axis (0 when divisible)
+    from ..parallel.stencil2d import ceil_overhang
+
+    over_k = ceil_overhang(comm.axis_size("k"), kl, kmax)
+    over_j = ceil_overhang(comm.axis_size("j"), jl, jmax)
+    over_i = ceil_overhang(comm.axis_size("i"), il, imax)
 
     def solve(p, rhs):
         cm = ca_masks_3d(kl, jl, il, H, kmax, jmax, imax, dtype)
-        om = deep_obstacle_masks_3d(m, kl, jl, il, H)
+        om = deep_obstacle_masks_3d(m, kl, jl, il, H,
+                                    over_k=over_k, over_j=over_j,
+                                    over_i=over_i)
         pd = embed_deep(p, H)
         rd = halo_exchange(embed_deep(rhs, H), comm, depth=H)
         if rb_k is not None:
@@ -582,6 +616,10 @@ def make_dist_obstacle_solver_3d(comm, imax, jmax, kmax, kl, jl, il,
                 pd2 = halo_exchange(pd2, comm)
                 pd2, r_evn = _obstacle_half_3d(pd2, rd, even, om,
                                                idx2, idy2, idz2)
+                if ragged:
+                    # the wall-ghost plane can open a dead shard whose
+                    # Neumann source lives on a neighbour (ca_halo)
+                    pd2 = halo_exchange(pd2, comm)
                 pd = neumann_masked_3d(pd2, cm)
                 r2 = jnp.sum(
                     jnp.where(

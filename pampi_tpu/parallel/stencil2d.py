@@ -189,6 +189,13 @@ def ca_inner(param, *local_extents) -> int:
     return ca_clamp(param.tpu_ca_inner, *local_extents)
 
 
+def ceil_overhang(nper: int, local: int, gmax: int) -> int:
+    """Trailing dead cells of a ceil-divided axis (0 when divisible) — the
+    single home of the overhang formula (used by deep_pad_widths and by
+    the obstacle shard/deep-mask pads)."""
+    return max(0, nper * local - gmax)
+
+
 def deep_pad_widths(halo: int, local: int, nper: int, gmax: int):
     """Per-axis pad widths for slicing a GLOBAL (gmax+2)-extent constant
     into (local + 2*halo)-extent deep shard blocks at the plain mesh
@@ -196,7 +203,7 @@ def deep_pad_widths(halo: int, local: int, nper: int, gmax: int):
     ragged ceil-division overhang (nper*local - gmax > 0), without which
     the trailing shard's dynamic_slice would CLAMP its start index and
     silently read shifted values into what must be dead-zero cells."""
-    over = max(0, nper * local - gmax)
+    over = ceil_overhang(nper, local, gmax)
     return (halo - 1, halo - 1 + over)
 
 

@@ -126,6 +126,17 @@ class Parameter:
     # the solve returns early (ops/multigrid.MG_STALL_RTOL rationale). Set 0
     # to disable and burn itermax like the reference's capped solves do.
     tpu_mg_stall_rtol: float = 1e-4
+    # capped-solve flat path (models/poisson.make_solver_fn flat=True,
+    # tpu_solver sor only): the pressure solve runs EXACTLY
+    # ceil(itermax/n_inner) kernel trips under fori_loop instead of the
+    # res-gated while. BITWISE identical on configs whose solves always
+    # hit itermax (the north-star cavity, the reference's canal configs);
+    # converging configs overdrive to the cap (extra sweeps only lower
+    # the residual). MEASURED neutral at 4096² (19.01 vs 19.04 ms/step,
+    # interleaved A/B, round 5): the loop TRIP overhead, not the residual
+    # gating, is the per-trip cost — kept as the structural option it is,
+    # not a speed claim. 0 = off (default).
+    tpu_flat_solve: int = 0
     # time-loop dispatch pipelining (models/_driver.drive_chunks): up to
     # this many chunk dispatches queued BEYOND the one the host is
     # confirming (so lookahead+1 states in flight), hiding the per-chunk

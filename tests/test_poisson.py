@@ -197,3 +197,46 @@ def test_rba_matches_rb_trajectory():
     np.testing.assert_allclose(
         np.asarray(rba.p), np.asarray(rb.p), rtol=0, atol=1e-12
     )
+
+
+def test_flat_solve_bitwise_on_capped_runs():
+    """tpu_flat_solve (round 5): exactly ceil(itermax/n) fori trips, no
+    res-gated cond. On a capped run (eps unreachable) the body sequence is
+    identical -> bitwise-equal field, residual and iteration count; on a
+    converging run it overdrives to the cap with a residual at or below
+    the while version's."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pampi_tpu.models.poisson import make_solver_fn
+
+    DT = jnp.float64
+    J = I = 64
+    dx = dy = 1.0 / I
+    rng = np.random.default_rng(3)
+    r = rng.standard_normal((J, I))
+    r -= r.mean()
+    rhs = jnp.zeros((J + 2, I + 2), DT).at[1:-1, 1:-1].set(jnp.asarray(r, DT))
+    p0 = jnp.zeros_like(rhs)
+
+    # capped: eps unreachable -> bitwise parity
+    w = jax.jit(make_solver_fn(I, J, dx, dy, 1.8, 1e-30, 60, DT,
+                               backend="jnp", n_inner=1))
+    f = jax.jit(make_solver_fn(I, J, dx, dy, 1.8, 1e-30, 60, DT,
+                               backend="jnp", n_inner=1, flat=True))
+    pw, resw, itw = w(p0, rhs)
+    pf, resf, itf = f(p0, rhs)
+    assert int(itw) == int(itf) == 60
+    np.testing.assert_array_equal(np.asarray(pw), np.asarray(pf))
+    assert float(resw) == float(resf)
+
+    # converging: flat overdrives to the cap, residual only improves
+    w2 = jax.jit(make_solver_fn(I, J, dx, dy, 1.8, 1e-6, 100000, DT,
+                                backend="jnp", n_inner=1))
+    f2 = jax.jit(make_solver_fn(I, J, dx, dy, 1.8, 1e-6, 5000, DT,
+                                backend="jnp", n_inner=1, flat=True))
+    _, resw2, itw2 = w2(p0, rhs)
+    _, resf2, itf2 = f2(p0, rhs)
+    assert int(itw2) < 5000 == int(itf2)
+    assert float(resf2) <= float(resw2)

@@ -256,7 +256,7 @@ def match4096(steps: int = 50) -> dict:
     return rec
 
 
-def run4096(te: float = 0.15) -> dict:
+def run4096(te: float = 0.15, lookahead: int = 2, chunk: int = 0) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -272,6 +272,11 @@ def run4096(te: float = 0.15) -> dict:
         # (round-3 depth sweep; the .par default stays 4 because small
         # CONVERGING workloads would overshoot by up to n-1 iterations)
         tpu_sor_inner=16,
+        # headroom levers (VERDICT r4 item 5): deeper dispatch pipelining
+        # and fewer host syncs (the flat capped-solve knob measured
+        # neutral — see params.py tpu_flat_solve); recorded in the
+        # artifact
+        tpu_lookahead=lookahead, tpu_chunk=chunk, tpu_flat_solve=1,
     )
     s = NS2DSolver(param, dtype=jnp.float32)
     # compile OUTSIDE the timed window (refconfig precedent: the C side's
@@ -304,6 +309,9 @@ def run4096(te: float = 0.15) -> dict:
     mean_it = sum(iters) / len(iters)
 
     step_ms = wall / max(steps, 1) * 1e3
+    # the 8-rank MPI/ICX proxy at this workload: measured ~1.3G
+    # updates/s/core x 8 = 10.56G; ms/step = sites*iters/10.56e9
+    proxy_ms = sites * mean_it / 10.56e9 * 1e3
     rec = {
         "artifact": "northstar_dcavity4096",
         "config": f"dcavity {N}^2 f32, Re=1000, tau=0.5, itermax=100, "
@@ -313,6 +321,9 @@ def run4096(te: float = 0.15) -> dict:
         "steps": steps,
         "wall_s": round(wall, 2),
         "ms_per_step": round(step_ms, 2),
+        "vs_8rank_proxy_x": round(proxy_ms / step_ms, 2),
+        "lookahead": lookahead,
+        "chunk": chunk or "model default (64)",
         "site_steps_per_s": round(sites * steps / wall / 1e9, 3),
         "sampled_sor_iters_per_step": round(mean_it, 1),
         "sampled_dt": dts[-1],
@@ -408,8 +419,25 @@ if __name__ == "__main__":
         out = os.path.join(RESULTS, "northstar_field_match_4096.json")
     elif mode == "run4096":
         te = float(sys.argv[2]) if len(sys.argv) > 2 else 0.15
-        rec = run4096(te)
+        la = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+        ch = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+        rec = run4096(te, la, ch)
         out = os.path.join(RESULTS, "northstar_dcavity4096.json")
+        # the ≥10x bar needs MARGIN across sessions (VERDICT r4 item 5):
+        # keep every prior session's headline in the artifact instead of
+        # overwriting it — and MERGE over the old record so curated
+        # analysis keys (round5_margin_assessment, ...) survive re-runs
+        # (tools/_artifact.write_merged below does the merge)
+        if os.path.exists(out):
+            with open(out) as fh:
+                old = json.load(fh)
+            prev = old.pop("previous_sessions", [])
+            prev.append({
+                k: old.get(k)
+                for k in ("wall_s", "ms_per_step", "vs_8rank_proxy_x",
+                          "steps", "te", "site_steps_per_s")
+            })
+            rec["previous_sessions"] = prev
     elif mode == "refconfig":
         rec = refconfig()
         out = os.path.join(RESULTS, "northstar_refconfig.json")
@@ -417,8 +445,6 @@ if __name__ == "__main__":
         raise SystemExit(
             f"unknown mode {mode!r} (match|match4096|run4096|refconfig)"
         )
-    with open(out, "w") as fh:
-        json.dump(rec, fh, indent=2)
-        fh.write("\n")
-    print(json.dumps(rec, indent=2))
-    print(f"wrote {out}")
+    from tools._artifact import write_merged
+
+    write_merged(out, rec)

@@ -123,10 +123,14 @@ def masked_kernel_rate(gj, gi, jl, il, ragged: bool) -> dict:
 
     # adaptive spans: the differential must be >= ~0.5 s or it sits inside
     # the tunnel's latency jitter (measurement pitfall; a 30 ms
-    # differential once read 11.8G for a 21.0G kernel)
+    # differential once read 11.8G for a 21.0G kernel). Calibrate the
+    # per-call cost LATENCY-FREE (two-point on the calibration itself —
+    # ta/ka would fold the fixed dispatch+readback latency into the
+    # estimate and undershoot the target exactly when latency is high)
     ka = 40
     ta = timed(ka)
-    kb = ka + max(80, int(0.6 / max(ta / ka, 1e-6)))
+    per = max((timed(2 * ka) - ta) / ka, 1e-6)
+    kb = ka + max(80, int(0.6 / per))
     tb = timed(kb)
     iters = (kb - ka) * N_INNER
     ups = jl * il * iters / max(tb - ta, 1e-9)
@@ -180,9 +184,11 @@ def jnp_ca_ragged_rate(gj, gi, jl, il) -> dict:
             best = min(best, time.perf_counter() - t0)
         return best
 
+    # latency-free span calibration — see masked_kernel_rate
     ka = 40
     ta = timed(ka)
-    kb = ka + max(80, int(0.6 / max(ta / ka, 1e-6)))  # >= ~0.5 s differential
+    per = max((timed(2 * ka) - ta) / ka, 1e-6)
+    kb = ka + max(80, int(0.6 / per))
     tb = timed(kb)
     ups = jl * il * (kb - ka) * n / max(tb - ta, 1e-9)
     return {"updates_per_sec": round(ups / 1e9, 2), "unit": "G",
