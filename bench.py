@@ -1,8 +1,19 @@
 """Headline benchmark: lattice-site updates/sec/chip, Poisson 4096² red-black
 SOR (the BASELINE.json metric).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "updates/s", "vs_baseline": N}
+Prints TWO JSON lines:
+  {"metric": "lattice_site_updates_per_sec_per_chip_poisson4096_rbsor", ...}
+  {"metric": "ns2d_dcavity4096_ms_per_step", "value": ms, "solve_ms": ...,
+   "nonsolve_ms": ..., "phases": <dispatch>, ...}
+
+The second line is the metric the fused step-phase kernels move (round 6):
+the NS-2D north-star step time WITH its solve/non-solve decomposition, so
+BENCH_*.json tracks the launch-overhead share directly — the round-5
+artifact showed the Poisson kernel already at the vector-issue wall while
+the non-solve phase chain (6.4 ms/step measured vs ~0.8 ms HBM-bound) was
+the swing term the headline number could not see. Off-TPU the NS line runs
+a 256² scaled-down twin of the same config (jnp phases, rate ~3 orders
+lower — trend data only, like the Poisson line's off-TPU mode).
 
 Method: 4096² grid, float32 (TPU-native), 9600 timed red-black iterations in
 ONE dispatch (fixed count via fori_loop — steady-state throughput, no
@@ -118,6 +129,73 @@ def _run_with_retry(backend: str):
         raise
 
 
+def _ns2d_step_line():
+    """NS-2D dcavity step time + solve/non-solve decomposition (the
+    north-star config at 4096² on TPU, a 256² twin off-TPU). The solve
+    share is measured by timing the step's OWN solve closure on the first
+    step's rhs; non-solve = step - solve, i.e. the phase chain the fused
+    kernels replace."""
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.utils import dispatch
+    from pampi_tpu.utils.params import Parameter as _P
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = 4096 if on_tpu else 256
+    steps = 128 if on_tpu else 8
+    reps = 6 if on_tpu else 3
+    param = _P(
+        name="dcavity", imax=n, jmax=n, re=1000.0, te=1e9, tau=0.5,
+        itermax=100, eps=1e-3, omg=1.7, gamma=0.9, tpu_dtype="float32",
+        tpu_sor_inner=16, tpu_flat_solve=1, tpu_chunk=steps,
+    )
+    s = NS2DSolver(param, dtype=jnp.float32)
+    state = (s.u, s.v, s.p, jnp.asarray(0.0, jnp.float32),
+             jnp.asarray(0, jnp.int32))
+    out = s._chunk_fn(*state)
+    float(out[3])  # compile + warm-up; scalar readback is the fence
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = s._chunk_fn(*state)
+        float(out[3])
+        best = min(best, time.perf_counter() - t0)
+    step_ms = best / steps * 1e3
+
+    if not on_tpu:
+        # the decomposition is TPU-only: off-TPU the standalone jitted
+        # solve compiles SLOWER than the same solve fused into the chunk
+        # program (measured 91-120 vs 80 ms/step at 256² — XLA:CPU
+        # whole-program optimization), so step - solve would go negative;
+        # on TPU both are the same pallas kernel and the subtraction is
+        # meaningful
+        return {
+            "metric": f"ns2d_dcavity{n}_ms_per_step",
+            "value": round(step_ms, 3),
+            "unit": "ms/step",
+            "solve_ms": None,
+            "nonsolve_ms": None,
+            "decomposition_note": "TPU-only (see bench.py)",
+            "phases": dispatch.last("ns2d_phases"),
+            "steps_timed": steps,
+            "config": f"dcavity {n}^2 f32 Re=1000 itermax=100 n_inner=16 flat",
+        }
+
+    # solve-only: the step's own solve closure on the first step's rhs —
+    # the shared protocol (NS2DSolver.time_solve_ms, also what
+    # tools/northstar.py records), no hand-copied phase wiring
+    solve_ms = s.time_solve_ms(reps=reps)
+    return {
+        "metric": f"ns2d_dcavity{n}_ms_per_step",
+        "value": round(step_ms, 3),
+        "unit": "ms/step",
+        "solve_ms": round(solve_ms, 3),
+        "nonsolve_ms": round(step_ms - solve_ms, 3),
+        "phases": dispatch.last("ns2d_phases"),
+        "steps_timed": steps,
+        "config": f"dcavity {n}^2 f32 Re=1000 itermax=100 n_inner=16 flat",
+    }
+
+
 def main() -> None:
     xlacache.enable()
     backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
@@ -138,8 +216,14 @@ def main() -> None:
                 "vs_baseline": ups / BASELINE_8RANK_UPDATES_PER_S,
                 "backend": backend,
             }
-        )
+        ),
+        flush=True,
     )
+    try:
+        print(json.dumps(_ns2d_step_line()), flush=True)
+    except Exception as exc:  # the NS line must not sink the headline
+        print(f"ns2d step line failed ({type(exc).__name__}: {exc})",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

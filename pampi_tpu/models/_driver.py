@@ -103,7 +103,12 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
 def pallas_retry(solver, what: str):
     """The retry() hook for a solver with `_backend`/`_uses_pallas`/
     `_build_chunk`/`_chunk_fn`: falls back to the jnp chunk exactly once; a
-    failure on the jnp path (or with pallas not even in play) re-raises."""
+    failure on the jnp path (or with pallas not even in play) re-raises.
+    Covers the FUSED step-phase chunk too: `_uses_pallas` reports the fused
+    kernels, and `_build_chunk(backend="jnp")` both selects the jnp solve
+    AND stands the fused phases down (resolve_fuse_phases' backend
+    contract), so one retry recovers from a failure in either kernel
+    family."""
 
     def retry():
         if solver._backend == "jnp" or not solver._uses_pallas():

@@ -174,11 +174,15 @@ class RingDMVM:
                 xb = xb * (1.0 + 0.0 * y[0])
                 return lax.fori_loop(0, R, rot_body, (y, xb))
 
-            y0 = lax.pcast(jnp.zeros((Nl,), dtype), ("r",), to="varying")
+            y0 = jnp.zeros((Nl,), dtype)
+            if hasattr(lax, "pcast"):  # newer jax: mark the accumulator
+                y0 = lax.pcast(y0, ("r",), to="varying")  # mesh-varying
             y, _ = lax.fori_loop(0, iters, iter_body, (y0, x_blk))
             return y
 
-        return jax.shard_map(
+        from ..parallel.comm import compat_shard_map
+
+        return compat_shard_map(
             kernel,
             mesh=self.mesh,
             in_specs=(P("r", None), P("r"), None),

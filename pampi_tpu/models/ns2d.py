@@ -115,6 +115,7 @@ class NS2DSolver:
         self.t = 0.0
         self.nt = 0
         self._backend = "auto"
+        self._fused = False  # set by _build_chunk (fused-phase dispatch)
         # flag-field obstacles (ops/obstacle.py): static geometry -> static
         # masks baked into the traced step as constants (branch-free)
         if param.obstacles.strip():
@@ -137,25 +138,25 @@ class NS2DSolver:
         self._chunk_fn = jax.jit(self._build_chunk())
 
     def _uses_pallas(self) -> bool:
-        """Whether the current chunk's pressure solve dispatches to pallas
-        (the uniform solver, the flag-masked solver, and mg's fine-level
-        smoother all go through the same backend probe; jnp-dispatched
-        dtypes/backends never do; fft and the always-jnp sor_lex oracle
-        contain no pallas kernel at all)."""
+        """Whether the current chunk contains ANY pallas kernel — the
+        pressure solve's (the uniform solver, the flag-masked solver, and
+        mg's fine-level smoother all go through the same backend probe;
+        jnp-dispatched dtypes/backends never do; fft and the always-jnp
+        sor_lex oracle contain no solve kernel) or the fused step-phase
+        pair, so the runtime retry protocol (models/_driver.pallas_retry)
+        covers the fused chunk too."""
+        if self._fused:
+            return True
         if self.param.tpu_solver in ("fft", "sor_lex"):
             return False
         from .poisson import _use_pallas
 
         return _use_pallas(self._backend, self.dtype)
 
-    # -- one full timestep, traced ------------------------------------
-    def _build_step(self, backend: str = "auto", instrumented: bool = False):
-        """One traced timestep. instrumented=True returns the SAME pipeline
-        with the pressure solve's discarded outputs exposed —
-        (u, v, p, t, nt, res, it, dt) — so measurement tools
-        (tools/northstar.py, tools/perf_obstacle_mg.py) can sample solver
-        iteration counts without hand-copying the step wiring (which would
-        silently diverge when this pipeline changes)."""
+    def _make_solve(self, backend: str):
+        """The pressure-solve closure for one backend — shared by the jnp
+        step chain and the fused-phase chunk (the fused kernels replace the
+        non-solve phases only; the solve dispatch is unchanged)."""
         param = self.param
         dx, dy = self.dx, self.dy
         dtype = self.dtype
@@ -196,10 +197,25 @@ class NS2DSolver:
                 masks, dtype, backend=backend,
                 n_inner=param.tpu_sor_inner,
             )
+        return solve
+
+    # -- one full timestep, traced ------------------------------------
+    def _build_presolve(self):
+        """The pre-solve phase chain (dt → wall BCs → special BC → obstacle
+        BC → F/G predictor → obstacle F/G mask → Poisson rhs) as a
+        standalone traced function (u, v) -> (u, v, f, g, rhs, dt).
+        _build_step composes it with the solve/projection phases; the
+        solve/non-solve decomposition tools (bench.py, tools/northstar.py)
+        call it to derive a representative rhs for timing the step's own
+        solve closure — one wiring, no hand-copies to drift."""
+        param = self.param
+        dx, dy = self.dx, self.dy
+        dtype = self.dtype
+        masks = self.masks
         adaptive = param.tau > 0.0
         problem = param.name
 
-        def step(u, v, p, t, nt):
+        def presolve(u, v):
             if adaptive:
                 dt = ops.compute_timestep(u, v, self.dt_bound, dx, dy, param.tau)
             else:
@@ -224,6 +240,48 @@ class NS2DSolver:
             if masks is not None:
                 f, g = mask_fg(f, g, u, v, masks)
             rhs = ops.compute_rhs(f, g, dt, dx, dy)
+            return u, v, f, g, rhs, dt
+
+        return presolve
+
+    def time_solve_ms(self, reps: int = 6) -> float:
+        """Best-of-`reps` wall time (ms) of the step's OWN solve closure on
+        the first step's rhs. The solve/non-solve decomposition tools
+        (bench.py, tools/northstar.py) both call this, so BENCH_*.json and
+        the northstar artifact always time the identical protocol: rhs via
+        _build_presolve, jit once, warm with a scalar readback fence,
+        best-of-reps perf_counter."""
+        import time
+
+        solve = jax.jit(self._make_solve(self._backend))
+        *_, rhs, _dt = jax.jit(self._build_presolve())(self.u, self.v)
+        _p, res, _it = solve(self.p, rhs)
+        float(res)  # compile + warm-up; scalar readback is the fence
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _p, res, _it = solve(self.p, rhs)
+            float(res)
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    def _build_step(self, backend: str = "auto", instrumented: bool = False):
+        """One traced timestep (the jnp phase chain — the parity oracle and
+        CPU path; _build_fused_chunk is the TPU production composition).
+        instrumented=True returns the SAME pipeline with the pressure
+        solve's discarded outputs exposed — (u, v, p, t, nt, res, it, dt) —
+        so measurement tools (tools/northstar.py, tools/perf_obstacle_mg.py)
+        can sample solver iteration counts without hand-copying the step
+        wiring (which would silently diverge when this pipeline changes)."""
+        param = self.param
+        dx, dy = self.dx, self.dy
+        dtype = self.dtype
+        masks = self.masks
+        solve = self._make_solve(backend)
+        presolve = self._build_presolve()
+
+        def step(u, v, p, t, nt):
+            u, v, f, g, rhs, dt = presolve(u, v)
             if masks is None:
                 p = lax.cond(nt % 100 == 0, ops.normalize_pressure, lambda q: q, p)
             else:
@@ -256,7 +314,93 @@ class NS2DSolver:
 
         return step
 
+    def _build_fused_chunk(self, backend: str):
+        """The fused-phase chunk: the non-solve step phases run as the two
+        Pallas kernels of ops/ns2d_fused.py (BCs+FG+RHS before the solve,
+        adaptUV+CFL-max after), the loop carries u/v in the kernels' padded
+        layout plus the running (umax, vmax) scalars, and the timestep is
+        pure scalar math (ops/ns2d.cfl_dt). Returns None when the fused
+        path is not dispatched (knob off, jnp backend, no TPU, probe/VMEM
+        failure) — the caller falls back to the jnp chunk."""
+        from ..ops.ns2d_fused import probe_fused_2d
+        from ..utils.dispatch import record, resolve_fuse_phases
+
+        param = self.param
+        if not resolve_fuse_phases(
+            param, backend, self.dtype, probe_fused_2d, "ns2d_phases",
+        ):
+            return None
+        from ..ops import ns2d_fused as nf
+
+        dx, dy = self.dx, self.dy
+        dtype = self.dtype
+        masks = self.masks
+        try:
+            pre, post, pad, unpad, _h = nf.make_fused_step_2d(
+                param, param.jmax, param.imax, dx, dy, dtype,
+                fluid=None if masks is None else masks.fluid,
+            )
+        except ValueError as exc:  # VMEM-infeasible geometry
+            record("ns2d_phases", f"jnp ({exc})")
+            return None
+        solve = self._make_solve(backend)
+        adaptive = param.tau > 0.0
+        te = param.te
+        chunk = param.tpu_chunk or self.CHUNK
+        offs = jnp.zeros((2,), jnp.int32)
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        if masks is not None:
+            from ..ops.obstacle import normalize_pressure_fluid
+
+            def normalize(q):
+                return normalize_pressure_fluid(q, masks)
+        else:
+            normalize = ops.normalize_pressure
+
+        def step(up, vp, p, t, nt, umax, vmax):
+            if adaptive:
+                dt = ops.cfl_dt(umax, vmax, self.dt_bound, dx, dy, param.tau)
+            else:
+                dt = jnp.asarray(param.dt, dtype)
+            dt11 = jnp.full((1, 1), dt, dtype)
+            up, vp, fp, gp, rhsp = pre(offs, dt11, up, vp)
+            rhs = unpad(rhsp)
+            p = lax.cond(nt % 100 == 0, normalize, lambda q: q, p)
+            p, _res, _it = solve(p, rhs)
+            up, vp, umax, vmax = post(offs, dt11, up, vp, fp, gp, pad(p))
+            t_next = t + dt.astype(time_dtype)
+            if _flags.verbose():
+                jax.debug.print("TIME {} , TIMESTEP {}", t_next, dt)
+            return up, vp, p, t_next, nt + 1, umax, vmax
+
+        def chunk_fn(u, v, p, t, nt):
+            up, vp = pad(u), pad(v)
+            umax = jnp.max(jnp.abs(u))
+            vmax = jnp.max(jnp.abs(v))
+
+            def cond(c):
+                return jnp.logical_and(c[3] <= te, c[7] < chunk)
+
+            def body(c):
+                up, vp, p, t, nt, umax, vmax, k = c
+                up, vp, p, t, nt, umax, vmax = step(
+                    up, vp, p, t, nt, umax, vmax
+                )
+                return up, vp, p, t, nt, umax, vmax, k + 1
+
+            up, vp, p, t, nt, _um, _vm, _k = lax.while_loop(
+                cond, body,
+                (up, vp, p, t, nt, umax, vmax, jnp.asarray(0, jnp.int32)),
+            )
+            return unpad(up), unpad(vp), p, t, nt
+
+        return chunk_fn
+
     def _build_chunk(self, backend: str = "auto"):
+        fused = self._build_fused_chunk(backend)
+        self._fused = fused is not None
+        if fused is not None:
+            return fused
         step = self._build_step(backend)
         te = self.param.te
         chunk = self.param.tpu_chunk or self.CHUNK

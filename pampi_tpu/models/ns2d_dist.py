@@ -437,6 +437,46 @@ class NS2DDistSolver:
         else:
             solve = _solve_sor
 
+        # -- fused step-phase kernels (ops/ns2d_fused.py): the per-shard
+        # non-solve phases (BCs + special BC + FG + fixups + RHS, then
+        # adaptUV) collapse into two global-coordinate-gated Pallas kernels
+        # around the solve — PRE on the depth-H deep-halo block (one
+        # exchange buys the whole validity chain, the CA discipline), POST
+        # on the plain extended block (adaptUV reads only center/+1).
+        # dt stays the jnp reduction (the deep-exchanged block contains the
+        # same global value set, so the ghost-inclusive max is unchanged).
+        # Ragged and obstacle decompositions keep the jnp chain (recorded).
+        from ..ops.ns2d_fused import FUSE_DEEP_HALO, probe_fused_2d
+
+        fuse_why_not = None
+        if self.ragged:
+            fuse_why_not = "ragged decomposition (fused kernels pending)"
+        elif self.masks is not None:
+            fuse_why_not = "dist obstacle flags (fused kernels pending)"
+        elif min(jl, il) < FUSE_DEEP_HALO:
+            fuse_why_not = f"shard extents < deep halo {FUSE_DEEP_HALO}"
+        fused_k = None
+        if _dispatch.resolve_fuse_phases(
+            param, "auto", dtype, probe_fused_2d, "ns2d_dist_phases",
+            why_not=fuse_why_not,
+        ):
+            from ..ops import ns2d_fused as nf
+
+            try:
+                pre_k, pad_deep, unpad_deep, _hk = nf.make_fused_pre_2d(
+                    param, self.jmax, self.imax, dx, dy, dtype,
+                    jl=jl, il=il, ext_pad=FUSE_DEEP_HALO - 1,
+                    prof_dtype=idx_dtype,
+                )
+                post_k, pad_ext, unpad_ext, _hk2 = nf.make_fused_post_2d(
+                    param, self.jmax, self.imax, dx, dy, dtype,
+                    jl=jl, il=il,
+                )
+                fused_k = (pre_k, post_k)
+                pallas_q = True
+            except ValueError as exc:  # VMEM-infeasible shard geometry
+                _dispatch.record("ns2d_dist_phases", f"jnp ({exc})")
+
         # -- weighted mean for normalizePressure ------------------------
         def wall_weight():
             if self.ragged:
@@ -577,6 +617,45 @@ class NS2DDistSolver:
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
             return u, v, p, t_next, nt + 1
 
+        def step_fused(u, v, p, t, nt):
+            """The fused-phase twin of step(): one deep exchange feeds the
+            PRE kernel (BCs+FG+RHS per shard, redundant halo recompute
+            bitwise-consistent across shards), the solve is unchanged, the
+            POST kernel projects on the exchanged extended blocks."""
+            pre_k, post_k = fused_k
+            H = FUSE_DEEP_HALO
+            ud = halo_exchange(embed_deep(u, H), comm, depth=H)
+            vd = halo_exchange(embed_deep(v, H), comm, depth=H)
+            # ghost-inclusive CFL max: the deep block carries the same
+            # global value set (owned + fresh neighbour copies + wall
+            # ghosts + dead zeros), so the max reduction is unchanged
+            dt = compute_dt(ud, vd) if adaptive else jnp.asarray(param.dt, dtype)
+            joff = get_offsets("j", jl)
+            ioff = get_offsets("i", il)
+            offs = jnp.stack([joff, ioff]).astype(jnp.int32)
+            dt11 = jnp.full((1, 1), dt, dtype)
+            upd, vpd, fpd, gpd, rpd = pre_k(
+                offs, dt11, pad_deep(ud), pad_deep(vd)
+            )
+            u = strip_deep(unpad_deep(upd), H)
+            v = strip_deep(unpad_deep(vpd), H)
+            f = strip_deep(unpad_deep(fpd), H)
+            g = strip_deep(unpad_deep(gpd), H)
+            rhs = strip_deep(unpad_deep(rpd), H)
+            p = lax.cond(nt % 100 == 0, normalize_pressure, lambda q: q, p)
+            p, _res, _it = solve(p, rhs)
+            up, vp, _um, _vm = post_k(
+                offs, dt11, pad_ext(u), pad_ext(v), pad_ext(f), pad_ext(g),
+                pad_ext(p),
+            )
+            u = unpad_ext(up)
+            v = unpad_ext(vp)
+            t_next = t + dt.astype(idx_dtype)
+            if _flags.verbose():
+                master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            return u, v, p, t_next, nt + 1
+
+        step_impl = step if fused_k is None else step_fused
         te = param.te
         chunk = self.CHUNK
 
@@ -587,7 +666,7 @@ class NS2DDistSolver:
 
             def body(c):
                 u, v, p, t, nt, k = c
-                u, v, p, t, nt = step(u, v, p, t, nt)
+                u, v, p, t, nt = step_impl(u, v, p, t, nt)
                 return u, v, p, t, nt, k + 1
 
             u, v, p, t, nt, _ = lax.while_loop(

@@ -251,6 +251,7 @@ class NS3DSolver:
         self.t = 0.0
         self.nt = 0
         self._backend = "auto"
+        self._fused = False  # set by _build_chunk (fused-phase dispatch)
         # flag-field obstacles (ops/obstacle3d.py): static geometry -> static
         # masks baked into the traced step as constants (branch-free)
         if param.obstacles.strip():
@@ -273,13 +274,17 @@ class NS3DSolver:
         self._chunk_fn = jax.jit(self._build_chunk())
 
     def _uses_pallas(self) -> bool:
+        if self._fused:
+            return True  # the fused step-phase pair is a pallas kernel
         if self.param.tpu_solver == "fft":
             return False  # fft chunks contain no pallas kernel
         # sor AND mg go through the probe: mg's fine-level smoother
         # dispatches the 3-D tblock kernel on large levels (round 4)
         return _use_pallas_3d(self._backend, self.dtype)
 
-    def _build_step(self, backend: str = "auto"):
+    def _make_solve(self, backend: str):
+        """The 3-D pressure-solve closure for one backend — shared by the
+        jnp step chain and the fused-phase chunk."""
         param = self.param
         g = self.grid
         dtype = self.dtype
@@ -312,6 +317,15 @@ class NS3DSolver:
                 layout=param.tpu_sor_layout,
                 stall_rtol=param.tpu_mg_stall_rtol,
             )
+        return solve
+
+    def _build_step(self, backend: str = "auto"):
+        param = self.param
+        g = self.grid
+        dtype = self.dtype
+        dx, dy, dz = g.dx, g.dy, g.dz
+        masks = self.masks
+        solve = self._make_solve(backend)
         bcs = {
             "top": param.bcTop,
             "bottom": param.bcBottom,
@@ -366,7 +380,94 @@ class NS3DSolver:
 
         return step
 
+    def _build_fused_chunk(self, backend: str):
+        """The 3-D fused-phase chunk (ops/ns3d_fused.py): the non-solve
+        phases run as two Pallas kernels around the solve, the loop carries
+        u/v/w in the padded layout plus the running (umax, vmax, wmax),
+        and the timestep is scalar math (ops/ns3d.cfl_dt_3d). None when the
+        fused path is not dispatched — the caller falls back to the jnp
+        chunk. 3-D obstacle flag fields keep the jnp chain."""
+        from ..ops.ns3d_fused import probe_fused_3d
+        from ..utils.dispatch import record, resolve_fuse_phases
+
+        param = self.param
+        why_not = (
+            "3-D obstacle flags (fused kernels are 2-D-only for flags)"
+            if self.masks is not None else None
+        )
+        if not resolve_fuse_phases(
+            param, backend, self.dtype, probe_fused_3d, "ns3d_phases",
+            why_not=why_not,
+        ):
+            return None
+        from ..ops import ns3d_fused as nf3
+
+        g = self.grid
+        dtype = self.dtype
+        dx, dy, dz = g.dx, g.dy, g.dz
+        try:
+            pre, post, pad3, unpad3, _h = nf3.make_fused_step_3d(
+                param, g.kmax, g.jmax, g.imax, dx, dy, dz, dtype,
+            )
+        except ValueError as exc:  # VMEM-infeasible geometry
+            record("ns3d_phases", f"jnp ({exc})")
+            return None
+        solve = self._make_solve(backend)
+        adaptive = param.tau > 0.0
+        te = param.te
+        chunk = param.tpu_chunk or self.CHUNK
+        offs = jnp.zeros((3,), jnp.int32)
+        dt_bound = jnp.asarray(self.dt_bound, dtype)
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+        def step(up, vp, wp, p, t, nt, umax, vmax, wmax):
+            if adaptive:
+                dt = ops.cfl_dt_3d(umax, vmax, wmax, dt_bound, dx, dy, dz,
+                                   param.tau)
+            else:
+                dt = jnp.asarray(param.dt, dtype)
+            dt11 = jnp.full((1, 1), dt, dtype)
+            up, vp, wp, fp, gp, hp, rhsp = pre(offs, dt11, up, vp, wp)
+            rhs = unpad3(rhsp)
+            p, _res, _it = solve(p, rhs)
+            up, vp, wp, umax, vmax, wmax = post(
+                offs, dt11, up, vp, wp, fp, gp, hp, pad3(p)
+            )
+            t_next = t + dt.astype(time_dtype)
+            if _flags.verbose():
+                jax.debug.print("TIME {} , TIMESTEP {}", t_next, dt)
+            return up, vp, wp, p, t_next, nt + 1, umax, vmax, wmax
+
+        def chunk_fn(u, v, w, p, t, nt):
+            up, vp, wp = pad3(u), pad3(v), pad3(w)
+            umax = jnp.max(jnp.abs(u))
+            vmax = jnp.max(jnp.abs(v))
+            wmax = jnp.max(jnp.abs(w))
+
+            def cond(c):
+                return jnp.logical_and(c[4] <= te, c[9] < chunk)
+
+            def body(c):
+                up, vp, wp, p, t, nt, um, vm, wm, k = c
+                up, vp, wp, p, t, nt, um, vm, wm = step(
+                    up, vp, wp, p, t, nt, um, vm, wm
+                )
+                return up, vp, wp, p, t, nt, um, vm, wm, k + 1
+
+            up, vp, wp, p, t, nt, _um, _vm, _wm, _k = lax.while_loop(
+                cond, body,
+                (up, vp, wp, p, t, nt, umax, vmax, wmax,
+                 jnp.asarray(0, jnp.int32)),
+            )
+            return unpad3(up), unpad3(vp), unpad3(wp), p, t, nt
+
+        return chunk_fn
+
     def _build_chunk(self, backend: str = "auto"):
+        fused = self._build_fused_chunk(backend)
+        self._fused = fused is not None
+        if fused is not None:
+            return fused
         step = self._build_step(backend)
         te = self.param.te
         chunk = self.param.tpu_chunk or self.CHUNK

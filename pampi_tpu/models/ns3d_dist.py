@@ -383,6 +383,42 @@ class NS3DDistSolver:
         else:
             solve = _solve_sor
 
+        # -- fused step-phase kernels (ops/ns3d_fused.py): the per-shard
+        # non-solve phases collapse into two global-coordinate-gated Pallas
+        # kernels around the solve (PRE on the depth-H deep-halo block, POST
+        # on the plain extended block) — the 3-D twin of the NS-2D wiring
+        # (models/ns2d_dist.py). Ragged and obstacle runs keep the jnp chain.
+        from ..ops.ns3d_fused import FUSE_DEEP_HALO, probe_fused_3d
+
+        fuse_why_not = None
+        if self.ragged:
+            fuse_why_not = "ragged decomposition (fused kernels pending)"
+        elif self.masks is not None:
+            fuse_why_not = "dist obstacle flags (fused kernels pending)"
+        elif min(kl, jl, il) < FUSE_DEEP_HALO:
+            fuse_why_not = f"shard extents < deep halo {FUSE_DEEP_HALO}"
+        fused_k = None
+        if _dispatch.resolve_fuse_phases(
+            param, "auto", dtype, probe_fused_3d, "ns3d_dist_phases",
+            why_not=fuse_why_not,
+        ):
+            from ..ops import ns3d_fused as nf3
+
+            try:
+                pre_k, pad_deep, unpad_deep, _hk = nf3.make_fused_pre_3d(
+                    param, g.kmax, g.jmax, g.imax, dx, dy, dz, dtype,
+                    kl=kl, jl=jl, il=il, ext_pad=FUSE_DEEP_HALO - 1,
+                )
+                post_k, pad_ext, unpad_ext, _hk2 = nf3.make_fused_post_3d(
+                    param, g.kmax, g.jmax, g.imax, dx, dy, dz, dtype,
+                    kl=kl, jl=jl, il=il,
+                )
+                fused_k = (pre_k, post_k)
+                pallas_o = True
+                self._pallas_o = True
+            except ValueError as exc:  # VMEM-infeasible shard geometry
+                _dispatch.record("ns3d_dist_phases", f"jnp ({exc})")
+
         gmasks = self.masks
         if gmasks is not None:
             from ..ops.obstacle3d import (
@@ -490,6 +526,50 @@ class NS3DDistSolver:
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
             return u, v, w, p, t_next, nt + 1
 
+        def step_fused(u, v, w, p, t, nt):
+            """The fused-phase twin of step() (see models/ns2d_dist.py):
+            one deep exchange feeds the PRE kernel, the solve is unchanged,
+            the POST kernel projects on the exchanged extended blocks."""
+            from ..parallel.comm import get_offsets
+
+            pre_k, post_k = fused_k
+            H = FUSE_DEEP_HALO
+            ud = halo_exchange(embed_deep(u, H), comm, depth=H)
+            vd = halo_exchange(embed_deep(v, H), comm, depth=H)
+            wd = halo_exchange(embed_deep(w, H), comm, depth=H)
+            # ghost-inclusive CFL max over the deep blocks: same global
+            # value set as the exchanged extended blocks
+            dt = (compute_dt(ud, vd, wd) if adaptive
+                  else jnp.asarray(param.dt, dtype))
+            offs = jnp.stack([
+                get_offsets("k", kl), get_offsets("j", jl),
+                get_offsets("i", il),
+            ]).astype(jnp.int32)
+            dt11 = jnp.full((1, 1), dt, dtype)
+            upd, vpd, wpd, fpd, gpd, hpd, rpd = pre_k(
+                offs, dt11, pad_deep(ud), pad_deep(vd), pad_deep(wd)
+            )
+            u = strip_deep(unpad_deep(upd), H)
+            v = strip_deep(unpad_deep(vpd), H)
+            w = strip_deep(unpad_deep(wpd), H)
+            f = strip_deep(unpad_deep(fpd), H)
+            g_ = strip_deep(unpad_deep(gpd), H)
+            h = strip_deep(unpad_deep(hpd), H)
+            rhs = strip_deep(unpad_deep(rpd), H)
+            p, _res, _it = solve(p, rhs)
+            up, vp, wp, _um, _vm, _wm = post_k(
+                offs, dt11, pad_ext(u), pad_ext(v), pad_ext(w),
+                pad_ext(f), pad_ext(g_), pad_ext(h), pad_ext(p),
+            )
+            u = unpad_ext(up)
+            v = unpad_ext(vp)
+            w = unpad_ext(wp)
+            t_next = t + dt.astype(idx_dtype)
+            if _flags.verbose():
+                master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            return u, v, w, p, t_next, nt + 1
+
+        step_impl = step if fused_k is None else step_fused
         te = param.te
         chunk = self.CHUNK
 
@@ -499,7 +579,7 @@ class NS3DDistSolver:
 
             def body(c):
                 u, v, w, p, t, nt, k = c
-                u, v, w, p, t, nt = step(u, v, w, p, t, nt)
+                u, v, w, p, t, nt = step_impl(u, v, w, p, t, nt)
                 return u, v, w, p, t, nt, k + 1
 
             u, v, w, p, t, nt, _ = lax.while_loop(

@@ -42,30 +42,29 @@ def _interior_mask(shape):
     return j & i
 
 
-def compute_fg_interior(u, v, dt, re, gx, gy, gamma, dx, dy):
-    """Momentum predictor interior only (computeFG, solver.c:360-423): central
-    + γ-blended donor-cell convective fluxes, viscous Laplacian, body force.
-    Distributed callers gate the wall fixups to wall-owning shards (an ungated
-    local fixup would clobber F/G at interior shard edges).
-
-    Full-array formulation: every neighbour is a roll of the whole array
-    (wrap values land outside the interior mask), so each output is ONE
-    fused elementwise pass — no interior DUS (see _interior_mask)."""
+def fg_predictor_terms(u, v, dt, re, gx, gy, gamma, dx, dy, roll=jnp.roll):
+    """Full-array F/G predictor arithmetic (no masking) — the SINGLE home of
+    the ~30-term donor-cell formula, shared by the jnp path
+    (`compute_fg_interior`) and the fused Pallas step-phase kernel
+    (ops/ns2d_fused.py). `roll` abstracts the neighbour gather: jnp.roll on
+    the whole array here, jnp.roll on the VMEM window in-kernel — identical
+    op sequence, so values agree BITWISE at every cell whose neighbours are
+    real (wrap/window-edge cells are masked out by both callers)."""
     idx, idy = 1.0 / dx, 1.0 / dy
     inv_re = 1.0 / re
 
     uc = u
-    ue = jnp.roll(u, -1, axis=1)
-    uw = jnp.roll(u, 1, axis=1)
-    un = jnp.roll(u, -1, axis=0)
-    us = jnp.roll(u, 1, axis=0)
-    unw = jnp.roll(u, (-1, 1), axis=(0, 1))
+    ue = roll(u, -1, axis=1)
+    uw = roll(u, 1, axis=1)
+    un = roll(u, -1, axis=0)
+    us = roll(u, 1, axis=0)
+    unw = roll(roll(u, -1, axis=0), 1, axis=1)
     vc = v
-    ve = jnp.roll(v, -1, axis=1)
-    vw = jnp.roll(v, 1, axis=1)
-    vn = jnp.roll(v, -1, axis=0)
-    vs = jnp.roll(v, 1, axis=0)
-    vse = jnp.roll(v, (1, -1), axis=(0, 1))
+    ve = roll(v, -1, axis=1)
+    vw = roll(v, 1, axis=1)
+    vn = roll(v, -1, axis=0)
+    vs = roll(v, 1, axis=0)
+    vse = roll(roll(v, 1, axis=0), -1, axis=1)
 
     du2dx = idx * 0.25 * (
         (uc + ue) * (uc + ue) - (uc + uw) * (uc + uw)
@@ -92,7 +91,20 @@ def compute_fg_interior(u, v, dt, re, gx, gy, gamma, dx, dy):
     )
     lap_v = idx * idx * (ve - 2.0 * vc + vw) + idy * idy * (vn - 2.0 * vc + vs)
     g_int = vc + dt * (inv_re * lap_v - duvdx - dv2dy + gy)
+    return f_int, g_int
 
+
+def compute_fg_interior(u, v, dt, re, gx, gy, gamma, dx, dy):
+    """Momentum predictor interior only (computeFG, solver.c:360-423): central
+    + γ-blended donor-cell convective fluxes, viscous Laplacian, body force.
+    Distributed callers gate the wall fixups to wall-owning shards (an ungated
+    local fixup would clobber F/G at interior shard edges).
+
+    Full-array formulation: every neighbour is a roll of the whole array
+    (wrap values land outside the interior mask), so each output is ONE
+    fused elementwise pass — no interior DUS (see _interior_mask). The
+    arithmetic lives in `fg_predictor_terms` (shared with the fused kernel)."""
+    f_int, g_int = fg_predictor_terms(u, v, dt, re, gx, gy, gamma, dx, dy)
     m = _interior_mask(u.shape)
     f = jnp.where(m, f_int, 0.0)
     g = jnp.where(m, g_int, 0.0)
@@ -109,14 +121,28 @@ def apply_fg_wall_fixups(f, g, u, v):
     return f, g
 
 
+def rhs_terms(f, g, dt, dx, dy, roll=jnp.roll):
+    """Full-array RHS = div(F,G)/dt arithmetic (shared with the fused
+    kernel, see fg_predictor_terms for the `roll` contract)."""
+    return (1.0 / dt) * (
+        (f - roll(f, 1, axis=1)) / dx + (g - roll(g, 1, axis=0)) / dy
+    )
+
+
 def compute_rhs(f, g, dt, dx, dy):
     """Pressure-Poisson RHS = div(F,G)/dt (computeRHS, solver.c:122-138).
     Full-array roll form — one fused pass, no interior DUS
     (_interior_mask)."""
-    rhs_full = (1.0 / dt) * (
-        (f - jnp.roll(f, 1, axis=1)) / dx + (g - jnp.roll(g, 1, axis=0)) / dy
-    )
-    return jnp.where(_interior_mask(f.shape), rhs_full, 0.0)
+    return jnp.where(_interior_mask(f.shape), rhs_terms(f, g, dt, dx, dy), 0.0)
+
+
+def adapt_terms(f, g, p, dt, dx, dy, roll=jnp.roll):
+    """Full-array projection arithmetic (shared with the fused kernel)."""
+    fx = dt / dx
+    fy = dt / dy
+    u_new = f - (roll(p, -1, axis=1) - p) * fx
+    v_new = g - (roll(p, -1, axis=0) - p) * fy
+    return u_new, v_new
 
 
 def adapt_uv(u, v, f, g, p, dt, dx, dy):
@@ -124,11 +150,8 @@ def adapt_uv(u, v, f, g, p, dt, dx, dy):
     Full-array roll form — the interior select fuses into the producer
     (_interior_mask); edge cells keep the incoming u/v exactly as the
     at[].set form did."""
-    fx = dt / dx
-    fy = dt / dy
     m = _interior_mask(u.shape)
-    u_new = f - (jnp.roll(p, -1, axis=1) - p) * fx
-    v_new = g - (jnp.roll(p, -1, axis=0) - p) * fy
+    u_new, v_new = adapt_terms(f, g, p, dt, dx, dy)
     return jnp.where(m, u_new, u), jnp.where(m, v_new, v)
 
 
@@ -200,11 +223,12 @@ def max_element(m):
     return jnp.max(jnp.abs(m))
 
 
-def compute_timestep(u, v, dt_bound, dx, dy, tau):
-    """Adaptive CFL timestep (computeTimestep, solver.c:219-234)."""
-    umax = max_element(u)
-    vmax = max_element(v)
-    inf = jnp.asarray(jnp.inf, u.dtype)
+def cfl_dt(umax, vmax, dt_bound, dx, dy, tau):
+    """CFL scalar math given the velocity maxima — shared by
+    compute_timestep and the fused step path (which carries umax/vmax from
+    the previous step's fused adapt+max kernel; max is exact regardless of
+    reduction order, so the two compositions are bitwise identical)."""
+    inf = jnp.asarray(jnp.inf, umax.dtype)
     dt = jnp.minimum(
         dt_bound,
         jnp.minimum(
@@ -212,6 +236,11 @@ def compute_timestep(u, v, dt_bound, dx, dy, tau):
         ),
     )
     return dt * tau
+
+
+def compute_timestep(u, v, dt_bound, dx, dy, tau):
+    """Adaptive CFL timestep (computeTimestep, solver.c:219-234)."""
+    return cfl_dt(max_element(u), max_element(v), dt_bound, dx, dy, tau)
 
 
 def normalize_pressure(p):

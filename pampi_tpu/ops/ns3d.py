@@ -32,29 +32,36 @@ def V3(a, dk=0, dj=0, di=0):
     return a[1 + dk : K - 1 + dk, 1 + dj : J - 1 + dj, 1 + di : I - 1 + di]
 
 
-def compute_fgh_interior(u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz):
-    """3-D momentum predictor interior (computeFG, solver.c:639-769)."""
+def fgh_predictor_terms(u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz,
+                        sh=V3):
+    """The 3-D momentum-predictor arithmetic (computeFG, solver.c:639-769) —
+    the SINGLE home of the formula, shared by the jnp path
+    (`compute_fgh_interior`, sh=V3 interior views) and the fused Pallas
+    step-phase kernel (ops/ns3d_fused.py, a roll-based window shift).
+    `sh(a, dk=, dj=, di=)` returns the (dk, dj, di)-shifted view of `a`;
+    both accessors deliver the same neighbour VALUES at every cell whose
+    neighbours are real, so outputs agree bitwise there."""
     idx, idy, idz = 1.0 / dx, 1.0 / dy, 1.0 / dz
     inv_re = 1.0 / re
 
-    uc = V3(u)
-    vc = V3(v)
-    wc = V3(w)
-    u_ip, u_im = V3(u, di=1), V3(u, di=-1)
-    u_jp, u_jm = V3(u, dj=1), V3(u, dj=-1)
-    u_kp, u_km = V3(u, dk=1), V3(u, dk=-1)
-    v_ip, v_im = V3(v, di=1), V3(v, di=-1)
-    v_jp, v_jm = V3(v, dj=1), V3(v, dj=-1)
-    v_kp, v_km = V3(v, dk=1), V3(v, dk=-1)
-    w_ip, w_im = V3(w, di=1), V3(w, di=-1)
-    w_jp, w_jm = V3(w, dj=1), V3(w, dj=-1)
-    w_kp, w_km = V3(w, dk=1), V3(w, dk=-1)
-    u_im_jp = V3(u, dj=1, di=-1)
-    u_im_kp = V3(u, dk=1, di=-1)
-    v_jm_ip = V3(v, dj=-1, di=1)
-    v_jm_kp = V3(v, dk=1, dj=-1)
-    w_km_ip = V3(w, dk=-1, di=1)
-    w_km_jp = V3(w, dk=-1, dj=1)
+    uc = sh(u)
+    vc = sh(v)
+    wc = sh(w)
+    u_ip, u_im = sh(u, di=1), sh(u, di=-1)
+    u_jp, u_jm = sh(u, dj=1), sh(u, dj=-1)
+    u_kp, u_km = sh(u, dk=1), sh(u, dk=-1)
+    v_ip, v_im = sh(v, di=1), sh(v, di=-1)
+    v_jp, v_jm = sh(v, dj=1), sh(v, dj=-1)
+    v_kp, v_km = sh(v, dk=1), sh(v, dk=-1)
+    w_ip, w_im = sh(w, di=1), sh(w, di=-1)
+    w_jp, w_jm = sh(w, dj=1), sh(w, dj=-1)
+    w_kp, w_km = sh(w, dk=1), sh(w, dk=-1)
+    u_im_jp = sh(u, dj=1, di=-1)
+    u_im_kp = sh(u, dk=1, di=-1)
+    v_jm_ip = sh(v, dj=-1, di=1)
+    v_jm_kp = sh(v, dk=1, dj=-1)
+    w_km_ip = sh(w, dk=-1, di=1)
+    w_km_jp = sh(w, dk=-1, dj=1)
 
     ab = jnp.abs
 
@@ -127,7 +134,16 @@ def compute_fgh_interior(u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz):
         + idz * idz * (w_kp - 2.0 * wc + w_km)
     )
     h_int = wc + dt * (inv_re * lap_w - duwdx - dvwdy - dw2dz + gz)
+    return f_int, g_int, h_int
 
+
+def compute_fgh_interior(u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz):
+    """3-D momentum predictor interior (computeFG, solver.c:639-769); the
+    arithmetic lives in `fgh_predictor_terms` (shared with the fused
+    kernel)."""
+    f_int, g_int, h_int = fgh_predictor_terms(
+        u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz
+    )
     f = jnp.zeros_like(u).at[1:-1, 1:-1, 1:-1].set(f_int)
     g = jnp.zeros_like(v).at[1:-1, 1:-1, 1:-1].set(g_int)
     h = jnp.zeros_like(w).at[1:-1, 1:-1, 1:-1].set(h_int)
@@ -151,21 +167,36 @@ def compute_fgh(u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz):
     return apply_fgh_wall_fixups(f, g, h, u, v, w)
 
 
+def rhs_terms_3d(f, g, h, dt, dx, dy, dz, sh=V3):
+    """3-D RHS = div(F,G,H)/dt arithmetic (shared with the fused kernel,
+    see fgh_predictor_terms for the `sh` contract)."""
+    return (
+        (sh(f) - sh(f, di=-1)) / dx
+        + (sh(g) - sh(g, dj=-1)) / dy
+        + (sh(h) - sh(h, dk=-1)) / dz
+    ) * (1.0 / dt)
+
+
 def compute_rhs(f, g, h, dt, dx, dy, dz):
     """RHS = div(F,G,H)/dt (computeRHS, solver.c:163-172)."""
-    rhs_int = (
-        (V3(f) - V3(f, di=-1)) / dx
-        + (V3(g) - V3(g, dj=-1)) / dy
-        + (V3(h) - V3(h, dk=-1)) / dz
-    ) * (1.0 / dt)
+    rhs_int = rhs_terms_3d(f, g, h, dt, dx, dy, dz)
     return jnp.zeros_like(f).at[1:-1, 1:-1, 1:-1].set(rhs_int)
+
+
+def adapt_terms_3d(f, g, h, p, dt, dx, dy, dz, sh=V3):
+    """3-D projection arithmetic (shared with the fused kernel)."""
+    u_new = sh(f) - (sh(p, di=1) - sh(p)) * (dt / dx)
+    v_new = sh(g) - (sh(p, dj=1) - sh(p)) * (dt / dy)
+    w_new = sh(h) - (sh(p, dk=1) - sh(p)) * (dt / dz)
+    return u_new, v_new, w_new
 
 
 def adapt_uvw(u, v, w, f, g, h, p, dt, dx, dy, dz):
     """Projection (adaptUV, solver.c:845-852)."""
-    u = u.at[1:-1, 1:-1, 1:-1].set(V3(f) - (V3(p, di=1) - V3(p)) * (dt / dx))
-    v = v.at[1:-1, 1:-1, 1:-1].set(V3(g) - (V3(p, dj=1) - V3(p)) * (dt / dy))
-    w = w.at[1:-1, 1:-1, 1:-1].set(V3(h) - (V3(p, dk=1) - V3(p)) * (dt / dz))
+    u_new, v_new, w_new = adapt_terms_3d(f, g, h, p, dt, dx, dy, dz)
+    u = u.at[1:-1, 1:-1, 1:-1].set(u_new)
+    v = v.at[1:-1, 1:-1, 1:-1].set(v_new)
+    w = w.at[1:-1, 1:-1, 1:-1].set(w_new)
     return u, v, w
 
 
@@ -255,10 +286,10 @@ def max_element(m):
     return jnp.max(jnp.abs(m))
 
 
-def compute_timestep_3d(u, v, w, dt_bound, dx, dy, dz, tau):
-    """3-D CFL (computeTimestep, solver.c:340-362)."""
-    inf = jnp.asarray(jnp.inf, u.dtype)
-    umax, vmax, wmax = max_element(u), max_element(v), max_element(w)
+def cfl_dt_3d(umax, vmax, wmax, dt_bound, dx, dy, dz, tau):
+    """3-D CFL scalar math given the velocity maxima (see ops/ns2d.cfl_dt
+    for the fused-path sharing rationale)."""
+    inf = jnp.asarray(jnp.inf, umax.dtype)
     dt = jnp.minimum(
         dt_bound,
         jnp.minimum(
@@ -270,6 +301,14 @@ def compute_timestep_3d(u, v, w, dt_bound, dx, dy, dz, tau):
         ),
     )
     return dt * tau
+
+
+def compute_timestep_3d(u, v, w, dt_bound, dx, dy, dz, tau):
+    """3-D CFL (computeTimestep, solver.c:340-362)."""
+    return cfl_dt_3d(
+        max_element(u), max_element(v), max_element(w),
+        dt_bound, dx, dy, dz, tau,
+    )
 
 
 def normalize_pressure_3d(p, imax, jmax, kmax):

@@ -1,0 +1,684 @@
+"""Fused NS-3D step-phase Pallas kernels — the 3-D twin of ops/ns2d_fused.py.
+
+Same motivation and equivalence policy as the 2-D module (launch-latency
+amortization of the non-solve phase chain; copies/selects/maxes bitwise,
+compound F/G/H / RHS / projection arithmetic ulp-equivalent via the SHARED
+formula functions ops/ns3d.fgh_predictor_terms / rhs_terms_3d /
+adapt_terms_3d with a roll-based window shift):
+
+  PRE  (u, v, w, dt)  -> (u', v', w', F, G, H, rhs)
+       6-face wall BCs -> special BC -> F/G/H predictor + wall fixups ->
+       Poisson RHS
+  POST (u', v', w', F, G, H, p, dt)
+       -> (u'', v'', w'', max|u''|, max|v''|, max|w''|)
+       projection adaptUV + the 3-D CFL max reduction
+
+Layout: blocks along k (the untiled outermost axis — halo planes need no
+alignment rounding), full padded (jp, ip) planes per k-slice
+(sor3d_pallas.padded_ji tiling). `pad3`/`unpad3` convert at the chunk/step
+boundary. All writes are gated by GLOBAL coordinates (offsets via scalar
+prefetch), so the same kernels serve the single-device solver (offsets 0)
+and the distributed twin (per-shard deep-halo blocks, depth FUSE_DEEP_HALO
+exchange per step). Obstacle flag fields keep the jnp chain in 3-D (the
+models record the decision) — the 2-D module is the flag-composition home.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import ns3d as ops3
+from .ns2d_fused import FUSE_CHAIN, FUSE_DEEP_HALO  # shared validity chain
+from .sor_pallas import (
+    LANE,
+    VMEM_LIMIT_BYTES,
+    CompilerParams,
+    _align,
+    _check_dtype,
+    pltpu,
+)
+
+NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
+
+__all__ = [
+    "FUSE_CHAIN", "FUSE_DEEP_HALO", "make_fused_pre_3d",
+    "make_fused_post_3d", "make_fused_step_3d", "probe_fused_3d",
+]
+
+
+def _win_shift(a, dk=0, dj=0, di=0):
+    """fgh_predictor_terms' `sh` contract on the VMEM window: roll so that
+    out[x] = a[x + (dk, dj, di)] (identical neighbour values at every cell
+    whose neighbours are real)."""
+    out = a
+    if dk:
+        out = jnp.roll(out, -dk, axis=0)
+    if dj:
+        out = jnp.roll(out, -dj, axis=1)
+    if di:
+        out = jnp.roll(out, -di, axis=2)
+    return out
+
+
+def apply_wall_bcs_3d(u, v, w, gk, gj, gi, bcs, gkmax, gjmax, gimax):
+    """set_boundary_conditions_3d as sequential global-coordinate-gated
+    where-updates: same face order (the bcs dict's insertion order = the
+    reference's application order), same written values. axes: 0=k, 1=j,
+    2=i; normal component per axis {0: w, 1: v, 2: u}."""
+    fields = {0: w, 1: v, 2: u}
+    coords = {0: gk, 1: gj, 2: gi}
+    gmaxes = {0: gkmax, 1: gjmax, 2: gimax}
+    tans = {
+        a: (coords[a] >= 1) & (coords[a] <= gmaxes[a]) for a in (0, 1, 2)
+    }
+    from .ns3d import FACES
+
+    for face, kind in bcs.items():
+        axis, side = FACES[face]
+        g = coords[axis]
+        t_axes = [a for a in (0, 1, 2) if a != axis]
+        tan = tans[t_axes[0]] & tans[t_axes[1]]
+        if side == "lo":
+            ghost = (g == 0) & tan
+            wall = (g == 0) & tan
+            wall_in = -1  # read one plane inward: roll(x, -1, axis)
+        else:
+            ghost = (g == gmaxes[axis] + 1) & tan
+            wall = (g == gmaxes[axis]) & tan
+            wall_in = 1
+        normal = fields[axis]
+        zero = jnp.zeros((), normal.dtype)
+
+        def inward(x, s=wall_in, a=axis):
+            return jnp.roll(x, s, axis=a)
+
+        if kind == NOSLIP:
+            fields[axis] = jnp.where(wall, zero, normal)
+            for a in t_axes:
+                fields[a] = jnp.where(ghost, -inward(fields[a]), fields[a])
+        elif kind == SLIP:
+            fields[axis] = jnp.where(wall, zero, normal)
+            for a in t_axes:
+                fields[a] = jnp.where(ghost, inward(fields[a]), fields[a])
+        elif kind == OUTFLOW:
+            fields[axis] = jnp.where(wall, inward(normal), normal)
+            for a in t_axes:
+                fields[a] = jnp.where(ghost, inward(fields[a]), fields[a])
+        elif kind == PERIODIC:
+            pass
+    return fields[2], fields[1], fields[0]
+
+
+def apply_special_bc_3d(u, gk, gj, gi, problem, gkmax, gjmax, gimax):
+    """set_special_bc_dcavity_3d / set_special_bc_canal_3d in gated-where
+    form (incl. the reference's skip-last-interior-i-AND-k lid quirk)."""
+    if problem == "dcavity":
+        m = (
+            (gj == gjmax + 1)
+            & (gk >= 1) & (gk <= gkmax - 1)
+            & (gi >= 1) & (gi <= gimax - 1)
+        )
+        u = jnp.where(m, 2.0 - jnp.roll(u, 1, axis=1), u)
+    elif problem == "canal":
+        m = (
+            (gi == 0)
+            & (gk >= 1) & (gk <= gkmax)
+            & (gj >= 1) & (gj <= gjmax)
+        )
+        u = jnp.where(m, jnp.full((), 2.0, u.dtype), u)
+    return u
+
+
+def _pre3_kernel(
+    sref,    # SMEM scalar prefetch: int32[3] = (koff, joff, ioff)
+    dt_ref,  # SMEM (1, 1)
+    *refs,   # [u, v, w] + [u', v', w', f, g, h, rhs] + scratch
+    block_k: int,
+    nblocks: int,
+    gkmax: int,
+    gjmax: int,
+    gimax: int,
+    lkmax: int,
+    ljmax: int,
+    limax: int,
+    ext_pad: int,
+    halo: int,
+    bcs: tuple,      # tuple of (face, kind) — dict order preserved
+    problem: str | None,
+    re: float,
+    gx: float,
+    gy: float,
+    gz: float,
+    gamma: float,
+    dx: float,
+    dy: float,
+    dz: float,
+):
+    (u_in, v_in, w_in, u_out, v_out, w_out, f_out, g_out, h_out, r_out,
+     uw2, vw2, ww2, ob2, ld_sem, st_sem) = refs
+    b = pl.program_id(0)
+    bk = block_k
+    h = halo
+    slot = b % 2
+    nslot = (b + 1) % 2
+    koff = sref[0]
+    joff = sref[1]
+    ioff = sref[2]
+    dt = dt_ref[0, 0]
+
+    def load(k, s):
+        return [
+            pltpu.make_async_copy(
+                arr.at[pl.ds(k * bk, bk + 2 * h)], win.at[s],
+                ld_sem.at[s, q])
+            for q, (arr, win) in enumerate(
+                ((u_in, uw2), (v_in, vw2), (w_in, ww2)))
+        ]
+
+    def store(k, s):
+        outs = (u_out, v_out, w_out, f_out, g_out, h_out, r_out)
+        return [
+            pltpu.make_async_copy(
+                ob2.at[s, q], outs[q].at[pl.ds(h + k * bk, bk)],
+                st_sem.at[s, q])
+            for q in range(7)
+        ]
+
+    @pl.when(b == 0)
+    def _():
+        for c in load(0, 0):
+            c.start()
+
+    @pl.when(b + 1 < nblocks)
+    def _():
+        for c in load(b + 1, nslot):
+            c.start()
+
+    for c in load(b, slot):
+        c.wait()
+
+    u = uw2[slot]
+    v = vw2[slot]
+    w = ww2[slot]
+
+    # window cell (wk, wj, wi): deep-block index a_k = b*bk + wk - h,
+    # global extended index gk = a_k - ext_pad + koff (and j/i likewise)
+    a_k = b * bk - h + jax.lax.broadcasted_iota(jnp.int32, u.shape, 0)
+    a_j = jax.lax.broadcasted_iota(jnp.int32, u.shape, 1)
+    a_i = jax.lax.broadcasted_iota(jnp.int32, u.shape, 2)
+    gk = a_k - ext_pad + koff
+    gj = a_j - ext_pad + joff
+    gi = a_i - ext_pad + ioff
+
+    # dead-cell-zero invariant on the loaded windows (ns2d_fused rationale:
+    # the carried padded arrays' unstored halo/tail planes are undefined)
+    ext_k = lkmax + 2 + 2 * ext_pad
+    ext_j = ljmax + 2 + 2 * ext_pad
+    ext_i = limax + 2 + 2 * ext_pad
+    live_in = (
+        (a_k >= 0) & (a_k < ext_k)
+        & (a_j >= 0) & (a_j < ext_j)
+        & (a_i >= 0) & (a_i < ext_i)
+    )
+    u = jnp.where(live_in, u, 0.0)
+    v = jnp.where(live_in, v, 0.0)
+    w = jnp.where(live_in, w, 0.0)
+
+    u, v, w = apply_wall_bcs_3d(
+        u, v, w, gk, gj, gi, dict(bcs), gkmax, gjmax, gimax
+    )
+    u = apply_special_bc_3d(u, gk, gj, gi, problem, gkmax, gjmax, gimax)
+
+    f_full, g_full, h_full = ops3.fgh_predictor_terms(
+        u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz, sh=_win_shift
+    )
+    interior = (
+        (gk >= 1) & (gk <= gkmax)
+        & (gj >= 1) & (gj <= gjmax)
+        & (gi >= 1) & (gi <= gimax)
+    )
+    tan_k = (gk >= 1) & (gk <= gkmax)
+    tan_j = (gj >= 1) & (gj <= gjmax)
+    tan_i = (gi >= 1) & (gi <= gimax)
+    f = jnp.where(interior, f_full, 0.0)
+    g = jnp.where(interior, g_full, 0.0)
+    hh = jnp.where(interior, h_full, 0.0)
+    # wall fixups (apply_fgh_wall_fixups): F=U on left/right, G=V on
+    # bottom/top, H=W on front/back walls
+    f = jnp.where(((gi == 0) | (gi == gimax)) & tan_k & tan_j, u, f)
+    g = jnp.where(((gj == 0) | (gj == gjmax)) & tan_k & tan_i, v, g)
+    hh = jnp.where(((gk == 0) | (gk == gkmax)) & tan_j & tan_i, w, hh)
+
+    local_int = (
+        (a_k >= ext_pad + 1) & (a_k <= ext_pad + lkmax)
+        & (a_j >= ext_pad + 1) & (a_j <= ext_pad + ljmax)
+        & (a_i >= ext_pad + 1) & (a_i <= ext_pad + limax)
+    )
+    rhs = jnp.where(
+        interior & local_int,
+        ops3.rhs_terms_3d(f, g, hh, dt, dx, dy, dz, sh=_win_shift),
+        0.0,
+    )
+
+    @pl.when(b >= 2)
+    def _():
+        for c in store(b - 2, slot):
+            c.wait()
+
+    for q, arr in enumerate((u, v, w, f, g, hh, rhs)):
+        ob2[slot, q] = arr[h: h + bk]
+    for c in store(b, slot):
+        c.start()
+
+    @pl.when(b == nblocks - 1)
+    def _():
+        for c in store(b, slot):
+            c.wait()
+        if nblocks > 1:
+            for c in store(b - 1, nslot):
+                c.wait()
+
+
+def _post3_kernel(
+    sref,    # SMEM scalar prefetch: int32[3]
+    dt_ref,  # SMEM (1, 1)
+    *refs,   # [u, v, w, f, g, h, p] + [u', v', w', umax, vmax, wmax] + scratch
+    block_k: int,
+    nblocks: int,
+    gkmax: int,
+    gjmax: int,
+    gimax: int,
+    ext_pad: int,
+    halo: int,
+    dx: float,
+    dy: float,
+    dz: float,
+):
+    (ub, vb, wb, fb, gb, hb, p_in,
+     u_out, v_out, w_out, umax, vmax, wmax,
+     bw2, pw2, ob2, macc, ld_sem, st_sem) = refs
+    b = pl.program_id(0)
+    bk = block_k
+    h = halo
+    slot = b % 2
+    nslot = (b + 1) % 2
+    koff = sref[0]
+    joff = sref[1]
+    ioff = sref[2]
+    dt = dt_ref[0, 0]
+
+    def load(k, s):
+        copies = [
+            pltpu.make_async_copy(
+                arr.at[pl.ds(h + k * bk, bk)], bw2.at[s, q],
+                ld_sem.at[s, q])
+            for q, arr in enumerate((ub, vb, wb, fb, gb, hb))
+        ]
+        copies.append(pltpu.make_async_copy(
+            p_in.at[pl.ds(k * bk, bk + 2 * h)], pw2.at[s], ld_sem.at[s, 6]))
+        return copies
+
+    def store(k, s):
+        return [
+            pltpu.make_async_copy(
+                ob2.at[s, q], arr.at[pl.ds(h + k * bk, bk)],
+                st_sem.at[s, q])
+            for q, arr in enumerate((u_out, v_out, w_out))
+        ]
+
+    @pl.when(b == 0)
+    def _():
+        macc[...] = jnp.zeros_like(macc)
+        for c in load(0, 0):
+            c.start()
+
+    @pl.when(b + 1 < nblocks)
+    def _():
+        for c in load(b + 1, nslot):
+            c.start()
+
+    for c in load(b, slot):
+        c.wait()
+
+    u = bw2[slot, 0]
+    v = bw2[slot, 1]
+    w = bw2[slot, 2]
+    f = bw2[slot, 3]
+    g = bw2[slot, 4]
+    hh = bw2[slot, 5]
+    pw = pw2[slot]
+    pc = pw[h: h + bk]
+
+    def sh_p(x, dk=0, dj=0, di=0):
+        # adapt_terms_3d's shift contract on the p window: +1 in k comes
+        # from the halo plane above the owned band, in-plane shifts roll
+        if dk:
+            return pw[h + dk: h + bk + dk]
+        return _win_shift(x, 0, dj, di)
+
+    a_k = b * bk + jax.lax.broadcasted_iota(jnp.int32, u.shape, 0)
+    a_j = jax.lax.broadcasted_iota(jnp.int32, u.shape, 1)
+    a_i = jax.lax.broadcasted_iota(jnp.int32, u.shape, 2)
+    gk = a_k - ext_pad + koff
+    gj = a_j - ext_pad + joff
+    gi = a_i - ext_pad + ioff
+    interior = (
+        (gk >= 1) & (gk <= gkmax)
+        & (gj >= 1) & (gj <= gjmax)
+        & (gi >= 1) & (gi <= gimax)
+    )
+
+    ua, va, wa = ops3.adapt_terms_3d(f, g, hh, pc, dt, dx, dy, dz, sh=sh_p)
+    u = jnp.where(interior, ua, u)
+    v = jnp.where(interior, va, v)
+    w = jnp.where(interior, wa, w)
+
+    @pl.when(b >= 2)
+    def _():
+        for c in store(b - 2, slot):
+            c.wait()
+
+    ob2[slot, 0] = u
+    ob2[slot, 1] = v
+    ob2[slot, 2] = w
+    for c in store(b, slot):
+        c.start()
+
+    # ghost-inclusive 3-D maxElement (solver.c:299-310), dead cells and
+    # stale deep halos excluded
+    valid = (
+        (gk >= 0) & (gk <= gkmax + 1)
+        & (gj >= 0) & (gj <= gjmax + 1)
+        & (gi >= 0) & (gi <= gimax + 1)
+    )
+    zero = jnp.zeros((), u.dtype)
+    for q, arr in enumerate((u, v, w)):
+        m = jnp.max(jnp.where(valid, jnp.abs(arr), zero), axis=(0, 1))
+        macc[q: q + 1, :] = jnp.maximum(macc[q: q + 1, :], m[None, :])
+
+    @pl.when(b == nblocks - 1)
+    def _():
+        umax[0, 0] = jnp.max(macc[0:1, :])
+        vmax[0, 0] = jnp.max(macc[1:2, :])
+        wmax[0, 0] = jnp.max(macc[2:3, :])
+        for c in store(b, slot):
+            c.wait()
+        if nblocks > 1:
+            for c in store(b - 1, nslot):
+                c.wait()
+
+
+def fused3_vmem_bytes(bk: int, h: int, jp: int, ip: int,
+                      itemsize: int) -> int:
+    """Scratch bytes of the larger kernel (pre: 3 windows + 7 out bands;
+    post: 6 in bands + 1 window + 3 out bands), double buffered, plus the
+    per-lane max accumulator."""
+    plane = jp * ip
+    win = (bk + 2 * h) * plane
+    band = bk * plane
+    pre = 2 * (3 * win + 7 * band)
+    post = 2 * (6 * band + win + 3 * band) + 3 * ip
+    return itemsize * max(pre, post)
+
+
+def pick_block_k_fused(kext: int, jp: int, ip: int, dtype) -> int:
+    """Block depth: budget the resident planes (20·bk + 12·h of the pre
+    kernel) against half the raised VMEM limit, capped by the whole grid."""
+    plane = jp * ip * jnp.dtype(dtype).itemsize
+    h = FUSE_CHAIN
+    feasible = ((VMEM_LIMIT_BYTES // 2) // plane - 12 * h) // 20
+    return max(1, min(feasible, kext, 32))
+
+
+def _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, block_k,
+           interpret):
+    if pltpu is None:
+        raise ValueError("pallas TPU backend unavailable")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _check_dtype(dtype, interpret)
+    lkmax = gkmax if kl is None else kl
+    ljmax = gjmax if jl is None else jl
+    limax = gimax if il is None else il
+    ext_k = lkmax + 2 + 2 * ext_pad
+    ext_j = ljmax + 2 + 2 * ext_pad
+    ext_i = limax + 2 + 2 * ext_pad
+    a = _align(dtype)
+    jp = -(-ext_j // a) * a
+    ip = -(-ext_i // LANE) * LANE
+    h = FUSE_CHAIN
+    if block_k is None:
+        block_k = pick_block_k_fused(ext_k, jp, ip, dtype)
+    nblocks = -(-ext_k // block_k)
+    kp = nblocks * block_k + 2 * h
+    itemsize = jnp.dtype(dtype).itemsize
+    if fused3_vmem_bytes(block_k, h, jp, ip, itemsize) > VMEM_LIMIT_BYTES // 2:
+        raise ValueError(
+            f"fused 3-D step-phase scratch {fused3_vmem_bytes(block_k, h, jp, ip, itemsize) >> 20} MiB "
+            f"exceeds the VMEM budget (block_k={block_k}, plane {jp}x{ip}); "
+            "the jnp phase chain is the fallback"
+        )
+
+    def pad3(x):
+        out = jnp.zeros((kp, jp, ip), x.dtype)
+        return out.at[h: h + x.shape[0], : x.shape[1], : x.shape[2]].set(x)
+
+    def unpad3(xp):
+        return xp[h: h + ext_k, :ext_j, :ext_i]
+
+    return (interpret, lkmax, ljmax, limax, h, block_k, jp, ip, nblocks,
+            kp, pad3, unpad3)
+
+
+def make_fused_pre_3d(
+    param,
+    gkmax: int,
+    gjmax: int,
+    gimax: int,
+    dx: float,
+    dy: float,
+    dz: float,
+    dtype,
+    *,
+    kl: int | None = None,
+    jl: int | None = None,
+    il: int | None = None,
+    ext_pad: int = 0,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Build the 3-D PRE kernel:
+      pre(offs_i32[3], dt_11, u_pad, v_pad, w_pad)
+          -> (u', v', w', f, g, h, rhs)                            [padded]
+    plus (pad3, unpad3, halo). Geometry contract as make_fused_pre_2d."""
+    (interpret, lkmax, ljmax, limax, h, block_k, jp, ip, nblocks, kp,
+     pad3, unpad3) = _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il,
+                            ext_pad, block_k, interpret)
+    bcs = (
+        ("top", param.bcTop), ("bottom", param.bcBottom),
+        ("left", param.bcLeft), ("right", param.bcRight),
+        ("front", param.bcFront), ("back", param.bcBack),
+    )
+    kernel = functools.partial(
+        _pre3_kernel,
+        block_k=block_k,
+        nblocks=nblocks,
+        gkmax=gkmax,
+        gjmax=gjmax,
+        gimax=gimax,
+        lkmax=lkmax,
+        ljmax=ljmax,
+        limax=limax,
+        ext_pad=ext_pad,
+        halo=h,
+        bcs=bcs,
+        problem=param.name.replace("3d", ""),
+        re=param.re,
+        gx=param.gx,
+        gy=param.gy,
+        gz=param.gz,
+        gamma=param.gamma,
+        dx=dx,
+        dy=dy,
+        dz=dz,
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nblocks,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + [pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 7,
+            scratch_shapes=[
+                pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+                pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+                pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+                pltpu.VMEM((2, 7, block_k, jp, ip), dtype),
+                pltpu.SemaphoreType.DMA((2, 3)),
+                pltpu.SemaphoreType.DMA((2, 7)),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((kp, jp, ip), dtype)] * 7,
+        compiler_params=CompilerParams(vmem_limit_bytes=VMEM_LIMIT_BYTES),
+        interpret=interpret,
+    )
+
+    def pre(offs, dt11, u_pad, v_pad, w_pad):
+        return call(offs, dt11, u_pad, v_pad, w_pad)
+
+    return pre, pad3, unpad3, h
+
+
+def make_fused_post_3d(
+    param,
+    gkmax: int,
+    gjmax: int,
+    gimax: int,
+    dx: float,
+    dy: float,
+    dz: float,
+    dtype,
+    *,
+    kl: int | None = None,
+    jl: int | None = None,
+    il: int | None = None,
+    ext_pad: int = 0,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Build the 3-D POST kernel:
+      post(offs_i32[3], dt_11, u, v, w, f, g, h, p)  [all padded]
+          -> (u'', v'', w'', umax, vmax, wmax)."""
+    (interpret, lkmax, ljmax, limax, h, block_k, jp, ip, nblocks, kp,
+     pad3, unpad3) = _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il,
+                            ext_pad, block_k, interpret)
+    del lkmax, ljmax, limax
+    kernel = functools.partial(
+        _post3_kernel,
+        block_k=block_k,
+        nblocks=nblocks,
+        gkmax=gkmax,
+        gjmax=gjmax,
+        gimax=gimax,
+        ext_pad=ext_pad,
+        halo=h,
+        dx=dx,
+        dy=dy,
+        dz=dz,
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nblocks,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + [pl.BlockSpec(memory_space=pl.ANY)] * 7,
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3
+            + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 3,
+            scratch_shapes=[
+                pltpu.VMEM((2, 6, block_k, jp, ip), dtype),
+                pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+                pltpu.VMEM((2, 3, block_k, jp, ip), dtype),
+                pltpu.VMEM((3, ip), dtype),
+                pltpu.SemaphoreType.DMA((2, 7)),
+                pltpu.SemaphoreType.DMA((2, 3)),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((kp, jp, ip), dtype)] * 3
+        + [jax.ShapeDtypeStruct((1, 1), dtype)] * 3,
+        compiler_params=CompilerParams(vmem_limit_bytes=VMEM_LIMIT_BYTES),
+        interpret=interpret,
+    )
+
+    def post(offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad, p_pad):
+        u_pad, v_pad, w_pad, um, vm, wm = call(
+            offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad, p_pad
+        )
+        return u_pad, v_pad, w_pad, um[0, 0], vm[0, 0], wm[0, 0]
+
+    return post, pad3, unpad3, h
+
+
+def make_fused_step_3d(
+    param,
+    gkmax: int,
+    gjmax: int,
+    gimax: int,
+    dx: float,
+    dy: float,
+    dz: float,
+    dtype,
+    *,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """The single-device composition (pre + post on the whole grid).
+    Returns (pre, post, pad3, unpad3, halo)."""
+    pre, pad3, unpad3, h = make_fused_pre_3d(
+        param, gkmax, gjmax, gimax, dx, dy, dz, dtype,
+        block_k=block_k, interpret=interpret,
+    )
+    post, _p, _u, _h = make_fused_post_3d(
+        param, gkmax, gjmax, gimax, dx, dy, dz, dtype,
+        block_k=block_k, interpret=interpret,
+    )
+    return pre, post, pad3, unpad3, h
+
+
+_PROBE_OK: bool | None = None
+
+
+def probe_fused_3d() -> bool:
+    """One-time smoke test of the 3-D fused pair on the real backend."""
+    global _PROBE_OK
+    if _PROBE_OK is None:
+        try:
+            from ..utils.params import Parameter
+
+            param = Parameter(name="dcavity3d", imax=30, jmax=30, kmax=30)
+            pre, post, pad3, _unpad3, _h = make_fused_step_3d(
+                param, 30, 30, 30, 1.0 / 30, 1.0 / 30, 1.0 / 30,
+                jnp.float32, interpret=False,
+            )
+            z = pad3(jnp.zeros((32, 32, 32), jnp.float32))
+            offs = jnp.zeros((3,), jnp.int32)
+            dt11 = jnp.full((1, 1), 0.01, jnp.float32)
+            up, vp, wp, fp, gp, hp, _r = pre(offs, dt11, z, z, z)
+            out = post(offs, dt11, up, vp, wp, fp, gp, hp, z)
+            float(out[3])  # force completion
+            _PROBE_OK = True
+        except Exception:  # noqa: BLE001
+            import warnings
+
+            warnings.warn(
+                "fused 3-D NS step-phase kernels unavailable; keeping the "
+                "jnp phase chain",
+                stacklevel=2,
+            )
+            _PROBE_OK = False
+    return _PROBE_OK

@@ -51,7 +51,7 @@ try:  # pallas TPU backend is absent on some CPU-only installs
 except ImportError:  # pragma: no cover
     pltpu = None
 
-from .sor_pallas import LANE, VMEM_LIMIT_BYTES, _align, _check_dtype
+from .sor_pallas import CompilerParams, LANE, VMEM_LIMIT_BYTES, _align, _check_dtype
 
 
 def padded_ji(jmax: int, imax: int, dtype) -> tuple[int, int]:
@@ -398,7 +398,7 @@ def make_rb_iter_tblock_3d(
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,
@@ -752,7 +752,7 @@ def make_rb_iter_tblock_3d_octants(
             pltpu.SemaphoreType.DMA((2, 16)),
             pltpu.SemaphoreType.DMA((2, 8)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,

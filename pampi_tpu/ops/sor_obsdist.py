@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from .sor_pallas import (
+    CompilerParams,
     VMEM_LIMIT_BYTES,
     _check_dtype,
     masked_stencil_ops,
@@ -196,6 +197,19 @@ def make_rb_iters_obsdist(jmax, imax, jl, il, n, dx, dy, omega, dtype, *,
         return None, 0, 0
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if loop_sweeps and not interpret:
+        # the looped (scf.for) kernel is bitwise-correct in interpret mode
+        # but CRASHES the production Mosaic compiler at any depth on the
+        # current toolchain (round-5 measured outcome, see the depth note
+        # below) — a compile-time crash is not catchable by the dispatch
+        # backoff, so refuse here instead of letting the opt-in reach the
+        # real compiler (ADVICE round-5 item)
+        raise ValueError(
+            "loop_sweeps=True is an interpret-mode-only form: the scf.for "
+            "sweep loop crashes the production Mosaic compiler (round-5 "
+            "record, results/obsdist2048.json); use the unrolled default "
+            "on TPU"
+        )
     _check_dtype(dtype, interpret)
     H = ca_halo(n, ragged)
     ext_j = jl + 2 * H  # logical rows of the deep block incl. its "+2"
@@ -286,7 +300,7 @@ def make_rb_iters_obsdist(jmax, imax, jl, il, n, dx, dy, omega, dtype, *,
             jax.ShapeDtypeStruct((rp, wp), dtype),
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,

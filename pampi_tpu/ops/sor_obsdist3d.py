@@ -25,6 +25,7 @@ from jax.experimental import pallas as pl
 
 from .sor3d_pallas import (
     VMEM_LIMIT_BYTES,
+    CompilerParams,
     _check_dtype,
     masked_stencil_ops_3d,
     padded_ji,
@@ -230,7 +231,7 @@ def make_rb_iters_obsdist_3d(kmax, jmax, imax, kl, jl, il, n, dx, dy, dz,
             jax.ShapeDtypeStruct((kp, jp, ip), dtype),
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,

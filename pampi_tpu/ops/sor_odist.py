@@ -18,7 +18,7 @@ from jax.experimental import pallas as pl
 
 from ..parallel.octants_dist import OGeom, QIDX
 from .sor_octants import BITS, EVEN, ODD, _flip
-from .sor_pallas import VMEM_LIMIT_BYTES, _check_dtype, pltpu
+from .sor_pallas import CompilerParams, VMEM_LIMIT_BYTES, _check_dtype, pltpu
 
 
 def octants_dist_vmem_bytes(g: OGeom, itemsize: int) -> int:
@@ -268,7 +268,7 @@ def make_rb_iters_odist(g: OGeom, dx: float, dy: float, dz: float,
             jax.ShapeDtypeStruct((8, g.sp, g.jp2, g.ip2), dtype),
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,

@@ -59,6 +59,15 @@ try:  # pallas TPU backend is absent on some CPU-only installs
 except ImportError:  # pragma: no cover
     pltpu = None
 
+# jax renamed TPUCompilerParams -> CompilerParams; support both so the
+# interpret-mode kernels (and their parity tests) run on either toolchain
+CompilerParams = (
+    getattr(pltpu, "CompilerParams", None)
+    or getattr(pltpu, "TPUCompilerParams", None)
+    if pltpu is not None
+    else None
+)
+
 
 LANE = 128  # lane tile; DMA slice widths must be multiples of this
 
@@ -577,7 +586,7 @@ def make_rb_iter_tblock(
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,
@@ -923,7 +932,7 @@ def make_rb_iter_tblock_quarters(
             pltpu.SemaphoreType.DMA((2, 8)),
             pltpu.SemaphoreType.DMA((2, 4)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,
@@ -1008,7 +1017,7 @@ def make_rb_iter_pallas(
             pltpu.VMEM((block_rows, wp), dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         input_output_aliases={0: 0},

@@ -26,6 +26,7 @@ from jax.experimental import pallas as pl
 
 from ..parallel.quarters_dist import QGeom, SLOT_PARITY
 from .sor_pallas import (
+    CompilerParams,
     VMEM_LIMIT_BYTES,
     _check_dtype,
     pltpu,
@@ -252,7 +253,7 @@ def make_rb_iters_qdist(g: QGeom, dx: float, dy: float, omega: float, dtype,
             jax.ShapeDtypeStruct((4, g.rp, g.w2p), dtype),
             jax.ShapeDtypeStruct((1, 1), dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES
         ),
         interpret=interpret,

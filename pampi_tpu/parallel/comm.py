@@ -139,6 +139,27 @@ def dims_create(nranks: int, ndims: int,
     return min(factorizations(nranks, ndims), key=score)
 
 
+def compat_shard_map(fn, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` across toolchains — the ONE place the version shim
+    lives (CartComm.shard_map, models/dmvm.py and tests/test_sor_pallas.py
+    all route through it). Older jax only ships
+    `jax.experimental.shard_map`, whose check_rep predates the while-loop
+    replication rule every chunked solver needs, so validation is forced
+    off on that branch; the check_vma contract is still enforced wherever
+    `jax.shard_map` exists (the TPU image and the CI mesh tests there)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 @dataclass
 class CartComm:
     """Cartesian device-mesh communicator (≙ the Comm struct, comm.h:104-115).
@@ -234,7 +255,7 @@ class CartComm:
         test meshes (test_ns2d_dist/test_ns3d_dist/test_poisson_dist), which
         is what catches out_spec/ppermute mistakes the relaxed production
         trace would hide."""
-        return jax.shard_map(
+        return compat_shard_map(
             fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=check_vma,
         )

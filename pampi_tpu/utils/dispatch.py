@@ -53,3 +53,52 @@ def resolve_solver(param, obstacles: bool, ragged: bool = False):
         choice, why = "fft", "plain grid: exact DCT direct solve"
     record("solver_auto", f"{choice} ({why})")
     return param.replace(tpu_solver=choice)
+
+
+def resolve_fuse_phases(param, backend: str, dtype, probe, key: str,
+                        why_not: str | None = None) -> bool:
+    """`tpu_fuse_phases` -> whether this build dispatches the fused NS
+    step-phase kernels (ops/ns2d_fused.py / ns3d_fused.py), extending the
+    measured `auto` matrix to the phase chain: the round-5 north-star
+    decomposition showed the ~40-launch jnp chain at 6.4 ms/step vs a
+    ~0.8 ms HBM floor, so on TPU fusing is the measured-best choice
+    wherever the kernels exist. Decision recorded under `key` (dryrun
+    artifacts, tests assert on it).
+
+    backend is the model's retry-protocol backend: "jnp" (the pallas-retry
+    fallback) always disables fusion — that IS the retry's contract.
+    `why_not` marks structurally ineligible builds (ragged, dist-obstacle,
+    3-D obstacle) where the kernels don't exist yet; `probe` is the
+    kernel-family one-time smoke test ("on" skips it: the interpret-mode
+    force used by parity tests and dryruns)."""
+    import jax
+    import jax.numpy as jnp
+
+    knob = param.tpu_fuse_phases
+    if knob not in ("auto", "on", "off"):
+        raise ValueError(
+            f"tpu_fuse_phases must be auto|on|off, got {knob!r}"
+        )
+    if knob == "off":
+        record(key, "jnp (tpu_fuse_phases off)")
+        return False
+    if backend == "jnp":
+        record(key, "jnp (retry fallback backend)")
+        return False
+    if why_not is not None:
+        record(key, f"jnp ({why_not})")
+        return False
+    if knob == "on":
+        record(key, "pallas_fused (forced)")
+        return True
+    if jax.default_backend() != "tpu":
+        record(key, "jnp (no TPU)")
+        return False
+    if jnp.dtype(dtype).itemsize > 4:
+        record(key, "jnp (dtype not Mosaic-lowerable)")
+        return False
+    if not probe():
+        record(key, "jnp (probe failed)")
+        return False
+    record(key, "pallas_fused")
+    return True

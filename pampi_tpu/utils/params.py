@@ -121,6 +121,24 @@ class Parameter:
     #          "solver_auto" dispatch key. The default stays "sor" for
     #          reference-trajectory parity.
     tpu_solver: str = "sor"
+    # fused step-phase kernels (ops/ns2d_fused.py, ns3d_fused.py): the
+    # non-solve NS timestep phases (BCs + special BC + computeFG + RHS +
+    # adaptUV + CFL max) collapse from the ~40-launch jnp chain into two
+    # Pallas HBM sweeps bracketing the pressure solve — the round-5
+    # north-star decomposition measured that chain at 6.4 ms/step vs a
+    # ~0.8 ms HBM floor at dcavity 4096² (results/northstar_dcavity4096.json).
+    #   "auto" fuse when eligible: real TPU + Mosaic dtype + one-time probe
+    #          + VMEM-feasible geometry; plain and (2-D single-device)
+    #          obstacle runs fuse, distributed divisible plain runs fuse
+    #          per shard, ragged / dist-obstacle / 3-D-obstacle keep the
+    #          jnp chain (utils/dispatch.resolve_fuse_phases records every
+    #          decision under the "*_phases" keys)
+    #   "on"   force (interpret off-TPU — the parity-test mode)
+    #   "off"  always the jnp phase chain
+    # Numerics: BC/select/max phases bitwise-identical; F/G/RHS/projection
+    # ulp-equivalent (same formula functions, compiler fma differences only
+    # — the quarters-layout precedent).
+    tpu_fuse_phases: str = "auto"
     # MG stall detector (tpu_solver mg only): a V-cycle whose residual
     # changed less than this RELATIVE tolerance is treated as floored and
     # the solve returns early (ops/multigrid.MG_STALL_RTOL rationale). Set 0

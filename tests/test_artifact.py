@@ -1,0 +1,34 @@
+"""tools/_artifact.write_merged: re-runs must refresh measured keys without
+clobbering curated NESTED fields (ADVICE round-5 item — the shallow
+dict.update lost any curated field under a colliding top-level key)."""
+
+import json
+
+
+def test_write_merged_recursive(tmp_path):
+    from tools._artifact import write_merged
+
+    path = str(tmp_path / "results" / "rec.json")
+    write_merged(path, {
+        "ms_per_step": 19.06,
+        "decomposition": {"solve_ms": 12.6, "nonsolve_ms": 6.4},
+    })
+    # an analyst curates fields inside the tool-produced nested record
+    with open(path) as fh:
+        rec = json.load(fh)
+    rec["decomposition"]["assessment"] = "launch-bound"
+    rec["verdict"] = {"outcome": "NOT MET", "margin": -0.66}
+    with open(path, "w") as fh:
+        json.dump(rec, fh)
+    # the re-run refreshes measured keys only
+    out = write_merged(path, {
+        "ms_per_step": 13.9,
+        "decomposition": {"solve_ms": 12.6, "nonsolve_ms": 1.2},
+    })
+    assert out["ms_per_step"] == 13.9
+    assert out["decomposition"]["nonsolve_ms"] == 1.2
+    assert out["decomposition"]["assessment"] == "launch-bound"  # survives
+    assert out["verdict"] == {"outcome": "NOT MET", "margin": -0.66}
+    # a type change on a key replaces wholesale (new wins)
+    out = write_merged(path, {"verdict": "MET"})
+    assert out["verdict"] == "MET"

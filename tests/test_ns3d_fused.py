@@ -1,0 +1,155 @@
+"""Fused NS-3D step-phase kernels (ops/ns3d_fused.py) vs the jnp chain —
+the 3-D twin of tests/test_ns2d_fused.py, same equivalence contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pampi_tpu.models.ns3d import NS3DSolver
+from pampi_tpu.ops import ns3d as ops3
+from pampi_tpu.ops import ns3d_fused as nf3
+from pampi_tpu.utils import dispatch
+from pampi_tpu.utils.params import Parameter
+
+
+def _ulp_close(a, b, scale=None):
+    a, b = np.asarray(a), np.asarray(b)
+    tol = 1e-12 if a.dtype == np.float64 else 2e-5
+    s = max(1.0, np.abs(b).max() if scale is None else scale)
+    return np.abs(a - b).max() <= tol * s
+
+
+@pytest.mark.parametrize("problem,bckw", [
+    ("dcavity3d", {}),
+    ("canal3d", dict(bcLeft=3, bcRight=3, bcFront=2, bcBack=2)),
+])
+@pytest.mark.parametrize("shape", [(16, 16, 16), (12, 20, 28)])
+@pytest.mark.parametrize("block_k", [None, 4])
+def test_phase_parity_3d(problem, bckw, shape, block_k):
+    km, jm, im = shape
+    param = Parameter(name=problem, imax=im, jmax=jm, kmax=km, re=100.0,
+                      gamma=0.9, **bckw)
+    dx, dy, dz = param.xlength / im, param.ylength / jm, param.zlength / km
+    rng = np.random.default_rng(11)
+    shp = (km + 2, jm + 2, im + 2)
+    u = jnp.asarray(rng.normal(size=shp))
+    v = jnp.asarray(rng.normal(size=shp))
+    w = jnp.asarray(rng.normal(size=shp))
+    p = jnp.asarray(rng.normal(size=shp))
+    dt = jnp.asarray(0.011)
+    bcs = {"top": param.bcTop, "bottom": param.bcBottom,
+           "left": param.bcLeft, "right": param.bcRight,
+           "front": param.bcFront, "back": param.bcBack}
+    u1, v1, w1 = ops3.set_boundary_conditions_3d(u, v, w, bcs)
+    if problem == "dcavity3d":
+        u1 = ops3.set_special_bc_dcavity_3d(u1)
+    else:
+        u1 = ops3.set_special_bc_canal_3d(u1)
+    f, g, h = ops3.compute_fgh(u1, v1, w1, dt, param.re, 0.0, 0.0, 0.0,
+                               param.gamma, dx, dy, dz)
+    rhs = ops3.compute_rhs(f, g, h, dt, dx, dy, dz)
+    u2, v2, w2 = ops3.adapt_uvw(u1, v1, w1, f, g, h, p, dt, dx, dy, dz)
+
+    pre, post, pad3, unpad3, _h = nf3.make_fused_step_3d(
+        param, km, jm, im, dx, dy, dz, jnp.float64, interpret=True,
+        block_k=block_k)
+    offs = jnp.zeros((3,), jnp.int32)
+    dt11 = jnp.full((1, 1), dt)
+    up, vp, wp, fp, gp, hp, rp = pre(offs, dt11, pad3(u), pad3(v), pad3(w))
+    assert jnp.array_equal(unpad3(up), u1)
+    assert jnp.array_equal(unpad3(vp), v1)
+    assert jnp.array_equal(unpad3(wp), w1)
+    assert _ulp_close(unpad3(fp), f)
+    assert _ulp_close(unpad3(gp), g)
+    assert _ulp_close(unpad3(hp), h)
+    assert _ulp_close(unpad3(rp), rhs, scale=float(jnp.abs(rhs).max()))
+    up2, vp2, wp2, um, vm, wm = post(
+        offs, dt11, up, vp, wp, fp, gp, hp, pad3(p))
+    assert _ulp_close(unpad3(up2), u2)
+    assert _ulp_close(unpad3(vp2), v2)
+    assert _ulp_close(unpad3(wp2), w2)
+    for got, ref in ((um, u2), (vm, v2), (wm, w2)):
+        assert abs(float(got) - float(ops3.max_element(ref))) <= 1e-12
+
+
+def _run_solver(fuse, **kw):
+    base = dict(name="dcavity3d", imax=16, jmax=16, kmax=16, re=10.0,
+                te=0.02, tau=0.5, itermax=40, eps=1e-4, omg=1.7, gamma=0.9)
+    base.update(kw)
+    s = NS3DSolver(Parameter(tpu_fuse_phases=fuse, **base))
+    s.run(progress=False)
+    return s
+
+
+@pytest.mark.parametrize("kw", [
+    {},
+    dict(name="canal3d", bcLeft=3, bcRight=3),
+    dict(tpu_solver="fft"),
+    dict(tau=-1.0, dt=0.004),
+])
+def test_solver_e2e_fused_matches_jnp_3d(kw):
+    a, b = _run_solver("off", **kw), _run_solver("on", **kw)
+    assert b._fused and not a._fused
+    assert a.nt == b.nt
+    for n in ("u", "v", "w", "p"):
+        d = np.abs(np.asarray(getattr(a, n)) - np.asarray(getattr(b, n)))
+        assert np.isfinite(d).all() and d.max() < 1e-9, n
+
+
+def test_obstacle_3d_keeps_jnp_chain():
+    """3-D obstacle flag fields are not fused (the 2-D module is the flag
+    home); the knob must record the decision and the run must work."""
+    s = _run_solver("auto", obstacles="0.3,0.3,0.3,0.6,0.6,0.6", te=0.004,
+                    tpu_solver="sor")
+    assert not s._fused
+    assert "obstacle" in dispatch.last("ns3d_phases")
+
+
+def test_dist_fused_matches_single_3d():
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = Parameter(name="dcavity3d", imax=16, jmax=16, kmax=16, re=10.0,
+                      te=0.008, tau=0.5, itermax=40, eps=1e-4, omg=1.7,
+                      gamma=0.9)
+    single = NS3DSolver(param.replace(tpu_fuse_phases="off"))
+    single.run(progress=False)
+    sg = single.collect()
+    for dims in [(2, 2, 2), (1, 2, 4)]:
+        dist = NS3DDistSolver(param.replace(tpu_fuse_phases="on"),
+                              CartComm(ndims=3, dims=dims))
+        dist.run(progress=False)
+        assert dispatch.last("ns3d_dist_phases") == "pallas_fused (forced)"
+        dg = dist.collect()
+        assert dist.nt == single.nt
+        for n, (x, y) in zip("uvwp", zip(sg, dg)):
+            d = np.abs(np.asarray(x) - np.asarray(y))
+            assert np.isfinite(d).all() and d.max() < 1e-10, (dims, n)
+
+
+def _count_prim(jaxpr, name):
+    n = sum(1 for e in jaxpr.eqns if e.primitive.name == name)
+    for e in jaxpr.eqns:
+        for v in e.params.values():
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vals:
+                if type(x).__name__ == "ClosedJaxpr":
+                    n += _count_prim(x.jaxpr, name)
+                elif type(x).__name__ == "Jaxpr":
+                    n += _count_prim(x, name)
+    return n
+
+
+def test_launch_count_regression_3d():
+    param = Parameter(name="dcavity3d", imax=16, jmax=16, kmax=16, re=10.0,
+                      te=0.02, tau=0.5, itermax=20, eps=1e-3,
+                      tpu_solver="fft")
+    fused = NS3DSolver(param.replace(tpu_fuse_phases="on"))
+    plain = NS3DSolver(param.replace(tpu_fuse_phases="off"))
+    state = (plain.u, plain.v, plain.w, plain.p,
+             jnp.asarray(0.0, jnp.float64), jnp.asarray(0, jnp.int32))
+    jx_f = jax.make_jaxpr(fused._build_chunk())(*state)
+    jx_p = jax.make_jaxpr(plain._build_chunk())(*state)
+    assert _count_prim(jx_f.jaxpr, "pallas_call") == 2
+    assert _count_prim(jx_p.jaxpr, "pallas_call") == 0

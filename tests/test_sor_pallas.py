@@ -228,10 +228,11 @@ def test_tblock_kernel_composes_with_shard_map():
         out, r = rb(pl_, rl_)
         return out, jax.lax.pmax(r, "r")  # any collective proves the wiring
 
-    smf = jax.jit(
-        jax.shard_map(kern, mesh=mesh, in_specs=(P(), P()),
-                      out_specs=(P(), P()), check_vma=False)
-    )
+    from pampi_tpu.parallel.comm import compat_shard_map
+
+    sm = compat_shard_map(kern, mesh=mesh, in_specs=(P(), P()),
+                          out_specs=(P(), P()), check_vma=False)
+    smf = jax.jit(sm)
     s_p, s_r = smf(pp, rp)
     assert float(d_r) == float(s_r)
     np.testing.assert_array_equal(np.asarray(d_p), np.asarray(s_p))

@@ -6,13 +6,28 @@ import json
 import os
 
 
+def merge_nested(old: dict, new: dict) -> dict:
+    """Recursive merge: `new` wins per leaf key, but dict-valued keys merge
+    key-by-key instead of being clobbered wholesale — so a re-run that
+    refreshes a tool-produced nested record (e.g. a per-session block)
+    keeps the curated fields an analyst added inside it (ADVICE round-5:
+    the shallow dict.update lost any curated field whose top-level key
+    collided with a tool key)."""
+    out = dict(old)
+    for k, v in new.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = merge_nested(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 def write_merged(path: str, rec: dict) -> dict:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     if os.path.exists(path):
         with open(path) as fh:
             old = json.load(fh)
-        old.update(rec)
-        rec = old
+        rec = merge_nested(old, rec)
     with open(path, "w") as fh:
         json.dump(rec, fh, indent=2)
         fh.write("\n")
