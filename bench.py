@@ -1,10 +1,13 @@
 """Headline benchmark: lattice-site updates/sec/chip, Poisson 4096² red-black
 SOR (the BASELINE.json metric).
 
-Prints TWO JSON lines:
+Prints THREE JSON lines:
   {"metric": "lattice_site_updates_per_sec_per_chip_poisson4096_rbsor", ...}
   {"metric": "ns2d_dcavity4096_ms_per_step", "value": ms, "solve_ms": ...,
    "nonsolve_ms": ..., "phases": <dispatch>, ...}
+  {"metric": "ns2d_obstacle2048x512_ms_per_step", ...}  (PR 2: the fused
+   obstacle variant's decomposition; ragged/dist twins live in
+   tools/perf_ragged.py and tools/perf_obsdist.py)
 
 The second line is the metric the fused step-phase kernels move (round 6):
 the NS-2D north-star step time WITH its solve/non-solve decomposition, so
@@ -129,25 +132,18 @@ def _run_with_retry(backend: str):
         raise
 
 
-def _ns2d_step_line():
-    """NS-2D dcavity step time + solve/non-solve decomposition (the
-    north-star config at 4096² on TPU, a 256² twin off-TPU). The solve
-    share is measured by timing the step's OWN solve closure on the first
-    step's rhs; non-solve = step - solve, i.e. the phase chain the fused
-    kernels replace."""
+def _step_decomposition_line(param, metric, config, steps, reps):
+    """Chunk-timed NS-2D ms/step + the TPU-only solve/non-solve split —
+    the ONE protocol every bench step line uses (compile + warm with a
+    scalar-readback fence, best-of-reps; the solve share via
+    NS2DSolver.time_solve_ms, also what tools/northstar.py records —
+    no hand-copied phase wiring to silently diverge). `param` must carry
+    tpu_flat_solve=1 so every solve runs exactly itermax iterations and
+    the step - solve subtraction is well-defined."""
     from pampi_tpu.models.ns2d import NS2DSolver
     from pampi_tpu.utils import dispatch
-    from pampi_tpu.utils.params import Parameter as _P
 
-    on_tpu = jax.default_backend() == "tpu"
-    n = 4096 if on_tpu else 256
-    steps = 128 if on_tpu else 8
-    reps = 6 if on_tpu else 3
-    param = _P(
-        name="dcavity", imax=n, jmax=n, re=1000.0, te=1e9, tau=0.5,
-        itermax=100, eps=1e-3, omg=1.7, gamma=0.9, tpu_dtype="float32",
-        tpu_sor_inner=16, tpu_flat_solve=1, tpu_chunk=steps,
-    )
+    assert param.tpu_flat_solve, "decomposition needs the flat solve"
     s = NS2DSolver(param, dtype=jnp.float32)
     state = (s.u, s.v, s.p, jnp.asarray(0.0, jnp.float32),
              jnp.asarray(0, jnp.int32))
@@ -160,40 +156,71 @@ def _ns2d_step_line():
         float(out[3])
         best = min(best, time.perf_counter() - t0)
     step_ms = best / steps * 1e3
-
-    if not on_tpu:
+    line = {
+        "metric": metric,
+        "value": round(step_ms, 3),
+        "unit": "ms/step",
+        "phases": dispatch.last("ns2d_phases"),
+        "steps_timed": steps,
+        "config": config,
+    }
+    if jax.default_backend() != "tpu":
         # the decomposition is TPU-only: off-TPU the standalone jitted
         # solve compiles SLOWER than the same solve fused into the chunk
         # program (measured 91-120 vs 80 ms/step at 256² — XLA:CPU
         # whole-program optimization), so step - solve would go negative;
         # on TPU both are the same pallas kernel and the subtraction is
         # meaningful
-        return {
-            "metric": f"ns2d_dcavity{n}_ms_per_step",
-            "value": round(step_ms, 3),
-            "unit": "ms/step",
-            "solve_ms": None,
-            "nonsolve_ms": None,
-            "decomposition_note": "TPU-only (see bench.py)",
-            "phases": dispatch.last("ns2d_phases"),
-            "steps_timed": steps,
-            "config": f"dcavity {n}^2 f32 Re=1000 itermax=100 n_inner=16 flat",
-        }
-
-    # solve-only: the step's own solve closure on the first step's rhs —
-    # the shared protocol (NS2DSolver.time_solve_ms, also what
-    # tools/northstar.py records), no hand-copied phase wiring
+        return {**line, "solve_ms": None, "nonsolve_ms": None,
+                "decomposition_note": "TPU-only (see bench.py)"}
     solve_ms = s.time_solve_ms(reps=reps)
-    return {
-        "metric": f"ns2d_dcavity{n}_ms_per_step",
-        "value": round(step_ms, 3),
-        "unit": "ms/step",
-        "solve_ms": round(solve_ms, 3),
-        "nonsolve_ms": round(step_ms - solve_ms, 3),
-        "phases": dispatch.last("ns2d_phases"),
-        "steps_timed": steps,
-        "config": f"dcavity {n}^2 f32 Re=1000 itermax=100 n_inner=16 flat",
-    }
+    return {**line, "solve_ms": round(solve_ms, 3),
+            "nonsolve_ms": round(step_ms - solve_ms, 3)}
+
+
+def _ns2d_step_line():
+    """NS-2D dcavity step time + solve/non-solve decomposition (the
+    north-star config at 4096² on TPU, a 256² twin off-TPU)."""
+    from pampi_tpu.utils.params import Parameter as _P
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = 4096 if on_tpu else 256
+    steps = 128 if on_tpu else 8
+    param = _P(
+        name="dcavity", imax=n, jmax=n, re=1000.0, te=1e9, tau=0.5,
+        itermax=100, eps=1e-3, omg=1.7, gamma=0.9, tpu_dtype="float32",
+        tpu_sor_inner=16, tpu_flat_solve=1, tpu_chunk=steps,
+    )
+    return _step_decomposition_line(
+        param, f"ns2d_dcavity{n}_ms_per_step",
+        f"dcavity {n}^2 f32 Re=1000 itermax=100 n_inner=16 flat",
+        steps, 6 if on_tpu else 3,
+    )
+
+
+def _ns2d_obstacle_step_line():
+    """The obstacle twin of _ns2d_step_line (PR 2: obstacle flag fields now
+    ride the fused phase megakernels everywhere): flag-masked canal at the
+    BASELINE obsdist geometry (2048x512 on TPU, a 256x64 twin off-TPU)."""
+    from pampi_tpu.utils.params import Parameter as _P
+
+    on_tpu = jax.default_backend() == "tpu"
+    ni, nj = (2048, 512) if on_tpu else (256, 64)
+    steps = 64 if on_tpu else 8
+    param = _P(
+        name="canal_obstacle", imax=ni, jmax=nj,
+        xlength=16.0, ylength=4.0, re=100.0, te=1e9, tau=0.5,
+        itermax=100, eps=1e-3, omg=1.7, gamma=0.9, u_init=1.0,
+        bcLeft=3, bcRight=3, bcTop=1, bcBottom=1,
+        obstacles="6.0,1.5,10.0,2.5",
+        tpu_dtype="float32", tpu_solver="sor", tpu_sor_inner=16,
+        tpu_flat_solve=1, tpu_chunk=steps,
+    )
+    return _step_decomposition_line(
+        param, f"ns2d_obstacle{ni}x{nj}_ms_per_step",
+        f"canal_obstacle {ni}x{nj} f32 Re=100 itermax=100 n_inner=16 flat",
+        steps, 6 if on_tpu else 3,
+    )
 
 
 def main() -> None:
@@ -223,6 +250,11 @@ def main() -> None:
         print(json.dumps(_ns2d_step_line()), flush=True)
     except Exception as exc:  # the NS line must not sink the headline
         print(f"ns2d step line failed ({type(exc).__name__}: {exc})",
+              file=sys.stderr)
+    try:
+        print(json.dumps(_ns2d_obstacle_step_line()), flush=True)
+    except Exception as exc:
+        print(f"ns2d obstacle step line failed ({type(exc).__name__}: {exc})",
               file=sys.stderr)
 
 

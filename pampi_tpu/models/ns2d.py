@@ -253,14 +253,24 @@ class NS2DSolver:
         best-of-reps perf_counter."""
         import time
 
-        solve = jax.jit(self._make_solve(self._backend))
         *_, rhs, _dt = jax.jit(self._build_presolve())(self.u, self.v)
-        _p, res, _it = solve(self.p, rhs)
+        fold = getattr(self, "_folded_solve", None)
+        if fold is not None:
+            # the folded chunk runs its solve ENTIRELY in the padded layout
+            # (models/poisson.make_padded_solver_fn) — time that program,
+            # not the conversion-wrapped _make_solve the step no longer uses
+            solve_fn, pad = fold
+            solve = jax.jit(solve_fn)
+            p_in, rhs_in = pad(self.p), pad(rhs)
+        else:
+            solve = jax.jit(self._make_solve(self._backend))
+            p_in, rhs_in = self.p, rhs
+        _p, res, _it = solve(p_in, rhs_in)
         float(res)  # compile + warm-up; scalar readback is the fence
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
-            _p, res, _it = solve(self.p, rhs)
+            _p, res, _it = solve(p_in, rhs_in)
             float(res)
             best = min(best, time.perf_counter() - t0)
         return best * 1e3
@@ -325,6 +335,10 @@ class NS2DSolver:
         from ..ops.ns2d_fused import probe_fused_2d
         from ..utils.dispatch import record, resolve_fuse_phases
 
+        # reset BEFORE any early return: the pallas-retry rebuild
+        # (backend="jnp") exits at the gate below and must not leave a
+        # stale folded solve for time_solve_ms to time
+        self._folded_solve = None
         param = self.param
         if not resolve_fuse_phases(
             param, backend, self.dtype, probe_fused_2d, "ns2d_phases",
@@ -335,15 +349,87 @@ class NS2DSolver:
         dx, dy = self.dx, self.dy
         dtype = self.dtype
         masks = self.masks
-        try:
-            pre, post, pad, unpad, _h = nf.make_fused_step_2d(
+
+        # p-layout fold (the ROADMAP post-fusion knob): when the pressure
+        # solve resolves to the checkerboard tblock kernel, run it DIRECTLY
+        # on the fused kernels' padded layout — p and rhs stay padded across
+        # the whole chunk and the per-step layout passes around the solve
+        # (unpad rhs, re-pad rhs, pad/unpad p) vanish. The quarters layout
+        # keeps explicit conversions (its stacked data layout cannot be
+        # shared with the phase kernels; it remains the measured-best solve
+        # at 4096², so auto-even grids are untouched).
+        solve_pad = br_fold = None
+
+        def ckb_solve_home():
+            if param.tpu_sor_layout == "checkerboard":
+                return True
+            if param.tpu_sor_layout == "quarters":
+                return False
+            # auto: ask the solver's OWN layout resolution (including its
+            # quarters-VMEM-infeasible fallback to checkerboard) instead of
+            # re-deriving the policy here; called lazily, only when the
+            # other fold preconditions already hold (the probe builds a
+            # throwaway quarters kernel)
+            from .poisson import _try_quarters
+
+            return _try_quarters(
+                param.imax, param.jmax, dx, dy, param.omg, dtype,
+                param.tpu_sor_inner, "auto",
+            ) is None
+
+        from .poisson import _use_pallas
+
+        if (masks is None and param.tpu_solver == "sor"
+                and (param.tpu_fuse_phases == "on"
+                     or _use_pallas(backend, dtype))
+                and ckb_solve_home()):
+            from .poisson import make_padded_solver_fn
+
+            try:
+                solve_pad, br_fold, h_fold = make_padded_solver_fn(
+                    param.imax, param.jmax, dx, dy, param.omg, param.eps,
+                    param.itermax, dtype, n_inner=param.tpu_sor_inner,
+                    flat=bool(param.tpu_flat_solve),
+                )
+                if (br_fold, h_fold) != nf.fused_layout_2d(
+                        param.jmax, param.imax, dtype, block_rows=br_fold):
+                    solve_pad = br_fold = None  # halo mismatch: no shared layout
+            except ValueError:  # tblock unavailable/VMEM-infeasible
+                solve_pad = br_fold = None
+
+        def build_step(block_rows):
+            return nf.make_fused_step_2d(
                 param, param.jmax, param.imax, dx, dy, dtype,
                 fluid=None if masks is None else masks.fluid,
+                block_rows=block_rows,
             )
+
+        try:
+            pre, post, pad, unpad, _h = build_step(br_fold)
         except ValueError as exc:  # VMEM-infeasible geometry
-            record("ns2d_phases", f"jnp ({exc})")
-            return None
-        solve = self._make_solve(backend)
+            if br_fold is None:
+                record("ns2d_phases", f"jnp ({exc})")
+                return None
+            # the solve's block_rows didn't fit the phase kernels' larger
+            # VMEM budget: give up the fold, keep the fusion (PR 1 default
+            # geometry) rather than dropping the whole step to the jnp chain
+            solve_pad = br_fold = None
+            try:
+                pre, post, pad, unpad, _h = build_step(None)
+            except ValueError as exc2:
+                record("ns2d_phases", f"jnp ({exc2})")
+                return None
+        # recorded only now: the fold is live only if the phase kernels
+        # themselves built (a VMEM failure above falls back to the jnp
+        # chain, where no padded layout exists at all)
+        record("ns2d_p_layout",
+               "folded (solve shares the fused padded layout)"
+               if solve_pad is not None else "explicit pad/unpad")
+        solve = self._make_solve(backend) if solve_pad is None else solve_pad
+        if solve_pad is not None:
+            # time_solve_ms must time THIS padded-layout solve, not the
+            # conversion-wrapped _make_solve the folded step no longer runs
+            self._folded_solve = (solve_pad, pad)
         adaptive = param.tau > 0.0
         te = param.te
         chunk = param.tpu_chunk or self.CHUNK
@@ -357,17 +443,31 @@ class NS2DSolver:
         else:
             normalize = ops.normalize_pressure
 
+        folded = solve_pad is not None
+        if folded:
+            # normalize on the padded carry: the conversion pair runs only
+            # inside the every-100-steps cond branch
+            def norm_carry(q):
+                return pad(normalize(unpad(q)))
+        else:
+            norm_carry = normalize
+
         def step(up, vp, p, t, nt, umax, vmax):
+            # `p` is the padded carry when folded, the plain array otherwise
             if adaptive:
                 dt = ops.cfl_dt(umax, vmax, self.dt_bound, dx, dy, param.tau)
             else:
                 dt = jnp.asarray(param.dt, dtype)
             dt11 = jnp.full((1, 1), dt, dtype)
             up, vp, fp, gp, rhsp = pre(offs, dt11, up, vp)
-            rhs = unpad(rhsp)
-            p = lax.cond(nt % 100 == 0, normalize, lambda q: q, p)
-            p, _res, _it = solve(p, rhs)
-            up, vp, umax, vmax = post(offs, dt11, up, vp, fp, gp, pad(p))
+            p = lax.cond(nt % 100 == 0, norm_carry, lambda q: q, p)
+            if folded:
+                p, _res, _it = solve(p, rhsp)
+                p_post = p
+            else:
+                p, _res, _it = solve(p, unpad(rhsp))
+                p_post = pad(p)
+            up, vp, umax, vmax = post(offs, dt11, up, vp, fp, gp, p_post)
             t_next = t + dt.astype(time_dtype)
             if _flags.verbose():
                 jax.debug.print("TIME {} , TIMESTEP {}", t_next, dt)
@@ -375,6 +475,8 @@ class NS2DSolver:
 
         def chunk_fn(u, v, p, t, nt):
             up, vp = pad(u), pad(v)
+            if folded:
+                p = pad(p)
             umax = jnp.max(jnp.abs(u))
             vmax = jnp.max(jnp.abs(v))
 
@@ -392,7 +494,7 @@ class NS2DSolver:
                 cond, body,
                 (up, vp, p, t, nt, umax, vmax, jnp.asarray(0, jnp.int32)),
             )
-            return unpad(up), unpad(vp), p, t, nt
+            return unpad(up), unpad(vp), unpad(p) if folded else p, t, nt
 
         return chunk_fn
 

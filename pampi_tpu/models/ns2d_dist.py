@@ -445,15 +445,13 @@ class NS2DDistSolver:
         # on the plain extended block (adaptUV reads only center/+1).
         # dt stays the jnp reduction (the deep-exchanged block contains the
         # same global value set, so the ghost-inclusive max is unchanged).
-        # Ragged and obstacle decompositions keep the jnp chain (recorded).
+        # Ragged shards are the same kernels at uneven block bounds (global
+        # gating + the POST live-mask multiply); obstacle runs feed the
+        # per-shard global-constant flag slices at call time (fluid=True).
         from ..ops.ns2d_fused import FUSE_DEEP_HALO, probe_fused_2d
 
         fuse_why_not = None
-        if self.ragged:
-            fuse_why_not = "ragged decomposition (fused kernels pending)"
-        elif self.masks is not None:
-            fuse_why_not = "dist obstacle flags (fused kernels pending)"
-        elif min(jl, il) < FUSE_DEEP_HALO:
+        if min(jl, il) < FUSE_DEEP_HALO:
             fuse_why_not = f"shard extents < deep halo {FUSE_DEEP_HALO}"
         fused_k = None
         if _dispatch.resolve_fuse_phases(
@@ -466,11 +464,14 @@ class NS2DDistSolver:
                 pre_k, pad_deep, unpad_deep, _hk = nf.make_fused_pre_2d(
                     param, self.jmax, self.imax, dx, dy, dtype,
                     jl=jl, il=il, ext_pad=FUSE_DEEP_HALO - 1,
+                    fluid=True if self.masks is not None else None,
                     prof_dtype=idx_dtype,
                 )
                 post_k, pad_ext, unpad_ext, _hk2 = nf.make_fused_post_2d(
                     param, self.jmax, self.imax, dx, dy, dtype,
                     jl=jl, il=il,
+                    fluid=True if self.masks is not None else None,
+                    ragged=self.ragged,
                 )
                 fused_k = (pre_k, post_k)
                 pallas_q = True
@@ -516,6 +517,28 @@ class NS2DDistSolver:
             def local_masks():
                 # must run INSIDE the shard_map trace (mesh offsets)
                 return shard_masks(gmasks, jl, il, over_j, over_i)
+
+            def fused_flag_blocks():
+                """Per-shard deep-halo and extended slices of the global 0/1
+                fluid flag for the fused kernels (the shard_masks
+                global-constant-slice convention: overlapping slices agree
+                across shards), in the kernels' padded layouts. Beyond-global
+                deep-halo cells read flag 0 — their outputs are stripped or
+                interior-gated. Loop-invariant constant gathers: XLA hoists
+                them out of the chunk's while loop."""
+                H = FUSE_DEEP_HALO
+                joff = get_offsets("j", jl)
+                ioff = get_offsets("i", il)
+                fl = gmasks.fluid
+                wide = jnp.pad(
+                    fl, ((H - 1, over_j + H - 1), (H - 1, over_i + H - 1))
+                )
+                deep = lax.dynamic_slice(
+                    wide, (joff, ioff), (jl + 2 * H, il + 2 * H)
+                )
+                hi = jnp.pad(fl, ((0, over_j), (0, over_i)))
+                ext = lax.dynamic_slice(hi, (joff, ioff), (jl + 2, il + 2))
+                return pad_deep(deep), pad_ext(ext)
 
         def normalize_pressure(p):
             if gmasks is not None:
@@ -634,8 +657,13 @@ class NS2DDistSolver:
             ioff = get_offsets("i", il)
             offs = jnp.stack([joff, ioff]).astype(jnp.int32)
             dt11 = jnp.full((1, 1), dt, dtype)
+            pre_extra = post_extra = ()
+            if gmasks is not None:
+                flg_deep, flg_ext = fused_flag_blocks()
+                pre_extra = (flg_deep,)
+                post_extra = (flg_ext,)
             upd, vpd, fpd, gpd, rpd = pre_k(
-                offs, dt11, pad_deep(ud), pad_deep(vd)
+                offs, dt11, pad_deep(ud), pad_deep(vd), *pre_extra
             )
             u = strip_deep(unpad_deep(upd), H)
             v = strip_deep(unpad_deep(vpd), H)
@@ -646,7 +674,7 @@ class NS2DDistSolver:
             p, _res, _it = solve(p, rhs)
             up, vp, _um, _vm = post_k(
                 offs, dt11, pad_ext(u), pad_ext(v), pad_ext(f), pad_ext(g),
-                pad_ext(p),
+                pad_ext(p), *post_extra,
             )
             u = unpad_ext(up)
             v = unpad_ext(vp)

@@ -386,18 +386,14 @@ class NS3DSolver:
         u/v/w in the padded layout plus the running (umax, vmax, wmax),
         and the timestep is scalar math (ops/ns3d.cfl_dt_3d). None when the
         fused path is not dispatched — the caller falls back to the jnp
-        chunk. 3-D obstacle flag fields keep the jnp chain."""
+        chunk. Obstacle flag fields compose in-kernel (the 2-D template):
+        the global flag rides as a baked padded constant."""
         from ..ops.ns3d_fused import probe_fused_3d
         from ..utils.dispatch import record, resolve_fuse_phases
 
         param = self.param
-        why_not = (
-            "3-D obstacle flags (fused kernels are 2-D-only for flags)"
-            if self.masks is not None else None
-        )
         if not resolve_fuse_phases(
             param, backend, self.dtype, probe_fused_3d, "ns3d_phases",
-            why_not=why_not,
         ):
             return None
         from ..ops import ns3d_fused as nf3
@@ -408,6 +404,7 @@ class NS3DSolver:
         try:
             pre, post, pad3, unpad3, _h = nf3.make_fused_step_3d(
                 param, g.kmax, g.jmax, g.imax, dx, dy, dz, dtype,
+                fluid=None if self.masks is None else self.masks.fluid,
             )
         except ValueError as exc:  # VMEM-infeasible geometry
             record("ns3d_phases", f"jnp ({exc})")

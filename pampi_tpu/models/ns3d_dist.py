@@ -387,15 +387,14 @@ class NS3DDistSolver:
         # non-solve phases collapse into two global-coordinate-gated Pallas
         # kernels around the solve (PRE on the depth-H deep-halo block, POST
         # on the plain extended block) — the 3-D twin of the NS-2D wiring
-        # (models/ns2d_dist.py). Ragged and obstacle runs keep the jnp chain.
+        # (models/ns2d_dist.py). Ragged shards are the same kernels at
+        # uneven block bounds (global gating + the POST live-mask multiply);
+        # obstacle runs feed the per-shard global-constant flag slices at
+        # call time (fluid=True).
         from ..ops.ns3d_fused import FUSE_DEEP_HALO, probe_fused_3d
 
         fuse_why_not = None
-        if self.ragged:
-            fuse_why_not = "ragged decomposition (fused kernels pending)"
-        elif self.masks is not None:
-            fuse_why_not = "dist obstacle flags (fused kernels pending)"
-        elif min(kl, jl, il) < FUSE_DEEP_HALO:
+        if min(kl, jl, il) < FUSE_DEEP_HALO:
             fuse_why_not = f"shard extents < deep halo {FUSE_DEEP_HALO}"
         fused_k = None
         if _dispatch.resolve_fuse_phases(
@@ -408,10 +407,13 @@ class NS3DDistSolver:
                 pre_k, pad_deep, unpad_deep, _hk = nf3.make_fused_pre_3d(
                     param, g.kmax, g.jmax, g.imax, dx, dy, dz, dtype,
                     kl=kl, jl=jl, il=il, ext_pad=FUSE_DEEP_HALO - 1,
+                    fluid=True if self.masks is not None else None,
                 )
                 post_k, pad_ext, unpad_ext, _hk2 = nf3.make_fused_post_3d(
                     param, g.kmax, g.jmax, g.imax, dx, dy, dz, dtype,
                     kl=kl, jl=jl, il=il,
+                    fluid=True if self.masks is not None else None,
+                    ragged=self.ragged,
                 )
                 fused_k = (pre_k, post_k)
                 pallas_o = True
@@ -440,6 +442,32 @@ class NS3DDistSolver:
                 # must run INSIDE the shard_map trace (mesh offsets)
                 return shard_masks_3d(gmasks, kl, jl, il,
                                       over_k, over_j, over_i)
+
+            def fused_flag_blocks():
+                """Per-shard deep-halo and extended slices of the global 0/1
+                fluid flag for the fused kernels (the shard_masks_3d
+                global-constant-slice convention), in the kernels' padded
+                layouts — see models/ns2d_dist.py's twin for the invariants."""
+                from ..parallel.comm import get_offsets
+
+                H = FUSE_DEEP_HALO
+                koff = get_offsets("k", kl)
+                joff = get_offsets("j", jl)
+                ioff = get_offsets("i", il)
+                fl = gmasks.fluid
+                wide = jnp.pad(fl, (
+                    (H - 1, over_k + H - 1), (H - 1, over_j + H - 1),
+                    (H - 1, over_i + H - 1),
+                ))
+                deep = lax.dynamic_slice(
+                    wide, (koff, joff, ioff),
+                    (kl + 2 * H, jl + 2 * H, il + 2 * H),
+                )
+                hi = jnp.pad(fl, ((0, over_k), (0, over_j), (0, over_i)))
+                ext = lax.dynamic_slice(
+                    hi, (koff, joff, ioff), (kl + 2, jl + 2, il + 2)
+                )
+                return pad_deep(deep), pad_ext(ext)
 
         def compute_dt(u, v, w):
             umax = reduction(jnp.max(jnp.abs(u)), comm, "max")
@@ -546,8 +574,14 @@ class NS3DDistSolver:
                 get_offsets("i", il),
             ]).astype(jnp.int32)
             dt11 = jnp.full((1, 1), dt, dtype)
+            pre_extra = post_extra = ()
+            if gmasks is not None:
+                flg_deep, flg_ext = fused_flag_blocks()
+                pre_extra = (flg_deep,)
+                post_extra = (flg_ext,)
             upd, vpd, wpd, fpd, gpd, hpd, rpd = pre_k(
-                offs, dt11, pad_deep(ud), pad_deep(vd), pad_deep(wd)
+                offs, dt11, pad_deep(ud), pad_deep(vd), pad_deep(wd),
+                *pre_extra,
             )
             u = strip_deep(unpad_deep(upd), H)
             v = strip_deep(unpad_deep(vpd), H)
@@ -560,6 +594,7 @@ class NS3DDistSolver:
             up, vp, wp, _um, _vm, _wm = post_k(
                 offs, dt11, pad_ext(u), pad_ext(v), pad_ext(w),
                 pad_ext(f), pad_ext(g_), pad_ext(h), pad_ext(p),
+                *post_extra,
             )
             u = unpad_ext(up)
             v = unpad_ext(vp)

@@ -72,6 +72,38 @@ def _use_pallas(backend: str, dtype=jnp.float32, probe=None) -> bool:
     return probe()
 
 
+def _try_quarters(imax, jmax, dx, dy, omega, dtype, n_inner, layout):
+    """The quarters-layout resolution of make_rb_loop, factored out so the
+    p-layout fold (models/ns2d) asks the solver's OWN decision instead of
+    re-deriving the policy by hand: the built (rb_iter, brq, h) when the
+    pallas solve smooths on the stacked quarters layout, None when
+    checkerboard is the solve home (layout forced to checkerboard, odd
+    dims under auto, or quarters construction VMEM-infeasible). A forced
+    layout="quarters" propagates construction errors."""
+    if layout not in ("auto", "quarters"):
+        return None
+    even = imax % 2 == 0 and jmax % 2 == 0
+    if layout == "quarters" and not even:
+        raise ValueError("quarters layout needs even imax and jmax")
+    if not even:
+        return None
+    from ..ops import sor_pallas as sp
+
+    # construction raises on pre-checked conditions (odd dims, f64)
+    # and on VMEM infeasibility (quarters_feasible): forced layout
+    # propagates the error, auto falls back to checkerboard; runtime
+    # kernel failures surface at first dispatch and are handled by
+    # the callers' jnp fallback
+    try:
+        return sp.make_rb_iter_tblock_quarters(
+            imax, jmax, dx, dy, omega, dtype, n_inner=n_inner
+        )
+    except ValueError:
+        if layout == "quarters":
+            raise
+        return None
+
+
 def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
                  n_inner: int = 1, layout: str = "auto"):
     """Public dispatcher for loop-carried use: returns
@@ -100,44 +132,28 @@ def make_rb_loop(imax, jmax, dx, dy, omega, dtype, backend: str = "auto",
             f"{layout!r} (octants is the 3-D layout)"
         )
     if _use_pallas(backend, dtype):
-        want_q = layout in ("auto", "quarters")
-        even = imax % 2 == 0 and jmax % 2 == 0
-        if layout == "quarters" and not even:
-            raise ValueError("quarters layout needs even imax and jmax")
-        if want_q and even:
-            from ..ops import sor_pallas as sp
+        from ..ops import sor_pallas as sp
 
-            # construction raises on pre-checked conditions (odd dims, f64)
-            # and on VMEM infeasibility (quarters_feasible): forced layout
-            # propagates the error, auto falls back to checkerboard; runtime
-            # kernel failures surface at first dispatch and are handled by
-            # the callers' jnp fallback
-            try:
-                rb_iter, brq, h = sp.make_rb_iter_tblock_quarters(
-                    imax, jmax, dx, dy, omega, dtype, n_inner=n_inner
-                )
-            except ValueError:
-                if layout == "quarters":
-                    raise
-                rb_iter = None
-            if rb_iter is not None:
-                norm = float(imax * jmax)
+        q = _try_quarters(imax, jmax, dx, dy, omega, dtype, n_inner, layout)
+        if q is not None:
+            rb_iter, brq, h = q
+            norm = float(imax * jmax)
 
-                def step(p_stacked, rhs_stacked):
-                    p_stacked, rsq = rb_iter(p_stacked, rhs_stacked)
-                    # bf16 storage accumulates the residual in f32 — keep
-                    # it there: the convergence scalar must not be
-                    # re-quantized to 8 mantissa bits on its way to the
-                    # res >= eps² check (the loop carries res at >= f32)
-                    return p_stacked, rsq / norm
+            def step(p_stacked, rhs_stacked):
+                p_stacked, rsq = rb_iter(p_stacked, rhs_stacked)
+                # bf16 storage accumulates the residual in f32 — keep
+                # it there: the convergence scalar must not be
+                # re-quantized to 8 mantissa bits on its way to the
+                # res >= eps² check (the loop carries res at >= f32)
+                return p_stacked, rsq / norm
 
-                def prep(x):
-                    return sp.pad_quarters(x, brq, h)
+            def prep(x):
+                return sp.pad_quarters(x, brq, h)
 
-                def post(xq):
-                    return sp.unpad_quarters(xq, jmax, imax, h)
+            def post(xq):
+                return sp.unpad_quarters(xq, jmax, imax, h)
 
-                return step, prep, post, n_inner
+            return step, prep, post, n_inner
         kernel = "tblock" if n_inner > 1 else "fused"
         try:
             step, prep, post = make_rb_step_padded(
@@ -283,6 +299,61 @@ def make_rba_step(imax, jmax, dx, dy, omega, dtype):
     factor = omega * (0.5 * (dx2 * dy2) / (dx2 + dy2))
     return make_rb_step(imax, jmax, dx, dy, omega, dtype, backend="jnp",
                         factor=factor)
+
+
+def make_padded_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
+                          n_inner: int = 1, block_rows: int | None = None,
+                          interpret: bool | None = None, flat: bool = False):
+    """The rb convergence loop operating ENTIRELY in the sor_pallas padded
+    layout: (p_pad, rhs_pad) -> (p_pad', res, it), no layout conversion
+    inside. This is the p-layout fold of the fused NS-2D step
+    (models/ns2d._build_fused_chunk): when the fused phase kernels share
+    the solve's (block_rows, halo) geometry, the per-step pad/unpad passes
+    around the solve vanish — p and rhs stay padded across the whole chunk.
+    Input halo/tail rows may be UNDEFINED (the fused PRE never stores
+    them): the tblock kernel consumes p/rhs only at
+    logical-coordinate-gated cells (jnp.where selects, not multiplies), so
+    garbage there cannot reach any stored value or the residual.
+
+    Built on the checkerboard tblock kernel (the quarters layout is a
+    different stacked data layout the fused kernels cannot share); raises
+    ValueError when that kernel is unavailable or VMEM-infeasible. Same
+    n_inner/flat contracts as make_solver_fn. Returns
+    (solve, block_rows, halo)."""
+    from ..ops import sor_pallas as sp
+
+    eff = max(1, n_inner)
+    rb_iter, block_rows, halo = sp.make_rb_iter_tblock(
+        imax, jmax, dx, dy, omega, dtype, n_inner=eff,
+        block_rows=block_rows, interpret=interpret,
+    )
+    if rb_iter is None:
+        raise ValueError("pallas backend unavailable")
+    norm = float(imax * jmax)
+    epssq = eps * eps
+    res_dtype = jnp.promote_types(dtype, jnp.float32)
+
+    def solve(p_pad, rhs_pad):
+        def cond(carry):
+            _, res, it = carry
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(carry):
+            p, _, it = carry
+            p, rsq = rb_iter(p, rhs_pad)
+            res = (rsq / norm).astype(res_dtype)
+            if _flags.debug():
+                jax.debug.print("{} Residuum: {}", it + (eff - 1), res)
+            return p, res, it + eff
+
+        init = (p_pad, jnp.asarray(1.0, res_dtype),
+                jnp.asarray(0, jnp.int32))
+        if flat:
+            trips = -(-itermax // eff)
+            return jax.lax.fori_loop(0, trips, lambda _t, c: body(c), init)
+        return jax.lax.while_loop(cond, body, init)
+
+    return solve, block_rows, halo
 
 
 def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,

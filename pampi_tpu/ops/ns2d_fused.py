@@ -46,13 +46,24 @@ H = FUSE_DEEP_HALO: cell (a, b) holds global extended index
 (joff + a - H + 1, ...) — the stencil2d embed_deep convention) after one
 depth-H exchange per step.
 
-Obstacle flag fields compose branch-free (single-device): the padded 0/1
-fluid flag rides as a third input window and u_face/v_face are derived
-in-kernel from it (integer-exact, matching ops/obstacle.make_masks
-including the ghost-column wrap fix), so the obstacle velocity BC, F/G
-face mask and projection face mask are the same flag-multiply forms the
-jnp path uses. Distributed obstacle/ragged runs keep the jnp chain (the
-models record the decision).
+Obstacle flag fields compose branch-free: the padded 0/1 fluid flag rides
+as a third input window and u_face/v_face are derived in-kernel from it
+(integer-exact, matching ops/obstacle.make_masks including the
+ghost-column wrap fix), so the obstacle velocity BC, F/G face mask and
+projection face mask are the same flag-multiply forms the jnp path uses.
+Single-device callers bake the global flag in as a padded constant
+(`fluid=<array>`); distributed callers pass `fluid=True` and feed the
+per-shard deep-halo slice of the global flag at call time (the
+ops/sor_obsdist global-constant-slice convention — sliced blocks agree
+wherever shards overlap, so redundant halo recompute stays consistent).
+
+Ragged (pad-with-mask) shards are the SAME kernels at uneven block
+bounds: every write is already global-coordinate-gated (hi walls sit
+anywhere inside a trailing shard, exactly parallel/ragged2d.py's masked
+forms), and POST(ragged=True) appends the live-mask multiply that zeroes
+dead cells after the projection — the one extra op the jnp ragged chain
+does (live_masks) so pad-cell garbage never reaches the ghost-inclusive
+CFL scan.
 """
 
 from __future__ import annotations
@@ -371,6 +382,7 @@ def _post_kernel(
     dx: float,
     dy: float,
     masked: bool,
+    ragged: bool,
 ):
     """adaptUV + the CFL max|u|/max|v| reduction. u/v/f/g ride as owned
     bands (adaptUV reads them at the center only); p (and the flag, whose
@@ -475,6 +487,13 @@ def _post_kernel(
         va = va * v_face
     u = jnp.where(interior, ua, u)
     v = jnp.where(interior, va, v)
+    if ragged:
+        # the jnp ragged chain's live-mask MULTIPLY (ragged2d.live_masks),
+        # op-for-op: dead pad cells go to zero after the projection so the
+        # next step's ghost-inclusive CFL scan never sees garbage
+        live = ((gj <= gjmax + 1) & (gi <= gimax + 1)).astype(u.dtype)
+        u = u * live
+        v = v * live
 
     @pl.when(b >= 2)
     def _():
@@ -536,7 +555,10 @@ def _layout(ext_rows: int, ext_cols: int, dtype, block_rows):
 
 def _geom(param, gjmax, gimax, dtype, jl, il, ext_pad, fluid, prof_dtype,
           block_rows, interpret):
-    """Shared geometry/feasibility resolution for the pre/post builders."""
+    """Shared geometry/feasibility resolution for the pre/post builders.
+    `fluid` is None (no obstacles), a global (jmax+2, imax+2) 0/1 array
+    (single-device: baked in as a padded constant), or True (distributed:
+    the per-shard flag block is an extra call-time argument)."""
     if pltpu is None:
         raise ValueError("pallas TPU backend unavailable")
     if interpret is None:
@@ -550,8 +572,6 @@ def _geom(param, gjmax, gimax, dtype, jl, il, ext_pad, fluid, prof_dtype,
                                              block_rows)
     itemsize = jnp.dtype(dtype).itemsize
     masked = fluid is not None
-    if masked and ext_pad:
-        raise ValueError("obstacle fused phases are single-device only")
     if not fused_feasible(block_rows, h, wp, itemsize, masked):
         raise ValueError(
             f"fused step-phase scratch {fused_vmem_bytes(block_rows, h, wp, itemsize, masked) >> 20} MiB "
@@ -568,12 +588,21 @@ def _geom(param, gjmax, gimax, dtype, jl, il, ext_pad, fluid, prof_dtype,
         return unpad_array(xp, ext_rows - 2, ext_cols - 2, h)
 
     flg_padded = None
-    if masked:
+    if masked and fluid is not True:
         import numpy as np
 
         flg_padded = _pad(jnp.asarray(np.asarray(fluid), dtype))
     return (interpret, ljmax, limax, h, block_rows, wp, nblocks, rp,
             masked, prof_dtype, _pad, _unpad, flg_padded)
+
+
+def fused_layout_2d(jmax: int, imax: int, dtype, block_rows=None):
+    """(block_rows, halo) of the single-device fused padded layout — what
+    make_fused_step_2d resolves to. Callers that want the pressure solve on
+    the SAME layout (the p-layout fold, models/ns2d) read it here and pass
+    block_rows to both builders."""
+    h, br, _wp, _nb, _rp = _layout(jmax + 2, imax + 2, dtype, block_rows)
+    return br, h
 
 
 def make_fused_pre_2d(
@@ -597,8 +626,11 @@ def make_fused_pre_2d(
     plus (pad, unpad, halo) for its layout. Single-device: jl/il omitted,
     ext_pad 0, offsets zeros. Distributed: jl/il are the shard's interior
     extents, ext_pad = FUSE_DEEP_HALO - 1, arrays are the padded deep-halo
-    blocks. Raises ValueError on VMEM infeasibility — the caller's contract
-    is to fall back to the jnp chain."""
+    blocks. fluid=True (distributed obstacles) appends a call-time flag
+    argument: pre(offs, dt11, u_pad, v_pad, flg_pad), flg_pad the padded
+    per-shard deep-halo slice of the global flag. Raises ValueError on
+    VMEM infeasibility — the caller's contract is to fall back to the jnp
+    chain."""
     (interpret, ljmax, limax, h, block_rows, wp, nblocks, rp, masked,
      prof_dtype, _pad, _unpad, flg_padded) = _geom(
         param, gjmax, gimax, dtype, jl, il, ext_pad, fluid, prof_dtype,
@@ -656,7 +688,11 @@ def make_fused_pre_2d(
         interpret=interpret,
     )
 
-    if masked:
+    if masked and flg_padded is None:
+
+        def pre(offs, dt11, u_pad, v_pad, flg_pad):
+            return pre_call(offs, dt11, u_pad, v_pad, flg_pad)
+    elif masked:
 
         def pre(offs, dt11, u_pad, v_pad):
             return pre_call(offs, dt11, u_pad, v_pad, flg_padded)
@@ -680,6 +716,7 @@ def make_fused_post_2d(
     il: int | None = None,
     ext_pad: int = 0,
     fluid=None,
+    ragged: bool = False,
     block_rows: int | None = None,
     interpret: bool | None = None,
 ):
@@ -688,7 +725,9 @@ def make_fused_post_2d(
           -> (u'', v'', umax, vmax)                     [padded + scalars]
     Distributed callers build it on the PLAIN extended block (ext_pad 0):
     adaptUV reads only center/+1 values, all inside the exchanged halo-1
-    ring."""
+    ring. fluid=True appends a call-time flag argument (the padded
+    per-shard EXTENDED-block slice of the global flag); ragged=True
+    appends the dead-cell live-mask multiply after the projection."""
     (interpret, ljmax, limax, h, block_rows, wp, nblocks, rp, masked,
      _prof_dtype, _pad, _unpad, flg_padded) = _geom(
         param, gjmax, gimax, dtype, jl, il, ext_pad, fluid, None,
@@ -706,6 +745,7 @@ def make_fused_post_2d(
         dx=dx,
         dy=dy,
         masked=masked,
+        ragged=ragged,
     )
     n_in_post = 6 if masked else 5
     post_scratch = [
@@ -739,7 +779,14 @@ def make_fused_post_2d(
         interpret=interpret,
     )
 
-    if masked:
+    if masked and flg_padded is None:
+
+        def post(offs, dt11, u_pad, v_pad, f_pad, g_pad, p_pad, flg_pad):
+            u_pad, v_pad, um, vm = post_call(
+                offs, dt11, u_pad, v_pad, f_pad, g_pad, p_pad, flg_pad
+            )
+            return u_pad, v_pad, um[0, 0], vm[0, 0]
+    elif masked:
 
         def post(offs, dt11, u_pad, v_pad, f_pad, g_pad, p_pad):
             u_pad, v_pad, um, vm = post_call(

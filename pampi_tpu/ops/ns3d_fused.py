@@ -19,8 +19,20 @@ alignment rounding), full padded (jp, ip) planes per k-slice
 boundary. All writes are gated by GLOBAL coordinates (offsets via scalar
 prefetch), so the same kernels serve the single-device solver (offsets 0)
 and the distributed twin (per-shard deep-halo blocks, depth FUSE_DEEP_HALO
-exchange per step). Obstacle flag fields keep the jnp chain in 3-D (the
-models record the decision) — the 2-D module is the flag-composition home.
+exchange per step).
+
+Obstacle flag fields compose branch-free exactly like the 2-D module: the
+padded 0/1 fluid flag rides as a fourth input window and
+u_face/v_face/w_face are derived in-kernel (integer-exact parity with
+ops/obstacle3d.make_masks_3d including the ghost-plane wrap fixes), so the
+3-D obstacle velocity BC (priority-ordered tangential mirrors), the F/G/H
+face masks and the projection face masks are the same flag-multiply forms
+the jnp path uses. Single-device callers bake the flag as a padded
+constant (`fluid=<array>`); distributed callers pass `fluid=True` and
+feed the per-shard global-constant slice at call time. Ragged shards are
+the same kernels at uneven block bounds (global gating), with
+POST(ragged=True) appending the live-mask multiply of the jnp ragged
+chain (parallel/ragged3d.live_masks_3d).
 """
 
 from __future__ import annotations
@@ -133,10 +145,67 @@ def apply_special_bc_3d(u, gk, gj, gi, problem, gkmax, gjmax, gimax):
     return u
 
 
+def _obstacle_faces_3d(fl, gk, gj, gi, gkmax, gjmax, gimax, sh=_win_shift):
+    """u/v/w_face derived from the 0/1 fluid flag window — integer-exact
+    parity with ops/obstacle3d.make_masks_3d (incl. its ghost-plane
+    wrap-fixes: the last global ghost column/row/plane is forced to a
+    face). `sh` is the window's neighbour-shift contract."""
+    one = jnp.ones((), fl.dtype)
+    u_face = jnp.where(gi == gimax + 1, one, fl * sh(fl, 0, 0, 1))
+    v_face = jnp.where(gj == gjmax + 1, one, fl * sh(fl, 0, 1, 0))
+    w_face = jnp.where(gk == gkmax + 1, one, fl * sh(fl, 1, 0, 0))
+    return u_face, v_face, w_face
+
+
+def apply_obstacle_velocity_bc_3d_window(u, v, w, fl, u_face, v_face,
+                                         w_face, sh=_win_shift):
+    """ops/obstacle3d.apply_obstacle_velocity_bc_3d transcribed on the
+    window: zero normal components on faces touching an obstacle, then the
+    priority-ordered first-hit tangential mirror (`_mirror`) with `sh` as
+    the neighbour read. Every wrapped read the full-array form relies on is
+    multiplied by zero at the cells that could see window wrap (the ghost
+    shell is always fluid), as in the 2-D transcription."""
+    one = jnp.ones((), u.dtype)
+    u = u * u_face
+    v = v * v_face
+    w = w * w_face
+
+    def mirror(comp, both_obs, faces_and_vals):
+        acc = jnp.zeros_like(comp)
+        remaining = jnp.ones_like(comp)
+        for fm, val in faces_and_vals:
+            acc = acc + remaining * fm * (-val)
+            remaining = remaining * (one - fm)
+        return comp + both_obs * acc
+
+    both_u = (one - fl) * (one - sh(fl, 0, 0, 1))
+    u = mirror(u, both_u, [
+        (sh(u_face, 0, 1, 0), sh(u, 0, 1, 0)),     # north (j+1)
+        (sh(u_face, 0, -1, 0), sh(u, 0, -1, 0)),   # south (j-1)
+        (sh(u_face, 1, 0, 0), sh(u, 1, 0, 0)),     # back  (k+1)
+        (sh(u_face, -1, 0, 0), sh(u, -1, 0, 0)),   # front (k-1)
+    ])
+    both_v = (one - fl) * (one - sh(fl, 0, 1, 0))
+    v = mirror(v, both_v, [
+        (sh(v_face, 0, 0, 1), sh(v, 0, 0, 1)),     # east  (i+1)
+        (sh(v_face, 0, 0, -1), sh(v, 0, 0, -1)),   # west  (i-1)
+        (sh(v_face, 1, 0, 0), sh(v, 1, 0, 0)),     # back
+        (sh(v_face, -1, 0, 0), sh(v, -1, 0, 0)),   # front
+    ])
+    both_w = (one - fl) * (one - sh(fl, 1, 0, 0))
+    w = mirror(w, both_w, [
+        (sh(w_face, 0, 0, 1), sh(w, 0, 0, 1)),     # east
+        (sh(w_face, 0, 0, -1), sh(w, 0, 0, -1)),   # west
+        (sh(w_face, 0, 1, 0), sh(w, 0, 1, 0)),     # north
+        (sh(w_face, 0, -1, 0), sh(w, 0, -1, 0)),   # south
+    ])
+    return u, v, w
+
+
 def _pre3_kernel(
     sref,    # SMEM scalar prefetch: int32[3] = (koff, joff, ioff)
     dt_ref,  # SMEM (1, 1)
-    *refs,   # [u, v, w] + [u', v', w', f, g, h, rhs] + scratch
+    *refs,   # [u, v, w(, flg)] + [u', v', w', f, g, h, rhs] + scratch
     block_k: int,
     nblocks: int,
     gkmax: int,
@@ -157,9 +226,15 @@ def _pre3_kernel(
     dx: float,
     dy: float,
     dz: float,
+    masked: bool,
 ):
-    (u_in, v_in, w_in, u_out, v_out, w_out, f_out, g_out, h_out, r_out,
-     uw2, vw2, ww2, ob2, ld_sem, st_sem) = refs
+    if masked:
+        (u_in, v_in, w_in, flg, u_out, v_out, w_out, f_out, g_out, h_out,
+         r_out, uw2, vw2, ww2, fw2, ob2, ld_sem, st_sem) = refs
+    else:
+        (u_in, v_in, w_in, u_out, v_out, w_out, f_out, g_out, h_out, r_out,
+         uw2, vw2, ww2, ob2, ld_sem, st_sem) = refs
+        flg = fw2 = None
     b = pl.program_id(0)
     bk = block_k
     h = halo
@@ -171,12 +246,14 @@ def _pre3_kernel(
     dt = dt_ref[0, 0]
 
     def load(k, s):
+        ins = [(u_in, uw2), (v_in, vw2), (w_in, ww2)]
+        if masked:
+            ins.append((flg, fw2))
         return [
             pltpu.make_async_copy(
                 arr.at[pl.ds(k * bk, bk + 2 * h)], win.at[s],
                 ld_sem.at[s, q])
-            for q, (arr, win) in enumerate(
-                ((u_in, uw2), (v_in, vw2), (w_in, ww2)))
+            for q, (arr, win) in enumerate(ins)
         ]
 
     def store(k, s):
@@ -232,6 +309,14 @@ def _pre3_kernel(
         u, v, w, gk, gj, gi, dict(bcs), gkmax, gjmax, gimax
     )
     u = apply_special_bc_3d(u, gk, gj, gi, problem, gkmax, gjmax, gimax)
+    if masked:
+        fl = fw2[slot]
+        u_face, v_face, w_face = _obstacle_faces_3d(
+            fl, gk, gj, gi, gkmax, gjmax, gimax
+        )
+        u, v, w = apply_obstacle_velocity_bc_3d_window(
+            u, v, w, fl, u_face, v_face, w_face
+        )
 
     f_full, g_full, h_full = ops3.fgh_predictor_terms(
         u, v, w, dt, re, gx, gy, gz, gamma, dx, dy, dz, sh=_win_shift
@@ -252,6 +337,12 @@ def _pre3_kernel(
     f = jnp.where(((gi == 0) | (gi == gimax)) & tan_k & tan_j, u, f)
     g = jnp.where(((gj == 0) | (gj == gjmax)) & tan_k & tan_i, v, g)
     hh = jnp.where(((gk == 0) | (gk == gkmax)) & tan_j & tan_i, w, hh)
+    if masked:
+        # F/G/H carry U/V/W on non-fluid faces (obstacle3d.mask_fgh)
+        one = jnp.ones((), u.dtype)
+        f = u_face * f + (one - u_face) * u
+        g = v_face * g + (one - v_face) * v
+        hh = w_face * hh + (one - w_face) * w
 
     local_int = (
         (a_k >= ext_pad + 1) & (a_k <= ext_pad + lkmax)
@@ -286,7 +377,7 @@ def _pre3_kernel(
 def _post3_kernel(
     sref,    # SMEM scalar prefetch: int32[3]
     dt_ref,  # SMEM (1, 1)
-    *refs,   # [u, v, w, f, g, h, p] + [u', v', w', umax, vmax, wmax] + scratch
+    *refs,   # [u, v, w, f, g, h, p(, flg)] + [u', v', w', umax, vmax, wmax] + scratch
     block_k: int,
     nblocks: int,
     gkmax: int,
@@ -297,10 +388,18 @@ def _post3_kernel(
     dx: float,
     dy: float,
     dz: float,
+    masked: bool,
+    ragged: bool,
 ):
-    (ub, vb, wb, fb, gb, hb, p_in,
-     u_out, v_out, w_out, umax, vmax, wmax,
-     bw2, pw2, ob2, macc, ld_sem, st_sem) = refs
+    if masked:
+        (ub, vb, wb, fb, gb, hb, p_in, flg,
+         u_out, v_out, w_out, umax, vmax, wmax,
+         bw2, pw2, fw2, ob2, macc, ld_sem, st_sem) = refs
+    else:
+        (ub, vb, wb, fb, gb, hb, p_in,
+         u_out, v_out, w_out, umax, vmax, wmax,
+         bw2, pw2, ob2, macc, ld_sem, st_sem) = refs
+        flg = fw2 = None
     b = pl.program_id(0)
     bk = block_k
     h = halo
@@ -320,6 +419,10 @@ def _post3_kernel(
         ]
         copies.append(pltpu.make_async_copy(
             p_in.at[pl.ds(k * bk, bk + 2 * h)], pw2.at[s], ld_sem.at[s, 6]))
+        if masked:
+            copies.append(pltpu.make_async_copy(
+                flg.at[pl.ds(k * bk, bk + 2 * h)], fw2.at[s],
+                ld_sem.at[s, 7]))
         return copies
 
     def store(k, s):
@@ -373,9 +476,36 @@ def _post3_kernel(
     )
 
     ua, va, wa = ops3.adapt_terms_3d(f, g, hh, pc, dt, dx, dy, dz, sh=sh_p)
+    if masked:
+        # projection restricted to fluid-fluid faces (adapt_uvw_obstacle):
+        # faces derived from the flag window, the +k shift served from the
+        # halo plane above the owned band (the sh_p contract)
+        flw = fw2[slot]
+        flc = flw[h: h + bk]
+
+        def sh_f(x, dk=0, dj=0, di=0):
+            if dk:
+                return flw[h + dk: h + bk + dk]
+            return _win_shift(x, 0, dj, di)
+
+        u_face, v_face, w_face = _obstacle_faces_3d(
+            flc, gk, gj, gi, gkmax, gjmax, gimax, sh=sh_f
+        )
+        ua = ua * u_face
+        va = va * v_face
+        wa = wa * w_face
     u = jnp.where(interior, ua, u)
     v = jnp.where(interior, va, v)
     w = jnp.where(interior, wa, w)
+    if ragged:
+        # the jnp ragged chain's live-mask multiply (ragged3d.live_masks_3d)
+        # op-for-op: dead pad cells go to zero after the projection so the
+        # ghost-inclusive CFL scan never sees garbage
+        live = ((gk <= gkmax + 1) & (gj <= gjmax + 1)
+                & (gi <= gimax + 1)).astype(u.dtype)
+        u = u * live
+        v = v * live
+        w = w * live
 
     @pl.when(b >= 2)
     def _():
@@ -412,30 +542,38 @@ def _post3_kernel(
                 c.wait()
 
 
-def fused3_vmem_bytes(bk: int, h: int, jp: int, ip: int,
-                      itemsize: int) -> int:
-    """Scratch bytes of the larger kernel (pre: 3 windows + 7 out bands;
-    post: 6 in bands + 1 window + 3 out bands), double buffered, plus the
-    per-lane max accumulator."""
+def fused3_vmem_bytes(bk: int, h: int, jp: int, ip: int, itemsize: int,
+                      masked: bool = False) -> int:
+    """Scratch bytes of the larger kernel (pre: 3-4 windows + 7 out bands;
+    post: 6 in bands + 1-2 windows + 3 out bands), double buffered, plus
+    the per-lane max accumulator."""
     plane = jp * ip
     win = (bk + 2 * h) * plane
     band = bk * plane
-    pre = 2 * (3 * win + 7 * band)
-    post = 2 * (6 * band + win + 3 * band) + 3 * ip
+    pre = 2 * ((4 if masked else 3) * win + 7 * band)
+    post = 2 * (6 * band + (2 if masked else 1) * win + 3 * band) + 3 * ip
     return itemsize * max(pre, post)
 
 
-def pick_block_k_fused(kext: int, jp: int, ip: int, dtype) -> int:
+def pick_block_k_fused(kext: int, jp: int, ip: int, dtype,
+                       masked: bool = False) -> int:
     """Block depth: budget the resident planes (20·bk + 12·h of the pre
-    kernel) against half the raised VMEM limit, capped by the whole grid."""
+    kernel, +2·bk+4·h for the flag window) against half the raised VMEM
+    limit, capped by the whole grid."""
     plane = jp * ip * jnp.dtype(dtype).itemsize
     h = FUSE_CHAIN
-    feasible = ((VMEM_LIMIT_BYTES // 2) // plane - 12 * h) // 20
+    per_bk = 22 if masked else 20
+    per_h = 16 if masked else 12
+    feasible = ((VMEM_LIMIT_BYTES // 2) // plane - per_h * h) // per_bk
     return max(1, min(feasible, kext, 32))
 
 
-def _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, block_k,
+def _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, fluid, block_k,
            interpret):
+    """Shared geometry/feasibility resolution (the 2-D _geom contract):
+    `fluid` is None (no obstacles), a global (kmax+2, jmax+2, imax+2) 0/1
+    array (single-device: baked in as a padded constant), or True
+    (distributed: the per-shard flag block is an extra call-time arg)."""
     if pltpu is None:
         raise ValueError("pallas TPU backend unavailable")
     if interpret is None:
@@ -451,14 +589,15 @@ def _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, block_k,
     jp = -(-ext_j // a) * a
     ip = -(-ext_i // LANE) * LANE
     h = FUSE_CHAIN
+    masked = fluid is not None
     if block_k is None:
-        block_k = pick_block_k_fused(ext_k, jp, ip, dtype)
+        block_k = pick_block_k_fused(ext_k, jp, ip, dtype, masked)
     nblocks = -(-ext_k // block_k)
     kp = nblocks * block_k + 2 * h
     itemsize = jnp.dtype(dtype).itemsize
-    if fused3_vmem_bytes(block_k, h, jp, ip, itemsize) > VMEM_LIMIT_BYTES // 2:
+    if fused3_vmem_bytes(block_k, h, jp, ip, itemsize, masked) > VMEM_LIMIT_BYTES // 2:
         raise ValueError(
-            f"fused 3-D step-phase scratch {fused3_vmem_bytes(block_k, h, jp, ip, itemsize) >> 20} MiB "
+            f"fused 3-D step-phase scratch {fused3_vmem_bytes(block_k, h, jp, ip, itemsize, masked) >> 20} MiB "
             f"exceeds the VMEM budget (block_k={block_k}, plane {jp}x{ip}); "
             "the jnp phase chain is the fallback"
         )
@@ -470,8 +609,13 @@ def _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, block_k,
     def unpad3(xp):
         return xp[h: h + ext_k, :ext_j, :ext_i]
 
+    flg_padded = None
+    if masked and fluid is not True:
+        import numpy as np
+
+        flg_padded = pad3(jnp.asarray(np.asarray(fluid), dtype))
     return (interpret, lkmax, ljmax, limax, h, block_k, jp, ip, nblocks,
-            kp, pad3, unpad3)
+            kp, masked, pad3, unpad3, flg_padded)
 
 
 def make_fused_pre_3d(
@@ -488,16 +632,20 @@ def make_fused_pre_3d(
     jl: int | None = None,
     il: int | None = None,
     ext_pad: int = 0,
+    fluid=None,
     block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Build the 3-D PRE kernel:
       pre(offs_i32[3], dt_11, u_pad, v_pad, w_pad)
           -> (u', v', w', f, g, h, rhs)                            [padded]
-    plus (pad3, unpad3, halo). Geometry contract as make_fused_pre_2d."""
+    plus (pad3, unpad3, halo). Geometry contract as make_fused_pre_2d;
+    fluid=True (distributed obstacles) appends a call-time flag argument
+    (the padded per-shard deep-halo slice of the global flag)."""
     (interpret, lkmax, ljmax, limax, h, block_k, jp, ip, nblocks, kp,
-     pad3, unpad3) = _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il,
-                            ext_pad, block_k, interpret)
+     masked, pad3, unpad3, flg_padded) = _geom3(
+        gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, fluid, block_k,
+        interpret)
     bcs = (
         ("top", param.bcTop), ("bottom", param.bcBottom),
         ("left", param.bcLeft), ("right", param.bcRight),
@@ -525,31 +673,48 @@ def make_fused_pre_3d(
         dx=dx,
         dy=dy,
         dz=dz,
+        masked=masked,
     )
+    n_in = 4 if masked else 3
+    pre_scratch = [
+        pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+        pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+        pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+    ]
+    if masked:
+        pre_scratch.append(pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype))
+    pre_scratch += [
+        pltpu.VMEM((2, 7, block_k, jp, ip), dtype),
+        pltpu.SemaphoreType.DMA((2, n_in)),
+        pltpu.SemaphoreType.DMA((2, 7)),
+    ]
     call = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(nblocks,),
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
-            + [pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            + [pl.BlockSpec(memory_space=pl.ANY)] * n_in,
             out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 7,
-            scratch_shapes=[
-                pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
-                pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
-                pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
-                pltpu.VMEM((2, 7, block_k, jp, ip), dtype),
-                pltpu.SemaphoreType.DMA((2, 3)),
-                pltpu.SemaphoreType.DMA((2, 7)),
-            ],
+            scratch_shapes=pre_scratch,
         ),
         out_shape=[jax.ShapeDtypeStruct((kp, jp, ip), dtype)] * 7,
         compiler_params=CompilerParams(vmem_limit_bytes=VMEM_LIMIT_BYTES),
         interpret=interpret,
     )
 
-    def pre(offs, dt11, u_pad, v_pad, w_pad):
-        return call(offs, dt11, u_pad, v_pad, w_pad)
+    if masked and flg_padded is None:
+
+        def pre(offs, dt11, u_pad, v_pad, w_pad, flg_pad):
+            return call(offs, dt11, u_pad, v_pad, w_pad, flg_pad)
+    elif masked:
+
+        def pre(offs, dt11, u_pad, v_pad, w_pad):
+            return call(offs, dt11, u_pad, v_pad, w_pad, flg_padded)
+    else:
+
+        def pre(offs, dt11, u_pad, v_pad, w_pad):
+            return call(offs, dt11, u_pad, v_pad, w_pad)
 
     return pre, pad3, unpad3, h
 
@@ -568,15 +733,21 @@ def make_fused_post_3d(
     jl: int | None = None,
     il: int | None = None,
     ext_pad: int = 0,
+    fluid=None,
+    ragged: bool = False,
     block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Build the 3-D POST kernel:
       post(offs_i32[3], dt_11, u, v, w, f, g, h, p)  [all padded]
-          -> (u'', v'', w'', umax, vmax, wmax)."""
+          -> (u'', v'', w'', umax, vmax, wmax).
+    fluid=True appends a call-time flag argument (the padded per-shard
+    EXTENDED-block slice of the global flag); ragged=True appends the
+    dead-cell live-mask multiply after the projection."""
     (interpret, lkmax, ljmax, limax, h, block_k, jp, ip, nblocks, kp,
-     pad3, unpad3) = _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il,
-                            ext_pad, block_k, interpret)
+     masked, pad3, unpad3, flg_padded) = _geom3(
+        gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, fluid, block_k,
+        interpret)
     del lkmax, ljmax, limax
     kernel = functools.partial(
         _post3_kernel,
@@ -590,24 +761,32 @@ def make_fused_post_3d(
         dx=dx,
         dy=dy,
         dz=dz,
+        masked=masked,
+        ragged=ragged,
     )
+    n_in_post = 8 if masked else 7
+    post_scratch = [
+        pltpu.VMEM((2, 6, block_k, jp, ip), dtype),
+        pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
+    ]
+    if masked:
+        post_scratch.append(pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype))
+    post_scratch += [
+        pltpu.VMEM((2, 3, block_k, jp, ip), dtype),
+        pltpu.VMEM((3, ip), dtype),
+        pltpu.SemaphoreType.DMA((2, n_in_post)),
+        pltpu.SemaphoreType.DMA((2, 3)),
+    ]
     call = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(nblocks,),
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
-            + [pl.BlockSpec(memory_space=pl.ANY)] * 7,
+            + [pl.BlockSpec(memory_space=pl.ANY)] * n_in_post,
             out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3
             + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 3,
-            scratch_shapes=[
-                pltpu.VMEM((2, 6, block_k, jp, ip), dtype),
-                pltpu.VMEM((2, block_k + 2 * h, jp, ip), dtype),
-                pltpu.VMEM((2, 3, block_k, jp, ip), dtype),
-                pltpu.VMEM((3, ip), dtype),
-                pltpu.SemaphoreType.DMA((2, 7)),
-                pltpu.SemaphoreType.DMA((2, 3)),
-            ],
+            scratch_shapes=post_scratch,
         ),
         out_shape=[jax.ShapeDtypeStruct((kp, jp, ip), dtype)] * 3
         + [jax.ShapeDtypeStruct((1, 1), dtype)] * 3,
@@ -615,11 +794,32 @@ def make_fused_post_3d(
         interpret=interpret,
     )
 
-    def post(offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad, p_pad):
-        u_pad, v_pad, w_pad, um, vm, wm = call(
-            offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad, p_pad
-        )
-        return u_pad, v_pad, w_pad, um[0, 0], vm[0, 0], wm[0, 0]
+    if masked and flg_padded is None:
+
+        def post(offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad,
+                 p_pad, flg_pad):
+            u_pad, v_pad, w_pad, um, vm, wm = call(
+                offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad,
+                p_pad, flg_pad
+            )
+            return u_pad, v_pad, w_pad, um[0, 0], vm[0, 0], wm[0, 0]
+    elif masked:
+
+        def post(offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad,
+                 p_pad):
+            u_pad, v_pad, w_pad, um, vm, wm = call(
+                offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad,
+                p_pad, flg_padded
+            )
+            return u_pad, v_pad, w_pad, um[0, 0], vm[0, 0], wm[0, 0]
+    else:
+
+        def post(offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad,
+                 p_pad):
+            u_pad, v_pad, w_pad, um, vm, wm = call(
+                offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad, p_pad
+            )
+            return u_pad, v_pad, w_pad, um[0, 0], vm[0, 0], wm[0, 0]
 
     return post, pad3, unpad3, h
 
@@ -634,17 +834,19 @@ def make_fused_step_3d(
     dz: float,
     dtype,
     *,
+    fluid=None,
     block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """The single-device composition (pre + post on the whole grid).
-    Returns (pre, post, pad3, unpad3, halo)."""
+    Returns (pre, post, pad3, unpad3, halo). `fluid` switches on the
+    obstacle mode with the global flag baked in as a padded constant."""
     pre, pad3, unpad3, h = make_fused_pre_3d(
-        param, gkmax, gjmax, gimax, dx, dy, dz, dtype,
+        param, gkmax, gjmax, gimax, dx, dy, dz, dtype, fluid=fluid,
         block_k=block_k, interpret=interpret,
     )
     post, _p, _u, _h = make_fused_post_3d(
-        param, gkmax, gjmax, gimax, dx, dy, dz, dtype,
+        param, gkmax, gjmax, gimax, dx, dy, dz, dtype, fluid=fluid,
         block_k=block_k, interpret=interpret,
     )
     return pre, post, pad3, unpad3, h

@@ -67,10 +67,11 @@ def resolve_fuse_phases(param, backend: str, dtype, probe, key: str,
 
     backend is the model's retry-protocol backend: "jnp" (the pallas-retry
     fallback) always disables fusion — that IS the retry's contract.
-    `why_not` marks structurally ineligible builds (ragged, dist-obstacle,
-    3-D obstacle) where the kernels don't exist yet; `probe` is the
-    kernel-family one-time smoke test ("on" skips it: the interpret-mode
-    force used by parity tests and dryruns)."""
+    `why_not` marks structurally ineligible builds (shard extents smaller
+    than the deep halo — ragged, distributed-obstacle and 3-D-obstacle
+    builds fuse since PR 2); `probe` is the kernel-family one-time smoke
+    test ("on" skips it: the interpret-mode force used by parity tests and
+    dryruns)."""
     import jax
     import jax.numpy as jnp
 

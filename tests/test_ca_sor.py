@@ -130,7 +130,7 @@ def test_ns2d_ca_inner_exact_parity(reference_dir):
 def test_ns3d_ca_inner_exact_parity():
     param = Parameter(
         name="dcavity3d", imax=16, jmax=16, kmax=16,
-        re=10.0, te=0.03, tau=0.5, itermax=40, eps=1e-30, omg=1.7,
+        re=10.0, te=0.015, tau=0.5, itermax=40, eps=1e-30, omg=1.7,
         gamma=0.9, tpu_ca_inner=2,
     )
     single = NS3DSolver(param)
@@ -147,7 +147,7 @@ def test_ns3d_ca_converged_parity():
     the converged states must still agree to solver tolerance."""
     param = Parameter(
         name="dcavity3d", imax=16, jmax=16, kmax=16,
-        re=10.0, te=0.03, tau=0.5, itermax=100, eps=1e-4, omg=1.7,
+        re=10.0, te=0.015, tau=0.5, itermax=100, eps=1e-4, omg=1.7,
         gamma=0.9,
     )
     a = NS3DSolver(param)

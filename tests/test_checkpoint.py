@@ -80,14 +80,14 @@ def test_roundtrip_distributed(tmp_path):
 
     path = str(tmp_path / "ck3d.npz")
     dims = (2, 2, 2)
-    ref = NS3DDistSolver(p3(0.4), CartComm(ndims=3, dims=dims))
+    ref = NS3DDistSolver(p3(0.2), CartComm(ndims=3, dims=dims))
     ref.run(progress=False)
 
-    first = NS3DDistSolver(p3(0.15), CartComm(ndims=3, dims=dims))
+    first = NS3DDistSolver(p3(0.08), CartComm(ndims=3, dims=dims))
     first.run(progress=False)
     ckpt.save_checkpoint(path, first)
 
-    second = NS3DDistSolver(p3(0.4), CartComm(ndims=3, dims=dims))
+    second = NS3DDistSolver(p3(0.2), CartComm(ndims=3, dims=dims))
     ckpt.load_checkpoint(path, second)
     assert second.t == first.t and second.nt == first.nt
     second.run(progress=False)
@@ -95,6 +95,6 @@ def test_roundtrip_distributed(tmp_path):
     for a, b in zip(ref.collect(), second.collect()):
         np.testing.assert_array_equal(a, b)
 
-    other = NS3DDistSolver(p3(0.4), CartComm(ndims=3, dims=(1, 2, 4)))
+    other = NS3DDistSolver(p3(0.2), CartComm(ndims=3, dims=(1, 2, 4)))
     with pytest.raises(ValueError, match="mesh"):
         ckpt.load_checkpoint(path, other)

@@ -226,6 +226,140 @@ def test_dist_canal_fused_matches_single():
         assert np.isfinite(d).all() and d.max() < 1e-10, n
 
 
+def test_obstacle_calltime_flag_matches_baked():
+    """The distributed-obstacle mode (fluid=True: the flag is a call-time
+    argument) must be BITWISE the single-device baked-constant mode on the
+    same geometry — same kernels, same windows, only the flag's delivery
+    differs."""
+    from pampi_tpu.ops import obstacle as obst
+
+    jm, im = 32, 48
+    param = Parameter(name="canal_obstacle", imax=im, jmax=jm, re=10.0,
+                      bcLeft=3, bcRight=3, obstacles="0.3,0.3,0.6,0.6",
+                      gamma=0.9, omg=1.7)
+    dx, dy = param.xlength / im, param.ylength / jm
+    fluid = obst.build_fluid(im, jm, dx, dy, param.obstacles)
+    m = obst.make_masks(fluid, dx, dy, param.omg, jnp.float64)
+    rng = np.random.default_rng(5)
+    u = jnp.asarray(rng.normal(size=(jm + 2, im + 2)))
+    v = jnp.asarray(rng.normal(size=(jm + 2, im + 2)))
+    p = jnp.asarray(rng.normal(size=(jm + 2, im + 2)))
+    dt11 = jnp.full((1, 1), 0.01)
+    offs = jnp.zeros((2,), jnp.int32)
+    pre_b, post_b, pad, unpad, _h = nf.make_fused_step_2d(
+        param, jm, im, dx, dy, jnp.float64, fluid=m.fluid, interpret=True)
+    pre_c, _p1, _u1, _h1 = nf.make_fused_pre_2d(
+        param, jm, im, dx, dy, jnp.float64, fluid=True, interpret=True)
+    post_c, _p2, _u2, _h2 = nf.make_fused_post_2d(
+        param, jm, im, dx, dy, jnp.float64, fluid=True, interpret=True)
+    flg = pad(m.fluid)
+    outs_b = pre_b(offs, dt11, pad(u), pad(v))
+    outs_c = pre_c(offs, dt11, pad(u), pad(v), flg)
+    for a, b in zip(outs_b, outs_c):
+        assert jnp.array_equal(unpad(a), unpad(b))
+    up, vp, fp, gp, _r = outs_b
+    got_b = post_b(offs, dt11, up, vp, fp, gp, pad(p))
+    got_c = post_c(offs, dt11, up, vp, fp, gp, pad(p), flg)
+    for a, b in zip(got_b[:2], got_c[:2]):
+        assert jnp.array_equal(unpad(a), unpad(b))
+    assert float(got_b[2]) == float(got_c[2])
+    assert float(got_b[3]) == float(got_c[3])
+
+
+def test_ragged_post_live_mask():
+    """POST(ragged=True) must zero dead pad cells after the projection —
+    bitwise the plain POST times the live mask (the jnp ragged chain's
+    live_masks multiply), with the CFL max scanning live cells only."""
+    jm_global, im_global = 27, 21   # trailing-shard view: block > global
+    jl, il = 32, 24
+    param = Parameter(name="dcavity", imax=im_global, jmax=jm_global,
+                      re=10.0)
+    dx, dy = 1.0 / im_global, 1.0 / jm_global
+    rng = np.random.default_rng(9)
+    shp = (jl + 2, il + 2)
+    u = jnp.asarray(rng.normal(size=shp))
+    v = jnp.asarray(rng.normal(size=shp))
+    f = jnp.asarray(rng.normal(size=shp))
+    g = jnp.asarray(rng.normal(size=shp))
+    p = jnp.asarray(rng.normal(size=shp))
+    dt11 = jnp.full((1, 1), 0.01)
+    offs = jnp.zeros((2,), jnp.int32)
+    kw = dict(jl=jl, il=il, interpret=True)
+    post_r, pad, unpad, _h = nf.make_fused_post_2d(
+        param, jm_global, im_global, dx, dy, jnp.float64, ragged=True, **kw)
+    post_p, _p, _u, _h2 = nf.make_fused_post_2d(
+        param, jm_global, im_global, dx, dy, jnp.float64, **kw)
+    ur, vr, umr, vmr = post_r(offs, dt11, pad(u), pad(v), pad(f), pad(g),
+                              pad(p))
+    up, vp, _um, _vm = post_p(offs, dt11, pad(u), pad(v), pad(f), pad(g),
+                              pad(p))
+    gj = np.arange(jl + 2)[:, None]
+    gi = np.arange(il + 2)[None, :]
+    live = ((gj <= jm_global + 1) & (gi <= im_global + 1))
+    assert jnp.array_equal(unpad(ur), unpad(up) * live)
+    assert jnp.array_equal(unpad(vr), unpad(vp) * live)
+    # the ragged CFL max never sees dead cells
+    assert float(umr) == float(np.abs(np.asarray(unpad(ur))).max())
+    assert float(vmr) == float(np.abs(np.asarray(unpad(vr))).max())
+
+
+def test_dist_ragged_fused_matches_single():
+    """Ragged shards on the fused kernels (uneven block bounds + the POST
+    live-mask multiply) vs the single-device jnp chain — with and without
+    an obstacle flag field riding along."""
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    cases = [
+        Parameter(name="dcavity", imax=50, jmax=50, re=10.0, te=0.003,
+                  tau=0.5, itermax=60, eps=1e-4, omg=1.7, gamma=0.9),
+        Parameter(name="canal_obstacle", imax=50, jmax=30, re=10.0,
+                  te=0.003, tau=0.5, itermax=60, eps=1e-4, omg=1.7,
+                  gamma=0.9, bcLeft=3, bcRight=3,
+                  obstacles="0.3,0.3,0.6,0.6"),
+    ]
+    for param in cases:
+        single = NS2DSolver(param.replace(tpu_fuse_phases="off"))
+        single.run(progress=False)
+        dist = NS2DDistSolver(param.replace(tpu_fuse_phases="on"),
+                              CartComm(ndims=2, dims=(4, 2)))
+        assert dist.ragged
+        dist.run(progress=False)
+        assert dispatch.last("ns2d_dist_phases") == "pallas_fused (forced)"
+        ud, vd, pd = dist.fields()
+        assert dist.nt == single.nt
+        for n, (x, y) in {"u": (single.u, ud), "v": (single.v, vd),
+                          "p": (single.p, pd)}.items():
+            d = np.abs(np.asarray(x) - y)
+            assert np.isfinite(d).all() and d.max() < 1e-9, (param.name, n)
+
+
+def test_dist_obstacle_fused_matches_single():
+    """Distributed obstacle flags through the fused kernels (per-shard
+    call-time global-constant flag slices) vs the single-device jnp
+    chain."""
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = Parameter(name="canal_obstacle", imax=64, jmax=32, re=10.0,
+                      te=0.003, tau=0.5, itermax=60, eps=1e-4, omg=1.7,
+                      gamma=0.9, bcLeft=3, bcRight=3,
+                      obstacles="0.3,0.3,0.6,0.6")
+    single = NS2DSolver(param.replace(tpu_fuse_phases="off"))
+    single.run(progress=False)
+    dist = NS2DDistSolver(param.replace(tpu_fuse_phases="on"),
+                          CartComm(ndims=2, dims=(2, 4)))
+    assert not dist.ragged and dist.masks is not None
+    dist.run(progress=False)
+    assert dispatch.last("ns2d_dist_phases") == "pallas_fused (forced)"
+    ud, vd, pd = dist.fields()
+    assert dist.nt == single.nt
+    for n, (x, y) in {"u": (single.u, ud), "v": (single.v, vd),
+                      "p": (single.p, pd)}.items():
+        d = np.abs(np.asarray(x) - y)
+        assert np.isfinite(d).all() and d.max() < 1e-9, n
+
+
 def _count_prim(jaxpr, name):
     n = sum(1 for e in jaxpr.eqns if e.primitive.name == name)
     for e in jaxpr.eqns:
@@ -266,6 +400,62 @@ def test_launch_count_regression():
     # slices + the solve + scalar math) vs the ~40-op jnp phase chain
     assert len(body_f.eqns) * 2 < len(body_p.eqns), (
         len(body_f.eqns), len(body_p.eqns))
+
+
+def test_dist_fused_launch_count():
+    """Each newly fused dist family's per-shard chunk lowers to exactly
+    TWO pallas kernels per step (pre + post; the jnp CA solve contributes
+    none) — the launch-amortization property, per family."""
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    cases = [
+        ("ragged", Parameter(name="dcavity", imax=50, jmax=50, re=10.0,
+                             te=0.05, tau=0.5, itermax=20, eps=1e-3),
+         (4, 2)),
+        ("obstacle", Parameter(name="canal_obstacle", imax=64, jmax=32,
+                               re=10.0, te=0.05, tau=0.5, itermax=20,
+                               eps=1e-3, bcLeft=3, bcRight=3,
+                               obstacles="0.3,0.3,0.6,0.6"), (2, 4)),
+    ]
+    for tag, param, dims in cases:
+        fused = NS2DDistSolver(param.replace(tpu_fuse_phases="on"),
+                               CartComm(ndims=2, dims=dims))
+        plain = NS2DDistSolver(param.replace(tpu_fuse_phases="off"),
+                               CartComm(ndims=2, dims=dims))
+        state = (fused.u, fused.v, fused.p, jnp.asarray(0.0, jnp.float64),
+                 jnp.asarray(0, jnp.int32))
+        jx_f = jax.make_jaxpr(fused._chunk_sm)(*state)
+        jx_p = jax.make_jaxpr(plain._chunk_sm)(*state)
+        assert _count_prim(jx_f.jaxpr, "pallas_call") == 2, tag
+        assert _count_prim(jx_p.jaxpr, "pallas_call") == 0, tag
+
+
+def test_p_layout_fold():
+    """The p-layout fold (the ROADMAP post-fusion knob): on the
+    checkerboard solve layout the pressure solve runs DIRECTLY on the
+    fused padded layout — dispatch records the fold, the chunk lowers to
+    exactly THREE pallas calls (pre + tblock solve + post, no layout
+    passes between them), and results match the jnp chain. The auto
+    layout on even grids keeps quarters with explicit conversions."""
+    base = dict(name="dcavity", imax=32, jmax=32, re=10.0, te=0.04,
+                tau=0.5, itermax=80, eps=1e-4, omg=1.7, gamma=0.9,
+                tpu_sor_layout="checkerboard", tpu_sor_inner=1)
+    a = _run_solver("off", **base)
+    b = _run_solver("on", **base)
+    assert dispatch.last("ns2d_p_layout").startswith("folded")
+    assert b._fused and a.nt == b.nt
+    for n in ("u", "v", "p"):
+        d = np.abs(np.asarray(getattr(a, n)) - np.asarray(getattr(b, n)))
+        assert np.isfinite(d).all() and d.max() < 1e-9, n
+    state = (a.u, a.v, a.p, jnp.asarray(0.0, jnp.float64),
+             jnp.asarray(0, jnp.int32))
+    jx = jax.make_jaxpr(b._build_chunk())(*state)
+    assert _count_prim(jx.jaxpr, "pallas_call") == 3
+    # auto on an even grid: quarters stays the solve home, no fold
+    NS2DSolver(Parameter(tpu_fuse_phases="on",
+                         **{**base, "tpu_sor_layout": "auto"}))
+    assert dispatch.last("ns2d_p_layout") == "explicit pad/unpad"
 
 
 def test_retry_backend_disables_fusion():

@@ -73,12 +73,13 @@ def test_phase_parity_3d(problem, bckw, shape, block_k):
         assert abs(float(got) - float(ops3.max_element(ref))) <= 1e-12
 
 
-def _run_solver(fuse, **kw):
+def _run_solver(fuse, run=True, **kw):
     base = dict(name="dcavity3d", imax=16, jmax=16, kmax=16, re=10.0,
                 te=0.02, tau=0.5, itermax=40, eps=1e-4, omg=1.7, gamma=0.9)
     base.update(kw)
     s = NS3DSolver(Parameter(tpu_fuse_phases=fuse, **base))
-    s.run(progress=False)
+    if run:
+        s.run(progress=False)
     return s
 
 
@@ -97,13 +98,81 @@ def test_solver_e2e_fused_matches_jnp_3d(kw):
         assert np.isfinite(d).all() and d.max() < 1e-9, n
 
 
-def test_obstacle_3d_keeps_jnp_chain():
-    """3-D obstacle flag fields are not fused (the 2-D module is the flag
-    home); the knob must record the decision and the run must work."""
-    s = _run_solver("auto", obstacles="0.3,0.3,0.3,0.6,0.6,0.6", te=0.004,
-                    tpu_solver="sor")
+def test_obstacle_phase_parity_3d():
+    """The 3-D flag-masked mode (PR 2): obstacle velocity BC (priority-
+    ordered tangential mirrors), F/G/H face masks and projection face
+    masks vs the ops/obstacle3d.py jnp forms. Copies bitwise, compound
+    terms at the ulp contract."""
+    from pampi_tpu.ops import obstacle3d as obst3
+
+    km, jm, im = 10, 12, 16
+    param = Parameter(name="dcavity3d", imax=im, jmax=jm, kmax=km, re=50.0,
+                      gamma=0.9, omg=1.7,
+                      obstacles="0.3,0.3,0.3,0.7,0.7,0.7")
+    dx, dy, dz = param.xlength / im, param.ylength / jm, param.zlength / km
+    fluid = obst3.build_fluid_3d(im, jm, km, dx, dy, dz, param.obstacles)
+    m = obst3.make_masks_3d(fluid, dx, dy, dz, param.omg, jnp.float64)
+    assert m.any_obstacle
+    rng = np.random.default_rng(11)
+    shp = (km + 2, jm + 2, im + 2)
+    u = jnp.asarray(rng.normal(size=shp))
+    v = jnp.asarray(rng.normal(size=shp))
+    w = jnp.asarray(rng.normal(size=shp))
+    p = jnp.asarray(rng.normal(size=shp))
+    dt = jnp.asarray(0.011)
+    bcs = {"top": param.bcTop, "bottom": param.bcBottom,
+           "left": param.bcLeft, "right": param.bcRight,
+           "front": param.bcFront, "back": param.bcBack}
+    u1, v1, w1 = ops3.set_boundary_conditions_3d(u, v, w, bcs)
+    u1 = ops3.set_special_bc_dcavity_3d(u1)
+    u1, v1, w1 = obst3.apply_obstacle_velocity_bc_3d(u1, v1, w1, m)
+    f, g, h = ops3.compute_fgh(u1, v1, w1, dt, param.re, 0.0, 0.0, 0.0,
+                               param.gamma, dx, dy, dz)
+    f, g, h = obst3.mask_fgh(f, g, h, u1, v1, w1, m)
+    rhs = ops3.compute_rhs(f, g, h, dt, dx, dy, dz)
+    u2, v2, w2 = obst3.adapt_uvw_obstacle(u1, v1, w1, f, g, h, p, dt,
+                                          dx, dy, dz, m)
+
+    pre, post, pad3, unpad3, _h = nf3.make_fused_step_3d(
+        param, km, jm, im, dx, dy, dz, jnp.float64, fluid=m.fluid,
+        interpret=True, block_k=4)
+    offs = jnp.zeros((3,), jnp.int32)
+    dt11 = jnp.full((1, 1), dt)
+    up, vp, wp, fp, gp, hp, rp = pre(offs, dt11, pad3(u), pad3(v), pad3(w))
+    # BC + obstacle BC are flag multiplies of copies -> bitwise
+    assert jnp.array_equal(unpad3(up), u1)
+    assert jnp.array_equal(unpad3(vp), v1)
+    assert jnp.array_equal(unpad3(wp), w1)
+    assert _ulp_close(unpad3(fp), f)
+    assert _ulp_close(unpad3(gp), g)
+    assert _ulp_close(unpad3(hp), h)
+    assert _ulp_close(unpad3(rp), rhs, scale=float(jnp.abs(rhs).max()))
+    up2, vp2, wp2, um, vm, wm = post(
+        offs, dt11, up, vp, wp, fp, gp, hp, pad3(p))
+    assert _ulp_close(unpad3(up2), u2)
+    assert _ulp_close(unpad3(vp2), v2)
+    assert _ulp_close(unpad3(wp2), w2)
+    for got, ref in ((um, u2), (vm, v2), (wm, w2)):
+        assert abs(float(got) - float(ops3.max_element(ref))) <= 1e-12
+
+
+def test_obstacle_3d_fused_e2e():
+    """3-D obstacle flag fields fuse since PR 2 (in-kernel flag
+    derivation): forced fused run matches the jnp chain e2e; auto off-TPU
+    records the no-TPU decision, never a structural why_not."""
+    kw = dict(obstacles="0.3,0.3,0.3,0.7,0.7,0.7", te=0.006,
+              tpu_solver="sor", imax=16, jmax=16, kmax=12)
+    a = _run_solver("off", **kw)
+    b = _run_solver("on", **kw)
+    assert b._fused and not a._fused
+    assert a.nt == b.nt
+    for n in ("u", "v", "w", "p"):
+        d = np.abs(np.asarray(getattr(a, n)) - np.asarray(getattr(b, n)))
+        assert np.isfinite(d).all() and d.max() < 1e-9, n
+    # the auto decision is recorded at chunk build — no run needed
+    s = _run_solver("auto", run=False, **kw)
     assert not s._fused
-    assert "obstacle" in dispatch.last("ns3d_phases")
+    assert dispatch.last("ns3d_phases") == "jnp (no TPU)"
 
 
 def test_dist_fused_matches_single_3d():
@@ -141,10 +210,53 @@ def _count_prim(jaxpr, name):
     return n
 
 
+def test_dist_ragged_obstacle_fused_matches_single_3d():
+    """The ragged + obstacle composition through the 3-D fused kernels
+    (uneven block bounds, POST live-mask, call-time flag slices) vs the
+    single-device jnp chain."""
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = Parameter(name="dcavity3d", imax=17, jmax=16, kmax=12, re=10.0,
+                      te=0.004, tau=0.5, itermax=40, eps=1e-4, omg=1.7,
+                      gamma=0.9, obstacles="0.3,0.3,0.3,0.7,0.7,0.7")
+    single = NS3DSolver(param.replace(tpu_fuse_phases="off"))
+    single.run(progress=False)
+    sg = single.collect()
+    dist = NS3DDistSolver(param.replace(tpu_fuse_phases="on"),
+                          CartComm(ndims=3, dims=(2, 2, 2)))
+    assert dist.ragged and dist.masks is not None
+    dist.run(progress=False)
+    assert dispatch.last("ns3d_dist_phases") == "pallas_fused (forced)"
+    dg = dist.collect()
+    assert dist.nt == single.nt
+    for n, (x, y) in zip("uvwp", zip(sg, dg)):
+        d = np.abs(np.asarray(x) - np.asarray(y))
+        assert np.isfinite(d).all() and d.max() < 1e-9, n
+
+
 def test_launch_count_regression_3d():
     param = Parameter(name="dcavity3d", imax=16, jmax=16, kmax=16, re=10.0,
                       te=0.02, tau=0.5, itermax=20, eps=1e-3,
                       tpu_solver="fft")
+    fused = NS3DSolver(param.replace(tpu_fuse_phases="on"))
+    plain = NS3DSolver(param.replace(tpu_fuse_phases="off"))
+    state = (plain.u, plain.v, plain.w, plain.p,
+             jnp.asarray(0.0, jnp.float64), jnp.asarray(0, jnp.int32))
+    jx_f = jax.make_jaxpr(fused._build_chunk())(*state)
+    jx_p = jax.make_jaxpr(plain._build_chunk())(*state)
+    assert _count_prim(jx_f.jaxpr, "pallas_call") == 2
+    assert _count_prim(jx_p.jaxpr, "pallas_call") == 0
+
+
+def test_launch_count_regression_obstacle_3d():
+    """The fused 3-D obstacle chunk lowers to exactly TWO pallas kernels
+    per step (the flag rides as a kernel input, not extra launches); the
+    jnp eps-coefficient solve contributes none."""
+    param = Parameter(name="dcavity3d", imax=16, jmax=16, kmax=12, re=10.0,
+                      te=0.02, tau=0.5, itermax=20, eps=1e-3,
+                      tpu_solver="sor",
+                      obstacles="0.3,0.3,0.3,0.7,0.7,0.7")
     fused = NS3DSolver(param.replace(tpu_fuse_phases="on"))
     plain = NS3DSolver(param.replace(tpu_fuse_phases="off"))
     state = (plain.u, plain.v, plain.w, plain.p,

@@ -228,7 +228,7 @@ def test_dist_obstacle_mg_3d_matches_single_device():
     from pampi_tpu.parallel.comm import CartComm
 
     param = Parameter(
-        name="dcavity3d", imax=16, jmax=16, kmax=16, re=10.0, te=0.05,
+        name="dcavity3d", imax=16, jmax=16, kmax=16, re=10.0, te=0.02,
         tau=0.5, itermax=500, eps=1e-3, omg=1.7, gamma=0.9,
         obstacles="0.35,0.35,0.35,0.65,0.65,0.65", tpu_solver="mg",
     )

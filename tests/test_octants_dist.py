@@ -83,7 +83,7 @@ def test_ns3d_dist_octants_vs_single(reference_dir, dims):
         )
 
 
-def test_odist_clamp_and_eligibility():
+def test_odist_clamp_and_eligibility(reference_dir):
     assert od.odist_clamp(8, 8, 8, 8) == 3
     assert od.odist_supported(16, 16, 16, 8, 4, 8)
     assert not od.odist_supported(15, 16, 16, 8, 4, 8)
@@ -91,7 +91,9 @@ def test_odist_clamp_and_eligibility():
     with pytest.raises(ValueError):
         # 12/4 = 3: odd per-shard k extent — forced octants must refuse
         NS3DDistSolver(
-            read_parameter("/root/reference/assignment-6/dcavity.par").replace(
+            read_parameter(
+                str(reference_dir / "assignment-6" / "dcavity.par")
+            ).replace(
                 te=0.0, imax=12, jmax=12, kmax=12, tpu_sor_layout="octants"
             ),
             CartComm(ndims=3, dims=(4, 2, 1)),

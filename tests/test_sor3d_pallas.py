@@ -176,7 +176,7 @@ def test_ns3d_solver_backend_equivalence():
 
     param = Parameter(
         name="dcavity3d", imax=8, jmax=8, kmax=8,
-        re=10.0, te=0.06, tau=0.5, itermax=100, eps=1e-4, omg=1.7,
+        re=10.0, te=0.03, tau=0.5, itermax=100, eps=1e-4, omg=1.7,
         gamma=0.9, tpu_dtype="float32",
     )
     a = NS3DSolver(param, dtype=DT)

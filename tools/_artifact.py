@@ -22,6 +22,70 @@ def merge_nested(old: dict, new: dict) -> dict:
     return out
 
 
+def dist_step_decomposition(make_solver, key: str, reps: int = 3) -> dict:
+    """Solve/non-solve step decomposition for a DISTRIBUTED NS-2D/3-D
+    config — the mesh twin of bench.py's `_ns2d_step_line` protocol.
+    `make_solver(itermax)` builds a ready dist solver (te far beyond reach
+    so a chunk always runs its full CHUNK steps; eps below reach so every
+    solve caps at itermax).
+
+    step_ms comes from best-of-`reps` chunk dispatches fenced by a scalar
+    readback. The solve share uses the repo's two-point differencing: a
+    second build at 2×itermax isolates the pure per-iteration solve cost
+    (`solve_iter_ms` = itermax × per-iteration), so the remainder
+    (`nonsolve_ms` = step - solve_iter) carries the phase chain PLUS the
+    per-solve envelope (layout conversions, loop setup) — exactly the
+    budget the fused phase kernels and the p-layout fold move. TPU-only:
+    off-TPU the timing fields stay null (XLA:CPU whole-program optimization
+    makes the subtraction meaningless — the bench.py contract) and only the
+    dispatch tag is recorded."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pampi_tpu.utils import dispatch
+
+    s = make_solver(None)  # production itermax build, records dispatch
+    tag = dispatch.last(key)
+    base = {"phases": tag, "steps_timed": type(s).CHUNK}
+    if jax.default_backend() != "tpu":
+        # one key set on every path (itermax/note null rather than absent)
+        # so write_merged re-runs across hosts never leave stale fields
+        return {**base, "step_ms": None, "solve_iter_ms": None,
+                "nonsolve_ms": None, "itermax": None,
+                "decomposition_note": "TPU-only (see tools/_artifact.py)"}
+
+    def step_ms_of(sv):
+        steps = type(sv).CHUNK
+        time_dtype = (jnp.float64 if jax.config.jax_enable_x64
+                      else jnp.float32)
+        state = [getattr(sv, n) for n in ("u", "v", "w", "p")
+                 if hasattr(sv, n) and getattr(sv, n) is not None]
+        args = (*state, jnp.asarray(0.0, time_dtype),
+                jnp.asarray(0, jnp.int32))
+        out = sv._chunk_sm(*args)
+        float(out[-2])  # compile + warm; scalar readback is the fence
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = sv._chunk_sm(*args)
+            float(out[-2])
+            best = min(best, time.perf_counter() - t0)
+        return best / steps * 1e3
+
+    step_ms = step_ms_of(s)
+    itermax = s.param.itermax
+    step2_ms = step_ms_of(make_solver(2 * itermax))
+    solve_iter_ms = step2_ms - step_ms  # itermax extra capped iterations
+    return {**base,
+            "step_ms": round(step_ms, 3),
+            "solve_iter_ms": round(solve_iter_ms, 3),
+            "nonsolve_ms": round(step_ms - solve_iter_ms, 3),
+            "itermax": itermax,
+            "decomposition_note": None}
+
+
 def write_merged(path: str, rec: dict) -> dict:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     if os.path.exists(path):

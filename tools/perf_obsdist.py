@@ -138,6 +138,24 @@ def main() -> dict:
         }
         print(f"dist ca{can} [{tag}]: {t*1e3:.1f} ms "
               f"{rec['dist_one_shard'][f'ca{can}']['gups']}G", flush=True)
+
+    # step-level solve/non-solve decomposition of the FUSED dist obstacle
+    # run (PR 2: obstacle shards now ride the phase megakernels with
+    # call-time flag slices) — bench.py's decomposition protocol on the
+    # mesh, via tools/_artifact.dist_step_decomposition
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from tools._artifact import dist_step_decomposition
+
+    def make_solver(itermax):
+        p_step = param.replace(
+            te=1e9, tau=0.5, eps=1e-30, itermax=itermax or param.itermax,
+            tpu_dtype="float32", tpu_sor_inner=16, tpu_ca_inner=16,
+        )
+        return NS2DDistSolver(p_step, CartComm(ndims=2, dims=(1, 1)),
+                              dtype=DT)
+
+    rec["obstacle_step_decomposition"] = dist_step_decomposition(
+        make_solver, "ns2d_dist_phases", reps=REPS)
     return rec
 
 

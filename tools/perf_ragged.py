@@ -169,7 +169,9 @@ def jnp_ca_ragged_rate(gj, gi, jl, il) -> dict:
         return jax.lax.fori_loop(0, k[0], body,
                                  (x, jnp.zeros((), jnp.float32)))
 
-    f = jax.jit(jax.shard_map(
+    from pampi_tpu.parallel.comm import compat_shard_map
+
+    f = jax.jit(compat_shard_map(
         kern, mesh=mesh, in_specs=(P(), P(), P()), out_specs=(P(), P()),
         check_vma=False,
     ))
@@ -196,6 +198,43 @@ def jnp_ca_ragged_rate(gj, gi, jl, il) -> dict:
             "spans": [ka, kb]}
 
 
+def ragged_step_decomposition() -> dict:
+    """Step-level solve/non-solve decomposition of a RAGGED fused NS-2D
+    run (PR 2: ragged shards now ride the fused phase megakernels) — the
+    mesh twin of bench.py's decomposition line, via
+    tools/_artifact.dist_step_decomposition. Needs >= 4 devices for a
+    genuinely ragged (2, 2) mesh; below that no solver is built, so every
+    field (including the dispatch tag) is null with a note — the record
+    keeps the SAME key set either way so write_merged's recursive merge
+    never sees keys appear and disappear across hosts. Timing fields are
+    additionally null off-TPU (the dist_step_decomposition contract)."""
+    from tools._artifact import dist_step_decomposition
+
+    if len(jax.devices()) < 4:
+        return {"phases": None, "steps_timed": None,
+                "step_ms": None, "solve_iter_ms": None, "nonsolve_ms": None,
+                "itermax": None,
+                "decomposition_note": "needs >= 4 devices for a ragged mesh"}
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.utils.params import Parameter
+
+    def make_solver(itermax):
+        param = Parameter(
+            name="dcavity", imax=4095, jmax=4095, re=1000.0, te=1e9,
+            tau=0.5, itermax=itermax or 100, eps=1e-30, omg=1.7, gamma=0.9,
+            tpu_dtype="float32", tpu_sor_inner=N_INNER,
+            tpu_ca_inner=N_INNER,
+        )
+        s = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2)),
+                           dtype=jnp.float32)
+        assert s.ragged
+        return s
+
+    return dist_step_decomposition(make_solver, "ns2d_dist_phases",
+                                   reps=REPS)
+
+
 if __name__ == "__main__":
     rec = {
         "artifact": "ragged_throughput",
@@ -210,6 +249,7 @@ if __name__ == "__main__":
     rec["masked_ragged_4095"] = masked_kernel_rate(
         4095, 4095, 2048, 2048, ragged=True)
     rec["jnp_ca_ragged_4095"] = jnp_ca_ragged_rate(4095, 4095, 2048, 2048)
+    rec["ragged_step_decomposition_4095"] = ragged_step_decomposition()
     from tools._artifact import write_merged
 
     write_merged(os.path.join(REPO, "results", "ragged_throughput.json"),
