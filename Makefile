@@ -55,10 +55,22 @@ format:
 	  && clang-format -i $(SRC)/*.c $(SRC)/*.h \
 	  || echo "clang-format not installed; skipping"
 
+# Render a PAMPI_TELEMETRY flight record (utils/telemetry.py JSONL) into a
+# human-readable run report; MERGE=<artifact.json> additionally folds the
+# summary block into a BENCH/MULTICHIP artifact (merge-preserving).
+#   make telemetry-report TELEMETRY=run.jsonl [MERGE=BENCH_r07.json]
+TELEMETRY ?= telemetry.jsonl
+telemetry-report:
+	python tools/telemetry_report.py $(TELEMETRY) \
+	  $(if $(MERGE),--merge $(MERGE))
+
+check-artifacts:
+	python tools/check_artifact.py
+
 clean:
 	rm -rf $(BUILD) exe-$(TAG)
 
 distclean:
 	rm -rf build exe-*
 
-.PHONY: all test asm format clean distclean
+.PHONY: all test asm format telemetry-report check-artifacts clean distclean

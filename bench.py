@@ -141,12 +141,11 @@ def _step_decomposition_line(param, metric, config, steps, reps):
     tpu_flat_solve=1 so every solve runs exactly itermax iterations and
     the step - solve subtraction is well-defined."""
     from pampi_tpu.models.ns2d import NS2DSolver
-    from pampi_tpu.utils import dispatch
+    from pampi_tpu.utils import dispatch, telemetry
 
     assert param.tpu_flat_solve, "decomposition needs the flat solve"
     s = NS2DSolver(param, dtype=jnp.float32)
-    state = (s.u, s.v, s.p, jnp.asarray(0.0, jnp.float32),
-             jnp.asarray(0, jnp.int32))
+    state = s.initial_state()
     out = s._chunk_fn(*state)
     float(out[3])  # compile + warm-up; scalar readback is the fence
     best = float("inf")
@@ -171,11 +170,19 @@ def _step_decomposition_line(param, metric, config, steps, reps):
         # whole-program optimization), so step - solve would go negative;
         # on TPU both are the same pallas kernel and the subtraction is
         # meaningful
-        return {**line, "solve_ms": None, "nonsolve_ms": None,
+        line = {**line, "solve_ms": None, "nonsolve_ms": None,
                 "decomposition_note": "TPU-only (see bench.py)"}
-    solve_ms = s.time_solve_ms(reps=reps)
-    return {**line, "solve_ms": round(solve_ms, 3),
-            "nonsolve_ms": round(step_ms - solve_ms, 3)}
+    else:
+        solve_ms = s.time_solve_ms(reps=reps)
+        line = {**line, "solve_ms": round(solve_ms, 3),
+                "nonsolve_ms": round(step_ms - solve_ms, 3)}
+    # the decomposition as shared telemetry spans + the headline metric
+    # record (no-ops when PAMPI_TELEMETRY is unset)
+    telemetry.emit_decomposition(metric, step_ms, line["solve_ms"],
+                                 line["nonsolve_ms"],
+                                 phases=line["phases"], config=config)
+    telemetry.emit("metric", **line)
+    return line
 
 
 def _ns2d_step_line():
@@ -224,7 +231,10 @@ def _ns2d_obstacle_step_line():
 
 
 def main() -> None:
+    from pampi_tpu.utils import telemetry
+
     xlacache.enable()
+    telemetry.start_run(tool="bench")
     backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
     try:
         dt, iters = _run_with_retry("auto")
@@ -234,18 +244,15 @@ def main() -> None:
         backend = "jnp-fallback"
         dt, iters = _run_with_retry("jnp")
     ups = N * N * iters / dt
-    print(
-        json.dumps(
-            {
-                "metric": "lattice_site_updates_per_sec_per_chip_poisson4096_rbsor",
-                "value": ups,
-                "unit": "updates/s",
-                "vs_baseline": ups / BASELINE_8RANK_UPDATES_PER_S,
-                "backend": backend,
-            }
-        ),
-        flush=True,
-    )
+    headline = {
+        "metric": "lattice_site_updates_per_sec_per_chip_poisson4096_rbsor",
+        "value": ups,
+        "unit": "updates/s",
+        "vs_baseline": ups / BASELINE_8RANK_UPDATES_PER_S,
+        "backend": backend,
+    }
+    telemetry.emit("metric", **headline)
+    print(json.dumps(headline), flush=True)
     try:
         print(json.dumps(_ns2d_step_line()), flush=True)
     except Exception as exc:  # the NS line must not sink the headline

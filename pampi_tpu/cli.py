@@ -105,16 +105,26 @@ def _run(argv) -> int:
         os.environ.setdefault("PAMPI_DTYPE", param.tpu_dtype)
 
         from .utils import profiling as prof
+        from .utils import telemetry
 
         print_parameter(param)
         prof.init()
+        telemetry.start_run(
+            tool="cli", config=argv[1], problem=param.name,
+            grid=[param.kmax, param.jmax, param.imax],
+            solver=param.tpu_solver, dtype=param.tpu_dtype,
+        )
         try:
             return _dispatch(param, prof)
         finally:
             # always stop an open XProf trace and print the region table, even
             # when the solver or a writer raises — that's the run worth
-            # profiling
+            # profiling. telemetry.finalize after prof.finalize: the region
+            # table is still populated (only reset() clears it) and lands in
+            # the JSONL finalize record; both are idempotent vs their atexit
+            # hooks
             prof.finalize()
+            telemetry.finalize()
 
 
 def _dispatch(param, prof) -> int:

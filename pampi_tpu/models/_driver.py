@@ -94,7 +94,15 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
         bar.update(t_old)
         if on_state is not None:
             on_state(old)
-        if t_old > te:
+        # NaN loop time is terminal, not "not yet past te": an adaptive-dt
+        # blow-up makes dt and then t NaN, every subsequent chunk is a
+        # device no-op (its while-cond sees NaN <= te false), and
+        # `t_old > te` is false for NaN — without this the loop would spin
+        # forever on no-op dispatches (the dist solvers' `while t <= te`
+        # already exits on NaN; this is the single-device twin). The
+        # telemetry sentinel, when enabled, has already named the
+        # last-good step by the time we land here.
+        if t_old > te or t_old != t_old:
             final = old
     bar.stop()
     return final

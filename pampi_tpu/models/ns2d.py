@@ -21,6 +21,8 @@ TPU-first design:
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -28,6 +30,7 @@ from jax import lax
 
 from ..ops import ns2d as ops
 from ..utils import flags as _flags
+from ..utils import telemetry as _tm
 from ..utils.datio import write_pressure, write_velocity
 from ..utils.params import Parameter, validate_obstacle_layout
 from ..utils.precision import resolve_dtype
@@ -135,7 +138,13 @@ class NS2DSolver:
             self.masks = obst.make_masks(fluid, self.dx, self.dy, param.omg, dtype)
         else:
             self.masks = None
+        t0 = time.perf_counter()
         self._chunk_fn = jax.jit(self._build_chunk())
+        from ..utils import dispatch as _dispatch
+
+        _tm.emit("build", family="ns2d", grid=[self.jmax, self.imax],
+                 trace_wall_s=round(time.perf_counter() - t0, 3),
+                 phases=_dispatch.last("ns2d_phases"))
 
     def _uses_pallas(self) -> bool:
         """Whether the current chunk contains ANY pallas kernel — the
@@ -324,14 +333,22 @@ class NS2DSolver:
 
         return step
 
-    def _build_fused_chunk(self, backend: str):
+    def _build_fused_chunk(self, backend: str, metrics: bool = False):
         """The fused-phase chunk: the non-solve step phases run as the two
         Pallas kernels of ops/ns2d_fused.py (BCs+FG+RHS before the solve,
         adaptUV+CFL-max after), the loop carries u/v in the kernels' padded
         layout plus the running (umax, vmax) scalars, and the timestep is
         pure scalar math (ops/ns2d.cfl_dt). Returns None when the fused
         path is not dispatched (knob off, jnp backend, no TPU, probe/VMEM
-        failure) — the caller falls back to the jnp chunk."""
+        failure) — the caller falls back to the jnp chunk.
+
+        metrics=True (PAMPI_TELEMETRY set at build time) additionally
+        threads the in-band telemetry vector through the chunk: the solve's
+        res/it and dt join the already-carried CFL maxima as f32 scalars,
+        plus the non-finite sentinel (utils/telemetry.sentinel_update) —
+        read out only at the chunk boundary where the host already syncs.
+        metrics=False takes the exact pre-telemetry trace (jaxpr identity,
+        tests/test_telemetry.py)."""
         from ..ops.ns2d_fused import probe_fused_2d
         from ..utils.dispatch import record, resolve_fuse_phases
 
@@ -471,6 +488,9 @@ class NS2DSolver:
             t_next = t + dt.astype(time_dtype)
             if _flags.verbose():
                 jax.debug.print("TIME {} , TIMESTEP {}", t_next, dt)
+            if metrics:
+                return (up, vp, p, t_next, nt + 1, umax, vmax,
+                        _res, _it, dt)
             return up, vp, p, t_next, nt + 1, umax, vmax
 
         def chunk_fn(u, v, p, t, nt):
@@ -496,14 +516,52 @@ class NS2DSolver:
             )
             return unpad(up), unpad(vp), unpad(p) if folded else p, t, nt
 
-        return chunk_fn
+        def chunk_fn_metrics(u, v, p, t, nt, m):
+            # the telemetry twin: same loop, the f32 metrics scalars ride
+            # the carry and pack into the in-band vector at the boundary
+            up, vp = pad(u), pad(v)
+            if folded:
+                p = pad(p)
+            umax = jnp.max(jnp.abs(u))
+            vmax = jnp.max(jnp.abs(v))
+
+            def cond(c):
+                return jnp.logical_and(c[3] <= te, c[7] < chunk)
+
+            def body(c):
+                up, vp, p, t, nt, umax, vmax, k, res, it, dtv, bad = c
+                up, vp, p, t, nt, umax, vmax, res, it, dtv = step(
+                    up, vp, p, t, nt, umax, vmax
+                )
+                # maxima stay native-dtype in the carry (the CFL scalars);
+                # metrics_step's f32 copies feed only the sentinel
+                res, it, dtv, _um, _vm, bad = _tm.metrics_step(
+                    bad, nt, res, it, dtv, umax, vmax)
+                return up, vp, p, t, nt, umax, vmax, k + 1, res, it, dtv, bad
+
+            (up, vp, p, t, nt, umax, vmax, _k,
+             res, it, dtv, bad) = lax.while_loop(
+                cond, body,
+                (up, vp, p, t, nt, umax, vmax, jnp.asarray(0, jnp.int32),
+                 m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT], m[_tm.M_BAD]),
+            )
+            m = _tm.metrics_pack(res, it, dtv, umax, vmax, 0.0, bad)
+            return (unpad(up), unpad(vp), unpad(p) if folded else p,
+                    t, nt, m)
+
+        return chunk_fn_metrics if metrics else chunk_fn
 
     def _build_chunk(self, backend: str = "auto"):
-        fused = self._build_fused_chunk(backend)
+        # telemetry is a trace-time decision, like utils/flags.py: unset
+        # means the chunk below is byte-identical to the uninstrumented
+        # program (asserted by tests/test_telemetry.py)
+        metrics = _tm.enabled()
+        self._metrics = metrics
+        fused = self._build_fused_chunk(backend, metrics=metrics)
         self._fused = fused is not None
         if fused is not None:
             return fused
-        step = self._build_step(backend)
+        step = self._build_step(backend, instrumented=metrics)
         te = self.param.te
         chunk = self.param.tpu_chunk or self.CHUNK
 
@@ -522,9 +580,47 @@ class NS2DSolver:
             )
             return u, v, p, t, nt
 
-        return chunk_fn
+        def chunk_fn_metrics(u, v, p, t, nt, m):
+            # the telemetry twin of chunk_fn: the instrumented step exposes
+            # the solve's discarded res/it plus dt; |u|/|v| maxima are the
+            # two extra fused reductions this path did not already carry
+            def cond(c):
+                return jnp.logical_and(c[3] <= te, c[5] < chunk)
+
+            def body(c):
+                u, v, p, t, nt, k, res, it, dtv, um, vm, bad = c
+                u, v, p, t, nt, res, it, dtv = step(u, v, p, t, nt)
+                res, it, dtv, um, vm, bad = _tm.metrics_step(
+                    bad, nt, res, it, dtv,
+                    ops.max_element(u), ops.max_element(v))
+                return u, v, p, t, nt, k + 1, res, it, dtv, um, vm, bad
+
+            (u, v, p, t, nt, _k, res, it, dtv, um, vm, bad) = lax.while_loop(
+                cond, body,
+                (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
+                 m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                 m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_BAD]),
+            )
+            return u, v, p, t, nt, _tm.metrics_pack(
+                res, it, dtv, um, vm, 0.0, bad)
+
+        return chunk_fn_metrics if metrics else chunk_fn
 
     # -- driver API ----------------------------------------------------
+    def initial_state(self) -> tuple:
+        """The chunk-call state tuple matching the built chunk's arity —
+        (u, v, p, t, nt), plus the in-band telemetry metrics vector when
+        PAMPI_TELEMETRY was set at build time. The measurement tools
+        (bench.py, tools/northstar.py) call the chunk with this instead of
+        hand-building the tuple, so the telemetry arity cannot drift."""
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        state = (self.u, self.v, self.p,
+                 jnp.asarray(self.t, time_dtype),
+                 jnp.asarray(self.nt, jnp.int32))
+        if getattr(self, "_metrics", False):
+            state = state + (_tm.metrics_init(),)
+        return state
+
     def run(self, progress: bool = True, on_sync=None) -> None:
         """Advance from t to te. `on_sync(self)` fires at each host sync
         (every CHUNK device steps) — the checkpoint hook point. Loop + retry
@@ -532,16 +628,16 @@ class NS2DSolver:
         from ._driver import drive_chunks, pallas_retry
 
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
-        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        state = (self.u, self.v, self.p,
-                 jnp.asarray(self.t, time_dtype),
-                 jnp.asarray(self.nt, jnp.int32))
+        state = self.initial_state()
+        rec = _tm.ChunkRecorder("ns2d", self.nt) if self._metrics else None
 
         def publish(s):
             self.u, self.v, self.p = s[0], s[1], s[2]
             self.t, self.nt = float(s[3]), int(s[4])
 
         def on_state(s):
+            if rec is not None:
+                rec.update(float(s[3]), int(s[4]), s[5])
             if on_sync is not None:
                 publish(s)
                 on_sync(self)

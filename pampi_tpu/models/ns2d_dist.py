@@ -31,6 +31,8 @@ physical walls, reproducing the sequential full-array mean (solver.c:204).
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -65,6 +67,7 @@ from ..parallel.stencil2d import (
 )
 from ..utils import dispatch as _dispatch
 from ..utils import flags as _flags
+from ..utils import telemetry as _tm
 from ..utils.datio import write_pressure, write_velocity
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -83,6 +86,11 @@ class NS2DDistSolver:
     CHUNK = 64
 
     def __init__(self, param: Parameter, comm: CartComm | None = None, dtype=None):
+        self._t0_build = time.perf_counter()
+        # telemetry is a trace-time decision (utils/flags.py convention):
+        # unset leaves every traced program below byte-identical
+        metrics = _tm.enabled()
+        self._metrics = metrics
         if dtype is None:
             dtype = resolve_dtype(param.tpu_dtype)
         if param.tpu_solver == "sor_lex":
@@ -155,6 +163,7 @@ class NS2DDistSolver:
         comm = self.comm
         param = self.param
         dtype = self.dtype
+        metrics = self._metrics  # trace-time telemetry gate (see __init__)
         jl, il = self.jl, self.il
         dx, dy = self.dx, self.dy
         Pj = comm.axis_size("j")
@@ -598,10 +607,10 @@ class NS2DDistSolver:
             rhs = ops.compute_rhs(f, g, dt, dx, dy)
             p = lax.cond(nt % 100 == 0, normalize_pressure, lambda q: q, p)
             p, res, it = solve(p, rhs)
-            return u, v, f, g, rhs, p, dt
+            return u, v, f, g, rhs, p, dt, res, it
 
         def step(u, v, p, t, nt):
-            u, v, f, g, _rhs, p, dt = step_phases(u, v, p, nt)
+            u, v, f, g, _rhs, p, dt, res, it = step_phases(u, v, p, nt)
 
             def adapt(u, v):
                 if gmasks is not None:
@@ -638,6 +647,12 @@ class NS2DDistSolver:
             if _flags.verbose():
                 # printed AFTER t += dt, matching A5 main.c:52-57
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            if metrics:
+                # mesh-global |u|/|v| maxima (replicated, like res) — the
+                # in-band telemetry scalars; Allreduce MAX only on this path
+                um = reduction(jnp.max(jnp.abs(u)), comm, "max")
+                vm = reduction(jnp.max(jnp.abs(v)), comm, "max")
+                return u, v, p, t_next, nt + 1, res, it, dt, um, vm
             return u, v, p, t_next, nt + 1
 
         def step_fused(u, v, p, t, nt):
@@ -672,7 +687,7 @@ class NS2DDistSolver:
             rhs = strip_deep(unpad_deep(rpd), H)
             p = lax.cond(nt % 100 == 0, normalize_pressure, lambda q: q, p)
             p, _res, _it = solve(p, rhs)
-            up, vp, _um, _vm = post_k(
+            up, vp, um_l, vm_l = post_k(
                 offs, dt11, pad_ext(u), pad_ext(v), pad_ext(f), pad_ext(g),
                 pad_ext(p), *post_extra,
             )
@@ -681,6 +696,12 @@ class NS2DDistSolver:
             t_next = t + dt.astype(idx_dtype)
             if _flags.verbose():
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            if metrics:
+                # the POST kernel's carried maxima are per-shard: one
+                # Allreduce MAX makes them the global telemetry scalars
+                um = reduction(um_l, comm, "max")
+                vm = reduction(vm_l, comm, "max")
+                return u, v, p, t_next, nt + 1, _res, _it, dt, um, vm
             return u, v, p, t_next, nt + 1
 
         step_impl = step if fused_k is None else step_fused
@@ -702,6 +723,30 @@ class NS2DDistSolver:
             )
             return u, v, p, t, nt
 
+        def chunk_kernel_metrics(u, v, p, t, nt, m):
+            # the telemetry twin: replicated f32 metrics scalars ride the
+            # carry, packed into the in-band vector at the chunk boundary
+            def cond(c):
+                return jnp.logical_and(c[3] <= te, c[5] < chunk)
+
+            def body(c):
+                u, v, p, t, nt, k, res, it, dtv, um, vm, bad = c
+                u, v, p, t, nt, res, it, dtv, um, vm = step_impl(
+                    u, v, p, t, nt
+                )
+                res, it, dtv, um, vm, bad = _tm.metrics_step(
+                    bad, nt, res, it, dtv, um, vm)
+                return u, v, p, t, nt, k + 1, res, it, dtv, um, vm, bad
+
+            (u, v, p, t, nt, _k, res, it, dtv, um, vm, bad) = lax.while_loop(
+                cond, body,
+                (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
+                 m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                 m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_BAD]),
+            )
+            return u, v, p, t, nt, _tm.metrics_pack(
+                res, it, dtv, um, vm, 0.0, bad)
+
         def init_kernel():
             shape = (jl + 2, il + 2)
             u = jnp.full(shape, param.u_init, dtype)
@@ -714,31 +759,78 @@ class NS2DDistSolver:
             comm.shard_map(
                 step_phases,
                 in_specs=(spec, spec, spec, P()),
-                out_specs=(spec,) * 6 + (P(),),
+                out_specs=(spec,) * 6 + (P(), P(), P()),
                 check_vma=not pallas_q,
             )
         )
         self._init_sm = jax.jit(
             comm.shard_map(init_kernel, in_specs=(), out_specs=(spec,) * 3)
         )
+        mextra = (P(),) if metrics else ()
         self._chunk_sm = jax.jit(
             comm.shard_map(
-                chunk_kernel,
-                in_specs=(spec, spec, spec, P(), P()),
-                out_specs=(spec, spec, spec, P(), P()),
+                chunk_kernel_metrics if metrics else chunk_kernel,
+                in_specs=(spec, spec, spec, P(), P()) + mextra,
+                out_specs=(spec, spec, spec, P(), P()) + mextra,
                 check_vma=not pallas_q,
             )
         )
+        _tm.emit("build", family="ns2d_dist",
+                 grid=[self.jmax, self.imax], mesh=list(comm.dims),
+                 trace_wall_s=round(time.perf_counter() - self._t0_build, 3),
+                 phases=_dispatch.last("ns2d_dist_phases"))
+        if _tm.enabled():
+            # static per-shard halo-exchange byte counts (the step-level
+            # exchanges of the path actually dispatched; the pressure
+            # solve's internal exchanges depend on CA depth/iteration count
+            # and are excluded — see utils/telemetry.py)
+            isz = jnp.dtype(dtype).itemsize
+            rec = {
+                "family": "ns2d_dist", "mesh": list(comm.dims),
+                "shard": [jl, il], "dtype": str(jnp.dtype(dtype)),
+                "path": "fused" if fused_k is not None else "jnp",
+                "exchange_bytes_depth1":
+                    _tm.halo_exchange_bytes((jl, il), 1, isz),
+            }
+            if fused_k is not None:
+                rec.update(
+                    deep_halo=FUSE_DEEP_HALO,
+                    deep_exchange_bytes=_tm.halo_exchange_bytes(
+                        (jl, il), FUSE_DEEP_HALO, isz),
+                    exchanges_per_step={"deep": 2},
+                )
+            else:
+                rec.update(exchanges_per_step={
+                    "depth1": 4 + (2 if gmasks is not None else 0),
+                    "shift": 2,
+                })
+            _tm.emit("halo", **rec)
 
     # ------------------------------------------------------------------
+    def initial_state(self) -> tuple:
+        """(u, v, p, t, nt[, metrics]) matching the built chunk's arity
+        (the NS-2D convention — see models/ns2d.initial_state)."""
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        state = (self.u, self.v, self.p,
+                 jnp.asarray(self.t, time_dtype),
+                 jnp.asarray(self.nt, jnp.int32))
+        if self._metrics:
+            state = state + (_tm.metrics_init(),)
+        return state
+
     def run(self, progress: bool = True, on_sync=None) -> None:
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
-        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        t = jnp.asarray(self.t, time_dtype)
-        nt = jnp.asarray(self.nt, jnp.int32)
-        u, v, p = self.u, self.v, self.p
+        state = self.initial_state()
+        u, v, p, t, nt = state[:5]
+        m = state[5] if self._metrics else None
+        rec = (_tm.ChunkRecorder("ns2d_dist", self.nt)
+               if self._metrics else None)
         while float(t) <= self.param.te:
-            u, v, p, t, nt = self._chunk_sm(u, v, p, t, nt)
+            if self._metrics:
+                u, v, p, t, nt, m = self._chunk_sm(u, v, p, t, nt, m)
+                rec.update(float(t), int(nt), m)
+            else:
+                u, v, p, t, nt = self._chunk_sm(u, v, p, t, nt)
             bar.update(float(t))
             if on_sync is not None:
                 self.u, self.v, self.p = u, v, p

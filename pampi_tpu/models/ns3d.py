@@ -20,6 +20,8 @@ Time loop runs on-device in host-synced chunks like NS-2D.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -27,6 +29,7 @@ from jax import lax
 
 from ..ops import ns3d as ops
 from ..utils import flags as _flags
+from ..utils import telemetry as _tm
 from ..utils.grid import Grid
 from ..utils.params import Parameter, validate_obstacle_layout
 from ..utils.precision import resolve_dtype
@@ -271,7 +274,14 @@ class NS3DSolver:
             )
         else:
             self.masks = None
+        t0 = time.perf_counter()
         self._chunk_fn = jax.jit(self._build_chunk())
+        from ..utils import dispatch as _dispatch
+
+        _tm.emit("build", family="ns3d",
+                 grid=[g.kmax, g.jmax, g.imax],
+                 trace_wall_s=round(time.perf_counter() - t0, 3),
+                 phases=_dispatch.last("ns3d_phases"))
 
     def _uses_pallas(self) -> bool:
         if self._fused:
@@ -319,7 +329,11 @@ class NS3DSolver:
             )
         return solve
 
-    def _build_step(self, backend: str = "auto"):
+    def _build_step(self, backend: str = "auto", instrumented: bool = False):
+        """One traced timestep. instrumented=True returns the SAME pipeline
+        with the solve's discarded outputs exposed —
+        (u, v, w, p, t, nt, res, it, dt) — the telemetry chunk's source
+        (the NS-2D convention, models/ns2d.py)."""
         param = self.param
         g = self.grid
         dtype = self.dtype
@@ -376,11 +390,13 @@ class NS3DSolver:
             if _flags.verbose():
                 # printed AFTER t += dt, matching A6 main.c:58-62
                 jax.debug.print("TIME {} , TIMESTEP {}", t_next, dt)
+            if instrumented:
+                return u, v, w, p, t_next, nt + 1, _res, _it, dt
             return u, v, w, p, t_next, nt + 1
 
         return step
 
-    def _build_fused_chunk(self, backend: str):
+    def _build_fused_chunk(self, backend: str, metrics: bool = False):
         """The 3-D fused-phase chunk (ops/ns3d_fused.py): the non-solve
         phases run as two Pallas kernels around the solve, the loop carries
         u/v/w in the padded layout plus the running (umax, vmax, wmax),
@@ -433,6 +449,9 @@ class NS3DSolver:
             t_next = t + dt.astype(time_dtype)
             if _flags.verbose():
                 jax.debug.print("TIME {} , TIMESTEP {}", t_next, dt)
+            if metrics:
+                return (up, vp, wp, p, t_next, nt + 1, umax, vmax, wmax,
+                        _res, _it, dt)
             return up, vp, wp, p, t_next, nt + 1, umax, vmax, wmax
 
         def chunk_fn(u, v, w, p, t, nt):
@@ -458,14 +477,50 @@ class NS3DSolver:
             )
             return unpad3(up), unpad3(vp), unpad3(wp), p, t, nt
 
-        return chunk_fn
+        def chunk_fn_metrics(u, v, w, p, t, nt, m):
+            # the telemetry twin: the carried CFL maxima and the solve's
+            # res/it pack into the in-band vector at the chunk boundary
+            up, vp, wp = pad3(u), pad3(v), pad3(w)
+            umax = jnp.max(jnp.abs(u))
+            vmax = jnp.max(jnp.abs(v))
+            wmax = jnp.max(jnp.abs(w))
+
+            def cond(c):
+                return jnp.logical_and(c[4] <= te, c[9] < chunk)
+
+            def body(c):
+                (up, vp, wp, p, t, nt, um, vm, wm, k,
+                 res, it, dtv, bad) = c
+                (up, vp, wp, p, t, nt, um, vm, wm,
+                 res, it, dtv) = step(up, vp, wp, p, t, nt, um, vm, wm)
+                # maxima stay native-dtype in the carry (the CFL scalars)
+                res, it, dtv, _u, _v, _w, bad = _tm.metrics_step(
+                    bad, nt, res, it, dtv, um, vm, wm)
+                return (up, vp, wp, p, t, nt, um, vm, wm, k + 1,
+                        res, it, dtv, bad)
+
+            (up, vp, wp, p, t, nt, um, vm, wm, _k,
+             res, it, dtv, bad) = lax.while_loop(
+                cond, body,
+                (up, vp, wp, p, t, nt, umax, vmax, wmax,
+                 jnp.asarray(0, jnp.int32),
+                 m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT], m[_tm.M_BAD]),
+            )
+            return (unpad3(up), unpad3(vp), unpad3(wp), p, t, nt,
+                    _tm.metrics_pack(res, it, dtv, um, vm, wm, bad))
+
+        return chunk_fn_metrics if metrics else chunk_fn
 
     def _build_chunk(self, backend: str = "auto"):
-        fused = self._build_fused_chunk(backend)
+        # trace-time telemetry gate (utils/flags.py convention): unset means
+        # the chunk below is byte-identical to the uninstrumented program
+        metrics = _tm.enabled()
+        self._metrics = metrics
+        fused = self._build_fused_chunk(backend, metrics=metrics)
         self._fused = fused is not None
         if fused is not None:
             return fused
-        step = self._build_step(backend)
+        step = self._build_step(backend, instrumented=metrics)
         te = self.param.te
         chunk = self.param.tpu_chunk or self.CHUNK
 
@@ -483,22 +538,57 @@ class NS3DSolver:
             )
             return u, v, w, p, t, nt
 
-        return chunk_fn
+        def chunk_fn_metrics(u, v, w, p, t, nt, m):
+            def cond(c):
+                return jnp.logical_and(c[4] <= te, c[6] < chunk)
+
+            def body(c):
+                u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm, bad = c
+                u, v, w, p, t, nt, res, it, dtv = step(u, v, w, p, t, nt)
+                res, it, dtv, um, vm, wm, bad = _tm.metrics_step(
+                    bad, nt, res, it, dtv, ops.max_element(u),
+                    ops.max_element(v), ops.max_element(w))
+                return (u, v, w, p, t, nt, k + 1,
+                        res, it, dtv, um, vm, wm, bad)
+
+            (u, v, w, p, t, nt, _k,
+             res, it, dtv, um, vm, wm, bad) = lax.while_loop(
+                cond, body,
+                (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
+                 m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                 m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_WMAX],
+                 m[_tm.M_BAD]),
+            )
+            return u, v, w, p, t, nt, _tm.metrics_pack(
+                res, it, dtv, um, vm, wm, bad)
+
+        return chunk_fn_metrics if metrics else chunk_fn
+
+    def initial_state(self) -> tuple:
+        """(u, v, w, p, t, nt[, metrics]) matching the built chunk's arity
+        (the NS-2D convention — see models/ns2d.initial_state)."""
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        state = (self.u, self.v, self.w, self.p,
+                 jnp.asarray(self.t, time_dtype),
+                 jnp.asarray(self.nt, jnp.int32))
+        if getattr(self, "_metrics", False):
+            state = state + (_tm.metrics_init(),)
+        return state
 
     def run(self, progress: bool = True, on_sync=None) -> None:
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
-        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        t = jnp.asarray(self.t, time_dtype)
-        nt = jnp.asarray(self.nt, jnp.int32)
         from ._driver import drive_chunks, pallas_retry
 
-        state = (self.u, self.v, self.w, self.p, t, nt)
+        state = self.initial_state()
+        rec = _tm.ChunkRecorder("ns3d", self.nt) if self._metrics else None
 
         def publish(s):
             self.u, self.v, self.w, self.p = s[0], s[1], s[2], s[3]
             self.t, self.nt = float(s[4]), int(s[5])
 
         def on_state(s):
+            if rec is not None:
+                rec.update(float(s[4]), int(s[5]), s[6])
             if on_sync is not None:
                 publish(s)
                 on_sync(self)

@@ -21,6 +21,8 @@ solve loop (≙ trailing :288).
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -57,6 +59,7 @@ from ..parallel.stencil3d import (
 )
 from ..utils import dispatch as _dispatch
 from ..utils import flags as _flags
+from ..utils import telemetry as _tm
 from ..utils.grid import Grid
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -76,6 +79,10 @@ class NS3DDistSolver:
     CHUNK = 32
 
     def __init__(self, param: Parameter, comm: CartComm | None = None, dtype=None):
+        self._t0_build = time.perf_counter()
+        # trace-time telemetry gate (utils/flags.py convention)
+        metrics = _tm.enabled()
+        self._metrics = metrics
         if dtype is None:
             dtype = resolve_dtype(param.tpu_dtype)
         self.param = param
@@ -150,6 +157,7 @@ class NS3DDistSolver:
         param = self.param
         g = self.grid
         dtype = self.dtype
+        metrics = self._metrics  # trace-time telemetry gate (see __init__)
         kl, jl, il = self.kl, self.jl, self.il
         dx, dy, dz = g.dx, g.dy, g.dz
 
@@ -518,7 +526,8 @@ class NS3DDistSolver:
             g_ = halo_shift(g_, comm, "j")
             h = halo_shift(h, comm, "k")
             rhs = ops.compute_rhs(f, g_, h, dt, dx, dy, dz)
-            p, _res, _it = solve(p, rhs)
+            p, res, it = solve(p, rhs)
+
             def adapt(u, v, w):
                 if gmasks is not None:
                     return adapt_uvw_obstacle(
@@ -552,6 +561,12 @@ class NS3DDistSolver:
             if _flags.verbose():
                 # printed AFTER t += dt, matching A6 main.c:58-62
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            if metrics:
+                # mesh-global maxima (replicated) — telemetry scalars
+                um = reduction(jnp.max(jnp.abs(u)), comm, "max")
+                vm = reduction(jnp.max(jnp.abs(v)), comm, "max")
+                wm = reduction(jnp.max(jnp.abs(w)), comm, "max")
+                return u, v, w, p, t_next, nt + 1, res, it, dt, um, vm, wm
             return u, v, w, p, t_next, nt + 1
 
         def step_fused(u, v, w, p, t, nt):
@@ -591,7 +606,7 @@ class NS3DDistSolver:
             h = strip_deep(unpad_deep(hpd), H)
             rhs = strip_deep(unpad_deep(rpd), H)
             p, _res, _it = solve(p, rhs)
-            up, vp, wp, _um, _vm, _wm = post_k(
+            up, vp, wp, um_l, vm_l, wm_l = post_k(
                 offs, dt11, pad_ext(u), pad_ext(v), pad_ext(w),
                 pad_ext(f), pad_ext(g_), pad_ext(h), pad_ext(p),
                 *post_extra,
@@ -602,6 +617,14 @@ class NS3DDistSolver:
             t_next = t + dt.astype(idx_dtype)
             if _flags.verbose():
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            if metrics:
+                # the POST kernel's maxima are per-shard: Allreduce MAX
+                # makes them the global telemetry scalars
+                um = reduction(um_l, comm, "max")
+                vm = reduction(vm_l, comm, "max")
+                wm = reduction(wm_l, comm, "max")
+                return (u, v, w, p, t_next, nt + 1, _res, _it, dt,
+                        um, vm, wm)
             return u, v, w, p, t_next, nt + 1
 
         step_impl = step if fused_k is None else step_fused
@@ -621,6 +644,31 @@ class NS3DDistSolver:
                 cond, body, (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32))
             )
             return u, v, w, p, t, nt
+
+        def chunk_kernel_metrics(u, v, w, p, t, nt, m):
+            # the telemetry twin (see models/ns2d_dist.py)
+            def cond(c):
+                return jnp.logical_and(c[4] <= te, c[6] < chunk)
+
+            def body(c):
+                u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm, bad = c
+                (u, v, w, p, t, nt,
+                 res, it, dtv, um, vm, wm) = step_impl(u, v, w, p, t, nt)
+                res, it, dtv, um, vm, wm, bad = _tm.metrics_step(
+                    bad, nt, res, it, dtv, um, vm, wm)
+                return (u, v, w, p, t, nt, k + 1,
+                        res, it, dtv, um, vm, wm, bad)
+
+            (u, v, w, p, t, nt, _k,
+             res, it, dtv, um, vm, wm, bad) = lax.while_loop(
+                cond, body,
+                (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
+                 m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                 m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_WMAX],
+                 m[_tm.M_BAD]),
+            )
+            return u, v, w, p, t, nt, _tm.metrics_pack(
+                res, it, dtv, um, vm, wm, bad)
 
         def init_kernel():
             shape = (kl + 2, jl + 2, il + 2)
@@ -647,27 +695,72 @@ class NS3DDistSolver:
         self._init_sm = jax.jit(
             comm.shard_map(init_kernel, in_specs=(), out_specs=(spec,) * 4)
         )
+        mextra = (P(),) if metrics else ()
         self._chunk_sm = jax.jit(
             comm.shard_map(
-                chunk_kernel,
-                in_specs=(spec,) * 4 + (P(), P()),
-                out_specs=(spec,) * 4 + (P(), P()),
+                chunk_kernel_metrics if metrics else chunk_kernel,
+                in_specs=(spec,) * 4 + (P(), P()) + mextra,
+                out_specs=(spec,) * 4 + (P(), P()) + mextra,
                 check_vma=not pallas_o,
             )
         )
         self._collect_sm = jax.jit(
             comm.shard_map(collect_kernel, in_specs=(spec,) * 4, out_specs=(spec,) * 4)
         )
+        _tm.emit("build", family="ns3d_dist",
+                 grid=[g.kmax, g.jmax, g.imax], mesh=list(comm.dims),
+                 trace_wall_s=round(time.perf_counter() - self._t0_build, 3),
+                 phases=_dispatch.last("ns3d_dist_phases"))
+        if _tm.enabled():
+            # static per-shard halo-exchange byte counts (step-level
+            # exchanges of the dispatched path; solve internals excluded)
+            isz = jnp.dtype(dtype).itemsize
+            rec = {
+                "family": "ns3d_dist", "mesh": list(comm.dims),
+                "shard": [kl, jl, il], "dtype": str(jnp.dtype(dtype)),
+                "path": "fused" if fused_k is not None else "jnp",
+                "exchange_bytes_depth1":
+                    _tm.halo_exchange_bytes((kl, jl, il), 1, isz),
+            }
+            if fused_k is not None:
+                rec.update(
+                    deep_halo=FUSE_DEEP_HALO,
+                    deep_exchange_bytes=_tm.halo_exchange_bytes(
+                        (kl, jl, il), FUSE_DEEP_HALO, isz),
+                    exchanges_per_step={"deep": 3},
+                )
+            else:
+                rec.update(exchanges_per_step={
+                    "depth1": 6 + (3 if gmasks is not None else 0),
+                    "shift": 3,
+                })
+            _tm.emit("halo", **rec)
 
     # ------------------------------------------------------------------
+    def initial_state(self) -> tuple:
+        """(u, v, w, p, t, nt[, metrics]) matching the built chunk's arity
+        (the NS-2D convention — see models/ns2d.initial_state)."""
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        state = (self.u, self.v, self.w, self.p,
+                 jnp.asarray(self.t, time_dtype),
+                 jnp.asarray(self.nt, jnp.int32))
+        if self._metrics:
+            state = state + (_tm.metrics_init(),)
+        return state
+
     def run(self, progress: bool = True, on_sync=None) -> None:
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
-        time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        t = jnp.asarray(self.t, time_dtype)
-        nt = jnp.asarray(self.nt, jnp.int32)
-        u, v, w, p = self.u, self.v, self.w, self.p
+        state = self.initial_state()
+        u, v, w, p, t, nt = state[:6]
+        m = state[6] if self._metrics else None
+        rec = (_tm.ChunkRecorder("ns3d_dist", self.nt)
+               if self._metrics else None)
         while float(t) <= self.param.te:
-            u, v, w, p, t, nt = self._chunk_sm(u, v, w, p, t, nt)
+            if self._metrics:
+                u, v, w, p, t, nt, m = self._chunk_sm(u, v, w, p, t, nt, m)
+                rec.update(float(t), int(nt), m)
+            else:
+                u, v, w, p, t, nt = self._chunk_sm(u, v, w, p, t, nt)
             bar.update(float(t))
             if on_sync is not None:
                 self.u, self.v, self.w, self.p = u, v, w, p

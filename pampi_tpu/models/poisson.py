@@ -494,6 +494,12 @@ class PoissonSolver:
         )
 
     def solve(self):
+        import math
+        import time
+
+        from ..utils import telemetry as _tm
+
+        t0 = time.perf_counter()
         try:
             p, res, it = self._solve(self.p, self.rhs)
             # dispatch is async: force completion inside the try so a pallas
@@ -511,6 +517,14 @@ class PoissonSolver:
             p, res, it = self._solve(self.p, self.rhs)
             out = int(it), float(res)
         self.p = p
+        # host-plane flight record: the (it, res) pair already crosses to
+        # the host here, so the record costs nothing extra on-device
+        _tm.emit("solve", family="poisson", iters=out[0], res=out[1],
+                 wall_s=round(time.perf_counter() - t0, 4),
+                 backend=self._backend)
+        if not math.isfinite(out[1]):
+            _tm.emit("divergence", family="poisson", res=out[1],
+                     iters=out[0])
         return out
 
     def write_result(self, path: str = "p.dat") -> None:

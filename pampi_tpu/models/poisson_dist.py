@@ -313,10 +313,22 @@ class DistPoissonSolver:
 
     # -- driver API ----------------------------------------------------
     def solve(self):
+        import math
+        import time
+
+        from ..utils import telemetry as _tm
+
+        t0 = time.perf_counter()
         fn = self._solve_resume if self._started else self._solve_first
         self._started = True
         self.p, res, it = fn(self.p)
         self.res, self.it = float(res), int(it)
+        _tm.emit("solve", family="poisson_dist", iters=self.it,
+                 res=self.res, wall_s=round(time.perf_counter() - t0, 4),
+                 mesh=list(self.comm.dims))
+        if not math.isfinite(self.res):
+            _tm.emit("divergence", family="poisson_dist", res=self.res,
+                     iters=self.it)
         return self.it, self.res
 
     def full_field(self) -> np.ndarray:

@@ -12,6 +12,11 @@ _RECORD: dict[str, str] = {}
 
 def record(key: str, value: str) -> None:
     _RECORD[key] = value
+    # stream the decision to the flight recorder (no-op when PAMPI_TELEMETRY
+    # is unset) — dryrun artifacts and the run report show every dispatch
+    from . import telemetry
+
+    telemetry.emit("dispatch", key=key, value=value)
 
 
 def last(key: str) -> str | None:

@@ -38,6 +38,8 @@ _times: dict[str, float] = defaultdict(float)
 _counts: dict[str, int] = defaultdict(int)
 _device_times: dict[str, float] = defaultdict(float)
 _tracing = False
+_finalized = False
+_atexit_registered = False
 
 
 def enabled() -> bool:
@@ -45,10 +47,18 @@ def enabled() -> bool:
 
 
 def init() -> None:
-    """≙ LIKWID_MARKER_INIT."""
-    global _tracing
+    """≙ LIKWID_MARKER_INIT. Also arms the atexit finalize hook so the
+    region table / PAMPI_PROFILE_CSV survives a driver that exits early or
+    raises without reaching its own finalize() call."""
+    global _tracing, _finalized, _atexit_registered
     if not enabled():
         return
+    _finalized = False  # re-arm after a prior finalize (init/finalize pairs)
+    if not _atexit_registered:
+        import atexit
+
+        atexit.register(finalize)
+        _atexit_registered = True
     if _MODE != "1":
         import jax
 
@@ -85,13 +95,33 @@ def add_device_time(name: str, seconds: float, calls: int = 1) -> None:
     _counts[name] += calls
 
 
+def table() -> dict[str, dict]:
+    """The region table as data ({region: {calls, wall_s, device_s}}) —
+    the telemetry finalize record's source; empty when nothing recorded."""
+    names = set(_times) | set(_device_times)
+    return {
+        name: {
+            "calls": _counts[name],
+            "wall_s": round(_times[name], 6) if name in _times else None,
+            "device_s": (
+                round(_device_times[name], 6)
+                if name in _device_times else None
+            ),
+        }
+        for name in names
+    }
+
+
 def finalize(out=None) -> None:
     """≙ LIKWID_MARKER_CLOSE: stop the trace, print the region table, and
-    write the CSV twin when PAMPI_PROFILE_CSV is set."""
-    global _tracing
+    write the CSV twin when PAMPI_PROFILE_CSV is set. Idempotent: the
+    atexit hook and an explicit driver call must not print the table (or
+    rewrite the CSV) twice; init() re-arms."""
+    global _tracing, _finalized
     out = out if out is not None else sys.stderr
-    if not enabled():
+    if not enabled() or _finalized:
         return
+    _finalized = True
     if _tracing:
         import jax
 
@@ -122,6 +152,8 @@ def finalize(out=None) -> None:
 
 
 def reset() -> None:
+    global _finalized
     _times.clear()
     _counts.clear()
     _device_times.clear()
+    _finalized = False

@@ -1,0 +1,348 @@
+"""Flight-recorder telemetry: in-band solver metrics + a JSONL run log.
+
+Two planes, one switch (`PAMPI_TELEMETRY=<path>`, read at trace/call time
+like `utils/flags.py` — unset means every call is a no-op and the traced
+programs are UNCHANGED, test-asserted in tests/test_telemetry.py):
+
+Device plane — the jitted chunk already carries scalars (the fused-phase
+CFL maxima); with telemetry enabled the chunk additionally carries a small
+METRICS vector (layout below): final pressure residual, solve iterations,
+dt, velocity maxima, and a non-finite sentinel derived from those carried
+scalars. The vector is read out only at chunk boundaries, where the host
+already syncs on the loop time — the hot loop gains ZERO extra launches or
+syncs; the extra per-step work is a handful of fused scalar ops (plus the
+|u|/|v| max reductions on paths that did not already carry them). The
+sentinel records the step count at which the state FIRST went non-finite,
+upgrading a blow-up from silent NaN garbage to a structured diagnostic
+naming the last-good step.
+
+Host plane — every record is one JSON line appended to the
+`PAMPI_TELEMETRY` file, schema-versioned (`"v"`) and kind-tagged:
+
+  run         process/run metadata (emitted once, before any other record)
+  dispatch    a `utils/dispatch.record` decision (streamed as it happens)
+  build       solver construction: per-family trace/build wall time
+  chunk       one host sync: steps, wall, ms/step, res/it/dt/maxima; the
+              FIRST chunk record is compile-inclusive (includes_compile)
+  divergence  the sentinel fired: first_bad_step / last_good_step
+  solve       a driver-level Poisson solve (iters, residual, wall)
+  halo        static per-shard halo-exchange byte counts (dist solvers)
+  span        a named timing span — the ONE decomposition protocol the
+              perf tools share (bench.py, tools/northstar.py, tools/perf_*)
+  metric      a headline metric line (bench.py's JSON lines, artifacts)
+  finalize    end of run: the `utils/profiling` region table
+
+Multi-process runs emit from process 0 only. `tools/telemetry_report.py`
+aggregates a JSONL into a human-readable report and a summary block for
+the BENCH/MULTICHIP artifacts.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import time
+import warnings
+
+SCHEMA_VERSION = 1
+
+# METRICS vector layout (float32, shared by the 2-D and 3-D families; the
+# 2-D solvers leave M_WMAX at 0). M_BAD < 0 means all-finite so far;
+# otherwise it holds the step count `nt` AFTER which the carried scalars
+# first went non-finite (so the last fully-good step is M_BAD - 1).
+M_RES, M_IT, M_DT, M_UMAX, M_VMAX, M_WMAX, M_BAD = range(7)
+METRICS_LEN = 7
+
+_run_emitted = False
+_finalized = False
+_atexit_registered = False
+_write_failed = False
+
+
+def _path() -> str:
+    return os.environ.get("PAMPI_TELEMETRY", "")
+
+
+def enabled() -> bool:
+    return bool(_path())
+
+
+def reset() -> None:
+    """Re-arm the per-process one-shot records (tests)."""
+    global _run_emitted, _finalized, _write_failed
+    _run_emitted = False
+    _finalized = False
+    _write_failed = False
+
+
+def _is_master() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:  # jax not initialised yet — single process
+        return True
+
+
+def emit(kind: str, **fields) -> None:
+    """Append one schema-versioned record; no-op when disabled. A write
+    failure (bad path, full disk) costs the flight record, never the run:
+    warn once and stand down instead of sinking the solver or a bench
+    headline behind an observability layer."""
+    global _atexit_registered, _write_failed
+    if not enabled() or _write_failed or not _is_master():
+        return
+    if kind != "run":
+        _ensure_run()
+    if not _atexit_registered:
+        # the finalize record must survive a driver that exits early or
+        # raises (the same contract as profiling.finalize's atexit hook)
+        import atexit
+
+        atexit.register(finalize)
+        _atexit_registered = True
+    rec = {"v": SCHEMA_VERSION, "kind": kind, "ts": round(time.time(), 3)}
+    rec.update(fields)
+    try:
+        with open(_path(), "a") as fh:
+            # allow_nan=False + the sanitizer: divergence records carry
+            # non-finite scalars BY DESIGN, and Python's default NaN/Inf
+            # tokens are invalid JSON for every strict parser downstream
+            # (jq, JS, a --merge'd committed artifact) — encode them as
+            # strings ("nan"/"inf"/"-inf"; float() round-trips them)
+            fh.write(json.dumps(_json_safe(rec), allow_nan=False) + "\n")
+    except OSError as exc:
+        _write_failed = True
+        warnings.warn(
+            f"PAMPI_TELEMETRY write to {_path()!r} failed ({exc}); "
+            "telemetry disabled for the rest of this run",
+            stacklevel=2,
+        )
+
+
+def _json_safe(x):
+    """Strict-JSON encoding of non-finite floats as strings (recursive)."""
+    if isinstance(x, float) and not math.isfinite(x):
+        return repr(x)  # "nan" / "inf" / "-inf" — float() round-trips
+    if isinstance(x, dict):
+        return {k: _json_safe(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_json_safe(v) for v in x]
+    return x
+
+
+def _run_meta() -> dict:
+    import sys
+
+    meta = {"argv": sys.argv, "pid": os.getpid()}
+    try:
+        import jax
+
+        meta.update(
+            backend=jax.default_backend(),
+            n_devices=len(jax.devices()),
+            n_processes=jax.process_count(),
+            jax_version=jax.__version__,
+        )
+    except Exception:
+        pass
+    return meta
+
+
+def _ensure_run() -> None:
+    global _run_emitted
+    if _run_emitted:
+        return
+    _run_emitted = True  # before emit: emit() calls back into _ensure_run
+    emit("run", **_run_meta())
+
+
+def start_run(**fields) -> None:
+    """Emit the run-metadata record with caller context (tool name, config).
+    Safe to call when disabled; the `run` record is emitted exactly once
+    per process (a later implicit emit sees it already written)."""
+    global _run_emitted
+    if not enabled() or not _is_master() or _run_emitted:
+        return
+    _run_emitted = True
+    emit("run", **{**_run_meta(), **fields})
+
+
+def emit_span(name: str, ms, **fields) -> None:
+    """The shared span record: one named timing, milliseconds. Every perf
+    tool's decomposition row goes through here — one protocol instead of
+    per-tool two-point differencing formats."""
+    emit("span", name=name, ms=None if ms is None else round(float(ms), 4),
+         **fields)
+
+
+def emit_decomposition(name: str, step_ms, solve_ms, nonsolve_ms, **fields):
+    """A solve/non-solve step decomposition as three spans (`<name>.step`,
+    `.solve`, `.nonsolve`). solve/nonsolve may be None (the TPU-only
+    contract of bench.py): only the step span is emitted then."""
+    emit_span(f"{name}.step", step_ms, **fields)
+    if solve_ms is not None:
+        emit_span(f"{name}.solve", solve_ms, **fields)
+        emit_span(f"{name}.nonsolve", nonsolve_ms, **fields)
+
+
+@contextlib.contextmanager
+def span(name: str, **fields):
+    """Wall-clock a block as a span record; no-op when disabled."""
+    if not enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        # a raising block still leaves its span in the flight record (the
+        # crash-surviving contract: that block is the one worth reading)
+        emit_span(name, (time.perf_counter() - t0) * 1e3, **fields)
+
+
+def finalize() -> None:
+    """Emit the end-of-run record (the profiling region table, when any
+    regions were recorded). Idempotent — the atexit hook and an explicit
+    driver call must not double-emit."""
+    global _finalized
+    if _finalized or not enabled():
+        return
+    _finalized = True
+    from . import profiling as prof
+
+    table = prof.table()
+    emit("finalize", profile_regions=table if table else None)
+
+
+# ---------------------------------------------------------------------------
+# Device plane: the in-band metrics vector carried through the jitted chunk.
+# All helpers are traced into the chunk ONLY when enabled() at build time —
+# the off path never sees them (jaxpr identity, tests/test_telemetry.py).
+# ---------------------------------------------------------------------------
+
+def metrics_init():
+    """Fresh metrics vector: all zeros, sentinel at -1 (all finite)."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((METRICS_LEN,), jnp.float32).at[M_BAD].set(-1.0)
+
+
+def metrics_pack(res, it, dt, umax, vmax, wmax, bad):
+    """Pack the carried scalars into the f32 metrics vector."""
+    import jax.numpy as jnp
+
+    return jnp.stack([
+        jnp.asarray(x).astype(jnp.float32)
+        for x in (res, it, dt, umax, vmax, wmax, bad)
+    ])
+
+
+def metrics_step(bad, nt_after, res, it, dt, *maxes):
+    """One step's update of a metrics chunk's f32 scalar carry: cast the
+    step's metric scalars to the in-band precision and latch the
+    non-finite sentinel. Returns (res, it, dt, *maxes, bad), all f32 —
+    the ONE cast/sentinel wiring every family's metrics loop threads
+    (callers whose loop carries the maxima natively, e.g. the fused
+    chunks' CFL scalars, discard the f32 max copies)."""
+    import jax.numpy as jnp
+
+    vals = [jnp.asarray(x).astype(jnp.float32)
+            for x in (res, it, dt) + maxes]
+    res32, _it32, dt32 = vals[:3]
+    bad = sentinel_update(bad, nt_after, res32, dt32, *vals[3:])
+    return (*vals, bad)
+
+
+def sentinel_update(bad, nt_after, *scalars):
+    """First-non-finite tracking: once any carried scalar is non-finite,
+    latch the step count `nt_after` (the value of nt AFTER the offending
+    step). All f32 scalar math — fuses into the chunk program."""
+    import jax.numpy as jnp
+
+    finite = jnp.asarray(True)
+    for s in scalars:
+        finite = jnp.logical_and(finite, jnp.isfinite(s))
+    hit = jnp.logical_and(bad < 0, jnp.logical_not(finite))
+    return jnp.where(hit, jnp.asarray(nt_after).astype(jnp.float32), bad)
+
+
+def halo_exchange_bytes(extents, depth: int, itemsize: int) -> int:
+    """Static per-shard bytes one full `parallel/comm.halo_exchange` moves:
+    axis-by-axis full strips, both directions — per axis 2 messages of
+    `depth` ghost layers times the other extended extents."""
+    ext = [e + 2 * depth for e in extents]
+    total = 0
+    for ax in range(len(extents)):
+        other = 1
+        for o, e in enumerate(ext):
+            if o != ax:
+                other *= e
+        total += 2 * depth * other
+    return total * itemsize
+
+
+class ChunkRecorder:
+    """Host-plane per-chunk recorder: call update(t, nt, metrics) at each
+    host sync. Emits one `chunk` record per sync (the first is
+    compile-inclusive) and a single `divergence` record + warning the first
+    time the in-band sentinel reports a non-finite step."""
+
+    def __init__(self, family: str, nt0: int = 0):
+        self.family = family
+        self._last = time.perf_counter()
+        self._nt = nt0
+        self._first = True
+        self._diverged = False
+
+    def update(self, t: float, nt: int, metrics) -> None:
+        if not enabled():
+            return
+        import numpy as np
+
+        m = np.asarray(metrics, dtype=np.float64)
+        now = time.perf_counter()
+        wall = now - self._last
+        self._last = now
+        steps = nt - self._nt
+        self._nt = nt
+        emit(
+            "chunk",
+            family=self.family,
+            t=float(t),
+            nt=int(nt),
+            steps=steps,
+            wall_s=round(wall, 4),
+            ms_per_step=(round(wall / steps * 1e3, 4) if steps else None),
+            includes_compile=self._first,
+            res=float(m[M_RES]),
+            iters=int(m[M_IT]),
+            dt=float(m[M_DT]),
+            umax=float(m[M_UMAX]),
+            vmax=float(m[M_VMAX]),
+            wmax=float(m[M_WMAX]),
+        )
+        self._first = False
+        bad = m[M_BAD]
+        if bad >= 0 and not self._diverged:
+            self._diverged = True
+            first_bad, last_good = int(bad), int(bad) - 1
+            emit(
+                "divergence",
+                family=self.family,
+                first_bad_step=first_bad,
+                last_good_step=last_good,
+                res=float(m[M_RES]),
+                dt=float(m[M_DT]),
+                umax=float(m[M_UMAX]),
+                vmax=float(m[M_VMAX]),
+                wmax=float(m[M_WMAX]),
+            )
+            warnings.warn(
+                f"{self.family}: solver state went non-finite at step "
+                f"{first_bad} (last good step {last_good}) — see the "
+                "telemetry divergence record",
+                stacklevel=2,
+            )

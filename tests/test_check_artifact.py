@@ -1,0 +1,53 @@
+"""tools/check_artifact.py: the committed BENCH/MULTICHIP artifacts must
+lint clean (tier-1 — a driver round that writes a malformed artifact, or a
+refactor that renames a decomposition field, fails here), and the lint
+must actually catch violations."""
+
+import glob
+import os
+
+from tools import check_artifact as ca
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_committed_artifacts_lint_clean():
+    files = sorted(
+        glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+        + glob.glob(os.path.join(REPO, "MULTICHIP_r*.json"))
+    )
+    assert files, "no committed artifacts found"
+    errors = [e for path in files for e in ca.lint_file(path)]
+    assert errors == []
+
+
+def test_lint_catches_missing_required():
+    assert any("rc" in e for e in ca.lint_bench({"n": 1}))
+    assert any("ok" in e for e in ca.lint_multichip({"n_devices": 8}))
+
+
+def test_lint_catches_gutted_decomposition():
+    """An NS step line without the solve/non-solve decomposition keys is a
+    schema violation — null VALUES are legal (off-TPU), missing KEYS are
+    not."""
+    good = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+            "parsed_ns2d": {"metric": "ns2d_dcavity4096_ms_per_step",
+                            "value": 1.0, "unit": "ms/step",
+                            "solve_ms": None, "nonsolve_ms": None,
+                            "phases": "jnp", "steps_timed": 8}}
+    assert ca.lint_bench(good) == []
+    bad = dict(good, parsed_ns2d={
+        "metric": "ns2d_dcavity4096_ms_per_step", "value": 1.0,
+        "unit": "ms/step"})
+    assert any("solve_ms" in e for e in ca.lint_bench(bad))
+
+
+def test_lint_telemetry_summary_block():
+    base = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+            "tail": ""}
+    good = dict(base, telemetry_summary={
+        "schema_version": 1, "dispatch": {}, "records": 4,
+        "chunks": {"count": 1, "steps": 8}})
+    assert ca.lint_multichip(good) == []
+    bad = dict(base, telemetry_summary={"records": 4})
+    assert any("schema_version" in e for e in ca.lint_multichip(bad))

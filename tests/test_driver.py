@@ -50,6 +50,26 @@ def test_normal_loop_runs_until_te_and_syncs():
     assert bar.stopped and bar.updates == [1.0, 2.0, 3.0]
 
 
+def test_nan_time_is_terminal_not_a_spin():
+    """An adaptive-dt blow-up makes t NaN; every later chunk is a device
+    no-op and `t_old > te` is false for NaN — the loop must treat NaN as
+    terminal (the dist solvers' `while t <= te` already exits on NaN)
+    instead of spinning forever on no-op dispatches."""
+    bar = _Bar()
+
+    def nan_chunk(t, n):
+        return (jnp.asarray(float("nan")), n + 1)
+
+    state = drive_chunks(
+        (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+        nan_chunk, te=100.0, time_index=0, bar=bar,
+        retry=lambda: None,
+    )
+    assert float(state[0]) != float(state[0])  # NaN returned, loop exited
+    assert int(state[1]) == 1  # terminated on the FIRST NaN confirmation
+    assert bar.stopped
+
+
 def test_transient_fault_retried_exactly_once():
     calls = {"n": 0}
 

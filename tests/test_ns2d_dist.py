@@ -52,7 +52,7 @@ def test_debug_phase_harness(reference_dir):
         te=0.0, imax=32, jmax=32
     )
     dist = NS2DDistSolver(param, CartComm(ndims=2, dims=(4, 2)))
-    u, v, f, g, rhs, p1, dt = dist._debug_sm(
+    u, v, f, g, rhs, p1, dt, _res, _it = dist._debug_sm(
         dist.u, dist.v, dist.p, jnp.asarray(0, jnp.int32)
     )
     shape = (34, 34)

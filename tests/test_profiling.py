@@ -31,3 +31,44 @@ def test_enabled_accounts_regions(monkeypatch):
     txt = out.getvalue()
     assert "solve" in txt and "writeResult" in txt
     assert prof._counts["solve"] == 3
+
+
+def test_finalize_idempotent_and_atexit(monkeypatch, tmp_path):
+    """finalize() must be safe to call twice (the atexit hook + the
+    driver's explicit call): the table prints once and the CSV is not
+    rewritten; init() re-arms for the next init/finalize pair."""
+    monkeypatch.setattr(prof, "_MODE", "1")
+    csv = tmp_path / "regions.csv"
+    monkeypatch.setenv("PAMPI_PROFILE_CSV", str(csv))
+    prof.reset()
+    prof.init()
+    assert prof._atexit_registered  # early-exit safety net is armed
+    with prof.region("solve"):
+        pass
+    out1, out2 = io.StringIO(), io.StringIO()
+    prof.finalize(out1)
+    assert "solve" in out1.getvalue() and csv.exists()
+    csv.unlink()
+    prof.finalize(out2)  # second call: no table, no CSV rewrite
+    assert out2.getvalue() == ""
+    assert not csv.exists()
+    prof.init()  # re-armed
+    out3 = io.StringIO()
+    prof.finalize(out3)
+    assert "solve" in out3.getvalue()
+
+
+def test_table_accessor(monkeypatch):
+    """table() — the telemetry finalize record's source — mirrors the
+    wall/device accounting."""
+    monkeypatch.setattr(prof, "_MODE", "1")
+    prof.reset()
+    prof.init()
+    with prof.region("solve"):
+        pass
+    prof.add_device_time("kernel", 1.5, calls=2)
+    t = prof.table()
+    assert t["solve"]["calls"] == 1 and t["solve"]["wall_s"] >= 0
+    assert t["solve"]["device_s"] is None
+    assert t["kernel"] == {"calls": 2, "wall_s": 1.5, "device_s": 1.5}
+    prof.reset()

@@ -1,0 +1,290 @@
+"""Flight-recorder telemetry (utils/telemetry.py).
+
+Three contracts (ISSUE 3 acceptance):
+- OFF-PATH ZERO COST: with PAMPI_TELEMETRY unset the solver chunk's jaxpr
+  is the uninstrumented program — same output arity, same Pallas launch
+  count as the PR-2 pinned values, no sentinel ops — and builds are
+  deterministic (two off builds trace identically).
+- JSONL ROUND-TRIP: a run with PAMPI_TELEMETRY set produces schema-
+  versioned records that tools/telemetry_report.py loads, renders and
+  summarizes, and whose summary block merges + lints cleanly.
+- DIVERGENCE SENTINEL: an injected blow-up (huge fixed dt) surfaces a
+  structured last-good-step diagnostic instead of silent NaN garbage.
+
+Compile cost: every solver here is 16², itermax <= 20, a few steps —
+the telemetry twin chunks are distinct traces by necessity, so the tests
+keep each build tiny rather than sharing one (the marker-audit lever).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.utils import telemetry as tm
+from pampi_tpu.utils.params import Parameter
+
+
+def _count_prim(jaxpr, name):
+    n = sum(1 for e in jaxpr.eqns if e.primitive.name == name)
+    for e in jaxpr.eqns:
+        for v in e.params.values():
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vals:
+                if type(x).__name__ == "ClosedJaxpr":
+                    n += _count_prim(x.jaxpr, name)
+                elif type(x).__name__ == "Jaxpr":
+                    n += _count_prim(x, name)
+    return n
+
+
+@pytest.fixture()
+def tel_off(monkeypatch):
+    monkeypatch.delenv("PAMPI_TELEMETRY", raising=False)
+    tm.reset()
+
+
+@pytest.fixture()
+def tel_on(tmp_path, monkeypatch):
+    path = tmp_path / "run.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(path))
+    tm.reset()
+    yield path
+    tm.reset()
+
+
+def _records(path):
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+_BASE = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02, tau=0.5,
+             itermax=20, eps=1e-4, omg=1.7, gamma=0.9)
+
+
+def test_offpath_jaxpr_identity(tel_off, tmp_path, monkeypatch):
+    """PAMPI_TELEMETRY unset -> the chunk is the PRE-TELEMETRY program:
+    5 outputs (u, v, p, t, nt), zero sentinel ops, deterministic trace;
+    setting it changes ONLY the in-band additions (6th output, isfinite),
+    never the Pallas launch count."""
+    param = Parameter(**_BASE)
+    off1 = NS2DSolver(param)
+    jx_off1 = jax.make_jaxpr(off1._build_chunk())(*off1.initial_state())
+    off2 = NS2DSolver(param)
+    jx_off2 = jax.make_jaxpr(off2._build_chunk())(*off2.initial_state())
+    assert not off1._metrics
+    assert len(jx_off1.jaxpr.outvars) == 5
+    assert str(jx_off1) == str(jx_off2)  # bitwise-identical trace
+    assert "is_finite" not in str(jx_off1)
+    n_pallas_off = _count_prim(jx_off1.jaxpr, "pallas_call")
+
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(tmp_path / "r.jsonl"))
+    tm.reset()
+    on = NS2DSolver(param)
+    jx_on = jax.make_jaxpr(on._build_chunk())(*on.initial_state())
+    assert on._metrics
+    assert len(jx_on.jaxpr.outvars) == 6  # + the metrics vector
+    assert "is_finite" in str(jx_on)
+    assert _count_prim(jx_on.jaxpr, "pallas_call") == n_pallas_off
+
+
+def test_offpath_fused_launch_count(tel_off, tmp_path, monkeypatch):
+    """The fused-phase chunk keeps its PR-2 pinned launch count (2: pre +
+    post, fft solve contributes none) with telemetry on AND off — the
+    metrics ride the already-carried scalars, zero extra launches."""
+    param = Parameter(tpu_fuse_phases="on", tpu_solver="fft",
+                      **{**_BASE, "te": 0.05, "itermax": 40})
+    off = NS2DSolver(param)
+    jx_off = jax.make_jaxpr(off._build_chunk())(*off.initial_state())
+    assert _count_prim(jx_off.jaxpr, "pallas_call") == 2
+    assert len(jx_off.jaxpr.outvars) == 5
+    assert "is_finite" not in str(jx_off)
+
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(tmp_path / "r.jsonl"))
+    tm.reset()
+    on = NS2DSolver(param)
+    assert on._fused and on._metrics
+    jx_on = jax.make_jaxpr(on._build_chunk())(*on.initial_state())
+    assert _count_prim(jx_on.jaxpr, "pallas_call") == 2
+    assert len(jx_on.jaxpr.outvars) == 6
+
+
+def test_jsonl_schema_roundtrip(tel_on):
+    """End-to-end: run -> JSONL -> report render + summary -> artifact
+    merge -> schema lint."""
+    s = NS2DSolver(Parameter(tpu_chunk=2, **_BASE))
+    s.run(progress=False)
+    tm.finalize()
+    recs = _records(tel_on)
+    kinds = {r["kind"] for r in recs}
+    assert {"run", "dispatch", "build", "chunk", "finalize"} <= kinds
+    for r in recs:  # schema: every record versioned and kind-tagged
+        assert r["v"] == tm.SCHEMA_VERSION and "kind" in r and "ts" in r
+    chunks = [r for r in recs if r["kind"] == "chunk"]
+    assert len(chunks) >= 2  # tpu_chunk=2 forces multiple host syncs
+    assert chunks[0]["includes_compile"] and not chunks[1]["includes_compile"]
+    assert chunks[-1]["nt"] == s.nt
+    assert sum(c["steps"] for c in chunks) == s.nt
+    last = chunks[-1]
+    assert np.isfinite(last["res"]) and last["dt"] > 0
+    # umax is the carried max |u| incl. ghosts (ops/ns2d.max_element) of
+    # the final state, at the f32 in-band precision
+    assert np.isclose(last["umax"], float(np.abs(np.asarray(s.u)).max()),
+                      rtol=1e-6)
+
+    # report round-trip
+    from tools import telemetry_report as tr
+
+    loaded = tr.load(str(tel_on))
+    assert len(loaded) == len(recs)
+    text = tr.render(loaded)
+    for needle in ("dispatch decisions", "builds", "chunks", "ns2d_phases"):
+        assert needle in text
+    summ = tr.summary(loaded)
+    assert summ["chunks"]["steps"] == s.nt
+    assert summ["dispatch"]["ns2d_phases"].startswith("jnp")
+    assert summ["divergence"] is None
+
+    # artifact merge + lint (the BENCH_rXX telemetry_summary block)
+    from tools import check_artifact as ca
+    from tools._artifact import write_merged
+
+    art = str(tel_on.parent / "BENCH_test.json")
+    with open(art, "w") as fh:
+        json.dump({"n": 7, "cmd": "bench", "rc": 0, "tail": ""}, fh)
+    merged = write_merged(art, {"telemetry_summary": summ})
+    assert ca.lint_bench(merged) == []
+    # a gutted summary block must be flagged
+    assert ca.lint_bench({"n": 1, "cmd": "", "rc": 0, "tail": "",
+                          "telemetry_summary": {"records": 1}}) != []
+
+
+def test_divergence_sentinel(tel_on):
+    """Injected blow-up (fixed dt=1.0 — wildly unstable on this config):
+    the run still completes (semantics unchanged), but the flight record
+    carries a structured divergence diagnostic naming the last-good step,
+    and a warning surfaces it."""
+    param = Parameter(**{**_BASE, "re": 1000.0, "te": 6.5, "tau": -1.0,
+                         "dt": 1.0, "itermax": 10, "tpu_chunk": 4})
+    s = NS2DSolver(param)
+    with pytest.warns(UserWarning, match="non-finite.*last good step"):
+        s.run(progress=False)
+    # divergence records carry non-finite scalars BY DESIGN — the JSONL
+    # must still be STRICT JSON (string-encoded "nan"/"inf", no Python
+    # NaN tokens a jq/JS/merged-artifact consumer would choke on)
+    def no_const(tok):
+        raise AssertionError(f"non-strict JSON token {tok!r}")
+
+    for ln in open(tel_on):
+        json.loads(ln, parse_constant=no_const)
+    recs = _records(tel_on)
+    div = [r for r in recs if r["kind"] == "divergence"]
+    assert len(div) == 1  # latched once, not per chunk
+    d = div[0]
+    assert d["family"] == "ns2d"
+    assert d["first_bad_step"] >= 1
+    assert d["last_good_step"] == d["first_bad_step"] - 1
+    assert d["first_bad_step"] <= s.nt
+    # the tripping scalar: string-encoded, float() restores non-finite
+    assert not np.isfinite(float(d["res"]))
+    # the report surfaces it
+    from tools import telemetry_report as tr
+
+    text = tr.render(recs)
+    assert "DIVERGENCE" in text
+    assert str(d["last_good_step"]) in text
+    assert tr.summary(recs)["divergence"] is not None
+
+
+def test_divergence_sentinel_dist(tel_on):
+    """The dist chunk carries the same sentinel (replicated scalars)."""
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    param = Parameter(**{**_BASE, "re": 1000.0, "te": 6.5, "tau": -1.0,
+                         "dt": 1.0, "itermax": 10})
+    s = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2)))
+    with pytest.warns(UserWarning, match="non-finite"):
+        s.run(progress=False)
+    div = [r for r in _records(tel_on) if r["kind"] == "divergence"]
+    assert len(div) == 1 and div[0]["family"] == "ns2d_dist"
+    assert div[0]["last_good_step"] == div[0]["first_bad_step"] - 1
+
+
+def test_span_and_metric_records(tel_on):
+    """The shared span protocol (the one decomposition record every perf
+    tool emits) and the halo record helper."""
+    with tm.span("unit.block", tool="test"):
+        pass
+    tm.emit_decomposition("unit.decomp", 10.0, 6.0, 4.0, phases="x")
+    tm.emit_decomposition("unit.off_tpu", None, None, None)
+    recs = _records(tel_on)
+    spans = {r["name"]: r for r in recs if r["kind"] == "span"}
+    assert "unit.block" in spans and spans["unit.block"]["ms"] >= 0
+    assert spans["unit.decomp.step"]["ms"] == 10.0
+    assert spans["unit.decomp.solve"]["ms"] == 6.0
+    assert spans["unit.decomp.nonsolve"]["ms"] == 4.0
+    assert "unit.off_tpu.step" in spans  # TPU-only fields: step span only
+    assert "unit.off_tpu.solve" not in spans
+    # static halo bytes: 2-D axis-by-axis full strips, both directions
+    assert tm.halo_exchange_bytes((8, 16), 1, 4) == (2 * 18 + 2 * 10) * 4
+
+
+def test_bad_path_degrades_not_crashes(monkeypatch):
+    """An unwritable PAMPI_TELEMETRY path costs the flight record, never
+    the run: one warning, then telemetry stands down and the solver runs
+    to completion."""
+    monkeypatch.setenv("PAMPI_TELEMETRY", "/no/such/dir/run.jsonl")
+    tm.reset()
+    with pytest.warns(UserWarning, match="telemetry disabled"):
+        s = NS2DSolver(Parameter(**_BASE))  # first emit is dispatch.record
+    s.run(progress=False)  # later emits are no-ops, the run completes
+    assert s.nt > 0
+    tm.reset()
+
+
+def test_span_survives_raise(tel_on):
+    """A raising block still leaves its span record (the crash-surviving
+    contract — that block is the one worth reading)."""
+    with pytest.raises(RuntimeError, match="boom"):
+        with tm.span("unit.crash"):
+            raise RuntimeError("boom")
+    spans = [r for r in _records(tel_on) if r["kind"] == "span"]
+    assert [s["name"] for s in spans] == ["unit.crash"]
+    assert spans[0]["ms"] >= 0
+
+
+def test_dist_halo_record(tel_on):
+    """Dist solver construction emits the static per-shard halo-exchange
+    byte counts for the dispatched path."""
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    NS2DDistSolver(Parameter(**{**_BASE, "imax": 32, "jmax": 32}),
+                   CartComm(ndims=2, dims=(4, 2)))
+    halo = [r for r in _records(tel_on) if r["kind"] == "halo"]
+    assert len(halo) == 1
+    h = halo[0]
+    assert h["shard"] == [8, 16] and h["mesh"] == [4, 2]
+    isz = jnp.dtype(jnp.float64).itemsize  # x64 test default
+    assert h["exchange_bytes_depth1"] == tm.halo_exchange_bytes(
+        (8, 16), 1, isz)
+    assert h["path"] in ("jnp", "fused")
+    assert "exchanges_per_step" in h
+
+
+def test_initial_state_arity(tel_off, tmp_path, monkeypatch):
+    """initial_state tracks the built chunk's arity (the tools call the
+    chunk with it — bench.py, tools/_artifact.dist_step_decomposition)."""
+    s_off = NS2DSolver(Parameter(**_BASE))
+    assert len(s_off.initial_state()) == 5
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(tmp_path / "r.jsonl"))
+    tm.reset()
+    s_on = NS2DSolver(Parameter(**_BASE))
+    st = s_on.initial_state()
+    assert len(st) == 6 and st[5].shape == (tm.METRICS_LEN,)
+    out = s_on._chunk_fn(*st)
+    assert len(out) == 6
+    float(out[3])  # the loop-time fence every tool uses still holds

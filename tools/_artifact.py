@@ -42,9 +42,8 @@ def dist_step_decomposition(make_solver, key: str, reps: int = 3) -> dict:
     import time
 
     import jax
-    import jax.numpy as jnp
 
-    from pampi_tpu.utils import dispatch
+    from pampi_tpu.utils import dispatch, telemetry
 
     s = make_solver(None)  # production itermax build, records dispatch
     tag = dispatch.last(key)
@@ -52,25 +51,24 @@ def dist_step_decomposition(make_solver, key: str, reps: int = 3) -> dict:
     if jax.default_backend() != "tpu":
         # one key set on every path (itermax/note null rather than absent)
         # so write_merged re-runs across hosts never leave stale fields
+        telemetry.emit_decomposition(key, None, None, None, phases=tag)
         return {**base, "step_ms": None, "solve_iter_ms": None,
                 "nonsolve_ms": None, "itermax": None,
                 "decomposition_note": "TPU-only (see tools/_artifact.py)"}
 
     def step_ms_of(sv):
         steps = type(sv).CHUNK
-        time_dtype = (jnp.float64 if jax.config.jax_enable_x64
-                      else jnp.float32)
-        state = [getattr(sv, n) for n in ("u", "v", "w", "p")
-                 if hasattr(sv, n) and getattr(sv, n) is not None]
-        args = (*state, jnp.asarray(0.0, time_dtype),
-                jnp.asarray(0, jnp.int32))
+        # initial_state matches the chunk's arity (telemetry appends the
+        # in-band metrics vector); the fence reads the carried loop time
+        args = sv.initial_state()
+        ti = len(args) - (3 if sv._metrics else 2)
         out = sv._chunk_sm(*args)
-        float(out[-2])  # compile + warm; scalar readback is the fence
+        float(out[ti])  # compile + warm; scalar readback is the fence
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
             out = sv._chunk_sm(*args)
-            float(out[-2])
+            float(out[ti])
             best = min(best, time.perf_counter() - t0)
         return best / steps * 1e3
 
@@ -78,6 +76,12 @@ def dist_step_decomposition(make_solver, key: str, reps: int = 3) -> dict:
     itermax = s.param.itermax
     step2_ms = step_ms_of(make_solver(2 * itermax))
     solve_iter_ms = step2_ms - step_ms  # itermax extra capped iterations
+    # the decomposition as shared telemetry spans (no-op when unset):
+    # solve here is the PER-ITERATION cost times itermax — the same
+    # two-point differencing the artifact records
+    telemetry.emit_decomposition(key, step_ms, solve_iter_ms,
+                                 step_ms - solve_iter_ms,
+                                 phases=tag, itermax=itermax)
     return {**base,
             "step_ms": round(step_ms, 3),
             "solve_iter_ms": round(solve_iter_ms, 3),

@@ -1,0 +1,117 @@
+"""Schema lint for the committed driver artifacts (BENCH_rXX.json /
+MULTICHIP_rXX.json) and the telemetry summary blocks merged into them.
+
+    python tools/check_artifact.py [files...]
+
+With no arguments, lints every BENCH_r*.json / MULTICHIP_r*.json in the
+repo root. Exit 1 with one line per violation. A tier-1 test
+(tests/test_check_artifact.py) runs the lint over the committed artifacts,
+so a driver round that writes a malformed artifact — or a refactor that
+renames a decomposition field the analysts rely on — fails CI instead of
+silently degrading the record.
+
+Contracts:
+- BENCH: {n, cmd, rc, tail} required. `parsed*` blocks (the JSON lines
+  bench.py prints) need {metric, value, unit}; NS step-line blocks
+  additionally carry the solve/non-solve decomposition keys (values may be
+  null off-TPU — the bench.py contract — but the KEYS must exist).
+- MULTICHIP: {n_devices, rc, ok, skipped, tail} required.
+- telemetry_summary (optional until a run emits one): the
+  tools/telemetry_report.summary shape — {schema_version, dispatch,
+  chunks, records}.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BENCH_REQUIRED = ("n", "cmd", "rc", "tail")
+MULTICHIP_REQUIRED = ("n_devices", "rc", "ok", "skipped", "tail")
+PARSED_REQUIRED = ("metric", "value", "unit")
+# the decomposition keys every NS step line carries (bench.py
+# _step_decomposition_line; null values are legal off-TPU)
+DECOMP_KEYS = ("solve_ms", "nonsolve_ms", "phases", "steps_timed")
+SUMMARY_REQUIRED = ("schema_version", "dispatch", "chunks", "records")
+
+
+def _missing(d: dict, keys, where: str) -> list[str]:
+    return [f"{where}: missing key {key!r}" for key in keys if key not in d]
+
+
+def lint_telemetry_summary(d: dict, where: str) -> list[str]:
+    errs = _missing(d, SUMMARY_REQUIRED, where)
+    chunks = d.get("chunks")
+    if isinstance(chunks, dict):
+        errs += _missing(chunks, ("count", "steps"), f"{where}.chunks")
+    elif "chunks" in d:
+        errs.append(f"{where}.chunks: not a dict")
+    return errs
+
+
+def lint_bench(d: dict, where: str = "BENCH") -> list[str]:
+    errs = _missing(d, BENCH_REQUIRED, where)
+    for key, block in d.items():
+        if not key.startswith("parsed") or not isinstance(block, dict):
+            continue
+        errs += _missing(block, PARSED_REQUIRED, f"{where}.{key}")
+        metric = str(block.get("metric", ""))
+        if metric.startswith("ns2d_") and metric.endswith("ms_per_step"):
+            errs += _missing(block, DECOMP_KEYS, f"{where}.{key}")
+    if isinstance(d.get("telemetry_summary"), dict):
+        errs += lint_telemetry_summary(
+            d["telemetry_summary"], f"{where}.telemetry_summary")
+    return errs
+
+
+def lint_multichip(d: dict, where: str = "MULTICHIP") -> list[str]:
+    errs = _missing(d, MULTICHIP_REQUIRED, where)
+    if isinstance(d.get("telemetry_summary"), dict):
+        errs += lint_telemetry_summary(
+            d["telemetry_summary"], f"{where}.telemetry_summary")
+    return errs
+
+
+def lint_file(path: str) -> list[str]:
+    base = os.path.basename(path)
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        return [f"{base}: unreadable ({exc})"]
+    if not isinstance(d, dict):
+        return [f"{base}: top level is not an object"]
+    if base.startswith("BENCH"):
+        return lint_bench(d, base)
+    if base.startswith("MULTICHIP"):
+        return lint_multichip(d, base)
+    return [f"{base}: unknown artifact family (expected BENCH_*/MULTICHIP_*)"]
+
+
+def main(argv: list[str]) -> int:
+    files = argv[1:]
+    if not files:
+        files = sorted(
+            glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+            + glob.glob(os.path.join(REPO, "MULTICHIP_r*.json"))
+        )
+    if not files:
+        print("no artifacts found", file=sys.stderr)
+        return 1
+    errors = []
+    for path in files:
+        errs = lint_file(path)
+        errors += errs
+        status = "FAIL" if errs else "ok"
+        print(f"{status:>4}  {os.path.basename(path)}")
+    for e in errors:
+        print(f"  {e}", file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -278,14 +278,15 @@ def run4096(te: float = 0.15, lookahead: int = 2, chunk: int = 0) -> dict:
         # artifact
         tpu_lookahead=lookahead, tpu_chunk=chunk, tpu_flat_solve=1,
     )
+    from pampi_tpu.utils import telemetry
+
+    telemetry.start_run(tool="northstar.run4096")
     s = NS2DSolver(param, dtype=jnp.float32)
     # compile OUTSIDE the timed window (refconfig precedent: the C side's
     # 'Solution took' is a solver-only timer, main.c:63): one chunk call
-    # from the pristine state, result discarded
-    warm = s._chunk_fn(
-        s.u, s.v, s.p, jnp.asarray(0.0, jnp.float32),
-        jnp.asarray(0, jnp.int32),
-    )
+    # from the pristine state, result discarded (initial_state matches the
+    # chunk's telemetry arity)
+    warm = s._chunk_fn(*s.initial_state())
     float(warm[3])
     t0 = time.perf_counter()
     s.run(progress=True)
@@ -395,6 +396,15 @@ def run4096(te: float = 0.15, lookahead: int = 2, chunk: int = 0) -> dict:
             "weather (round-3 protocol measured 12.7 on the same kernel)."
         ),
     }
+    # the decomposition as shared telemetry spans + the artifact record
+    # (no-ops when PAMPI_TELEMETRY is unset)
+    telemetry.emit_decomposition(
+        "northstar_dcavity4096", phase_decomposition["step_ms"],
+        phase_decomposition["solve_ms"], phase_decomposition["nonsolve_ms"],
+        phases=_dispatch.last("ns2d_phases"))
+    telemetry.emit("metric", metric="northstar_dcavity4096_ms_per_step",
+                   value=rec["ms_per_step"], unit="ms/step",
+                   steps=steps, final_pressure_residual=rec["final_pressure_residual"])
     return rec
 
 
@@ -419,10 +429,7 @@ def refconfig() -> dict:
     # compile OUTSIDE the timed window (the C side's 'Solution took' is a
     # solver-only timer, main.c:63): one chunk call from the pristine state,
     # result discarded — the solver's stored state is untouched
-    warm = s._chunk_fn(
-        s.u, s.v, s.p, jnp.asarray(0.0, jnp.float32),
-        jnp.asarray(0, jnp.int32),
-    )
+    warm = s._chunk_fn(*s.initial_state())
     float(warm[3])  # scalar fence
     t0 = time.perf_counter()
     s.run(progress=True)

@@ -11,6 +11,11 @@ tunnel's per-dispatch latency floor swings 25 µs–100 ms (see BASELINE.md),
 which differencing cancels exactly. Steps chain through the loop carry, so
 they serialize naturally. Best-of-REPS on each term suppresses jitter.
 
+Each measured row is also a shared telemetry span record
+(utils/telemetry.emit_span; no-op unless PAMPI_TELEMETRY is set), so this
+tool's output aggregates through tools/telemetry_report.py like every
+other perf tool instead of living only in ad-hoc prints.
+
 Run on the real chip:  python tools/perf_ns2d4096.py [solvers...]
 Defaults to: sor fft mg.
 """
@@ -75,8 +80,14 @@ def measure(solver: str) -> float:
 
 
 if __name__ == "__main__":
+    from pampi_tpu.utils import telemetry
+
     solvers = sys.argv[1:] or ["sor", "fft", "mg"]
+    telemetry.start_run(tool="perf_ns2d4096", solvers=solvers)
     print(f"backend={jax.default_backend()} N={N} itermax=100 eps=1e-3 f32")
     for sv in solvers:
         ms = measure(sv) * 1e3
+        telemetry.emit_span(f"ns2d4096.step[{sv}]", ms,
+                            grid=[N, N], itermax=100,
+                            protocol="chained-step two-point differencing")
         print(f"{sv:4s}: {ms:8.2f} ms/step")

@@ -74,7 +74,9 @@ def dist_chunk_msstep(param, comm, settle=2):
     d = NS3DDistSolver(param, comm=comm, dtype=DT)
     d.CHUNK = STEPS
     d._build()
-    state = tuple(d._init_sm()) + (T0, NT0)
+    # initial_state matches the chunk's arity (telemetry appends the
+    # in-band metrics vector); the u/v/w/p it carries ARE _init_sm's
+    state = d.initial_state()
     for _ in range(settle):
         state = d._chunk_sm(*state)
     jax.block_until_ready(state)
@@ -86,7 +88,7 @@ def single_chunk_msstep(param, settle=2):
     s = NS3DSolver(param, dtype=DT)
     s.CHUNK = STEPS
     s._chunk_fn = jax.jit(s._build_chunk())
-    state = (s.u, s.v, s.w, s.p, T0, NT0)
+    state = s.initial_state()
     for _ in range(settle):
         state = s._chunk_fn(*state)
     jax.block_until_ready(state)
@@ -109,7 +111,7 @@ def settled_solve_inputs(param):
     s = NS3DSolver(param, dtype=DT)
     s.CHUNK = 32
     s._chunk_fn = jax.jit(s._build_chunk())
-    st = (s.u, s.v, s.w, s.p, T0, NT0)
+    st = s.initial_state()
     for _ in range(2):
         st = s._chunk_fn(*st)
     jax.block_until_ready(st)

@@ -47,10 +47,12 @@ def main() -> dict:
     from pampi_tpu.ops import obstacle as obst
     from pampi_tpu.parallel.comm import CartComm
     from pampi_tpu.utils import dispatch as _dispatch
+    from pampi_tpu.utils import telemetry
     from pampi_tpu.utils import xlacache
 
     xlacache.enable()  # the big-halo kernels cost ~25 min/compile
                        # through the remote-compile tunnel
+    telemetry.start_run(tool="perf_obsdist")
 
     param = read_parameter(PAR)
     imax, jmax = param.imax, param.jmax
@@ -104,6 +106,8 @@ def main() -> dict:
             "s": round(t, 4),
             "gups": round(sites * ITS / t / 1e9, 1),
         }
+        telemetry.emit_span(f"obsdist2048.single[n{n}]", t * 1e3,
+                            gups=rec["single_device"][f"n{n}"]["gups"])
         print(f"single n{n}: {t*1e3:.1f} ms "
               f"{rec['single_device'][f'n{n}']['gups']}G", flush=True)
 
@@ -136,6 +140,9 @@ def main() -> dict:
             "gups": round(sites * ITS / t / 1e9, 1),
             "dispatch": tag,
         }
+        telemetry.emit_span(f"obsdist2048.dist[ca{can}]", t * 1e3,
+                            gups=rec["dist_one_shard"][f"ca{can}"]["gups"],
+                            dispatch=tag)
         print(f"dist ca{can} [{tag}]: {t*1e3:.1f} ms "
               f"{rec['dist_one_shard'][f'ca{can}']['gups']}G", flush=True)
 

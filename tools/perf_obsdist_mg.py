@@ -46,10 +46,9 @@ def measure_dist_step_ms(solver: str, dims=(1, 1)) -> dict:
     comm = CartComm(ndims=2, dims=dims)
     before = dispatch.snapshot()  # the record is process-global
     s = NS2DDistSolver(param, comm, dtype=jnp.float32)
-    t0 = jnp.asarray(0.0, jnp.float32)
-    nt0 = jnp.asarray(0, jnp.int32)
-    # warm compile + settle one chunk (64 steps)
-    state = s._chunk_sm(s.u, s.v, s.p, t0, nt0)
+    # warm compile + settle one chunk (64 steps); initial_state matches
+    # the chunk's arity (telemetry appends the in-band metrics vector)
+    state = s._chunk_sm(*s.initial_state())
     float(state[3])
 
     def run_chunks(k):

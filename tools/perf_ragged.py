@@ -236,6 +236,9 @@ def ragged_step_decomposition() -> dict:
 
 
 if __name__ == "__main__":
+    from pampi_tpu.utils import telemetry
+
+    telemetry.start_run(tool="perf_ragged")
     rec = {
         "artifact": "ragged_throughput",
         "backend": jax.default_backend(),
@@ -250,6 +253,11 @@ if __name__ == "__main__":
         4095, 4095, 2048, 2048, ragged=True)
     rec["jnp_ca_ragged_4095"] = jnp_ca_ragged_rate(4095, 4095, 2048, 2048)
     rec["ragged_step_decomposition_4095"] = ragged_step_decomposition()
+    for name in ("quarters_divisible_4096_solve", "masked_divisible_4096",
+                 "masked_ragged_4095", "jnp_ca_ragged_4095"):
+        # kernel-rate rows as shared span records (ms=None: these are
+        # steady-state rates, not single-span walls)
+        telemetry.emit_span(f"ragged_throughput.{name}", None, **rec[name])
     from tools._artifact import write_merged
 
     write_merged(os.path.join(REPO, "results", "ragged_throughput.json"),

@@ -1,7 +1,10 @@
 """Measure the 3-D red-black SOR iteration at NS-3D headline shapes on the
 real chip: jnp half-sweep composition vs the fused Pallas kernel across
 block_k / n_inner. Reports lattice-site updates/s (sites x RB-iterations /
-wall). Run on TPU: python tools/perf_sor3d.py [K J I]"""
+wall); every row is also a shared telemetry span
+(utils/telemetry.emit_span — the one perf-tool record protocol, no-op
+unless PAMPI_TELEMETRY is set).
+Run on TPU: python tools/perf_sor3d.py [K J I]"""
 
 import functools
 import os
@@ -27,6 +30,10 @@ DT = jnp.float32
 ITERS = 200
 dx, dy, dz, omega = 1.0 / I, 1.0 / J, 1.0 / K, 1.8
 
+from pampi_tpu.utils import telemetry  # noqa: E402
+
+telemetry.start_run(tool="perf_sor3d", grid=[K, J, I])
+
 
 def timeit(fn, *args):
     out = fn(*args)
@@ -39,6 +46,9 @@ def timeit(fn, *args):
 
 def report(tag, dt_s, rb_iters):
     ups = K * J * I * rb_iters / dt_s
+    telemetry.emit_span(f"sor3d.{tag.strip().replace(' ', '_')}",
+                        dt_s * 1e3, grid=[K, J, I], rb_iters=rb_iters,
+                        gups=round(ups / 1e9, 2))
     print(f"{tag:34s} {dt_s*1e3:8.1f} ms  {ups/1e9:7.2f} G updates/s")
     return ups
 

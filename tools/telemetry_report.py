@@ -1,0 +1,236 @@
+"""Aggregate a PAMPI_TELEMETRY JSONL into a human-readable run report.
+
+    python tools/telemetry_report.py run.jsonl [--merge ARTIFACT.json]
+
+Renders the flight record (utils/telemetry.py schema): run metadata,
+dispatch decisions, build/trace walls, per-chunk solver health
+(residual/iterations/dt/velocity maxima, ms/step), divergence diagnostics,
+the shared decomposition spans, static halo-exchange byte counts, driver
+solve records, and the profiling region table. `--merge <path>` folds the
+machine-readable summary block into a BENCH_rXX/MULTICHIP_rXX artifact
+under the `telemetry_summary` key via tools/_artifact.write_merged (the
+merge-preserving convention), so on-chip sessions commit one artifact that
+carries both the measured headline and the run's flight record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def load(path: str) -> list[dict]:
+    """Parse the JSONL; unparseable lines are reported, not fatal (a run
+    killed mid-write may leave a torn last line)."""
+    records = []
+    with open(path) as fh:
+        for n, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"warning: line {n} unparseable (torn write?)",
+                      file=sys.stderr)
+    return records
+
+
+def _num(x) -> float:
+    """Record scalars may be string-encoded non-finite floats ("nan"/"inf"
+    — strict-JSON encoding, utils/telemetry._json_safe); float() restores
+    them for formatting."""
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+def _by_kind(records: list[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for r in records:
+        out.setdefault(r.get("kind", "?"), []).append(r)
+    return out
+
+
+def summary(records: list[dict]) -> dict:
+    """The machine-readable summary block (`telemetry_summary` in merged
+    artifacts; tools/check_artifact.py lints its shape)."""
+    k = _by_kind(records)
+    run = k.get("run", [{}])[0]
+    chunks = [c for c in k.get("chunk", []) if c.get("steps")]
+    # compile is in the first chunk only: steady-state ms/step excludes it
+    steady = [c for c in chunks if not c.get("includes_compile")]
+    last = chunks[-1] if chunks else None
+    spans = {}
+    for s in k.get("span", []):
+        spans[s["name"]] = {
+            key: val for key, val in s.items()
+            if key not in ("v", "kind", "ts", "name")
+        }
+    out = {
+        "schema_version": run.get("v", 1),
+        "backend": run.get("backend"),
+        "n_devices": run.get("n_devices"),
+        "records": len(records),
+        "dispatch": {d["key"]: d["value"] for d in k.get("dispatch", [])},
+        "builds": {
+            b.get("family", "?"): b.get("trace_wall_s")
+            for b in k.get("build", [])
+        },
+        "chunks": {
+            "count": len(chunks),
+            "steps": sum(c["steps"] for c in chunks),
+            "wall_s": round(sum(c["wall_s"] for c in chunks), 3),
+            "ms_per_step_steady": (
+                round(min(c["ms_per_step"] for c in steady), 3)
+                if steady else None
+            ),
+            "last": None if last is None else {
+                key: last.get(key)
+                for key in ("nt", "t", "res", "iters", "dt",
+                            "umax", "vmax", "wmax")
+            },
+        },
+        "divergence": k.get("divergence", []) or None,
+        "spans": spans or None,
+        "solves": {
+            "count": len(k.get("solve", [])),
+            "last": (
+                {key: k["solve"][-1].get(key)
+                 for key in ("family", "iters", "res", "wall_s")}
+                if k.get("solve") else None
+            ),
+        },
+        "halo": [
+            {key: val for key, val in h.items()
+             if key not in ("v", "kind", "ts")}
+            for h in k.get("halo", [])
+        ] or None,
+        "profile_regions": (
+            k["finalize"][-1].get("profile_regions")
+            if k.get("finalize") else None
+        ),
+    }
+    return out
+
+
+def render(records: list[dict]) -> str:
+    """The human-readable report."""
+    k = _by_kind(records)
+    lines: list[str] = []
+    add = lines.append
+    run = k.get("run", [{}])[0]
+    add("== run ==")
+    add(f"  backend={run.get('backend')} devices={run.get('n_devices')} "
+        f"processes={run.get('n_processes')} jax={run.get('jax_version')}")
+    for key in ("tool", "config", "problem", "grid", "solver", "dtype"):
+        if key in run:
+            add(f"  {key}={run[key]}")
+
+    if k.get("dispatch"):
+        add("== dispatch decisions ==")
+        seen = {}
+        for d in k["dispatch"]:
+            seen[d["key"]] = d["value"]
+        for key, val in seen.items():
+            add(f"  {key:<24} {val}")
+
+    if k.get("build"):
+        add("== builds (trace/build wall) ==")
+        for b in k["build"]:
+            extra = f" mesh={b['mesh']}" if "mesh" in b else ""
+            add(f"  {b.get('family', '?'):<12} {b.get('trace_wall_s')}s "
+                f"grid={b.get('grid')}{extra} phases={b.get('phases')}")
+
+    chunks = k.get("chunk", [])
+    if chunks:
+        add("== chunks (per host sync; first is compile-inclusive) ==")
+        add(f"  {'nt':>8} {'steps':>6} {'ms/step':>10} {'res':>12}"
+            f" {'iters':>6} {'dt':>12} {'umax':>10} {'vmax':>10} {'wmax':>10}")
+        for c in chunks:
+            ms = c.get("ms_per_step")
+            add(f"  {c.get('nt'):>8} {str(c.get('steps')):>6} "
+                f"{'-' if ms is None else format(ms, '10.3f')} "
+                f"{_num(c.get('res')):>12.4e} {c.get('iters'):>6} "
+                f"{_num(c.get('dt')):>12.4e} {_num(c.get('umax')):>10.4g} "
+                f"{_num(c.get('vmax')):>10.4g} {_num(c.get('wmax')):>10.4g}"
+                + ("  [compile]" if c.get("includes_compile") else ""))
+
+    for d in k.get("divergence", []):
+        add("== DIVERGENCE ==")
+        add(f"  {d.get('family')}: state went non-finite at step "
+            f"{d.get('first_bad_step')} (last good step "
+            f"{d.get('last_good_step')})"
+            if "first_bad_step" in d else
+            f"  {d.get('family')}: non-finite residual {d.get('res')}")
+
+    if k.get("solve"):
+        add("== driver solves ==")
+        for s in k["solve"]:
+            add(f"  {s.get('family'):<14} it={s.get('iters'):>6} "
+                f"res={_num(s.get('res')):.4e} wall={s.get('wall_s')}s")
+
+    if k.get("span"):
+        add("== spans ==")
+        for s in k["span"]:
+            meta = {key: val for key, val in s.items()
+                    if key not in ("v", "kind", "ts", "name", "ms")}
+            add(f"  {s['name']:<40} "
+                f"{'-' if s.get('ms') is None else format(s['ms'], '10.3f')}"
+                f" ms  {meta if meta else ''}")
+
+    if k.get("halo"):
+        add("== halo exchange (static per-shard) ==")
+        for h in k["halo"]:
+            add(f"  {h.get('family'):<12} mesh={h.get('mesh')} "
+                f"shard={h.get('shard')} path={h.get('path')} "
+                f"depth1={h.get('exchange_bytes_depth1')}B"
+                + (f" deep(H={h.get('deep_halo')})="
+                   f"{h.get('deep_exchange_bytes')}B"
+                   if h.get("deep_halo") else "")
+                + f" per-step={h.get('exchanges_per_step')}")
+
+    prof = (k["finalize"][-1].get("profile_regions")
+            if k.get("finalize") else None)
+    if prof:
+        add("== profiling regions ==")
+        add(f"  {'region':<24} {'calls':>6} {'wall_s':>10} {'device_s':>10}")
+        for name, row in sorted(
+            prof.items(), key=lambda kv: -(kv[1].get("wall_s") or 0)
+        ):
+            add(f"  {name:<24} {row.get('calls'):>6} "
+                f"{str(row.get('wall_s')):>10} {str(row.get('device_s')):>10}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) < 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 1
+    path = argv[1]
+    merge_to = None
+    if "--merge" in argv:
+        i = argv.index("--merge")
+        if i + 1 >= len(argv):
+            print("--merge needs an artifact path", file=sys.stderr)
+            return 1
+        merge_to = argv[i + 1]
+    records = load(path)
+    if not records:
+        print(f"no records in {path}", file=sys.stderr)
+        return 1
+    sys.stdout.write(render(records))
+    if merge_to:
+        from tools._artifact import write_merged
+
+        write_merged(merge_to, {"telemetry_summary": summary(records)})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
