@@ -67,10 +67,19 @@ telemetry-report:
 check-artifacts:
 	python tools/check_artifact.py
 
+# Standalone run of the fault-injection / recovery suite (PAMPI_FAULTS
+# plane, retry budgets, rollback-recovery, checkpoint durability edges).
+# The same tests ride tier-1 at 16-squared size; this target is the quick
+# focused loop while touching the recovery layer.
+fault-suite:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faultinject.py \
+	  tests/test_driver.py tests/test_checkpoint.py -q
+
 clean:
 	rm -rf $(BUILD) exe-$(TAG)
 
 distclean:
 	rm -rf build exe-*
 
-.PHONY: all test asm format telemetry-report check-artifacts clean distclean
+.PHONY: all test asm format telemetry-report check-artifacts fault-suite \
+	clean distclean

@@ -168,6 +168,28 @@ def _dispatch(param, prof) -> int:
         )
         return 1
 
+    if (param.tpu_recover_ring < 0 or param.tpu_recover_max < 1
+            or not 0.0 < param.tpu_recover_dt_scale <= 1.0
+            or param.tpu_retry_replenish < 0):
+        print(
+            "Error: recovery knobs out of range — need tpu_recover_ring "
+            ">= 0, tpu_recover_max >= 1, 0 < tpu_recover_dt_scale <= 1, "
+            "tpu_retry_replenish >= 0 (got "
+            f"{param.tpu_recover_ring}, {param.tpu_recover_max}, "
+            f"{param.tpu_recover_dt_scale}, {param.tpu_retry_replenish})",
+            file=sys.stderr,
+        )
+        return 1
+
+    if os.environ.get("PAMPI_FAULTS"):
+        # fault injection is the recovery layer's TEST plane — loud when it
+        # leaks into a real run (utils/faultinject.py)
+        print(
+            "WARNING: PAMPI_FAULTS is set — deterministic fault injection "
+            "armed (test-only; unset it for production runs)",
+            file=sys.stderr,
+        )
+
     if param.tpu_sor_layout not in ("auto", "checkerboard", "quarters",
                                     "octants"):
         print(

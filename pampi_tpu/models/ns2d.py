@@ -29,8 +29,10 @@ import numpy as np
 from jax import lax
 
 from ..ops import ns2d as ops
+from ..utils import faultinject as _fi
 from ..utils import flags as _flags
 from ..utils import telemetry as _tm
+from ._driver import clamped_dt
 from ..utils.datio import write_pressure, write_velocity
 from ..utils.params import Parameter, validate_obstacle_layout
 from ..utils.precision import resolve_dtype
@@ -119,6 +121,7 @@ class NS2DSolver:
         self.nt = 0
         self._backend = "auto"
         self._fused = False  # set by _build_chunk (fused-phase dispatch)
+        self._dt_scale = 1.0  # recovery dt clamp (models/_driver.clamped_dt)
         # flag-field obstacles (ops/obstacle.py): static geometry -> static
         # masks baked into the traced step as constants (branch-free)
         if param.obstacles.strip():
@@ -139,6 +142,11 @@ class NS2DSolver:
         else:
             self.masks = None
         t0 = time.perf_counter()
+        # fault-injection generation for this build (utils/faultinject.py):
+        # taken HERE and in _rebuild_chunk only, never inside _build_chunk —
+        # the pallas->jnp fallback rebuild must keep the failing chunk's
+        # armed corruption instead of silently spending a fresh generation
+        self._field_faults = _fi.take_field_faults()
         self._chunk_fn = jax.jit(self._build_chunk())
         from ..utils import dispatch as _dispatch
 
@@ -223,12 +231,14 @@ class NS2DSolver:
         masks = self.masks
         adaptive = param.tau > 0.0
         problem = param.name
+        dt_scale = self._dt_scale  # 1.0 = identity (recovery rebuilds clamp)
 
         def presolve(u, v):
             if adaptive:
                 dt = ops.compute_timestep(u, v, self.dt_bound, dx, dy, param.tau)
             else:
                 dt = jnp.asarray(param.dt, dtype)
+            dt = clamped_dt(dt, dt_scale)
             u, v = ops.set_boundary_conditions(
                 u, v, param.bcLeft, param.bcRight, param.bcBottom, param.bcTop
             )
@@ -298,8 +308,10 @@ class NS2DSolver:
         masks = self.masks
         solve = self._make_solve(backend)
         presolve = self._build_presolve()
+        faults = getattr(self, "_field_faults", ())
 
         def step(u, v, p, t, nt):
+            u, v, p = _fi.apply_field_faults(faults, nt, u=u, v=v, p=p)
             u, v, f, g, rhs, dt = presolve(u, v)
             if masks is None:
                 p = lax.cond(nt % 100 == 0, ops.normalize_pressure, lambda q: q, p)
@@ -448,6 +460,8 @@ class NS2DSolver:
             # conversion-wrapped _make_solve the folded step no longer runs
             self._folded_solve = (solve_pad, pad)
         adaptive = param.tau > 0.0
+        dt_scale = self._dt_scale  # 1.0 = identity (recovery rebuilds clamp)
+        faults = getattr(self, "_field_faults", ())
         te = param.te
         chunk = param.tpu_chunk or self.CHUNK
         offs = jnp.zeros((2,), jnp.int32)
@@ -471,10 +485,12 @@ class NS2DSolver:
 
         def step(up, vp, p, t, nt, umax, vmax):
             # `p` is the padded carry when folded, the plain array otherwise
+            up, vp, p = _fi.apply_field_faults(faults, nt, u=up, v=vp, p=p)
             if adaptive:
                 dt = ops.cfl_dt(umax, vmax, self.dt_bound, dx, dy, param.tau)
             else:
                 dt = jnp.asarray(param.dt, dtype)
+            dt = clamped_dt(dt, dt_scale)
             dt11 = jnp.full((1, 1), dt, dtype)
             up, vp, fp, gp, rhsp = pre(offs, dt11, up, vp)
             p = lax.cond(nt % 100 == 0, norm_carry, lambda q: q, p)
@@ -554,7 +570,11 @@ class NS2DSolver:
     def _build_chunk(self, backend: str = "auto"):
         # telemetry is a trace-time decision, like utils/flags.py: unset
         # means the chunk below is byte-identical to the uninstrumented
-        # program (asserted by tests/test_telemetry.py)
+        # program (asserted by tests/test_telemetry.py). Field-fault
+        # injection (PAMPI_FAULTS nan/inf clauses) follows the same
+        # contract via self._field_faults — set by __init__/_rebuild_chunk,
+        # NOT taken here (the pallas fallback rebuild reuses the armed
+        # generation; only a recovery rebuild advances it).
         metrics = _tm.enabled()
         self._metrics = metrics
         fused = self._build_fused_chunk(backend, metrics=metrics)
@@ -607,6 +627,16 @@ class NS2DSolver:
         return chunk_fn_metrics if metrics else chunk_fn
 
     # -- driver API ----------------------------------------------------
+    def _rebuild_chunk(self):
+        """Re-trace the chunk against the solver's CURRENT attributes
+        (backend, recovery dt clamp) — the rollback-recovery rebuild hook
+        (models/_driver.RingRecovery). Advances the fault-injection
+        generation: single-charge corruption clauses are spent, so the
+        recovered run re-drives clean."""
+        self._field_faults = _fi.take_field_faults()
+        self._chunk_fn = jax.jit(self._build_chunk(backend=self._backend))
+        return self._chunk_fn
+
     def initial_state(self) -> tuple:
         """The chunk-call state tuple matching the built chunk's arity —
         (u, v, p, t, nt), plus the in-band telemetry metrics vector when
@@ -623,13 +653,14 @@ class NS2DSolver:
 
     def run(self, progress: bool = True, on_sync=None) -> None:
         """Advance from t to te. `on_sync(self)` fires at each host sync
-        (every CHUNK device steps) — the checkpoint hook point. Loop + retry
-        protocol live in models/_driver.py."""
-        from ._driver import drive_chunks, pallas_retry
+        (every CHUNK device steps) — the checkpoint hook point. Loop +
+        retry/rollback protocol live in models/_driver.py."""
+        from ._driver import drive_chunks, make_recovery, pallas_retry
 
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         state = self.initial_state()
         rec = _tm.ChunkRecorder("ns2d", self.nt) if self._metrics else None
+        recover = make_recovery(self, "ns2d", time_index=3, recorder=rec)
 
         def publish(s):
             self.u, self.v, self.p = s[0], s[1], s[2]
@@ -638,13 +669,22 @@ class NS2DSolver:
         def on_state(s):
             if rec is not None:
                 rec.update(float(s[3]), int(s[4]), s[5])
+            if recover is not None:
+                recover.capture(s)
             if on_sync is not None:
                 publish(s)
                 on_sync(self)
 
+        if recover is not None:
+            recover.capture(state)  # first-chunk divergence is recoverable
         state = drive_chunks(state, self._chunk_fn, self.param.te, 3, bar,
-                             pallas_retry(self, "pressure solve"), on_state,
-                             lookahead=self.param.tpu_lookahead)
+                             pallas_retry(
+                                 self, "pressure solve",
+                                 restore_after=self.param.tpu_retry_replenish,
+                             ),
+                             on_state, lookahead=self.param.tpu_lookahead,
+                             replenish_after=self.param.tpu_retry_replenish,
+                             recover=recover)
         publish(state)
 
     def write_result(

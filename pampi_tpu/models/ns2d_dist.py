@@ -66,8 +66,10 @@ from ..parallel.stencil2d import (
     wall_flags,
 )
 from ..utils import dispatch as _dispatch
+from ..utils import faultinject as _fi
 from ..utils import flags as _flags
 from ..utils import telemetry as _tm
+from ._driver import clamped_dt
 from ..utils.datio import write_pressure, write_velocity
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -154,6 +156,10 @@ class NS2DDistSolver:
             )
         else:
             self.masks = None
+        self._dt_scale = 1.0  # recovery dt clamp (models/_driver.clamped_dt)
+        # fault-injection generation: taken here and in _rebuild_chunk
+        # only (see models/ns2d.py for the rationale)
+        self._field_faults = _fi.take_field_faults()
         self._build()
         # extended-block state, stacked over the mesh
         self.u, self.v, self.p = self._init_sm()
@@ -164,6 +170,11 @@ class NS2DDistSolver:
         param = self.param
         dtype = self.dtype
         metrics = self._metrics  # trace-time telemetry gate (see __init__)
+        # field-fault injection + recovery dt clamp: both trace-time, both
+        # identity when unarmed (the PAMPI_FAULTS-unset jaxpr contract);
+        # the generation is taken by __init__/_rebuild_chunk, not here
+        field_faults = self._field_faults
+        dt_scale = self._dt_scale
         jl, il = self.jl, self.il
         dx, dy = self.dx, self.dy
         Pj = comm.axis_size("j")
@@ -582,9 +593,11 @@ class NS2DDistSolver:
             solve; step() appends the projection, debug_kernel returns the
             intermediates (the automated heir of the reference's test.c
             halo dump, SURVEY.md §4.1)."""
+            u, v, p = _fi.apply_field_faults(field_faults, nt, u=u, v=v, p=p)
             u = halo_exchange(u, comm)
             v = halo_exchange(v, comm)
             dt = compute_dt(u, v) if adaptive else jnp.asarray(param.dt, dtype)
+            dt = clamped_dt(dt, dt_scale)
             u, v = set_bcs(u, v)
             u = set_special_bc(u)
             u = halo_exchange(u, comm)
@@ -662,12 +675,14 @@ class NS2DDistSolver:
             POST kernel projects on the exchanged extended blocks."""
             pre_k, post_k = fused_k
             H = FUSE_DEEP_HALO
+            u, v, p = _fi.apply_field_faults(field_faults, nt, u=u, v=v, p=p)
             ud = halo_exchange(embed_deep(u, H), comm, depth=H)
             vd = halo_exchange(embed_deep(v, H), comm, depth=H)
             # ghost-inclusive CFL max: the deep block carries the same
             # global value set (owned + fresh neighbour copies + wall
             # ghosts + dead zeros), so the max reduction is unchanged
             dt = compute_dt(ud, vd) if adaptive else jnp.asarray(param.dt, dtype)
+            dt = clamped_dt(dt, dt_scale)
             joff = get_offsets("j", jl)
             ioff = get_offsets("i", il)
             offs = jnp.stack([joff, ioff]).astype(jnp.int32)
@@ -807,6 +822,15 @@ class NS2DDistSolver:
             _tm.emit("halo", **rec)
 
     # ------------------------------------------------------------------
+    def _rebuild_chunk(self):
+        """Rebuild every traced kernel against the solver's CURRENT
+        attributes (recovery dt clamp) — the rollback-recovery rebuild hook
+        (models/_driver.RingRecovery). Advances the fault-injection
+        generation (see models/ns2d._rebuild_chunk)."""
+        self._field_faults = _fi.take_field_faults()
+        self._build()
+        return self._chunk_sm
+
     def initial_state(self) -> tuple:
         """(u, v, p, t, nt[, metrics]) matching the built chunk's arity
         (the NS-2D convention — see models/ns2d.initial_state)."""
@@ -819,26 +843,48 @@ class NS2DDistSolver:
         return state
 
     def run(self, progress: bool = True, on_sync=None) -> None:
+        """The dist drive loop now IS models/_driver.drive_chunks (PR 4):
+        same chunk semantics as before (dispatch, read t, sync — the
+        historical while-t<=te loop), plus the shared failure protocol the
+        single-device families already had — transient-fault retry with a
+        replenishing budget and divergence rollback-recovery when a ring
+        is armed. No pallas rebuild hook here (the per-shard kernels have
+        no per-backend rebuild path), so non-transient chunk failures
+        propagate unchanged."""
+        from ._driver import drive_chunks, make_recovery
+
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         state = self.initial_state()
-        u, v, p, t, nt = state[:5]
-        m = state[5] if self._metrics else None
         rec = (_tm.ChunkRecorder("ns2d_dist", self.nt)
                if self._metrics else None)
-        while float(t) <= self.param.te:
-            if self._metrics:
-                u, v, p, t, nt, m = self._chunk_sm(u, v, p, t, nt, m)
-                rec.update(float(t), int(nt), m)
-            else:
-                u, v, p, t, nt = self._chunk_sm(u, v, p, t, nt)
-            bar.update(float(t))
+        recover = make_recovery(self, "ns2d_dist", time_index=3,
+                                recorder=rec)
+
+        def publish(s):
+            self.u, self.v, self.p = s[0], s[1], s[2]
+            self.t, self.nt = float(s[3]), int(s[4])
+
+        def on_state(s):
+            if rec is not None:
+                rec.update(float(s[3]), int(s[4]), s[5])
+            if recover is not None:
+                recover.capture(s)
             if on_sync is not None:
-                self.u, self.v, self.p = u, v, p
-                self.t, self.nt = float(t), int(nt)
+                publish(s)
                 on_sync(self)
-        bar.stop()
-        self.u, self.v, self.p = u, v, p
-        self.t, self.nt = float(t), int(nt)
+
+        if recover is not None:
+            recover.capture(state)  # first-chunk divergence is recoverable
+        # transient retry is SINGLE-CONTROLLER only: under a multi-process
+        # launch a rank-local re-dispatch would desynchronize the chunk's
+        # collectives across ranks (ROADMAP open item) — disable it there
+        # and let the fault kill the job cleanly
+        budget = 0 if jax.process_count() > 1 else 1
+        state = drive_chunks(state, self._chunk_sm, self.param.te, 3, bar,
+                             retry=lambda: None, on_state=on_state,
+                             replenish_after=self.param.tpu_retry_replenish,
+                             recover=recover, transient_budget=budget)
+        publish(state)
 
     # -- collect: stacked extended blocks -> full reference-layout array -
     def _assemble(self, stacked) -> np.ndarray:

@@ -28,8 +28,10 @@ import numpy as np
 from jax import lax
 
 from ..ops import ns3d as ops
+from ..utils import faultinject as _fi
 from ..utils import flags as _flags
 from ..utils import telemetry as _tm
+from ._driver import clamped_dt
 from ..utils.grid import Grid
 from ..utils.params import Parameter, validate_obstacle_layout
 from ..utils.precision import resolve_dtype
@@ -255,6 +257,7 @@ class NS3DSolver:
         self.nt = 0
         self._backend = "auto"
         self._fused = False  # set by _build_chunk (fused-phase dispatch)
+        self._dt_scale = 1.0  # recovery dt clamp (models/_driver.clamped_dt)
         # flag-field obstacles (ops/obstacle3d.py): static geometry -> static
         # masks baked into the traced step as constants (branch-free)
         if param.obstacles.strip():
@@ -275,6 +278,9 @@ class NS3DSolver:
         else:
             self.masks = None
         t0 = time.perf_counter()
+        # fault-injection generation: taken here and in _rebuild_chunk
+        # only (see models/ns2d.py for the pallas-fallback rationale)
+        self._field_faults = _fi.take_field_faults()
         self._chunk_fn = jax.jit(self._build_chunk())
         from ..utils import dispatch as _dispatch
 
@@ -350,14 +356,19 @@ class NS3DSolver:
         }
         adaptive = param.tau > 0.0
         problem = param.name.replace("3d", "")
+        dt_scale = self._dt_scale  # 1.0 = identity (recovery rebuilds clamp)
+        faults = getattr(self, "_field_faults", ())
 
         def step(u, v, w, p, t, nt):
+            u, v, w, p = _fi.apply_field_faults(faults, nt, u=u, v=v, w=w,
+                                                p=p)
             if adaptive:
                 dt = ops.compute_timestep_3d(
                     u, v, w, jnp.asarray(self.dt_bound, dtype), dx, dy, dz, param.tau
                 )
             else:
                 dt = jnp.asarray(param.dt, dtype)
+            dt = clamped_dt(dt, dt_scale)
             u, v, w = ops.set_boundary_conditions_3d(u, v, w, bcs)
             if problem == "dcavity":
                 u = ops.set_special_bc_dcavity_3d(u)
@@ -427,6 +438,8 @@ class NS3DSolver:
             return None
         solve = self._make_solve(backend)
         adaptive = param.tau > 0.0
+        dt_scale = self._dt_scale  # 1.0 = identity (recovery rebuilds clamp)
+        faults = getattr(self, "_field_faults", ())
         te = param.te
         chunk = param.tpu_chunk or self.CHUNK
         offs = jnp.zeros((3,), jnp.int32)
@@ -434,11 +447,14 @@ class NS3DSolver:
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
         def step(up, vp, wp, p, t, nt, umax, vmax, wmax):
+            up, vp, wp, p = _fi.apply_field_faults(faults, nt, u=up, v=vp,
+                                                   w=wp, p=p)
             if adaptive:
                 dt = ops.cfl_dt_3d(umax, vmax, wmax, dt_bound, dx, dy, dz,
                                    param.tau)
             else:
                 dt = jnp.asarray(param.dt, dtype)
+            dt = clamped_dt(dt, dt_scale)
             dt11 = jnp.full((1, 1), dt, dtype)
             up, vp, wp, fp, gp, hp, rhsp = pre(offs, dt11, up, vp, wp)
             rhs = unpad3(rhsp)
@@ -513,7 +529,9 @@ class NS3DSolver:
 
     def _build_chunk(self, backend: str = "auto"):
         # trace-time telemetry gate (utils/flags.py convention): unset means
-        # the chunk below is byte-identical to the uninstrumented program
+        # the chunk below is byte-identical to the uninstrumented program.
+        # Field-fault injection reads self._field_faults — set by
+        # __init__/_rebuild_chunk, not taken here (see ns2d)
         metrics = _tm.enabled()
         self._metrics = metrics
         fused = self._build_fused_chunk(backend, metrics=metrics)
@@ -564,6 +582,15 @@ class NS3DSolver:
 
         return chunk_fn_metrics if metrics else chunk_fn
 
+    def _rebuild_chunk(self):
+        """Re-trace the chunk against the solver's CURRENT attributes
+        (backend, recovery dt clamp) — the rollback-recovery rebuild hook
+        (models/_driver.RingRecovery). Advances the fault-injection
+        generation (see models/ns2d._rebuild_chunk)."""
+        self._field_faults = _fi.take_field_faults()
+        self._chunk_fn = jax.jit(self._build_chunk(backend=self._backend))
+        return self._chunk_fn
+
     def initial_state(self) -> tuple:
         """(u, v, w, p, t, nt[, metrics]) matching the built chunk's arity
         (the NS-2D convention — see models/ns2d.initial_state)."""
@@ -577,10 +604,11 @@ class NS3DSolver:
 
     def run(self, progress: bool = True, on_sync=None) -> None:
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
-        from ._driver import drive_chunks, pallas_retry
+        from ._driver import drive_chunks, make_recovery, pallas_retry
 
         state = self.initial_state()
         rec = _tm.ChunkRecorder("ns3d", self.nt) if self._metrics else None
+        recover = make_recovery(self, "ns3d", time_index=4, recorder=rec)
 
         def publish(s):
             self.u, self.v, self.w, self.p = s[0], s[1], s[2], s[3]
@@ -589,13 +617,22 @@ class NS3DSolver:
         def on_state(s):
             if rec is not None:
                 rec.update(float(s[4]), int(s[5]), s[6])
+            if recover is not None:
+                recover.capture(s)
             if on_sync is not None:
                 publish(s)
                 on_sync(self)
 
+        if recover is not None:
+            recover.capture(state)  # first-chunk divergence is recoverable
         state = drive_chunks(state, self._chunk_fn, self.param.te, 4, bar,
-                             pallas_retry(self, "3-D pressure solve"),
-                             on_state, lookahead=self.param.tpu_lookahead)
+                             pallas_retry(
+                                 self, "3-D pressure solve",
+                                 restore_after=self.param.tpu_retry_replenish,
+                             ),
+                             on_state, lookahead=self.param.tpu_lookahead,
+                             replenish_after=self.param.tpu_retry_replenish,
+                             recover=recover)
         publish(state)
 
     def collect(self):

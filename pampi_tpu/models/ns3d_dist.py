@@ -58,8 +58,10 @@ from ..parallel.stencil3d import (
     rb_exchange_per_sweep_3d,
 )
 from ..utils import dispatch as _dispatch
+from ..utils import faultinject as _fi
 from ..utils import flags as _flags
 from ..utils import telemetry as _tm
+from ._driver import clamped_dt
 from ..utils.grid import Grid
 from ..utils.params import Parameter
 from ..utils.precision import resolve_dtype
@@ -148,6 +150,10 @@ class NS3DDistSolver:
             )
         else:
             self.masks = None
+        self._dt_scale = 1.0  # recovery dt clamp (models/_driver.clamped_dt)
+        # fault-injection generation: taken here and in _rebuild_chunk
+        # only (see models/ns2d.py for the rationale)
+        self._field_faults = _fi.take_field_faults()
         self._build()
         self.u, self.v, self.w, self.p = self._init_sm()
 
@@ -158,6 +164,11 @@ class NS3DDistSolver:
         g = self.grid
         dtype = self.dtype
         metrics = self._metrics  # trace-time telemetry gate (see __init__)
+        # field-fault injection + recovery dt clamp: both trace-time, both
+        # identity when unarmed (the PAMPI_FAULTS-unset jaxpr contract);
+        # the generation is taken by __init__/_rebuild_chunk, not here
+        field_faults = self._field_faults
+        dt_scale = self._dt_scale
         kl, jl, il = self.kl, self.jl, self.il
         dx, dy, dz = g.dx, g.dy, g.dz
 
@@ -498,10 +509,13 @@ class NS3DDistSolver:
         idx_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
         def step(u, v, w, p, t, nt):
+            u, v, w, p = _fi.apply_field_faults(field_faults, nt, u=u, v=v,
+                                                w=w, p=p)
             u = halo_exchange(u, comm)
             v = halo_exchange(v, comm)
             w = halo_exchange(w, comm)
             dt = compute_dt(u, v, w) if adaptive else jnp.asarray(param.dt, dtype)
+            dt = clamped_dt(dt, dt_scale)
             u, v, w = set_bcs(u, v, w)
             u = set_special_bc(u)
             u = halo_exchange(u, comm)
@@ -577,6 +591,8 @@ class NS3DDistSolver:
 
             pre_k, post_k = fused_k
             H = FUSE_DEEP_HALO
+            u, v, w, p = _fi.apply_field_faults(field_faults, nt, u=u, v=v,
+                                                w=w, p=p)
             ud = halo_exchange(embed_deep(u, H), comm, depth=H)
             vd = halo_exchange(embed_deep(v, H), comm, depth=H)
             wd = halo_exchange(embed_deep(w, H), comm, depth=H)
@@ -584,6 +600,7 @@ class NS3DDistSolver:
             # value set as the exchanged extended blocks
             dt = (compute_dt(ud, vd, wd) if adaptive
                   else jnp.asarray(param.dt, dtype))
+            dt = clamped_dt(dt, dt_scale)
             offs = jnp.stack([
                 get_offsets("k", kl), get_offsets("j", jl),
                 get_offsets("i", il),
@@ -737,6 +754,15 @@ class NS3DDistSolver:
             _tm.emit("halo", **rec)
 
     # ------------------------------------------------------------------
+    def _rebuild_chunk(self):
+        """Rebuild every traced kernel against the solver's CURRENT
+        attributes (recovery dt clamp) — the rollback-recovery rebuild hook
+        (models/_driver.RingRecovery). Advances the fault-injection
+        generation (see models/ns2d._rebuild_chunk)."""
+        self._field_faults = _fi.take_field_faults()
+        self._build()
+        return self._chunk_sm
+
     def initial_state(self) -> tuple:
         """(u, v, w, p, t, nt[, metrics]) matching the built chunk's arity
         (the NS-2D convention — see models/ns2d.initial_state)."""
@@ -749,26 +775,39 @@ class NS3DDistSolver:
         return state
 
     def run(self, progress: bool = True, on_sync=None) -> None:
+        """The shared drive loop (models/_driver.drive_chunks) — see
+        models/ns2d_dist.run for the migration contract."""
+        from ._driver import drive_chunks, make_recovery
+
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         state = self.initial_state()
-        u, v, w, p, t, nt = state[:6]
-        m = state[6] if self._metrics else None
         rec = (_tm.ChunkRecorder("ns3d_dist", self.nt)
                if self._metrics else None)
-        while float(t) <= self.param.te:
-            if self._metrics:
-                u, v, w, p, t, nt, m = self._chunk_sm(u, v, w, p, t, nt, m)
-                rec.update(float(t), int(nt), m)
-            else:
-                u, v, w, p, t, nt = self._chunk_sm(u, v, w, p, t, nt)
-            bar.update(float(t))
+        recover = make_recovery(self, "ns3d_dist", time_index=4,
+                                recorder=rec)
+
+        def publish(s):
+            self.u, self.v, self.w, self.p = s[0], s[1], s[2], s[3]
+            self.t, self.nt = float(s[4]), int(s[5])
+
+        def on_state(s):
+            if rec is not None:
+                rec.update(float(s[4]), int(s[5]), s[6])
+            if recover is not None:
+                recover.capture(s)
             if on_sync is not None:
-                self.u, self.v, self.w, self.p = u, v, w, p
-                self.t, self.nt = float(t), int(nt)
+                publish(s)
                 on_sync(self)
-        bar.stop()
-        self.u, self.v, self.w, self.p = u, v, w, p
-        self.t, self.nt = float(t), int(nt)
+
+        if recover is not None:
+            recover.capture(state)  # first-chunk divergence is recoverable
+        # transient retry is single-controller only (see ns2d_dist.run)
+        budget = 0 if jax.process_count() > 1 else 1
+        state = drive_chunks(state, self._chunk_sm, self.param.te, 4, bar,
+                             retry=lambda: None, on_state=on_state,
+                             replenish_after=self.param.tpu_retry_replenish,
+                             recover=recover, transient_budget=budget)
+        publish(state)
 
     def collect(self):
         """Gather cell-centered global fields to the host. The collect

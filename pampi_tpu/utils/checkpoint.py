@@ -3,11 +3,24 @@
 The reference has NO checkpoint subsystem (SURVEY.md §5: end-of-run output
 only; its .par te/dt schema would support restart files but none exist) —
 this closes that gap TPU-side. A checkpoint is a single .npz holding the
-solver's field arrays (u, v[, w], p), simulated time t, step count nt, and
-the grid extents for a shape sanity-check on load. Solvers expose host-sync
-points (their chunked device loops return to Python every CHUNK steps);
-the driver installs `periodic_writer` there, so checkpointing never forces
-an extra device sync of its own.
+solver's field arrays (u, v[, w], p), simulated time t, step count nt, the
+grid extents for a shape sanity-check on load, a schema version, and a
+CRC32 per field so a torn or bit-rotted file is REJECTED with a clear
+error instead of silently restarting from garbage. Solvers expose
+host-sync points (their chunked device loops return to Python every CHUNK
+steps); the driver installs `periodic_writer` there, so checkpointing
+never forces an extra device sync of its own.
+
+Durability protocol (PR 4): writes go to `path.tmp` first and land via
+atomic rename, and a write over an EXISTING checkpoint first rotates it to
+`path.prev` — two generations on disk, so the crash/corruption window of
+any single write never loses the run. `load_checkpoint` verifies the
+per-field CRCs; a torn/corrupt/missing primary falls back to the `.prev`
+generation (with a warning and a `ckpt reject` telemetry record).
+Config-class mismatches (wrong mesh, wrong grid) are NOT corruption and
+never fall back — they raise the clear ValueError they always did. The
+drive loop's divergence rollback uses the newest on-disk generation as the
+COLD tier under its in-memory state ring (models/_driver.RingRecovery).
 
 .par keys (framework-only):
   tpu_checkpoint        path to write (every tpu_ckpt_every syncs +
@@ -18,14 +31,48 @@ an extra device sync of its own.
 
 from __future__ import annotations
 
+import math
+import os
+import warnings
+import zlib
+
 import numpy as np
 
+from . import faultinject as _fi
+from . import telemetry as _tm
+
 _FIELDS = ("u", "v", "w", "p")
+
+# bump when the .npz schema changes shape; version-1 files (pre-CRC) still
+# load — their integrity is only the zip container's
+CKPT_VERSION = 2
+
+
+class CheckpointCorruptError(ValueError):
+    """Torn or corrupt checkpoint file (CRC mismatch, truncated zip,
+    missing member) — the class `load_checkpoint`'s `.prev` fallback
+    catches. Config mismatches (mesh/grid) stay plain ValueError and never
+    fall back: restarting an incompatible run is a user error, not rot."""
+
+
+# the exception classes a torn/corrupt/missing .npz can surface as.
+# FileNotFoundError (not all of OSError: an EACCES/EIO on a HEALTHY primary
+# must surface raw, never masquerade as rot and silently restore stale
+# state) covers a primary lost in the rotate->rename crash window
+def _corrupt_classes():
+    import zipfile
+
+    return (CheckpointCorruptError, zipfile.BadZipFile, zlib.error,
+            EOFError, FileNotFoundError, KeyError)
 
 
 def _mesh_dims(solver):
     comm = getattr(solver, "comm", None)
     return tuple(comm.dims) if comm is not None else ()
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
 def save_checkpoint(path: str, solver) -> None:
@@ -45,6 +92,25 @@ def save_checkpoint(path: str, solver) -> None:
     # is mesh-dependent; record the mesh so a mismatched restart errors
     # clearly instead of with a confusing shape diff
     data["mesh"] = np.asarray(_mesh_dims(solver), dtype=np.int64)
+    data["version"] = np.int64(CKPT_VERSION)
+    if not math.isfinite(float(data["t"])) or not all(
+        np.isfinite(data[f]).all() for f in _FIELDS if f in data
+    ):
+        # a diverged state is a perfectly CRC-valid checkpoint — and
+        # writing it would rotate the last GOOD generation to .prev (or
+        # off the end). Refuse: restart/rollback must only ever see
+        # finite states. (Every rank returns consistently — `data` is the
+        # same collective gather everywhere.)
+        warnings.warn(
+            f"refusing to checkpoint a non-finite solver state to {path} "
+            "(the existing generations are left untouched)",
+            stacklevel=2,
+        )
+        _tm.emit("ckpt", event="skip", path=path, reason="non-finite state")
+        return
+    for f in _FIELDS:
+        if f in data:
+            data[f"crc_{f}"] = np.uint32(_crc(data[f]))
     # the fetches above are collective under a multi-process launch; the
     # file itself is written by rank 0 only. Restart re-reads it on EVERY
     # rank, so under a real multi-host launch the path must live on storage
@@ -53,16 +119,68 @@ def save_checkpoint(path: str, solver) -> None:
 
     if not multihost.is_master():
         return
+    injected = _fi.ckpt_write_faults()
     tmp = f"{path}.tmp"
     with open(tmp, "wb") as fh:
+        if "torn" in injected:
+            _fi.torn_write(fh)  # garbage + forged crash: tmp torn, live safe
         np.savez(fh, **data)
-    import os
+    rotated = os.path.exists(path)
+    if rotated:
+        import zipfile
 
+        if not zipfile.is_zipfile(path):
+            # never rotate an evidently-torn primary over the .prev
+            # generation — .prev may be the ONLY good state left (a full
+            # CRC re-read per save would catch subtler rot too, but costs
+            # a whole extra read of production-sized checkpoints; the
+            # cheap container check covers the torn/garbage class, and a
+            # bit-rotted member is displaced by the good new primary one
+            # rename later anyway)
+            os.replace(path, f"{path}.bad")
+            rotated = False
+            _tm.emit("ckpt", event="reject", path=path,
+                     error="torn primary; not rotated over .prev")
+            warnings.warn(
+                f"existing checkpoint {path} is torn; keeping the .prev "
+                f"generation and parking the bad file at {path}.bad",
+                stacklevel=2,
+            )
+        else:
+            # rotate ONLY once the new generation is fully on disk: the
+            # live file stays the newest VALID checkpoint all the way
+            os.replace(path, f"{path}.prev")
+            _tm.emit("ckpt", event="rotate", path=path)
     os.replace(tmp, path)  # atomic: a crash mid-write never corrupts
+    _tm.emit("ckpt", event="save", path=path, t=float(solver.t),
+             nt=int(solver.nt), rotated=rotated)
+    if "corrupt" in injected:
+        _fi.corrupt_file(path)  # forged corruption-at-rest of this write
 
 
-def load_checkpoint(path: str, solver) -> None:
-    with np.load(path) as z:
+def _load_one(path: str, solver) -> None:
+    try:
+        z = np.load(path)
+    except (ValueError, EOFError) as exc:
+        # a garbage (non-zip) container surfaces as np.load's ValueError —
+        # that's corruption, not a config error, so make it fall back
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: unreadable container ({exc})"
+        ) from exc
+    with z:
+        if "version" in z and int(z["version"]) > CKPT_VERSION:
+            raise ValueError(
+                f"checkpoint {path} has schema version {int(z['version'])}; "
+                f"this build reads <= {CKPT_VERSION} (written by a newer "
+                "pampi_tpu)"
+            )
+        for f in _FIELDS:
+            key = f"crc_{f}"
+            if f in z and key in z and _crc(z[f]) != int(z[key]):
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: field {f!r} fails its CRC32 "
+                    "(torn or corrupt write)"
+                )
         mesh_saved = tuple(z["mesh"]) if "mesh" in z else ()
         mesh_now = _mesh_dims(solver)
         if mesh_saved != mesh_now:
@@ -93,6 +211,45 @@ def load_checkpoint(path: str, solver) -> None:
                 setattr(solver, f, new)
         solver.t = float(z["t"])
         solver.nt = int(z["nt"])
+
+
+def load_checkpoint(path: str, solver, fallback: bool = True) -> None:
+    """Restore `solver` from `path`. A torn/corrupt/missing primary falls
+    back to the rotated `path.prev` generation (fallback=False disables,
+    for callers that must see the raw failure); a corrupt file with no
+    valid previous generation raises CheckpointCorruptError naming both."""
+    try:
+        _load_one(path, solver)
+    except _corrupt_classes() as exc:
+        _tm.emit("ckpt", event="reject", path=path, error=str(exc))
+        prev = f"{path}.prev"
+        if not fallback or not os.path.exists(prev):
+            if isinstance(exc, FileNotFoundError):
+                raise  # a plainly missing file is a config error, not rot
+            raise CheckpointCorruptError(
+                f"checkpoint {path} is torn or corrupt ({exc}) and no "
+                f"previous generation exists at {prev}"
+            ) from exc
+        warnings.warn(
+            f"checkpoint {path} is torn or corrupt ({exc}); falling back "
+            f"to the previous generation {prev}",
+            stacklevel=2,
+        )
+        try:
+            _load_one(prev, solver)
+        except _corrupt_classes() as exc2:
+            # both generations gone: ONE structured error naming both (a
+            # raw BadZipFile/zlib.error would escape cli.py's restart
+            # handler, which catches OSError/ValueError/KeyError)
+            raise CheckpointCorruptError(
+                f"checkpoint {path} is torn or corrupt ({exc}) and so is "
+                f"the previous generation {prev} ({exc2})"
+            ) from exc2
+        _tm.emit("ckpt", event="load", path=prev, generation="prev",
+                 t=float(solver.t), nt=int(solver.nt))
+        return
+    _tm.emit("ckpt", event="load", path=path, generation="primary",
+             t=float(solver.t), nt=int(solver.nt))
 
 
 def periodic_writer(path: str, every: int = 10):

@@ -1,0 +1,267 @@
+"""Deterministic fault injection: the proof plane for the recovery layer.
+
+`PAMPI_FAULTS=<spec>` (TEST-ONLY — never set it on a production run) arms
+deterministic faults at named trigger points, so the retry/rollback
+machinery in `models/_driver.py` and `utils/checkpoint.py` can be exercised
+end-to-end instead of waiting for real hardware to misbehave. The switch
+follows the `utils/flags.py` convention: unset means every hook below is a
+no-op, traced programs are byte-identical to the uninjected build, and the
+drive loop takes the exact historical path (test-asserted in
+tests/test_faultinject.py, the same contract as `PAMPI_TELEMETRY`).
+
+Spec grammar — comma-separated clauses, each `kind@site<N>[:field][*count]`:
+
+  pallas@chunk<N>         forged pallas runtime failure on the Nth chunk
+                          dispatch (exercises the pallas->jnp rebuild)
+  transient@chunk<N>      forged `UNAVAILABLE` device fault on the Nth
+                          dispatch (exercises the transient retry budget;
+                          repeat the clause with different N for spaced /
+                          back-to-back transients)
+  nan@step<N>:<field>     trace-time NaN corruption of solver field
+                          u|v|w|p at step N (exercises the PR 3 in-band
+                          divergence sentinel end-to-end)
+  inf@step<N>:<field>     same, +inf
+  ckpt_torn@write<N>      forged crash mid-`np.savez` on the Nth checkpoint
+                          write — a torn `.tmp` is left behind (proves the
+                          atomic-rename protocol never corrupts the live file)
+  ckpt_corrupt@write<N>   flip bytes in the primary checkpoint after the
+                          Nth successful write (exercises CRC rejection +
+                          the `.prev` generation fallback)
+  telemetry@emit<N>       OSError on the Nth telemetry record write
+                          (exercises the warn-once stand-down)
+
+Field-corruption clauses (`nan`/`inf`) are consumed by SOLVER GENERATIONS
+(one take in __init__, one per recovery `_rebuild_chunk` — a pallas->jnp
+fallback rebuild keeps the current generation): each clause arms `count`
+generations (default 1, `*R` re-arms R), and a take spends one charge. A
+rollback-recovery rebuild therefore re-drives CLEAN once the clause is
+spent — the deterministic shape the recovery tests need (and `*99` makes
+the corruption persistent, the recovery-exhaustion shape). Host-side
+counters (chunk dispatches, checkpoint writes, telemetry records) are
+process-global and 1-based; tests call `reset()` between runs.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FIELDS = ("u", "v", "w", "p")
+_KIND_SITE = {
+    "pallas": "chunk",
+    "transient": "chunk",
+    "nan": "step",
+    "inf": "step",
+    "ckpt_torn": "write",
+    "ckpt_corrupt": "write",
+    "telemetry": "emit",
+}
+
+_CLAUSE_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<site>[a-z]+)(?P<n>\d+)"
+    r"(?::(?P<field>[a-z]))?(?:\*(?P<count>\d+))?$"
+)
+
+
+class FaultSpecError(ValueError):
+    """Unparseable PAMPI_FAULTS spec — fail loudly at the first hook, not
+    silently run the uninjected program a test believes is injected."""
+
+
+class InjectedPallasError(RuntimeError):
+    """Forged pallas runtime failure (`pallas@chunk<N>`): NOT transient, so
+    the drive loop routes it to the pallas->jnp rebuild hook, and a run
+    with no jnp alternative terminates with this diagnostic."""
+
+
+class JaxRuntimeError(Exception):
+    """Name-alike of jax's runtime error for `transient@chunk<N>`:
+    `_driver._is_transient_device_fault` matches on the type NAME plus
+    `UNAVAILABLE` in the message, so the forged fault takes exactly the
+    real transient's retry path without touching jax internals."""
+
+
+class CheckpointWriteCrash(RuntimeError):
+    """Forged process crash mid-checkpoint-write (`ckpt_torn@write<N>`):
+    raised after garbage bytes went into the `.tmp`, before the atomic
+    rename — the crash window the rename protocol must survive."""
+
+
+# per-process mutable state: trigger counters, per-clause build charges
+_counters: dict[str, int] = {}
+_charges: dict[int, int] = {}
+_cache: tuple[str, tuple] | None = None
+
+
+def enabled() -> bool:
+    return bool(os.environ.get("PAMPI_FAULTS", ""))
+
+
+def reset() -> None:
+    """Re-arm every clause and zero the trigger counters (tests)."""
+    global _cache
+    _counters.clear()
+    _charges.clear()
+    _cache = None
+
+
+def _clauses() -> tuple:
+    """Parse (and cache) the spec: tuples of (kind, site, n, field, count)."""
+    global _cache
+    spec = os.environ.get("PAMPI_FAULTS", "")
+    if _cache is not None and _cache[0] == spec:
+        return _cache[1]
+    out = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        m = _CLAUSE_RE.match(raw)
+        if m is None or _KIND_SITE.get(m["kind"]) != m["site"]:
+            raise FaultSpecError(
+                f"bad PAMPI_FAULTS clause {raw!r}; grammar: "
+                "pallas@chunk<N> | transient@chunk<N> | nan@step<N>:<field> "
+                "| inf@step<N>:<field> | ckpt_torn@write<N> | "
+                "ckpt_corrupt@write<N> | telemetry@emit<N>  (comma-separated;"
+                " field faults take an optional *<count> re-arm suffix)"
+            )
+        field = m["field"]
+        if m["kind"] in ("nan", "inf"):
+            if field not in _FIELDS:
+                raise FaultSpecError(
+                    f"PAMPI_FAULTS clause {raw!r}: field must be one of "
+                    f"{'|'.join(_FIELDS)}"
+                )
+        elif field is not None:
+            raise FaultSpecError(
+                f"PAMPI_FAULTS clause {raw!r}: only nan/inf take a :<field>"
+            )
+        out.append((m["kind"], m["site"], int(m["n"]), field,
+                    int(m["count"] or 1)))
+    _cache = (spec, tuple(out))
+    return _cache[1]
+
+
+def _bump(site: str) -> int:
+    n = _counters.get(site, 0) + 1
+    _counters[site] = n
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Host-side triggers
+# ---------------------------------------------------------------------------
+
+def maybe_chunk_fault() -> None:
+    """Called by the drive loop once per chunk DISPATCH (1-based; a retried
+    chunk is a new dispatch). Raises the forged fault armed for this index."""
+    if not enabled():
+        return
+    n = _bump("chunk")
+    for kind, site, when, _f, _c in _clauses():
+        if site != "chunk" or when != n:
+            continue
+        if kind == "pallas":
+            raise InjectedPallasError(
+                f"PAMPI_FAULTS: injected pallas runtime failure at chunk "
+                f"dispatch {n}"
+            )
+        raise JaxRuntimeError(
+            f"UNAVAILABLE: PAMPI_FAULTS injected transient device fault at "
+            f"chunk dispatch {n}"
+        )
+
+
+def ckpt_write_faults() -> frozenset:
+    """Bump the checkpoint-write counter (one bump per save attempt) and
+    return the fault kinds armed for this write: subset of
+    {"torn", "corrupt"}."""
+    if not enabled():
+        return frozenset()
+    n = _bump("write")
+    hit = set()
+    for kind, site, when, _f, _c in _clauses():
+        if site == "write" and when == n:
+            hit.add(kind.replace("ckpt_", ""))
+    return frozenset(hit)
+
+
+def torn_write(fh) -> None:
+    """The `ckpt_torn` payload: garbage partial bytes into the open `.tmp`,
+    then the forged crash — `np.savez` never runs, the rename never happens."""
+    fh.write(b"PAMPI-TORN-CHECKPOINT\x00\xde\xad")
+    fh.flush()
+    raise CheckpointWriteCrash(
+        "PAMPI_FAULTS: injected crash mid-checkpoint-write (torn .tmp left "
+        "behind; the live file must be untouched)"
+    )
+
+
+def corrupt_file(path: str, at: float = 0.5) -> None:
+    """Flip bytes mid-file (the `ckpt_corrupt` payload; also a direct test
+    helper for corruption-at-rest)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(max(0, int(size * at) - 8))
+        fh.write(b"\xde\xad\xbe\xef" * 4)
+
+
+def maybe_telemetry_fail() -> None:
+    """Called by `telemetry.emit` once per record write; raises OSError for
+    the armed index (the emit path's own except handles it — warn once,
+    stand down, never sink the run)."""
+    if not enabled():
+        return
+    n = _bump("emit")
+    for kind, site, when, _f, _c in _clauses():
+        if kind == "telemetry" and site == "emit" and when == n:
+            raise OSError(
+                f"PAMPI_FAULTS: injected telemetry write failure at record {n}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Trace-time field corruption
+# ---------------------------------------------------------------------------
+
+def take_field_faults() -> tuple:
+    """Consume one solver generation of nan/inf clauses: every armed
+    clause with charges left spends one and is returned as
+    (field, step, value). Solvers call this in __init__ and
+    `_rebuild_chunk` (NOT per `_build_chunk` — the pallas fallback rebuild
+    reuses the armed generation) and bake the result, so consumption is
+    deterministic at take time (lazy jit tracing never double-spends) and
+    a rollback-recovery rebuild gets the NEXT generation — clean once the
+    clause is spent."""
+    if not enabled():
+        return ()
+    out = []
+    for idx, (kind, _s, step, field, count) in enumerate(_clauses()):
+        if kind not in ("nan", "inf"):
+            continue
+        used = _charges.get(idx, 0)
+        if used >= count:
+            continue
+        _charges[idx] = used + 1
+        out.append((field, step, float("nan" if kind == "nan" else "inf")))
+    return tuple(out)
+
+
+def apply_field_faults(faults, nt, **fields) -> tuple:
+    """Bake taken clauses into a traced step: each becomes
+    `where(nt == step, bad, x)` on its named field (values returned in
+    keyword order). With no clauses — the PAMPI_FAULTS-unset path — the
+    inputs pass through as the SAME tracers: zero added ops, jaxpr
+    identity preserved."""
+    if not faults:
+        return tuple(fields.values())
+    import jax.numpy as jnp
+
+    out = dict(fields)
+    for field, step, value in faults:
+        if field in out:
+            x = out[field]
+            out[field] = jnp.where(
+                jnp.asarray(nt) == step, jnp.asarray(value, x.dtype), x
+            )
+    return tuple(out.values())

@@ -174,10 +174,34 @@ class Parameter:
     # ShardedVtkWriter; binary, byte-identical to "binary"). On a
     # single-device run "sharded" degrades to "binary" (same bytes).
     tpu_vtk: str = "ascii"
-    # checkpoint/restart (utils/checkpoint.py; the reference has none)
+    # checkpoint/restart (utils/checkpoint.py; the reference has none).
+    # Writes rotate the live file to <path>.prev first (two generations on
+    # disk) and carry per-field CRC32s; load rejects torn/corrupt files and
+    # falls back to the .prev generation (README "Robustness").
     tpu_checkpoint: str = ""
     tpu_ckpt_every: int = 10
     tpu_restart: str = ""
+    # divergence rollback-recovery (models/_driver.RingRecovery; README
+    # "Robustness"): tpu_recover_ring > 0 arms an in-memory ring of the
+    # last-K confirmed finite chunk states (no disk round-trip on the hot
+    # path; the on-disk tpu_checkpoint is the cold tier when the ring is
+    # exhausted). On a NaN loop time the drive loop rolls back to the
+    # newest ring entry (successive attempts dig deeper) and re-drives
+    # with dt clamped by tpu_recover_dt_scale (cumulative per attempt),
+    # at most tpu_recover_max attempts per run — each attempt emits a
+    # structured `recover` telemetry record. 0 (default) keeps the
+    # historical terminate-on-NaN behavior. Memory cost: ring x one state
+    # tuple held on device.
+    tpu_recover_ring: int = 0
+    tpu_recover_dt_scale: float = 0.5
+    tpu_recover_max: int = 3
+    # retry-budget replenishment (models/_driver.drive_chunks): the
+    # one-shot transient device-fault budget refills — and a pallas->jnp
+    # runtime fallback is allowed to restore the pallas chunk — after this
+    # many consecutive clean chunks, so a 10-hour run survives more than
+    # one spaced transient. 0 = never refill (the historical
+    # one-fault-per-run budget).
+    tpu_retry_replenish: int = 8
     # keys explicitly present in the parsed file (not a .par key itself);
     # lets the driver tell a 3-D config (kmax/zlength/bcFront set) from a
     # 2-D one, since the reference distinguishes by binary instead

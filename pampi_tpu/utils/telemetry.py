@@ -25,6 +25,12 @@ Host plane — every record is one JSON line appended to the
   chunk       one host sync: steps, wall, ms/step, res/it/dt/maxima; the
               FIRST chunk record is compile-inclusive (includes_compile)
   divergence  the sentinel fired: first_bad_step / last_good_step
+  recover     a divergence rollback-recovery attempt (models/_driver.
+              RingRecovery): attempt #, rollback target t/nt, dt clamp
+  retry       a retry-budget consumption (transient device fault retried,
+              pallas->jnp fallback, pallas restore after clean chunks)
+  ckpt        a checkpoint event (utils/checkpoint.py): save / rotate /
+              load / reject, with path and t/nt where meaningful
   solve       a driver-level Poisson solve (iters, residual, wall)
   halo        static per-shard halo-exchange byte counts (dist solvers)
   span        a named timing span — the ONE decomposition protocol the
@@ -46,7 +52,7 @@ import os
 import time
 import warnings
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2  # v2 (PR 4): + recover / retry / ckpt record kinds
 
 # METRICS vector layout (float32, shared by the 2-D and 3-D families; the
 # 2-D solvers leave M_WMAX at 0). M_BAD < 0 means all-finite so far;
@@ -106,6 +112,9 @@ def emit(kind: str, **fields) -> None:
     rec = {"v": SCHEMA_VERSION, "kind": kind, "ts": round(time.time(), 3)}
     rec.update(fields)
     try:
+        from . import faultinject as _fi
+
+        _fi.maybe_telemetry_fail()  # injected write failure (test-only)
         with open(_path(), "a") as fh:
             # allow_nan=False + the sanitizer: divergence records carry
             # non-finite scalars BY DESIGN, and Python's default NaN/Inf
@@ -296,6 +305,19 @@ class ChunkRecorder:
         self._nt = nt0
         self._first = True
         self._diverged = False
+
+    def rearm(self, nt=None) -> None:
+        """Re-arm the one-shot divergence latch: rollback-recovery rolled
+        the state back, so a SECOND blow-up must record again. Passing the
+        rollback target `nt` also re-baselines the step counter and wall
+        timer (without it the first post-rollback chunk record would
+        report negative steps/ms_per_step) and marks that record
+        compile-inclusive — the rebuilt chunk re-traces."""
+        self._diverged = False
+        if nt is not None:
+            self._nt = int(nt)
+            self._last = time.perf_counter()
+            self._first = True
 
     def update(self, t: float, nt: int, metrics) -> None:
         if not enabled():

@@ -22,6 +22,23 @@ import pytest
 REFERENCE = pathlib.Path("/root/reference")
 
 
+@pytest.fixture()
+def faults(monkeypatch):
+    """Arm a PAMPI_FAULTS spec via the returned setter (utils/faultinject);
+    guarantees env cleanup + counter/charge reset however the test exits.
+    Shared by the injection suites (test_faultinject, test_checkpoint)."""
+    from pampi_tpu.utils import faultinject as fi
+
+    def arm(spec):
+        monkeypatch.setenv("PAMPI_FAULTS", spec)
+        fi.reset()
+
+    monkeypatch.delenv("PAMPI_FAULTS", raising=False)
+    fi.reset()
+    yield arm
+    fi.reset()
+
+
 @pytest.fixture(scope="session")
 def reference_dir() -> pathlib.Path:
     """Path to the reference C tree. Unmounted containers (the growth/CI

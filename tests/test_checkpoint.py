@@ -1,12 +1,19 @@
 """Checkpoint/restart round-trip: a run interrupted at a host sync and
 resumed from the .npz must finish bit-identical to an uninterrupted run
-(the subsystem the reference lacks, SURVEY.md §5)."""
+(the subsystem the reference lacks, SURVEY.md §5). PR 4 durability edges:
+per-field CRC32 + schema version, live->.prev rotation, torn-.tmp crash
+safety, corrupt-primary fallback, and the restart-under-telemetry arity
+contract."""
+
+import os
 
 import numpy as np
 import pytest
 
 from pampi_tpu.models.ns2d import NS2DSolver
 from pampi_tpu.utils import checkpoint as ckpt
+from pampi_tpu.utils import faultinject as fi
+from pampi_tpu.utils import telemetry as tm
 from pampi_tpu.utils.params import Parameter, read_parameter
 
 
@@ -98,3 +105,203 @@ def test_roundtrip_distributed(tmp_path):
     other = NS3DDistSolver(p3(0.2), CartComm(ndims=3, dims=(1, 2, 4)))
     with pytest.raises(ValueError, match="mesh"):
         ckpt.load_checkpoint(path, other)
+
+
+# ---------------------------------------------------------------------------
+# PR 4: durability edges (rotation, torn writes, corruption, fallback)
+# ---------------------------------------------------------------------------
+
+# the `faults` arming fixture lives in tests/conftest.py
+
+def _two_generations(tmp_path):
+    """One solver, two saves: gen1 rotated to .prev, gen2 live. Returns
+    (path, solver, t_gen1, t_gen2)."""
+    path = str(tmp_path / "ck.npz")
+    s = NS2DSolver(_param(te=0.1))
+    s.run(progress=False)
+    t1 = s.t
+    ckpt.save_checkpoint(path, s)
+    s.t = t1 + 7.0  # distinguishable second generation
+    ckpt.save_checkpoint(path, s)
+    assert os.path.exists(path + ".prev")
+    return path, s, t1, s.t
+
+
+def test_rotation_keeps_previous_generation(tmp_path):
+    path, _s, t1, t2 = _two_generations(tmp_path)
+    a = NS2DSolver(_param(te=0.1))
+    ckpt.load_checkpoint(path, a)
+    assert a.t == t2
+    b = NS2DSolver(_param(te=0.1))
+    ckpt.load_checkpoint(path + ".prev", b)
+    assert b.t == t1
+
+
+def test_torn_tmp_never_corrupts_live(tmp_path, faults):
+    """An injected crash mid-np.savez leaves a torn .tmp; the atomic-rename
+    protocol keeps the live file (and .prev) byte-valid and loadable."""
+    path, s, t1, t2 = _two_generations(tmp_path)
+    faults("ckpt_torn@write1")
+    with pytest.raises(fi.CheckpointWriteCrash, match="torn"):
+        ckpt.save_checkpoint(path, s)
+    assert os.path.exists(path + ".tmp")  # the torn artifact
+    fresh = NS2DSolver(_param(te=0.1))
+    ckpt.load_checkpoint(path, fresh)  # live file: still gen2, CRC-clean
+    assert fresh.t == t2
+    prev = NS2DSolver(_param(te=0.1))
+    ckpt.load_checkpoint(path + ".prev", prev)
+    assert prev.t == t1
+
+
+def test_corrupt_primary_falls_back_to_prev(tmp_path, faults):
+    """An injected post-write corruption of the primary is rejected (CRC /
+    zip integrity) and load falls back to the .prev generation."""
+    path, s, t1, _t2 = _two_generations(tmp_path)
+    faults("ckpt_corrupt@write1")
+    ckpt.save_checkpoint(path, s)  # gen3 written then corrupted in place
+    fresh = NS2DSolver(_param(te=0.1))
+    with pytest.warns(UserWarning, match="falling back"):
+        ckpt.load_checkpoint(path, fresh)
+    # .prev is now gen2 (rotated by the gen3 write)
+    assert fresh.t == s.t
+
+
+def test_corrupt_without_prev_raises_clearly(tmp_path):
+    """Corruption-at-rest with no previous generation: a clear structured
+    error naming the file, not a confusing numpy traceback."""
+    path = str(tmp_path / "only.npz")
+    s = NS2DSolver(_param(te=0.1))
+    ckpt.save_checkpoint(path, s)
+    fi.corrupt_file(path)
+    other = NS2DSolver(_param(te=0.1))
+    with pytest.raises(ckpt.CheckpointCorruptError, match="torn or corrupt"):
+        ckpt.load_checkpoint(path, other)
+
+
+def test_crc_rejects_payload_bitflip(tmp_path):
+    """A checkpoint whose zip container still reads but whose field bytes
+    changed fails the per-field CRC32 (defense beyond the container's own
+    integrity): rebuild the .npz with one flipped u value."""
+    path = str(tmp_path / "ck.npz")
+    s = NS2DSolver(_param(te=0.1))
+    s.run(progress=False)
+    ckpt.save_checkpoint(path, s)
+    with np.load(path) as z:
+        data = {k: z[k].copy() for k in z.files}
+    data["u"].flat[5] += 1.0  # payload flip, container re-written validly
+    with open(path, "wb") as fh:
+        np.savez(fh, **data)
+    other = NS2DSolver(_param(te=0.1))
+    with pytest.raises(ckpt.CheckpointCorruptError, match="CRC32"):
+        ckpt.load_checkpoint(path, other, fallback=False)
+
+
+def test_mesh_mismatch_single_vs_dist(tmp_path):
+    """A dist-written checkpoint refuses to load into a single-device
+    solver (stacked extended blocks are mesh-dependent) — with the message
+    naming tpu_mesh, and NO .prev fallback (config error, not rot)."""
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    path = str(tmp_path / "ck.npz")
+    d = NS2DDistSolver(_param(te=0.05), CartComm(ndims=2, dims=(2, 2)))
+    d.run(progress=False)
+    ckpt.save_checkpoint(path, d)
+    single = NS2DSolver(_param(te=0.05))
+    with pytest.raises(ValueError, match="tpu_mesh"):
+        ckpt.load_checkpoint(path, single)
+
+
+def test_restart_under_telemetry(tmp_path, monkeypatch):
+    """Satellite (PR 4): a restart of a telemetry-enabled run rebuilds its
+    chunk state via initial_state(), so the first post-restart chunk has
+    the metrics arity — and the resumed run finishes bit-identical to an
+    uninterrupted telemetry run, with ckpt save/load records and a
+    continuous chunk trajectory in the flight record."""
+    import json
+
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(tmp_path / "a.jsonl"))
+    tm.reset()
+    ref = NS2DSolver(_param(te=0.5))
+    ref.run(progress=False)
+
+    path = str(tmp_path / "ck.npz")
+    first = NS2DSolver(_param(te=0.2))
+    first.run(progress=False, on_sync=ckpt.periodic_writer(path, every=1))
+    ckpt.save_checkpoint(path, first)
+
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(tmp_path / "b.jsonl"))
+    tm.reset()
+    second = NS2DSolver(_param(te=0.5))
+    assert second._metrics and len(second.initial_state()) == 6
+    ckpt.load_checkpoint(path, second)
+    second.run(progress=False)
+
+    assert ref.nt == second.nt
+    np.testing.assert_array_equal(np.asarray(ref.p), np.asarray(second.p))
+    np.testing.assert_array_equal(np.asarray(ref.u), np.asarray(second.u))
+
+    recs = [json.loads(ln) for ln in open(tmp_path / "b.jsonl") if ln.strip()]
+    loads = [r for r in recs if r["kind"] == "ckpt" and r["event"] == "load"]
+    assert len(loads) == 1 and loads[0]["nt"] == first.nt
+    chunks = [r for r in recs if r["kind"] == "chunk"]
+    # the post-restart trajectory starts where the checkpoint left off
+    assert chunks[0]["nt"] > first.nt and chunks[-1]["nt"] == second.nt
+    assert sum(c["steps"] for c in chunks) == second.nt - first.nt
+    tm.reset()
+
+
+def test_nonfinite_state_refused(tmp_path):
+    """A diverged state is a CRC-valid checkpoint — saving it would rotate
+    the last GOOD generation away, and a later restart/rollback would
+    resume from garbage. save_checkpoint must refuse and leave the
+    existing generations untouched."""
+    path = str(tmp_path / "ck.npz")
+    s = NS2DSolver(_param(te=0.1))
+    s.run(progress=False)
+    good_t = s.t
+    ckpt.save_checkpoint(path, s)
+    s.t = float("nan")
+    with pytest.warns(UserWarning, match="non-finite"):
+        ckpt.save_checkpoint(path, s)
+    s.t = good_t
+    s.u = s.u.at[3, 3].set(float("inf"))  # finite t, poisoned field
+    with pytest.warns(UserWarning, match="non-finite"):
+        ckpt.save_checkpoint(path, s)
+    assert not os.path.exists(path + ".prev")  # no rotation happened
+    fresh = NS2DSolver(_param(te=0.1))
+    ckpt.load_checkpoint(path, fresh)  # live file: still the good state
+    assert fresh.t == good_t
+    assert np.isfinite(np.asarray(fresh.u)).all()
+
+
+def test_torn_primary_not_rotated_over_prev(tmp_path):
+    """A torn (non-zip) primary must never rotate over the .prev
+    generation — .prev may be the only good state left. It is parked at
+    .bad and the new write lands as the fresh primary."""
+    path, s, t1, t2 = _two_generations(tmp_path)
+    with open(path, "wb") as fh:
+        fh.write(b"garbage, definitely not a zip")
+    with pytest.warns(UserWarning, match="torn"):
+        ckpt.save_checkpoint(path, s)  # gen3 write over the torn primary
+    assert os.path.exists(path + ".bad")  # the torn file, parked
+    b = NS2DSolver(_param(te=0.1))
+    ckpt.load_checkpoint(path + ".prev", b)
+    assert b.t == t1  # .prev untouched: still gen1
+    fresh = NS2DSolver(_param(te=0.1))
+    ckpt.load_checkpoint(path, fresh)  # new primary: the gen3 state
+    assert fresh.t == s.t
+
+
+def test_both_generations_corrupt_one_structured_error(tmp_path):
+    """Primary and .prev both corrupt: ONE CheckpointCorruptError naming
+    both (a ValueError subclass — cli.py's restart handler catches it),
+    never a raw BadZipFile escaping with a traceback."""
+    path, s, _t1, _t2 = _two_generations(tmp_path)
+    fi.corrupt_file(path)
+    fi.corrupt_file(path + ".prev")
+    other = NS2DSolver(_param(te=0.1))
+    with pytest.warns(UserWarning, match="falling back"):
+        with pytest.raises(ckpt.CheckpointCorruptError,
+                           match="and so is the previous generation"):
+            ckpt.load_checkpoint(path, other)

@@ -258,3 +258,228 @@ def test_tpu_chunk_override_preserves_results():
     assert a.nt == b.nt > 3
     np.testing.assert_array_equal(np.asarray(a.u), np.asarray(b.u))
     np.testing.assert_array_equal(np.asarray(a.p), np.asarray(b.p))
+
+
+# ---------------------------------------------------------------------------
+# PR 4: replenishing retry budgets + rollback-recovery protocol units
+# ---------------------------------------------------------------------------
+
+def test_transient_budget_replenishes_after_clean_chunks():
+    """The satellite fix: a second spaced transient is retried once the
+    budget refilled (replenish_after consecutive clean chunks); pre-PR the
+    per-run budget was one."""
+    calls = {"n": 0}
+
+    def flaky(t, n):
+        calls["n"] += 1
+        if calls["n"] in (2, 7):  # 3+ clean confirmations apart
+            raise JaxRuntimeError("UNAVAILABLE: TPU device error")
+        return (t + 1.0, n + 1)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state = drive_chunks(
+            (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+            flaky, te=7.5, time_index=0, bar=_Bar(), retry=lambda: None,
+            replenish_after=3,
+        )
+    assert float(state[0]) == 8.0 and int(state[1]) == 8
+    assert sum("transient" in str(x.message) for x in w) == 2
+
+
+def test_transient_budget_stays_one_inside_window():
+    """Two faults inside one replenish window still exhaust the budget."""
+    calls = {"n": 0}
+
+    def flaky(t, n):
+        calls["n"] += 1
+        if calls["n"] in (2, 4):
+            raise JaxRuntimeError("UNAVAILABLE: TPU device error")
+        return (t + 1.0, n + 1)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(JaxRuntimeError):
+            drive_chunks(
+                (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+                flaky, te=9.5, time_index=0, bar=_Bar(), retry=lambda: None,
+                replenish_after=10,
+            )
+
+
+def test_pallas_restore_after_clean_chunks():
+    """restore_after > 0: after the jnp fallback runs that many clean
+    chunks, the pallas chunk is rebuilt and takes over (rebuild sequence
+    jnp -> original backend)."""
+    s = _FakeSolver()
+    retry = pallas_retry(s, "pressure solve", restore_after=2)
+    first_fail = {"done": False}
+
+    orig_build = s._build_chunk
+
+    def build(backend):
+        fn = orig_build(backend)
+
+        def chunk(t, n):
+            if backend != "jnp" and not first_fail["done"]:
+                first_fail["done"] = True
+                raise RuntimeError("pallas kernel exploded")
+            return fn(t, n)
+
+        return chunk
+
+    s._build_chunk = build
+    s._chunk_fn = build("auto")
+    s.rebuilds.clear()  # the initial build is not a retry rebuild
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state = drive_chunks(
+            (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+            s._chunk_fn, te=5.5, time_index=0, bar=_Bar(), retry=retry,
+        )
+    assert float(state[0]) == 6.0 and int(state[1]) == 6
+    assert s.rebuilds == ["jnp", "auto"]  # fallback, then restore
+    assert s._backend == "auto"
+    assert any("restoring the pallas" in str(x.message) for x in w)
+
+
+def test_pallas_refailure_after_restore_stays_jnp():
+    """A pallas that breaks again right after its restore is judged
+    deterministically broken: one more fallback, no further restores."""
+    s = _FakeSolver()
+    retry = pallas_retry(s, "x", restore_after=1)
+    retry()                      # fallback 1 (pretend pallas failed)
+    assert retry.on_clean_chunk() is not None   # restored after 1 clean
+    s._uses = True
+    retry()                      # breaks again immediately -> dead
+    assert s.rebuilds == ["jnp", "auto", "jnp"]
+    for _ in range(5):
+        assert retry.on_clean_chunk() is None   # stays on jnp forever
+
+
+class _RecSolver:
+    """Minimal recovery target: state is (t, nt)."""
+
+    def __init__(self):
+        self._dt_scale = 1.0
+        self.rebuilt = 0
+
+    def _rebuild_chunk(self):
+        self.rebuilt += 1
+        def chunk(t, n):
+            return (t + 1.0, n + 1)
+        return chunk
+
+
+def test_ring_recovery_rolls_back_and_clamps():
+    from pampi_tpu.models._driver import RingRecovery
+
+    s = _RecSolver()
+    r = RingRecovery(s, "unit", time_index=0, ring=2, dt_scale=0.5,
+                     max_attempts=2)
+    for t in (1.0, 2.0, 3.0):
+        r.capture((jnp.asarray(t), jnp.asarray(int(t), jnp.int32)))
+    r.capture((jnp.asarray(float("nan")), jnp.asarray(9, jnp.int32)))
+    # ring keeps the last 2 FINITE states; NaN is never captured
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state, fn = r.attempt()
+    assert float(state[0]) == 3.0 and s._dt_scale == 0.5 and s.rebuilt == 1
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state, fn = r.attempt()          # digs one deeper, clamps again
+    assert float(state[0]) == 2.0 and s._dt_scale == 0.25
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert r.attempt() is None       # attempts exhausted -> terminal
+
+
+def test_drive_chunks_recovers_from_nan_time():
+    """End-to-end on fake chunks: a NaN loop time with an armed recovery
+    rolls back (rebuilt chunk advances cleanly) instead of terminating."""
+    from pampi_tpu.models._driver import RingRecovery
+
+    s = _RecSolver()
+    r = RingRecovery(s, "unit", time_index=0, ring=4, dt_scale=0.5,
+                     max_attempts=3)
+    calls = {"n": 0}
+
+    def diverging(t, n):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            return (jnp.asarray(float("nan")), n + 1)
+        return (t + 1.0, n + 1)
+
+    bar = _Bar()
+    s0 = (jnp.asarray(0.0), jnp.asarray(0, jnp.int32))
+    r.capture(s0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state = drive_chunks(
+            s0, diverging, te=4.5, time_index=0, bar=bar,
+            retry=lambda: None, on_state=r.capture, recover=r,
+        )
+    assert any("rolled back" in str(x.message) for x in w)
+    assert float(state[0]) == 5.0  # finished on the rebuilt chunk
+    assert s.rebuilt == 1
+
+
+def test_exhausted_transient_never_consumes_pallas_fallback():
+    """A transient UNAVAILABLE with the budget spent RE-RAISES — it must
+    not fall into the pallas->jnp hook (which would misattribute the
+    fault and could permanently retire a healthy kernel via the
+    post-restore broken latch)."""
+    s = _FakeSolver()
+    retry = pallas_retry(s, "x")
+    calls = {"n": 0}
+
+    def flaky(t, n):
+        calls["n"] += 1
+        if calls["n"] in (2, 3):
+            raise JaxRuntimeError("UNAVAILABLE: TPU device error")
+        return (t + 1.0, n + 1)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(JaxRuntimeError):
+            drive_chunks(
+                (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+                flaky, te=9.5, time_index=0, bar=_Bar(), retry=retry,
+                replenish_after=10,
+            )
+    assert s.rebuilds == []  # the pallas budget was never touched
+
+
+def test_transient_budget_zero_disables_retry():
+    """transient_budget=0 (the multi-process dist guard): the first
+    transient propagates — no rank-local re-dispatch."""
+    def flaky(t, n):
+        raise JaxRuntimeError("UNAVAILABLE: TPU device error")
+
+    with pytest.raises(JaxRuntimeError):
+        drive_chunks(
+            (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+            flaky, te=2.5, time_index=0, bar=_Bar(), retry=lambda: None,
+            transient_budget=0,
+        )
+
+
+def test_pallas_refailure_long_after_restore_not_dead():
+    """A pallas failure long after a restore (a full clean streak later)
+    is a fresh fault, not probation evidence: the fallback happens again
+    and a later restore is still allowed. Guards the drive-loop ordering
+    (the streak must be judged BEFORE any reset)."""
+    s = _FakeSolver()
+    retry = pallas_retry(s, "x", restore_after=2)
+    retry()                                    # fallback 1
+    for _ in range(2):
+        fn = retry.on_clean_chunk()
+    assert fn is not None                      # restored
+    for _ in range(5):
+        assert retry.on_clean_chunk() is None  # long clean streak on pallas
+    s._uses = True
+    assert retry() is not None                 # fails again — NOT dead
+    for _ in range(2):
+        fn = retry.on_clean_chunk()
+    assert fn is not None                      # restore still allowed
+    assert s.rebuilds == ["jnp", "auto", "jnp", "auto"]

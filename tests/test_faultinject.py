@@ -1,0 +1,413 @@
+"""Fault-injection harness (utils/faultinject.py) + the recovery layer it
+proves (ISSUE 4 acceptance):
+
+- IDENTITY WHEN UNSET: with PAMPI_FAULTS unset every hook is a no-op and
+  the solver chunk's jaxpr is byte-identical to the uninjected build;
+  host-side fault clauses never touch traces at all (same contract as
+  PAMPI_TELEMETRY).
+- RECOVERABLE CLASSES complete the run: a spaced transient matches the
+  uninjected run bitwise (same compiled chunk, same inputs); the pallas
+  failure falls back to jnp and asserts trajectory-level invariants; an
+  injected field corruption under an armed ring rolls back and re-drives
+  with a clamped dt to a finite final state.
+- TERMINAL CLASSES fail with a structured diagnostic naming the fault —
+  never a hang, never silent NaN fields without a record.
+
+Compile cost: every solver is 16², itermax <= 50, a few steps (the PR 3
+marker-audit lever); the recovery-exhaustion test pays 3 rebuilds by
+design (each rollback re-traces) and stays on the jnp chunk.
+"""
+
+import json
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.utils import faultinject as fi
+from pampi_tpu.utils import telemetry as tm
+from pampi_tpu.utils.params import Parameter
+
+_BASE = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.05, tau=0.5,
+             itermax=50, eps=1e-4, omg=1.7, gamma=0.9)
+
+
+# the `faults` arming fixture lives in tests/conftest.py (shared with
+# test_checkpoint.py)
+
+
+@pytest.fixture()
+def tel_on(tmp_path, monkeypatch):
+    path = tmp_path / "run.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(path))
+    tm.reset()
+    yield path
+    tm.reset()
+
+
+def _records(path):
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+def _kinds(path, kind):
+    return [r for r in _records(path) if r["kind"] == kind]
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+def test_spec_parse_and_errors(faults):
+    import math
+
+    faults("transient@chunk2, nan@step5:u*3 ,ckpt_torn@write1")
+    assert fi.enabled()
+    # one generation of field faults per take; *3 arms three builds
+    for _ in range(3):
+        taken = fi.take_field_faults()
+        assert len(taken) == 1
+        field, step, value = taken[0]
+        assert field == "u" and step == 5 and math.isnan(value)
+    assert fi.take_field_faults() == ()  # charges spent
+
+    for bad in ("nan@step5", "pallas@step3", "bogus@chunk1", "nan@step2:q",
+                "transient@chunk2:u"):
+        faults(bad)
+        with pytest.raises(fi.FaultSpecError, match="PAMPI_FAULTS"):
+            fi.take_field_faults()
+            fi.maybe_chunk_fault()
+
+
+def test_counters_reset(faults):
+    faults("transient@chunk1")
+    with pytest.raises(fi.JaxRuntimeError, match="UNAVAILABLE"):
+        fi.maybe_chunk_fault()
+    fi.maybe_chunk_fault()  # dispatch 2: clean
+    fi.reset()
+    with pytest.raises(fi.JaxRuntimeError):
+        fi.maybe_chunk_fault()  # counter rewound: dispatch 1 again
+
+
+# ---------------------------------------------------------------------------
+# identity when unset (the PAMPI_TELEMETRY contract, acceptance-pinned)
+# ---------------------------------------------------------------------------
+
+def test_unset_is_byte_identical(faults, monkeypatch):
+    """PAMPI_FAULTS unset -> the chunk is the uninjected program (two off
+    builds trace identically, 5 outvars, no `select` from a corruption
+    where); HOST-side clauses (chunk/write/emit sites) never touch traces;
+    only nan/inf clauses change the jaxpr — and only in the armed build."""
+    param = Parameter(**_BASE)
+    off1 = NS2DSolver(param)
+    jx_off1 = jax.make_jaxpr(off1._build_chunk())(*off1.initial_state())
+    off2 = NS2DSolver(param)
+    jx_off2 = jax.make_jaxpr(off2._build_chunk())(*off2.initial_state())
+    assert str(jx_off1) == str(jx_off2)
+    assert len(jx_off1.jaxpr.outvars) == 5
+
+    faults("transient@chunk99,pallas@chunk98,ckpt_torn@write9,telemetry@emit9")
+    host_only = NS2DSolver(param)
+    jx_host = jax.make_jaxpr(host_only._build_chunk())(*host_only.initial_state())
+    assert str(jx_host) == str(jx_off1)  # host faults are not in the trace
+
+    faults("nan@step3:u*9")
+    armed = NS2DSolver(param)
+    jx_armed = jax.make_jaxpr(armed._build_chunk())(*armed.initial_state())
+    assert str(jx_armed) != str(jx_off1)  # the corruption where() is baked
+
+
+# ---------------------------------------------------------------------------
+# transient device faults (budget + replenishment)
+# ---------------------------------------------------------------------------
+
+def test_transient_injection_recovers_bitwise(faults):
+    """A single spaced transient re-dispatches the same compiled chunk on
+    unchanged inputs — the final fields match the uninjected run bitwise
+    (the ulp-parity contract's strongest form: same arithmetic, same
+    program)."""
+    ref = NS2DSolver(Parameter(tpu_chunk=2, **_BASE))
+    ref.run(progress=False)
+
+    faults("transient@chunk2")
+    s = NS2DSolver(Parameter(tpu_chunk=2, **_BASE))
+    with pytest.warns(UserWarning, match="transient"):
+        s.run(progress=False)
+    assert s.nt == ref.nt
+    np.testing.assert_array_equal(np.asarray(s.u), np.asarray(ref.u))
+    np.testing.assert_array_equal(np.asarray(s.p), np.asarray(ref.p))
+
+
+def test_spaced_transients_replenish(faults, tel_on):
+    """Two transients spaced past the replenish window both retry (the
+    satellite fix: the budget used to be one per run), each consumption
+    leaving a structured `retry` record."""
+    faults("transient@chunk2,transient@chunk9")
+    s = NS2DSolver(Parameter(tpu_chunk=1, tpu_retry_replenish=3, **_BASE))
+    with pytest.warns(UserWarning, match="transient"):
+        s.run(progress=False)
+    assert s.t > _BASE["te"] and np.isfinite(np.asarray(s.u)).all()
+    retries = _kinds(tel_on, "retry")
+    assert len(retries) == 2
+    assert all(r["fault"] == "transient" for r in retries)
+
+
+def test_back_to_back_transients_terminal(faults):
+    """Transients inside one replenish window exhaust the budget: the run
+    fails with the injected diagnostic (naming the fault), never a hang."""
+    faults("transient@chunk2,transient@chunk3")
+    s = NS2DSolver(Parameter(tpu_chunk=1, tpu_retry_replenish=50, **_BASE))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(fi.JaxRuntimeError, match="UNAVAILABLE.*chunk dispatch 3"):
+            s.run(progress=False)
+
+
+# ---------------------------------------------------------------------------
+# pallas runtime failure -> jnp rebuild
+# ---------------------------------------------------------------------------
+
+def test_pallas_injection_falls_back_to_jnp(faults, tel_on):
+    """An injected pallas failure on a fused chunk rebuilds on the jnp path
+    and completes. Arithmetic changes (fused kernels stand down), so the
+    assertion is trajectory-level: finite fields, t past te, and the
+    structured `retry` record naming the fallback."""
+    faults("pallas@chunk2")
+    s = NS2DSolver(Parameter(tpu_fuse_phases="on", tpu_solver="fft",
+                             tpu_chunk=2, **_BASE))
+    assert s._fused and s._uses_pallas()
+    with pytest.warns(UserWarning, match="jnp path"):
+        s.run(progress=False)
+    assert s._backend == "jnp"
+    assert s.t > _BASE["te"]
+    assert np.isfinite(np.asarray(s.u)).all()
+    assert np.isfinite(np.asarray(s.p)).all()
+    falls = [r for r in _kinds(tel_on, "retry")
+             if r.get("action") == "jnp_fallback"]
+    assert len(falls) == 1 and falls[0]["fault"] == "pallas"
+
+
+def test_pallas_injection_without_alternative_is_terminal(faults):
+    """The same fault on a chunk that never ran pallas has no fallback:
+    the run fails with the injected diagnostic naming the fault."""
+    faults("pallas@chunk2")
+    s = NS2DSolver(Parameter(tpu_chunk=1, **_BASE))  # jnp-dispatched on CPU
+    assert not s._uses_pallas()
+    with pytest.raises(fi.InjectedPallasError, match="chunk dispatch 2"):
+        s.run(progress=False)
+
+
+# ---------------------------------------------------------------------------
+# field corruption -> sentinel -> rollback-recovery
+# ---------------------------------------------------------------------------
+
+def test_nan_injection_exercises_sentinel(faults, tel_on):
+    """Fixed-dt run, no ring: the injected NaN surfaces as the PR 3
+    structured divergence diagnostic (record + warning), end-to-end from
+    the in-band sentinel — not as silent garbage."""
+    faults("nan@step3:u")
+    s = NS2DSolver(Parameter(tpu_chunk=2,
+                             **{**_BASE, "tau": -1.0, "dt": 0.002}))
+    with pytest.warns(UserWarning, match="non-finite"):
+        s.run(progress=False)
+    div = _kinds(tel_on, "divergence")
+    assert len(div) == 1
+    # corruption lands at step start nt==3; the sentinel latches nt_after
+    assert div[0]["first_bad_step"] == 4
+    assert div[0]["last_good_step"] == 3
+
+
+def test_divergence_rollback_recovery(faults, tel_on):
+    """The tentpole end-to-end: injected corruption diverges the run, the
+    armed ring rolls back to the last clean captured state, the rebuilt
+    chunk (injection generation spent) re-drives with a clamped dt, and
+    the run COMPLETES with finite fields and a structured `recover`
+    record."""
+    faults("nan@step5:u")
+    s = NS2DSolver(Parameter(tpu_chunk=2, tpu_recover_ring=4, **_BASE))
+    with pytest.warns(UserWarning, match="rolled back"):
+        s.run(progress=False)
+    assert s.t > _BASE["te"]
+    assert np.isfinite(np.asarray(s.u)).all()
+    assert np.isfinite(np.asarray(s.p)).all()
+    assert s._dt_scale == 0.5  # one attempt, clamped once
+    recs = _kinds(tel_on, "recover")
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["attempt"] == 1 and r["source"] == "ring"
+    assert r["nt"] == 4  # rolled back to the chunk boundary before step 5
+    assert _kinds(tel_on, "divergence")  # the sentinel named the blow-up
+    # the rollback re-baselines the recorder: nt rewinds at the rollback
+    # point, but no chunk record may ever report negative steps/ms
+    chunks = _kinds(tel_on, "chunk")
+    assert chunks[-1]["nt"] == s.nt
+    assert all(c["steps"] >= 0 for c in chunks)
+    assert all(c["ms_per_step"] is None or c["ms_per_step"] >= 0
+               for c in chunks)
+
+
+def test_recovery_exhaustion_is_terminal(faults, tel_on):
+    """Persistent corruption (*99 re-arms every rebuild) defeats recovery:
+    max_attempts rollbacks, then a structured give-up — the run ends on
+    the diverged state (early, with the diagnostic), never hangs."""
+    faults("nan@step5:u*99")
+    s = NS2DSolver(Parameter(tpu_chunk=2, tpu_recover_ring=4,
+                             tpu_recover_max=2, **_BASE))
+    with pytest.warns(UserWarning, match="gave up"):
+        s.run(progress=False)
+    assert not np.isfinite(np.asarray(s.u)).all()  # diverged state returned
+    recs = _kinds(tel_on, "recover")
+    assert [r["attempt"] for r in recs] == [1, 2, 3]
+    assert recs[-1]["gave_up"] and recs[-1]["reason"] == "max_attempts"
+    assert len(_kinds(tel_on, "divergence")) == 3  # rearm() per rollback
+
+
+def test_dist_transient_recovers(faults):
+    """The dist families now ride the same drive loop (PR 4 migration):
+    an injected transient retries instead of killing the run."""
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    ref = NS2DDistSolver(Parameter(**_BASE), CartComm(ndims=2, dims=(2, 2)))
+    ref.run(progress=False)
+    faults("transient@chunk1")
+    s = NS2DDistSolver(Parameter(**_BASE), CartComm(ndims=2, dims=(2, 2)))
+    with pytest.warns(UserWarning, match="transient"):
+        s.run(progress=False)
+    assert s.nt == ref.nt
+    for a, b in zip(s.fields(), ref.fields()):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# telemetry-write failure
+# ---------------------------------------------------------------------------
+
+def test_telemetry_write_failure_stands_down(faults, tel_on):
+    """An injected telemetry write failure costs the flight record, never
+    the run: one warning, the file keeps the records before the fault."""
+    faults("telemetry@emit3")
+    with pytest.warns(UserWarning, match="telemetry disabled"):
+        s = NS2DSolver(Parameter(tpu_chunk=2, **_BASE))
+        s.run(progress=False)
+    assert s.t > _BASE["te"] and np.isfinite(np.asarray(s.u)).all()
+    assert len(_records(tel_on)) == 2  # records 1-2 landed, 3 tore it down
+
+
+# ---------------------------------------------------------------------------
+# report + artifact-lint round-trip of the resilience kinds (satellite)
+# ---------------------------------------------------------------------------
+
+def test_resilience_records_render_and_lint(tel_on):
+    """recover/retry/ckpt records flow through tools/telemetry_report.py
+    (render + summary) and the summary block passes — and is actually
+    checked by — tools/check_artifact.py."""
+    tm.emit("retry", fault="transient", budget_left=0, t=1.25)
+    tm.emit("retry", fault="pallas", action="jnp_fallback", what="solve")
+    tm.emit("recover", family="ns2d", attempt=1, source="ring", t=0.5,
+            nt=8, dt_scale=0.5)
+    tm.emit("ckpt", event="save", path="ck.npz", t=0.5, nt=8, rotated=True)
+    tm.emit("ckpt", event="rotate", path="ck.npz")
+    tm.emit("ckpt", event="reject", path="ck.npz", error="CRC32")
+    tm.emit("ckpt", event="load", path="ck.npz.prev", generation="prev",
+            t=0.25, nt=4)
+
+    from tools import check_artifact as ca
+    from tools import telemetry_report as tr
+
+    recs = tr.load(str(tel_on))
+    text = tr.render(recs)
+    for needle in ("recovery (divergence rollback)", "rolled back to",
+                   "retries (budget consumptions)", "jnp_fallback",
+                   "checkpoints", "reject"):
+        assert needle in text, needle
+    summ = tr.summary(recs)
+    assert len(summ["recoveries"]) == 1 and summ["recoveries"][0]["nt"] == 8
+    assert [r["fault"] for r in summ["retries"]] == ["transient", "pallas"]
+    assert summ["ckpt"] == {"save": 1, "rotate": 1, "load": 1, "reject": 1,
+                            "skip": 0}
+    where = "BENCH.telemetry_summary"
+    assert ca.lint_telemetry_summary(summ, where) == []
+    # gutted blocks are FLAGGED, not waved through
+    assert ca.lint_telemetry_summary({**summ, "retries": "zap"}, where)
+    assert ca.lint_telemetry_summary({**summ, "recoveries": [{}]}, where)
+    assert ca.lint_telemetry_summary({**summ, "ckpt": {"save": 1}}, where)
+
+
+# ---------------------------------------------------------------------------
+# review regressions: fault classification + generation accounting
+# ---------------------------------------------------------------------------
+
+def test_transient_while_pallas_active_stays_transient(faults):
+    """A transient UNAVAILABLE while the fused/pallas chunk is active takes
+    the same-chunk retry, NOT the pallas->jnp fallback — misclassifying a
+    device hiccup as a kernel fault would (after a restore) trip the
+    deterministically-broken latch and pay jnp speed for the whole run."""
+    faults("transient@chunk2")
+    s = NS2DSolver(Parameter(tpu_fuse_phases="on", tpu_solver="fft",
+                             tpu_chunk=2, **_BASE))
+    assert s._uses_pallas()
+    with pytest.warns(UserWarning, match="transient"):
+        s.run(progress=False)
+    assert s._backend != "jnp" and s._fused  # never fell back
+    assert s.t > _BASE["te"]
+
+
+def test_pallas_fallback_keeps_armed_corruption(faults, tel_on):
+    """A combined pallas+nan spec must not lose the corruption to the jnp
+    fallback rebuild: the generation is taken per solver (__init__ /
+    recovery rebuild), so the rebuilt chunk still carries the armed nan
+    and the sentinel fires — never a silently-uninjected run."""
+    faults("pallas@chunk1,nan@step3:u")
+    s = NS2DSolver(Parameter(tpu_fuse_phases="on", tpu_solver="fft",
+                             tpu_chunk=2,
+                             **{**_BASE, "tau": -1.0, "dt": 0.002}))
+    with pytest.warns(UserWarning, match="jnp path"):
+        s.run(progress=False)
+    # the fallback fired (and the restore may later bring pallas back —
+    # that is the replenishing budget working, not a failure)
+    assert any(r.get("action") == "jnp_fallback"
+               for r in _kinds(tel_on, "retry"))
+    div = _kinds(tel_on, "divergence")
+    assert len(div) == 1 and div[0]["first_bad_step"] == 4
+
+
+def test_bad_spec_fails_loudly_at_build(faults):
+    """An unparseable spec surfaces as FaultSpecError at the FIRST hook —
+    solver construction (the generation take) — never a silently
+    uninjected run (the module's fail-loudly contract end-to-end)."""
+    faults("nan@step5")  # missing the :field
+    with pytest.raises(fi.FaultSpecError, match="PAMPI_FAULTS"):
+        NS2DSolver(Parameter(tpu_chunk=2, **_BASE))
+
+
+def test_bad_spec_not_classified_as_kernel_fault(faults):
+    """If the spec error first surfaces inside the drive loop (env armed
+    after build), it must re-raise directly — never routed into the
+    retry/pallas classification as if a kernel had failed."""
+    from pampi_tpu.models._driver import drive_chunks
+
+    faults("nan@step5")
+    called = []
+
+    class _Bar:
+        def update(self, t):
+            pass
+
+        def stop(self):
+            pass
+
+    def retry():
+        called.append(1)
+        return None
+
+    import jax.numpy as jnp
+
+    with pytest.raises(fi.FaultSpecError, match="PAMPI_FAULTS"):
+        drive_chunks(
+            (jnp.asarray(0.0), jnp.asarray(0, jnp.int32)),
+            lambda t, n: (t + 1.0, n + 1), te=2.5, time_index=0,
+            bar=_Bar(), retry=retry,
+        )
+    assert not called  # the retry hook never consulted

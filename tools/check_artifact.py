@@ -18,7 +18,9 @@ Contracts:
 - MULTICHIP: {n_devices, rc, ok, skipped, tail} required.
 - telemetry_summary (optional until a run emits one): the
   tools/telemetry_report.summary shape — {schema_version, dispatch,
-  chunks, records}.
+  chunks, records}; when the PR 4 resilience blocks are present,
+  `recoveries`/`retries` must be lists of records and `ckpt` a
+  save/rotate/load/reject count map.
 """
 
 from __future__ import annotations
@@ -43,6 +45,9 @@ def _missing(d: dict, keys, where: str) -> list[str]:
     return [f"{where}: missing key {key!r}" for key in keys if key not in d]
 
 
+CKPT_EVENTS = ("save", "rotate", "load", "reject", "skip")
+
+
 def lint_telemetry_summary(d: dict, where: str) -> list[str]:
     errs = _missing(d, SUMMARY_REQUIRED, where)
     chunks = d.get("chunks")
@@ -50,6 +55,20 @@ def lint_telemetry_summary(d: dict, where: str) -> list[str]:
         errs += _missing(chunks, ("count", "steps"), f"{where}.chunks")
     elif "chunks" in d:
         errs.append(f"{where}.chunks: not a dict")
+    # the PR 4 resilience blocks (optional; null when the run had none)
+    for key, need in (("recoveries", "attempt"), ("retries", "fault")):
+        block = d.get(key)
+        if block is None:
+            continue
+        if not isinstance(block, list):
+            errs.append(f"{where}.{key}: not a list")
+        elif not all(isinstance(r, dict) and need in r for r in block):
+            errs.append(f"{where}.{key}: record missing {need!r}")
+    if d.get("ckpt") is not None:
+        if not isinstance(d["ckpt"], dict):
+            errs.append(f"{where}.ckpt: not a dict")
+        else:
+            errs += _missing(d["ckpt"], CKPT_EVENTS, f"{where}.ckpt")
     return errs
 
 

@@ -4,9 +4,11 @@
 
 Renders the flight record (utils/telemetry.py schema): run metadata,
 dispatch decisions, build/trace walls, per-chunk solver health
-(residual/iterations/dt/velocity maxima, ms/step), divergence diagnostics,
-the shared decomposition spans, static halo-exchange byte counts, driver
-solve records, and the profiling region table. `--merge <path>` folds the
+(residual/iterations/dt/velocity maxima, ms/step), divergence diagnostics
+plus the PR 4 resilience records (rollback-recovery attempts, retry-budget
+consumptions, checkpoint save/rotate/load/reject events), the shared
+decomposition spans, static halo-exchange byte counts, driver solve
+records, and the profiling region table. `--merge <path>` folds the
 machine-readable summary block into a BENCH_rXX/MULTICHIP_rXX artifact
 under the `telemetry_summary` key via tools/_artifact.write_merged (the
 merge-preserving convention), so on-chip sessions commit one artifact that
@@ -97,6 +99,20 @@ def summary(records: list[dict]) -> dict:
             },
         },
         "divergence": k.get("divergence", []) or None,
+        "recoveries": [
+            {key: val for key, val in r.items()
+             if key not in ("v", "kind", "ts")}
+            for r in k.get("recover", [])
+        ] or None,
+        "retries": [
+            {key: val for key, val in r.items()
+             if key not in ("v", "kind", "ts")}
+            for r in k.get("retry", [])
+        ] or None,
+        "ckpt": {
+            ev: sum(1 for c in k.get("ckpt", []) if c.get("event") == ev)
+            for ev in ("save", "rotate", "load", "reject", "skip")
+        } if k.get("ckpt") else None,
         "spans": spans or None,
         "solves": {
             "count": len(k.get("solve", [])),
@@ -168,6 +184,33 @@ def render(records: list[dict]) -> str:
             f"{d.get('last_good_step')})"
             if "first_bad_step" in d else
             f"  {d.get('family')}: non-finite residual {d.get('res')}")
+
+    if k.get("recover"):
+        add("== recovery (divergence rollback) ==")
+        for r in k["recover"]:
+            if r.get("gave_up"):
+                add(f"  attempt {r.get('attempt')}: GAVE UP "
+                    f"({r.get('reason')})")
+            else:
+                add(f"  attempt {r.get('attempt')}: rolled back to "
+                    f"t={_num(r.get('t')):.6g} (step {r.get('nt')}, "
+                    f"{r.get('source')}) dt_scale={r.get('dt_scale')}")
+
+    if k.get("retry"):
+        add("== retries (budget consumptions) ==")
+        for r in k["retry"]:
+            extra = (f" action={r['action']}" if "action" in r else
+                     f" budget_left={r.get('budget_left')}")
+            add(f"  {r.get('fault'):<10}{extra}")
+
+    if k.get("ckpt"):
+        add("== checkpoints ==")
+        for c in k["ckpt"]:
+            where = (f" t={_num(c.get('t')):.6g} nt={c.get('nt')}"
+                     if "nt" in c else "")
+            add(f"  {c.get('event'):<8} {c.get('path')}{where}"
+                + (f"  [{c.get('generation')}]" if "generation" in c else "")
+                + (f"  error={c.get('error')}" if "error" in c else ""))
 
     if k.get("solve"):
         add("== driver solves ==")
