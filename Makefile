@@ -67,6 +67,17 @@ telemetry-report:
 check-artifacts:
 	python tools/check_artifact.py
 
+# tracecheck: the static contract checker (pampi_tpu/analysis/) — AST
+# lint rules over pampi_tpu/ tools/ tests/, stencil halo footprints vs
+# declared depths, the dispatch-matrix jaxpr contracts vs CONTRACTS.json,
+# and the committed-artifact schema lint. Regenerate the baseline after
+# an INTENDED trace change with `make lint-update`.
+lint:
+	python tools/lint.py
+
+lint-update:
+	python tools/lint.py --update
+
 # Standalone run of the fault-injection / recovery suite (PAMPI_FAULTS
 # plane, retry budgets, rollback-recovery, checkpoint durability edges).
 # The same tests ride tier-1 at 16-squared size; this target is the quick
@@ -81,5 +92,5 @@ clean:
 distclean:
 	rm -rf build exe-*
 
-.PHONY: all test asm format telemetry-report check-artifacts fault-suite \
-	clean distclean
+.PHONY: all test asm format telemetry-report check-artifacts lint \
+	lint-update fault-suite clean distclean

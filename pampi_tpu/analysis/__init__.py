@@ -1,0 +1,36 @@
+"""Tracecheck: static contract checking for the pampi-tpu tree.
+
+The codebase rests on implicit contracts that no single runtime test can
+guard globally — fused chunks lower to a pinned number of Pallas launches,
+flag-off builds trace byte-identical programs, deep-halo kernels never
+read past their declared halo, env vars are read only through the
+`utils/flags.py` accessor, `shard_map` only through
+`parallel/comm.compat_shard_map`. This package checks them statically
+(trace + analyze, no device execution), the same role compile-time
+footprint/race analysis plays for MPI stencil codes:
+
+  jaxprcheck  trace every solver family's chunk under the dispatch matrix
+              and assert launch counts, host-callback absence, dtype
+              discipline, metrics arity, and jaxpr-hash identity against
+              the committed CONTRACTS.json baseline
+  halocheck   derive each stencil kernel's static access footprint (the
+              dependency cone of owned outputs on the exchanged block)
+              and compare against the declared halo depths
+  astlint     repo-specific AST rules with file:line diagnostics and
+              inline `# lint: allow(<rule>)` escapes
+
+Driver: `tools/lint.py` (all three passes; `--update` regenerates the
+CONTRACTS.json baseline). Tier-1 coverage: tests/test_analysis.py.
+"""
+
+import importlib
+
+__all__ = ["astlint", "halocheck", "jaxprcheck"]
+
+
+def __getattr__(name):
+    # lazy: astlint is pure stdlib and must stay importable (and fast)
+    # without pulling jax in through the trace-analysis siblings
+    if name in __all__:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,431 @@
+"""Halo footprint analyzer: does any stencil kernel read past its halo?
+
+The distributed-correctness bug class this guards (the stencil-code
+analog of a race): a shard's kernel computes its OWNED cells from the
+extended block one depth-H exchange filled, so every input cell in the
+dependency cone of an owned output must lie within H layers of the owned
+region — a read one layer deeper consumes a stale/unexchanged value and
+the distributed trajectory silently diverges from the sequential one.
+Past contracts of exactly this shape: `stencil2d.ca_halo(n) = 2n` (+1 on
+ragged layouts — the dead-shard wall-ghost refresh), and the fused PRE
+kernels' 3-layer validity chain (`ops/ns2d_fused.FUSE_CHAIN`).
+
+Method — the static access footprint, derived from the program itself:
+each checked kernel is a pure jnp function (the CA iteration bodies are
+the importable production functions; the Pallas PRE/POST chains are
+composed here from the SAME window formulas the kernels store —
+`apply_wall_bcs_2d`, `fg_predictor_terms`, ... — in the kernels' own
+order). We linearize it once at random inputs (one `jax.grad` of a
+random projection of the owned outputs) and read the dependency cone off
+the gradient's nonzero pattern: grad[cell] != 0  ⟺  that input cell
+influences some owned output. Masked branches (`jnp.where` wall gates,
+flag multiplies) are handled exactly — a masked-off read is NOT a
+dependency — which pure index-offset interval analysis cannot do (a
+`where(wall, roll(p), p)` would blow its bounding box to the whole
+array). With float64 random inputs an existing dependency cancelling to
+an exact numerical zero has probability ~0; the mutation tests (a seeded
+under-halo declaration, an over-wide stencil) pin that the detector
+actually fires.
+
+The registry (`standard_entries()`) carries, per kernel: the function,
+the owned-region box, and the DECLARED halo (read from the same source
+the production dispatch uses — `ca_halo`, `FUSE_CHAIN`). `check_all()`
+re-measures and reports `footprint > declared` as an error with a
+file:line anchor at the kernel's source.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass
+
+from .astlint import Violation
+
+RULE = "halo-footprint"
+
+
+def _anchor(obj) -> tuple[str, int]:
+    """file:line of a function/module object for diagnostics."""
+    try:
+        path = inspect.getsourcefile(obj) or "<unknown>"
+        line = inspect.getsourcelines(obj)[1]
+        return path, line
+    except (OSError, TypeError):
+        return getattr(obj, "__file__", "<unknown>"), 1
+
+@dataclass
+class HaloEntry:
+    """One checked kernel: `fn(*arrays)` -> array or tuple of arrays, all
+    inputs/outputs in ONE index frame; `owned` is the box (tuple of
+    slices) of cells the shard owns in that frame; `declared` the halo
+    depth the production dispatch exchanges for it; `anchor` the source
+    location blamed on violation."""
+
+    name: str
+    fn: object
+    in_shapes: tuple
+    owned: tuple
+    declared: int
+    anchor: tuple = ("<unknown>", 1)
+    # indices of inputs whose footprint participates in the check (e.g.
+    # scalar dt operands are excluded); default: every array input
+    checked_inputs: tuple = ()
+    note: str = ""
+
+
+def _beyond_owned_depth(nonzero, owned) -> int:
+    """Max per-axis distance of a True cell beyond the owned box (0 when
+    every dependency is owned)."""
+    import numpy as np
+
+    idx = np.argwhere(nonzero)
+    if idx.size == 0:
+        return 0
+    depth = 0
+    for ax, sl in enumerate(owned):
+        lo, hi, _ = sl.indices(nonzero.shape[ax])
+        below = lo - idx[:, ax]
+        above = idx[:, ax] - (hi - 1)
+        depth = max(depth, int(np.maximum(below, above).clip(min=0).max()))
+    return depth
+
+
+def measure(entry: HaloEntry, seed: int = 0) -> dict[int, int]:
+    """The access footprint: per checked input, the max depth (in cells)
+    beyond the owned box that influences any owned output. One
+    linearization — see the module docstring."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    # float64 when x64 is on (the tools/lint.py and test harness default);
+    # f32 otherwise — either way an existing dependency cancelling to an
+    # exact zero under random N(0,1) inputs has probability ~0
+    xs = [jnp.asarray(rng.standard_normal(s)) for s in entry.in_shapes]
+    checked = entry.checked_inputs or tuple(range(len(xs)))
+
+    # one scalar projection of the owned outputs with random weights: its
+    # gradient's nonzero pattern is the union dependency cone
+    weights = None
+
+    def projected(*inp):
+        nonlocal weights
+        out = entry.fn(*inp)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        outs = [o for o in outs if getattr(o, "ndim", 0) == len(entry.owned)]
+        if weights is None:
+            weights = [
+                jnp.asarray(rng.standard_normal(o[tuple(entry.owned)].shape))
+                for o in outs
+            ]
+        acc = 0.0
+        for o, r in zip(outs, weights):
+            acc = acc + jnp.vdot(o[tuple(entry.owned)], r.astype(o.dtype))
+        return acc
+
+    grads = jax.grad(projected, argnums=checked)(*xs)
+    out = {}
+    for i, g in zip(checked, grads):
+        out[i] = _beyond_owned_depth(np.asarray(g) != 0.0, entry.owned)
+    return out
+
+
+def check_entry(entry: HaloEntry, seed: int = 0) -> list[Violation]:
+    """footprint > declared  ->  one violation per offending input."""
+    vs = []
+    path, line = entry.anchor
+    for i, depth in measure(entry, seed=seed).items():
+        if depth > entry.declared:
+            vs.append(Violation(
+                path, line, RULE,
+                f"{entry.name}: input #{i} read footprint reaches "
+                f"{depth} cells beyond the owned region but the declared "
+                f"halo is {entry.declared} — an under-halo read consumes "
+                f"stale/unexchanged data on distributed shards"
+                + (f" ({entry.note})" if entry.note else ""),
+            ))
+    return vs
+
+
+# ---------------------------------------------------------------------------
+# the production registry
+# ---------------------------------------------------------------------------
+
+def _ca2d_entry(n: int, ragged: bool = False) -> HaloEntry:
+    """stencil2d.ca_rb_iters at CA depth n: the depth-ca_halo(n) exchange
+    must cover n fused red-black iterations. `ragged=True` builds the
+    dead-trailing-shard geometry whose wall-ghost refresh consumes the one
+    extra layer ca_halo ships there."""
+    from ..parallel import stencil2d as s2
+
+    jl = il = 6
+    jmax = imax = 30
+    H = s2.ca_halo(n, ragged=ragged)
+    if ragged:
+        # the shard whose FIRST owned row is the wall-ghost row
+        # gj == jmax+1 (every later row dead): its Neumann refresh after
+        # 2n half-sweeps reads the innermost halo cell (ca_halo docstring)
+        joff, ioff = jmax, 8
+    else:
+        joff, ioff = 8, 8
+    masks = s2.ca_masks(jl, il, H, jmax, imax, float, joff=joff, ioff=ioff)
+    shape = (jl + 2 * H, il + 2 * H)
+
+    def fn(p, rhs):
+        return s2.ca_rb_iters(p, rhs, n, masks, 0.45, 1.0, 1.3)[0]
+
+    owned = (slice(H, H + jl), slice(H, H + il))
+    return HaloEntry(
+        name=f"stencil2d.ca_rb_iters[n={n}{', ragged' if ragged else ''}]",
+        fn=fn,
+        in_shapes=(shape, shape),
+        owned=owned,
+        declared=H,
+        anchor=_anchor(s2.ca_rb_iters),
+        note=f"declared = ca_halo({n}, ragged={ragged}) = {H}",
+    )
+
+
+def _ca3d_entry(n: int) -> HaloEntry:
+    from ..parallel import stencil2d as s2
+    from ..parallel import stencil3d as s3
+
+    kl = jl = il = 4
+    gmax = 20
+    H = s2.ca_halo(n)
+    masks = s3.ca_masks_3d(kl, jl, il, H, gmax, gmax, gmax, float,
+                           koff=6, joff=6, ioff=6)
+    shape = (kl + 2 * H, jl + 2 * H, il + 2 * H)
+
+    def fn(p, rhs):
+        return s3.ca_rb_iters_3d(p, rhs, n, masks, 0.45, 1.0, 1.3, 0.8)[0]
+
+    owned = (slice(H, H + kl), slice(H, H + jl), slice(H, H + il))
+    return HaloEntry(
+        name=f"stencil3d.ca_rb_iters_3d[n={n}]",
+        fn=fn,
+        in_shapes=(shape, shape),
+        owned=owned,
+        declared=H,
+        anchor=_anchor(s3.ca_rb_iters_3d),
+        note=f"declared = ca_halo({n}) = {H}",
+    )
+
+
+def _pre2d_entry(shard: str, obstacles: bool = False) -> HaloEntry:
+    """The fused 2-D PRE chain (deep-halo kernel): the same window
+    formulas _pre_kernel stores, in its order — wall BCs, special BC,
+    obstacle velocity BC, F/G predictor, wall fixups, obstacle F/G mask,
+    RHS with the local-interior clip. The dependency cone of the outputs
+    restricted to the shard's OWNED interior must stay within FUSE_CHAIN
+    layers — the per-step validity budget the deep exchange covers."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import ns2d as ops
+    from ..ops import ns2d_fused as nf
+
+    jl = il = 6
+    gjmax = gimax = 24
+    ext_pad = nf.FUSE_DEEP_HALO - 1
+    rows = jl + 2 + 2 * ext_pad
+    cols = il + 2 + 2 * ext_pad
+    offsets = {
+        "interior": (8, 8),
+        "corner_lo": (0, 0),
+        "wall_hi": (gjmax - jl, 8),
+    }
+    joff, ioff = offsets[shard]
+    a_j = jnp.arange(rows, dtype=jnp.int32)[:, None] * jnp.ones(
+        (1, cols), jnp.int32)
+    a_i = jnp.arange(cols, dtype=jnp.int32)[None, :] * jnp.ones(
+        (rows, 1), jnp.int32)
+    gj = a_j - ext_pad + joff
+    gi = a_i - ext_pad + ioff
+    bc = (nf.NOSLIP, nf.NOSLIP, nf.NOSLIP, nf.NOSLIP)
+    dt, re, gamma = 0.01, 10.0, 0.9
+    dx, dy = 1.0 / gimax, 1.0 / gjmax
+    interior = (gj >= 1) & (gj <= gjmax) & (gi >= 1) & (gi <= gimax)
+    rows_m = (gj >= 1) & (gj <= gjmax)
+    cols_m = (gi >= 1) & (gi <= gimax)
+    local_int = (
+        (a_j >= ext_pad + 1) & (a_j <= ext_pad + jl)
+        & (a_i >= ext_pad + 1) & (a_i <= ext_pad + il)
+    )
+    fl = None
+    if obstacles:
+        # a deterministic obstacle block straddling the owned low edge so
+        # every term of the obstacle BC's mirror stencil is live. The
+        # measured footprint comes out at 2 (< FUSE_CHAIN = 3): the chain
+        # budget charges each stage ≤1 conservatively, but RHS reads F/G
+        # only same-row/low-side and G reads u only northward, so no
+        # composed path actually consumes all three layers — the declared
+        # halo has one layer of genuine slack, which this entry records
+        # (and which a widened stencil would eat before ever corrupting a
+        # distributed run).
+        flag = np.ones((rows, cols))
+        pj, pi = ext_pad - 1, ext_pad + 3
+        flag[pj:pj + 3, pi:pi + 2] = 0.0
+        fl = jnp.asarray(flag)
+
+    def fn(u, v):
+        u, v = nf.apply_wall_bcs_2d(u, v, gj, gi, bc, gjmax, gimax)
+        u = nf.apply_special_bc_2d(u, gj, gi, "dcavity", gjmax, gimax,
+                                   dy, 1.0, u.dtype, u.dtype)
+        if obstacles:
+            u_face, v_face = nf._obstacle_faces(fl, gj, gi, gjmax, gimax)
+            u, v = nf.apply_obstacle_velocity_bc_window(
+                u, v, fl, u_face, v_face)
+        f_full, g_full = ops.fg_predictor_terms(
+            u, v, dt, re, 0.0, 0.0, gamma, dx, dy)
+        f = jnp.where(interior, f_full, 0.0)
+        g = jnp.where(interior, g_full, 0.0)
+        f = jnp.where((gi == 0) & rows_m, u, f)
+        f = jnp.where((gi == gimax) & rows_m, u, f)
+        g = jnp.where((gj == 0) & cols_m, v, g)
+        g = jnp.where((gj == gimax) & cols_m, v, g)
+        if obstacles:
+            one = jnp.ones((), u.dtype)
+            f = u_face * f + (one - u_face) * u
+            g = v_face * g + (one - v_face) * v
+        rhs = jnp.where(
+            interior & local_int, ops.rhs_terms(f, g, dt, dx, dy), 0.0)
+        return u, v, f, g, rhs
+
+    owned = (slice(ext_pad + 1, ext_pad + 1 + jl),
+             slice(ext_pad + 1, ext_pad + 1 + il))
+    return HaloEntry(
+        name=("ns2d_fused.PRE"
+              f"[{shard}{', obstacles' if obstacles else ''}]"),
+        fn=fn,
+        in_shapes=((rows, cols), (rows, cols)),
+        owned=owned,
+        declared=nf.FUSE_CHAIN,
+        anchor=_anchor(nf.make_fused_pre_2d),
+        note="declared = FUSE_CHAIN (deep exchange ships FUSE_DEEP_HALO)",
+    )
+
+
+def _post2d_entry() -> HaloEntry:
+    """The fused 2-D POST chain: adaptUV's p reads must stay inside the
+    exchanged halo-1 ring of the plain extended block."""
+    from ..ops import ns2d as ops
+    from ..ops import ns2d_fused as nf
+
+    jl = il = 8
+    shape = (jl + 2, il + 2)
+
+    def fn(f, g, p):
+        return ops.adapt_terms(f, g, p, 0.01, 1.0 / il, 1.0 / jl)
+
+    owned = (slice(1, 1 + jl), slice(1, 1 + il))
+    return HaloEntry(
+        name="ns2d_fused.POST[adapt_terms]",
+        fn=fn,
+        in_shapes=(shape, shape, shape),
+        owned=owned,
+        declared=1,
+        anchor=_anchor(nf.make_fused_post_2d),
+        note="declared = 1 (plain extended block, halo-1 exchange)",
+    )
+
+
+def _pre3d_entry() -> HaloEntry:
+    """The fused 3-D PRE chain (same structure as _pre2d_entry, on a
+    dcavity3d lid shard) against the shared FUSE_CHAIN declaration."""
+    import jax.numpy as jnp
+
+    from ..ops import ns3d as ops3
+    from ..ops import ns3d_fused as nf3
+    from ..ops.ns3d import FACES
+
+    kl = jl = il = 4
+    gmax = 12
+    ext_pad = nf3.FUSE_DEEP_HALO - 1
+    ext = (kl + 2 + 2 * ext_pad, jl + 2 + 2 * ext_pad,
+           il + 2 + 2 * ext_pad)
+    koff, joff, ioff = 4, gmax - jl, 4  # lid (j-hi) shard
+    a_k = jnp.arange(ext[0], dtype=jnp.int32)[:, None, None] + jnp.zeros(
+        ext, jnp.int32)
+    a_j = jnp.arange(ext[1], dtype=jnp.int32)[None, :, None] + jnp.zeros(
+        ext, jnp.int32)
+    a_i = jnp.arange(ext[2], dtype=jnp.int32)[None, None, :] + jnp.zeros(
+        ext, jnp.int32)
+    gk = a_k - ext_pad + koff
+    gj = a_j - ext_pad + joff
+    gi = a_i - ext_pad + ioff
+    bcs = {face: nf3.NOSLIP for face in FACES}
+    dt, re, gamma = 0.01, 10.0, 0.9
+    dx = dy = dz = 1.0 / gmax
+    interior = (
+        (gk >= 1) & (gk <= gmax) & (gj >= 1) & (gj <= gmax)
+        & (gi >= 1) & (gi <= gmax)
+    )
+    tan_k = (gk >= 1) & (gk <= gmax)
+    tan_j = (gj >= 1) & (gj <= gmax)
+    tan_i = (gi >= 1) & (gi <= gmax)
+    local_int = (
+        (a_k >= ext_pad + 1) & (a_k <= ext_pad + kl)
+        & (a_j >= ext_pad + 1) & (a_j <= ext_pad + jl)
+        & (a_i >= ext_pad + 1) & (a_i <= ext_pad + il)
+    )
+
+    def fn(u, v, w):
+        u, v, w = nf3.apply_wall_bcs_3d(
+            u, v, w, gk, gj, gi, dict(bcs), gmax, gmax, gmax)
+        u = nf3.apply_special_bc_3d(u, gk, gj, gi, "dcavity",
+                                    gmax, gmax, gmax)
+        f_full, g_full, h_full = ops3.fgh_predictor_terms(
+            u, v, w, dt, re, 0.0, 0.0, 0.0, gamma, dx, dy, dz,
+            sh=nf3._win_shift)
+        f = jnp.where(interior, f_full, 0.0)
+        g = jnp.where(interior, g_full, 0.0)
+        hh = jnp.where(interior, h_full, 0.0)
+        f = jnp.where(((gi == 0) | (gi == gmax)) & tan_k & tan_j, u, f)
+        g = jnp.where(((gj == 0) | (gj == gmax)) & tan_k & tan_i, v, g)
+        hh = jnp.where(((gk == 0) | (gk == gmax)) & tan_j & tan_i, w, hh)
+        rhs = jnp.where(
+            interior & local_int,
+            ops3.rhs_terms_3d(f, g, hh, dt, dx, dy, dz, sh=nf3._win_shift),
+            0.0,
+        )
+        return u, v, w, f, g, hh, rhs
+
+    owned = (slice(ext_pad + 1, ext_pad + 1 + kl),
+             slice(ext_pad + 1, ext_pad + 1 + jl),
+             slice(ext_pad + 1, ext_pad + 1 + il))
+    return HaloEntry(
+        name="ns3d_fused.PRE[lid shard]",
+        fn=fn,
+        in_shapes=(ext, ext, ext),
+        owned=owned,
+        declared=nf3.FUSE_CHAIN,
+        anchor=_anchor(nf3.make_fused_pre_3d),
+        note="declared = FUSE_CHAIN (deep exchange ships FUSE_DEEP_HALO)",
+    )
+
+
+def standard_entries() -> list:
+    """The production registry: every deep-halo contract the dispatch
+    layer relies on. Kept cheap (tiny blocks, one linearization each) so
+    tier-1 and `make lint` both run it."""
+    return [
+        _ca2d_entry(1),
+        _ca2d_entry(2),
+        _ca2d_entry(1, ragged=True),
+        _ca3d_entry(1),
+        _pre2d_entry("interior"),
+        _pre2d_entry("corner_lo"),
+        _pre2d_entry("wall_hi"),
+        _pre2d_entry("interior", obstacles=True),
+        _post2d_entry(),
+        _pre3d_entry(),
+    ]
+
+
+def check_all(entries=None, seed: int = 0) -> list[Violation]:
+    vs: list[Violation] = []
+    for entry in (standard_entries() if entries is None else entries):
+        vs += check_entry(entry, seed=seed)
+    return vs

@@ -1,0 +1,507 @@
+"""Jaxpr contract checker: trace every solver family's chunk under the
+dispatch matrix and statically assert the program-shape contracts.
+
+What one trace proves (no device execution — `jax.make_jaxpr` only):
+
+  launch counts   the chunk lowers to EXACTLY the number of `pallas_call`s
+                  the `resolve_fuse_phases` / p-fold dispatch decision
+                  implies (fused = 2, + 1 when the solve is folded onto
+                  the shared padded layout, 0 on the jnp chain; fft
+                  contributes none) — the launch-amortization property
+                  the fused kernels exist for.
+  host callbacks  no `*_callback` primitive unless a PAMPI_DEBUG /
+                  PAMPI_VERBOSE / PAMPI_CHECK flag was armed at trace
+                  time — a stray `jax.debug.print` in a hot loop costs a
+                  host sync per step.
+  dtype policy    every float intermediate is the compute dtype, the
+                  time-accumulator dtype, or f32 (the in-band metrics
+                  precision) — a silent promotion off the `precision.py`
+                  contract doubles memory traffic before any test sees a
+                  numeric difference.
+  metrics arity   `initial_state()` arity == chunk invars/outvars, with
+                  telemetry off AND on (the PR 3 contract every
+                  measurement tool leans on).
+  trace identity  the flag-off jaxpr hash matches the committed
+                  `CONTRACTS.json` baseline (regenerate with
+                  `tools/lint.py --update`); drift fails with a primitive
+                  -histogram diff of the offending eqns. Hashes are
+                  compared only when the baseline's environment (jax
+                  version, x64, backend) matches — a toolchain bump
+                  regenerates, it does not silently pass.
+
+The config matrix spans the dispatch dimensions: jnp/fused ×
+single-device/distributed × plain/obstacle/ragged × explicit/folded p
+layout. Knobs are FORCED (never `auto`) so the expected launch counts are
+platform-independent wherever the kernel family is (fft solves carry no
+kernel; forced fusion and the forced checkerboard fold build the same
+program on CPU and TPU); paths whose solve dispatch is genuinely
+platform-dependent pin their count through the env-keyed baseline
+instead.
+
+Shared helpers (`count_prim`, `trace_chunk`, `assert_offpath_identity`)
+are THE home of the jaxpr pins the test suite previously hand-rolled per
+file (tests/test_telemetry.py, tests/test_faultinject.py,
+tests/test_ns*_fused.py import from here).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+from dataclasses import dataclass
+
+from .astlint import Violation
+
+RULE_LAUNCH = "launch-count"
+RULE_CALLBACK = "host-callback"
+RULE_DTYPE = "dtype-promotion"
+RULE_ARITY = "metrics-arity"
+RULE_HASH = "trace-drift"
+
+BASELINE_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walkers (shared with the test suite)
+# ---------------------------------------------------------------------------
+
+def iter_eqns(jaxpr):
+    """Every eqn of a jaxpr, recursing into sub-jaxprs (while/cond/pjit/
+    pallas bodies)."""
+    for e in jaxpr.eqns:
+        yield e
+        for v in e.params.values():
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vals:
+                if type(x).__name__ == "ClosedJaxpr":
+                    yield from iter_eqns(x.jaxpr)
+                elif type(x).__name__ == "Jaxpr":
+                    yield from iter_eqns(x)
+
+
+def count_prim(jaxpr, name: str) -> int:
+    """Occurrences of a primitive anywhere in the program (the pin the
+    fused-kernel launch-count tests assert on)."""
+    return sum(1 for e in iter_eqns(jaxpr) if e.primitive.name == name)
+
+
+def prim_histogram(jaxpr) -> dict[str, int]:
+    hist: dict[str, int] = {}
+    for e in iter_eqns(jaxpr):
+        hist[e.primitive.name] = hist.get(e.primitive.name, 0) + 1
+    return hist
+
+
+def host_callbacks(jaxpr) -> list[str]:
+    """Primitive names of host-callback eqns (debug_callback from
+    jax.debug.print, io_callback, pure_callback, legacy outside_call)."""
+    return [
+        e.primitive.name
+        for e in iter_eqns(jaxpr)
+        if "callback" in e.primitive.name or e.primitive.name == "outside_call"
+    ]
+
+
+def float_dtypes(jaxpr) -> set[str]:
+    """Every floating dtype appearing on an eqn output anywhere."""
+    import numpy as np
+
+    out = set()
+    for e in iter_eqns(jaxpr):
+        for v in e.outvars:
+            aval = getattr(v, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is not None and np.issubdtype(dt, np.floating):
+                out.add(str(dt))
+    return out
+
+
+def jaxpr_hash(closed) -> str:
+    """sha256 of the pretty-printed program — the trace-identity token.
+    Stable within one (jax version, x64, backend) environment; the
+    baseline stores that environment and hashes are only compared when it
+    matches."""
+    return hashlib.sha256(str(closed).encode()).hexdigest()
+
+
+def diff_histograms(old: dict, new: dict) -> list[str]:
+    """Primitive-count deltas, the drift diagnostic: which eqns appeared/
+    vanished."""
+    lines = []
+    for name in sorted(set(old) | set(new)):
+        a, b = old.get(name, 0), new.get(name, 0)
+        if a != b:
+            lines.append(f"{name}: {a} -> {b} ({b - a:+d})")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# chunk tracing
+# ---------------------------------------------------------------------------
+
+def chunk_callable(solver):
+    """The traced chunk entry point, uniformly across families: the
+    distributed solvers expose the shard_map'ed `_chunk_sm`; the
+    single-device ones rebuild via `_build_chunk()` (same builder the
+    production `_chunk_fn` wraps)."""
+    if hasattr(solver, "_chunk_sm"):
+        return solver._chunk_sm
+    return solver._build_chunk()
+
+
+def trace_chunk(solver):
+    """ClosedJaxpr of the solver's chunk at its own initial_state arity."""
+    import jax
+
+    return jax.make_jaxpr(chunk_callable(solver))(*solver.initial_state())
+
+
+def chunk_signature(solver, jaxpr=None) -> dict:
+    """The contract-relevant shape of a chunk program."""
+    jx = trace_chunk(solver) if jaxpr is None else jaxpr
+    return {
+        "outvars": len(jx.jaxpr.outvars),
+        "invars": len(jx.jaxpr.invars),
+        "pallas_calls": count_prim(jx.jaxpr, "pallas_call"),
+        "callbacks": host_callbacks(jx.jaxpr),
+        "state_arity": len(solver.initial_state()),
+        "hash": jaxpr_hash(jx),
+        "prims": prim_histogram(jx.jaxpr),
+    }
+
+
+def assert_offpath_identity(make_solver, expect_outvars: int = 5):
+    """THE flag-off identity pin, shared by the telemetry and
+    fault-injection suites: two independent builds trace byte-identically,
+    with the expected plain arity and no sentinel ops. Returns
+    (second solver, its ClosedJaxpr) for follow-on pins."""
+    a = make_solver()
+    jx_a = trace_chunk(a)
+    b = make_solver()
+    jx_b = trace_chunk(b)
+    assert str(jx_a) == str(jx_b), "flag-off build is not deterministic"
+    assert len(jx_a.jaxpr.outvars) == expect_outvars, (
+        f"flag-off chunk arity {len(jx_a.jaxpr.outvars)} != "
+        f"{expect_outvars}"
+    )
+    assert "is_finite" not in str(jx_a), (
+        "flag-off chunk contains sentinel ops"
+    )
+    return b, jx_b
+
+
+# ---------------------------------------------------------------------------
+# the dispatch-matrix configs
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ChunkConfig:
+    """One traced build of the dispatch matrix. The launch-count contract
+    comes in three strengths:
+
+    - `expected_pallas` set: a platform-independent static pin (fft
+      solves, forced fusion).
+    - `derive=True`: the expected count is DERIVED from the recorded
+      dispatch decisions — 2 for a `pallas_fused` phase decision, +1 for
+      a folded p layout, +1 for a solve whose dispatch record starts with
+      "pallas" (`solve_key`). This is the per-decision contract: whatever
+      the dispatcher chose, the trace must contain exactly the kernels
+      that choice implies.
+    - neither: only the env-keyed baseline pins the count (single-device
+      solve paths that record no dispatch decision).
+
+    `dispatch_keys` are recorded into the baseline and diffed on drift."""
+
+    name: str
+    family: str
+    params: dict
+    dims: tuple | None = None
+    expected_pallas: int | None = None
+    derive: bool = False
+    phases_key: str = ""
+    fold_key: str = ""
+    solve_key: str = ""
+    dispatch_keys: tuple = ()
+    notes: str = ""
+
+    def build(self):
+        from ..utils.params import Parameter
+
+        param = Parameter(**self.params)
+        if self.dims is None:
+            if self.family == "ns2d":
+                from ..models.ns2d import NS2DSolver
+
+                return NS2DSolver(param)
+            from ..models.ns3d import NS3DSolver
+
+            return NS3DSolver(param)
+        from ..parallel.comm import CartComm
+
+        comm = CartComm(ndims=len(self.dims), dims=self.dims)
+        if self.family == "ns2d_dist":
+            from ..models.ns2d_dist import NS2DDistSolver
+
+            return NS2DDistSolver(param, comm)
+        from ..models.ns3d_dist import NS3DDistSolver
+
+        return NS3DDistSolver(param, comm)
+
+
+_B2 = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02, tau=0.5,
+           itermax=10, eps=1e-4, omg=1.7, gamma=0.9)
+_B3 = dict(name="dcavity3d", imax=8, jmax=8, kmax=8, re=10.0, te=0.02,
+           tau=0.5, itermax=8, eps=1e-4, omg=1.7, gamma=0.9)
+_OBS = dict(name="canal_obstacle", imax=24, jmax=12, re=10.0, te=0.02,
+            tau=0.5, itermax=10, eps=1e-3, omg=1.7, gamma=0.9,
+            bcLeft=3, bcRight=3, obstacles="0.3,0.3,0.6,0.6")
+
+
+def standard_configs() -> list[ChunkConfig]:
+    """The dispatch matrix: jnp/fused × single/dist × plain/obstacle/
+    ragged × explicit/folded p layout. Grids are 16²/8³ — each config is
+    one trace, no compile."""
+    return [
+        ChunkConfig(
+            "ns2d_jnp", "ns2d",
+            dict(_B2, tpu_fuse_phases="off", tpu_solver="fft"),
+            expected_pallas=0, dispatch_keys=("ns2d_phases",),
+            notes="jnp phase chain + fft solve: zero kernels by contract"),
+        ChunkConfig(
+            "ns2d_fused_fft", "ns2d",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="fft"),
+            expected_pallas=2, dispatch_keys=("ns2d_phases",),
+            notes="fused phases bracket an fft solve: PRE + POST only"),
+        ChunkConfig(
+            "ns2d_fused_fold", "ns2d",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard", tpu_sor_inner=1),
+            derive=True, phases_key="ns2d_phases",
+            fold_key="ns2d_p_layout",
+            dispatch_keys=("ns2d_phases", "ns2d_p_layout"),
+            notes="p-layout fold: PRE + tblock solve + POST, no layout "
+                  "passes between them"),
+        ChunkConfig(
+            "ns2d_obstacle_fused", "ns2d",
+            dict(_OBS, tpu_fuse_phases="on", tpu_solver="sor"),
+            expected_pallas=None, dispatch_keys=("ns2d_phases",),
+            notes="single-device obstacle solve records no dispatch "
+                  "decision and is platform-dependent: baseline-pinned"),
+        ChunkConfig(
+            "ns2d_dist_jnp", "ns2d_dist",
+            dict(_B2, tpu_fuse_phases="off", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist")),
+        ChunkConfig(
+            "ns2d_dist_fused", "ns2d_dist",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist"),
+            notes="fused dist: PRE + POST per shard + whatever the solve "
+                  "dispatch chose"),
+        ChunkConfig(
+            "ns2d_dist_ragged_fused", "ns2d_dist",
+            dict(_B2, imax=18, jmax=18, tpu_fuse_phases="on",
+                 tpu_solver="sor", tpu_sor_layout="checkerboard"),
+            dims=(4, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist"),
+            notes="ragged shards ride the same kernels at uneven bounds"),
+        ChunkConfig(
+            "ns2d_dist_obstacle_fused", "ns2d_dist",
+            dict(_OBS, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="obstacle_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "obstacle_dist"),
+            notes="dist obstacle flags compose via call-time flag blocks"),
+        ChunkConfig(
+            "ns3d_jnp", "ns3d",
+            dict(_B3, tpu_fuse_phases="off", tpu_solver="fft"),
+            expected_pallas=0, dispatch_keys=("ns3d_phases",)),
+        ChunkConfig(
+            "ns3d_fused_fft", "ns3d",
+            dict(_B3, tpu_fuse_phases="on", tpu_solver="fft"),
+            expected_pallas=2, dispatch_keys=("ns3d_phases",)),
+        ChunkConfig(
+            "ns3d_dist_fused", "ns3d_dist",
+            dict(_B3, tpu_fuse_phases="on", tpu_solver="sor"),
+            dims=(2, 2, 2), derive=True, phases_key="ns3d_dist_phases",
+            solve_key="ns3d_dist",
+            dispatch_keys=("ns3d_dist_phases", "ns3d_dist")),
+    ]
+
+
+def expected_launches(cfg: ChunkConfig, decisions: dict):
+    """The launch budget a build's recorded dispatch decisions imply (see
+    ChunkConfig). Returns (count, how) — count None when only the
+    baseline pins this config."""
+    if cfg.expected_pallas is not None:
+        return cfg.expected_pallas, "static"
+    if not cfg.derive:
+        return None, "baseline"
+    n = 0
+    if (decisions.get(cfg.phases_key) or "").startswith("pallas_fused"):
+        n += 2
+    if (decisions.get(cfg.fold_key) or "").startswith("folded"):
+        n += 1
+    if (decisions.get(cfg.solve_key) or "").startswith("pallas"):
+        n += 1
+    return n, "derived"
+
+
+# ---------------------------------------------------------------------------
+# the checks
+# ---------------------------------------------------------------------------
+
+def environment() -> dict:
+    import jax
+
+    return {
+        "jax": jax.__version__,
+        "x64": bool(jax.config.jax_enable_x64),
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+    }
+
+
+def _anchor(family: str) -> tuple[str, int]:
+    import importlib
+
+    mod = importlib.import_module(f"pampi_tpu.models.{family}")
+    try:
+        return inspect.getsourcefile(mod), 1
+    except TypeError:
+        return f"pampi_tpu/models/{family}.py", 1
+
+
+def _forbidden_floats(solver, jaxpr) -> set[str]:
+    """Float dtypes outside the precision contract: compute dtype, the
+    time-accumulator dtype, f32 (metrics / index math)."""
+    import jax
+    import jax.numpy as jnp
+
+    allowed = {
+        str(jnp.dtype(solver.dtype)),
+        "float64" if jax.config.jax_enable_x64 else "float32",
+        "float32",
+    }
+    return float_dtypes(jaxpr.jaxpr) - allowed
+
+
+def check_config(cfg: ChunkConfig, baseline: dict | None,
+                 env_matches: bool) -> tuple[list[Violation], dict]:
+    """Build + trace one config, check the live contracts, and compare
+    against its baseline entry (hash only when the environment matches).
+    Returns (violations, fresh baseline entry)."""
+    from ..utils import dispatch
+
+    path, line = _anchor(cfg.family)
+    solver = cfg.build()
+    jx = trace_chunk(solver)
+    sig = chunk_signature(solver, jx)
+    decisions = {k: dispatch.last(k) for k in cfg.dispatch_keys}
+    entry = {
+        "hash": sig["hash"],
+        "outvars": sig["outvars"],
+        "pallas_calls": sig["pallas_calls"],
+        "eqns": sum(sig["prims"].values()),
+        "prims": sig["prims"],
+        "dispatch": decisions,
+    }
+    vs: list[Violation] = []
+
+    def emit(rule, msg):
+        vs.append(Violation(path, line, rule, f"{cfg.name}: {msg}"))
+
+    # launch count per dispatch decision
+    expected, how = expected_launches(cfg, decisions)
+    entry["expected_pallas"] = expected
+    if expected is not None and sig["pallas_calls"] != expected:
+        emit(RULE_LAUNCH,
+             f"chunk lowers to {sig['pallas_calls']} pallas_call(s), the "
+             f"{how} contract says {expected} "
+             f"(dispatch: {decisions}; {cfg.notes})")
+    # host callbacks only behind armed flags
+    from ..utils import flags as _flags
+
+    if not (_flags.debug() or _flags.verbose() or _flags.check()):
+        if sig["callbacks"]:
+            emit(RULE_CALLBACK,
+                 f"chunk contains host callbacks {sig['callbacks']} with "
+                 "no PAMPI_DEBUG/PAMPI_VERBOSE/PAMPI_CHECK armed — each "
+                 "costs a host sync per step")
+    # dtype policy
+    bad = _forbidden_floats(solver, jx)
+    if bad:
+        emit(RULE_DTYPE,
+             f"float dtypes {sorted(bad)} off the precision contract "
+             f"(compute dtype {solver.dtype.__name__ if hasattr(solver.dtype, '__name__') else solver.dtype})")
+    # metrics arity: initial_state drives every tool's chunk call
+    if sig["state_arity"] != sig["invars"] \
+            or sig["state_arity"] != sig["outvars"]:
+        emit(RULE_ARITY,
+             f"initial_state() arity {sig['state_arity']} vs chunk "
+             f"invars {sig['invars']} / outvars {sig['outvars']}")
+    # baseline comparison — env-gated throughout: launch counts on
+    # baseline-only paths depend on toolchain probe outcomes just like
+    # the hash does (a mismatched jax reports environment drift once,
+    # it does not fail per config)
+    if baseline is not None and env_matches:
+        if baseline.get("pallas_calls") != sig["pallas_calls"]:
+            emit(RULE_LAUNCH,
+                 f"pallas_call count drifted from the baseline: "
+                 f"{baseline.get('pallas_calls')} -> "
+                 f"{sig['pallas_calls']} (tools/lint.py --update if "
+                 "intended)")
+        if baseline.get("hash") != sig["hash"]:
+            diff = diff_histograms(baseline.get("prims", {}), sig["prims"])
+            base_disp = baseline.get("dispatch", {})
+            ddiff = [f"{k}: {base_disp.get(k)!r} -> {v!r}"
+                     for k, v in decisions.items()
+                     if base_disp.get(k) != v]
+            emit(RULE_HASH,
+                 "flag-off trace drifted from CONTRACTS.json; offending "
+                 "eqns (primitive-count deltas): "
+                 + ("; ".join(diff) if diff else
+                    "none — op parameters/ordering changed")
+                 + (f"; dispatch: {'; '.join(ddiff)}" if ddiff else "")
+                 + " (tools/lint.py --update if intended)")
+    return vs, entry
+
+
+def run(baseline: dict | None = None, configs=None,
+        update: bool = False) -> tuple[list[Violation], dict]:
+    """Check every config. Returns (violations, fresh baseline dict) —
+    the driver writes the latter on --update. A missing baseline (or a
+    missing config entry) is only an error when not updating."""
+    configs = standard_configs() if configs is None else configs
+    env = environment()
+    base_env = (baseline or {}).get("env")
+    env_matches = base_env == env
+    base_cfgs = (baseline or {}).get("configs", {})
+    vs: list[Violation] = []
+    fresh = {"version": BASELINE_VERSION, "env": env, "configs": {}}
+    if baseline is not None and not env_matches and not update:
+        vs.append(Violation(
+            "CONTRACTS.json", 1, RULE_HASH,
+            f"baseline environment {base_env} != current {env}: trace-"
+            "hash identity not comparable (structural contracts still "
+            "checked; regenerate the baseline on this toolchain with "
+            "tools/lint.py --update)"))
+    for cfg in configs:
+        entry = base_cfgs.get(cfg.name)
+        if entry is None and baseline is not None and not update:
+            vs.append(Violation(
+                "CONTRACTS.json", 1, RULE_HASH,
+                f"{cfg.name}: no baseline entry (tools/lint.py --update)"))
+        cfg_vs, fresh_entry = check_config(
+            cfg, None if update else entry, env_matches)
+        vs += cfg_vs
+        fresh["configs"][cfg.name] = fresh_entry
+    return vs, fresh

@@ -102,7 +102,9 @@ def _run(argv) -> int:
             import jax
 
             jax.config.update("jax_enable_x64", True)
-        os.environ.setdefault("PAMPI_DTYPE", param.tpu_dtype)
+        from .utils import flags as _flags
+
+        _flags.set_default("PAMPI_DTYPE", param.tpu_dtype)
 
         from .utils import profiling as prof
         from .utils import telemetry
@@ -181,7 +183,9 @@ def _dispatch(param, prof) -> int:
         )
         return 1
 
-    if os.environ.get("PAMPI_FAULTS"):
+    from .utils import faultinject as _fi
+
+    if _fi.enabled():
         # fault injection is the recovery layer's TEST plane — loud when it
         # leaks into a real run (utils/faultinject.py)
         print(

@@ -129,7 +129,7 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
             # force completion of the oldest in-flight chunk: async pallas
             # faults surface here, overlapped with the younger dispatches
             t_old = float(old[time_index])
-        except Exception as exc:
+        except Exception as exc:  # lint: allow(broad-except) — the fault-classification funnel: every runtime error class routes to transient/pallas/raise below
             if isinstance(exc, _fi.FaultSpecError):
                 raise  # a broken TEST spec fails loudly at the first hook
                 # — never classified as a kernel fault or retried
@@ -380,7 +380,7 @@ class RingRecovery:
 
         try:
             ckpt.load_checkpoint(self.ckpt_path, self.solver)
-        except Exception as exc:
+        except Exception as exc:  # lint: allow(broad-except) — a cold-tier restore failure of ANY class degrades to "no checkpoint", never kills recovery
             warnings.warn(
                 f"{self.family}: cold-tier restore from "
                 f"{self.ckpt_path!r} failed ({exc})", stacklevel=2,

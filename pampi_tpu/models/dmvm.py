@@ -53,7 +53,7 @@ from ..utils.timing import get_timestamp
 def _print_sum(s):
     import sys
 
-    print("Sum: %f" % float(s), file=sys.stderr)
+    print("Sum: %f" % float(s), file=sys.stderr)  # lint: allow(print-call) — -DCHECK stderr parity (A3a dmvm.c:26-36)
 
 
 def _fence(y) -> None:
@@ -209,7 +209,7 @@ def main(argv) -> int:
     (assignment-3a/src/main.c:25-34, 93-95) and appends a bench-harness CSV
     row `Ranks,NITER,N,MFlops,Time` (bash scripts/bench-node.sh:25)."""
     if len(argv) < 3:
-        print(f"Usage: {argv[0]} <N> <iter>")
+        print(f"Usage: {argv[0]} <N> <iter>")  # lint: allow(print-call) — CLI usage line (reference main.c parity)
         return 0
     N, iters = int(argv[1]), int(argv[2])
     ndev = len(jax.devices())
@@ -221,7 +221,7 @@ def main(argv) -> int:
         if ndev > 1:
             import sys as _sys
 
-            print(
+            print(  # lint: allow(print-call) — pre-run CLI warning (stderr)
                 f"warning: N={N} not divisible by {ndev} devices; "
                 "running single-device",
                 file=_sys.stderr,
@@ -230,13 +230,16 @@ def main(argv) -> int:
         y, walltime = seq.run(iters)
         mflops = 1.0e-6 * 2.0 * N * N * iters / walltime
         ranks = 1
-    print("%d %d %.2f %.2f" % (iters, N, mflops, walltime))
-    import os
-
+    print("%d %d %.2f %.2f" % (iters, N, mflops, walltime))  # lint: allow(print-call) — the bench headline the harness greps (A3a main.c:93-95)
     from ..parallel import multihost
 
-    if os.environ.get("PAMPI_CSV") and multihost.is_master():
+    # read per RUN through the registered accessor (utils/flags.py) — the
+    # bench harness exports PAMPI_CSV between dmvm invocations of one
+    # process, so an import-time or first-call cache would miss it
+    csv_path = _flags.env("PAMPI_CSV",
+                          doc="dmvm bench CSV append path (rank 0 only)")
+    if csv_path and multihost.is_master():
         # one CSV row per RUN, not per process (rank-0 convention)
-        with open(os.environ["PAMPI_CSV"], "a") as fh:
+        with open(csv_path, "a") as fh:
             fh.write("%d,%d,%d,%.2f,%.2f\n" % (ranks, iters, N, mflops, walltime))
     return 0

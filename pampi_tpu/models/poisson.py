@@ -505,7 +505,7 @@ class PoissonSolver:
             # dispatch is async: force completion inside the try so a pallas
             # runtime fault surfaces here, not at the caller's readback
             out = int(it), float(res)
-        except Exception:
+        except Exception:  # lint: allow(broad-except) — pallas runtime faults have no stable class; non-pallas paths re-raise below
             if self._backend == "jnp" or self.param.tpu_solver in (
                 "mg", "fft", "sor_lex", "sor_rba",
             ):

@@ -856,7 +856,7 @@ def probe_fused_2d() -> bool:
             up, vp, um, _vm = post(offs, dt11, up, vp, fp, gp, z)
             float(um)  # force completion: async errors surface here
             _PROBE_OK = True
-        except Exception:  # noqa: BLE001 — any failure means "don't"
+        except Exception:  # lint: allow(broad-except) — probe contract: any failure means "don't dispatch"
             import warnings
 
             warnings.warn(

@@ -874,7 +874,7 @@ def probe_fused_3d() -> bool:
             out = post(offs, dt11, up, vp, wp, fp, gp, hp, z)
             float(out[3])  # force completion
             _PROBE_OK = True
-        except Exception:  # noqa: BLE001
+        except Exception:  # lint: allow(broad-except) — probe contract: any failure means "don't dispatch"
             import warnings
 
             warnings.warn(

@@ -841,7 +841,7 @@ def probe_pallas_3d() -> bool:
             _, res = rb(z, z)
             float(res)  # force completion: async errors surface here
             _PROBE3D_OK = True
-        except Exception as exc:  # noqa: BLE001 — any failure means "don't"
+        except Exception as exc:  # lint: allow(broad-except) — probe contract: any failure means "don't dispatch"
             import warnings
 
             warnings.warn(

@@ -95,5 +95,5 @@ def main(argv) -> int:
         comm = CartComm(ndims=ndims)
         comm.print_config()
         paths = dump_halos(comm)
-        print(f"wrote {len(paths)} ghost-face dumps (halo-<dir>-r<rank>.txt)")
+        print(f"wrote {len(paths)} ghost-face dumps (halo-<dir>-r<rank>.txt)")  # lint: allow(print-call) — interactive debug CLI
     return 0

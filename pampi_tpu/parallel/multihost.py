@@ -40,13 +40,21 @@ def init_from_env() -> tuple[int, int]:
     global _initialized
     import jax
 
-    coord = os.environ.get("PAMPI_COORDINATOR", "")
-    auto = os.environ.get("PAMPI_MULTIHOST", "") == "auto"
+    from ..utils import flags as _flags
+
+    coord = _flags.env("PAMPI_COORDINATOR",
+                       doc="host:port of the jax.distributed coordinator")
+    auto = _flags.env("PAMPI_MULTIHOST",
+                      doc="'auto' = pod/SLURM topology from the "
+                          "environment") == "auto"
     if _initialized or not (coord or auto):
         return jax.process_index(), jax.process_count()
     if coord:
-        nprocs = int(os.environ["PAMPI_NPROCS"])
-        proc_id = int(os.environ["PAMPI_PROC_ID"])
+        nprocs = int(_flags.env("PAMPI_NPROCS",
+                                doc="process count (with PAMPI_COORDINATOR)"))
+        proc_id = int(_flags.env("PAMPI_PROC_ID",
+                                 doc="this process's rank (with "
+                                     "PAMPI_COORDINATOR)"))
         jax.distributed.initialize(
             coordinator_address=coord, num_processes=nprocs, process_id=proc_id
         )

@@ -48,7 +48,8 @@ def wall_flags(comm: CartComm):
 # ----------------------------------------------------------------------
 
 
-def ca_masks(jl: int, il: int, halo: int, jmax: int, imax: int, dtype):
+def ca_masks(jl: int, il: int, halo: int, jmax: int, imax: int, dtype,
+             joff=None, ioff=None):
     """Mask set on the (jl+2·halo, il+2·halo) extended block, from GLOBAL
     coordinates (local cell (a, b) ↔ global extended index
     (joff + a - halo + 1, ioff + b - halo + 1); owned interior starts at
@@ -57,11 +58,17 @@ def ca_masks(jl: int, il: int, halo: int, jmax: int, imax: int, dtype):
     clipped to the global interior like the sequential Neumann BC), and the
     owned-cell mask for non-redundant residual accounting.
 
+    joff/ioff default to the calling shard's mesh offsets (get_offsets —
+    requires a shard_map context); explicit values build the mask set for
+    a CHOSEN shard geometry outside any mesh, which is how the halo
+    analyzer (analysis/halocheck.py) probes the CA footprint per shard
+    position without spinning up a device mesh.
+
     halo=1 degenerates to the classic 1-ghost-layer extended block (owned ==
     interior), used by the extent-1 fallback path below."""
     H = halo
-    joff = get_offsets("j", jl)
-    ioff = get_offsets("i", il)
+    joff = get_offsets("j", jl) if joff is None else joff
+    ioff = get_offsets("i", il) if ioff is None else ioff
     gj = jnp.arange(jl + 2 * H, dtype=jnp.int32)[:, None] - (H - 1) + joff
     gi = jnp.arange(il + 2 * H, dtype=jnp.int32)[None, :] - (H - 1) + ioff
     interior = (gj >= 1) & (gj <= jmax) & (gi >= 1) & (gi <= imax)

@@ -29,16 +29,19 @@ def face_flags(comm: CartComm):
 
 
 def ca_masks_3d(kl: int, jl: int, il: int, halo: int,
-                kmax: int, jmax: int, imax: int, dtype):
+                kmax: int, jmax: int, imax: int, dtype,
+                koff=None, joff=None, ioff=None):
     """Mask set on the (kl+2H, jl+2H, il+2H) extended block from GLOBAL
     coordinates (owned interior starts at local index H). odd/even follow the
     reference's pass order (pass 0 = (i+j+k) parity 1, solver.c:203-231).
-    halo=1 degenerates to the classic 1-ghost-layer layout for the extent-1
-    fallback."""
+    Explicit koff/joff/ioff build a chosen shard geometry outside any mesh
+    (the stencil2d.ca_masks contract — used by analysis/halocheck.py);
+    None reads the calling shard's offsets. halo=1 degenerates to the
+    classic 1-ghost-layer layout for the extent-1 fallback."""
     H = halo
-    koff = get_offsets("k", kl)
-    joff = get_offsets("j", jl)
-    ioff = get_offsets("i", il)
+    koff = get_offsets("k", kl) if koff is None else koff
+    joff = get_offsets("j", jl) if joff is None else joff
+    ioff = get_offsets("i", il) if ioff is None else ioff
     gk = jnp.arange(kl + 2 * H, dtype=jnp.int32)[:, None, None] - (H - 1) + koff
     gj = jnp.arange(jl + 2 * H, dtype=jnp.int32)[None, :, None] - (H - 1) + joff
     gi = jnp.arange(il + 2 * H, dtype=jnp.int32)[None, None, :] - (H - 1) + ioff

@@ -94,7 +94,11 @@ _cache: tuple[str, tuple] | None = None
 
 
 def enabled() -> bool:
-    return bool(os.environ.get("PAMPI_FAULTS", ""))
+    from . import flags as _flags
+
+    return bool(_flags.env("PAMPI_FAULTS",
+                           doc="deterministic fault-injection spec "
+                               "(test-only)"))
 
 
 def reset() -> None:
@@ -107,8 +111,10 @@ def reset() -> None:
 
 def _clauses() -> tuple:
     """Parse (and cache) the spec: tuples of (kind, site, n, field, count)."""
+    from . import flags as _flags
+
     global _cache
-    spec = os.environ.get("PAMPI_FAULTS", "")
+    spec = _flags.env("PAMPI_FAULTS")
     if _cache is not None and _cache[0] == spec:
         return _cache[1]
     out = []

@@ -22,15 +22,53 @@ PAMPI_VERBOSE/PAMPI_DEBUG to the JAX process (native/src/shim_main.c:43-46).
 
 The prints are `jax.debug.print` host callbacks inside the jitted loops —
 tracing bakes the flag in, so runs without the env pay zero cost.
+
+This module is also the ONE registered home of environment reads: every
+PAMPI_* variable the package consumes is read through `env()` (or the
+`_on` boolean wrapper), which records the variable in a per-process
+inventory (`registered()`). The static lint (analysis/astlint.py rule
+`env-read`) rejects direct `os.environ`/`os.getenv` use anywhere else in
+`pampi_tpu/`, so the inventory is complete by construction — a new knob
+cannot ship without appearing here, in the lint's static scan of
+`flags.env("PAMPI_...")` literals, and in the README env-var table.
 """
 
 from __future__ import annotations
 
 import os
 
+# every env var read through env()/set_default() so far this process,
+# keeping the most recent non-empty doc — the runtime twin of astlint's
+# static inventory (tests/test_analysis.py asserts the two agree)
+_REGISTRY: dict[str, str] = {}
+
+
+def env(name: str, default: str = "", doc: str = "") -> str:
+    """Read an environment variable at CALL time (trace-time semantics:
+    the caller bakes the value into whatever it builds next, and a later
+    build re-reads). The one registered accessor — see the module
+    docstring."""
+    if name not in _REGISTRY or doc:
+        _REGISTRY[name] = doc or _REGISTRY.get(name, "")
+    return os.environ.get(name, default)
+
+
+def set_default(name: str, value: str) -> None:
+    """Registered `os.environ.setdefault` twin: exports a value to child
+    contexts (the native shim, subprocess tools) without clobbering an
+    operator-set one."""
+    if name not in _REGISTRY:
+        _REGISTRY[name] = ""
+    os.environ.setdefault(name, value)
+
+
+def registered() -> dict[str, str]:
+    """The env vars read through this accessor so far this process."""
+    return dict(_REGISTRY)
+
 
 def _on(name: str) -> bool:
-    return os.environ.get(name, "") not in ("", "0")
+    return env(name) not in ("", "0")
 
 
 def debug() -> bool:

@@ -20,9 +20,13 @@ _REPO = pathlib.Path(__file__).resolve().parent.parent.parent
 
 
 def _find_lib():
-    if os.environ.get("PAMPI_NATIVE", "1") == "0":
+    from . import flags as _flags
+
+    if _flags.env("PAMPI_NATIVE", "1",
+                  doc="0 disables the native runtime layer") == "0":
         return None
-    cand = [os.environ.get("PAMPI_NATIVE_LIB", "")]
+    cand = [_flags.env("PAMPI_NATIVE_LIB",
+                       doc="explicit libpampi_native.so path")]
     cand += [str(p) for p in _REPO.glob("build/*/libpampi_native.so")]
     for c in cand:
         if c and os.path.exists(c):

@@ -28,12 +28,10 @@ Usage (mirrors LIKWID_MARKER_*):
 from __future__ import annotations
 
 import contextlib
-import os
 import sys
 import time
 from collections import defaultdict
 
-_MODE = os.environ.get("PAMPI_PROFILE", "0")
 _times: dict[str, float] = defaultdict(float)
 _counts: dict[str, int] = defaultdict(int)
 _device_times: dict[str, float] = defaultdict(float)
@@ -42,8 +40,21 @@ _finalized = False
 _atexit_registered = False
 
 
+def _mode() -> str:
+    """PAMPI_PROFILE read at CALL time through the registered accessor
+    (utils/flags.py) — an import-time cache would bake the value of
+    whichever process imported this module first (observed: a harness
+    setting PAMPI_PROFILE after `import pampi_tpu` silently got no-op
+    regions), and would hide the variable from the lint's env inventory."""
+    from . import flags as _flags
+
+    return _flags.env("PAMPI_PROFILE", "0",
+                      doc="0/unset off; 1 region accounting; <dir> also "
+                          "writes an XProf trace")
+
+
 def enabled() -> bool:
-    return _MODE not in ("", "0")
+    return _mode() not in ("", "0")
 
 
 def init() -> None:
@@ -59,10 +70,10 @@ def init() -> None:
 
         atexit.register(finalize)
         _atexit_registered = True
-    if _MODE != "1":
+    if _mode() != "1":
         import jax
 
-        jax.profiler.start_trace(_MODE)
+        jax.profiler.start_trace(_mode())
         _tracing = True
 
 
@@ -137,7 +148,10 @@ def finalize(out=None) -> None:
         for name in names:
             t = _times.get(name) or _device_times.get(name, 0.0)
             out.write(f"{name:<24} {_counts[name]:>6} {t:>12.4f}\n")
-    csv_path = os.environ.get("PAMPI_PROFILE_CSV", "")
+    from . import flags as _flags
+
+    csv_path = _flags.env("PAMPI_PROFILE_CSV",
+                          doc="finalize() writes the region table as CSV")
     if csv_path and names:
         with open(csv_path, "w") as fh:
             fh.write("region,calls,wall_s,device_s\n")

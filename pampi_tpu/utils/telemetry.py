@@ -68,7 +68,10 @@ _write_failed = False
 
 
 def _path() -> str:
-    return os.environ.get("PAMPI_TELEMETRY", "")
+    from . import flags as _flags
+
+    return _flags.env("PAMPI_TELEMETRY",
+                      doc="flight-recorder JSONL path (unset = off)")
 
 
 def enabled() -> bool:
@@ -88,7 +91,7 @@ def _is_master() -> bool:
         import jax
 
         return jax.process_index() == 0
-    except Exception:  # jax not initialised yet — single process
+    except Exception:  # lint: allow(broad-except) — any probe failure (jax not initialised, no runtime) means single-process
         return True
 
 
@@ -155,7 +158,7 @@ def _run_meta() -> dict:
             n_processes=jax.process_count(),
             jax_version=jax.__version__,
         )
-    except Exception:
+    except Exception:  # lint: allow(broad-except) — metadata is best-effort; a probe crash must never sink the run record
         pass
     return meta
 

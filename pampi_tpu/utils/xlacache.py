@@ -29,7 +29,11 @@ def enable(path: str | None = None) -> str | None:
     host machine") and can wedge a multi-process run with one rank dead and
     its peers blocked in a collective (observed). Set PAMPI_XLA_CACHE=<dir>
     to opt a CPU run in anyway."""
-    val = os.environ.get("PAMPI_XLA_CACHE", "")
+    from . import flags as _flags
+
+    val = _flags.env("PAMPI_XLA_CACHE",
+                     doc="XLA compilation-cache dir; 0/off disables, "
+                         "unset = accelerator-only default")
     if val.lower() in ("0", "off", "none"):
         return None
     if not val:

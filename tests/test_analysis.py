@@ -1,0 +1,391 @@
+"""Tracecheck (pampi_tpu/analysis/ + tools/lint.py) — ISSUE 5 acceptance:
+
+- AST LINT: the tree is clean; every rule fires on a seeded violation
+  with a file:line diagnostic; `# lint: allow(<rule>)` escapes it.
+- HALO FOOTPRINTS: the production registry passes and the CA entries are
+  TIGHT (measured == declared, so the probe is sharp, not vacuous); the
+  two mutation classes — a seeded under-halo declaration and an
+  over-wide stencil — are both flagged.
+- JAXPR CONTRACTS: a config subset round-trips through the baseline
+  (update -> check clean -> update again byte-stable); seeded
+  launch-count drift and hash drift are flagged with primitive-count
+  diffs; the committed CONTRACTS.json matches the harness environment
+  and the current config matrix.
+
+Compile cost: everything here TRACES (make_jaxpr) or linearizes tiny
+blocks — no jit execution of solver chunks.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pampi_tpu.analysis import astlint, halocheck, jaxprcheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# astlint
+# ---------------------------------------------------------------------------
+
+def test_astlint_tree_clean():
+    """The repo itself passes its own lint (the make-lint gate)."""
+    violations, errors = astlint.lint_tree(REPO)
+    assert errors == []
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def _lint_src(tmp_path, src, name="pampi_tpu/models/seeded.py", rules=None):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(src)
+    vs, err = astlint.lint_file(str(path), rules=rules,
+                                root=str(tmp_path))
+    assert err is None
+    return vs
+
+
+def test_rogue_env_read_flagged(tmp_path):
+    """The satellite bug class (PAMPI_CSV in dmvm, PAMPI_PROFILE cached at
+    import): any os.environ read outside utils/flags.py is flagged at its
+    line; the allow escape and the accessor home are exempt."""
+    src = ("import os\n"
+           "MODE = os.environ.get('PAMPI_X', '0')\n"
+           "PATH = os.environ['PAMPI_Y']\n"
+           "OK = os.environ.get('PAMPI_Z')  # lint: allow(env-read) — t\n")
+    vs = _lint_src(tmp_path, src)
+    assert [(v.line, v.rule) for v in vs] == [
+        (2, "env-read"), (3, "env-read")]
+    assert "flags.env()" in vs[0].message
+    # the accessor layer itself is exempt by location
+    vs = _lint_src(tmp_path, src, name="pampi_tpu/utils/flags.py")
+    assert vs == []
+
+
+def test_raw_shard_map_flagged(tmp_path):
+    """The two-past-PRs rule: shard_map only through compat_shard_map —
+    in EVERY spelling (qualified call, bare call after `from jax import
+    shard_map`, aliased module import). Applies to harness trees too
+    (tools/ and tests/ regressed before)."""
+    src = ("import jax\n"
+           "from jax.experimental.shard_map import shard_map\n"
+           "f = jax.shard_map(lambda x: x, None, None, None)\n")
+    vs = _lint_src(tmp_path, src, name="tools/seeded_tool.py")
+    assert [v.line for v in vs] == [2, 3]
+    assert all(v.rule == "raw-shard-map" for v in vs)
+    assert "compat_shard_map" in vs[0].message
+    vs = _lint_src(tmp_path, src, name="pampi_tpu/parallel/comm.py")
+    assert vs == []  # the shim's home
+    # ...but NOT a file that merely ends with the shim's name (path-
+    # component boundary, never a bare suffix)
+    vs = _lint_src(tmp_path, src, name="pampi_tpu/parallel/webcomm.py")
+    assert len(vs) == 2
+
+    # the newer-jax spelling and the aliased import both flag too
+    src2 = ("from jax import shard_map\n"
+            "import jax.experimental.shard_map as sm\n"
+            "a = shard_map(lambda x: x, None, None, None)\n"
+            "b = sm.shard_map(lambda x: x, None, None, None)\n")
+    vs = _lint_src(tmp_path, src2, name="tools/seeded_tool2.py")
+    assert [v.line for v in vs] == [1, 2, 3, 4]
+    assert all(v.rule == "raw-shard-map" for v in vs)
+
+
+def test_traced_context_rules(tmp_path):
+    """np.* and nondeterminism inside a traced closure (a def nested in a
+    _build_*/make_* builder); builder BODIES are trace-time host code
+    where numpy is legitimate."""
+    src = ("import numpy as np\n"
+           "import time, random\n"
+           "def make_step(n):\n"
+           "    c = np.arange(n)  # builder body: constant baking, legal\n"
+           "    def step(x):\n"
+           "        y = np.asarray(x)\n"
+           "        t = time.time()\n"
+           "        r = random.random()\n"
+           "        return y + c[0] + t + r\n"
+           "    return step\n")
+    vs = _lint_src(tmp_path, src)
+    assert [(v.line, v.rule) for v in vs] == [
+        (6, "np-in-traced"), (7, "traced-nondet"), (8, "traced-nondet")]
+
+
+def test_broad_except_and_print(tmp_path):
+    src = ("def f():\n"
+           "    try:\n"
+           "        pass\n"
+           "    except Exception:\n"
+           "        print('boom')\n"
+           "    except Exception:  # lint: allow(broad-except) — probe\n"
+           "        pass\n")
+    vs = _lint_src(tmp_path, src)
+    assert [(v.line, v.rule) for v in vs] == [
+        (4, "broad-except"), (5, "print-call")]
+    assert str(vs[0]).startswith("pampi_tpu/models/seeded.py:4: ")
+
+
+def test_env_inventory_complete():
+    """The static env-var inventory: every PAMPI_* knob the library reads
+    is registered through flags.env at a named site — the rogue reads the
+    satellites fixed (PAMPI_CSV, PAMPI_PROFILE) now appear here. The
+    RUNTIME registry (flags.registered(), populated as accessors run)
+    must agree with the static scan: a var the process actually read that
+    the scan can't see would mean a non-literal name snuck past the
+    lint."""
+    inv = astlint.env_inventory(REPO)
+    for var, home in [
+        ("PAMPI_TELEMETRY", "utils/telemetry.py"),
+        ("PAMPI_FAULTS", "utils/faultinject.py"),
+        ("PAMPI_PROFILE", "utils/profiling.py"),
+        ("PAMPI_CSV", "models/dmvm.py"),
+        ("PAMPI_XLA_CACHE", "utils/xlacache.py"),
+        ("PAMPI_NATIVE", "utils/native.py"),
+        ("PAMPI_COORDINATOR", "parallel/multihost.py"),
+    ]:
+        assert var in inv, var
+        assert any(home in site for site in inv[var]), (var, inv[var])
+
+    from pampi_tpu.utils import faultinject as fi
+    from pampi_tpu.utils import flags, profiling, telemetry
+
+    telemetry.enabled()
+    fi.enabled()
+    profiling.enabled()
+    reg = flags.registered()
+    assert {"PAMPI_TELEMETRY", "PAMPI_FAULTS", "PAMPI_PROFILE"} <= set(reg)
+    assert set(reg) <= set(inv) | {"PAMPI_DEBUG", "PAMPI_VERBOSE",
+                                   "PAMPI_CHECK", "PAMPI_DTYPE"}
+    # accessor docs ride the registry (the runtime-readable knob table)
+    assert reg["PAMPI_TELEMETRY"]
+
+
+# ---------------------------------------------------------------------------
+# halocheck
+# ---------------------------------------------------------------------------
+
+def _ca_entry(n=1, ragged=False):
+    return halocheck._ca2d_entry(n, ragged=ragged)
+
+
+def test_halo_registry_subset_clean_and_tight():
+    """The CA contracts hold AND are tight: ca_halo(n) layers are exactly
+    consumed (divisible 2n; ragged 2n+1 — the dead-shard wall-ghost
+    refresh), so the probe measures the real footprint, not a lower
+    bound."""
+    for n, ragged in ((1, False), (2, False), (1, True)):
+        e = _ca_entry(n, ragged)
+        assert halocheck.check_entry(e) == []
+        assert max(halocheck.measure(e).values()) == e.declared, e.name
+    post = halocheck._post2d_entry()
+    assert halocheck.check_entry(post) == []
+    assert halocheck.measure(post)[2] == 1  # p: exactly the halo-1 ring
+
+
+def test_halo_under_declaration_flagged():
+    """Mutation 1 (the seeded too-narrow halo): the same kernel declared
+    one layer shallower is an under-halo read, with a file:line anchor at
+    the kernel source."""
+    e = _ca_entry(2)
+    e.declared -= 1
+    vs = halocheck.check_entry(e)
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.rule == "halo-footprint"
+    assert "stencil2d.py" in v.path and v.line > 0
+    assert "4 cells beyond" in v.message and "declared halo is 3" in v.message
+
+
+def test_halo_overwide_stencil_flagged():
+    """Mutation 2 (the seeded too-wide stencil offset): a ±2 read smuggled
+    into the n=1 iteration — the regression class where someone widens a
+    difference operator without bumping ca_halo. Built on a block with
+    spare layers (halo 4) so the wider read has real cells to land on;
+    the declaration stays the production ca_halo(1) = 2."""
+    import jax.numpy as jnp
+
+    from pampi_tpu.parallel import stencil2d as s2
+
+    jl = il = 6
+    room = 4  # block layers available; the CONTRACT stays ca_halo(1) = 2
+    masks = s2.ca_masks(jl, il, room, 30, 30, float, joff=8, ioff=8)
+    shape = (jl + 2 * room, il + 2 * room)
+
+    def base(p, rhs):
+        return s2.ca_rb_iters(p, rhs, 1, masks, 0.45, 1.0, 1.3)[0]
+
+    entry = halocheck.HaloEntry(
+        name="mutated.ca_rb_iters", fn=base,
+        in_shapes=(shape, shape),
+        owned=(slice(room, room + jl), slice(room, room + il)),
+        declared=s2.ca_halo(1),
+        anchor=("mutated.py", 1))
+    assert halocheck.check_entry(entry) == []  # the clean tree passes
+
+    def widened(p, rhs):
+        return base(p + 0.001 * jnp.roll(p, 2, axis=0), rhs)
+
+    entry.fn = widened
+    vs = halocheck.check_entry(entry)
+    assert len(vs) == 1
+    assert "4 cells beyond the owned region" in vs[0].message
+    assert "declared halo is 2" in vs[0].message
+
+
+def test_halo_fused_pre_within_budget():
+    """The fused PRE chain stays within FUSE_CHAIN on every shard
+    position (the deep-halo PRE contract)."""
+    for shard in ("interior", "corner_lo", "wall_hi"):
+        e = halocheck._pre2d_entry(shard)
+        assert halocheck.check_entry(e) == [], shard
+
+
+# ---------------------------------------------------------------------------
+# jaxprcheck
+# ---------------------------------------------------------------------------
+
+def _subset():
+    keep = {"ns2d_jnp", "ns2d_fused_fft", "ns2d_fused_fold"}
+    return [c for c in jaxprcheck.standard_configs() if c.name in keep]
+
+
+@pytest.fixture(scope="module")
+def subset_baseline():
+    """One traced subset baseline shared by the drift tests (each config
+    build is a solver construction — don't pay it per test)."""
+    vs, fresh = jaxprcheck.run(baseline=None, configs=_subset(),
+                               update=True)
+    assert vs == []
+    return fresh
+
+
+def test_contracts_roundtrip_stable(subset_baseline):
+    """update -> check clean -> update again byte-stable (the --update
+    round-trip contract: regenerating without a code change is a no-op
+    diff)."""
+    vs, _ = jaxprcheck.run(baseline=subset_baseline, configs=_subset())
+    assert vs == [], [str(v) for v in vs]
+    _, again = jaxprcheck.run(baseline=subset_baseline, configs=_subset(),
+                              update=True)
+    assert json.dumps(again, sort_keys=True) == json.dumps(
+        subset_baseline, sort_keys=True)
+
+
+def test_seeded_launch_drift_flagged(subset_baseline):
+    """Mutation: a baseline pinning a different launch count (as if a
+    layout pass crept back between the fused kernels) fails with the
+    dispatch decision in the diagnostic."""
+    tampered = json.loads(json.dumps(subset_baseline))
+    tampered["configs"]["ns2d_fused_fft"]["pallas_calls"] = 4
+    cfg = [c for c in _subset() if c.name == "ns2d_fused_fft"]
+    vs, _ = jaxprcheck.run(baseline=tampered, configs=cfg)
+    launch = [v for v in vs if v.rule == "launch-count"]
+    assert len(launch) == 1
+    assert "4 -> 2" in launch[0].message
+    assert launch[0].path.endswith("models/ns2d.py")
+
+
+def test_seeded_hash_drift_flagged(subset_baseline):
+    """Mutation: hash drift (an eqn-level change to the flag-off program)
+    fails with a primitive-count diff of the offending eqns."""
+    tampered = json.loads(json.dumps(subset_baseline))
+    entry = tampered["configs"]["ns2d_jnp"]
+    entry["hash"] = "0" * 64
+    entry["prims"] = dict(entry["prims"], pallas_call=7, while_loop_x=1)
+    cfg = [c for c in _subset() if c.name == "ns2d_jnp"]
+    vs, _ = jaxprcheck.run(baseline=tampered, configs=cfg)
+    drift = [v for v in vs if v.rule == "trace-drift"]
+    assert len(drift) == 1
+    msg = drift[0].message
+    assert "pallas_call: 7 -> 0" in msg and "while_loop_x: 1 -> 0" in msg
+    assert "--update" in msg
+
+
+def test_env_mismatch_reported_not_compared(subset_baseline):
+    """A baseline from another toolchain reports environment drift once
+    and skips hash comparison instead of failing every config."""
+    foreign = json.loads(json.dumps(subset_baseline))
+    foreign["env"] = dict(foreign["env"], jax="9.9.9")
+    for e in foreign["configs"].values():
+        e["hash"] = "f" * 64   # would fail if compared
+        e["pallas_calls"] = 9  # likewise toolchain-dependent: not compared
+    vs, _ = jaxprcheck.run(baseline=foreign, configs=_subset())
+    assert [v.rule for v in vs] == ["trace-drift"]
+    assert "environment" in vs[0].message
+
+
+def test_callback_and_dtype_detectors():
+    """The primitive scanners behind the host-callback and dtype checks."""
+    import jax
+    import jax.numpy as jnp
+
+    def noisy(x):
+        jax.debug.print("x={}", x)
+        return x * 2.0
+
+    jx = jax.make_jaxpr(noisy)(1.0)
+    assert jaxprcheck.host_callbacks(jx.jaxpr) == ["debug_callback"]
+
+    def promoting(x):
+        return x.astype(jnp.float64) + 1.0, x * jnp.float32(2)
+
+    jx = jax.make_jaxpr(promoting)(jnp.zeros((3,), jnp.float32))
+    fts = jaxprcheck.float_dtypes(jx.jaxpr)
+    assert {"float32", "float64"} <= fts
+
+
+def test_telemetry_arity_contract(tmp_path, monkeypatch):
+    """With PAMPI_TELEMETRY armed the traced chunk and initial_state()
+    agree at the metrics arity (6/6) and the signature reflects it — the
+    contract every measurement tool leans on."""
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.utils import telemetry as tm
+    from pampi_tpu.utils.params import Parameter
+
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(tmp_path / "t.jsonl"))
+    tm.reset()
+    s = NS2DSolver(Parameter(name="dcavity", imax=16, jmax=16, re=10.0,
+                             te=0.02, tau=0.5, itermax=10, eps=1e-4))
+    sig = jaxprcheck.chunk_signature(s)
+    assert sig["state_arity"] == sig["invars"] == sig["outvars"] == 6
+    tm.reset()
+
+
+def test_committed_baseline_current():
+    """The committed CONTRACTS.json was generated in THIS harness
+    environment and covers exactly the current config matrix — a stale
+    baseline (config added/renamed without --update) fails here, not on
+    an operator's machine."""
+    path = os.path.join(REPO, "CONTRACTS.json")
+    with open(path) as fh:
+        baseline = json.load(fh)
+    assert baseline["env"] == jaxprcheck.environment()
+    assert set(baseline["configs"]) == {
+        c.name for c in jaxprcheck.standard_configs()}
+    # and it passes the shared artifact lint (the one import spelling the
+    # other suites use — don't load the module under a second name)
+    from tools import check_artifact as ca
+
+    assert ca.lint_contracts(baseline) == []
+    assert ca.lint_contracts({"version": 1}) != []
+
+
+def test_lint_driver_ast_pass():
+    """tools/lint.py --only ast runs standalone (no jax import needed for
+    the rule pass) and exits clean on the tree — and on an explicit file
+    path (the per-file pre-commit invocation)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--only", "ast"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "[ast] ok" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--only", "ast", "pampi_tpu/utils/flags.py"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "[ast] ok" in proc.stdout

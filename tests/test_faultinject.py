@@ -98,23 +98,25 @@ def test_unset_is_byte_identical(faults, monkeypatch):
     """PAMPI_FAULTS unset -> the chunk is the uninjected program (two off
     builds trace identically, 5 outvars, no `select` from a corruption
     where); HOST-side clauses (chunk/write/emit sites) never touch traces;
-    only nan/inf clauses change the jaxpr — and only in the armed build."""
+    only nan/inf clauses change the jaxpr — and only in the armed build.
+    The off-path pin is the shared analysis/jaxprcheck helper (one home
+    for this contract — tests/test_telemetry.py asserts the same one)."""
+    from pampi_tpu.analysis.jaxprcheck import (
+        assert_offpath_identity,
+        trace_chunk,
+    )
+
     param = Parameter(**_BASE)
-    off1 = NS2DSolver(param)
-    jx_off1 = jax.make_jaxpr(off1._build_chunk())(*off1.initial_state())
-    off2 = NS2DSolver(param)
-    jx_off2 = jax.make_jaxpr(off2._build_chunk())(*off2.initial_state())
-    assert str(jx_off1) == str(jx_off2)
-    assert len(jx_off1.jaxpr.outvars) == 5
+    _off, jx_off1 = assert_offpath_identity(lambda: NS2DSolver(param))
 
     faults("transient@chunk99,pallas@chunk98,ckpt_torn@write9,telemetry@emit9")
     host_only = NS2DSolver(param)
-    jx_host = jax.make_jaxpr(host_only._build_chunk())(*host_only.initial_state())
+    jx_host = trace_chunk(host_only)
     assert str(jx_host) == str(jx_off1)  # host faults are not in the trace
 
     faults("nan@step3:u*9")
     armed = NS2DSolver(param)
-    jx_armed = jax.make_jaxpr(armed._build_chunk())(*armed.initial_state())
+    jx_armed = trace_chunk(armed)
     assert str(jx_armed) != str(jx_off1)  # the corruption where() is baked
 
 

@@ -360,17 +360,9 @@ def test_dist_obstacle_fused_matches_single():
         assert np.isfinite(d).all() and d.max() < 1e-9, n
 
 
-def _count_prim(jaxpr, name):
-    n = sum(1 for e in jaxpr.eqns if e.primitive.name == name)
-    for e in jaxpr.eqns:
-        for v in e.params.values():
-            vals = v if isinstance(v, (tuple, list)) else (v,)
-            for x in vals:
-                if type(x).__name__ == "ClosedJaxpr":
-                    n += _count_prim(x.jaxpr, name)
-                elif type(x).__name__ == "Jaxpr":
-                    n += _count_prim(x, name)
-    return n
+# the recursive pallas-launch counter lives in the shared analysis
+# layer (one home for every jaxpr pin — see tools/lint.py)
+from pampi_tpu.analysis.jaxprcheck import count_prim as _count_prim
 
 
 def _while_body(jaxpr):

@@ -1,5 +1,7 @@
 """Profiling region hooks (utils/profiling.py — the LIKWID-marker parity
-layer): no-op when disabled, wall-clock accounting when enabled."""
+layer): no-op when disabled, wall-clock accounting when enabled.
+PAMPI_PROFILE is read at call time through utils/flags.env — tests arm it
+via the environment, the same surface production uses."""
 
 import io
 
@@ -7,7 +9,7 @@ from pampi_tpu.utils import profiling as prof
 
 
 def test_disabled_is_noop(monkeypatch):
-    monkeypatch.setattr(prof, "_MODE", "0")
+    monkeypatch.setenv("PAMPI_PROFILE", "0")
     prof.reset()
     prof.init()
     with prof.region("solve"):
@@ -18,7 +20,7 @@ def test_disabled_is_noop(monkeypatch):
 
 
 def test_enabled_accounts_regions(monkeypatch):
-    monkeypatch.setattr(prof, "_MODE", "1")
+    monkeypatch.setenv("PAMPI_PROFILE", "1")
     prof.reset()
     prof.init()
     for _ in range(3):
@@ -37,7 +39,7 @@ def test_finalize_idempotent_and_atexit(monkeypatch, tmp_path):
     """finalize() must be safe to call twice (the atexit hook + the
     driver's explicit call): the table prints once and the CSV is not
     rewritten; init() re-arms for the next init/finalize pair."""
-    monkeypatch.setattr(prof, "_MODE", "1")
+    monkeypatch.setenv("PAMPI_PROFILE", "1")
     csv = tmp_path / "regions.csv"
     monkeypatch.setenv("PAMPI_PROFILE_CSV", str(csv))
     prof.reset()
@@ -61,7 +63,7 @@ def test_finalize_idempotent_and_atexit(monkeypatch, tmp_path):
 def test_table_accessor(monkeypatch):
     """table() — the telemetry finalize record's source — mirrors the
     wall/device accounting."""
-    monkeypatch.setattr(prof, "_MODE", "1")
+    monkeypatch.setenv("PAMPI_PROFILE", "1")
     prof.reset()
     prof.init()
     with prof.region("solve"):

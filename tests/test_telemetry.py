@@ -23,22 +23,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from pampi_tpu.analysis.jaxprcheck import (
+    assert_offpath_identity,
+    count_prim as _count_prim,
+)
 from pampi_tpu.models.ns2d import NS2DSolver
 from pampi_tpu.utils import telemetry as tm
 from pampi_tpu.utils.params import Parameter
-
-
-def _count_prim(jaxpr, name):
-    n = sum(1 for e in jaxpr.eqns if e.primitive.name == name)
-    for e in jaxpr.eqns:
-        for v in e.params.values():
-            vals = v if isinstance(v, (tuple, list)) else (v,)
-            for x in vals:
-                if type(x).__name__ == "ClosedJaxpr":
-                    n += _count_prim(x.jaxpr, name)
-                elif type(x).__name__ == "Jaxpr":
-                    n += _count_prim(x, name)
-    return n
 
 
 @pytest.fixture()
@@ -68,16 +59,12 @@ def test_offpath_jaxpr_identity(tel_off, tmp_path, monkeypatch):
     """PAMPI_TELEMETRY unset -> the chunk is the PRE-TELEMETRY program:
     5 outputs (u, v, p, t, nt), zero sentinel ops, deterministic trace;
     setting it changes ONLY the in-band additions (6th output, isfinite),
-    never the Pallas launch count."""
+    never the Pallas launch count. The off-path pin itself lives in ONE
+    place — analysis/jaxprcheck.assert_offpath_identity, shared with
+    tests/test_faultinject.py and the `make lint` trace contracts."""
     param = Parameter(**_BASE)
-    off1 = NS2DSolver(param)
-    jx_off1 = jax.make_jaxpr(off1._build_chunk())(*off1.initial_state())
-    off2 = NS2DSolver(param)
-    jx_off2 = jax.make_jaxpr(off2._build_chunk())(*off2.initial_state())
+    off1, jx_off1 = assert_offpath_identity(lambda: NS2DSolver(param))
     assert not off1._metrics
-    assert len(jx_off1.jaxpr.outvars) == 5
-    assert str(jx_off1) == str(jx_off2)  # bitwise-identical trace
-    assert "is_finite" not in str(jx_off1)
     n_pallas_off = _count_prim(jx_off1.jaxpr, "pallas_call")
 
     monkeypatch.setenv("PAMPI_TELEMETRY", str(tmp_path / "r.jsonl"))

@@ -1,14 +1,17 @@
 """Schema lint for the committed driver artifacts (BENCH_rXX.json /
-MULTICHIP_rXX.json) and the telemetry summary blocks merged into them.
+MULTICHIP_rXX.json), the telemetry summary blocks merged into them, and
+the static-analysis baseline (CONTRACTS.json).
 
     python tools/check_artifact.py [files...]
 
 With no arguments, lints every BENCH_r*.json / MULTICHIP_r*.json in the
-repo root. Exit 1 with one line per violation. A tier-1 test
-(tests/test_check_artifact.py) runs the lint over the committed artifacts,
-so a driver round that writes a malformed artifact — or a refactor that
-renames a decomposition field the analysts rely on — fails CI instead of
-silently degrading the record.
+repo root plus CONTRACTS.json when present. Exit 1 with one line per
+violation. A tier-1 test (tests/test_check_artifact.py) runs the lint
+over the committed artifacts, so a driver round that writes a malformed
+artifact — or a refactor that renames a decomposition field the analysts
+rely on — fails CI instead of silently degrading the record. The same
+lint runs as the `artifacts` pass of `tools/lint.py` (make lint): one
+analysis layer for CI, the test suite, and the artifact check.
 
 Contracts:
 - BENCH: {n, cmd, rc, tail} required. `parsed*` blocks (the JSON lines
@@ -21,6 +24,11 @@ Contracts:
   chunks, records}; when the PR 4 resilience blocks are present,
   `recoveries`/`retries` must be lists of records and `ckpt` a
   save/rotate/load/reject count map.
+- CONTRACTS: {version, env, configs} with env naming the trace
+  environment (jax/x64/backend) and every config entry carrying the
+  jaxprcheck signature keys ({hash, outvars, pallas_calls, prims,
+  dispatch}) — a hand-edited or truncated baseline would otherwise turn
+  the trace-identity check into a silent no-op.
 """
 
 from __future__ import annotations
@@ -95,6 +103,34 @@ def lint_multichip(d: dict, where: str = "MULTICHIP") -> list[str]:
     return errs
 
 
+CONTRACTS_REQUIRED = ("version", "env", "configs")
+CONTRACTS_ENV = ("jax", "x64", "backend")
+CONTRACTS_ENTRY = ("hash", "outvars", "pallas_calls", "prims", "dispatch")
+
+
+def lint_contracts(d: dict, where: str = "CONTRACTS") -> list[str]:
+    """The analysis/jaxprcheck baseline shape (see module docstring)."""
+    errs = _missing(d, CONTRACTS_REQUIRED, where)
+    env = d.get("env")
+    if isinstance(env, dict):
+        errs += _missing(env, CONTRACTS_ENV, f"{where}.env")
+    elif "env" in d:
+        errs.append(f"{where}.env: not a dict")
+    configs = d.get("configs")
+    if isinstance(configs, dict):
+        if not configs:
+            errs.append(f"{where}.configs: empty")
+        for name, entry in configs.items():
+            if not isinstance(entry, dict):
+                errs.append(f"{where}.configs.{name}: not a dict")
+                continue
+            errs += _missing(entry, CONTRACTS_ENTRY,
+                             f"{where}.configs.{name}")
+    elif "configs" in d:
+        errs.append(f"{where}.configs: not a dict")
+    return errs
+
+
 def lint_file(path: str) -> list[str]:
     base = os.path.basename(path)
     try:
@@ -108,16 +144,28 @@ def lint_file(path: str) -> list[str]:
         return lint_bench(d, base)
     if base.startswith("MULTICHIP"):
         return lint_multichip(d, base)
-    return [f"{base}: unknown artifact family (expected BENCH_*/MULTICHIP_*)"]
+    if base.startswith("CONTRACTS"):
+        return lint_contracts(d, base)
+    return [f"{base}: unknown artifact family "
+            "(expected BENCH_*/MULTICHIP_*/CONTRACTS*)"]
+
+
+def default_files() -> list[str]:
+    """The committed artifact set (shared with tools/lint.py)."""
+    files = sorted(
+        glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+        + glob.glob(os.path.join(REPO, "MULTICHIP_r*.json"))
+    )
+    contracts = os.path.join(REPO, "CONTRACTS.json")
+    if os.path.exists(contracts):
+        files.append(contracts)
+    return files
 
 
 def main(argv: list[str]) -> int:
     files = argv[1:]
     if not files:
-        files = sorted(
-            glob.glob(os.path.join(REPO, "BENCH_r*.json"))
-            + glob.glob(os.path.join(REPO, "MULTICHIP_r*.json"))
-        )
+        files = default_files()
     if not files:
         print("no artifacts found", file=sys.stderr)
         return 1

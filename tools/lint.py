@@ -1,0 +1,149 @@
+"""tracecheck — the static contract checker driver (pampi_tpu/analysis/).
+
+    python tools/lint.py [--only ast|halo|jaxpr|artifacts] [--update]
+                         [--contracts PATH] [paths...]
+
+Three passes (all by default, `make lint`):
+
+  ast        repo lint rules over pampi_tpu/, tools/, tests/ (or the
+             given paths) — file:line diagnostics, `# lint: allow(<rule>)`
+             escapes (analysis/astlint.py)
+  halo       stencil/Pallas access footprints vs declared halo depths
+             (analysis/halocheck.py)
+  jaxpr      the dispatch-matrix trace contracts vs CONTRACTS.json
+             (analysis/jaxprcheck.py); `--update` regenerates the
+             baseline after an intended program change
+  artifacts  the committed BENCH/MULTICHIP schema lint
+             (tools/check_artifact.py) — CI, the test suite and this
+             driver share the one analysis layer
+
+The jaxpr pass pins its environment (CPU backend, x64, 8 host devices —
+the test harness environment) BEFORE importing jax, so the committed
+baseline is reproducible on any machine with the same jax version; on a
+different jax the hash comparison is reported as environment drift and
+the structural contracts still run.
+
+Exit 0 = clean; 1 = violations (one `file:line: [rule] message` per
+line); 2 = driver error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONTRACTS = os.path.join(REPO, "CONTRACTS.json")
+
+# the pinned trace environment — must precede any jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+sys.path.insert(0, REPO)
+
+
+def run_ast(paths) -> list:
+    from pampi_tpu.analysis import astlint
+
+    if paths:
+        violations, errors = [], []
+        for p in paths:
+            if os.path.isdir(p):
+                vs, errs = astlint.lint_tree(
+                    os.path.dirname(os.path.abspath(p)) or ".",
+                    subdirs=(os.path.basename(os.path.abspath(p)),))
+                errors += errs
+            else:
+                # lint_file returns (violations, one error string or None)
+                vs, err = astlint.lint_file(p, root=REPO)
+                if err:
+                    errors.append(err)
+            violations += vs
+    else:
+        violations, errors = astlint.lint_tree(REPO)
+    for e in errors:
+        print(f"ast: {e}", file=sys.stderr)
+    return violations + [
+        astlint.Violation(e.split(":", 1)[0], 1, "parse-error", e)
+        for e in errors
+    ]
+
+
+def run_halo() -> list:
+    from pampi_tpu.analysis import halocheck
+
+    return halocheck.check_all()
+
+
+def run_jaxpr(update: bool, contracts_path: str) -> list:
+    from pampi_tpu.analysis import jaxprcheck
+
+    baseline = None
+    if os.path.exists(contracts_path):
+        with open(contracts_path) as fh:
+            baseline = json.load(fh)
+    elif not update:
+        print(f"jaxpr: no baseline at {contracts_path} — tracing fresh "
+              "(run with --update to commit one)", file=sys.stderr)
+    violations, fresh = jaxprcheck.run(baseline=baseline, update=update)
+    if update:
+        with open(contracts_path, "w") as fh:
+            json.dump(fresh, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"jaxpr: baseline written to {contracts_path} "
+              f"({len(fresh['configs'])} configs)")
+    return violations
+
+
+def run_artifacts() -> list:
+    from pampi_tpu.analysis.astlint import Violation
+
+    import check_artifact as ca
+
+    errs = []
+    for path in ca.default_files():
+        errs += [Violation(os.path.basename(path), 1, "artifact", e)
+                 for e in ca.lint_file(path)]
+    return errs
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", choices=("ast", "halo", "jaxpr", "artifacts"))
+    ap.add_argument("--update", action="store_true",
+                    help="regenerate the CONTRACTS.json baseline")
+    ap.add_argument("--contracts", default=CONTRACTS)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs for the ast pass (default: the repo)")
+    args = ap.parse_args(argv[1:])
+
+    passes = (args.only,) if args.only else ("ast", "halo", "jaxpr",
+                                             "artifacts")
+    total = 0
+    for name in passes:
+        if name == "ast":
+            vs = run_ast(args.paths)
+        elif name == "halo":
+            vs = run_halo()
+        elif name == "jaxpr":
+            vs = run_jaxpr(args.update, args.contracts)
+        else:
+            vs = run_artifacts()
+        for v in vs:
+            print(str(v))
+        status = "ok" if not vs else f"{len(vs)} violation(s)"
+        print(f"[{name}] {status}")
+        total += len(vs)
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
