@@ -70,13 +70,19 @@ check-artifacts:
 # tracecheck: the static contract checker (pampi_tpu/analysis/) — AST
 # lint rules over pampi_tpu/ tools/ tests/, stencil halo footprints vs
 # declared depths, the dispatch-matrix jaxpr contracts vs CONTRACTS.json,
-# and the committed-artifact schema lint. Regenerate the baseline after
-# an INTENDED trace change with `make lint-update`.
+# the collective-schedule census (comm) and Pallas kernel-resource
+# checks (pallas), and the committed-artifact schema lint. Regenerate
+# the baseline (configs + comm sections) after an INTENDED change with
+# `make lint-update`. `make lint-comm` runs the comm contract alone —
+# the overlap refactor's inner loop (one matrix trace, no AST/halo).
 lint:
 	python tools/lint.py
 
 lint-update:
 	python tools/lint.py --update
+
+lint-comm:
+	python tools/lint.py --only comm
 
 # Standalone run of the fault-injection / recovery suite (PAMPI_FAULTS
 # plane, retry budgets, rollback-recovery, checkpoint durability edges).
@@ -93,4 +99,4 @@ distclean:
 	rm -rf build exe-*
 
 .PHONY: all test asm format telemetry-report check-artifacts lint \
-	lint-update fault-suite clean distclean
+	lint-update lint-comm fault-suite clean distclean

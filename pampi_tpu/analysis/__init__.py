@@ -16,16 +16,24 @@ footprint/race analysis plays for MPI stencil codes:
   halocheck   derive each stencil kernel's static access footprint (the
               dependency cone of owned outputs on the exchanged block)
               and compare against the declared halo depths
+  commcheck   census the collectives of every traced chunk (counts,
+              ppermute message multiset, per-step halo traffic bytes)
+              against the env-keyed `comm` section of CONTRACTS.json and
+              the solvers' own static halo-byte records
+  palcheck    check every pallas_call's block tiling, static VMEM
+              footprint, grid×index-map bounds, and aliasing hazards —
+              the Mosaic compile-time failures, decided on CPU
   astlint     repo-specific AST rules with file:line diagnostics and
               inline `# lint: allow(<rule>)` escapes
 
-Driver: `tools/lint.py` (all three passes; `--update` regenerates the
-CONTRACTS.json baseline). Tier-1 coverage: tests/test_analysis.py.
+Driver: `tools/lint.py` (all passes; `--update` regenerates the
+CONTRACTS.json baseline, configs + comm sections). Tier-1 coverage:
+tests/test_analysis.py.
 """
 
 import importlib
 
-__all__ = ["astlint", "halocheck", "jaxprcheck"]
+__all__ = ["astlint", "commcheck", "halocheck", "jaxprcheck", "palcheck"]
 
 
 def __getattr__(name):

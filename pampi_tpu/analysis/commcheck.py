@@ -1,0 +1,290 @@
+"""Collective-contract checker: does any chunk program move more halo
+traffic than the communication schedule declares?
+
+The bug class this guards is the distributed twin of the launch-count
+contract: the overlap refactor (ROADMAP item 2) will rewrite exactly the
+step-level exchange schedule, and "Persistent and Partitioned MPI for
+Stencil Communication" (PAPERS.md) shows the overlap win evaporates if
+extra exchanges sneak onto the critical path — a resharding collective
+introduced by sharding propagation, a duplicated `halo_exchange`, or a
+solve that silently re-exchanges per iteration would all cost latency the
+telemetry only notices on real hardware. A static census of the traced
+program catches them on CPU, before any TPU time is spent.
+
+What one trace proves (`jax.make_jaxpr` of the chunk, no execution —
+shapes inside `shard_map` are per-shard, so the census is the per-shard
+accounting the PR 3 telemetry records use):
+
+  collective census   occurrences of every collective primitive
+                      (`ppermute`/`psum`/`pmax`/... ) in the chunk. The
+                      while-loop step body traces once, so the counts are
+                      per-STEP (solve-internal `fori` iterations likewise
+                      trace once). Pinned env-keyed in the `comm` section
+                      of CONTRACTS.json; drift fails with a per-primitive
+                      diff (`tools/lint.py --update` after an intended
+                      schedule change).
+  resharding ban      `all_gather`/`all_to_all`/`reduce_scatter` never
+                      appear: every production chunk is a manual
+                      shard_map program whose only data motion is the
+                      explicit ppermute exchange — a resharding
+                      collective means sharding propagation re-laid data
+                      out behind the schedule's back.
+  halo traffic bytes  per-step ppermute payload bytes, derived from the
+                      collective operands' shapes/dtypes. Baseline-pinned
+                      (byte-volume drift is the "one fat message became
+                      three thin ones" regression), and cross-checked
+                      against the solver's own static accounting:
+  telemetry cross-check  the PR 3 `halo` telemetry record
+                      (`solver._halo_record()`, priced by
+                      `parallel/comm.halo_exchange_bytes`) must agree
+                      with the trace — its byte totals must equal the
+                      strip geometry `comm.halo_strip_shapes` implies,
+                      and the trace must actually contain the declared
+                      step-level exchange messages (exact count for the
+                      fused deep exchange; at-least for the depth-1
+                      class, whose strip shape the staggered shifts
+                      share). The record and this pass both lean on the
+                      ONE helper in `parallel/comm.py`, so the two byte
+                      accountings cannot diverge silently.
+
+Single-device configs are checked too: their contract is zero collectives
+(a collective in a single-device chunk means a mesh axis leaked into the
+trace).
+"""
+
+from __future__ import annotations
+
+from .astlint import Violation
+from .jaxprcheck import _anchor, iter_eqns
+
+RULE_COUNT = "comm-collective"
+RULE_BYTES = "comm-bytes"
+RULE_RESHARD = "comm-reshard"
+RULE_XCHECK = "comm-telemetry"
+
+# the census vocabulary: every cross-shard primitive a chunk could carry
+COLLECTIVES = ("ppermute", "psum", "pmax", "pmin", "all_gather",
+               "all_to_all", "reduce_scatter")
+# manual shard_map chunks may permute and reduce; re-LAYOUT collectives
+# only appear when sharding propagation re-distributes behind the
+# explicit schedule — banned outright, not baseline-pinned
+RESHARDING = ("all_gather", "all_to_all", "reduce_scatter")
+
+
+def strip_key(shape, dtype) -> str:
+    """Census key of one ppermute message: '4x16:float64'."""
+    return "x".join(str(int(s)) for s in shape) + f":{dtype}"
+
+
+def census(jaxpr) -> dict:
+    """The collective content of a traced program: per-primitive counts,
+    the ppermute message multiset (shape×dtype -> occurrences), and the
+    total ppermute payload bytes per shard."""
+    import numpy as np
+
+    counts = {name: 0 for name in COLLECTIVES}
+    strips: dict[str, int] = {}
+    total = 0
+    for e in iter_eqns(jaxpr):
+        name = e.primitive.name
+        if name not in counts:
+            continue
+        counts[name] += 1
+        if name == "ppermute":
+            aval = e.invars[0].aval
+            key = strip_key(aval.shape, aval.dtype)
+            strips[key] = strips.get(key, 0) + 1
+            total += int(np.prod(aval.shape)) * np.dtype(aval.dtype).itemsize
+    return {"collectives": counts, "ppermute_bytes": total,
+            "strips": strips}
+
+
+def config_entry(traced) -> dict:
+    """The fresh `comm` baseline entry for one traced config."""
+    entry = census(traced.jaxpr.jaxpr)
+    rec = getattr(traced.solver, "_halo_record", None)
+    entry["halo"] = rec() if callable(rec) else None
+    return entry
+
+
+def diff_counts(old: dict, new: dict, kind: str) -> list[str]:
+    """Per-primitive (or per-strip) deltas — the drift diagnostic."""
+    lines = []
+    for name in sorted(set(old) | set(new)):
+        a, b = old.get(name, 0), new.get(name, 0)
+        if a != b:
+            lines.append(f"{kind} {name}: {a} -> {b} ({b - a:+d})")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# the telemetry cross-check
+# ---------------------------------------------------------------------------
+
+def _expected_strips(rec: dict) -> list[tuple[str, int, bool]]:
+    """The step-level exchange messages the solver's `halo` record
+    declares, as (strip key, per-axis count, exact) triples. Axes whose
+    mesh dim is 1 exchange nothing (`_exchange_axis` short-circuits) and
+    are skipped. The deep fused exchange is checked EXACTLY — its strip
+    shape is unique to the deep block, so a duplicated deep exchange
+    cannot hide. The depth-1 class is checked at-least: its strip shape
+    is shared with the staggered shifts and with depth-1 exchanges inside
+    solve/POST plumbing the record deliberately excludes."""
+    from ..parallel.comm import halo_strip_shapes
+
+    import numpy as np
+
+    shard = tuple(rec["shard"])
+    mesh = tuple(rec["mesh"])
+    dtype = np.dtype(rec["dtype"])
+    per_step = rec.get("exchanges_per_step", {})
+    out = []
+    if "deep" in per_step:
+        shapes = halo_strip_shapes(shard, rec["deep_halo"])
+        for ax, shape in enumerate(shapes):
+            if mesh[ax] > 1:
+                out.append((strip_key(shape, dtype),
+                            2 * per_step["deep"], True))
+    if "depth1" in per_step:
+        shapes = halo_strip_shapes(shard, 1)
+        # one staggered shift per axis (F/G/H donor edges) shares the
+        # depth-1 strip shape
+        shifts = per_step.get("shift", 0) // len(shard)
+        for ax, shape in enumerate(shapes):
+            if mesh[ax] > 1:
+                out.append((strip_key(shape, dtype),
+                            2 * per_step["depth1"] + shifts, False))
+    return out
+
+
+def crosscheck_record(rec: dict, entry: dict) -> list[str]:
+    """The PR 3 halo record vs this trace census. Returns diagnostic
+    strings (empty = the two byte accountings agree)."""
+    from ..parallel.comm import halo_exchange_bytes
+
+    import numpy as np
+
+    errs = []
+    shard = tuple(rec["shard"])
+    isz = np.dtype(rec["dtype"]).itemsize
+    # (1) the record's byte totals are exactly what the shared strip
+    # geometry prices — a record hand-computing bytes would drift here
+    want = halo_exchange_bytes(shard, 1, isz)
+    if rec["exchange_bytes_depth1"] != want:
+        errs.append(
+            f"halo record exchange_bytes_depth1={rec['exchange_bytes_depth1']}"
+            f" != comm.halo_exchange_bytes({shard}, 1) = {want}")
+    if "deep_exchange_bytes" in rec:
+        want = halo_exchange_bytes(shard, rec["deep_halo"], isz)
+        if rec["deep_exchange_bytes"] != want:
+            errs.append(
+                f"halo record deep_exchange_bytes={rec['deep_exchange_bytes']}"
+                f" != comm.halo_exchange_bytes({shard}, "
+                f"{rec['deep_halo']}) = {want}")
+    # (2) the trace actually contains the declared step-level messages
+    strips = entry["strips"]
+    for key, count, exact in _expected_strips(rec):
+        have = strips.get(key, 0)
+        if exact and have != count:
+            errs.append(
+                f"deep-exchange strip {key}: trace carries {have} "
+                f"ppermute(s), the halo record declares exactly {count} "
+                "(a duplicated or dropped deep exchange)")
+        elif not exact and have < count:
+            errs.append(
+                f"depth-1 strip {key}: trace carries {have} ppermute(s), "
+                f"the halo record declares at least {count}")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+def check_config(traced, baseline: dict | None,
+                 env_matches: bool) -> tuple[list[Violation], dict]:
+    """Census one traced config, apply the structural rules, and compare
+    against its `comm` baseline entry. Returns (violations, fresh
+    entry)."""
+    cfg = traced.cfg
+    path, line = _anchor(cfg.family)
+    entry = config_entry(traced)
+    counts = entry["collectives"]
+    vs: list[Violation] = []
+
+    def emit(rule, msg):
+        vs.append(Violation(path, line, rule, f"{cfg.name}: {msg}"))
+
+    # resharding collectives are banned on every chunk path
+    resharded = {n: counts[n] for n in RESHARDING if counts[n]}
+    if resharded:
+        emit(RULE_RESHARD,
+             f"chunk contains resharding collectives {resharded} — "
+             "sharding propagation re-laid data out behind the explicit "
+             "exchange schedule")
+    # single-device chunks carry no collectives at all
+    if cfg.dims is None and any(counts.values()):
+        emit(RULE_COUNT,
+             f"single-device chunk contains collectives "
+             f"{ {k: v for k, v in counts.items() if v} } — a mesh axis "
+             "leaked into the trace")
+    # the telemetry cross-check (dist solvers expose _halo_record)
+    if entry["halo"] is not None:
+        for msg in crosscheck_record(entry["halo"], entry):
+            emit(RULE_XCHECK, msg)
+    # baseline comparison — env-gated like the jaxpr hash: collective
+    # schedules follow the solve dispatch, which follows toolchain probes
+    if baseline is not None and env_matches:
+        cdiff = diff_counts(baseline.get("collectives", {}), counts,
+                            "collective")
+        if cdiff:
+            emit(RULE_COUNT,
+                 "collective schedule drifted from the comm baseline: "
+                 + "; ".join(cdiff)
+                 + " (tools/lint.py --update if intended)")
+        if baseline.get("ppermute_bytes") != entry["ppermute_bytes"]:
+            sdiff = diff_counts(baseline.get("strips", {}),
+                                entry["strips"], "strip")
+            emit(RULE_BYTES,
+                 f"per-step halo traffic drifted: "
+                 f"{baseline.get('ppermute_bytes')} -> "
+                 f"{entry['ppermute_bytes']} bytes/shard ("
+                 + ("; ".join(sdiff) if sdiff else "same strips, other "
+                    "dtype/shape change")
+                 + ") (tools/lint.py --update if intended)")
+        elif baseline.get("strips") != entry["strips"]:
+            # byte-neutral reshuffle (e.g. one fat message split into
+            # equal thin ones) still drifts the schedule
+            sdiff = diff_counts(baseline.get("strips", {}),
+                                entry["strips"], "strip")
+            emit(RULE_BYTES,
+                 "halo message geometry drifted at equal byte volume: "
+                 + "; ".join(sdiff)
+                 + " (tools/lint.py --update if intended)")
+    return vs, entry
+
+
+def run(baseline: dict | None = None, configs=None, update: bool = False,
+        traced=None, env_matches: bool = True) -> tuple[list, dict]:
+    """Check every config of the matrix. `baseline` is the `comm` section
+    of CONTRACTS.json ({config name: entry}); returns (violations, fresh
+    comm section). `traced` (jaxprcheck.trace_matrix) shares solver
+    builds across passes."""
+    from . import jaxprcheck
+
+    if traced is None:
+        traced = jaxprcheck.trace_matrix(configs)
+    vs: list[Violation] = []
+    fresh: dict[str, dict] = {}
+    for t in traced:
+        entry = (baseline or {}).get(t.cfg.name)
+        if entry is None and baseline is not None and not update:
+            vs.append(Violation(
+                "CONTRACTS.json", 1, RULE_COUNT,
+                f"{t.cfg.name}: no comm baseline entry "
+                "(tools/lint.py --update)"))
+        t_vs, fresh_entry = check_config(
+            t, None if update else entry, env_matches)
+        vs += t_vs
+        fresh[t.cfg.name] = fresh_entry
+    return vs, fresh

@@ -424,6 +424,24 @@ def standard_entries() -> list:
     ]
 
 
+def pre_chain_footprint(seed: int = 0) -> int:
+    """The MEASURED access footprint of the fused PRE chains (max over
+    the registry's PRE entries and inputs) — as opposed to the DECLARED
+    `FUSE_CHAIN` budget. Currently 2 of the declared 3: the chain budget
+    charges each stage ≤1 conservatively but no composed read path
+    consumes all three layers (see `_pre2d_entry`). A future perf pass
+    tempted by `FUSE_DEEP_HALO = 3` (ROADMAP carried-forward) must
+    re-derive through THIS function rather than trusting the
+    declaration; tests/test_analysis.py pins the current value so the
+    slack can only shrink loudly."""
+    depth = 0
+    for entry in standard_entries():
+        if ".PRE" not in entry.name:
+            continue
+        depth = max(depth, max(measure(entry, seed=seed).values()))
+    return depth
+
+
 def check_all(entries=None, seed: int = 0) -> list[Violation]:
     vs: list[Violation] = []
     for entry in (standard_entries() if entries is None else entries):

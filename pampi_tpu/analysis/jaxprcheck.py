@@ -356,6 +356,39 @@ def expected_launches(cfg: ChunkConfig, decisions: dict):
 
 
 # ---------------------------------------------------------------------------
+# the shared trace matrix
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TracedConfig:
+    """One built-and-traced config of the matrix: the solver, its chunk
+    ClosedJaxpr, and the dispatch decisions recorded DURING the build
+    (dispatch.last is a last-write register, so they must be captured
+    before the next config builds). The jaxpr, comm and pallas passes all
+    analyze this one object — tracing the matrix once per lint run, not
+    once per pass."""
+
+    cfg: ChunkConfig
+    solver: object
+    jaxpr: object
+    decisions: dict
+
+
+def trace_config(cfg: ChunkConfig) -> TracedConfig:
+    from ..utils import dispatch
+
+    solver = cfg.build()
+    jx = trace_chunk(solver)
+    return TracedConfig(
+        cfg, solver, jx, {k: dispatch.last(k) for k in cfg.dispatch_keys})
+
+
+def trace_matrix(configs=None) -> list[TracedConfig]:
+    return [trace_config(cfg)
+            for cfg in (standard_configs() if configs is None else configs)]
+
+
+# ---------------------------------------------------------------------------
 # the checks
 # ---------------------------------------------------------------------------
 
@@ -395,17 +428,17 @@ def _forbidden_floats(solver, jaxpr) -> set[str]:
 
 
 def check_config(cfg: ChunkConfig, baseline: dict | None,
-                 env_matches: bool) -> tuple[list[Violation], dict]:
-    """Build + trace one config, check the live contracts, and compare
-    against its baseline entry (hash only when the environment matches).
-    Returns (violations, fresh baseline entry)."""
-    from ..utils import dispatch
-
+                 env_matches: bool,
+                 traced: TracedConfig | None = None) -> tuple[list, dict]:
+    """Build + trace one config (or reuse a `trace_matrix` entry), check
+    the live contracts, and compare against its baseline entry (hash only
+    when the environment matches). Returns (violations, fresh baseline
+    entry)."""
     path, line = _anchor(cfg.family)
-    solver = cfg.build()
-    jx = trace_chunk(solver)
+    if traced is None:
+        traced = trace_config(cfg)
+    solver, jx, decisions = traced.solver, traced.jaxpr, traced.decisions
     sig = chunk_signature(solver, jx)
-    decisions = {k: dispatch.last(k) for k in cfg.dispatch_keys}
     entry = {
         "hash": sig["hash"],
         "outvars": sig["outvars"],
@@ -476,11 +509,16 @@ def check_config(cfg: ChunkConfig, baseline: dict | None,
 
 
 def run(baseline: dict | None = None, configs=None,
-        update: bool = False) -> tuple[list[Violation], dict]:
+        update: bool = False, traced=None) -> tuple[list[Violation], dict]:
     """Check every config. Returns (violations, fresh baseline dict) —
     the driver writes the latter on --update. A missing baseline (or a
-    missing config entry) is only an error when not updating."""
+    missing config entry) is only an error when not updating. `traced`
+    (a `trace_matrix` result) short-circuits the per-config builds so
+    several passes can share one matrix."""
+    if traced is not None:
+        configs = [t.cfg for t in traced]
     configs = standard_configs() if configs is None else configs
+    by_name = {t.cfg.name: t for t in traced} if traced else {}
     env = environment()
     base_env = (baseline or {}).get("env")
     env_matches = base_env == env
@@ -501,7 +539,8 @@ def run(baseline: dict | None = None, configs=None,
                 "CONTRACTS.json", 1, RULE_HASH,
                 f"{cfg.name}: no baseline entry (tools/lint.py --update)"))
         cfg_vs, fresh_entry = check_config(
-            cfg, None if update else entry, env_matches)
+            cfg, None if update else entry, env_matches,
+            traced=by_name.get(cfg.name))
         vs += cfg_vs
         fresh["configs"][cfg.name] = fresh_entry
     return vs, fresh

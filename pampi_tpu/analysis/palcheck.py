@@ -1,0 +1,431 @@
+"""Pallas kernel-resource checker: would this `pallas_call` compile and
+fit on a TPU core?
+
+The bug class this guards: a VMEM-overflowing scratch buffer, a mistiled
+block, or an out-of-bounds index map in a Pallas kernel fails only at
+Mosaic compile time ON A TPU — which this container does not have. Every
+such failure found during the on-chip campaign so far (the tblock
+feasibility guard, the quarters VMEM fallback, the 128-lane padding
+convention) is statically decidable from the traced program, so this pass
+decides them at lint time, on CPU, over the same `jaxprcheck`
+trace matrix the launch-count contract uses plus standalone large-grid
+kernel builds (`extra_entries`) where the grids are big enough to
+actually partition into blocks.
+
+Per `pallas_call` eqn (all data read off `grid_mapping` — block shapes,
+index maps, memory spaces — and the kernel jaxpr's scratch operands):
+
+  tiling       blocks that PARTITION an array dimension (block extent <
+               array extent) must be multiples of the dtype tile
+               granularity in the last two dims — lane 128 always,
+               sublane 8/16/32 by itemsize (f32 (8,128), bf16 (16,128),
+               int8 (32,128)). Full-extent blocks are exempt: Mosaic
+               pads a whole-array window, but a misaligned PARTITIONED
+               block re-tiles every grid step.
+  vmem budget  static per-launch footprint: block windows bound to VMEM
+               (double-buffered when the grid pipelines, i.e. >1 step)
+               plus VMEM scratch, against the kernel's own declared
+               `vmem_limit_bytes` (falling back to the repo-wide
+               `ops/sor_pallas.VMEM_LIMIT_BYTES`). `pl.ANY` operands
+               live in HBM and are charged nothing — their windows enter
+               via the explicit scratch buffers the kernel DMAs into.
+  index bounds grid × index map must stay in-bounds of each operand:
+               every grid point's block start (Blocked semantics:
+               index × block shape) must land inside the array (the
+               final block may overhang — Mosaic masks it). Index maps
+               are evaluated concretely per grid point; maps that read
+               scalar-prefetch operands with nontrivial arithmetic are
+               reported unevaluable rather than guessed at.
+  aliasing     `input_output_aliases` pairs must window the SAME
+               geometry (equal array shape/dtype, block shape, index
+               map), and a donated input buffer must not also be read
+               through another operand of the same call — the classic
+               use-after-donation hazard.
+
+Diagnostics carry the kernel's own file:line (from the pallas_call's
+`name_and_src_info`), so a violation points at the kernel source, not at
+the solver that dispatched it.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from .astlint import Violation
+from .jaxprcheck import iter_eqns
+
+RULE_TILE = "pallas-tile"
+RULE_VMEM = "pallas-vmem"
+RULE_OOB = "pallas-index-oob"
+RULE_ALIAS = "pallas-alias"
+
+# enumerate the full grid up to this many points; beyond it, check the
+# corner/edge sample (first/middle/last per dim) — index maps are affine
+# in practice, so extremes catch sign/offset errors
+GRID_ENUM_LIMIT = 4096
+
+_SRC_RE = re.compile(r"at (.+?):(\d+)")
+
+
+def min_tile(dtype) -> tuple[int, int]:
+    """TPU native tile granularity (sublane, lane) by dtype width: f32
+    (8, 128); second-to-last dim doubles as the dtype narrows."""
+    import numpy as np
+
+    itemsize = np.dtype(dtype).itemsize
+    return {2: 16, 1: 32}.get(itemsize, 8), 128
+
+
+def block_extents(bm) -> tuple[int, ...]:
+    """`block_shape` as plain element extents: squeezed dims (spelled
+    `None` in the BlockSpec, a `Mapped` sentinel in the jaxpr param) are
+    extent 1 — one element per grid step along that dim."""
+    import numpy as np
+
+    return tuple(int(s) if isinstance(s, (int, np.integer)) else 1
+                 for s in bm.block_shape)
+
+
+def _mspace(aval) -> str:
+    """Normalized memory-space tag of a MemRef aval: 'vmem' (the default
+    when unannotated), 'smem', 'any', 'semaphore_mem'."""
+    ms = getattr(aval, "memory_space", None)
+    if ms is None:
+        return "vmem"
+    return getattr(ms, "value", str(ms))
+
+
+@dataclass
+class Launch:
+    """One pallas_call eqn, decoded for checking."""
+
+    name: str
+    path: str
+    line: int
+    grid: tuple
+    in_mappings: list
+    out_mappings: list
+    scratch_avals: list
+    aliases: tuple
+    vmem_limit: int | None
+    num_index_operands: int
+    eqn: object
+
+    @property
+    def mappings(self):
+        return self.in_mappings + self.out_mappings
+
+
+def decode(eqn) -> Launch:
+    gm = eqn.params["grid_mapping"]
+    nsi = eqn.params["name_and_src_info"]
+    m = _SRC_RE.search(getattr(nsi, "src_info", "") or "")
+    path, line = (m.group(1), int(m.group(2))) if m else ("<unknown>", 1)
+    kernel_jaxpr = eqn.params["jaxpr"]
+    nscratch = gm.num_scratch_operands
+    scratch = [v.aval for v in kernel_jaxpr.invars[len(kernel_jaxpr.invars)
+                                                   - nscratch:]] \
+        if nscratch else []
+    mosaic = (eqn.params.get("compiler_params") or {}).get("mosaic", {})
+    return Launch(
+        name=nsi.name,
+        path=path,
+        line=line,
+        grid=tuple(gm.grid),
+        in_mappings=list(gm.block_mappings[:gm.num_inputs]),
+        out_mappings=list(
+            gm.block_mappings[gm.num_inputs:gm.num_inputs + gm.num_outputs]),
+        scratch_avals=scratch,
+        aliases=tuple(eqn.params.get("input_output_aliases") or ()),
+        vmem_limit=mosaic.get("vmem_limit_bytes"),
+        num_index_operands=gm.num_index_operands,
+        eqn=eqn,
+    )
+
+
+def launches(jaxpr) -> list[Launch]:
+    """Every pallas_call anywhere in the program (while/cond/pjit bodies
+    included)."""
+    return [decode(e) for e in iter_eqns(jaxpr)
+            if e.primitive.name == "pallas_call"]
+
+
+# ---------------------------------------------------------------------------
+# index-map evaluation
+# ---------------------------------------------------------------------------
+
+def eval_index_map(closed, grid_idx: tuple) -> tuple | None:
+    """Concrete block indices for one grid point, or None when the map
+    depends on a scalar-prefetch operand through real arithmetic (then
+    the coverage check abstains instead of guessing)."""
+    import jax
+    import jax.core
+
+    jaxpr = closed.jaxpr
+    n = len(grid_idx)
+    if not jaxpr.eqns:
+        env = dict(zip(jaxpr.invars[:n], grid_idx))
+        out = []
+        for v in jaxpr.outvars:
+            if isinstance(v, jax.core.Literal):
+                out.append(int(v.val))
+            elif v in env:
+                out.append(int(env[v]))
+            else:
+                return None
+        return tuple(out)
+    if len(jaxpr.invars) == n and all(
+            getattr(v.aval, "shape", None) == () for v in jaxpr.invars):
+        import numpy as np
+
+        args = [np.asarray(i, dtype=v.aval.dtype)
+                for v, i in zip(jaxpr.invars, grid_idx)]
+        vals = jax.core.eval_jaxpr(jaxpr, closed.consts, *args)
+        return tuple(int(v) for v in vals)
+    return None
+
+
+def grid_points(grid: tuple):
+    """Every grid point when the grid is small; the first/middle/last
+    corner sample otherwise."""
+    import itertools
+
+    total = 1
+    for g in grid:
+        total *= g
+    if total <= GRID_ENUM_LIMIT:
+        yield from itertools.product(*(range(g) for g in grid))
+        return
+    axes = [sorted({0, g // 2, g - 1}) for g in grid]
+    yield from itertools.product(*axes)
+
+
+# ---------------------------------------------------------------------------
+# the checks
+# ---------------------------------------------------------------------------
+
+def vmem_estimate(launch: Launch) -> int:
+    """Static per-launch VMEM bytes: VMEM-bound block windows (×2 when
+    the grid pipelines — Mosaic double-buffers the automatic windows)
+    plus VMEM scratch."""
+    import numpy as np
+
+    pipelined = 1
+    for g in launch.grid:
+        pipelined *= g
+    buf = 2 if pipelined > 1 else 1
+    total = 0
+    for bm in launch.mappings:
+        aval = bm.transformed_block_aval
+        if _mspace(aval) != "vmem":
+            continue
+        n = 1
+        for s in block_extents(bm):
+            n *= s
+        total += buf * n * np.dtype(aval.dtype).itemsize
+    for aval in launch.scratch_avals:
+        if _mspace(aval) != "vmem":
+            continue
+        n = 1
+        for s in aval.shape:
+            n *= int(s)
+        total += n * np.dtype(aval.dtype).itemsize
+    return total
+
+
+def check_launch(launch: Launch, budget: int | None = None,
+                 context: str = "") -> list[Violation]:
+    """All four rules over one decoded pallas_call."""
+    vs: list[Violation] = []
+    where = f"{context}{launch.name}"
+
+    def emit(rule, msg):
+        vs.append(Violation(launch.path, launch.line, rule,
+                            f"{where}: {msg}"))
+
+    # --- tiling ---------------------------------------------------------
+    for bm in launch.mappings:
+        aval = bm.transformed_block_aval
+        if _mspace(aval) not in ("vmem",):
+            continue
+        array = bm.array_shape_dtype.shape
+        block = block_extents(bm)
+        if len(block) < 2 or len(block) != len(array):
+            continue
+        # squeezed dims (extent 1 by iteration, not by windowing) are
+        # the programmer's explicit layout choice — not a tiling bug
+        squeezed = {d for d, s in enumerate(bm.block_shape)
+                    if block[d] != s}
+        sub, lane = min_tile(aval.dtype)
+        for dim, need in ((len(block) - 1, lane), (len(block) - 2, sub)):
+            if dim in squeezed:
+                continue
+            if block[dim] < array[dim] and block[dim] % need:
+                emit(RULE_TILE,
+                     f"operand {bm.origin}: block {block} partitions a "
+                     f"{array} {aval.dtype} array but dim {dim} extent "
+                     f"{block[dim]} is not a multiple of the tile "
+                     f"granularity {need} — Mosaic re-tiles every grid "
+                     "step (or refuses the layout)")
+    # --- vmem budget ----------------------------------------------------
+    est = vmem_estimate(launch)
+    limit = budget if budget is not None else launch.vmem_limit
+    if limit is None:
+        from ..ops.sor_pallas import VMEM_LIMIT_BYTES
+
+        limit = VMEM_LIMIT_BYTES
+    if est > limit:
+        emit(RULE_VMEM,
+             f"static VMEM footprint {est} bytes ({est >> 20} MiB) "
+             f"exceeds the budget {limit} bytes — blocks "
+             f"{[block_extents(bm) for bm in launch.mappings if _mspace(bm.transformed_block_aval) == 'vmem']}, "
+             f"scratch {[tuple(a.shape) for a in launch.scratch_avals if _mspace(a) == 'vmem']}"
+             )
+    # --- grid × index-map coverage --------------------------------------
+    for bm in launch.mappings:
+        array = bm.array_shape_dtype.shape
+        block = block_extents(bm)
+        if len(block) != len(array):
+            continue
+        for point in grid_points(launch.grid):
+            idx = eval_index_map(bm.index_map_jaxpr, point)
+            if idx is None:
+                break  # unevaluable map: abstain for this operand
+            if len(idx) != len(block):
+                break
+            for d, (i, b, a) in enumerate(zip(idx, block, array)):
+                start = i * b
+                if start < 0 or start >= a:
+                    emit(RULE_OOB,
+                         f"operand {bm.origin}: grid point {point} maps "
+                         f"to block index {idx} — dim {d} starts at "
+                         f"element {start}, outside the array extent "
+                         f"{a} (stale/garbage window every launch)")
+                    break
+            else:
+                continue
+            break
+    # --- aliasing -------------------------------------------------------
+    seen_in, seen_out = set(), set()
+    for i, o in launch.aliases:
+        if i in seen_in or o in seen_out:
+            emit(RULE_ALIAS,
+                 f"alias ({i} -> {o}) re-donates an operand already "
+                 "aliased — double donation")
+        seen_in.add(i)
+        seen_out.add(o)
+        if i >= len(launch.in_mappings) or o >= len(launch.out_mappings):
+            emit(RULE_ALIAS, f"alias ({i} -> {o}) out of operand range")
+            continue
+        bi, bo = launch.in_mappings[i], launch.out_mappings[o]
+        same = (
+            bi.array_shape_dtype.shape == bo.array_shape_dtype.shape
+            and bi.array_shape_dtype.dtype == bo.array_shape_dtype.dtype
+            and tuple(bi.block_shape) == tuple(bo.block_shape)
+            and str(bi.index_map_jaxpr) == str(bo.index_map_jaxpr)
+        )
+        if not same:
+            how = ("index maps differ"
+                   if tuple(bi.block_shape) == tuple(bo.block_shape)
+                   and bi.array_shape_dtype == bo.array_shape_dtype
+                   else f"input block {tuple(bi.block_shape)} of "
+                        f"{bi.array_shape_dtype.shape} vs output block "
+                        f"{tuple(bo.block_shape)} of "
+                        f"{bo.array_shape_dtype.shape}")
+            emit(RULE_ALIAS,
+                 f"alias ({i} -> {o}) windows differ ({how}) — the "
+                 "donated buffer is rewritten through a different window "
+                 "than it is read")
+        # a donated input read through a SECOND operand of the same call
+        invars = list(launch.eqn.invars)
+        opvars = invars[launch.num_index_operands:]
+        if i < len(opvars):
+            donated = opvars[i]
+            dups = [k for k, v in enumerate(opvars)
+                    if v is donated and k != i]
+            if dups:
+                emit(RULE_ALIAS,
+                     f"donated input #{i} is also read through operand(s) "
+                     f"{dups} of the same call — use-after-donation")
+    return vs
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+def extra_entries() -> list:
+    """Standalone large-grid kernel builds: the production solve kernels
+    at extents big enough that the grid actually partitions (the matrix
+    configs trace at 16²/8³ where every launch collapses to one
+    full-array block). Trace-only — nothing executes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import sor_pallas as sp
+
+    out = []
+    n = 512
+    rb, br = sp.make_rb_iter_pallas(n, n, 1.0 / n, 1.0 / n, 1.7,
+                                    jnp.float32, interpret=True)
+    if rb is not None:
+        p = jnp.zeros((sp.padded_rows(n, br, jnp.float32),
+                       sp.padded_width(n)), jnp.float32)
+        out.append(("sor_pallas.rb_iter[512²]", jax.make_jaxpr(rb)(p, p)))
+    rb_t, br_t, h = sp.make_rb_iter_tblock(n, n, 1.0 / n, 1.0 / n, 1.7,
+                                           jnp.float32, n_inner=4,
+                                           interpret=True)
+    if rb_t is not None:
+        nblocks = -(-(n + 2) // br_t)
+        pt = jnp.zeros((nblocks * br_t + 2 * h, sp.padded_width(n)),
+                       jnp.float32)
+        out.append(("sor_pallas.rb_iter_tblock[512²]",
+                    jax.make_jaxpr(rb_t)(pt, pt)))
+    rb_q, brq, hq = sp.make_rb_iter_tblock_quarters(
+        n, n, 1.0 / n, 1.0 / n, 1.7, jnp.float32, n_inner=2,
+        interpret=True)
+    if rb_q is not None:
+        pq = sp.pad_quarters(jnp.zeros((n + 2, n + 2), jnp.float32),
+                             brq, hq)
+        out.append(("sor_pallas.rb_iter_tblock_quarters[512²]",
+                    jax.make_jaxpr(rb_q)(pq, pq)))
+    from ..ops import sor3d_pallas as sp3
+
+    m = 64
+    rb_3, bk = sp3.make_rb_iter_tblock_3d(
+        m, m, m, 1.0 / m, 1.0 / m, 1.0 / m, 1.7, jnp.float32,
+        n_inner=1, interpret=True)
+    if rb_3 is not None:
+        p3 = sp3.pad_array_3d(jnp.zeros((m + 2, m + 2, m + 2),
+                                        jnp.float32), bk, 1)
+        out.append(("sor3d_pallas.rb_iter_tblock_3d[64³]",
+                    jax.make_jaxpr(rb_3)(p3, p3)))
+    return out
+
+
+def check_jaxpr(jaxpr, budget: int | None = None,
+                context: str = "") -> list[Violation]:
+    vs: list[Violation] = []
+    for launch in launches(jaxpr):
+        vs += check_launch(launch, budget=budget, context=context)
+    return vs
+
+
+def run(traced=None, configs=None, budget: int | None = None,
+        extras: bool = True) -> list[Violation]:
+    """Check every pallas_call of the trace matrix plus the standalone
+    large-grid builds. Stateless (no baseline): every rule is decidable
+    from the program alone."""
+    from . import jaxprcheck
+
+    if traced is None:
+        traced = jaxprcheck.trace_matrix(configs)
+    vs: list[Violation] = []
+    for t in traced:
+        vs += check_jaxpr(t.jaxpr.jaxpr, budget=budget,
+                          context=f"{t.cfg.name}/")
+    if extras:
+        for name, jx in extra_entries():
+            vs += check_jaxpr(jx.jaxpr, budget=budget, context=f"{name}/")
+    return vs

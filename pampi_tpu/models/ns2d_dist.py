@@ -45,6 +45,7 @@ from ..parallel.comm import (
     CartComm,
     get_offsets,
     halo_exchange,
+    halo_exchange_bytes,
     halo_shift,
     reduction,
 )
@@ -794,34 +795,45 @@ class NS2DDistSolver:
                  grid=[self.jmax, self.imax], mesh=list(comm.dims),
                  trace_wall_s=round(time.perf_counter() - self._t0_build, 3),
                  phases=_dispatch.last("ns2d_dist_phases"))
+        # static per-shard halo-exchange byte counts (the step-level
+        # exchanges of the path actually dispatched; the pressure
+        # solve's internal exchanges depend on CA depth/iteration count
+        # and are excluded). Built unconditionally: the telemetry `halo`
+        # record and the commcheck trace census (analysis/commcheck.py)
+        # read the SAME dict, both priced by comm.halo_exchange_bytes.
+        isz = jnp.dtype(dtype).itemsize
+        rec = {
+            "family": "ns2d_dist", "mesh": list(comm.dims),
+            "shard": [jl, il], "dtype": str(jnp.dtype(dtype)),
+            "path": "fused" if fused_k is not None else "jnp",
+            "exchange_bytes_depth1":
+                halo_exchange_bytes((jl, il), 1, isz),
+        }
+        if fused_k is not None:
+            rec.update(
+                deep_halo=FUSE_DEEP_HALO,
+                deep_exchange_bytes=halo_exchange_bytes(
+                    (jl, il), FUSE_DEEP_HALO, isz),
+                exchanges_per_step={"deep": 2},
+            )
+        else:
+            rec.update(exchanges_per_step={
+                "depth1": 4 + (2 if gmasks is not None else 0),
+                "shift": 2,
+            })
+        self._halo_rec = rec
         if _tm.enabled():
-            # static per-shard halo-exchange byte counts (the step-level
-            # exchanges of the path actually dispatched; the pressure
-            # solve's internal exchanges depend on CA depth/iteration count
-            # and are excluded — see utils/telemetry.py)
-            isz = jnp.dtype(dtype).itemsize
-            rec = {
-                "family": "ns2d_dist", "mesh": list(comm.dims),
-                "shard": [jl, il], "dtype": str(jnp.dtype(dtype)),
-                "path": "fused" if fused_k is not None else "jnp",
-                "exchange_bytes_depth1":
-                    _tm.halo_exchange_bytes((jl, il), 1, isz),
-            }
-            if fused_k is not None:
-                rec.update(
-                    deep_halo=FUSE_DEEP_HALO,
-                    deep_exchange_bytes=_tm.halo_exchange_bytes(
-                        (jl, il), FUSE_DEEP_HALO, isz),
-                    exchanges_per_step={"deep": 2},
-                )
-            else:
-                rec.update(exchanges_per_step={
-                    "depth1": 4 + (2 if gmasks is not None else 0),
-                    "shift": 2,
-                })
             _tm.emit("halo", **rec)
 
     # ------------------------------------------------------------------
+    def _halo_record(self) -> dict:
+        """The static halo-exchange accounting of the path this build
+        dispatched — the dict the telemetry `halo` record emits, exposed
+        so analysis/commcheck.py can cross-check it against the traced
+        collective census without arming PAMPI_TELEMETRY (which would
+        change the traced program)."""
+        return dict(self._halo_rec)
+
     def _rebuild_chunk(self):
         """Rebuild every traced kernel against the solver's CURRENT
         attributes (recovery dt clamp) — the rollback-recovery rebuild hook

@@ -35,6 +35,7 @@ from ..parallel.comm import (
     master_print,
     CartComm,
     halo_exchange,
+    halo_exchange_bytes,
     halo_shift,
     reduction,
 )
@@ -728,32 +729,42 @@ class NS3DDistSolver:
                  grid=[g.kmax, g.jmax, g.imax], mesh=list(comm.dims),
                  trace_wall_s=round(time.perf_counter() - self._t0_build, 3),
                  phases=_dispatch.last("ns3d_dist_phases"))
+        # static per-shard halo-exchange byte counts (step-level
+        # exchanges of the dispatched path; solve internals excluded).
+        # Built unconditionally: the telemetry `halo` record and the
+        # commcheck trace census read the SAME dict, both priced by
+        # comm.halo_exchange_bytes (see models/ns2d_dist._halo_record).
+        isz = jnp.dtype(dtype).itemsize
+        rec = {
+            "family": "ns3d_dist", "mesh": list(comm.dims),
+            "shard": [kl, jl, il], "dtype": str(jnp.dtype(dtype)),
+            "path": "fused" if fused_k is not None else "jnp",
+            "exchange_bytes_depth1":
+                halo_exchange_bytes((kl, jl, il), 1, isz),
+        }
+        if fused_k is not None:
+            rec.update(
+                deep_halo=FUSE_DEEP_HALO,
+                deep_exchange_bytes=halo_exchange_bytes(
+                    (kl, jl, il), FUSE_DEEP_HALO, isz),
+                exchanges_per_step={"deep": 3},
+            )
+        else:
+            rec.update(exchanges_per_step={
+                "depth1": 6 + (3 if gmasks is not None else 0),
+                "shift": 3,
+            })
+        self._halo_rec = rec
         if _tm.enabled():
-            # static per-shard halo-exchange byte counts (step-level
-            # exchanges of the dispatched path; solve internals excluded)
-            isz = jnp.dtype(dtype).itemsize
-            rec = {
-                "family": "ns3d_dist", "mesh": list(comm.dims),
-                "shard": [kl, jl, il], "dtype": str(jnp.dtype(dtype)),
-                "path": "fused" if fused_k is not None else "jnp",
-                "exchange_bytes_depth1":
-                    _tm.halo_exchange_bytes((kl, jl, il), 1, isz),
-            }
-            if fused_k is not None:
-                rec.update(
-                    deep_halo=FUSE_DEEP_HALO,
-                    deep_exchange_bytes=_tm.halo_exchange_bytes(
-                        (kl, jl, il), FUSE_DEEP_HALO, isz),
-                    exchanges_per_step={"deep": 3},
-                )
-            else:
-                rec.update(exchanges_per_step={
-                    "depth1": 6 + (3 if gmasks is not None else 0),
-                    "shift": 3,
-                })
             _tm.emit("halo", **rec)
 
     # ------------------------------------------------------------------
+    def _halo_record(self) -> dict:
+        """The static halo-exchange accounting of the dispatched path —
+        see models/ns2d_dist._halo_record (the commcheck cross-check
+        hook)."""
+        return dict(self._halo_rec)
+
     def _rebuild_chunk(self):
         """Rebuild every traced kernel against the solver's CURRENT
         attributes (recovery dt clamp) — the rollback-recovery rebuild hook

@@ -364,6 +364,38 @@ def halo_exchange(x, comm: CartComm, periodic=(), depth: int = 1):
     return x
 
 
+def halo_strip_shapes(extents, depth: int = 1) -> list[tuple[int, ...]]:
+    """Per-axis ppermute message shapes of ONE full `halo_exchange` over an
+    extended block with the given OWNED extents: along each exchanged axis
+    the two travelling strips are `depth` ghost layers wide and span the
+    full EXTENDED extent of every other axis (ghost corners included —
+    that is what makes the axis-by-axis exchange corner-consistent). This
+    is the one statement of the exchange's message geometry: the byte
+    accounting below, the PR 3 telemetry records, and the commcheck trace
+    census (analysis/commcheck.py) all derive from it, so the accountings
+    cannot diverge."""
+    ext = [e + 2 * depth for e in extents]
+    return [
+        tuple(depth if a == ax else ext[a] for a in range(len(ext)))
+        for ax in range(len(extents))
+    ]
+
+
+def halo_exchange_bytes(extents, depth: int, itemsize: int) -> int:
+    """Static per-shard bytes one full `halo_exchange` moves: two strips
+    (one per direction) of every `halo_strip_shapes` message. THE shared
+    byte accounting — solver-__init__ telemetry `halo` records
+    (models/ns*_dist.py) and the commcheck contract pass both call this
+    helper rather than re-deriving."""
+    total = 0
+    for shape in halo_strip_shapes(extents, depth):
+        n = 1
+        for s in shape:
+            n *= s
+        total += 2 * n
+    return total * itemsize
+
+
 def halo_shift(x, comm: CartComm, axis: str):
     """commShift (comm.c:196-244): one-directional staggered exchange — fill
     the LOW ghost strip along `axis` from the minus-neighbour's high interior

@@ -65,6 +65,39 @@ def init_from_env() -> tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 
+def multiprocess_capable() -> tuple[bool, str]:
+    """Can THIS jax build run cross-process collectives on the current
+    backend? Backend DETECTION, not a blanket environment guess: TPU/GPU
+    runtimes always can; the CPU backend can only when its collectives
+    implementation (gloo) is compiled into the jaxlib — absent it, every
+    cross-process ppermute dies with "Multiprocess computations aren't
+    implemented on the CPU backend". Returns (capable, reason-if-not).
+    tests/test_multihost.py gates on this (ROADMAP item 4 names that
+    suite the acceptance gate on real hardware, so it must SKIP with
+    this reason on incapable containers, not fail)."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "cpu":
+        return True, ""
+    try:
+        from jax._src.lib import xla_client
+
+        collectives = getattr(xla_client._xla, "collectives", None)
+    except (ImportError, AttributeError):
+        collectives = None
+    if collectives is not None and hasattr(
+            collectives, "make_gloo_tcp_collectives"):
+        return True, ""
+    return False, (
+        "cpu backend without a cross-process collectives implementation "
+        "(this jaxlib ships no gloo: xla_client._xla.collectives is "
+        "unavailable) — multi-process launches would fail with "
+        "'Multiprocess computations aren't implemented on the CPU "
+        "backend'"
+    )
+
+
 def is_master() -> bool:
     """commIsMaster (comm.h:138) at process granularity."""
     import jax

@@ -1,16 +1,25 @@
-"""Tracecheck (pampi_tpu/analysis/ + tools/lint.py) — ISSUE 5 acceptance:
+"""Tracecheck (pampi_tpu/analysis/ + tools/lint.py) — ISSUE 5/6 acceptance:
 
 - AST LINT: the tree is clean; every rule fires on a seeded violation
   with a file:line diagnostic; `# lint: allow(<rule>)` escapes it.
 - HALO FOOTPRINTS: the production registry passes and the CA entries are
   TIGHT (measured == declared, so the probe is sharp, not vacuous); the
   two mutation classes — a seeded under-halo declaration and an
-  over-wide stencil — are both flagged.
+  over-wide stencil — are both flagged; the FUSE_CHAIN slack is pinned.
 - JAXPR CONTRACTS: a config subset round-trips through the baseline
   (update -> check clean -> update again byte-stable); seeded
   launch-count drift and hash drift are flagged with primitive-count
   diffs; the committed CONTRACTS.json matches the harness environment
   and the current config matrix.
+- COMM CONTRACTS (ISSUE 6): the collective census round-trips
+  byte-stable through the comm baseline; a smuggled extra exchange, a
+  byte-volume drift, and a resharding collective are each flagged with
+  per-primitive diffs; the telemetry halo record cross-check fires on a
+  mis-priced record and on a dropped deep-exchange message.
+- PALLAS RESOURCES (ISSUE 6): the traced matrix + large-grid kernel
+  builds are clean; an over-budget VMEM block, an OOB index map, a
+  mistiled partitioned block, and both aliasing hazards are each
+  flagged with the kernel's file:line.
 
 Compile cost: everything here TRACES (make_jaxpr) or linearizes tiny
 blocks — no jit execution of solver chunks.
@@ -20,10 +29,12 @@ import json
 import os
 import subprocess
 import sys
+import types
 
 import pytest
 
-from pampi_tpu.analysis import astlint, halocheck, jaxprcheck
+from pampi_tpu.analysis import (astlint, commcheck, halocheck, jaxprcheck,
+                                palcheck)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -243,6 +254,24 @@ def test_halo_fused_pre_within_budget():
         assert halocheck.check_entry(e) == [], shard
 
 
+def test_fuse_chain_slack_pinned():
+    """The ROADMAP carried-forward slack, pinned: the MEASURED PRE-chain
+    footprint is 2 of the DECLARED FUSE_CHAIN = 3 — one layer of genuine
+    slack the deep exchange ships unconsumed. A future perf pass wanting
+    `FUSE_DEEP_HALO = 3` re-derives through `halocheck.pre_chain_footprint`
+    instead of trusting the declaration; if the chain ever widens to eat
+    the slack, THIS pin fails before any distributed run corrupts."""
+    from pampi_tpu.ops import ns2d_fused as nf
+
+    measured = halocheck.pre_chain_footprint()
+    assert measured == 2, (
+        "PRE-chain footprint moved — update the ROADMAP slack note and "
+        "re-audit any FUSE_DEEP_HALO consumer")
+    assert nf.FUSE_CHAIN == 3
+    assert nf.FUSE_DEEP_HALO == nf.FUSE_CHAIN + 1
+    assert measured < nf.FUSE_CHAIN  # the slack exists today
+
+
 # ---------------------------------------------------------------------------
 # jaxprcheck
 # ---------------------------------------------------------------------------
@@ -365,12 +394,25 @@ def test_committed_baseline_current():
     assert baseline["env"] == jaxprcheck.environment()
     assert set(baseline["configs"]) == {
         c.name for c in jaxprcheck.standard_configs()}
+    # the comm census covers the SAME matrix (ISSUE 6: the comm baseline
+    # is committed, not optional)
+    assert set(baseline["comm"]) == set(baseline["configs"])
+    for entry in baseline["comm"].values():
+        assert set(entry) >= {"collectives", "ppermute_bytes", "strips",
+                              "halo"}
     # and it passes the shared artifact lint (the one import spelling the
     # other suites use — don't load the module under a second name)
     from tools import check_artifact as ca
 
     assert ca.lint_contracts(baseline) == []
     assert ca.lint_contracts({"version": 1}) != []
+    # a truncated comm section is a lint error, not a silent no-op
+    broken = json.loads(json.dumps(baseline))
+    broken["comm"].popitem()
+    assert any(".comm" in e for e in ca.lint_contracts(broken))
+    broken2 = json.loads(json.dumps(baseline))
+    next(iter(broken2["comm"].values())).pop("ppermute_bytes")
+    assert any("ppermute_bytes" in e for e in ca.lint_contracts(broken2))
 
 
 def test_lint_driver_ast_pass():
@@ -389,3 +431,426 @@ def test_lint_driver_ast_pass():
         capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "[ast] ok" in proc.stdout
+
+
+def test_lint_driver_only_multiselect():
+    """--only takes a comma list (the ISSUE 6 satellite: the overlap
+    refactor's inner loop runs `--only comm` alone; `ast,artifacts` here
+    keeps the test jax-trace-free), runs passes in CANONICAL order
+    regardless of the flag's spelling (artifacts must follow a pending
+    --update flush), and rejects unknown pass names."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--only", "artifacts,ast"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "[ast] ok" in proc.stdout
+    assert "[artifacts] ok" in proc.stdout
+    assert proc.stdout.index("[ast]") < proc.stdout.index("[artifacts]")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--only", "ast,nonsense"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2
+    assert "nonsense" in proc.stderr
+
+
+def test_lint_partial_update_no_mixed_env_baseline(tmp_path, monkeypatch,
+                                                  comm_traced):
+    """A partial `--update` (comm section only) under a CHANGED trace
+    environment must not pair the new `env` key with configs hashes
+    traced under the old one — the driver regenerates the missing
+    section from the shared matrix instead of preserving it."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint as lint_mod
+    finally:
+        sys.path.pop(0)
+
+    _, configs_fresh = jaxprcheck.run(traced=comm_traced, update=True)
+    _, comm_fresh = commcheck.run(traced=comm_traced, update=True)
+    stale = dict(configs_fresh, comm=comm_fresh)
+    stale["env"] = dict(stale["env"], jax="0.0.0")  # another toolchain
+    path = tmp_path / "CONTRACTS.json"
+    path.write_text(json.dumps(stale))
+
+    ctx = lint_mod.TraceContext(str(path), update=True)
+    ctx._traced = comm_traced  # the subset matrix, already built
+    vs = ctx.run_comm()
+    assert vs == []
+    assert ctx.fresh_configs is None  # only the comm pass ran
+    ctx.write()
+    merged = json.loads(path.read_text())
+    assert merged["env"] == jaxprcheck.environment()
+    # configs were REGENERATED under the new env, not carried over
+    assert merged["configs"] == configs_fresh["configs"]
+    # and a full check against the result is clean
+    vs, _ = jaxprcheck.run(baseline=merged, traced=comm_traced)
+    assert vs == [], [str(v) for v in vs]
+
+
+# ---------------------------------------------------------------------------
+# commcheck
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def comm_traced():
+    """One traced subset shared by the comm/pallas suites (each config is
+    a solver build — don't pay it per test): a single-device chunk, a
+    jnp dist chunk, and a fused dist chunk (deep exchange + both fused
+    kernels)."""
+    keep = {"ns2d_jnp", "ns2d_dist_jnp", "ns2d_dist_fused"}
+    cfgs = [c for c in jaxprcheck.standard_configs() if c.name in keep]
+    return jaxprcheck.trace_matrix(cfgs)
+
+
+def _fused(traced):
+    return next(t for t in traced if t.cfg.name == "ns2d_dist_fused")
+
+
+def test_comm_roundtrip_stable(comm_traced):
+    """update -> check clean -> update again byte-stable (the comm
+    section --update contract, the ISSUE 6 satellite)."""
+    vs, fresh = commcheck.run(traced=comm_traced, update=True)
+    assert vs == [], [str(v) for v in vs]
+    vs, _ = commcheck.run(baseline=fresh, traced=comm_traced)
+    assert vs == [], [str(v) for v in vs]
+    _, again = commcheck.run(traced=comm_traced, update=True)
+    assert json.dumps(again, sort_keys=True) == json.dumps(
+        fresh, sort_keys=True)
+
+
+def test_comm_extra_collective_flagged(comm_traced):
+    """Mutation 1: a baseline recording fewer exchanges (as if the
+    current tree smuggled extras in) fails with a per-primitive diff —
+    and a byte drift with a per-strip diff."""
+    _, fresh = commcheck.run(traced=comm_traced, update=True)
+    tampered = json.loads(json.dumps(fresh))
+    entry = tampered["ns2d_dist_fused"]
+    entry["collectives"]["ppermute"] -= 2
+    vs, _ = commcheck.run(baseline=tampered, traced=comm_traced)
+    count = [v for v in vs if v.rule == commcheck.RULE_COUNT]
+    assert len(count) == 1
+    assert "ppermute: 18 -> 20 (+2)" in count[0].message
+    assert count[0].path.endswith("models/ns2d_dist.py")
+
+    tampered = json.loads(json.dumps(fresh))
+    entry = tampered["ns2d_dist_fused"]
+    entry["ppermute_bytes"] -= 1024
+    entry["strips"]["4x16:float64"] -= 1
+    vs, _ = commcheck.run(baseline=tampered, traced=comm_traced)
+    bytes_vs = [v for v in vs if v.rule == commcheck.RULE_BYTES]
+    assert len(bytes_vs) == 1
+    assert "4x16:float64: 3 -> 4 (+1)" in bytes_vs[0].message
+
+
+def test_comm_smuggled_exchange_census():
+    """Mutation 2, on a real program pair: the same shard_map stencil
+    body with a DUPLICATED halo_exchange censuses to exactly double the
+    ppermute count/bytes, and checking the doubled program against the
+    clean baseline fails both rules."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from pampi_tpu.parallel.comm import CartComm, halo_exchange
+
+    comm = CartComm(ndims=2, dims=(2, 2))
+    spec = P("j", "i")
+
+    def once(x):
+        return halo_exchange(x, comm)
+
+    def twice(x):
+        return halo_exchange(halo_exchange(x, comm), comm)
+
+    x = jnp.zeros((16, 16))
+    jx1 = jax.make_jaxpr(comm.shard_map(once, (spec,), spec))(x)
+    jx2 = jax.make_jaxpr(comm.shard_map(twice, (spec,), spec))(x)
+    c1, c2 = commcheck.census(jx1.jaxpr), commcheck.census(jx2.jaxpr)
+    assert c1["collectives"]["ppermute"] == 4  # 2 axes x 2 directions
+    assert c2["collectives"]["ppermute"] == 8
+    assert c2["ppermute_bytes"] == 2 * c1["ppermute_bytes"] > 0
+
+    clean = dict(c1, halo=None)
+    mutant = types.SimpleNamespace(
+        cfg=types.SimpleNamespace(name="mutated", family="ns2d_dist",
+                                  dims=(2, 2)),
+        solver=object(), jaxpr=jx2)
+    vs, _ = commcheck.check_config(mutant, clean, env_matches=True)
+    rules = {v.rule for v in vs}
+    assert commcheck.RULE_COUNT in rules and commcheck.RULE_BYTES in rules
+    assert any("ppermute: 4 -> 8 (+4)" in v.message for v in vs)
+
+
+def test_comm_reshard_flagged():
+    """A resharding collective (what sharding propagation inserts behind
+    an explicit schedule) is banned outright — no baseline needed."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from pampi_tpu.parallel.comm import CartComm
+
+    comm = CartComm(ndims=2, dims=(2, 2))
+
+    def gathers(x):
+        return lax.all_gather(x, "j")
+
+    jx = jax.make_jaxpr(
+        comm.shard_map(gathers, (P("j", "i"),), P(None, "j", "i"))
+    )(jnp.zeros((16, 16)))
+    bad = types.SimpleNamespace(
+        cfg=types.SimpleNamespace(name="reshard", family="ns2d_dist",
+                                  dims=(2, 2)),
+        solver=object(), jaxpr=jx)
+    vs, _ = commcheck.check_config(bad, None, env_matches=True)
+    assert [v.rule for v in vs] == [commcheck.RULE_RESHARD]
+    assert "all_gather" in vs[0].message
+
+
+def test_comm_single_device_collective_flagged(comm_traced):
+    """A collective in a single-device chunk means a mesh axis leaked —
+    the census of a dist program checked under a dims=None config
+    fails."""
+    dist = _fused(comm_traced)
+    leaked = types.SimpleNamespace(
+        cfg=types.SimpleNamespace(name="leaked", family="ns2d",
+                                  dims=None),
+        solver=object(), jaxpr=dist.jaxpr)
+    vs, _ = commcheck.check_config(leaked, None, env_matches=True)
+    assert any(v.rule == commcheck.RULE_COUNT
+               and "single-device" in v.message for v in vs)
+
+
+def test_comm_telemetry_crosscheck(comm_traced):
+    """The halo-record cross-check: the solver's own static accounting
+    (a) prices exactly what comm.halo_exchange_bytes says, (b) declares
+    deep-exchange messages the trace really contains — and a mis-priced
+    record or a dropped/duplicated deep strip is flagged."""
+    t = _fused(comm_traced)
+    entry = commcheck.config_entry(t)
+    rec = t.solver._halo_record()
+    assert commcheck.crosscheck_record(rec, entry) == []
+
+    # (a) a record hand-computing bytes (off by one strip) is caught
+    bad = dict(rec, deep_exchange_bytes=rec["deep_exchange_bytes"] - 64)
+    errs = commcheck.crosscheck_record(bad, entry)
+    assert any("deep_exchange_bytes" in e for e in errs)
+
+    # (b) a trace missing one declared deep message is caught (exact
+    # count for the deep class: a duplicated exchange can't hide either)
+    thin = json.loads(json.dumps(entry))
+    thin["strips"]["4x16:float64"] -= 1
+    errs = commcheck.crosscheck_record(rec, thin)
+    assert any("deep-exchange strip" in e for e in errs)
+
+
+def test_comm_halo_record_is_shared_accounting(comm_traced):
+    """The ISSUE 6 dedupe satellite: the PR 3 telemetry `halo` record and
+    commcheck both price through parallel/comm.halo_exchange_bytes — the
+    solver hook returns the SAME dict the telemetry plane emits, and the
+    utils/telemetry spelling is an alias of the comm helper."""
+    import numpy as np
+
+    from pampi_tpu.parallel.comm import (halo_exchange_bytes,
+                                         halo_strip_shapes)
+    from pampi_tpu.utils import telemetry as tm
+
+    rec = _fused(comm_traced).solver._halo_record()
+    isz = np.dtype(rec["dtype"]).itemsize
+    shard = tuple(rec["shard"])
+    assert rec["exchange_bytes_depth1"] == halo_exchange_bytes(
+        shard, 1, isz)
+    assert rec["deep_exchange_bytes"] == halo_exchange_bytes(
+        shard, rec["deep_halo"], isz)
+    # the alias and the helper agree (and the strip geometry sums to it)
+    assert tm.halo_exchange_bytes((8, 16), 1, 4) == halo_exchange_bytes(
+        (8, 16), 1, 4)
+    strips = halo_strip_shapes(shard, rec["deep_halo"])
+    total = sum(2 * int(np.prod(s)) for s in strips) * isz
+    assert total == rec["deep_exchange_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# palcheck
+# ---------------------------------------------------------------------------
+
+def _toy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def _toy_call(grid, in_spec, out_spec, shape=(256, 256), **kw):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if grid is not None:
+        kw["grid"] = grid
+    f = pl.pallas_call(
+        _toy_kernel,
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
+        in_specs=[in_spec], out_specs=out_spec,
+        interpret=True, **kw)
+    return jax.make_jaxpr(f)(jnp.ones(shape, jnp.float32))
+
+
+def test_palcheck_matrix_and_extras_clean(comm_traced):
+    """The production kernels pass: the fused dist chunk's launches (the
+    matrix population) and the standalone large-grid builds (where the
+    grid actually partitions: pipelined tblock, aliased rb kernel)."""
+    assert palcheck.run(traced=comm_traced, extras=False) == []
+    extras = palcheck.extra_entries()
+    # rb + tblock + quarters (2-D) + tblock 3-D — all four solve-kernel
+    # layouts, at grids large enough to partition
+    assert len(extras) == 4
+    for name, jx in extras:
+        vs = palcheck.check_jaxpr(jx.jaxpr, context=f"{name}/")
+        assert vs == [], [str(v) for v in vs]
+        # the decoded launches carry real kernel anchors
+        for launch in palcheck.launches(jx.jaxpr):
+            assert "/ops/sor" in launch.path and launch.path.endswith(".py")
+            assert launch.line > 0
+
+
+def test_palcheck_oversized_block_flagged():
+    """Mutation: a block whose window exceeds the VMEM budget — the
+    failure class `tblock_feasible` guards at build time, now also caught
+    on any kernel statically."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    jx = _toy_call(None, pl.BlockSpec((2048, 2048), lambda: (0, 0)),
+                   pl.BlockSpec((2048, 2048), lambda: (0, 0)),
+                   shape=(2048, 2048))
+    vs = palcheck.check_jaxpr(jx.jaxpr, budget=1 << 20)
+    assert [v.rule for v in vs] == [palcheck.RULE_VMEM]
+    assert "exceeds the budget" in vs[0].message
+    # within budget: clean
+    assert palcheck.check_jaxpr(jx.jaxpr, budget=64 << 20) == []
+
+
+def test_palcheck_oob_index_map_flagged():
+    """Mutation: an index map shifted one block past the array — every
+    grid point's window start must land inside the operand."""
+    from jax.experimental import pallas as pl
+
+    jx = _toy_call((2,),
+                   pl.BlockSpec((128, 256), lambda i: (i + 1, 0)),
+                   pl.BlockSpec((128, 256), lambda i: (i, 0)))
+    vs = palcheck.check_jaxpr(jx.jaxpr)
+    assert [v.rule for v in vs] == [palcheck.RULE_OOB]
+    assert "grid point (1,)" in vs[0].message
+    assert "starts at element 256" in vs[0].message
+
+
+def test_palcheck_mistiled_block_flagged():
+    """Mutation: a partitioned block off the (8, 128) f32 granularity is
+    flagged per offending dim; a FULL-extent unaligned block is exempt
+    (Mosaic pads whole-array windows — the repo's own (40, 128)-style
+    blocks rely on that)."""
+    from jax.experimental import pallas as pl
+
+    jx = _toy_call((4, 4),
+                   pl.BlockSpec((60, 60), lambda i, j: (i, j)),
+                   pl.BlockSpec((60, 60), lambda i, j: (i, j)),
+                   shape=(240, 240))
+    vs = palcheck.check_jaxpr(jx.jaxpr)
+    tiles = [v for v in vs if v.rule == palcheck.RULE_TILE]
+    assert len(tiles) == 4  # 2 operands x 2 misaligned dims
+    assert any("granularity 128" in v.message for v in tiles)
+    assert any("granularity 8" in v.message for v in tiles)
+    # full-extent block, unaligned sublane: exempt
+    jx = _toy_call((1,), pl.BlockSpec((30, 128), lambda i: (0, 0)),
+                   pl.BlockSpec((30, 128), lambda i: (0, 0)),
+                   shape=(30, 128))
+    assert palcheck.check_jaxpr(jx.jaxpr) == []
+
+
+def test_palcheck_alias_hazards_flagged():
+    """Mutations: (a) an aliased pair windowed through DIFFERENT index
+    maps — the donated buffer is rewritten elsewhere than it is read;
+    (b) a donated input also read through a second operand of the same
+    call (use-after-donation)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def k2(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] + y_ref[...]
+
+    x = jnp.ones((256, 256), jnp.float32)
+    f = pl.pallas_call(
+        k2, out_shape=jax.ShapeDtypeStruct((256, 256), jnp.float32),
+        grid=(2,),
+        in_specs=[pl.BlockSpec((128, 256), lambda i: (i, 0)),
+                  pl.BlockSpec((128, 256), lambda i: (1 - i, 0))],
+        out_specs=pl.BlockSpec((128, 256), lambda i: (i, 0)),
+        input_output_aliases={1: 0}, interpret=True)
+    vs = palcheck.check_jaxpr(jax.make_jaxpr(f)(x, x).jaxpr)
+    assert [v.rule for v in vs] == [palcheck.RULE_ALIAS]
+    assert "index maps differ" in vs[0].message
+
+    f2 = pl.pallas_call(
+        k2, out_shape=jax.ShapeDtypeStruct((256, 256), jnp.float32),
+        input_output_aliases={0: 0}, interpret=True)
+    vs = palcheck.check_jaxpr(jax.make_jaxpr(lambda a: f2(a, a))(x).jaxpr)
+    assert [v.rule for v in vs] == [palcheck.RULE_ALIAS]
+    assert "use-after-donation" in vs[0].message
+
+
+def test_palcheck_squeezed_block_dims():
+    """A pallas_call windowing with squeezed dims (None in the BlockSpec,
+    a Mapped sentinel in the jaxpr param) must CHECK, not crash the lint
+    driver: extents count as 1 for VMEM/coverage, and squeezed dims are
+    exempt from the tiling rule (iteration, not windowing)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def row_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    f = pl.pallas_call(
+        row_kernel,
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+        grid=(16,),
+        in_specs=[pl.BlockSpec((None, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((None, 128), lambda i: (i, 0)),
+        interpret=True)
+    jx = jax.make_jaxpr(f)(jnp.ones((16, 128), jnp.float32))
+    assert palcheck.check_jaxpr(jx.jaxpr) == []
+    (launch,) = palcheck.launches(jx.jaxpr)
+    assert palcheck.block_extents(launch.in_mappings[0]) == (1, 128)
+    assert palcheck.vmem_estimate(launch) > 0
+    # an OOB map through a squeezed dim still flags (start = index * 1)
+    f2 = pl.pallas_call(
+        row_kernel,
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+        grid=(16,),
+        in_specs=[pl.BlockSpec((None, 128), lambda i: (i + 1, 0))],
+        out_specs=pl.BlockSpec((None, 128), lambda i: (i, 0)),
+        interpret=True)
+    jx2 = jax.make_jaxpr(f2)(jnp.ones((16, 128), jnp.float32))
+    vs = palcheck.check_jaxpr(jx2.jaxpr)
+    assert [v.rule for v in vs] == [palcheck.RULE_OOB]
+
+
+def test_palcheck_vmem_estimate_scratch_and_pipeline():
+    """The estimator's two accounting rules on a production kernel: ANY
+    operands charge nothing (their windows enter via explicit VMEM
+    scratch), and the declared compiler vmem_limit is the default
+    budget."""
+    name, jx = palcheck.extra_entries()[0]  # rb_iter: ANY + 2 VMEM scratch
+    (launch,) = palcheck.launches(jx.jaxpr)
+    est = palcheck.vmem_estimate(launch)
+    import numpy as np
+
+    want = sum(
+        int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+        for a in launch.scratch_avals if palcheck._mspace(a) == "vmem")
+    # + the (1, 1) smem residual block charges nothing; ANY blocks either
+    assert est == want > 0
+    assert launch.vmem_limit == 100 << 20  # sor_pallas.VMEM_LIMIT_BYTES
+    assert launch.aliases == ((0, 0),)

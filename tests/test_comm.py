@@ -153,3 +153,40 @@ def test_local_shape_divisibility():
     assert comm.local_shape((8, 8)) == (2, 4)
     with pytest.raises(ValueError):
         comm.local_shape((9, 8))
+
+
+def test_halo_strip_shapes_and_bytes():
+    """The ONE message-geometry statement (ISSUE 6 dedupe satellite):
+    `halo_strip_shapes` describes per-axis exchange strips (depth layers
+    wide, full EXTENDED extent across — ghost corners ride along), and
+    `halo_exchange_bytes` is exactly two directions of each. The
+    utils/telemetry spelling is an alias of the same helper."""
+    from pampi_tpu.parallel.comm import halo_exchange_bytes, halo_strip_shapes
+    from pampi_tpu.utils import telemetry as tm
+
+    assert halo_strip_shapes((8, 8), 1) == [(1, 10), (10, 1)]
+    assert halo_strip_shapes((8, 8), 4) == [(4, 16), (16, 4)]
+    assert halo_strip_shapes((4, 4, 4), 2) == [
+        (2, 8, 8), (8, 2, 8), (8, 8, 2)]
+    # the historical closed form: per axis, 2 * depth * prod(other ext)
+    assert halo_exchange_bytes((8, 16), 1, 8) == (2 * 18 + 2 * 10) * 8
+    assert halo_exchange_bytes((8, 8), 4, 8) == (2 * 4 * 16 * 2) * 8
+    assert tm.halo_exchange_bytes((8, 16), 1, 8) == halo_exchange_bytes(
+        (8, 16), 1, 8)
+
+
+def test_multiprocess_capability_probe():
+    """The tests/test_multihost.py gate (ISSUE 6 satellite): backend
+    DETECTION, not a blanket skip. On this CPU container the probe must
+    say incapable-with-reason iff the jaxlib ships no gloo collectives;
+    on TPU/GPU it is always capable (ROADMAP item 4's acceptance suite
+    un-gates itself on real hardware)."""
+    from pampi_tpu.parallel.multihost import multiprocess_capable
+
+    capable, reason = multiprocess_capable()
+    if jax.default_backend() != "cpu":
+        assert capable
+    if capable:
+        assert reason == ""
+    else:
+        assert "collectives" in reason

@@ -6,7 +6,11 @@ starting N python processes that join one jax.distributed process group
 (Gloo collectives on CPU), with the device mesh spanning all processes.
 These tests run the REAL cross-process path — separate OS processes,
 cross-process ppermute/psum — not the in-process virtual mesh the rest of
-the suite uses.
+the suite uses. They are gated on backend capability, not blanket-skipped:
+`multihost.multiprocess_capable()` probes whether THIS jax build can run
+cross-process collectives on the current backend (TPU/GPU yes; CPU only
+with a gloo-enabled jaxlib), so on real hardware — where ROADMAP item 4
+names this file the acceptance suite — the gate opens by itself.
 """
 
 import pathlib
@@ -14,6 +18,11 @@ import subprocess
 
 import numpy as np
 import pytest
+
+from pampi_tpu.parallel.multihost import multiprocess_capable
+
+_capable, _reason = multiprocess_capable()
+pytestmark = pytest.mark.skipif(not _capable, reason=_reason)
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 LAUNCHER = REPO / "scripts" / "launch-multihost.sh"

@@ -24,11 +24,13 @@ Contracts:
   chunks, records}; when the PR 4 resilience blocks are present,
   `recoveries`/`retries` must be lists of records and `ckpt` a
   save/rotate/load/reject count map.
-- CONTRACTS: {version, env, configs} with env naming the trace
-  environment (jax/x64/backend) and every config entry carrying the
+- CONTRACTS: {version, env, configs, comm} with env naming the trace
+  environment (jax/x64/backend), every config entry carrying the
   jaxprcheck signature keys ({hash, outvars, pallas_calls, prims,
-  dispatch}) — a hand-edited or truncated baseline would otherwise turn
-  the trace-identity check into a silent no-op.
+  dispatch}), and every comm entry the commcheck census keys
+  ({collectives, ppermute_bytes, strips, halo}) over the SAME config
+  set — a hand-edited or truncated baseline would otherwise turn the
+  trace-identity or collective contract into a silent no-op.
 """
 
 from __future__ import annotations
@@ -103,13 +105,17 @@ def lint_multichip(d: dict, where: str = "MULTICHIP") -> list[str]:
     return errs
 
 
-CONTRACTS_REQUIRED = ("version", "env", "configs")
+CONTRACTS_REQUIRED = ("version", "env", "configs", "comm")
 CONTRACTS_ENV = ("jax", "x64", "backend")
 CONTRACTS_ENTRY = ("hash", "outvars", "pallas_calls", "prims", "dispatch")
+# the commcheck census entry (analysis/commcheck.config_entry): a
+# truncated comm section would silently no-op the collective contract
+CONTRACTS_COMM_ENTRY = ("collectives", "ppermute_bytes", "strips", "halo")
 
 
 def lint_contracts(d: dict, where: str = "CONTRACTS") -> list[str]:
-    """The analysis/jaxprcheck baseline shape (see module docstring)."""
+    """The analysis/jaxprcheck + commcheck baseline shape (see module
+    docstring)."""
     errs = _missing(d, CONTRACTS_REQUIRED, where)
     env = d.get("env")
     if isinstance(env, dict):
@@ -128,6 +134,26 @@ def lint_contracts(d: dict, where: str = "CONTRACTS") -> list[str]:
                              f"{where}.configs.{name}")
     elif "configs" in d:
         errs.append(f"{where}.configs: not a dict")
+    comm = d.get("comm")
+    if isinstance(comm, dict):
+        if not comm:
+            errs.append(f"{where}.comm: empty")
+        for name, entry in comm.items():
+            if not isinstance(entry, dict):
+                errs.append(f"{where}.comm.{name}: not a dict")
+                continue
+            errs += _missing(entry, CONTRACTS_COMM_ENTRY,
+                             f"{where}.comm.{name}")
+            if not isinstance(entry.get("collectives"), dict):
+                errs.append(f"{where}.comm.{name}.collectives: not a dict")
+        # every traced config must carry a comm census (and no orphans) —
+        # the two sections describe the one matrix
+        if isinstance(configs, dict) and configs \
+                and set(comm) != set(configs):
+            errs.append(f"{where}.comm: config set differs from "
+                        f"{where}.configs")
+    elif "comm" in d:
+        errs.append(f"{where}.comm: not a dict")
     return errs
 
 

@@ -1,9 +1,9 @@
 """tracecheck — the static contract checker driver (pampi_tpu/analysis/).
 
-    python tools/lint.py [--only ast|halo|jaxpr|artifacts] [--update]
-                         [--contracts PATH] [paths...]
+    python tools/lint.py [--only PASS[,PASS...]] [--update]
+                         [--contracts PATH] [--vmem-budget BYTES] [paths...]
 
-Three passes (all by default, `make lint`):
+Five analysis passes plus the artifact lint (all by default, `make lint`):
 
   ast        repo lint rules over pampi_tpu/, tools/, tests/ (or the
              given paths) — file:line diagnostics, `# lint: allow(<rule>)`
@@ -13,15 +13,25 @@ Three passes (all by default, `make lint`):
   jaxpr      the dispatch-matrix trace contracts vs CONTRACTS.json
              (analysis/jaxprcheck.py); `--update` regenerates the
              baseline after an intended program change
-  artifacts  the committed BENCH/MULTICHIP schema lint
+  comm       collective census + per-step halo traffic bytes of every
+             traced chunk vs the `comm` section of CONTRACTS.json and
+             the solvers' static halo-byte records
+             (analysis/commcheck.py); `--update` regenerates
+  pallas     pallas_call block tiling, static VMEM footprint vs budget,
+             grid×index-map bounds, aliasing (analysis/palcheck.py)
+  artifacts  the committed BENCH/MULTICHIP/CONTRACTS schema lint
              (tools/check_artifact.py) — CI, the test suite and this
              driver share the one analysis layer
 
-The jaxpr pass pins its environment (CPU backend, x64, 8 host devices —
-the test harness environment) BEFORE importing jax, so the committed
+The jaxpr/comm/pallas passes share ONE trace of the config matrix per
+run (`jaxprcheck.trace_matrix`). `--only comm` is the overlap refactor's
+inner loop (`make lint-comm`): the comm contract alone, one matrix trace.
+
+The trace passes pin their environment (CPU backend, x64, 8 host devices
+— the test harness environment) BEFORE importing jax, so the committed
 baseline is reproducible on any machine with the same jax version; on a
-different jax the hash comparison is reported as environment drift and
-the structural contracts still run.
+different jax the hash/count comparisons are reported as environment
+drift and the structural contracts still run.
 
 Exit 0 = clean; 1 = violations (one `file:line: [rule] message` per
 line); 2 = driver error.
@@ -36,6 +46,9 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CONTRACTS = os.path.join(REPO, "CONTRACTS.json")
+
+PASSES = ("ast", "halo", "jaxpr", "comm", "pallas", "artifacts")
+TRACE_PASSES = ("jaxpr", "comm", "pallas")
 
 # the pinned trace environment — must precede any jax import
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -83,26 +96,6 @@ def run_halo() -> list:
     return halocheck.check_all()
 
 
-def run_jaxpr(update: bool, contracts_path: str) -> list:
-    from pampi_tpu.analysis import jaxprcheck
-
-    baseline = None
-    if os.path.exists(contracts_path):
-        with open(contracts_path) as fh:
-            baseline = json.load(fh)
-    elif not update:
-        print(f"jaxpr: no baseline at {contracts_path} — tracing fresh "
-              "(run with --update to commit one)", file=sys.stderr)
-    violations, fresh = jaxprcheck.run(baseline=baseline, update=update)
-    if update:
-        with open(contracts_path, "w") as fh:
-            json.dump(fresh, fh, indent=1, sort_keys=True)
-            fh.write("\n")
-        print(f"jaxpr: baseline written to {contracts_path} "
-              f"({len(fresh['configs'])} configs)")
-    return violations
-
-
 def run_artifacts() -> list:
     from pampi_tpu.analysis.astlint import Violation
 
@@ -115,33 +108,178 @@ def run_artifacts() -> list:
     return errs
 
 
+class TraceContext:
+    """The shared state of the trace passes: the baseline on disk, one
+    lazily-built trace of the config matrix, and the fresh baseline
+    sections accumulated for --update (written once, merged, at the
+    end — `--only comm --update` regenerates the comm section without
+    touching the configs section, and vice versa)."""
+
+    def __init__(self, contracts_path: str, update: bool):
+        self.path = contracts_path
+        self.update = update
+        self.baseline = None
+        if os.path.exists(contracts_path):
+            with open(contracts_path) as fh:
+                self.baseline = json.load(fh)
+        elif not update:
+            print(f"no baseline at {contracts_path} — tracing fresh "
+                  "(run with --update to commit one)", file=sys.stderr)
+        self._traced = None
+        self.fresh_configs = None
+        self.fresh_env = None
+        self.fresh_comm = None
+
+    def traced(self):
+        if self._traced is None:
+            from pampi_tpu.analysis import jaxprcheck
+
+            self._traced = jaxprcheck.trace_matrix()
+        return self._traced
+
+    def env_matches(self) -> bool:
+        from pampi_tpu.analysis import jaxprcheck
+
+        return (self.baseline or {}).get("env") == jaxprcheck.environment()
+
+    def run_jaxpr(self) -> list:
+        from pampi_tpu.analysis import jaxprcheck
+
+        violations, fresh = jaxprcheck.run(
+            baseline=self.baseline, update=self.update,
+            traced=self.traced())
+        self.fresh_configs = fresh["configs"]
+        self.fresh_env = fresh["env"]
+        return violations
+
+    def run_comm(self) -> list:
+        from pampi_tpu.analysis import commcheck, jaxprcheck
+
+        base_comm = (self.baseline or {}).get("comm")
+        if base_comm is None and self.baseline is not None \
+                and not self.update:
+            print("comm: baseline has no comm section — tracing fresh "
+                  "(run with --update to commit one)", file=sys.stderr)
+        env_matches = self.env_matches()
+        if base_comm is not None and not env_matches and not self.update:
+            # the jaxpr pass owns the env-drift VIOLATION (one per run);
+            # when comm runs alone, still say why counts aren't compared
+            print("comm: baseline environment differs — census counts "
+                  "not compared (structural rules still checked; "
+                  "regenerate with tools/lint.py --update)",
+                  file=sys.stderr)
+        violations, fresh = commcheck.run(
+            baseline=base_comm, update=self.update, traced=self.traced(),
+            env_matches=env_matches)
+        self.fresh_comm = fresh
+        if self.fresh_env is None:
+            self.fresh_env = jaxprcheck.environment()
+        return violations
+
+    def run_pallas(self, budget) -> list:
+        from pampi_tpu.analysis import palcheck
+
+        return palcheck.run(traced=self.traced(), budget=budget)
+
+    def write(self) -> None:
+        """Merge the fresh sections over the on-disk baseline and write
+        once. Sections whose pass did not run this invocation are
+        preserved — UNLESS the trace environment changed, in which case a
+        preserved section would pair old-env hashes/counts with the new
+        `env` key and silently defeat env-drift detection, so the
+        missing section is regenerated from the shared matrix too (the
+        traces are already in memory; only the bookkeeping re-runs)."""
+        from pampi_tpu.analysis import commcheck, jaxprcheck
+
+        env_changed = (self.baseline or {}).get("env") != self.fresh_env
+        if env_changed and self.baseline is not None:
+            if self.fresh_configs is None and self.fresh_comm is not None:
+                _, fresh = jaxprcheck.run(update=True, traced=self.traced())
+                self.fresh_configs = fresh["configs"]
+            elif self.fresh_comm is None \
+                    and self.fresh_configs is not None:
+                _, self.fresh_comm = commcheck.run(update=True,
+                                                   traced=self.traced())
+        merged = dict(self.baseline or {})
+        merged["version"] = jaxprcheck.BASELINE_VERSION
+        if self.fresh_env is not None:
+            merged["env"] = self.fresh_env
+        if self.fresh_configs is not None:
+            merged["configs"] = self.fresh_configs
+        if self.fresh_comm is not None:
+            merged["comm"] = self.fresh_comm
+        with open(self.path, "w") as fh:
+            json.dump(merged, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        sections = [s for s, fresh in (("configs", self.fresh_configs),
+                                       ("comm", self.fresh_comm))
+                    if fresh is not None]
+        print(f"baseline written to {self.path} "
+              f"(sections regenerated: {', '.join(sections)})")
+
+
 def main(argv) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--only", choices=("ast", "halo", "jaxpr", "artifacts"))
+    ap.add_argument("--only",
+                    help="comma-separated subset of passes to run: "
+                         + ",".join(PASSES))
     ap.add_argument("--update", action="store_true",
-                    help="regenerate the CONTRACTS.json baseline")
+                    help="regenerate the CONTRACTS.json baseline "
+                         "(configs/comm sections of the passes run)")
     ap.add_argument("--contracts", default=CONTRACTS)
+    ap.add_argument("--vmem-budget", type=int, default=None,
+                    help="override the pallas pass VMEM budget in bytes "
+                         "(default: each kernel's declared "
+                         "vmem_limit_bytes)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs for the ast pass (default: the repo)")
     args = ap.parse_args(argv[1:])
 
-    passes = (args.only,) if args.only else ("ast", "halo", "jaxpr",
-                                             "artifacts")
+    if args.only:
+        chosen = {p.strip() for p in args.only.split(",") if p.strip()}
+        bad = [p for p in sorted(chosen) if p not in PASSES]
+        if bad:
+            print(f"unknown pass(es) {bad}; choose from {PASSES}",
+                  file=sys.stderr)
+            return 2
+        # canonical order regardless of the flag's spelling: artifacts
+        # must run AFTER a pending --update flush, trace passes share
+        # one matrix in matrix order
+        passes = tuple(p for p in PASSES if p in chosen)
+    else:
+        passes = PASSES
+
+    ctx = None
+    if any(p in TRACE_PASSES for p in passes):
+        ctx = TraceContext(args.contracts, args.update)
+
     total = 0
+    written = False
     for name in passes:
         if name == "ast":
             vs = run_ast(args.paths)
         elif name == "halo":
             vs = run_halo()
         elif name == "jaxpr":
-            vs = run_jaxpr(args.update, args.contracts)
+            vs = ctx.run_jaxpr()
+        elif name == "comm":
+            vs = ctx.run_comm()
+        elif name == "pallas":
+            vs = ctx.run_pallas(args.vmem_budget)
         else:
+            # the artifact lint reads CONTRACTS.json from disk — flush a
+            # pending --update first so it lints the regenerated baseline
+            if ctx is not None and args.update and not written:
+                ctx.write()
+                written = True
             vs = run_artifacts()
         for v in vs:
             print(str(v))
         status = "ok" if not vs else f"{len(vs)} violation(s)"
         print(f"[{name}] {status}")
         total += len(vs)
+    if ctx is not None and args.update and not written:
+        ctx.write()
     return 1 if total else 0
 
 
