@@ -67,6 +67,21 @@ telemetry-report:
 check-artifacts:
 	python tools/check_artifact.py
 
+# Perf trend over the committed BENCH_r*.json artifacts + regression
+# gate: renders the (metric, backend) trajectory table and fails when
+# the newest point of any same-backend series regresses beyond the
+# tolerance vs the best earlier point. Also runs as the `trend` pass of
+# `make lint`.
+bench-trend:
+	python tools/bench_trend.py
+
+# Device-time profiling smoke: a tiny instrumented dist-NS run with
+# PAMPI_TELEMETRY + PAMPI_XPROF armed, trace ingestion, and the
+# comm-hidden-fraction block — CPU-safe, proves the xprof plane
+# end-to-end before any TPU time is spent.
+profile-smoke:
+	JAX_PLATFORMS=cpu python tools/profile_smoke.py
+
 # tracecheck: the static contract checker (pampi_tpu/analysis/) — AST
 # lint rules over pampi_tpu/ tools/ tests/, stencil halo footprints vs
 # declared depths, the dispatch-matrix jaxpr contracts vs CONTRACTS.json,
@@ -98,5 +113,5 @@ clean:
 distclean:
 	rm -rf build exe-*
 
-.PHONY: all test asm format telemetry-report check-artifacts lint \
-	lint-update lint-comm fault-suite clean distclean
+.PHONY: all test asm format telemetry-report check-artifacts bench-trend \
+	profile-smoke lint lint-update lint-comm fault-suite clean distclean

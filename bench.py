@@ -141,7 +141,7 @@ def _step_decomposition_line(param, metric, config, steps, reps):
     tpu_flat_solve=1 so every solve runs exactly itermax iterations and
     the step - solve subtraction is well-defined."""
     from pampi_tpu.models.ns2d import NS2DSolver
-    from pampi_tpu.utils import dispatch, telemetry
+    from pampi_tpu.utils import dispatch, telemetry, xprof
 
     assert param.tpu_flat_solve, "decomposition needs the flat solve"
     s = NS2DSolver(param, dtype=jnp.float32)
@@ -149,11 +149,14 @@ def _step_decomposition_line(param, metric, config, steps, reps):
     out = s._chunk_fn(*state)
     float(out[3])  # compile + warm-up; scalar readback is the fence
     best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = s._chunk_fn(*state)
-        float(out[3])
-        best = min(best, time.perf_counter() - t0)
+    # PAMPI_XPROF: device-trace the timed window (no-op when unset) —
+    # the per-kernel attribution behind the headline number
+    with xprof.capture(metric, steps=steps * reps):
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = s._chunk_fn(*state)
+            float(out[3])
+            best = min(best, time.perf_counter() - t0)
     step_ms = best / steps * 1e3
     line = {
         "metric": metric,

@@ -61,6 +61,7 @@ RULE_COUNT = "comm-collective"
 RULE_BYTES = "comm-bytes"
 RULE_RESHARD = "comm-reshard"
 RULE_XCHECK = "comm-telemetry"
+RULE_SCOPE = "comm-scope"
 
 # the census vocabulary: every cross-shard primitive a chunk could carry
 COLLECTIVES = ("ppermute", "psum", "pmax", "pmin", "all_gather",
@@ -72,8 +73,33 @@ RESHARDING = ("all_gather", "all_to_all", "reduce_scatter")
 
 
 def strip_key(shape, dtype) -> str:
-    """Census key of one ppermute message: '4x16:float64'."""
-    return "x".join(str(int(s)) for s in shape) + f":{dtype}"
+    """Census key of one ppermute message: '4x16:float64'. The ONE
+    convention, homed in `parallel/comm.strip_key` next to the exchange
+    whose messages it names — the `jax.named_scope` device-time scopes
+    and `utils/xprof`'s trace aggregation use the same token, so a lint
+    census entry and a profiler scope cannot drift apart."""
+    from ..parallel.comm import strip_key as _key
+
+    return _key(shape, dtype)
+
+
+def scoped_exchanges(jaxpr) -> dict[str, int]:
+    """ppermute eqns by their `halo_exchange.*`/`halo_shift.*` name-stack
+    scope (parallel/comm wraps every exchange axis in a jax.named_scope) —
+    the static twin of the xprof trace attribution. Unscoped ppermutes
+    (e.g. the quarters solve's own q_exchange) land under ''."""
+    out: dict[str, int] = {}
+    for e in iter_eqns(jaxpr):
+        if e.primitive.name != "ppermute":
+            continue
+        stack = str(getattr(e.source_info, "name_stack", "") or "")
+        label = ""
+        for part in stack.split("/"):
+            if part.startswith(("halo_exchange.", "halo_shift.")):
+                label = part
+                break
+        out[label] = out.get(label, 0) + 1
+    return out
 
 
 def census(jaxpr) -> dict:
@@ -228,6 +254,18 @@ def check_config(traced, baseline: dict | None,
              f"single-device chunk contains collectives "
              f"{ {k: v for k, v in counts.items() if v} } — a mesh axis "
              "leaked into the trace")
+    # every dist chunk's step-level exchanges must carry the named-scope
+    # attribution (parallel/comm._scope) — without it the xprof plane
+    # cannot attribute device time to the exchange and the comm-hidden
+    # fraction (ROADMAP item 2's headline) is unmeasurable
+    if cfg.dims is not None and counts.get("ppermute"):
+        scoped = scoped_exchanges(traced.jaxpr.jaxpr)
+        if not any(label for label in scoped):
+            emit(RULE_SCOPE,
+                 f"chunk carries {counts['ppermute']} ppermute(s) but none "
+                 "under a halo_exchange./halo_shift. named scope — the "
+                 "exchange lost its device-time attribution "
+                 "(parallel/comm._scope)")
     # the telemetry cross-check (dist solvers expose _halo_record)
     if entry["halo"] is not None:
         for msg in crosscheck_record(entry["halo"], entry):

@@ -110,6 +110,20 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
         raise ValueError(f"lookahead must be >= 0 (got {lookahead})")
     max_transient = max(0, transient_budget)  # replenish refills to THIS
     clean = 0  # consecutive confirmed chunks since the last fault/recovery
+    # per-chunk steps/s + ETA line behind PAMPI_PROFILE (utils/progress.
+    # ChunkEta): a multi-minute run stops being a silent decile bar. The
+    # state convention (..., t, nt[, metrics]) puts nt right after the
+    # loop time (the make_recovery contract), so the line costs one tiny
+    # scalar readback per chunk — and only when the flag is armed, on
+    # process 0 only (the master-only emitter convention; N ranks
+    # \r-redrawing one terminal would garble it).
+    from ..utils import profiling as _prof
+    from ..utils.progress import ChunkEta
+
+    eta = (ChunkEta(te)
+           if _prof.enabled() and jax.process_index() == 0 else None)
+    if eta is not None and hasattr(bar, "disable"):
+        bar.disable()  # one \r-redrawn line at a time — the ETA wins
     if float(state[time_index]) > te:
         bar.stop()
         return state
@@ -188,6 +202,8 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
                     # dispatches run the restored pallas chunk
                     chunk_fn = restored_fn
         bar.update(t_old)
+        if eta is not None:
+            eta.update(t_old, int(old[time_index + 1]))
         if on_state is not None:
             on_state(old)
         # NaN loop time is terminal, not "not yet past te": an adaptive-dt
@@ -219,6 +235,8 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
             # recovery off / gave up: terminate ON the diverged state (a
             # diagnostic-bearing early stop, never a hang on garbage)
             final = old
+    if eta is not None:
+        eta.stop()
     bar.stop()
     return final
 

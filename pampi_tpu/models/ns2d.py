@@ -677,15 +677,20 @@ class NS2DSolver:
 
         if recover is not None:
             recover.capture(state)  # first-chunk divergence is recoverable
-        state = drive_chunks(state, self._chunk_fn, self.param.te, 3, bar,
-                             pallas_retry(
-                                 self, "pressure solve",
-                                 restore_after=self.param.tpu_retry_replenish,
-                             ),
-                             on_state, lookahead=self.param.tpu_lookahead,
-                             replenish_after=self.param.tpu_retry_replenish,
-                             recover=recover)
-        publish(state)
+        from ..utils import xprof as _xprof
+
+        nt0 = self.nt
+        with _xprof.capture("ns2d", steps=lambda: self.nt - nt0):
+            state = drive_chunks(
+                state, self._chunk_fn, self.param.te, 3, bar,
+                pallas_retry(
+                    self, "pressure solve",
+                    restore_after=self.param.tpu_retry_replenish,
+                ),
+                on_state, lookahead=self.param.tpu_lookahead,
+                replenish_after=self.param.tpu_retry_replenish,
+                recover=recover)
+            publish(state)
 
     def write_result(
         self, pressure_path: str = "pressure.dat", velocity_path: str = "velocity.dat"

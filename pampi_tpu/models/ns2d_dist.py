@@ -70,6 +70,7 @@ from ..utils import dispatch as _dispatch
 from ..utils import faultinject as _fi
 from ..utils import flags as _flags
 from ..utils import telemetry as _tm
+from ..utils import xprof as _xprof
 from ._driver import clamped_dt
 from ..utils.datio import write_pressure, write_velocity
 from ..utils.params import Parameter
@@ -892,11 +893,38 @@ class NS2DDistSolver:
         # collectives across ranks (ROADMAP open item) — disable it there
         # and let the fault kill the job cleanly
         budget = 0 if jax.process_count() > 1 else 1
-        state = drive_chunks(state, self._chunk_sm, self.param.te, 3, bar,
-                             retry=lambda: None, on_state=on_state,
-                             replenish_after=self.param.tpu_retry_replenish,
-                             recover=recover, transient_budget=budget)
-        publish(state)
+        # PAMPI_XPROF: device-trace the drive loop (no-op when unset);
+        # the step count rides the xprof record so report tooling can
+        # normalize device times per step
+        nt0 = self.nt
+        with _xprof.capture("ns2d_dist", steps=lambda: self.nt - nt0):
+            state = drive_chunks(
+                state, self._chunk_sm, self.param.te, 3, bar,
+                retry=lambda: None, on_state=on_state,
+                replenish_after=self.param.tpu_retry_replenish,
+                recover=recover, transient_budget=budget)
+            publish(state)
+        self._emit_exchange_span()
+
+    def _emit_exchange_span(self) -> None:
+        """The ROADMAP-mandated `exchange` span: the serial critical-path
+        cost of one step's declared halo schedule, measured on an
+        exchange-only program (parallel/comm.time_exchange_ms) AFTER the
+        drive loop so the probe dispatches never pollute chunk timings or
+        the captured trace. Together with the xprof record's exchange
+        device/exposed split this is the comm-hidden-fraction input
+        (tools/telemetry_report.comm_hidden_fraction)."""
+        if not _tm.enabled():
+            return
+        from ..parallel.comm import exchange_schedule_bytes, time_exchange_ms
+
+        rec = self._halo_record()
+        _tm.emit_span(
+            f"{rec['family']}.exchange",
+            time_exchange_ms(self.comm, rec),
+            path=rec["path"], mesh=rec["mesh"], shard=rec["shard"],
+            bytes_per_step=exchange_schedule_bytes(rec),
+            mode="serial_probe")
 
     # -- collect: stacked extended blocks -> full reference-layout array -
     def _assemble(self, stacked) -> np.ndarray:

@@ -625,15 +625,20 @@ class NS3DSolver:
 
         if recover is not None:
             recover.capture(state)  # first-chunk divergence is recoverable
-        state = drive_chunks(state, self._chunk_fn, self.param.te, 4, bar,
-                             pallas_retry(
-                                 self, "3-D pressure solve",
-                                 restore_after=self.param.tpu_retry_replenish,
-                             ),
-                             on_state, lookahead=self.param.tpu_lookahead,
-                             replenish_after=self.param.tpu_retry_replenish,
-                             recover=recover)
-        publish(state)
+        from ..utils import xprof as _xprof
+
+        nt0 = self.nt
+        with _xprof.capture("ns3d", steps=lambda: self.nt - nt0):
+            state = drive_chunks(
+                state, self._chunk_fn, self.param.te, 4, bar,
+                pallas_retry(
+                    self, "3-D pressure solve",
+                    restore_after=self.param.tpu_retry_replenish,
+                ),
+                on_state, lookahead=self.param.tpu_lookahead,
+                replenish_after=self.param.tpu_retry_replenish,
+                recover=recover)
+            publish(state)
 
     def collect(self):
         """Cell-centered global fields (≙ commCollectResult's non-MPI path,

@@ -62,6 +62,7 @@ from ..utils import dispatch as _dispatch
 from ..utils import faultinject as _fi
 from ..utils import flags as _flags
 from ..utils import telemetry as _tm
+from ..utils import xprof as _xprof
 from ._driver import clamped_dt
 from ..utils.grid import Grid
 from ..utils.params import Parameter
@@ -814,11 +815,30 @@ class NS3DDistSolver:
             recover.capture(state)  # first-chunk divergence is recoverable
         # transient retry is single-controller only (see ns2d_dist.run)
         budget = 0 if jax.process_count() > 1 else 1
-        state = drive_chunks(state, self._chunk_sm, self.param.te, 4, bar,
-                             retry=lambda: None, on_state=on_state,
-                             replenish_after=self.param.tpu_retry_replenish,
-                             recover=recover, transient_budget=budget)
-        publish(state)
+        nt0 = self.nt
+        with _xprof.capture("ns3d_dist", steps=lambda: self.nt - nt0):
+            state = drive_chunks(
+                state, self._chunk_sm, self.param.te, 4, bar,
+                retry=lambda: None, on_state=on_state,
+                replenish_after=self.param.tpu_retry_replenish,
+                recover=recover, transient_budget=budget)
+            publish(state)
+        self._emit_exchange_span()
+
+    def _emit_exchange_span(self) -> None:
+        """The `exchange` span — see models/ns2d_dist._emit_exchange_span
+        (the serial critical-path probe of the declared halo schedule)."""
+        if not _tm.enabled():
+            return
+        from ..parallel.comm import exchange_schedule_bytes, time_exchange_ms
+
+        rec = self._halo_record()
+        _tm.emit_span(
+            f"{rec['family']}.exchange",
+            time_exchange_ms(self.comm, rec),
+            path=rec["path"], mesh=rec["mesh"], shard=rec["shard"],
+            bytes_per_step=exchange_schedule_bytes(rec),
+            mode="serial_probe")
 
     def collect(self):
         """Gather cell-centered global fields to the host. The collect

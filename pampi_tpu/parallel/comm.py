@@ -312,6 +312,26 @@ def get_offsets(axis_name: str, local_extent: int):
     return lax.axis_index(axis_name) * local_extent
 
 
+def strip_key(shape, dtype) -> str:
+    """Canonical name of one exchange message: '4x16:float64'. The ONE
+    naming convention across the observability plane — the commcheck
+    collective census keys ppermute messages with it
+    (analysis/commcheck.py), the `jax.named_scope` device-time scopes
+    below embed it, and `utils/xprof.py` aggregates trace events back by
+    the same token — so a lint census entry, a profiler scope and a
+    telemetry record all name the same strip."""
+    return "x".join(str(int(s)) for s in shape) + f":{jnp.dtype(dtype).name}"
+
+
+def _scope(kind: str, axis_name: str, shape, dtype):
+    """Device-time attribution scope of one exchange axis:
+    `halo_exchange.j.4x18:float64`. jax.named_scope leaves the jaxpr
+    byte-identical (only eqn name stacks / lowered-HLO metadata change),
+    so the flag-off trace-identity contract (CONTRACTS.json hashes) is
+    untouched — test-pinned in tests/test_xprof.py."""
+    return jax.named_scope(f"{kind}.{axis_name}.{strip_key(shape, dtype)}")
+
+
 def _nbr_perm(nper: int, up: bool, periodic: bool):
     if periodic:
         return [(r, (r + 1) % nper) for r in range(nper)] if up else [
@@ -331,20 +351,25 @@ def _exchange_axis(x, axis_name: str, nper: int, dim: int, periodic: bool,
         return x
     n = x.shape[dim]
     d = depth
-    # my high/low OWNED strips (d innermost owned layers on each side)
-    hi_edge = lax.slice_in_dim(x, n - 2 * d, n - d, axis=dim)
-    lo_edge = lax.slice_in_dim(x, d, 2 * d, axis=dim)
-    # strip travelling "up" (to +1 neighbour) fills their LOW ghost, and v.v.
-    from_lo = lax.ppermute(hi_edge, axis_name, _nbr_perm(nper, True, periodic))
-    from_hi = lax.ppermute(lo_edge, axis_name, _nbr_perm(nper, False, periodic))
-    if not periodic:
-        idx = lax.axis_index(axis_name)
-        old_lo = lax.slice_in_dim(x, 0, d, axis=dim)
-        old_hi = lax.slice_in_dim(x, n - d, n, axis=dim)
-        from_lo = jnp.where(idx > 0, from_lo, old_lo)
-        from_hi = jnp.where(idx < nper - 1, from_hi, old_hi)
-    x = lax.dynamic_update_slice_in_dim(x, from_lo, 0, axis=dim)
-    x = lax.dynamic_update_slice_in_dim(x, from_hi, n - d, axis=dim)
+    strip = tuple(d if a == dim else x.shape[a] for a in range(x.ndim))
+    with _scope("halo_exchange", axis_name, strip, x.dtype):
+        # my high/low OWNED strips (d innermost owned layers on each side)
+        hi_edge = lax.slice_in_dim(x, n - 2 * d, n - d, axis=dim)
+        lo_edge = lax.slice_in_dim(x, d, 2 * d, axis=dim)
+        # strip travelling "up" (to +1 neighbour) fills their LOW ghost,
+        # and v.v.
+        from_lo = lax.ppermute(hi_edge, axis_name,
+                               _nbr_perm(nper, True, periodic))
+        from_hi = lax.ppermute(lo_edge, axis_name,
+                               _nbr_perm(nper, False, periodic))
+        if not periodic:
+            idx = lax.axis_index(axis_name)
+            old_lo = lax.slice_in_dim(x, 0, d, axis=dim)
+            old_hi = lax.slice_in_dim(x, n - d, n, axis=dim)
+            from_lo = jnp.where(idx > 0, from_lo, old_lo)
+            from_hi = jnp.where(idx < nper - 1, from_hi, old_hi)
+        x = lax.dynamic_update_slice_in_dim(x, from_lo, 0, axis=dim)
+        x = lax.dynamic_update_slice_in_dim(x, from_hi, n - d, axis=dim)
     return x
 
 
@@ -406,12 +431,90 @@ def halo_shift(x, comm: CartComm, axis: str):
     if nper == 1:
         return x
     n = x.shape[dim]
-    hi_edge = lax.slice_in_dim(x, n - 2, n - 1, axis=dim)
-    from_lo = lax.ppermute(hi_edge, axis, _nbr_perm(nper, True, False))
-    idx = lax.axis_index(axis)
-    old_lo = lax.slice_in_dim(x, 0, 1, axis=dim)
-    from_lo = jnp.where(idx > 0, from_lo, old_lo)
-    return lax.dynamic_update_slice_in_dim(x, from_lo, 0, axis=dim)
+    strip = tuple(1 if a == dim else x.shape[a] for a in range(x.ndim))
+    with _scope("halo_shift", axis, strip, x.dtype):
+        hi_edge = lax.slice_in_dim(x, n - 2, n - 1, axis=dim)
+        from_lo = lax.ppermute(hi_edge, axis, _nbr_perm(nper, True, False))
+        idx = lax.axis_index(axis)
+        old_lo = lax.slice_in_dim(x, 0, 1, axis=dim)
+        from_lo = jnp.where(idx > 0, from_lo, old_lo)
+        return lax.dynamic_update_slice_in_dim(x, from_lo, 0, axis=dim)
+
+
+def exchange_schedule_bytes(record: dict) -> int:
+    """Per-step bytes of a solver's declared step-level exchange schedule
+    (the `_halo_record()` dict): full exchanges at their depths plus the
+    one-strip staggered shifts. Priced through `halo_exchange_bytes` /
+    `halo_strip_shapes` so this total and the commcheck census cannot
+    diverge."""
+    import numpy as np
+
+    shard = tuple(record["shard"])
+    isz = np.dtype(record["dtype"]).itemsize
+    per = record.get("exchanges_per_step", {})
+    total = per.get("depth1", 0) * halo_exchange_bytes(shard, 1, isz)
+    if "deep" in per:
+        total += per["deep"] * halo_exchange_bytes(
+            shard, record["deep_halo"], isz)
+    if per.get("shift"):
+        # one shift per axis (F/G/H donor edges): a single depth-1 strip,
+        # one direction
+        per_axis = per["shift"] // len(shard)
+        total += sum(per_axis * int(np.prod(s)) * isz
+                     for s in halo_strip_shapes(shard, 1))
+    return total
+
+
+def make_exchange_probe(comm: CartComm, record: dict):
+    """Jitted exchange-only program of a solver's declared step-level
+    schedule (`_halo_record()`): the SERIAL cost of one step's halo
+    traffic with nothing overlapping it — the `exchange` span's
+    critical-path number (ROADMAP item 2: the comm/compute-overlap
+    refactor is judged by how much of this time it hides). The exchanges
+    chain through one carried block per depth class so XLA cannot
+    reorder or elide them. Returns (fn, args)."""
+    per = record.get("exchanges_per_step", {})
+    shard = tuple(record["shard"])
+    dtype = jnp.dtype(record["dtype"])
+    names = comm.axis_names
+    H = int(record.get("deep_halo", 1))
+
+    def body(x1, xd):
+        for _ in range(int(per.get("depth1", 0))):
+            x1 = halo_exchange(x1, comm)
+        for k in range(int(per.get("shift", 0))):
+            x1 = halo_shift(x1, comm, names[k % len(names)])
+        for _ in range(int(per.get("deep", 0))):
+            xd = halo_exchange(xd, comm, depth=H)
+        return x1, xd
+
+    spec = comm.spec()
+    fn = jax.jit(comm.shard_map(body, in_specs=(spec, spec),
+                                out_specs=(spec, spec)))
+    sh = comm.sharding()
+    x1 = jax.device_put(
+        jnp.zeros(tuple(p * (s + 2) for p, s in zip(comm.dims, shard)),
+                  dtype), sh)
+    xd = jax.device_put(
+        jnp.zeros(tuple(p * (s + 2 * H) for p, s in zip(comm.dims, shard)),
+                  dtype), sh)
+    return fn, (x1, xd)
+
+
+def time_exchange_ms(comm: CartComm, record: dict, reps: int = 3) -> float:
+    """Best-of-reps wall time of ONE serial pass of the declared exchange
+    schedule, in ms (compile + one warm dispatch excluded). Off-TPU the
+    number is trend-only, like every other wall measurement here."""
+    import time as _time
+
+    fn, args = make_exchange_probe(comm, record)
+    jax.block_until_ready(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = _time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, _time.perf_counter() - t0)
+    return best * 1e3
 
 
 def reduction(val, comm: CartComm, op: str = "sum"):

@@ -1,12 +1,19 @@
-"""10-segment progress bar over simulated time.
+"""10-segment progress bar over simulated time, plus the per-chunk
+ETA line.
 
 Parity: initProgress/printProgress/stopProgress
 (/root/reference/assignment-6/src/progress.c:17-50) — a `\r`-redrawn
 `[####      ]` bar that fills as t approaches te. Only redraws when the
 integer decile changes.
+
+`ChunkEta` is the drive-loop twin (models/_driver.drive_chunks, armed by
+PAMPI_PROFILE): one stderr line per confirmed chunk with steps/s and an
+ETA extrapolated from the chunk trajectory — a multi-minute 4096² run
+stops being a silent decile bar.
 """
 
 import sys
+import time
 
 
 class Progress:
@@ -32,5 +39,70 @@ class Progress:
 
     def stop(self) -> None:
         if self._enabled:
+            self._out.write("\n")
+            self._out.flush()
+
+    def disable(self) -> None:
+        """Stand the bar down mid-run (the ChunkEta line replaces it —
+        two `\\r`-redrawn lines on one terminal would garble each other):
+        finish the open bracket line, then every later update/stop is a
+        no-op."""
+        if self._enabled:
+            self._out.write("\n")
+            self._out.flush()
+            self._enabled = False
+
+
+def _fmt_eta(seconds: float) -> str:
+    s = int(max(0.0, seconds))
+    if s >= 3600:
+        return f"{s // 3600}h{(s % 3600) // 60:02d}m"
+    if s >= 60:
+        return f"{s // 60}m{s % 60:02d}s"
+    return f"{s}s"
+
+
+class ChunkEta:
+    """Per-chunk progress line: steps/s and ETA from the chunk trajectory.
+
+    The rate is fit over the STEADY samples (the first chunk is
+    compile-inclusive and would poison a naive average — it is kept as
+    the time origin only once a second sample exists). ETA extrapolates
+    simulated-time progress: (te - t) / (dt_sim/dwall of the steady
+    window). NaN t (a diverged run) freezes the line rather than
+    printing garbage."""
+
+    def __init__(self, te: float, out=None):
+        self._te = te
+        self._out = out if out is not None else sys.stderr
+        self._samples: list[tuple[float, float, int]] = []  # (wall, t, nt)
+
+    def update(self, t: float, nt: int) -> None:
+        if t != t:  # NaN loop time: divergence, nothing to extrapolate
+            return
+        now = time.perf_counter()
+        self._samples.append((now, float(t), int(nt)))
+        if len(self._samples) < 2:
+            return
+        # steady window: drop the compile-inclusive first sample when a
+        # later pair exists
+        base = self._samples[1] if len(self._samples) > 2 else \
+            self._samples[0]
+        dwall = now - base[0]
+        dnt = nt - base[2]
+        dt_sim = t - base[1]
+        if dwall <= 0 or dnt <= 0:
+            return
+        sps = dnt / dwall
+        eta = ((self._te - t) / (dt_sim / dwall)
+               if dt_sim > 0 else float("inf"))
+        self._out.write(
+            f"\r[chunk] nt={nt} t={t:.6g}/{self._te:g} "
+            f"{sps:.1f} steps/s "
+            f"ETA {_fmt_eta(eta) if eta != float('inf') else '?'}   ")
+        self._out.flush()
+
+    def stop(self) -> None:
+        if len(self._samples) >= 2:
             self._out.write("\n")
             self._out.flush()

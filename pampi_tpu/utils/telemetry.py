@@ -34,9 +34,19 @@ Host plane — every record is one JSON line appended to the
   solve       a driver-level Poisson solve (iters, residual, wall)
   halo        static per-shard halo-exchange byte counts (dist solvers)
   span        a named timing span — the ONE decomposition protocol the
-              perf tools share (bench.py, tools/northstar.py, tools/perf_*)
+              perf tools share (bench.py, tools/northstar.py, tools/perf_*);
+              the dist solvers' `<family>.exchange` span records the
+              serial critical-path cost of one step's declared halo
+              schedule (parallel/comm.time_exchange_ms)
+  xprof       one captured device-trace region (utils/xprof.capture):
+              per-scope/collective/kernel device ms, busy/idle, and the
+              exchange device-vs-exposed split behind the comm-hidden
+              fraction
   metric      a headline metric line (bench.py's JSON lines, artifacts)
-  finalize    end of run: the `utils/profiling` region table
+  finalize    end of run: the `utils/profiling` region table, plus
+              `dropped_records` when any write failed — a truncated
+              flight record names its own truncation instead of reading
+              as a quiet run
 
 Multi-process runs emit from process 0 only. `tools/telemetry_report.py`
 aggregates a JSONL into a human-readable report and a summary block for
@@ -52,7 +62,8 @@ import os
 import time
 import warnings
 
-SCHEMA_VERSION = 2  # v2 (PR 4): + recover / retry / ckpt record kinds
+SCHEMA_VERSION = 3  # v3: + xprof record kind, finalize drop accounting
+#                     (v2, PR 4: + recover / retry / ckpt record kinds)
 
 # METRICS vector layout (float32, shared by the 2-D and 3-D families; the
 # 2-D solvers leave M_WMAX at 0). M_BAD < 0 means all-finite so far;
@@ -65,6 +76,7 @@ _run_emitted = False
 _finalized = False
 _atexit_registered = False
 _write_failed = False
+_dropped = 0  # records lost to write failures (reported by finalize)
 
 
 def _path() -> str:
@@ -80,10 +92,11 @@ def enabled() -> bool:
 
 def reset() -> None:
     """Re-arm the per-process one-shot records (tests)."""
-    global _run_emitted, _finalized, _write_failed
+    global _run_emitted, _finalized, _write_failed, _dropped
     _run_emitted = False
     _finalized = False
     _write_failed = False
+    _dropped = 0
 
 
 def _is_master() -> bool:
@@ -99,9 +112,15 @@ def emit(kind: str, **fields) -> None:
     """Append one schema-versioned record; no-op when disabled. A write
     failure (bad path, full disk) costs the flight record, never the run:
     warn once and stand down instead of sinking the solver or a bench
-    headline behind an observability layer."""
-    global _atexit_registered, _write_failed
-    if not enabled() or _write_failed or not _is_master():
+    headline behind an observability layer. Every record lost to the
+    stand-down is COUNTED (`_dropped`) and reported by the finalize
+    record, so a truncated flight record is never mistaken for a quiet
+    run."""
+    global _atexit_registered, _write_failed, _dropped
+    if not enabled() or not _is_master():
+        return
+    if _write_failed:
+        _dropped += 1
         return
     if kind != "run":
         _ensure_run()
@@ -127,6 +146,7 @@ def emit(kind: str, **fields) -> None:
             fh.write(json.dumps(_json_safe(rec), allow_nan=False) + "\n")
     except OSError as exc:
         _write_failed = True
+        _dropped += 1
         warnings.warn(
             f"PAMPI_TELEMETRY write to {_path()!r} failed ({exc}); "
             "telemetry disabled for the rest of this run",
@@ -217,16 +237,24 @@ def span(name: str, **fields):
 
 def finalize() -> None:
     """Emit the end-of-run record (the profiling region table, when any
-    regions were recorded). Idempotent — the atexit hook and an explicit
-    driver call must not double-emit."""
-    global _finalized
+    regions were recorded, plus the count of records dropped by write
+    failures). Idempotent — the atexit hook and an explicit driver call
+    must not double-emit. After a write-failure stand-down, ONE last
+    write is attempted for this record: a flight record that ends by
+    naming its own truncation beats one that is silently clipped (if the
+    path is still broken the attempt fails like any other write)."""
+    global _finalized, _write_failed
     if _finalized or not enabled():
         return
     _finalized = True
     from . import profiling as prof
 
     table = prof.table()
-    emit("finalize", profile_regions=table if table else None)
+    dropped = _dropped
+    if _write_failed:
+        _write_failed = False  # the one last-gasp attempt
+    emit("finalize", profile_regions=table if table else None,
+         dropped_records=dropped if dropped else None)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,352 @@
+"""Device-time profiling plane: XLA trace capture + host-side ingestion.
+
+The flight recorder (utils/telemetry.py) tells us what the HOST saw —
+chunk walls, residuals, spans. The north-star questions are device-side:
+which Pallas kernel, which collective, and how much of the halo exchange
+hides behind compute. This module closes that gap behind one switch:
+
+  PAMPI_XPROF=<dir>   capture a `jax.profiler` trace around each
+                      instrumented region (`capture(...)` — the solver
+                      drive loops and the bench/perf timed windows wrap
+                      themselves in it), ingest the resulting
+                      trace-event file on the host, and emit ONE
+                      schema-versioned `xprof` telemetry record per
+                      region with per-scope device times.
+  unset               every call is a no-op. Capture and ingestion are
+                      host-side only — the traced programs are
+                      byte-identical either way (the PAMPI_TELEMETRY /
+                      PAMPI_FAULTS contract, pinned in
+                      tests/test_xprof.py).
+
+Ingestion reads the Chrome trace-event JSON the profiler writes next to
+its XPlane file (`<host>.trace.json.gz` — present on this container's
+CPU backend too, so the whole plane is testable off-chip; a committed
+golden fixture pins the aggregation). Events are attributed three ways:
+
+  scopes       the `halo_exchange.<axis>.<strip>` / `halo_shift.*`
+               names `parallel/comm._scope` stamps on every exchange
+               axis (visible in TPU op metadata), falling back to the
+               collective's own HLO family name — one naming convention
+               with the commcheck census (`parallel/comm.strip_key`)
+  collectives  HLO collective families (collective-permute, all-reduce,
+               ...) — collective-permute IS the halo exchange traffic
+  kernels      everything else on a device track (fusions, pallas
+               kernels), top-N by total time
+
+plus per-track busy/idle (gap) time and the comm-hidden numbers ROADMAP
+item 2 is built against: `exchange_device_ms` (device time the exchange
+occupies) vs `exchange_exposed_ms` (the part of it during which no
+compute runs on the same track — the critical-path share). The
+comm-hidden fraction is 1 - exposed/device: today's serial schedule
+measures ~0; the overlap refactor is judged by how far it rises.
+
+Degraded wall-clock mode: when the profiler cannot start (no runtime
+support, a PAMPI_PROFILE=<dir> trace already active) or leaves no
+parseable trace-event file, the region still emits an `xprof` record
+with `mode: "wallclock"` and its wall time — a truncated record, never a
+sunk run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import re
+import time
+import warnings
+
+XPROF_SCHEMA_VERSION = 1
+
+# the comm._scope attribution tokens (one convention with commcheck's
+# strip keys) as they appear inside op metadata / event names
+_SCOPE_RE = re.compile(r"halo_(?:exchange|shift)\.[^\s/;,\"'()]+")
+# HLO collective families; collective-permute is the exchange traffic
+COLLECTIVE_TOKENS = ("collective-permute", "all-reduce", "all-gather",
+                     "all-to-all", "reduce-scatter", "collective-broadcast")
+EXCHANGE_TOKENS = ("collective-permute",)
+# control-flow CONTAINER ops (the chunk's while loop on the CPU thunk
+# executor): their events span every op they contain, so counting them
+# as compute would mark all nested exchange time "hidden" — they stay in
+# the kernel table but are excluded from the overlap cover
+_CONTAINER_RE = re.compile(r"^(while|conditional|call)[.\d]*$")
+TOP_KERNELS = 12
+
+
+def _dir() -> str:
+    from . import flags as _flags
+
+    return _flags.env("PAMPI_XPROF",
+                      doc="device-trace capture dir (unset = off)")
+
+
+def enabled() -> bool:
+    return bool(_dir())
+
+
+_active = False  # one capture at a time; nested regions ride the outer one
+_warned_no_sink = False
+
+
+@contextlib.contextmanager
+def capture(region: str, steps=None):
+    """Capture a profiler trace around the block and emit one `xprof`
+    telemetry record (no-op when PAMPI_XPROF is unset, or nested inside
+    an active capture). `steps` is an int or 0-arg callable evaluated at
+    exit — it rides the record so report tooling can normalize device
+    times per step. With PAMPI_XPROF armed but PAMPI_TELEMETRY unset the
+    trace files still land on disk (open them in XProf/Perfetto), but
+    there is no flight record to carry the ingested summary — warn once
+    and skip the ingestion instead of silently discarding it."""
+    global _active, _warned_no_sink
+    from . import telemetry as _tm
+
+    if not enabled() or _active:
+        yield
+        return
+    if not _tm.enabled() and not _warned_no_sink:
+        _warned_no_sink = True
+        warnings.warn(
+            "PAMPI_XPROF is armed but PAMPI_TELEMETRY is not: trace files "
+            "are written for offline viewing, but the ingested xprof "
+            "record (and the comm_hidden_fraction block) needs the flight "
+            "recorder — set PAMPI_TELEMETRY too", stacklevel=2)
+
+    root = _dir()
+    started = False
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        os.makedirs(root, exist_ok=True)
+        jax.profiler.start_trace(root)
+        started = True
+    except Exception as exc:  # lint: allow(broad-except) — profiler unavailability (no runtime support, a PAMPI_PROFILE trace already active) degrades to wall-clock, never sinks the run
+        warnings.warn(
+            f"PAMPI_XPROF: trace capture unavailable ({exc}); recording "
+            "wall-clock only", stacklevel=2)
+    _active = True
+    try:
+        yield
+    finally:
+        _active = False
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        summary = None
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+                if _tm.enabled():  # no flight record = no sink to ingest to
+                    path = latest_trace_file(root)
+                    if path:
+                        summary = summarize(load_trace_events(path),
+                                            source=path)
+            except Exception as exc:  # lint: allow(broad-except) — a stop/ingest failure of any class costs the device numbers, never the run (the crash-surviving span contract)
+                warnings.warn(
+                    f"PAMPI_XPROF: trace ingestion failed ({exc}); "
+                    "recording wall-clock only", stacklevel=2)
+        rec = {"schema": XPROF_SCHEMA_VERSION, "region": region,
+               "steps": steps() if callable(steps) else steps,
+               "wall_ms": round(wall_ms, 3)}
+        if summary is not None:
+            rec.update(summary)
+            rec["mode"] = "trace"
+        else:
+            rec["mode"] = "wallclock"
+        _tm.emit("xprof", **rec)
+
+
+# ---------------------------------------------------------------------------
+# trace-event ingestion (host-side; fully testable off-chip)
+# ---------------------------------------------------------------------------
+
+def latest_trace_file(root: str) -> str | None:
+    """Newest trace-event JSON under a profiler log dir (the profiler
+    writes plugins/profile/<ts>/<host>.trace.json.gz next to the XPlane
+    file; repeated captures leave several <ts> dirs)."""
+    hits: list[str] = []
+    for pat in ("*.trace.json.gz", "*.trace.json"):
+        hits += glob.glob(os.path.join(root, "**", pat), recursive=True)
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def load_trace_events(path: str) -> list[dict]:
+    """The Chrome trace-event list of a (possibly gzipped) trace file —
+    either the {"traceEvents": [...]} envelope or a bare event list."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as fh:
+        d = json.load(fh)
+    return d.get("traceEvents", []) if isinstance(d, dict) else d
+
+
+def _merge(intervals):
+    """Sorted union of (start, end) intervals."""
+    out = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1][1] = e
+        else:
+            out.append([s, e])
+    return out
+
+
+def _length(merged) -> float:
+    return sum(e - s for s, e in merged)
+
+
+def _exposed(target, cover) -> float:
+    """Length of the merged `target` intervals NOT covered by the merged
+    `cover` intervals — the exchange time with no compute over it."""
+    total = 0.0
+    j = 0
+    for s, e in target:
+        pos = s
+        while j < len(cover) and cover[j][1] <= pos:
+            j += 1
+        k = j
+        while pos < e:
+            if k >= len(cover) or cover[k][0] >= e:
+                total += e - pos
+                break
+            cs, ce = cover[k]
+            if cs > pos:
+                total += cs - pos
+            pos = max(pos, ce)
+            k += 1
+    return total
+
+
+def _scope_of(ev: dict) -> str | None:
+    """The comm named-scope label of one event, from its name or its op
+    metadata args (TPU traces carry the scope path in long_name/tf_op)."""
+    m = _SCOPE_RE.search(ev.get("name", ""))
+    if m:
+        return m.group(0)
+    args = ev.get("args")
+    if isinstance(args, dict):
+        for v in args.values():
+            if isinstance(v, str):
+                m = _SCOPE_RE.search(v)
+                if m:
+                    return m.group(0)
+    return None
+
+
+def _family(name: str) -> str | None:
+    low = name.lower()
+    for tok in COLLECTIVE_TOKENS:
+        if tok in low:
+            return tok
+    return None
+
+
+def _device_events(events: list[dict]) -> list[dict]:
+    """The device-op events of a trace: X events carrying HLO op metadata
+    (the CPU runtime's form), plus every X event on a pid whose
+    process_name marks a device track (the TPU/GPU form)."""
+    dev_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname = (e.get("args") or {}).get("name", "")
+            if re.search(r"/device:|TPU|GPU", str(pname)):
+                dev_pids.add(e.get("pid"))
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e or "ts" not in e:
+            continue
+        args = e.get("args")
+        if (isinstance(args, dict) and ("hlo_op" in args
+                                        or "hlo_module" in args)) \
+                or e.get("pid") in dev_pids:
+            out.append(e)
+    return out
+
+
+def summarize(events: list[dict], source: str | None = None) -> dict:
+    """Aggregate a trace-event list into the `xprof` record body: per-scope
+    / per-collective / per-kernel device ms, busy/idle per device track,
+    and the exchange device-vs-exposed split the comm-hidden fraction is
+    computed from. All times in ms (trace events are microseconds)."""
+    devs = _device_events(events)
+    tracks: dict[tuple, dict] = {}
+    # per-scope intervals kept PER TRACK: a union across concurrent
+    # device tracks would collapse their parallelism and under-count
+    # device time — union within a track (nested scope/op events), sum
+    # across tracks
+    scope_iv: dict[str, dict[tuple, list]] = {}
+    coll_ms: dict[str, float] = {}
+    kern_ms: dict[str, float] = {}
+    for e in devs:
+        ts, dur = float(e["ts"]), float(e["dur"])
+        if dur <= 0:
+            continue
+        track = (e.get("pid"), e.get("tid"))
+        tr = tracks.setdefault(track, {"all": [], "exch": [], "compute": []})
+        iv = (ts, ts + dur)
+        tr["all"].append(iv)
+        name = e.get("name", "")
+        scope = _scope_of(e)
+        fam = _family(name)
+        exch = (scope is not None
+                or any(tok in name.lower() for tok in EXCHANGE_TOKENS))
+        if exch:
+            tr["exch"].append(iv)
+            scope_iv.setdefault(scope or fam or "exchange", {}) \
+                .setdefault(track, []).append(iv)
+        elif not _CONTAINER_RE.match(name):
+            tr["compute"].append(iv)
+        if fam is not None:
+            coll_ms[fam] = coll_ms.get(fam, 0.0) + dur
+        elif scope is None:
+            kern_ms[name] = kern_ms.get(name, 0.0) + dur
+    if not tracks:
+        return {"tracks": 0, "total_ms": 0.0, "busy_ms": 0.0,
+                "idle_ms": 0.0, "scopes": {}, "collectives": {},
+                "kernels": {}, "exchange_device_ms": 0.0,
+                "exchange_exposed_ms": 0.0, "source": source}
+    busy = idle = span = exch_dev = exch_exp = 0.0
+    for tr in tracks.values():
+        merged = _merge(tr["all"])
+        t_span = merged[-1][1] - merged[0][0]
+        t_busy = _length(merged)
+        span = max(span, t_span)
+        busy += t_busy
+        idle += t_span - t_busy
+        ex = _merge(tr["exch"])
+        exch_dev += _length(ex)
+        exch_exp += _exposed(ex, _merge(tr["compute"]))
+    top = dict(sorted(kern_ms.items(), key=lambda kv: -kv[1])[:TOP_KERNELS])
+    ms = 1e-3  # trace-event timestamps are microseconds
+
+    def r(x):
+        return round(x * ms, 4)
+
+    return {
+        "tracks": len(tracks),
+        "total_ms": r(span),
+        "busy_ms": r(busy),
+        "idle_ms": r(idle),
+        "scopes": {
+            k: r(sum(_length(_merge(iv)) for iv in per_track.values()))
+            for k, per_track in scope_iv.items()
+        },
+        "collectives": {k: r(v) for k, v in coll_ms.items()},
+        "kernels": {k: r(v) for k, v in top.items()},
+        "exchange_device_ms": r(exch_dev),
+        "exchange_exposed_ms": r(exch_exp),
+        "source": source,
+    }
+
+
+def hidden_fraction(summary: dict) -> float | None:
+    """1 - exposed/device: the share of exchange device time hidden
+    behind compute. None when the trace carried no exchange events."""
+    dev = summary.get("exchange_device_ms") or 0.0
+    if dev <= 0:
+        return None
+    exp = summary.get("exchange_exposed_ms") or 0.0
+    return round(max(0.0, 1.0 - exp / dev), 4)

@@ -1,0 +1,110 @@
+"""tools/bench_trend.py: the BENCH trend normalization + regression gate.
+
+The committed BENCH_r01-r06 series must render populated (the round-8
+bench-trend input parsed to [] — the normalized `metrics` schema exists
+so that never recurs) and regression-free; a synthetic injected
+regression must fail; CPU and TPU points must never gate against each
+other."""
+
+import json
+
+from tools import bench_trend as bt
+
+
+def _art(tmp_path, n, metrics):
+    p = tmp_path / f"BENCH_r{n:02d}.json"
+    with open(p, "w") as fh:
+        json.dump({"n": n, "cmd": "x", "rc": 0, "tail": "",
+                   "schema_version": 1, "metrics": metrics}, fh)
+    return str(p)
+
+
+def _pt(value, name="m", unit="updates/s", backend="tpu"):
+    return {"name": name, "value": value, "unit": unit, "backend": backend}
+
+
+def test_committed_artifacts_render_populated():
+    """The acceptance pin: the committed BENCH_r01-r06 set yields a
+    multi-round, backend-partitioned series — never [] — and carries no
+    regression at the default tolerance."""
+    files = bt.default_files()
+    assert len(files) >= 6
+    series = bt.build_series(bt.load_points(files))
+    assert series, "committed BENCH artifacts yielded zero trend points"
+    # multi-round: the TPU poisson headline spans rounds 1-5
+    key = ("lattice_site_updates_per_sec_per_chip_poisson4096_rbsor", "tpu")
+    assert key in series and len(series[key]) >= 4
+    # backend partition: round 6 is the CPU growth container
+    assert ("lattice_site_updates_per_sec_per_chip_poisson4096_rbsor",
+            "cpu") in series
+    assert bt.lint() == []
+    table = bt.render(series)
+    assert "r01" in table and "r06" in table and "[tpu]" in table
+
+
+def test_synthetic_regression_fails(tmp_path):
+    """An injected regression beyond tolerance fails; within tolerance
+    passes (the make lint trend gate's contract)."""
+    files = [_art(tmp_path, 1, [_pt(100.0)]),
+             _art(tmp_path, 2, [_pt(80.0)])]  # -20% on a rate
+    errs = bt.lint(files, tolerance=0.10)
+    assert len(errs) == 1 and "dropped 20.0%" in errs[0]
+    assert bt.lint(files, tolerance=0.25) == []
+    # within tolerance
+    files = [_art(tmp_path, 1, [_pt(100.0)]), _art(tmp_path, 2, [_pt(95.0)])]
+    assert bt.lint(files, tolerance=0.10) == []
+
+
+def test_gate_vs_best_not_last(tmp_path):
+    """The gate compares against the BEST earlier point, not merely the
+    previous round — a slow multi-round slide cannot ratchet the
+    baseline down."""
+    files = [_art(tmp_path, i, [_pt(v)])
+             for i, v in ((1, 100.0), (2, 94.0), (3, 89.0))]
+    errs = bt.lint(files, tolerance=0.10)
+    assert len(errs) == 1 and "100" in errs[0]
+
+
+def test_backend_partition_never_cross_gates(tmp_path):
+    """A CPU trend point after strong TPU rounds is NOT a regression —
+    the series are keyed (metric, backend)."""
+    files = [_art(tmp_path, 1, [_pt(1e11, backend="tpu")]),
+             _art(tmp_path, 2, [_pt(5e7, backend="cpu")])]
+    assert bt.lint(files) == []
+    series = bt.build_series(bt.load_points(files))
+    assert ("m", "tpu") in series and ("m", "cpu") in series
+
+
+def test_latency_direction(tmp_path):
+    """ms/step regresses UPWARD; unknown units render but never gate."""
+    files = [_art(tmp_path, 1, [_pt(10.0, unit="ms/step")]),
+             _art(tmp_path, 2, [_pt(12.0, unit="ms/step")])]
+    errs = bt.lint(files, tolerance=0.10)
+    assert len(errs) == 1 and "rose" in errs[0]
+    files = [_art(tmp_path, 1, [_pt(10.0, unit="bananas")]),
+             _art(tmp_path, 2, [_pt(99.0, unit="bananas")])]
+    assert bt.lint(files, tolerance=0.10) == []
+
+
+def test_legacy_artifact_fallback(tmp_path):
+    """Artifacts without a normalized metrics list fall back to the same
+    normalizer over their parsed* blocks (never tail scraping)."""
+    p = tmp_path / "BENCH_r01.json"
+    with open(p, "w") as fh:
+        json.dump({"n": 1, "cmd": "x", "rc": 0, "tail": "",
+                   "parsed": {"metric": "legacy", "value": 5.0,
+                              "unit": "updates/s", "backend": "pallas"}}, fh)
+    pts = bt.load_points([str(p)])
+    assert pts == [{"round": 1, "name": "legacy", "value": 5.0,
+                    "unit": "updates/s", "backend": "tpu",
+                    "file": "BENCH_r01.json"}]
+
+
+def test_empty_input_is_a_violation(tmp_path):
+    """The trend pass FAILS on an empty series — the round-8 `[]` shape
+    is a lint error, not a silent pass."""
+    assert bt.lint([]) != []
+    p = tmp_path / "BENCH_r01.json"
+    with open(p, "w") as fh:
+        json.dump({"n": 1, "cmd": "x", "rc": 0, "tail": ""}, fh)
+    assert any("zero trend points" in e for e in bt.lint([str(p)]))

@@ -26,11 +26,44 @@ def test_lint_catches_missing_required():
     assert any("ok" in e for e in ca.lint_multichip({"n_devices": 8}))
 
 
+# the tools/_artifact.py normalized schema every artifact now carries
+_NORM = {"schema_version": 1, "metrics": []}
+
+
+def test_lint_normalized_schema():
+    """schema_version + the machine-readable metrics list are required
+    (the bench_trend input must never degrade back to tail scraping);
+    malformed entries and non-cpu/tpu backend tags are flagged."""
+    base = {"n": 1, "cmd": "x", "rc": 0, "tail": "", **_NORM}
+    assert ca.lint_bench(base) == []
+    assert any("schema_version" in e for e in ca.lint_bench(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": "", "metrics": []}))
+    assert any("metrics" in e for e in ca.lint_bench(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": "", "schema_version": 1}))
+    bad = dict(base, metrics=[{"name": "m", "value": 1.0,
+                               "unit": "x", "backend": "axon"}])
+    assert any("cpu|tpu" in e for e in ca.lint_bench(bad))
+    bad = dict(base, metrics=[{"name": "m"}])
+    assert any("value" in e for e in ca.lint_bench(bad))
+
+
+def test_lint_xprof_summary_block():
+    base = {"n": 1, "cmd": "x", "rc": 0, "tail": "", **_NORM}
+    good = dict(base, xprof_summary={
+        "mode": "trace", "scopes": {}, "collectives": {},
+        "exchange_device_ms": 1.0, "exchange_exposed_ms": 1.0})
+    assert ca.lint_bench(good) == []
+    wall = dict(base, xprof_summary={"mode": "wallclock", "wall_ms": 5.0})
+    assert ca.lint_bench(wall) == []  # degraded mode carries less
+    bad = dict(base, xprof_summary={"mode": "trace"})
+    assert any("scopes" in e for e in ca.lint_bench(bad))
+
+
 def test_lint_catches_gutted_decomposition():
     """An NS step line without the solve/non-solve decomposition keys is a
     schema violation — null VALUES are legal (off-TPU), missing KEYS are
     not."""
-    good = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+    good = {"n": 1, "cmd": "x", "rc": 0, "tail": "", **_NORM,
             "parsed_ns2d": {"metric": "ns2d_dcavity4096_ms_per_step",
                             "value": 1.0, "unit": "ms/step",
                             "solve_ms": None, "nonsolve_ms": None,
@@ -44,7 +77,7 @@ def test_lint_catches_gutted_decomposition():
 
 def test_lint_telemetry_summary_block():
     base = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
-            "tail": ""}
+            "tail": "", **_NORM}
     good = dict(base, telemetry_summary={
         "schema_version": 1, "dispatch": {}, "records": 4,
         "chunks": {"count": 1, "steps": 8}})

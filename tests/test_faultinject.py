@@ -297,6 +297,30 @@ def test_telemetry_write_failure_stands_down(faults, tel_on):
     assert len(_records(tel_on)) == 2  # records 1-2 landed, 3 tore it down
 
 
+def test_telemetry_drop_accounting(faults, tel_on):
+    """The stand-down COUNTS what it drops, and finalize's last-gasp
+    write lands the count (a truncated flight record names its own
+    truncation instead of reading as a quiet run); the report surfaces
+    it loudly."""
+    faults("telemetry@emit3")
+    with pytest.warns(UserWarning, match="telemetry disabled"):
+        s = NS2DSolver(Parameter(tpu_chunk=2, **_BASE))
+        s.run(progress=False)
+    tm.finalize()
+    recs = _records(tel_on)
+    fins = [r for r in recs if r["kind"] == "finalize"]
+    assert len(fins) == 1
+    dropped = fins[0]["dropped_records"]
+    # the failing record plus every post-stand-down emit of the run
+    # (chunk records etc.), but NOT the finalize record itself
+    assert dropped >= 2
+    from tools import telemetry_report as tr
+
+    assert "TRUNCATED" in tr.render(recs)
+    assert tr.summary(recs)["dropped_records"] == dropped
+    assert s.nt > 0  # the run itself was never at risk
+
+
 # ---------------------------------------------------------------------------
 # report + artifact-lint round-trip of the resilience kinds (satellite)
 # ---------------------------------------------------------------------------

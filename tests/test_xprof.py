@@ -1,0 +1,318 @@
+"""Device-time profiling plane (utils/xprof + the comm named scopes).
+
+Four contracts (ISSUE 8):
+- GOLDEN INGESTION: the trace-event aggregation (per-scope/collective/
+  kernel device ms, busy/idle, exchange device-vs-exposed) is pinned
+  against a committed golden trace fixture, so the whole plane is
+  testable off-chip.
+- OFF-PATH ZERO COST: PAMPI_XPROF is host-side only — the traced chunk
+  is byte-identical with the flag set or unset (the PAMPI_TELEMETRY /
+  PAMPI_FAULTS contract), and the always-on `jax.named_scope` exchange
+  attribution never changes the jaxpr text (CONTRACTS.json hashes).
+- NAMED-SCOPE PRESENCE: every dist chunk's ppermutes carry the
+  `halo_exchange.*`/`halo_shift.*` scopes, keyed by the SAME strip_key
+  the commcheck census uses (one naming convention across trace, lint
+  and telemetry).
+- EXCHANGE SPAN ROUND-TRIP: a dist run with telemetry armed emits the
+  serial-probe `.exchange` span; report -> merge -> artifact lint all
+  pass and the comm_hidden_fraction block lands in the artifact.
+"""
+
+import gzip
+import json
+import os
+import shutil
+
+import jax
+import pytest
+
+from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+from pampi_tpu.parallel.comm import (
+    CartComm,
+    exchange_schedule_bytes,
+    halo_exchange_bytes,
+    strip_key,
+    time_exchange_ms,
+)
+from pampi_tpu.utils import telemetry as tm
+from pampi_tpu.utils import xprof
+from pampi_tpu.utils.params import Parameter
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "xprof_golden.trace.json")
+
+_BASE = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02, tau=0.5,
+             itermax=10, eps=1e-4, omg=1.7, gamma=0.9)
+
+
+@pytest.fixture()
+def tel_on(tmp_path, monkeypatch):
+    path = tmp_path / "run.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(path))
+    tm.reset()
+    yield path
+    tm.reset()
+
+
+def _records(path):
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# golden-fixture ingestion
+# ---------------------------------------------------------------------------
+
+def test_golden_trace_aggregation():
+    """The committed fixture's numbers, pinned (see the fixture's metadata
+    note for the track layout): 2 device tracks, the host python track
+    ignored, exchange 1.1 ms of which 0.4 ms hides under fusion.2."""
+    s = xprof.summarize(xprof.load_trace_events(FIXTURE))
+    assert s["tracks"] == 2  # the /host:CPU python track is not a device
+    assert s["total_ms"] == 2.8
+    assert s["busy_ms"] == pytest.approx(5.4)   # 2.6 + 2.8 across tracks
+    assert s["idle_ms"] == pytest.approx(0.2)   # track 1's [2400, 2600] gap
+    # scope attribution by the comm strip_key convention
+    assert s["scopes"] == {
+        "halo_exchange.j.4x18:float32": pytest.approx(0.7),  # cp.1 + cp.3
+        "halo_exchange.i.18x4:float32": pytest.approx(0.4),  # cp.2
+    }
+    assert s["collectives"] == {"collective-permute": pytest.approx(1.1)}
+    # kernels summed by name across tracks
+    assert s["kernels"]["fusion.1"] == pytest.approx(2.2)
+    assert s["kernels"]["fusion.2"] == pytest.approx(1.0)
+    # the comm-hidden inputs: cp.2 is fully covered by fusion.2
+    assert s["exchange_device_ms"] == pytest.approx(1.1)
+    assert s["exchange_exposed_ms"] == pytest.approx(0.7)
+    assert xprof.hidden_fraction(s) == pytest.approx(1 - 0.7 / 1.1,
+                                                     abs=1e-4)
+
+
+def test_golden_gzip_and_discovery(tmp_path):
+    """Ingestion reads the profiler's gzipped form and latest_trace_file
+    finds it under the nested plugins/profile/<ts>/ layout."""
+    d = tmp_path / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    with open(FIXTURE, "rb") as src, gzip.open(d / "host.trace.json.gz",
+                                               "wb") as dst:
+        shutil.copyfileobj(src, dst)
+    found = xprof.latest_trace_file(str(tmp_path))
+    assert found and found.endswith(".trace.json.gz")
+    assert xprof.summarize(xprof.load_trace_events(found)) \
+        == xprof.summarize(xprof.load_trace_events(FIXTURE))
+
+
+def test_container_ops_do_not_hide_exchange():
+    """A while-loop container event spanning the whole chunk (the CPU
+    thunk executor's form) must not count as compute cover — otherwise
+    every exchange reads as 100% hidden."""
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 1000,
+         "name": "while.1", "args": {"hlo_op": "while.1"}},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 100, "dur": 200,
+         "name": "collective-permute.1",
+         "args": {"hlo_op": "collective-permute.1"}},
+    ]
+    s = xprof.summarize(events)
+    assert s["exchange_device_ms"] == pytest.approx(0.2)
+    assert s["exchange_exposed_ms"] == pytest.approx(0.2)  # NOT hidden
+    assert xprof.hidden_fraction(s) == 0.0
+
+
+def test_empty_trace_degrades():
+    s = xprof.summarize([])
+    assert s["tracks"] == 0 and s["exchange_device_ms"] == 0.0
+    assert xprof.hidden_fraction(s) is None
+
+
+# ---------------------------------------------------------------------------
+# the comm_hidden_fraction block (tools/telemetry_report)
+# ---------------------------------------------------------------------------
+
+def test_comm_hidden_fraction_trace_mode():
+    from tools import telemetry_report as tr
+
+    summ = xprof.summarize(xprof.load_trace_events(FIXTURE))
+    records = [
+        {"v": 3, "kind": "xprof", "ts": 0, "region": "ns2d_dist",
+         "steps": 10, "mode": "trace", **summ},
+        {"v": 3, "kind": "span", "ts": 0, "name": "ns2d_dist.exchange",
+         "ms": 0.2, "mode": "serial_probe"},
+    ]
+    chf = tr.comm_hidden_fraction(records)
+    assert chf["mode"] == "trace" and chf["steps"] == 10
+    assert chf["exchange_device_ms_per_step"] == pytest.approx(0.11)
+    assert chf["exchange_exposed_ms_per_step"] == pytest.approx(0.07)
+    assert chf["exchange_serial_ms_per_step"] == 0.2
+    assert chf["hidden_fraction"] == pytest.approx(1 - 0.7 / 1.1, abs=1e-4)
+    # the block survives the artifact lint
+    from tools import check_artifact as ca
+
+    assert ca.lint_comm_hidden(chf, "t") == []
+
+
+def test_comm_hidden_fraction_zero_attribution_stays_trace():
+    """A real trace that attributed ZERO exchange time (scope drift, a
+    single-device capture) must surface as mode 'trace' with hidden
+    None — never dressed up as a clean wallclock measurement."""
+    from tools import telemetry_report as tr
+
+    records = [
+        {"v": 3, "kind": "xprof", "ts": 0, "region": "ns2d", "steps": 4,
+         "mode": "trace", "exchange_device_ms": 0.0,
+         "exchange_exposed_ms": 0.0},
+        {"v": 3, "kind": "span", "ts": 0, "name": "ns2d_dist.exchange",
+         "ms": 0.3},
+    ]
+    chf = tr.comm_hidden_fraction(records)
+    assert chf["mode"] == "trace"
+    assert chf["hidden_fraction"] is None
+    assert chf["exchange_serial_ms_per_step"] == 0.3
+
+
+def test_comm_hidden_fraction_wallclock_mode():
+    """Degraded mode: only the serial probe exists — fully exposed."""
+    from tools import telemetry_report as tr
+
+    records = [{"v": 3, "kind": "span", "ts": 0,
+                "name": "ns3d_dist.exchange", "ms": 1.5}]
+    chf = tr.comm_hidden_fraction(records)
+    assert chf["mode"] == "wallclock"
+    assert chf["hidden_fraction"] == 0.0
+    assert chf["exchange_device_ms_per_step"] == 1.5
+    assert tr.comm_hidden_fraction([]) is None
+
+
+# ---------------------------------------------------------------------------
+# off-path identity + named-scope presence
+# ---------------------------------------------------------------------------
+
+def test_offpath_jaxpr_identity_xprof(tmp_path, monkeypatch):
+    """PAMPI_XPROF set vs unset: the traced dist chunk is byte-identical
+    (capture/ingestion are host-side; the named scopes are always on and
+    jaxpr-invisible — the CONTRACTS.json hash contract)."""
+    from pampi_tpu.analysis.jaxprcheck import trace_chunk
+
+    monkeypatch.delenv("PAMPI_XPROF", raising=False)
+    param = Parameter(**_BASE)
+    off = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2)))
+    jx_off = trace_chunk(off)
+    monkeypatch.setenv("PAMPI_XPROF", str(tmp_path / "trace"))
+    on = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2)))
+    jx_on = trace_chunk(on)
+    assert str(jx_off) == str(jx_on)
+    assert not (tmp_path / "trace").exists()  # tracing never armed
+
+
+def test_named_scopes_pinned_on_dist_chunk():
+    """Every dist chunk's step-level exchanges carry the halo_exchange /
+    halo_shift named scopes, keyed by the commcheck strip_key — the
+    static twin of the xprof trace attribution (and the `comm-scope`
+    lint rule's contract)."""
+    from pampi_tpu.analysis.commcheck import census, scoped_exchanges
+    from pampi_tpu.analysis.jaxprcheck import trace_chunk
+
+    s = NS2DDistSolver(Parameter(**_BASE), CartComm(ndims=2, dims=(2, 2)))
+    jx = trace_chunk(s)
+    scoped = scoped_exchanges(jx.jaxpr)
+    ex_labels = [l for l in scoped if l.startswith("halo_exchange.")]
+    sh_labels = [l for l in scoped if l.startswith("halo_shift.")]
+    assert ex_labels, f"no scoped exchanges in {scoped}"
+    assert sh_labels, f"no scoped shifts in {scoped}"  # F/G donor edges
+    # one naming convention: every scope's strip token is a census key
+    strips = census(jx.jaxpr)["strips"]
+    for label in ex_labels:
+        token = label.split(".", 2)[2]
+        assert token in strips, (label, sorted(strips))
+
+
+def test_strip_key_convention():
+    import numpy as np
+
+    assert strip_key((4, 18), np.dtype("float32")) == "4x18:float32"
+    # commcheck's spelling routes through the same helper
+    from pampi_tpu.analysis.commcheck import strip_key as ck
+
+    assert ck((4, 18), np.dtype("float32")) == strip_key(
+        (4, 18), np.dtype("float32"))
+
+
+# ---------------------------------------------------------------------------
+# capture + the exchange probe + the artifact round-trip
+# ---------------------------------------------------------------------------
+
+def test_capture_emits_record(tel_on, tmp_path, monkeypatch):
+    """End-to-end on this container: capture() around a jitted region
+    emits one `xprof` record (trace mode when the profiler writes a
+    parseable trace-event file — this CPU backend does — wallclock
+    otherwise; both are legal, neither may crash)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("PAMPI_XPROF", str(tmp_path / "trace"))
+    with xprof.capture("unit.region", steps=7):
+        x = jax.jit(lambda a: a * 2 + 1)(jnp.ones((32, 32)))
+        x.block_until_ready()
+    recs = [r for r in _records(tel_on) if r["kind"] == "xprof"]
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["region"] == "unit.region" and r["steps"] == 7
+    assert r["mode"] in ("trace", "wallclock") and r["wall_ms"] > 0
+    if r["mode"] == "trace":
+        assert r["busy_ms"] >= 0 and isinstance(r["scopes"], dict)
+
+
+def test_capture_noop_when_unset(tel_on, monkeypatch):
+    monkeypatch.delenv("PAMPI_XPROF", raising=False)
+    with xprof.capture("unit.off"):
+        pass
+    if os.path.exists(tel_on):
+        assert not any(r["kind"] == "xprof" for r in _records(tel_on))
+
+
+def test_exchange_probe_and_bytes():
+    """The serial exchange probe prices and times the declared schedule;
+    the byte accounting composes from the shared comm helpers."""
+    comm = CartComm(ndims=2, dims=(2, 2))
+    rec = {"family": "ns2d_dist", "mesh": [2, 2], "shard": [8, 8],
+           "dtype": "float64", "path": "jnp",
+           "exchange_bytes_depth1": halo_exchange_bytes((8, 8), 1, 8),
+           "exchanges_per_step": {"depth1": 4, "shift": 2}}
+    # 4 full depth-1 exchanges + one single-direction strip per axis
+    want = 4 * halo_exchange_bytes((8, 8), 1, 8) + 2 * (10 * 1 * 8)
+    assert exchange_schedule_bytes(rec) == want
+    ms = time_exchange_ms(comm, rec, reps=2)
+    assert ms > 0
+
+
+def test_exchange_span_roundtrip(tel_on, tmp_path):
+    """A dist run with telemetry armed emits the `.exchange` span; the
+    record flows report -> merge -> artifact lint, and the comm-hidden
+    block lands in the artifact (wallclock mode here: no PAMPI_XPROF)."""
+    s = NS2DDistSolver(Parameter(**_BASE), CartComm(ndims=2, dims=(2, 2)))
+    s.run(progress=False)
+    tm.finalize()
+    recs = _records(tel_on)
+    spans = [r for r in recs if r["kind"] == "span"
+             and r["name"] == "ns2d_dist.exchange"]
+    assert len(spans) == 1
+    sp = spans[0]
+    assert sp["ms"] > 0 and sp["mode"] == "serial_probe"
+    assert sp["bytes_per_step"] == exchange_schedule_bytes(s._halo_record())
+
+    from tools import check_artifact as ca
+    from tools import telemetry_report as tr
+    from tools._artifact import write_merged
+
+    chf = tr.comm_hidden_fraction(recs)
+    assert chf["mode"] == "wallclock" and chf["hidden_fraction"] == 0.0
+    art = str(tmp_path / "BENCH_unit.json")
+    with open(art, "w") as fh:
+        json.dump({"n": 8, "cmd": "unit", "rc": 0, "tail": ""}, fh)
+    merged = write_merged(art, {"telemetry_summary": tr.summary(recs),
+                                "comm_hidden_fraction": chf})
+    assert ca.lint_bench(merged) == []
+    # a malformed hidden fraction is flagged
+    bad = dict(merged,
+               comm_hidden_fraction=dict(chf, hidden_fraction=1.7))
+    assert any("hidden_fraction" in e for e in ca.lint_bench(bad))

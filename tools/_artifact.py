@@ -1,9 +1,62 @@
 """Shared artifact-write helper for the perf tools: merge-preserving JSON
 (the committed artifacts carry curated analysis fields the tools do not
-produce — a re-run refreshes the measured keys without deleting those)."""
+produce — a re-run refreshes the measured keys without deleting those).
+
+Every write also NORMALIZES the artifact (`schema_version` +
+`metrics: [{name, value, unit, backend}]`, legacy keys untouched): the
+measured numbers used to live only in free-form `parsed*` blocks and
+`tail` strings, which made the perf trajectory machine-unreadable —
+`tools/bench_trend.py` reads the normalized list, and
+`tools/check_artifact.py` lints it."""
 
 import json
 import os
+
+ARTIFACT_SCHEMA_VERSION = 1
+
+
+def backend_tag(block: dict) -> str:
+    """The cpu/tpu platform tag of one metric block — the partition
+    bench_trend gates within (a CPU growth-container trend point must
+    never gate against a chip number). Inference order: an explicit
+    `backend` field ("pallas" and the on-TPU "jnp-fallback" are chip
+    runs; plain "jnp" is the off-TPU path; literal platform names pass
+    through), then the NS step lines' `phases` dispatch tag, then the
+    TPU-only decomposition contract (null solve_ms + a
+    decomposition_note = off-TPU)."""
+    b = str(block.get("backend", "") or "")
+    if b:
+        return "cpu" if b in ("jnp", "cpu") else "tpu"
+    phases = str(block.get("phases", "") or "")
+    if phases:
+        return "cpu" if "no TPU" in phases else "tpu"
+    if block.get("decomposition_note") and block.get("solve_ms") is None:
+        return "cpu"
+    return "tpu"
+
+
+def collect_metrics(rec: dict) -> list[dict]:
+    """The normalized metric list of one artifact: every dict-valued
+    block carrying {metric, value} (the bench.py JSON-line shape the
+    `parsed*` keys hold) becomes one {name, value, unit, backend} entry.
+    Deterministic from the record alone, so re-merges are stable."""
+    out = []
+    seen = set()
+    for block in rec.values():
+        if not isinstance(block, dict) or "metric" not in block \
+                or "value" not in block:
+            continue
+        name = str(block["metric"])
+        if name in seen:
+            continue
+        seen.add(name)
+        out.append({
+            "name": name,
+            "value": block["value"],
+            "unit": block.get("unit"),
+            "backend": backend_tag(block),
+        })
+    return out
 
 
 def merge_nested(old: dict, new: dict) -> dict:
@@ -47,7 +100,25 @@ def dist_step_decomposition(make_solver, key: str, reps: int = 3) -> dict:
 
     s = make_solver(None)  # production itermax build, records dispatch
     tag = dispatch.last(key)
-    base = {"phases": tag, "steps_timed": type(s).CHUNK}
+    base = {"phases": tag, "steps_timed": type(s).CHUNK,
+            "exchange_ms": None}
+    if hasattr(s, "_halo_record") and telemetry.enabled():
+        # the ROADMAP-mandated `exchange` span (serial critical-path cost
+        # of one step's declared halo schedule — the comm-hidden-fraction
+        # input next to the xprof device numbers); wall-clock, so it is
+        # recorded on every backend (off-TPU trend-only, like all walls)
+        from pampi_tpu.parallel.comm import (
+            exchange_schedule_bytes,
+            time_exchange_ms,
+        )
+
+        rec_h = s._halo_record()
+        ex_ms = time_exchange_ms(s.comm, rec_h)
+        telemetry.emit_span(f"{key}.exchange", ex_ms, path=rec_h["path"],
+                            mesh=rec_h["mesh"], shard=rec_h["shard"],
+                            bytes_per_step=exchange_schedule_bytes(rec_h),
+                            mode="serial_probe")
+        base["exchange_ms"] = round(ex_ms, 3)
     if jax.default_backend() != "tpu":
         # one key set on every path (itermax/note null rather than absent)
         # so write_merged re-runs across hosts never leave stale fields
@@ -91,11 +162,17 @@ def dist_step_decomposition(make_solver, key: str, reps: int = 3) -> dict:
 
 
 def write_merged(path: str, rec: dict) -> dict:
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if os.path.dirname(path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
     if os.path.exists(path):
         with open(path) as fh:
             old = json.load(fh)
         rec = merge_nested(old, rec)
+    # normalize on every write: schema version + the machine-readable
+    # metric list (regenerated from the merged record, so curated AND
+    # measured blocks both surface; legacy keys stay)
+    rec["schema_version"] = ARTIFACT_SCHEMA_VERSION
+    rec["metrics"] = collect_metrics(rec)
     with open(path, "w") as fh:
         json.dump(rec, fh, indent=2)
         fh.write("\n")

@@ -1,0 +1,208 @@
+"""Perf trend over the committed BENCH_r*.json artifacts + regression gate.
+
+    python tools/bench_trend.py [--tolerance F] [--json] [files...]
+
+Normalizes every BENCH artifact into one trend series (round -> metric ->
+value, backend-tagged) from the `metrics` list `tools/_artifact.py` writes
+(legacy artifacts fall back to the same normalizer over their `parsed*`
+blocks — never to `tail`-string scraping), renders the trajectory table,
+and FAILS (exit 1) when the newest point of any same-backend series
+regresses beyond the tolerance vs the best earlier point of that series.
+
+Backend partition: every point is tagged cpu|tpu
+(`tools/_artifact.backend_tag`), and series are keyed (metric, backend) —
+a CPU growth-container round can never gate against a chip number, and
+vice versa. Direction comes from the unit: `*/s` rates regress downward,
+`ms*` latencies regress upward; metrics with unknown units render in the
+table but do not gate.
+
+Runs as the `trend` pass of `tools/lint.py` (make lint / make
+bench-trend), so a perf-regressing PR fails on CPU before any TPU time
+is spent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_TOLERANCE = 0.10
+
+
+def default_files() -> list[str]:
+    return sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+
+
+def _round_of(path: str, rec: dict) -> int:
+    n = rec.get("n")
+    if isinstance(n, int):
+        return n
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def load_points(files: list[str]) -> list[dict]:
+    """Every artifact's normalized metric entries as trend points
+    ({round, name, value, unit, backend, file})."""
+    from tools._artifact import collect_metrics
+
+    pts = []
+    for path in files:
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"warning: {os.path.basename(path)} unreadable ({exc})",
+                  file=sys.stderr)
+            continue
+        if not isinstance(rec, dict):
+            continue
+        rnd = _round_of(path, rec)
+        metrics = rec.get("metrics")
+        if not isinstance(metrics, list) or not metrics:
+            # legacy artifact: run the same normalizer over its blocks
+            metrics = collect_metrics(rec)
+        for m in metrics:
+            if not isinstance(m, dict) or not isinstance(
+                    m.get("value"), (int, float)):
+                continue
+            pts.append({"round": rnd, "name": str(m.get("name")),
+                        "value": float(m["value"]),
+                        "unit": m.get("unit"),
+                        "backend": m.get("backend", "tpu"),
+                        "file": os.path.basename(path)})
+    return pts
+
+
+def build_series(points: list[dict]) -> dict:
+    """{(name, backend): [(round, value, unit), ...]} sorted by round;
+    a repeated round within one series keeps the last-loaded point."""
+    out: dict[tuple, dict] = {}
+    for p in points:
+        out.setdefault((p["name"], p["backend"]), {})[p["round"]] = (
+            p["value"], p["unit"])
+    return {
+        key: [(r, v, u) for r, (v, u) in sorted(rounds.items())]
+        for key, rounds in out.items()
+    }
+
+
+def higher_is_better(unit) -> bool | None:
+    """Gate direction from the unit; None = render-only (no gate)."""
+    u = str(unit or "")
+    if u.endswith("/s"):
+        return True
+    if u.startswith("ms") or "ms/" in u:
+        return False
+    return None
+
+
+def check_regressions(series: dict,
+                      tolerance: float = DEFAULT_TOLERANCE) -> list[str]:
+    """The gate: the NEWEST point of each (metric, backend) series vs the
+    best EARLIER same-series point. Returns one diagnostic per
+    regression beyond the tolerance."""
+    errs = []
+    for (name, backend), pts in sorted(series.items()):
+        if len(pts) < 2:
+            continue
+        direction = higher_is_better(pts[-1][2])
+        if direction is None:
+            continue
+        last_round, last, _ = pts[-1]
+        prior = [v for _, v, _ in pts[:-1]]
+        best = max(prior) if direction else min(prior)
+        if best == 0:
+            continue
+        ratio = last / best
+        bad = ratio < 1.0 - tolerance if direction \
+            else ratio > 1.0 + tolerance
+        if bad:
+            arrow = "dropped" if direction else "rose"
+            errs.append(
+                f"{name} [{backend}]: r{last_round:02d} = {last:.6g} "
+                f"{arrow} {abs(1.0 - ratio) * 100:.1f}% beyond the "
+                f"{tolerance * 100:.0f}% tolerance vs the best earlier "
+                f"point {best:.6g}")
+    return errs
+
+
+def render(series: dict) -> str:
+    """The trajectory table: one row per (metric, backend), one column
+    per round."""
+    rounds = sorted({r for pts in series.values() for r, _, _ in pts})
+    if not rounds:
+        return "no trend points\n"
+    name_w = max(len(f"{n} [{b}]") for n, b in series) + 2
+    head = "metric".ljust(name_w) + "".join(
+        f"{'r%02d' % r:>14}" for r in rounds)
+    lines = [head]
+    for (name, backend), pts in sorted(series.items()):
+        by_round = {r: v for r, v, _ in pts}
+        unit = pts[-1][2]
+        row = f"{name} [{backend}]".ljust(name_w) + "".join(
+            f"{by_round[r]:>14.5g}" if r in by_round else f"{'-':>14}"
+            for r in rounds)
+        lines.append(row + (f"  {unit}" if unit else ""))
+    return "\n".join(lines) + "\n"
+
+
+def lint(files=None, tolerance: float = DEFAULT_TOLERANCE) -> list[str]:
+    """The tools/lint.py `trend` pass entry point: diagnostics only
+    (empty = no regression). An EMPTY series set is itself a violation —
+    the whole point of the normalized schema is that the trend input
+    never parses to []."""
+    files = default_files() if files is None else files
+    if not files:
+        return ["no BENCH_r*.json artifacts found"]
+    series = build_series(load_points(files))
+    if not series:
+        return ["BENCH artifacts yielded zero trend points "
+                "(normalized `metrics` lists missing or empty)"]
+    return check_regressions(series, tolerance)
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="allowed fractional regression vs the best "
+                         "same-backend point (default 0.10)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the series as JSON instead of the table")
+    ap.add_argument("files", nargs="*",
+                    help="artifacts (default: the committed BENCH_r*.json)")
+    args = ap.parse_args(argv[1:])
+    files = args.files or default_files()
+    if not files:
+        print("no BENCH_r*.json artifacts found", file=sys.stderr)
+        return 2
+    series = build_series(load_points(files))
+    if args.json:
+        print(json.dumps(
+            {f"{n} [{b}]": [{"round": r, "value": v, "unit": u}
+                            for r, v, u in pts]
+             for (n, b), pts in sorted(series.items())}, indent=2))
+    else:
+        sys.stdout.write(render(series))
+    if not series:
+        print("zero trend points — BENCH artifacts carry no normalized "
+              "metrics", file=sys.stderr)
+        return 1
+    errs = check_regressions(series, args.tolerance)
+    for e in errs:
+        print(f"REGRESSION: {e}", file=sys.stderr)
+    if not errs:
+        print(f"trend ok: {len(series)} series, no regression beyond "
+              f"{args.tolerance * 100:.0f}%")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -18,7 +18,16 @@ Contracts:
   bench.py prints) need {metric, value, unit}; NS step-line blocks
   additionally carry the solve/non-solve decomposition keys (values may be
   null off-TPU — the bench.py contract — but the KEYS must exist).
+- BENCH + MULTICHIP both carry the normalized schema tools/_artifact.py
+  writes: {schema_version, metrics} with every metrics entry shaped
+  {name, value, unit, backend} and backend in {cpu, tpu} — the
+  machine-readable trend surface tools/bench_trend.py gates on.
 - MULTICHIP: {n_devices, rc, ok, skipped, tail} required.
+- xprof_summary / comm_hidden_fraction (optional until a PAMPI_XPROF run
+  merges them): the utils/xprof record shape ({mode, ...; trace mode
+  additionally scopes/collectives/exchange_device_ms}) and the ROADMAP
+  item 2 block ({mode, steps, exchange device/exposed/serial per-step,
+  hidden_fraction}).
 - telemetry_summary (optional until a run emits one): the
   tools/telemetry_report.summary shape — {schema_version, dispatch,
   chunks, records}; when the PR 4 resilience blocks are present,
@@ -57,6 +66,70 @@ def _missing(d: dict, keys, where: str) -> list[str]:
 
 CKPT_EVENTS = ("save", "rotate", "load", "reject", "skip")
 
+METRIC_ENTRY = ("name", "value", "unit", "backend")
+CHF_KEYS = ("mode", "steps", "exchange_device_ms_per_step",
+            "exchange_exposed_ms_per_step", "exchange_serial_ms_per_step",
+            "hidden_fraction")
+XPROF_TRACE_KEYS = ("scopes", "collectives", "exchange_device_ms",
+                    "exchange_exposed_ms")
+
+
+def lint_normalized(d: dict, where: str) -> list[str]:
+    """The tools/_artifact.py normalized-schema keys every BENCH/MULTICHIP
+    artifact carries: schema_version + the machine-readable metrics list
+    bench_trend reads (so the perf trajectory never degrades back to
+    tail-string scraping)."""
+    errs = _missing(d, ("schema_version", "metrics"), where)
+    metrics = d.get("metrics")
+    if "metrics" not in d:
+        return errs
+    if not isinstance(metrics, list):
+        # a null metrics is the same degradation as a missing one: the
+        # trend input must always be a machine-readable list
+        return errs + [f"{where}.metrics: not a list"]
+    for i, m in enumerate(metrics):
+        if not isinstance(m, dict):
+            errs.append(f"{where}.metrics[{i}]: not a dict")
+            continue
+        errs += _missing(m, METRIC_ENTRY, f"{where}.metrics[{i}]")
+        if m.get("backend") not in ("cpu", "tpu"):
+            errs.append(f"{where}.metrics[{i}].backend: "
+                        f"{m.get('backend')!r} not cpu|tpu")
+    return errs
+
+
+def lint_xprof_summary(d: dict, where: str) -> list[str]:
+    errs = _missing(d, ("mode",), where)
+    if d.get("mode") == "trace":
+        errs += _missing(d, XPROF_TRACE_KEYS, where)
+        for key in ("scopes", "collectives"):
+            if key in d and not isinstance(d[key], dict):
+                errs.append(f"{where}.{key}: not a dict")
+    return errs
+
+
+def lint_comm_hidden(d: dict, where: str) -> list[str]:
+    errs = _missing(d, CHF_KEYS, where)
+    h = d.get("hidden_fraction")
+    if h is not None and not (isinstance(h, (int, float))
+                              and 0.0 <= h <= 1.0):
+        errs.append(f"{where}.hidden_fraction: {h!r} not in [0, 1]")
+    return errs
+
+
+def _lint_optional_blocks(d: dict, where: str) -> list[str]:
+    errs = []
+    for key, fn in (("xprof_summary", lint_xprof_summary),
+                    ("comm_hidden_fraction", lint_comm_hidden)):
+        block = d.get(key)
+        if block is None:
+            continue
+        if not isinstance(block, dict):
+            errs.append(f"{where}.{key}: not a dict")
+        else:
+            errs += fn(block, f"{where}.{key}")
+    return errs
+
 
 def lint_telemetry_summary(d: dict, where: str) -> list[str]:
     errs = _missing(d, SUMMARY_REQUIRED, where)
@@ -94,6 +167,8 @@ def lint_bench(d: dict, where: str = "BENCH") -> list[str]:
     if isinstance(d.get("telemetry_summary"), dict):
         errs += lint_telemetry_summary(
             d["telemetry_summary"], f"{where}.telemetry_summary")
+    errs += lint_normalized(d, where)
+    errs += _lint_optional_blocks(d, where)
     return errs
 
 
@@ -102,6 +177,8 @@ def lint_multichip(d: dict, where: str = "MULTICHIP") -> list[str]:
     if isinstance(d.get("telemetry_summary"), dict):
         errs += lint_telemetry_summary(
             d["telemetry_summary"], f"{where}.telemetry_summary")
+    errs += lint_normalized(d, where)
+    errs += _lint_optional_blocks(d, where)
     return errs
 
 

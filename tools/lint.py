@@ -22,6 +22,10 @@ Five analysis passes plus the artifact lint (all by default, `make lint`):
   artifacts  the committed BENCH/MULTICHIP/CONTRACTS schema lint
              (tools/check_artifact.py) — CI, the test suite and this
              driver share the one analysis layer
+  trend      the BENCH perf-trend regression gate (tools/bench_trend.py):
+             the newest point of every (metric, backend) series vs the
+             best earlier same-backend point — a perf-regressing PR
+             fails on CPU before any TPU time is spent
 
 The jaxpr/comm/pallas passes share ONE trace of the config matrix per
 run (`jaxprcheck.trace_matrix`). `--only comm` is the overlap refactor's
@@ -47,7 +51,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CONTRACTS = os.path.join(REPO, "CONTRACTS.json")
 
-PASSES = ("ast", "halo", "jaxpr", "comm", "pallas", "artifacts")
+PASSES = ("ast", "halo", "jaxpr", "comm", "pallas", "artifacts", "trend")
 TRACE_PASSES = ("jaxpr", "comm", "pallas")
 
 # the pinned trace environment — must precede any jax import
@@ -106,6 +110,15 @@ def run_artifacts() -> list:
         errs += [Violation(os.path.basename(path), 1, "artifact", e)
                  for e in ca.lint_file(path)]
     return errs
+
+
+def run_trend() -> list:
+    from pampi_tpu.analysis.astlint import Violation
+
+    import bench_trend as bt
+
+    return [Violation("BENCH_r*.json", 1, "bench-trend", e)
+            for e in bt.lint()]
 
 
 class TraceContext:
@@ -266,6 +279,8 @@ def main(argv) -> int:
             vs = ctx.run_comm()
         elif name == "pallas":
             vs = ctx.run_pallas(args.vmem_budget)
+        elif name == "trend":
+            vs = run_trend()
         else:
             # the artifact lint reads CONTRACTS.json from disk — flush a
             # pending --update first so it lints the regenerated baseline

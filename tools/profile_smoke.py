@@ -1,0 +1,74 @@
+"""Profile smoke: a tiny instrumented dist-NS run + trace ingestion on
+whatever backend this host has (make profile-smoke — CPU-safe).
+
+    python tools/profile_smoke.py [outdir]
+
+Arms PAMPI_TELEMETRY + PAMPI_XPROF (defaults under results/profile_smoke/),
+drives a 16² NS2D dist chunk loop, and renders the resulting flight
+record — proving the whole device-time observability plane end-to-end:
+trace capture, trace-event ingestion (utils/xprof), the `exchange` span,
+the `xprof` record, and the comm-hidden-fraction block — before any TPU
+time is spent. Exit 1 if the run produced no xprof record or no exchange
+span (the plane is broken, not merely quiet).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU-stable smoke environment: must precede any jax import (the
+# tools/lint.py convention); a TPU image just keeps its own backend
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+
+def main(argv: list[str]) -> int:
+    outdir = argv[1] if len(argv) > 1 else os.path.join(
+        REPO, "results", "profile_smoke")
+    os.makedirs(outdir, exist_ok=True)
+    jsonl = os.path.join(outdir, "run.jsonl")
+    if os.path.exists(jsonl):
+        os.remove(jsonl)
+    os.environ["PAMPI_TELEMETRY"] = jsonl
+    os.environ["PAMPI_XPROF"] = os.path.join(outdir, "trace")
+
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.utils import telemetry as tm
+    from pampi_tpu.utils.params import Parameter
+
+    tm.reset()
+    tm.start_run(tool="profile_smoke")
+    param = Parameter(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02,
+                      tau=0.5, itermax=10, eps=1e-4, omg=1.7, gamma=0.9)
+    s = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2)))
+    s.run(progress=False)
+    tm.finalize()
+
+    from tools import telemetry_report as tr
+
+    records = tr.load(jsonl)
+    sys.stdout.write(tr.render(records))
+    kinds = {r.get("kind") for r in records}
+    spans = [r for r in records if r.get("kind") == "span"
+             and str(r.get("name", "")).endswith(".exchange")]
+    chf = tr.comm_hidden_fraction(records)
+    print(f"\nsmoke: nt={s.nt} kinds={sorted(kinds)}")
+    print(f"smoke: comm_hidden_fraction = {json.dumps(chf)}")
+    if "xprof" not in kinds:
+        print("FAIL: no xprof record (capture or ingestion broken)",
+              file=sys.stderr)
+        return 1
+    if not spans:
+        print("FAIL: no .exchange span", file=sys.stderr)
+        return 1
+    print(f"smoke ok -> {jsonl}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -8,11 +8,18 @@ dispatch decisions, build/trace walls, per-chunk solver health
 plus the PR 4 resilience records (rollback-recovery attempts, retry-budget
 consumptions, checkpoint save/rotate/load/reject events), the shared
 decomposition spans, static halo-exchange byte counts, driver solve
-records, and the profiling region table. `--merge <path>` folds the
-machine-readable summary block into a BENCH_rXX/MULTICHIP_rXX artifact
-under the `telemetry_summary` key via tools/_artifact.write_merged (the
-merge-preserving convention), so on-chip sessions commit one artifact that
-carries both the measured headline and the run's flight record.
+records, the device-time profiling plane (`xprof` records: per-scope /
+per-collective / per-kernel device ms and the exchange device-vs-exposed
+split), and the profiling region table. A telemetry write-failure
+truncation (`finalize.dropped_records`) is surfaced loudly — a clipped
+flight record must never read as a quiet run.
+
+`--merge <path>` folds the machine-readable blocks into a
+BENCH_rXX/MULTICHIP_rXX artifact via tools/_artifact.write_merged (the
+merge-preserving convention): `telemetry_summary`, plus — when the run
+captured them — a top-level `xprof_summary` and the `comm_hidden_fraction`
+block ROADMAP item 2 is measured by (exchange device time vs its exposed
+critical-path share vs the serial-probe `.exchange` span).
 """
 
 from __future__ import annotations
@@ -131,8 +138,71 @@ def summary(records: list[dict]) -> dict:
             k["finalize"][-1].get("profile_regions")
             if k.get("finalize") else None
         ),
+        "dropped_records": (
+            k["finalize"][-1].get("dropped_records")
+            if k.get("finalize") else None
+        ),
+        # the xprof block deliberately does NOT ride here: --merge writes
+        # it once as the top-level `xprof_summary` (the linted contract)
     }
     return out
+
+
+def xprof_summary(records: list[dict]):
+    """The last captured device-trace region, cleaned for the artifact
+    (`xprof_summary` top-level block; tools/check_artifact.py lints it)."""
+    xs = [r for r in records if r.get("kind") == "xprof"]
+    if not xs:
+        return None
+    return {key: val for key, val in xs[-1].items()
+            if key not in ("v", "kind", "ts")}
+
+
+def comm_hidden_fraction(records: list[dict]):
+    """The ROADMAP item 2 measurement block: how much of the halo
+    exchange hides behind compute. Inputs are the run's last `xprof`
+    record (exchange device ms vs its exposed — critical-path — share,
+    from the device trace) and the last `<family>.exchange` span (the
+    serial probe: what the schedule costs when nothing overlaps it).
+    hidden_fraction = 1 - exposed/device; today's serial schedule
+    measures ~0 — the comm/compute-overlap refactor is judged by how far
+    it rises. In wall-clock (degraded) mode only the serial probe
+    exists: device == exposed == serial, hidden 0."""
+    x = xprof_summary(records) or {}
+    spans = [s for s in records if s.get("kind") == "span"
+             and str(s.get("name", "")).endswith(".exchange")]
+    serial = spans[-1].get("ms") if spans else None
+    dev = x.get("exchange_device_ms")
+    exp = x.get("exchange_exposed_ms")
+    steps = x.get("steps")
+    if not dev and serial is None:
+        return None
+    if x.get("mode") == "trace":
+        # trace mode: device/exposed are TOTALS over the captured region,
+        # normalized per step here; the serial span is per-step already.
+        # A trace that attributed ZERO exchange time stays mode "trace"
+        # with hidden None — an attribution failure (scope naming drift,
+        # a single-device capture) must surface as nulls, never be
+        # dressed up as a clean degraded measurement.
+        def per_step(v):
+            return round(v / steps, 4) if (v is not None and steps) else None
+
+        dev_ps, exp_ps = per_step(dev), per_step(exp)
+        hidden = (round(max(0.0, 1.0 - (exp or 0.0) / dev), 4)
+                  if dev else None)
+        mode = "trace"
+    else:
+        # degraded: only the serial probe exists — fully exposed
+        dev_ps = exp_ps = serial
+        hidden, mode = 0.0, "wallclock"
+    return {
+        "mode": mode,
+        "steps": steps,
+        "exchange_device_ms_per_step": dev_ps,
+        "exchange_exposed_ms_per_step": exp_ps,
+        "exchange_serial_ms_per_step": serial,
+        "hidden_fraction": hidden,
+    }
 
 
 def render(records: list[dict]) -> str:
@@ -238,6 +308,37 @@ def render(records: list[dict]) -> str:
                    if h.get("deep_halo") else "")
                 + f" per-step={h.get('exchanges_per_step')}")
 
+    if k.get("xprof"):
+        add("== device trace (xprof) ==")
+        for x in k["xprof"]:
+            add(f"  region={x.get('region')} mode={x.get('mode')} "
+                f"steps={x.get('steps')} wall={x.get('wall_ms')}ms "
+                f"tracks={x.get('tracks')} busy={x.get('busy_ms')}ms "
+                f"idle={x.get('idle_ms')}ms")
+            for title, block in (("scopes", x.get("scopes")),
+                                 ("collectives", x.get("collectives")),
+                                 ("kernels", x.get("kernels"))):
+                if not block:
+                    continue
+                add(f"  -- {title} --")
+                for name, ms in sorted(block.items(),
+                                       key=lambda kv: -_num(kv[1])):
+                    add(f"    {name:<44} {_num(ms):>10.3f} ms")
+        chf = comm_hidden_fraction(records)
+        if chf:
+            add("== comm-hidden fraction ==")
+            add(f"  mode={chf['mode']} "
+                f"device={chf['exchange_device_ms_per_step']} ms/step "
+                f"exposed={chf['exchange_exposed_ms_per_step']} ms/step "
+                f"serial-probe={chf['exchange_serial_ms_per_step']} ms "
+                f"hidden={chf['hidden_fraction']}")
+
+    fin = k["finalize"][-1] if k.get("finalize") else {}
+    if fin.get("dropped_records"):
+        add("== TRUNCATED FLIGHT RECORD ==")
+        add(f"  {fin['dropped_records']} record(s) dropped by telemetry "
+            "write failures — this run's record is incomplete, not quiet")
+
     prof = (k["finalize"][-1].get("profile_regions")
             if k.get("finalize") else None)
     if prof:
@@ -271,7 +372,14 @@ def main(argv: list[str]) -> int:
     if merge_to:
         from tools._artifact import write_merged
 
-        write_merged(merge_to, {"telemetry_summary": summary(records)})
+        block = {"telemetry_summary": summary(records)}
+        xp = xprof_summary(records)
+        if xp is not None:
+            block["xprof_summary"] = xp
+        chf = comm_hidden_fraction(records)
+        if chf is not None:
+            block["comm_hidden_fraction"] = chf
+        write_merged(merge_to, block)
     return 0
 
 
