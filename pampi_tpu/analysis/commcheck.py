@@ -55,7 +55,7 @@ trace).
 from __future__ import annotations
 
 from .astlint import Violation
-from .jaxprcheck import _anchor, iter_eqns
+from .jaxprcheck import _anchor, count_prim, iter_eqns
 
 RULE_COUNT = "comm-collective"
 RULE_BYTES = "comm-bytes"
@@ -144,6 +144,128 @@ def diff_counts(old: dict, new: dict, kind: str) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# the overlap-schedule checker (ROADMAP item 2)
+# ---------------------------------------------------------------------------
+
+def _deep_strip_keys(rec: dict) -> set[str]:
+    """Strip-key tokens of the record's deep-exchange messages on the
+    partitioned axes — the shapes that identify the STEP-LEVEL deep
+    exchange among a chunk's ppermutes (the solve's internal exchanges
+    travel at other depths)."""
+    from ..parallel.comm import halo_strip_shapes
+
+    import numpy as np
+
+    if "deep_halo" not in rec:
+        return set()
+    shard = tuple(rec["shard"])
+    mesh = tuple(rec["mesh"])
+    dtype = np.dtype(rec["dtype"])
+    return {
+        strip_key(shape, dtype)
+        for ax, shape in enumerate(halo_strip_shapes(shard,
+                                                     rec["deep_halo"]))
+        if mesh[ax] > 1
+    }
+
+
+def _find_chunk_loop(jaxpr):
+    """(enclosing jaxpr, while eqn) of the outermost while whose body
+    dispatches a pallas_call — the chunk step loop. None when the
+    program has no such loop (jnp solve paths still qualify via the
+    fused PRE/POST kernels)."""
+    for e in jaxpr.eqns:
+        if e.primitive.name == "while":
+            body = e.params["body_jaxpr"].jaxpr
+            if count_prim(body, "pallas_call"):
+                return jaxpr, e
+        for v in e.params.values():
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vals:
+                inner = None
+                if type(x).__name__ == "ClosedJaxpr":
+                    inner = x.jaxpr
+                elif type(x).__name__ == "Jaxpr":
+                    inner = x
+                if inner is not None:
+                    found = _find_chunk_loop(inner)
+                    if found is not None:
+                        return found
+    return None
+
+
+def overlap_schedule_violations(closed, rec: dict) -> list[str]:
+    """Static proof that a chunk program carries the DOUBLE-BUFFERED
+    overlap schedule (models/ns*_dist step_overlap; `make profile-smoke`
+    and tests assert through this one helper):
+
+    1. the chunk's step loop posts the deep exchange but no pallas_call
+       of the same iteration consumes its results (forward dataflow
+       taint over the flat loop body — the ppermutes feed only the loop
+       carry, i.e. next iteration's boundary half), and
+    2. a prologue deep exchange precedes the loop (the first
+       double-buffer generation is filled before step 1 consumes it).
+
+    Together these pin "exchange posted before the compute that could
+    hide it": within the traced schedule the exchange is no longer
+    serialized against the kernels — the structural precondition for a
+    nonzero comm-hidden fraction on chip. Returns diagnostics (empty =
+    the schedule holds); a SERIAL fused chunk fails check 1 (its PRE
+    kernel consumes the same-step exchange) — the negative control the
+    mutation test pins."""
+    deep_keys = _deep_strip_keys(rec)
+    if not deep_keys:
+        return ["halo record declares no deep exchange on a partitioned "
+                "axis — the overlap schedule has nothing to check"]
+    jaxpr = closed.jaxpr
+
+    def is_deep_ppermute(e):
+        if e.primitive.name != "ppermute":
+            return False
+        aval = e.invars[0].aval
+        return strip_key(aval.shape, aval.dtype) in deep_keys
+
+    found = _find_chunk_loop(jaxpr)
+    if found is None:
+        return ["chunk program has no pallas-dispatching step loop"]
+    level, while_eqn = found
+    body = while_eqn.params["body_jaxpr"].jaxpr
+    errs = []
+    # (1) dataflow: deep ppermute results must not reach any pallas_call
+    # of the same iteration (nested eqns treated atomically — taint
+    # flows through them conservatively)
+    deep_eqns = [e for e in body.eqns if is_deep_ppermute(e)]
+    if not deep_eqns:
+        errs.append(
+            "step loop body carries no deep-strip ppermute "
+            f"({sorted(deep_keys)}) — the step-level exchange vanished")
+    tainted: set[int] = set()
+    for e in body.eqns:
+        if is_deep_ppermute(e):
+            tainted.update(id(v) for v in e.outvars)
+            continue
+        hit = any(id(v) in tainted for v in e.invars)
+        if hit:
+            if e.primitive.name == "pallas_call":
+                errs.append(
+                    "a deep-exchange ppermute result feeds a pallas_call "
+                    "in the SAME iteration — the exchange is serialized "
+                    "against the kernel, not double-buffered")
+            tainted.update(id(v) for v in e.outvars)
+    # (2) the prologue exchange fills the first buffer generation
+    before = []
+    for e in level.eqns:
+        if e is while_eqn:
+            break
+        before.append(e)
+    if not any(is_deep_ppermute(e) for e in before):
+        errs.append(
+            "no prologue deep exchange precedes the step loop — the "
+            "first iteration's double buffer is never filled")
+    return errs
+
+
+# ---------------------------------------------------------------------------
 # the telemetry cross-check
 # ---------------------------------------------------------------------------
 
@@ -153,9 +275,12 @@ def _expected_strips(rec: dict) -> list[tuple[str, int, bool]]:
     mesh dim is 1 exchange nothing (`_exchange_axis` short-circuits) and
     are skipped. The deep fused exchange is checked EXACTLY — its strip
     shape is unique to the deep block, so a duplicated deep exchange
-    cannot hide. The depth-1 class is checked at-least: its strip shape
-    is shared with the staggered shifts and with depth-1 exchanges inside
-    solve/POST plumbing the record deliberately excludes."""
+    cannot hide; the overlapped schedule's once-per-chunk prologue
+    exchanges (`exchanges_per_chunk`, the double-buffer fill) trace into
+    the same chunk program and are added to the exact count. The depth-1
+    class is checked at-least: its strip shape is shared with the
+    staggered shifts and with depth-1 exchanges inside solve/POST
+    plumbing the record deliberately excludes."""
     from ..parallel.comm import halo_strip_shapes
 
     import numpy as np
@@ -164,13 +289,14 @@ def _expected_strips(rec: dict) -> list[tuple[str, int, bool]]:
     mesh = tuple(rec["mesh"])
     dtype = np.dtype(rec["dtype"])
     per_step = rec.get("exchanges_per_step", {})
+    per_chunk = rec.get("exchanges_per_chunk", {})
     out = []
     if "deep" in per_step:
         shapes = halo_strip_shapes(shard, rec["deep_halo"])
+        deep = per_step["deep"] + per_chunk.get("deep", 0)
         for ax, shape in enumerate(shapes):
             if mesh[ax] > 1:
-                out.append((strip_key(shape, dtype),
-                            2 * per_step["deep"], True))
+                out.append((strip_key(shape, dtype), 2 * deep, True))
     if "depth1" in per_step:
         shapes = halo_strip_shapes(shard, 1)
         # one staggered shift per axis (F/G/H donor edges) shares the

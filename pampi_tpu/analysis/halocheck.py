@@ -213,21 +213,24 @@ def _ca3d_entry(n: int) -> HaloEntry:
     )
 
 
-def _pre2d_entry(shard: str, obstacles: bool = False) -> HaloEntry:
+def _pre2d_entry(shard: str, obstacles: bool = False,
+                 size: int = 6) -> HaloEntry:
     """The fused 2-D PRE chain (deep-halo kernel): the same window
     formulas _pre_kernel stores, in its order — wall BCs, special BC,
     obstacle velocity BC, F/G predictor, wall fixups, obstacle F/G mask,
     RHS with the local-interior clip. The dependency cone of the outputs
     restricted to the shard's OWNED interior must stay within FUSE_CHAIN
-    layers — the per-step validity budget the deep exchange covers."""
+    layers — the per-step validity budget the deep exchange covers.
+    `size` widens the shard (the overlap-interior entry needs one wide
+    enough for a non-empty interior region)."""
     import jax.numpy as jnp
     import numpy as np
 
     from ..ops import ns2d as ops
     from ..ops import ns2d_fused as nf
 
-    jl = il = 6
-    gjmax = gimax = 24
+    jl = il = size
+    gjmax = gimax = max(24, 2 * size)
     ext_pad = nf.FUSE_DEEP_HALO - 1
     rows = jl + 2 + 2 * ext_pad
     cols = il + 2 + 2 * ext_pad
@@ -331,7 +334,7 @@ def _post2d_entry() -> HaloEntry:
     )
 
 
-def _pre3d_entry() -> HaloEntry:
+def _pre3d_entry(size: int = 4) -> HaloEntry:
     """The fused 3-D PRE chain (same structure as _pre2d_entry, on a
     dcavity3d lid shard) against the shared FUSE_CHAIN declaration."""
     import jax.numpy as jnp
@@ -340,8 +343,8 @@ def _pre3d_entry() -> HaloEntry:
     from ..ops import ns3d_fused as nf3
     from ..ops.ns3d import FACES
 
-    kl = jl = il = 4
-    gmax = 12
+    kl = jl = il = size
+    gmax = max(12, 3 * size)
     ext_pad = nf3.FUSE_DEEP_HALO - 1
     ext = (kl + 2 + 2 * ext_pad, jl + 2 + 2 * ext_pad,
            il + 2 + 2 * ext_pad)
@@ -406,6 +409,87 @@ def _pre3d_entry() -> HaloEntry:
     )
 
 
+def _overlap_box(local_extents, ext_pad: int, rim: int):
+    """The overlap interior region (parallel/overlap.interior_slices)
+    mapped into a PRE entry's deep-block index frame."""
+    from ..parallel.overlap import interior_slices
+
+    return tuple(
+        slice(s.start + ext_pad, s.stop + ext_pad)
+        for s in interior_slices(local_extents, rim)
+    )
+
+
+def overlap_interior_entry_2d(smuggle: int = 0) -> HaloEntry:
+    """The overlapped 2-D PRE's INTERIOR half: the same chain, owned box
+    restricted to the interior-merge region (parallel/overlap.py). Its
+    measured footprint must stay within FUSE_CHAIN of that box — i.e.
+    strictly clear of the exchanged deep strips, which sit one layer
+    further out. This is the contract that makes the interior half safe
+    to compute on the STALE double buffer: a smuggled read reaching the
+    strips measures FUSE_CHAIN + 1 and fails with the kernel's
+    file:line. `smuggle > 0` (mutation-test hook) forges exactly that —
+    a read `smuggle` layers past the validity chain."""
+    import jax.numpy as jnp
+
+    from ..ops import ns2d_fused as nf
+
+    jl = il = 12
+    base = _pre2d_entry("interior", size=jl)
+    ext_pad = nf.FUSE_DEEP_HALO - 1
+    owned = _overlap_box((jl, il), ext_pad, nf.OVERLAP_RIM)
+    fn = base.fn
+    if smuggle:
+        base_fn = base.fn
+
+        def fn(u, v):
+            u = u + 1e-3 * jnp.roll(u, nf.FUSE_CHAIN + smuggle, axis=0)
+            return base_fn(u, v)
+
+    return HaloEntry(
+        name="ns2d_fused.PRE[overlap interior half"
+             + (", smuggled]" if smuggle else "]"),
+        fn=fn,
+        in_shapes=base.in_shapes,
+        owned=owned,
+        declared=nf.FUSE_CHAIN,
+        anchor=base.anchor,
+        note="overlap interior region: cone must exclude the exchanged "
+             "deep strips (stale-buffer safety, parallel/overlap.py)",
+    )
+
+
+def overlap_interior_entry_3d(smuggle: int = 0) -> HaloEntry:
+    """The 3-D twin of overlap_interior_entry_2d."""
+    import jax.numpy as jnp
+
+    from ..ops import ns3d_fused as nf3
+
+    size = 8
+    base = _pre3d_entry(size=size)
+    ext_pad = nf3.FUSE_DEEP_HALO - 1
+    owned = _overlap_box((size, size, size), ext_pad, nf3.OVERLAP_RIM)
+    fn = base.fn
+    if smuggle:
+        base_fn = base.fn
+
+        def fn(u, v, w):
+            u = u + 1e-3 * jnp.roll(u, nf3.FUSE_CHAIN + smuggle, axis=0)
+            return base_fn(u, v, w)
+
+    return HaloEntry(
+        name="ns3d_fused.PRE[overlap interior half"
+             + (", smuggled]" if smuggle else "]"),
+        fn=fn,
+        in_shapes=base.in_shapes,
+        owned=owned,
+        declared=nf3.FUSE_CHAIN,
+        anchor=base.anchor,
+        note="overlap interior region: cone must exclude the exchanged "
+             "deep strips (stale-buffer safety, parallel/overlap.py)",
+    )
+
+
 def standard_entries() -> list:
     """The production registry: every deep-halo contract the dispatch
     layer relies on. Kept cheap (tiny blocks, one linearization each) so
@@ -421,6 +505,8 @@ def standard_entries() -> list:
         _pre2d_entry("interior", obstacles=True),
         _post2d_entry(),
         _pre3d_entry(),
+        overlap_interior_entry_2d(),
+        overlap_interior_entry_3d(),
     ]
 
 
@@ -436,7 +522,9 @@ def pre_chain_footprint(seed: int = 0) -> int:
     slack can only shrink loudly."""
     depth = 0
     for entry in standard_entries():
-        if ".PRE" not in entry.name:
+        if ".PRE" not in entry.name or "[overlap" in entry.name:
+            # the overlap-interior entries re-check the SAME chain on a
+            # restricted box; including them would double-count
             continue
         depth = max(depth, max(measure(entry, seed=seed).values()))
     return depth

@@ -204,9 +204,11 @@ class ChunkConfig:
     - `derive=True`: the expected count is DERIVED from the recorded
       dispatch decisions — 2 for a `pallas_fused` phase decision, +1 for
       a folded p layout, +1 for a solve whose dispatch record starts with
-      "pallas" (`solve_key`). This is the per-decision contract: whatever
-      the dispatcher chose, the trace must contain exactly the kernels
-      that choice implies.
+      "pallas" (`solve_key`), +1 for an overlapped schedule
+      (`overlap_key`: the PRE kernel runs as interior + boundary
+      halves). This is the per-decision contract: whatever the
+      dispatcher chose, the trace must contain exactly the kernels that
+      choice implies.
     - neither: only the env-keyed baseline pins the count (single-device
       solve paths that record no dispatch decision).
 
@@ -221,6 +223,7 @@ class ChunkConfig:
     phases_key: str = ""
     fold_key: str = ""
     solve_key: str = ""
+    overlap_key: str = ""
     dispatch_keys: tuple = ()
     notes: str = ""
 
@@ -259,8 +262,8 @@ _OBS = dict(name="canal_obstacle", imax=24, jmax=12, re=10.0, te=0.02,
 
 def standard_configs() -> list[ChunkConfig]:
     """The dispatch matrix: jnp/fused × single/dist × plain/obstacle/
-    ragged × explicit/folded p layout. Grids are 16²/8³ — each config is
-    one trace, no compile."""
+    ragged × explicit/folded p layout × serial/overlapped exchange
+    schedule. Grids are 16²/8³ — each config is one trace, no compile."""
     return [
         ChunkConfig(
             "ns2d_jnp", "ns2d",
@@ -292,33 +295,47 @@ def standard_configs() -> list[ChunkConfig]:
             dict(_B2, tpu_fuse_phases="off", tpu_solver="sor",
                  tpu_sor_layout="checkerboard"),
             dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
-            solve_key="ns2d_dist",
-            dispatch_keys=("ns2d_dist_phases", "ns2d_dist")),
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist")),
         ChunkConfig(
             "ns2d_dist_fused", "ns2d_dist",
             dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
                  tpu_sor_layout="checkerboard"),
             dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
-            solve_key="ns2d_dist",
-            dispatch_keys=("ns2d_dist_phases", "ns2d_dist"),
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist"),
             notes="fused dist: PRE + POST per shard + whatever the solve "
                   "dispatch chose"),
+        ChunkConfig(
+            "ns2d_dist_overlap", "ns2d_dist",
+            dict(_B2, tpu_fuse_phases="on", tpu_overlap="on",
+                 tpu_solver="sor", tpu_sor_layout="checkerboard"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist"),
+            notes="double-buffered overlap: interior + boundary PRE "
+                  "halves, the step N+1 deep exchange posted after POST "
+                  "(ppermutes feed only the loop carry)"),
         ChunkConfig(
             "ns2d_dist_ragged_fused", "ns2d_dist",
             dict(_B2, imax=18, jmax=18, tpu_fuse_phases="on",
                  tpu_solver="sor", tpu_sor_layout="checkerboard"),
             dims=(4, 2), derive=True, phases_key="ns2d_dist_phases",
-            solve_key="ns2d_dist",
-            dispatch_keys=("ns2d_dist_phases", "ns2d_dist"),
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist"),
             notes="ragged shards ride the same kernels at uneven bounds"),
         ChunkConfig(
             "ns2d_dist_obstacle_fused", "ns2d_dist",
             dict(_OBS, tpu_fuse_phases="on", tpu_solver="sor",
                  tpu_sor_layout="checkerboard"),
             dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
-            solve_key="obstacle_dist",
+            solve_key="obstacle_dist", overlap_key="overlap_ns2d_dist",
             dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
-                           "obstacle_dist"),
+                           "obstacle_dist", "overlap_ns2d_dist"),
             notes="dist obstacle flags compose via call-time flag blocks"),
         ChunkConfig(
             "ns3d_jnp", "ns3d",
@@ -332,8 +349,20 @@ def standard_configs() -> list[ChunkConfig]:
             "ns3d_dist_fused", "ns3d_dist",
             dict(_B3, tpu_fuse_phases="on", tpu_solver="sor"),
             dims=(2, 2, 2), derive=True, phases_key="ns3d_dist_phases",
-            solve_key="ns3d_dist",
-            dispatch_keys=("ns3d_dist_phases", "ns3d_dist")),
+            solve_key="ns3d_dist", overlap_key="overlap_ns3d_dist",
+            dispatch_keys=("ns3d_dist_phases", "ns3d_dist",
+                           "overlap_ns3d_dist")),
+        ChunkConfig(
+            "ns3d_dist_overlap", "ns3d_dist",
+            dict(_B3, tpu_fuse_phases="on", tpu_overlap="on",
+                 tpu_solver="sor"),
+            dims=(2, 2, 2), derive=True, phases_key="ns3d_dist_phases",
+            solve_key="ns3d_dist", overlap_key="overlap_ns3d_dist",
+            dispatch_keys=("ns3d_dist_phases", "ns3d_dist",
+                           "overlap_ns3d_dist"),
+            notes="the 3-D overlapped schedule (4-cell shards: interior "
+                  "region empty, boundary half covers the block — "
+                  "degenerate but schedule-correct)"),
     ]
 
 
@@ -352,6 +381,8 @@ def expected_launches(cfg: ChunkConfig, decisions: dict):
         n += 1
     if (decisions.get(cfg.solve_key) or "").startswith("pallas"):
         n += 1
+    if (decisions.get(cfg.overlap_key) or "").startswith("overlap"):
+        n += 1  # the PRE kernel runs twice: interior + boundary halves
     return n, "derived"
 
 

@@ -500,6 +500,20 @@ class NS2DDistSolver:
             except ValueError as exc:  # VMEM-infeasible shard geometry
                 _dispatch.record("ns2d_dist_phases", f"jnp ({exc})")
 
+        # -- comm/compute overlap (ROADMAP item 2): the double-buffered
+        # interior/boundary schedule rides the fused deep-halo step only;
+        # the serial schedule stays the parity oracle (`off` is bitwise
+        # the historical program — the CONTRACTS.json hash contract)
+        ovl_why = None
+        if fused_k is None:
+            ovl_why = "needs the fused deep-halo step (tpu_fuse_phases)"
+        elif field_faults:
+            ovl_why = ("PAMPI_FAULTS field faults armed (in-step writes "
+                       "would postdate the posted exchange)")
+        overlap = _dispatch.resolve_overlap(
+            param, "overlap_ns2d_dist", why_not=ovl_why)
+        self._overlap = overlap
+
         # -- weighted mean for normalizePressure ------------------------
         def wall_weight():
             if self.ragged:
@@ -574,9 +588,9 @@ class NS2DDistSolver:
             return p - s / nfull
 
         # -- CFL timestep (maxElement incl. ghosts + Allreduce MAX) ------
-        def compute_dt(u, v):
-            umax = reduction(jnp.max(jnp.abs(u)), comm, "max")
-            vmax = reduction(jnp.max(jnp.abs(v)), comm, "max")
+        def cfl_from_maxima(umax, vmax):
+            # the scalar tail, shared with the overlapped step (whose
+            # maxima ride the carry from the previous POST kernel)
             inf = jnp.asarray(jnp.inf, dtype)
             dt = jnp.minimum(
                 jnp.asarray(self.dt_bound, dtype),
@@ -586,6 +600,11 @@ class NS2DDistSolver:
                 ),
             )
             return dt * param.tau
+
+        def compute_dt(u, v):
+            umax = reduction(jnp.max(jnp.abs(u)), comm, "max")
+            vmax = reduction(jnp.max(jnp.abs(v)), comm, "max")
+            return cfl_from_maxima(umax, vmax)
 
         adaptive = param.tau > 0.0
 
@@ -721,6 +740,87 @@ class NS2DDistSolver:
                 return u, v, p, t_next, nt + 1, _res, _it, dt, um, vm
             return u, v, p, t_next, nt + 1
 
+        if overlap:
+            # -- overlapped fused step (parallel/overlap.py): the deep
+            # exchange for step N+1 is posted right after step N's POST
+            # and carried as a double-buffered (ud, vd) pair + the CFL
+            # maxima + a generation tag; PRE runs twice — interior half
+            # on the stale re-embedded block (no dependency on the
+            # exchange anywhere in its cone), boundary half on the
+            # buffered exchanged block — merged by the interior mask.
+            # Trajectory == step_fused's bitwise (the interior cone
+            # avoids the strips; max is reduction-order exact).
+            from ..ops.ns2d_fused import OVERLAP_RIM
+            from ..parallel import overlap as _ovl
+            from ..parallel.comm import persistent_exchange
+
+            H = FUSE_DEEP_HALO
+            deep_sched = persistent_exchange(comm, H, dtype)
+            int_mask = _ovl.interior_mask((jl, il), OVERLAP_RIM)
+
+            def exchange_buffers(u, v):
+                """Post the next step's deep exchange (the double
+                buffer's fill half)."""
+                return (deep_sched(embed_deep(u, H)),
+                        deep_sched(embed_deep(v, H)))
+
+            def buffer_maxima(ud, vd):
+                """Ghost-inclusive CFL maxima of the freshly exchanged
+                deep blocks — the serial step's compute_dt inputs, used
+                only for the chunk-prologue generation (steps >= 2 carry
+                the POST kernel's maxima instead)."""
+                return (reduction(jnp.max(jnp.abs(ud)), comm, "max"),
+                        reduction(jnp.max(jnp.abs(vd)), comm, "max"))
+
+            def step_overlap(u, v, p, t, nt, ud, vd, um, vm, gen):
+                pre_k, post_k = fused_k
+                dt = (cfl_from_maxima(um, vm) if adaptive
+                      else jnp.asarray(param.dt, dtype))
+                # stale-buffer detector: a generation-skewed double
+                # buffer poisons dt (NaN t -> drive-loop divergence)
+                dt = _ovl.generation_guard(dt, gen, nt)
+                dt = clamped_dt(dt, dt_scale)
+                joff = get_offsets("j", jl)
+                ioff = get_offsets("i", il)
+                offs = jnp.stack([joff, ioff]).astype(jnp.int32)
+                dt11 = jnp.full((1, 1), dt, dtype)
+                pre_extra = post_extra = ()
+                if gmasks is not None:
+                    flg_deep, flg_ext = fused_flag_blocks()
+                    pre_extra = (flg_deep,)
+                    post_extra = (flg_ext,)
+                ints = pre_k(offs, dt11, pad_deep(embed_deep(u, H)),
+                             pad_deep(embed_deep(v, H)), *pre_extra)
+                bnds = pre_k(offs, dt11, pad_deep(ud), pad_deep(vd),
+                             *pre_extra)
+                u, v, f, g, rhs = _ovl.merge_halves(
+                    int_mask,
+                    [strip_deep(unpad_deep(a), H) for a in ints],
+                    [strip_deep(unpad_deep(b), H) for b in bnds])
+                p = lax.cond(nt % 100 == 0, normalize_pressure,
+                             lambda q: q, p)
+                p, _res, _it = solve(p, rhs)
+                up, vp, um_l, vm_l = post_k(
+                    offs, dt11, pad_ext(u), pad_ext(v), pad_ext(f),
+                    pad_ext(g), pad_ext(p), *post_extra,
+                )
+                u = unpad_ext(up)
+                v = unpad_ext(vp)
+                # next step's CFL maxima: POST's carried per-shard maxima
+                # over the valid extended cells — the same global value
+                # set the serial step's exchanged-block scan sees
+                um = reduction(um_l, comm, "max")
+                vm = reduction(vm_l, comm, "max")
+                # post step N+1's exchange NOW: its results feed only the
+                # carried buffers (the boundary half, one iteration
+                # later) — nothing else in the trace depends on them
+                ud, vd = exchange_buffers(u, v)
+                t_next = t + dt.astype(idx_dtype)
+                if _flags.verbose():
+                    master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+                return (u, v, p, t_next, nt + 1, ud, vd, um, vm, nt + 1,
+                        _res, _it, dt)
+
         step_impl = step if fused_k is None else step_fused
         te = param.te
         chunk = self.CHUNK
@@ -764,6 +864,63 @@ class NS2DDistSolver:
             return u, v, p, t, nt, _tm.metrics_pack(
                 res, it, dtv, um, vm, 0.0, bad)
 
+        if overlap:
+            # the overlapped chunk: one prologue exchange fills the first
+            # generation of the double buffer (per CHUNK dispatch, off
+            # the per-step path); the loop carries (ud, vd, um, vm, gen)
+            # internally — the chunk's EXTERNAL state arity is unchanged,
+            # so checkpoints, recovery and every tool keep working
+            def chunk_kernel_overlap(u, v, p, t, nt):
+                ud, vd = exchange_buffers(u, v)
+                um, vm = buffer_maxima(ud, vd)
+
+                def cond(c):
+                    return jnp.logical_and(c[3] <= te, c[5] < chunk)
+
+                def body(c):
+                    u, v, p, t, nt, k, ud, vd, um, vm, gen = c
+                    (u, v, p, t, nt, ud, vd, um, vm, gen,
+                     _res, _it, _dt) = step_overlap(
+                        u, v, p, t, nt, ud, vd, um, vm, gen)
+                    return u, v, p, t, nt, k + 1, ud, vd, um, vm, gen
+
+                (u, v, p, t, nt, _k, _ud, _vd, _um, _vm,
+                 _gen) = lax.while_loop(
+                    cond, body,
+                    (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
+                     ud, vd, um, vm, nt),
+                )
+                return u, v, p, t, nt
+
+            def chunk_kernel_overlap_metrics(u, v, p, t, nt, m):
+                ud, vd = exchange_buffers(u, v)
+                um, vm = buffer_maxima(ud, vd)
+
+                def cond(c):
+                    return jnp.logical_and(c[3] <= te, c[5] < chunk)
+
+                def body(c):
+                    (u, v, p, t, nt, k, ud, vd, um, vm, gen,
+                     res, it, dtv, mum, mvm, bad) = c
+                    (u, v, p, t, nt, ud, vd, um, vm, gen,
+                     res, it, dtv) = step_overlap(
+                        u, v, p, t, nt, ud, vd, um, vm, gen)
+                    res, it, dtv, mum, mvm, bad = _tm.metrics_step(
+                        bad, nt, res, it, dtv, um, vm)
+                    return (u, v, p, t, nt, k + 1, ud, vd, um, vm, gen,
+                            res, it, dtv, mum, mvm, bad)
+
+                (u, v, p, t, nt, _k, _ud, _vd, _um, _vm, _gen,
+                 res, it, dtv, mum, mvm, bad) = lax.while_loop(
+                    cond, body,
+                    (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
+                     ud, vd, um, vm, nt,
+                     m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                     m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_BAD]),
+                )
+                return u, v, p, t, nt, _tm.metrics_pack(
+                    res, it, dtv, mum, mvm, 0.0, bad)
+
         def init_kernel():
             shape = (jl + 2, il + 2)
             u = jnp.full(shape, param.u_init, dtype)
@@ -784,9 +941,14 @@ class NS2DDistSolver:
             comm.shard_map(init_kernel, in_specs=(), out_specs=(spec,) * 3)
         )
         mextra = (P(),) if metrics else ()
+        if overlap:
+            chunk_fn = (chunk_kernel_overlap_metrics if metrics
+                        else chunk_kernel_overlap)
+        else:
+            chunk_fn = chunk_kernel_metrics if metrics else chunk_kernel
         self._chunk_sm = jax.jit(
             comm.shard_map(
-                chunk_kernel_metrics if metrics else chunk_kernel,
+                chunk_fn,
                 in_specs=(spec, spec, spec, P(), P()) + mextra,
                 out_specs=(spec, spec, spec, P(), P()) + mextra,
                 check_vma=not pallas_q,
@@ -817,6 +979,14 @@ class NS2DDistSolver:
                     (jl, il), FUSE_DEEP_HALO, isz),
                 exchanges_per_step={"deep": 2},
             )
+            if overlap:
+                # same per-step schedule (2 deep exchanges), but posted
+                # at the end of the step into the double buffer; the
+                # chunk prologue fills the first generation — commcheck's
+                # census cross-check counts both classes
+                rec.update(path="fused_overlap",
+                           overlap="double_buffered",
+                           exchanges_per_chunk={"deep": 2})
         else:
             rec.update(exchanges_per_step={
                 "depth1": 4 + (2 if gmasks is not None else 0),

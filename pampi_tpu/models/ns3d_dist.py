@@ -442,6 +442,19 @@ class NS3DDistSolver:
             except ValueError as exc:  # VMEM-infeasible shard geometry
                 _dispatch.record("ns3d_dist_phases", f"jnp ({exc})")
 
+        # -- comm/compute overlap: the 3-D twin of the NS-2D wiring (see
+        # models/ns2d_dist.py — double-buffered deep blocks, split PRE,
+        # carried CFL maxima; `off` stays bitwise the serial schedule)
+        ovl_why = None
+        if fused_k is None:
+            ovl_why = "needs the fused deep-halo step (tpu_fuse_phases)"
+        elif field_faults:
+            ovl_why = ("PAMPI_FAULTS field faults armed (in-step writes "
+                       "would postdate the posted exchange)")
+        overlap = _dispatch.resolve_overlap(
+            param, "overlap_ns3d_dist", why_not=ovl_why)
+        self._overlap = overlap
+
         gmasks = self.masks
         if gmasks is not None:
             from ..ops.obstacle3d import (
@@ -490,10 +503,9 @@ class NS3DDistSolver:
                 )
                 return pad_deep(deep), pad_ext(ext)
 
-        def compute_dt(u, v, w):
-            umax = reduction(jnp.max(jnp.abs(u)), comm, "max")
-            vmax = reduction(jnp.max(jnp.abs(v)), comm, "max")
-            wmax = reduction(jnp.max(jnp.abs(w)), comm, "max")
+        def cfl_from_maxima(umax, vmax, wmax):
+            # the scalar tail, shared with the overlapped step (whose
+            # maxima ride the carry from the previous POST kernel)
             inf = jnp.asarray(jnp.inf, dtype)
             dt = jnp.minimum(
                 jnp.asarray(self.dt_bound, dtype),
@@ -506,6 +518,12 @@ class NS3DDistSolver:
                 ),
             )
             return dt * param.tau
+
+        def compute_dt(u, v, w):
+            umax = reduction(jnp.max(jnp.abs(u)), comm, "max")
+            vmax = reduction(jnp.max(jnp.abs(v)), comm, "max")
+            wmax = reduction(jnp.max(jnp.abs(w)), comm, "max")
+            return cfl_from_maxima(umax, vmax, wmax)
 
         adaptive = param.tau > 0.0
         idx_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -646,6 +664,77 @@ class NS3DDistSolver:
                         um, vm, wm)
             return u, v, w, p, t_next, nt + 1
 
+        if overlap:
+            # -- overlapped fused step (parallel/overlap.py; see
+            # models/ns2d_dist.py for the full invariants): the deep
+            # exchange for step N+1 is posted after step N's POST and
+            # carried double-buffered; PRE runs as interior (stale
+            # blocks) + boundary (buffered exchanged blocks) halves
+            # merged by the interior mask; dt from the carried maxima.
+            from ..ops.ns3d_fused import OVERLAP_RIM
+            from ..parallel import overlap as _ovl
+            from ..parallel.comm import get_offsets, persistent_exchange
+
+            H3 = FUSE_DEEP_HALO
+            deep_sched = persistent_exchange(comm, H3, dtype)
+            int_mask = _ovl.interior_mask((kl, jl, il), OVERLAP_RIM)
+
+            def exchange_buffers(u, v, w):
+                return (deep_sched(embed_deep(u, H3)),
+                        deep_sched(embed_deep(v, H3)),
+                        deep_sched(embed_deep(w, H3)))
+
+            def buffer_maxima(ud, vd, wd):
+                return (reduction(jnp.max(jnp.abs(ud)), comm, "max"),
+                        reduction(jnp.max(jnp.abs(vd)), comm, "max"),
+                        reduction(jnp.max(jnp.abs(wd)), comm, "max"))
+
+            def step_overlap(u, v, w, p, t, nt, ud, vd, wd,
+                             um, vm, wm, gen):
+                pre_k, post_k = fused_k
+                dt = (cfl_from_maxima(um, vm, wm) if adaptive
+                      else jnp.asarray(param.dt, dtype))
+                dt = _ovl.generation_guard(dt, gen, nt)
+                dt = clamped_dt(dt, dt_scale)
+                offs = jnp.stack([
+                    get_offsets("k", kl), get_offsets("j", jl),
+                    get_offsets("i", il),
+                ]).astype(jnp.int32)
+                dt11 = jnp.full((1, 1), dt, dtype)
+                pre_extra = post_extra = ()
+                if gmasks is not None:
+                    flg_deep, flg_ext = fused_flag_blocks()
+                    pre_extra = (flg_deep,)
+                    post_extra = (flg_ext,)
+                ints = pre_k(offs, dt11, pad_deep(embed_deep(u, H3)),
+                             pad_deep(embed_deep(v, H3)),
+                             pad_deep(embed_deep(w, H3)), *pre_extra)
+                bnds = pre_k(offs, dt11, pad_deep(ud), pad_deep(vd),
+                             pad_deep(wd), *pre_extra)
+                u, v, w, f, g_, h, rhs = _ovl.merge_halves(
+                    int_mask,
+                    [strip_deep(unpad_deep(a), H3) for a in ints],
+                    [strip_deep(unpad_deep(b), H3) for b in bnds])
+                p, _res, _it = solve(p, rhs)
+                up, vp, wp, um_l, vm_l, wm_l = post_k(
+                    offs, dt11, pad_ext(u), pad_ext(v), pad_ext(w),
+                    pad_ext(f), pad_ext(g_), pad_ext(h), pad_ext(p),
+                    *post_extra,
+                )
+                u = unpad_ext(up)
+                v = unpad_ext(vp)
+                w = unpad_ext(wp)
+                um = reduction(um_l, comm, "max")
+                vm = reduction(vm_l, comm, "max")
+                wm = reduction(wm_l, comm, "max")
+                # post the next step's exchange into the double buffer
+                ud, vd, wd = exchange_buffers(u, v, w)
+                t_next = t + dt.astype(idx_dtype)
+                if _flags.verbose():
+                    master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+                return (u, v, w, p, t_next, nt + 1, ud, vd, wd,
+                        um, vm, wm, nt + 1, _res, _it, dt)
+
         step_impl = step if fused_k is None else step_fused
         te = param.te
         chunk = self.CHUNK
@@ -689,6 +778,65 @@ class NS3DDistSolver:
             return u, v, w, p, t, nt, _tm.metrics_pack(
                 res, it, dtv, um, vm, wm, bad)
 
+        if overlap:
+            # the overlapped chunk (see models/ns2d_dist.py): prologue
+            # exchange fills the first double-buffer generation; the
+            # internal carry grows (ud, vd, wd, um, vm, wm, gen) while
+            # the chunk's EXTERNAL state arity stays unchanged
+            def chunk_kernel_overlap(u, v, w, p, t, nt):
+                ud, vd, wd = exchange_buffers(u, v, w)
+                um, vm, wm = buffer_maxima(ud, vd, wd)
+
+                def cond(c):
+                    return jnp.logical_and(c[4] <= te, c[6] < chunk)
+
+                def body(c):
+                    u, v, w, p, t, nt, k, ud, vd, wd, um, vm, wm, gen = c
+                    (u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen,
+                     _res, _it, _dt) = step_overlap(
+                        u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen)
+                    return (u, v, w, p, t, nt, k + 1, ud, vd, wd,
+                            um, vm, wm, gen)
+
+                (u, v, w, p, t, nt, _k, _ud, _vd, _wd, _um, _vm, _wm,
+                 _gen) = lax.while_loop(
+                    cond, body,
+                    (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
+                     ud, vd, wd, um, vm, wm, nt),
+                )
+                return u, v, w, p, t, nt
+
+            def chunk_kernel_overlap_metrics(u, v, w, p, t, nt, m):
+                ud, vd, wd = exchange_buffers(u, v, w)
+                um, vm, wm = buffer_maxima(ud, vd, wd)
+
+                def cond(c):
+                    return jnp.logical_and(c[4] <= te, c[6] < chunk)
+
+                def body(c):
+                    (u, v, w, p, t, nt, k, ud, vd, wd, um, vm, wm, gen,
+                     res, it, dtv, mum, mvm, mwm, bad) = c
+                    (u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen,
+                     res, it, dtv) = step_overlap(
+                        u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen)
+                    res, it, dtv, mum, mvm, mwm, bad = _tm.metrics_step(
+                        bad, nt, res, it, dtv, um, vm, wm)
+                    return (u, v, w, p, t, nt, k + 1, ud, vd, wd,
+                            um, vm, wm, gen,
+                            res, it, dtv, mum, mvm, mwm, bad)
+
+                (u, v, w, p, t, nt, _k, _ud, _vd, _wd, _um, _vm, _wm,
+                 _gen, res, it, dtv, mum, mvm, mwm, bad) = lax.while_loop(
+                    cond, body,
+                    (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
+                     ud, vd, wd, um, vm, wm, nt,
+                     m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                     m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_WMAX],
+                     m[_tm.M_BAD]),
+                )
+                return u, v, w, p, t, nt, _tm.metrics_pack(
+                    res, it, dtv, mum, mvm, mwm, bad)
+
         def init_kernel():
             shape = (kl + 2, jl + 2, il + 2)
             return (
@@ -715,9 +863,14 @@ class NS3DDistSolver:
             comm.shard_map(init_kernel, in_specs=(), out_specs=(spec,) * 4)
         )
         mextra = (P(),) if metrics else ()
+        if overlap:
+            chunk_fn = (chunk_kernel_overlap_metrics if metrics
+                        else chunk_kernel_overlap)
+        else:
+            chunk_fn = chunk_kernel_metrics if metrics else chunk_kernel
         self._chunk_sm = jax.jit(
             comm.shard_map(
-                chunk_kernel_metrics if metrics else chunk_kernel,
+                chunk_fn,
                 in_specs=(spec,) * 4 + (P(), P()) + mextra,
                 out_specs=(spec,) * 4 + (P(), P()) + mextra,
                 check_vma=not pallas_o,
@@ -750,6 +903,13 @@ class NS3DDistSolver:
                     (kl, jl, il), FUSE_DEEP_HALO, isz),
                 exchanges_per_step={"deep": 3},
             )
+            if overlap:
+                # same per-step schedule, posted into the double buffer;
+                # the chunk prologue fills the first generation (see
+                # models/ns2d_dist.py)
+                rec.update(path="fused_overlap",
+                           overlap="double_buffered",
+                           exchanges_per_chunk={"deep": 3})
         else:
             rec.update(exchanges_per_step={
                 "depth1": 6 + (3 if gmasks is not None else 0),

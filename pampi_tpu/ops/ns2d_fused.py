@@ -95,6 +95,12 @@ NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
 # ships one extra because embed_deep's own ghost layer sits at depth H-1
 FUSE_CHAIN = 3
 FUSE_DEEP_HALO = FUSE_CHAIN + 1
+# comm/compute overlap (parallel/overlap.py): extended-block cells at
+# least this far from the block edge have a FUSE_CHAIN dependency cone
+# that never reaches the exchanged deep-halo strips — the interior half
+# of the split PRE call is gated to them (its measured footprint
+# excludes the strips; analysis/halocheck.py overlap-interior entries)
+OVERLAP_RIM = FUSE_CHAIN + 1
 
 
 def fuse_halo(dtype) -> int:

@@ -44,7 +44,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from . import ns3d as ops3
-from .ns2d_fused import FUSE_CHAIN, FUSE_DEEP_HALO  # shared validity chain
+from .ns2d_fused import (  # shared validity chain + overlap rim
+    FUSE_CHAIN,
+    FUSE_DEEP_HALO,
+    OVERLAP_RIM,
+)
 from .sor_pallas import (
     LANE,
     VMEM_LIMIT_BYTES,
@@ -57,7 +61,7 @@ from .sor_pallas import (
 NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
 
 __all__ = [
-    "FUSE_CHAIN", "FUSE_DEEP_HALO", "make_fused_pre_3d",
+    "FUSE_CHAIN", "FUSE_DEEP_HALO", "OVERLAP_RIM", "make_fused_pre_3d",
     "make_fused_post_3d", "make_fused_step_3d", "probe_fused_3d",
 ]
 

@@ -343,14 +343,20 @@ def _nbr_perm(nper: int, up: bool, periodic: bool):
 
 
 def _exchange_axis(x, axis_name: str, nper: int, dim: int, periodic: bool,
-                   depth: int = 1):
+                   depth: int = 1, perms=None):
     """Fill both `depth`-wide ghost strips of `x` along array dim `dim` from
     the ±1 neighbours on mesh axis `axis_name`. Physical-wall ghosts keep
-    their previous contents (MPI_PROC_NULL semantics)."""
+    their previous contents (MPI_PROC_NULL semantics). `perms` is an
+    optional precomputed (up, down) permutation-list pair — the
+    persistent-schedule path (ExchangeSchedule) resolves them once per
+    (mesh, depth, dtype); the default recomputes the identical lists, so
+    both paths trace the same program."""
     if nper == 1 and not periodic:
         return x
     n = x.shape[dim]
     d = depth
+    up, down = perms if perms is not None else (
+        _nbr_perm(nper, True, periodic), _nbr_perm(nper, False, periodic))
     strip = tuple(d if a == dim else x.shape[a] for a in range(x.ndim))
     with _scope("halo_exchange", axis_name, strip, x.dtype):
         # my high/low OWNED strips (d innermost owned layers on each side)
@@ -358,10 +364,8 @@ def _exchange_axis(x, axis_name: str, nper: int, dim: int, periodic: bool,
         lo_edge = lax.slice_in_dim(x, d, 2 * d, axis=dim)
         # strip travelling "up" (to +1 neighbour) fills their LOW ghost,
         # and v.v.
-        from_lo = lax.ppermute(hi_edge, axis_name,
-                               _nbr_perm(nper, True, periodic))
-        from_hi = lax.ppermute(lo_edge, axis_name,
-                               _nbr_perm(nper, False, periodic))
+        from_lo = lax.ppermute(hi_edge, axis_name, up)
+        from_hi = lax.ppermute(lo_edge, axis_name, down)
         if not periodic:
             idx = lax.axis_index(axis_name)
             old_lo = lax.slice_in_dim(x, 0, d, axis=dim)
@@ -387,6 +391,86 @@ def halo_exchange(x, comm: CartComm, periodic=(), depth: int = 1):
             axis_name in periodic, depth,
         )
     return x
+
+
+class ExchangeSchedule:
+    """Persistent halo-exchange schedule — the partitioned-MPI seam
+    (ROADMAP item 2; "Persistent and Partitioned MPI for Stencil
+    Communication", PAPERS.md): everything static about one exchange
+    class — the per-axis neighbour permutation lists, the travelling-strip
+    depth, the dtype contract — is resolved ONCE per (mesh, halo-depth,
+    dtype, periodic set) and reused by every exchange of that class,
+    instead of being re-derived at every `halo_exchange` trace site.
+    `__call__` traces the IDENTICAL program to
+    `halo_exchange(x, comm, periodic, depth)` (same slices, same
+    ppermutes with the same permutation lists, same named scopes), so a
+    solver can swap between the two forms without moving a byte of the
+    collective contract (commcheck census, CONTRACTS.json).
+
+    This is also the designated hook for hierarchical meshes: a future
+    intra-slice/inter-slice (ICI/DCN) exchange replaces the flat per-axis
+    plan here — one place, not one per solver. Instances come from
+    `persistent_exchange` (the per-process cache); building one directly
+    skips the cache but loses nothing else."""
+
+    def __init__(self, comm: CartComm, depth: int = 1, dtype=None,
+                 periodic=()):
+        self.comm = comm
+        self.depth = int(depth)
+        self.dtype = None if dtype is None else jnp.dtype(dtype)
+        self.periodic = tuple(periodic)
+        # the static plan: one entry per mesh axis, permutation lists
+        # resolved now (MPI_Send_init semantics — the "build once" half
+        # of persistent requests)
+        self.plan = []
+        for dim, name in enumerate(comm.axis_names):
+            nper = comm.axis_size(name)
+            per = name in self.periodic
+            self.plan.append((dim, name, nper, per, (
+                _nbr_perm(nper, True, per), _nbr_perm(nper, False, per))))
+
+    def __call__(self, x):
+        if self.dtype is not None and x.dtype != self.dtype:
+            raise TypeError(
+                f"ExchangeSchedule built for {self.dtype} applied to "
+                f"{x.dtype} — schedules are cached per (mesh, depth, "
+                "dtype); take the right one from persistent_exchange()"
+            )
+        for dim, name, nper, per, perms in self.plan:
+            x = _exchange_axis(x, name, nper, dim, per, self.depth, perms)
+        return x
+
+    def strip_shapes(self, owned_extents) -> list[tuple[int, ...]]:
+        """The per-axis message shapes of this schedule over a block with
+        the given owned extents (see halo_strip_shapes)."""
+        return halo_strip_shapes(owned_extents, self.depth)
+
+
+_SCHEDULE_CACHE: dict = {}
+
+
+def _mesh_key(comm: CartComm) -> tuple:
+    """Hashable identity of a comm's mesh (axis names + dims + device
+    ids) — stable across jax versions that may or may not hash Mesh."""
+    return (tuple(comm.axis_names), tuple(comm.dims),
+            tuple(d.id for d in comm.mesh.devices.flat))
+
+
+def persistent_exchange(comm: CartComm, depth: int = 1, dtype=None,
+                        periodic=()) -> ExchangeSchedule:
+    """The cached `ExchangeSchedule` for (mesh, halo-depth, dtype,
+    periodic) — built once per process, returned by identity afterwards
+    (test-pinned). Callers that exchange the same class of block many
+    times (the overlapped solvers, the exchange probe) hold one schedule
+    instead of re-deriving the plan per trace site."""
+    key = (_mesh_key(comm), int(depth),
+           None if dtype is None else jnp.dtype(dtype).name,
+           tuple(sorted(periodic)))
+    sched = _SCHEDULE_CACHE.get(key)
+    if sched is None:
+        sched = ExchangeSchedule(comm, depth, dtype, periodic)
+        _SCHEDULE_CACHE[key] = sched
+    return sched
 
 
 def halo_strip_shapes(extents, depth: int = 1) -> list[tuple[int, ...]]:
@@ -446,7 +530,9 @@ def exchange_schedule_bytes(record: dict) -> int:
     (the `_halo_record()` dict): full exchanges at their depths plus the
     one-strip staggered shifts. Priced through `halo_exchange_bytes` /
     `halo_strip_shapes` so this total and the commcheck census cannot
-    diverge."""
+    diverge. Per-STEP only: the overlap path's once-per-chunk prologue
+    exchanges (`exchanges_per_chunk`) amortize to ~0 and are excluded,
+    like the solve's internal exchanges."""
     import numpy as np
 
     shard = tuple(record["shard"])
@@ -465,6 +551,9 @@ def exchange_schedule_bytes(record: dict) -> int:
     return total
 
 
+_PROBE_CACHE: dict = {}
+
+
 def make_exchange_probe(comm: CartComm, record: dict):
     """Jitted exchange-only program of a solver's declared step-level
     schedule (`_halo_record()`): the SERIAL cost of one step's halo
@@ -472,25 +561,44 @@ def make_exchange_probe(comm: CartComm, record: dict):
     critical-path number (ROADMAP item 2: the comm/compute-overlap
     refactor is judged by how much of this time it hides). The exchanges
     chain through one carried block per depth class so XLA cannot
-    reorder or elide them. Returns (fn, args)."""
+    reorder or elide them. Returns (fn, args).
+
+    Cached per (mesh, record geometry, dtype) — the first consumer of
+    the persistent-schedule layer: repeated `time_exchange_ms` spans
+    (every dist run's epilogue, every `dist_step_decomposition`) reuse
+    one compiled probe instead of recompiling per call (identity
+    test-pinned). The deep exchange routes through the cached
+    `persistent_exchange` schedule; the per-step schedule it prices is
+    unchanged by the overlap refactor (`exchanges_per_chunk` prologue
+    exchanges are amortized over the chunk and deliberately excluded,
+    like the solve's internal exchanges)."""
     per = record.get("exchanges_per_step", {})
-    shard = tuple(record["shard"])
+    shard = tuple(int(s) for s in record["shard"])
     dtype = jnp.dtype(record["dtype"])
-    names = comm.axis_names
     H = int(record.get("deep_halo", 1))
+    key = (_mesh_key(comm), shard, dtype.name, H,
+           tuple(sorted((k, int(v)) for k, v in per.items())))
+    fn = _PROBE_CACHE.get(key)
+    if fn is None:
+        names = comm.axis_names
+        deep_sched = persistent_exchange(comm, H, dtype)
 
-    def body(x1, xd):
-        for _ in range(int(per.get("depth1", 0))):
-            x1 = halo_exchange(x1, comm)
-        for k in range(int(per.get("shift", 0))):
-            x1 = halo_shift(x1, comm, names[k % len(names)])
-        for _ in range(int(per.get("deep", 0))):
-            xd = halo_exchange(xd, comm, depth=H)
-        return x1, xd
+        def body(x1, xd):
+            for _ in range(int(per.get("depth1", 0))):
+                x1 = halo_exchange(x1, comm)
+            for k in range(int(per.get("shift", 0))):
+                x1 = halo_shift(x1, comm, names[k % len(names)])
+            for _ in range(int(per.get("deep", 0))):
+                xd = deep_sched(xd)
+            return x1, xd
 
-    spec = comm.spec()
-    fn = jax.jit(comm.shard_map(body, in_specs=(spec, spec),
-                                out_specs=(spec, spec)))
+        spec = comm.spec()
+        fn = jax.jit(comm.shard_map(body, in_specs=(spec, spec),
+                                    out_specs=(spec, spec)))
+        _PROBE_CACHE[key] = fn
+    # only the jitted program is cached (the recompile was the cost);
+    # the zero-filled argument blocks are rebuilt per call so the cache
+    # never pins two full-grid device buffers for the process lifetime
     sh = comm.sharding()
     x1 = jax.device_put(
         jnp.zeros(tuple(p * (s + 2) for p, s in zip(comm.dims, shard)),

@@ -1,0 +1,94 @@
+"""Comm/compute overlap: the interior/boundary split machinery shared by
+the overlapped distributed solvers (models/ns2d_dist, ns3d_dist).
+
+The overlapped step (`tpu_overlap`, ROADMAP item 2) restructures the
+fused deep-halo step so the ppermute exchange for step N+1's halos rides
+the loop carry as a DOUBLE-BUFFERED pair of deep blocks: posted right
+after step N's POST kernel (the moment the new edge cells exist), and
+consumed one iteration later by the BOUNDARY half of the PRE kernel only.
+The INTERIOR half of PRE runs on the stale re-embedded block, so the
+traced program carries no dependency path from the exchange to it — the
+structural property that lets XLA's latency-hiding scheduler / collective
+pipeliner fly the exchange behind the interior compute, and the property
+`analysis/commcheck.overlap_schedule_violations` pins statically.
+
+The split is write-gated, not grid-gated: both halves are the SAME
+Pallas kernel (ops/ns2d_fused, ns3d_fused — the global-coordinate-gated
+discipline) on the two buffers, merged by `merge_halves` with the
+interior mask below. Cells in the interior region have a FUSE_CHAIN
+dependency cone that never reaches the exchanged strips (the outer
+FUSE_DEEP_HALO layers of the deep block), so the interior half's values
+are bitwise those of the serial fused step; the boundary half reads the
+exchanged buffer — bitwise the block the serial step exchanges — so the
+merge reproduces the serial trajectory exactly (parity test-pinned,
+tests/test_overlap.py; footprint-pinned, analysis/halocheck.py's
+overlap-interior entries). Restricting each half's GRID to its region is
+the follow-on optimization; the dataflow split is what buys the overlap.
+
+Staleness safety: the carried buffers wear a generation tag (the step
+count they were exchanged for). `generation_guard` poisons dt with NaN
+on a mismatch, which the drive loop's divergence trigger catches — a
+skewed double buffer is detected, never silently consumed (mutation
+test-pinned via the GEN_SKEW hook).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Test hook: the generation-skew mutation test (tests/test_overlap.py)
+# monkeypatches this to a nonzero offset before building an overlapped
+# solver, forging a step that consumes a stale double buffer. Production
+# value is 0 — the guard then compiles to a compare that always passes.
+GEN_SKEW = 0
+
+
+def overlap_rim(chain: int) -> int:
+    """Width (in extended-block cells, from the block edge inward) of the
+    boundary region: the extended ghost layer itself (1) plus the
+    `chain`-cell validity cone of the fused PRE formulas. Every output
+    cell at least this far from the block edge has a dependency cone that
+    stays inside the OWNED cells — provably independent of the exchanged
+    strips."""
+    return chain + 1
+
+
+def interior_slices(local_extents, rim: int):
+    """Per-axis slices of the interior region on the (l+2)-extended
+    block: indices [rim, l+2-rim). Empty when a shard is thinner than
+    two rims — the split then degenerates to boundary-everywhere, which
+    is correct (and overlap-free)."""
+    return tuple(slice(rim, ext + 2 - rim) for ext in local_extents)
+
+
+def interior_mask(local_extents, rim: int):
+    """Boolean interior mask on the extended block (the merge gate of
+    `merge_halves`). Local-geometry only: ragged pad cells and wall
+    shards need no special case — both halves compute identical values
+    wherever the cone avoids the strips, and the strips are a local
+    property of the block."""
+    shape = tuple(ext + 2 for ext in local_extents)
+    m = jnp.zeros(shape, bool)
+    return m.at[interior_slices(local_extents, rim)].set(True)
+
+
+def merge_halves(mask, interior_vals, boundary_vals):
+    """Elementwise merge of the two PRE halves: interior cells from the
+    stale-block call, the rim from the exchanged-buffer call. A
+    `jnp.where` (not masked addition) so -0.0/NaN payloads survive
+    bit-exactly."""
+    return tuple(
+        jnp.where(mask, i, b) for i, b in zip(interior_vals, boundary_vals)
+    )
+
+
+def generation_guard(dt, gen, nt):
+    """Stale-double-buffer detector: the carried halo buffers were
+    exchanged for step `gen`; the consuming step is `nt`. On a mismatch
+    dt is poisoned with NaN, so t goes NaN and the drive loop's
+    divergence trigger (models/_driver.drive_chunks) reports a
+    structured failure instead of the solver silently consuming stale
+    halos. GEN_SKEW (module hook) forges the mismatch for the mutation
+    test."""
+    ok = (gen + GEN_SKEW) == nt
+    return jnp.where(ok, dt, jnp.asarray(jnp.nan, dt.dtype))

@@ -108,3 +108,41 @@ def resolve_fuse_phases(param, backend: str, dtype, probe, key: str,
         return False
     record(key, "pallas_fused")
     return True
+
+
+def resolve_overlap(param, key: str, why_not: str | None = None) -> bool:
+    """`tpu_overlap` -> whether this dist build dispatches the
+    double-buffered comm/compute-overlap schedule (parallel/overlap.py:
+    interior/boundary PRE split, the step N+1 deep exchange posted after
+    step N's POST) instead of the serial exchange-then-compute step.
+    Decision recorded under `key` ("overlap_ns2d_dist" /
+    "overlap_ns3d_dist" — the dryrun snapshot and tests assert on it).
+
+    `why_not` marks structurally ineligible builds: the overlap schedule
+    rides the fused deep-halo step (a jnp phase chain has per-phase
+    exchanges that cannot be posted early without redundant halo
+    recompute), and PAMPI_FAULTS field-fault builds keep the serial
+    schedule (the in-step fault write would postdate the posted
+    exchange). `off` must reproduce the serial schedule bitwise — the
+    jaxpr-hash identity contract vs CONTRACTS.json."""
+    import jax
+
+    knob = param.tpu_overlap
+    if knob not in ("auto", "on", "off"):
+        raise ValueError(
+            f"tpu_overlap must be auto|on|off, got {knob!r}"
+        )
+    if knob == "off":
+        record(key, "serial (tpu_overlap off)")
+        return False
+    if why_not is not None:
+        record(key, f"serial ({why_not})")
+        return False
+    if knob == "on":
+        record(key, "overlap (forced)")
+        return True
+    if jax.default_backend() != "tpu":
+        record(key, "serial (no TPU)")
+        return False
+    record(key, "overlap")
+    return True

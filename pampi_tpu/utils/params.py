@@ -139,6 +139,25 @@ class Parameter:
     # ulp-equivalent (same formula functions, compiler fma differences only
     # — the quarters-layout precedent).
     tpu_fuse_phases: str = "auto"
+    # comm/compute overlap (distributed fused paths only): the step-level
+    # deep-halo exchange for step N+1 is posted right after step N's POST
+    # kernel and carried as a DOUBLE-BUFFERED pair of deep blocks; the
+    # fused PRE splits into an interior half (provably independent of the
+    # exchange — the traced program carries no path from the ppermutes to
+    # it) and a boundary half that consumes the buffered exchange, merged
+    # by the global-gated interior mask (parallel/overlap.py). CFL dt
+    # comes from the POST kernel's carried |u|/|v|(/|w|) maxima (max is
+    # exact under any reduction order, so the trajectory equals the
+    # serial schedule's — parity test-pinned).
+    #   "auto" overlap when eligible: a real TPU + the fused deep-halo
+    #          step dispatched (jnp paths and PAMPI_FAULTS field-fault
+    #          builds keep the serial schedule;
+    #          utils/dispatch.resolve_overlap records every decision
+    #          under the "overlap_ns2d_dist"/"overlap_ns3d_dist" keys)
+    #   "on"   force (interpret kernels off-TPU — the parity-test mode)
+    #   "off"  the serial schedule (bitwise the historical program —
+    #          jaxpr-hash identity vs CONTRACTS.json)
+    tpu_overlap: str = "auto"
     # MG stall detector (tpu_solver mg only): a V-cycle whose residual
     # changed less than this RELATIVE tolerance is treated as floored and
     # the solve returns early (ops/multigrid.MG_STALL_RTOL rationale). Set 0

@@ -108,3 +108,38 @@ def test_empty_input_is_a_violation(tmp_path):
     with open(p, "w") as fh:
         json.dump({"n": 1, "cmd": "x", "rc": 0, "tail": ""}, fh)
     assert any("zero trend points" in e for e in bt.lint([str(p)]))
+
+
+def test_comm_hidden_fraction_higher_is_better(tmp_path):
+    """The overlap headline gates UPWARD: a drop in comm_hidden_fraction
+    means exchange time slid back onto the critical path (ROADMAP item 2;
+    NAME_DIRECTIONS overrides the unit heuristic for this metric)."""
+    assert bt.higher_is_better("fraction", "comm_hidden_fraction") is True
+    assert bt.higher_is_better("fraction") is None  # unit alone: no gate
+    pt = dict(name="comm_hidden_fraction", unit="fraction", backend="tpu")
+    files = [_art(tmp_path, 1, [dict(pt, value=0.6)]),
+             _art(tmp_path, 2, [dict(pt, value=0.3)])]
+    errs = bt.lint(files, tolerance=0.10)
+    assert len(errs) == 1 and "comm_hidden_fraction" in errs[0] \
+        and "dropped" in errs[0]
+    files = [_art(tmp_path, 1, [dict(pt, value=0.6)]),
+             _art(tmp_path, 2, [dict(pt, value=0.58)])]
+    assert bt.lint(files, tolerance=0.10) == []
+
+
+def test_comm_hidden_fraction_normalized_from_block(tmp_path):
+    """collect_metrics surfaces the merged comm_hidden_fraction block as
+    a normalized metric, backend-tagged from the run it came from (a CPU
+    smoke plane must not seed a chip-gating series)."""
+    from tools._artifact import collect_metrics
+
+    rec = {"comm_hidden_fraction": {"mode": "trace", "hidden_fraction": 0.4},
+           "telemetry_summary": {"backend": "cpu"}}
+    (m,) = collect_metrics(rec)
+    assert m == {"name": "comm_hidden_fraction", "value": 0.4,
+                 "unit": "fraction", "backend": "cpu"}
+    rec["telemetry_summary"]["backend"] = "tpu"
+    assert collect_metrics(rec)[0]["backend"] == "tpu"
+    # a null hidden fraction (attribution failure) yields no point
+    rec["comm_hidden_fraction"]["hidden_fraction"] = None
+    assert collect_metrics(rec) == []

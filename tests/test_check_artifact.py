@@ -84,3 +84,34 @@ def test_lint_telemetry_summary_block():
     assert ca.lint_multichip(good) == []
     bad = dict(base, telemetry_summary={"records": 4})
     assert any("schema_version" in e for e in ca.lint_multichip(bad))
+
+
+def test_lint_dispatch_snapshot_overlap_keys():
+    """Once a dryrun snapshot records ANY overlap_* decision, BOTH dist
+    families must carry an overlap/serial-tagged value; pre-overlap
+    snapshots (and tails without one) pass unchanged."""
+    ok_tail = ("OK ns2d-dist overlap mesh=(4, 2) [overlap (forced)]\n"
+               "dispatch snapshot: {'overlap_ns2d_dist': 'overlap (forced)',"
+               " 'overlap_ns3d_dist': 'serial (no TPU)'}\n")
+    assert ca.lint_dispatch_snapshot(ok_tail, "M") == []
+    # one family missing -> violation naming the key
+    bad_tail = ("dispatch snapshot: {'overlap_ns2d_dist': "
+                "'overlap (forced)'}\n")
+    errs = ca.lint_dispatch_snapshot(bad_tail, "M")
+    assert len(errs) == 1 and "overlap_ns3d_dist" in errs[0]
+    # untagged value -> violation
+    weird = ("dispatch snapshot: {'overlap_ns2d_dist': 'maybe', "
+             "'overlap_ns3d_dist': 'overlap'}\n")
+    errs = ca.lint_dispatch_snapshot(weird, "M")
+    assert len(errs) == 1 and "overlap_ns2d_dist" in errs[0]
+    # pre-overlap snapshot / no snapshot: pass
+    assert ca.lint_dispatch_snapshot(
+        "dispatch snapshot: {'ns2d_dist': 'jnp_ca'}\n", "M") == []
+    assert ca.lint_dispatch_snapshot("no snapshot here\n", "M") == []
+    # the committed r06 artifact carries both keys (the live subject)
+    import json, os
+    with open(os.path.join(ca.REPO, "MULTICHIP_r06.json")) as fh:
+        d = json.load(fh)
+    assert "overlap_ns2d_dist" in d["tail"] \
+        and "overlap_ns3d_dist" in d["tail"]
+    assert ca.lint_multichip(d, "MULTICHIP_r06") == []

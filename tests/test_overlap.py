@@ -1,0 +1,280 @@
+"""Comm/compute overlap (tpu_overlap): the double-buffered
+interior/boundary schedule vs the serial fused schedule.
+
+Contracts pinned here:
+- trajectory parity: overlap-on equals overlap-off (the serial parity
+  oracle) for plain/obstacle/ragged 2-D and 3-D configs at the repo's
+  ulp contract — both paths run the identical Pallas kernels, the
+  interior half's cone never reaches the exchanged strips, and max is
+  reduction-order exact, so the only admissible gap is XLA fusing the
+  jnp pieces (the solve) differently between the two compiled programs
+  (fma contraction; observed 0 on most configs, last-ulp on 3-D
+  obstacle);
+- off-identity: tpu_overlap off and (auto, off-TPU) trace byte-identical
+  programs — the CONTRACTS.json hash contract;
+- schedule structure: the traced overlapped chunk posts the deep
+  exchange double-buffered (prologue before the loop; no same-iteration
+  kernel consumes the ppermute results) and the SERIAL chunk fails the
+  same check — commcheck.overlap_schedule_violations' negative control;
+- stale-buffer detection: a generation-skewed double buffer (the
+  parallel/overlap.GEN_SKEW mutation hook) poisons t with NaN instead of
+  silently consuming stale halos;
+- halocheck: the overlap interior half's measured footprint excludes
+  the exchanged strips, and a smuggled deeper read fails with the
+  kernel's file:line;
+- the persistent-exchange layer: persistent_exchange and the jitted
+  exchange probe are cached (same object back), and the schedule traces
+  the identical program to halo_exchange.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+from pampi_tpu.parallel import overlap as ovl
+from pampi_tpu.parallel.comm import (
+    CartComm,
+    halo_exchange,
+    make_exchange_probe,
+    persistent_exchange,
+)
+from pampi_tpu.utils import dispatch
+from pampi_tpu.utils.params import Parameter
+from pampi_tpu.analysis import commcheck, halocheck
+from pampi_tpu.analysis.jaxprcheck import count_prim, trace_chunk
+
+_B2 = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02, tau=0.5,
+           itermax=10, eps=1e-4, omg=1.7, gamma=0.9,
+           tpu_fuse_phases="on", tpu_sor_layout="checkerboard")
+_B3 = dict(name="dcavity3d", imax=8, jmax=8, kmax=8, re=10.0, te=0.02,
+           tau=0.5, itermax=8, eps=1e-4, omg=1.7, gamma=0.9,
+           tpu_fuse_phases="on")
+
+
+def _run_pair_2d(param, dims):
+    ser = NS2DDistSolver(param.replace(tpu_overlap="off"),
+                         CartComm(ndims=2, dims=dims))
+    ser.run(progress=False)
+    assert dispatch.last("overlap_ns2d_dist") == "serial (tpu_overlap off)"
+    o = NS2DDistSolver(param.replace(tpu_overlap="on"),
+                       CartComm(ndims=2, dims=dims))
+    o.run(progress=False)
+    assert dispatch.last("overlap_ns2d_dist") == "overlap (forced)"
+    assert o.nt == ser.nt and ser.nt > 1
+    for n, (a, b) in zip("uvp", zip(ser.fields(), o.fields())):
+        _assert_ulp_equal(a, b, n)
+    return ser, o
+
+
+def _assert_ulp_equal(a, b, name):
+    d = np.abs(np.asarray(a) - np.asarray(b))
+    assert np.isfinite(d).all() and d.max() < 1e-12, (name, d.max())
+
+
+def test_overlap_matches_serial_2d_plain():
+    _run_pair_2d(Parameter(**_B2), (2, 2))
+
+
+def test_overlap_matches_serial_2d_obstacle():
+    param = Parameter(name="canal_obstacle", imax=24, jmax=12, re=10.0,
+                      te=0.02, tau=0.5, itermax=10, eps=1e-3, omg=1.7,
+                      gamma=0.9, bcLeft=3, bcRight=3,
+                      obstacles="0.3,0.3,0.6,0.6",
+                      tpu_fuse_phases="on", tpu_sor_layout="checkerboard")
+    ser, o = _run_pair_2d(param, (2, 2))
+    assert ser.masks is not None
+
+
+def test_overlap_matches_serial_2d_ragged():
+    # 18 rows over a 4-mesh: ceil-divided 5-row shards with a dead tail
+    param = Parameter(**{**_B2, "imax": 18, "jmax": 18})
+    ser, o = _run_pair_2d(param, (4, 2))
+    assert ser.ragged
+
+
+def _run_pair_3d(param, dims=(2, 2, 2)):
+    comm = CartComm(ndims=3, dims=dims)
+    ser = NS3DDistSolver(param.replace(tpu_overlap="off"), comm)
+    ser.run(progress=False)
+    o = NS3DDistSolver(param.replace(tpu_overlap="on"), comm)
+    o.run(progress=False)
+    assert dispatch.last("overlap_ns3d_dist") == "overlap (forced)"
+    assert o.nt == ser.nt and ser.nt >= 1
+    for n, (a, b) in zip("uvwp", zip(ser.collect(), o.collect())):
+        _assert_ulp_equal(a, b, n)
+    return ser, o
+
+
+def test_overlap_matches_serial_3d_plain():
+    # 4-cell shards: the interior region is EMPTY, the boundary half
+    # covers the whole block — the degenerate case must stay exact
+    _run_pair_3d(Parameter(**_B3))
+
+
+def test_overlap_matches_serial_3d_ragged():
+    param = Parameter(**{**_B3, "imax": 9, "jmax": 9, "kmax": 9})
+    ser, _ = _run_pair_3d(param)
+    assert ser.ragged
+
+
+@pytest.mark.slow
+def test_overlap_matches_serial_3d_obstacle():
+    param = Parameter(**{**_B3, "imax": 16, "jmax": 16, "kmax": 16,
+                         "obstacles": "0.3,0.3,0.3,0.7,0.7,0.7"})
+    ser, _ = _run_pair_3d(param)
+    assert ser.masks is not None
+
+
+# ---------------------------------------------------------------------------
+# program-shape contracts (trace-only, no chunk execution)
+# ---------------------------------------------------------------------------
+
+def test_overlap_off_is_bitwise_serial():
+    """off == auto (off-TPU) == the historical serial program."""
+    comm = CartComm(ndims=2, dims=(2, 2))
+    jx_off = trace_chunk(
+        NS2DDistSolver(Parameter(**_B2, tpu_overlap="off"), comm))
+    jx_auto = trace_chunk(NS2DDistSolver(Parameter(**_B2), comm))
+    assert dispatch.last("overlap_ns2d_dist") == "serial (no TPU)"
+    assert str(jx_off) == str(jx_auto)
+
+
+def test_overlap_launch_count_and_schedule():
+    comm = CartComm(ndims=2, dims=(2, 2))
+    ser = NS2DDistSolver(Parameter(**_B2), comm)
+    jx_ser = trace_chunk(ser)
+    o = NS2DDistSolver(Parameter(**_B2, tpu_overlap="on"), comm)
+    jx_o = trace_chunk(o)
+    # the split PRE adds exactly one launch (interior + boundary halves)
+    assert count_prim(jx_o.jaxpr, "pallas_call") \
+        == count_prim(jx_ser.jaxpr, "pallas_call") + 1
+    # the overlapped chunk is double-buffered; the serial one is the
+    # negative control (its PRE consumes the same-step exchange)
+    assert commcheck.overlap_schedule_violations(jx_o, o._halo_record()) \
+        == []
+    errs = commcheck.overlap_schedule_violations(jx_ser,
+                                                 ser._halo_record())
+    assert any("SAME iteration" in e for e in errs)
+    # per-step deep traffic unchanged: + one prologue per chunk
+    rec_o, rec_s = o._halo_record(), ser._halo_record()
+    assert rec_o["exchanges_per_step"] == rec_s["exchanges_per_step"]
+    assert rec_o["exchanges_per_chunk"] == {"deep": 2}
+    assert rec_o["path"] == "fused_overlap"
+
+
+def test_overlap_jnp_path_refuses():
+    """No fused kernels -> the serial schedule, with the reason
+    recorded (the overlap rides the deep-halo step only)."""
+    comm = CartComm(ndims=2, dims=(2, 2))
+    NS2DDistSolver(
+        Parameter(**{**_B2, "tpu_fuse_phases": "off",
+                     "tpu_overlap": "on"}), comm)
+    tag = dispatch.last("overlap_ns2d_dist")
+    assert tag.startswith("serial (needs the fused deep-halo step")
+
+
+def test_overlap_knob_validation():
+    comm = CartComm(ndims=2, dims=(2, 2))
+    with pytest.raises(ValueError, match="tpu_overlap"):
+        NS2DDistSolver(Parameter(**_B2, tpu_overlap="sometimes"), comm)
+
+
+def test_overlap_metrics_arity():
+    """Telemetry-armed overlapped chunk keeps the in-band metrics
+    contract: initial_state arity == chunk arity, sentinel ops on."""
+    from pampi_tpu.utils import telemetry as tm
+
+    import os
+
+    os.environ["PAMPI_TELEMETRY"] = os.devnull
+    try:
+        tm.reset()
+        comm = CartComm(ndims=2, dims=(2, 2))
+        s = NS2DDistSolver(Parameter(**_B2, tpu_overlap="on"), comm)
+        jx = trace_chunk(s)
+        assert len(s.initial_state()) == len(jx.jaxpr.outvars) == 6
+        assert "is_finite" in str(jx)
+    finally:
+        del os.environ["PAMPI_TELEMETRY"]
+        tm.reset()
+
+
+# ---------------------------------------------------------------------------
+# stale-buffer detection (the generation-skew mutation)
+# ---------------------------------------------------------------------------
+
+def test_generation_skew_detected(monkeypatch):
+    comm = CartComm(ndims=2, dims=(2, 2))
+    monkeypatch.setattr(ovl, "GEN_SKEW", 1)
+    s = NS2DDistSolver(Parameter(**_B2, tpu_overlap="on"), comm)
+    out = s._chunk_sm(*s.initial_state())
+    assert np.isnan(float(out[3])), \
+        "a generation-skewed double buffer must poison t, not be consumed"
+    monkeypatch.setattr(ovl, "GEN_SKEW", 0)
+    s2 = NS2DDistSolver(Parameter(**_B2, tpu_overlap="on"), comm)
+    out2 = s2._chunk_sm(*s2.initial_state())
+    assert np.isfinite(float(out2[3]))
+
+
+# ---------------------------------------------------------------------------
+# halocheck: the interior half excludes the exchanged strips
+# ---------------------------------------------------------------------------
+
+def test_overlap_interior_footprint_clean():
+    for entry in (halocheck.overlap_interior_entry_2d(),
+                  halocheck.overlap_interior_entry_3d()):
+        assert halocheck.check_entry(entry) == [], entry.name
+
+
+@pytest.mark.parametrize("make", [halocheck.overlap_interior_entry_2d,
+                                  halocheck.overlap_interior_entry_3d])
+def test_overlap_interior_smuggled_read_fires(make):
+    vs = halocheck.check_entry(make(smuggle=1))
+    assert vs, "a read reaching the exchanged strips must be flagged"
+    assert "ns2d_fused" in vs[0].path or "ns3d_fused" in vs[0].path
+    assert vs[0].line > 0
+
+
+# ---------------------------------------------------------------------------
+# the persistent-exchange layer
+# ---------------------------------------------------------------------------
+
+def test_persistent_schedule_cached_and_identical():
+    comm = CartComm(ndims=2, dims=(2, 2))
+    s1 = persistent_exchange(comm, 4, jnp.float64)
+    s2 = persistent_exchange(comm, 4, jnp.float64)
+    assert s1 is s2, "schedules must be cached per (mesh, depth, dtype)"
+    assert persistent_exchange(comm, 2, jnp.float64) is not s1
+    # the schedule traces the IDENTICAL program to halo_exchange (the
+    # wrapper name is part of the printed jaxpr, so both share one)
+    spec = comm.spec()
+
+    def traced(impl):
+        def exchange(x):
+            return impl(x)
+
+        xx = jnp.zeros((2 * 16, 2 * 16))
+        return jax.make_jaxpr(jax.jit(comm.shard_map(
+            exchange, in_specs=(spec,), out_specs=spec)))(xx)
+
+    jx_a = traced(s1)
+    jx_b = traced(lambda x: halo_exchange(x, comm, depth=4))
+    assert str(jx_a) == str(jx_b)
+    # dtype contract: a schedule refuses a mismatched block
+    with pytest.raises(TypeError, match="ExchangeSchedule"):
+        s1(jnp.zeros((4, 4), jnp.float32))
+
+
+def test_exchange_probe_cached():
+    comm = CartComm(ndims=2, dims=(2, 2))
+    rec = {"shard": [8, 8], "dtype": "float64", "deep_halo": 4,
+           "exchanges_per_step": {"deep": 2}}
+    fn_a, _ = make_exchange_probe(comm, rec)
+    fn_b, _ = make_exchange_probe(comm, dict(rec))  # equal record, new dict
+    assert fn_a is fn_b, "the jitted exchange probe must be cached per " \
+                         "(mesh, record geometry, dtype)"
+    fn_c, _ = make_exchange_probe(comm, {**rec, "deep_halo": 2})
+    assert fn_c is not fn_a

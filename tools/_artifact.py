@@ -38,8 +38,11 @@ def backend_tag(block: dict) -> str:
 def collect_metrics(rec: dict) -> list[dict]:
     """The normalized metric list of one artifact: every dict-valued
     block carrying {metric, value} (the bench.py JSON-line shape the
-    `parsed*` keys hold) becomes one {name, value, unit, backend} entry.
-    Deterministic from the record alone, so re-merges are stable."""
+    `parsed*` keys hold) becomes one {name, value, unit, backend} entry,
+    plus the `comm_hidden_fraction` block's headline number (ROADMAP
+    item 2 — a HIGHER-is-better series bench_trend gates on, see its
+    NAME_DIRECTIONS). Deterministic from the record alone, so re-merges
+    are stable."""
     out = []
     seen = set()
     for block in rec.values():
@@ -55,6 +58,20 @@ def collect_metrics(rec: dict) -> list[dict]:
             "value": block["value"],
             "unit": block.get("unit"),
             "backend": backend_tag(block),
+        })
+    chf = rec.get("comm_hidden_fraction")
+    if isinstance(chf, dict) and isinstance(
+            chf.get("hidden_fraction"), (int, float)) \
+            and "comm_hidden_fraction" not in seen:
+        # backend from the run the block was merged from (telemetry
+        # summary), never the tpu default: the CPU smoke plane must not
+        # seed a chip-gating series
+        run_backend = (rec.get("telemetry_summary") or {}).get("backend")
+        out.append({
+            "name": "comm_hidden_fraction",
+            "value": chf["hidden_fraction"],
+            "unit": "fraction",
+            "backend": "tpu" if run_backend == "tpu" else "cpu",
         })
     return out
 

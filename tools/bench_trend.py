@@ -94,8 +94,19 @@ def build_series(points: list[dict]) -> dict:
     }
 
 
-def higher_is_better(unit) -> bool | None:
-    """Gate direction from the unit; None = render-only (no gate)."""
+# metrics whose gate direction is a property of the metric itself, not
+# its unit: the comm-hidden fraction (ROADMAP item 2) is the overlap
+# refactor's headline — a DROP means exchange time slid back onto the
+# critical path, so it regresses downward despite its unitless [0, 1]
+# range
+NAME_DIRECTIONS = {"comm_hidden_fraction": True}
+
+
+def higher_is_better(unit, name: str | None = None) -> bool | None:
+    """Gate direction from the metric name (NAME_DIRECTIONS), else the
+    unit; None = render-only (no gate)."""
+    if name in NAME_DIRECTIONS:
+        return NAME_DIRECTIONS[name]
     u = str(unit or "")
     if u.endswith("/s"):
         return True
@@ -113,7 +124,7 @@ def check_regressions(series: dict,
     for (name, backend), pts in sorted(series.items()):
         if len(pts) < 2:
             continue
-        direction = higher_is_better(pts[-1][2])
+        direction = higher_is_better(pts[-1][2], name)
         if direction is None:
             continue
         last_round, last, _ = pts[-1]

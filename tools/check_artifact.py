@@ -44,9 +44,11 @@ Contracts:
 
 from __future__ import annotations
 
+import ast
 import glob
 import json
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -172,6 +174,39 @@ def lint_bench(d: dict, where: str = "BENCH") -> list[str]:
     return errs
 
 
+# the per-family overlap dispatch keys the dryrun snapshot records
+# (utils/dispatch.resolve_overlap); values are overlap-/serial-tagged
+OVERLAP_SNAPSHOT_KEYS = ("overlap_ns2d_dist", "overlap_ns3d_dist")
+
+
+def lint_dispatch_snapshot(tail: str, where: str) -> list[str]:
+    """The dryrun tail's `dispatch snapshot: {...}` line. Once a snapshot
+    records ANY overlap_* decision (the comm/compute-overlap rounds),
+    BOTH dist families must be present with an overlap|serial-tagged
+    value — a dryrun that exercised one family's overlap knob but
+    silently skipped the other would otherwise read as covered.
+    Pre-overlap artifacts (no overlap_* key in the snapshot) pass
+    unchanged."""
+    m = re.search(r"dispatch snapshot: (\{.*\})", tail)
+    if not m:
+        return []
+    try:
+        snap = ast.literal_eval(m.group(1))
+    except (ValueError, SyntaxError):
+        return [f"{where}.tail: dispatch snapshot line unparseable"]
+    if not isinstance(snap, dict) \
+            or not any(str(k).startswith("overlap_") for k in snap):
+        return []
+    errs = []
+    for key in OVERLAP_SNAPSHOT_KEYS:
+        val = str(snap.get(key, "") or "")
+        if not val.startswith(("overlap", "serial")):
+            errs.append(
+                f"{where}.tail snapshot: {key} missing or not "
+                f"overlap/serial-tagged ({val!r})")
+    return errs
+
+
 def lint_multichip(d: dict, where: str = "MULTICHIP") -> list[str]:
     errs = _missing(d, MULTICHIP_REQUIRED, where)
     if isinstance(d.get("telemetry_summary"), dict):
@@ -179,6 +214,7 @@ def lint_multichip(d: dict, where: str = "MULTICHIP") -> list[str]:
             d["telemetry_summary"], f"{where}.telemetry_summary")
     errs += lint_normalized(d, where)
     errs += _lint_optional_blocks(d, where)
+    errs += lint_dispatch_snapshot(str(d.get("tail", "") or ""), where)
     return errs
 
 

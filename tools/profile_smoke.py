@@ -3,13 +3,23 @@ whatever backend this host has (make profile-smoke — CPU-safe).
 
     python tools/profile_smoke.py [outdir]
 
-Arms PAMPI_TELEMETRY + PAMPI_XPROF (defaults under results/profile_smoke/),
-drives a 16² NS2D dist chunk loop, and renders the resulting flight
-record — proving the whole device-time observability plane end-to-end:
-trace capture, trace-event ingestion (utils/xprof), the `exchange` span,
-the `xprof` record, and the comm-hidden-fraction block — before any TPU
-time is spent. Exit 1 if the run produced no xprof record or no exchange
-span (the plane is broken, not merely quiet).
+Arms PAMPI_TELEMETRY + PAMPI_XPROF (defaults under results/profile_smoke/)
+and drives a 16² NS2D dist chunk loop on the OVERLAPPED schedule
+(`tpu_overlap on` + forced fused kernels, interpret mode off-TPU — one
+instrumented run: the CPU profiler collects one session per process, so
+the run that matters is the one captured), then renders the resulting
+flight record: proving the whole device-time observability plane
+end-to-end (trace capture, trace-event ingestion via utils/xprof, the
+`exchange` span, the `xprof` record, the comm-hidden-fraction block)
+AND the overlap schedule itself (the traced chunk posts the deep
+exchange double-buffered: a prologue exchange precedes the loop and no
+same-iteration kernel consumes the ppermute results —
+`analysis/commcheck.overlap_schedule_violations`), before any TPU time
+is spent. Exit 1 if the run produced no xprof record, no exchange span,
+or a serialized overlap schedule (the plane or the overlap is broken,
+not merely quiet). The measured hidden fraction stays ~0 here — CPU
+thunks serialize regardless; the schedule's >0 CAPABILITY is what the
+structural check pins, the real number belongs to the on-chip campaign.
 """
 
 from __future__ import annotations
@@ -44,10 +54,23 @@ def main(argv: list[str]) -> int:
     tm.reset()
     tm.start_run(tool="profile_smoke")
     param = Parameter(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02,
-                      tau=0.5, itermax=10, eps=1e-4, omg=1.7, gamma=0.9)
+                      tau=0.5, itermax=10, eps=1e-4, omg=1.7, gamma=0.9,
+                      tpu_fuse_phases="on", tpu_overlap="on",
+                      tpu_sor_layout="checkerboard")
     s = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2)))
+    # compile OUTSIDE the capture (without executing the chunk): the
+    # interpret-mode kernel build is Python-heavy enough to flood the
+    # profiler's event cap and crowd out the execution events the
+    # ingestion aggregates
+    s._chunk_sm.lower(*s.initial_state()).compile()
     s.run(progress=False)
     tm.finalize()
+
+    from pampi_tpu.analysis.commcheck import overlap_schedule_violations
+    from pampi_tpu.analysis.jaxprcheck import trace_chunk
+
+    sched_errs = overlap_schedule_violations(
+        trace_chunk(s), s._halo_record())
 
     from tools import telemetry_report as tr
 
@@ -59,6 +82,9 @@ def main(argv: list[str]) -> int:
     chf = tr.comm_hidden_fraction(records)
     print(f"\nsmoke: nt={s.nt} kinds={sorted(kinds)}")
     print(f"smoke: comm_hidden_fraction = {json.dumps(chf)}")
+    print("smoke: overlap dispatch = "
+          f"{s._halo_record().get('overlap')} "
+          f"path={s._halo_record().get('path')}")
     if "xprof" not in kinds:
         print("FAIL: no xprof record (capture or ingestion broken)",
               file=sys.stderr)
@@ -66,6 +92,12 @@ def main(argv: list[str]) -> int:
     if not spans:
         print("FAIL: no .exchange span", file=sys.stderr)
         return 1
+    if sched_errs:
+        for e in sched_errs:
+            print(f"FAIL overlap schedule: {e}", file=sys.stderr)
+        return 1
+    print("smoke: overlap schedule double-buffered in the traced chunk "
+          "(exchange posted before the compute that hides it)")
     print(f"smoke ok -> {jsonl}")
     return 0
 
