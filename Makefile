@@ -99,6 +99,21 @@ lint-update:
 lint-comm:
 	python tools/lint.py --only comm
 
+# Fleet smoke: a tiny mixed scenario queue through the whole serving
+# stack on CPU (enqueue -> bucket -> batch -> per-scenario artifacts),
+# with a drift gate — fails if any lane's result differs from its solo
+# oracle — plus the fleet telemetry/merge/lint round trip and the
+# fleet_scenarios_per_s throughput metric.
+fleet-smoke:
+	JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+
+# The full fleet test file INCLUDING the slow-marked parity cases
+# (fused / 3-D-dist vmap batches — tier-1 carries one representative
+# per axis to hold its 870 s window; this target is the complete
+# batch-of-N == N-solo matrix, all four families x jnp/fused).
+fleet-suite:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+
 # Standalone run of the fault-injection / recovery suite (PAMPI_FAULTS
 # plane, retry budgets, rollback-recovery, checkpoint durability edges).
 # The same tests ride tier-1 at 16-squared size; this target is the quick
@@ -114,4 +129,5 @@ distclean:
 	rm -rf build exe-*
 
 .PHONY: all test asm format telemetry-report check-artifacts bench-trend \
-	profile-smoke lint lint-update lint-comm fault-suite clean distclean
+	profile-smoke fleet-smoke fleet-suite lint lint-update lint-comm \
+	fault-suite clean distclean

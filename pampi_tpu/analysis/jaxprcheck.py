@@ -212,7 +212,14 @@ class ChunkConfig:
     - neither: only the env-keyed baseline pins the count (single-device
       solve paths that record no dispatch decision).
 
-    `dispatch_keys` are recorded into the baseline and diffed on drift."""
+    `dispatch_keys` are recorded into the baseline and diffed on drift.
+
+    `fleet` > 0 wraps the built solver in a `fleet/batch.BatchedSolver`
+    of that many identical lanes: the traced chunk is the VMAPPED fleet
+    program (ROADMAP item 3) — the same launch/census/resharding
+    contracts then pin the batched trace (a vmapped chunk must lower to
+    the same pallas launches and census the same collectives as the
+    dispatch decisions imply, with zero resharding collectives)."""
 
     name: str
     family: str
@@ -225,6 +232,7 @@ class ChunkConfig:
     solve_key: str = ""
     overlap_key: str = ""
     dispatch_keys: tuple = ()
+    fleet: int = 0
     notes: str = ""
 
     def build(self):
@@ -235,20 +243,30 @@ class ChunkConfig:
             if self.family == "ns2d":
                 from ..models.ns2d import NS2DSolver
 
-                return NS2DSolver(param)
-            from ..models.ns3d import NS3DSolver
+                solver = NS2DSolver(param)
+            else:
+                from ..models.ns3d import NS3DSolver
 
-            return NS3DSolver(param)
-        from ..parallel.comm import CartComm
+                solver = NS3DSolver(param)
+        else:
+            from ..parallel.comm import CartComm
 
-        comm = CartComm(ndims=len(self.dims), dims=self.dims)
-        if self.family == "ns2d_dist":
-            from ..models.ns2d_dist import NS2DDistSolver
+            comm = CartComm(ndims=len(self.dims), dims=self.dims)
+            if self.family == "ns2d_dist":
+                from ..models.ns2d_dist import NS2DDistSolver
 
-            return NS2DDistSolver(param, comm)
-        from ..models.ns3d_dist import NS3DDistSolver
+                solver = NS2DDistSolver(param, comm)
+            else:
+                from ..models.ns3d_dist import NS3DDistSolver
 
-        return NS3DDistSolver(param, comm)
+                solver = NS3DDistSolver(param, comm)
+        if self.fleet:
+            from ..fleet.batch import BatchedSolver
+
+            return BatchedSolver(solver, [param] * self.fleet,
+                                 [f"lane{i}" for i in range(self.fleet)],
+                                 family=self.family)
+        return solver
 
 
 _B2 = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02, tau=0.5,
@@ -363,6 +381,34 @@ def standard_configs() -> list[ChunkConfig]:
             notes="the 3-D overlapped schedule (4-cell shards: interior "
                   "region empty, boundary half covers the block — "
                   "degenerate but schedule-correct)"),
+        # the scenario-fleet batched programs (ROADMAP item 3): the
+        # vmapped chunk must keep the solo chunk's launch counts (vmap
+        # adds a batch grid dim, never a second launch), census the same
+        # collectives as its solo twin, and introduce zero resharding
+        # collectives — the contracts that make vmap-batching a safe
+        # serving default rather than a hope
+        ChunkConfig(
+            "ns2d_fleet_jnp", "ns2d",
+            dict(_B2, tpu_fuse_phases="off", tpu_solver="fft"),
+            expected_pallas=0, dispatch_keys=("ns2d_phases",), fleet=3,
+            notes="3-lane vmapped jnp+fft chunk: still zero kernels"),
+        ChunkConfig(
+            "ns2d_fleet_fused", "ns2d",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="fft"),
+            expected_pallas=2, dispatch_keys=("ns2d_phases",), fleet=3,
+            notes="3-lane vmapped fused chunk: PRE + POST exactly, the "
+                  "batch rides the kernels' leading grid axis"),
+        ChunkConfig(
+            "ns2d_dist_fleet", "ns2d_dist",
+            dict(_B2, tpu_fuse_phases="off", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist"), fleet=2,
+            notes="2-lane vmapped dist chunk: identical collective "
+                  "counts to the solo dist trace (lanes ride the "
+                  "messages, never add messages), named scopes intact"),
     ]
 
 

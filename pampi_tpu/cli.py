@@ -36,18 +36,32 @@ def main(argv=None) -> int:
     return _run(argv)
 
 
+def mesh_is_single(param) -> bool:
+    """Whether the tpu_mesh key resolves to the single-device path — the
+    ONE statement of that policy, shared by `_make_comm` (which builds
+    the CartComm otherwise) and the fleet scheduler's per-bucket mode
+    decision (`fleet/scheduler._is_dist` must never diverge from the
+    comm the template build actually constructs)."""
+    import jax
+
+    if len(jax.devices()) == 1:
+        return True
+    if param.tpu_mesh == "auto":
+        return False
+    return all(int(t) == 1 for t in param.tpu_mesh.split("x"))
+
+
 def _make_comm(param, ndims: int):
     """Resolve the tpu_mesh key to a CartComm, or None for single-device
     (the ≙ of ENABLE_MPI=false: same solver API, one process, comm.c:470-488)."""
     import jax
 
-    ndev = len(jax.devices())
     dims = (
         None
         if param.tpu_mesh == "auto"
         else tuple(int(t) for t in param.tpu_mesh.split("x"))
     )
-    if ndev == 1 or (dims is not None and all(d == 1 for d in dims)):
+    if mesh_is_single(param):
         if jax.process_count() > 1:
             # every rank would run the full serial solver and race on the
             # output files; a 1-cell mesh makes no sense distributed

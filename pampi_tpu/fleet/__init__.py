@@ -1,0 +1,46 @@
+"""Scenario-fleet serving: batched multi-tenant runs as a first-class
+workload (ROADMAP item 3).
+
+The north star's "millions of users" is not one 4096² run — it is
+thousands of concurrent small/medium scenarios (parameter sweeps,
+per-user `.par` configs, ensembles). This package turns the solo-run
+machinery into a serving stack:
+
+  queue.py      request intake + shared-trace bucketing (what may share
+                one compiled program)
+  batch.py      the vmapped batched driver: N lanes through one chunk,
+                diverged lanes frozen by the in-band sentinel
+  scheduler.py  the serving front: buckets -> execution mode
+                (`tpu_fleet` knob) -> compiled-program reuse -> fleet
+                summary artifact
+
+See README "Fleet serving" for the request format, the bucketing policy
+and the knob table.
+"""
+
+from .batch import BatchedSolver, FleetRecorder, lane_state
+from .queue import (
+    BucketKey,
+    ScenarioRequest,
+    bucket,
+    bucket_key,
+    family_of,
+    knob_signature,
+    load_queue,
+    signature_hash,
+)
+from .scheduler import (
+    FleetResult,
+    FleetScheduler,
+    ScenarioResult,
+    reset_templates,
+    run_fleet,
+)
+
+__all__ = [
+    "BatchedSolver", "FleetRecorder", "lane_state",
+    "BucketKey", "ScenarioRequest", "bucket", "bucket_key", "family_of",
+    "knob_signature", "load_queue", "signature_hash",
+    "FleetResult", "FleetScheduler", "ScenarioResult", "reset_templates",
+    "run_fleet",
+]

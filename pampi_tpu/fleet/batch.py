@@ -1,0 +1,318 @@
+"""Batched multi-tenant driver: N same-bucket scenarios through ONE
+vmapped chunk program.
+
+The solvers already expose everything a batch needs — `_build_chunk()` /
+`_chunk_sm` (the traced chunk), `initial_state()` (the chunk-arity state
+tuple) — so the batched driver is a thin functional wrapper: stack N
+per-lane state tuples on a leading scenario axis, vmap the chunk over
+it, and drive the result through `models/_driver.drive_chunks` exactly
+like a solo run (same retry protocol, same progress/telemetry hook
+points). jax batches the chunk's `lax.while_loop`s per lane (a lane
+whose own cond is false passes through by `select` — bitwise identity),
+so per-lane dt/CFL/residual trajectories are each lane's OWN: the jnp
+and dist chunks batch bitwise-equal to solo runs, the fused kernels at
+the repo's ulp contract (fma re-association under the batched grid —
+the quarters-layout precedent; test-pinned in tests/test_fleet.py).
+
+Diverged-lane isolation (the PR 3 sentinel put to work): the fleet
+wrapper appends a per-lane `active` mask plus two drive scalars to the
+stacked state. After each vmapped chunk, a lane whose in-band sentinel
+fired (or, without telemetry, whose loop time / fields went non-finite)
+is retired: `active` drops, and every later chunk passes its state
+through bitwise (`where(active, new, old)`) — the blown-up scenario
+freezes AT its divergence chunk holding the diagnostic-bearing state,
+keeps its emitted divergence record, and its batchmates continue
+untouched. The drive loop reads `t_drive = min over active lanes` (+inf
+once none remain), so a dead lane never blocks — and never spins — the
+fleet. Ring rollback-recovery stays a solo-run feature: a fleet-level
+rollback would rewind HEALTHY batchmates to recover one lane, the
+opposite of the isolation contract, so the batch driver does not arm it
+(requests carrying tpu_recover_ring are still served; the knob is
+recorded as inert for the batch).
+
+Per-lane fault injection (`nan|inf@lane<K>:<field>`, utils/faultinject):
+consumed at batch build, applied host-side to the stacked INITIAL state
+— the compiled chunk is byte-identical to the uninjected batch, so the
+isolation proof runs on the production program.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import faultinject as _fi
+from ..utils import telemetry as _tm
+
+
+def lane_state(template, param) -> tuple:
+    """One scenario's initial chunk state from the bucket's template
+    solver: the template's geometry/arity with the request's init values.
+    Exact — every family initializes its fields as constant fills (the
+    reference's init_arrays), so `full_like` reproduces precisely what a
+    solver built from `param` would hold."""
+    fields, tail = _split_state(template, template.initial_state())
+    names = _field_names(len(fields))
+    inits = {"u": param.u_init, "v": param.v_init, "w": param.w_init,
+             "p": param.p_init}
+    fresh = tuple(jnp.full_like(x, inits[n])
+                  for n, x in zip(names, fields))
+    # t/nt restart at zero per scenario; the metrics vector (when it
+    # rides) re-arms its sentinel
+    time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    out = fresh + (jnp.asarray(0.0, time_dtype), jnp.asarray(0, jnp.int32))
+    if template._metrics:
+        out = out + (_tm.metrics_init(),)
+    return out
+
+
+def _field_names(n_fields: int) -> tuple:
+    return ("u", "v", "p") if n_fields == 3 else ("u", "v", "w", "p")
+
+
+def _split_state(template, state):
+    """(field leaves, trailing scalars) of one lane state: the state
+    convention is (fields..., t, nt[, metrics])."""
+    n_tail = 3 if template._metrics else 2
+    return state[:len(state) - n_tail], state[len(state) - n_tail:]
+
+
+class BatchedSolver:
+    """N same-signature scenarios as one drive_chunks-compatible solver.
+
+    State layout: (stacked lane leaves..., active, t_drive, nt_drive)
+    where the lane leaves follow the template's own chunk arity with a
+    leading scenario axis, `active` is the (N,) lane-liveness mask and
+    the two drive scalars are what the host loop reads (`time_index` =
+    the t_drive slot). Exposes the retry-protocol surface
+    (`_backend`/`_uses_pallas`/`_build_chunk`/`_chunk_fn`) by delegating
+    to the template, so `models/_driver.pallas_retry` recovers a batched
+    pallas failure with the same jnp-fallback/restore protocol as a solo
+    run — one fallback covers all N lanes (they share the program)."""
+
+    def __init__(self, template, params, sids, family: str = ""):
+        if not params:
+            raise ValueError("BatchedSolver needs at least one scenario")
+        from .queue import DRIVE_KEYS
+
+        self.template = template
+        self.params = list(params)
+        self.sids = list(sids)
+        self.family = family or type(template).__name__
+        # trace-shaping fields come from the template (signature-equal
+        # across the batch by construction); the drive-time knobs —
+        # signature-excluded, so they CAN differ — come from the FIRST
+        # request: one drive loop serves all lanes, and the template's
+        # own values belong to whichever tenant happened to build it
+        self.param = template.param.replace(
+            **{k: getattr(self.params[0], k) for k in DRIVE_KEYS})
+        self.dtype = template.dtype
+        self.n = len(self.params)
+        self._metrics = template._metrics
+        self._lane_arity = len(template.initial_state())
+        self._time_index = self._lane_arity - (3 if self._metrics else 2)
+        self._n_fields = self._time_index
+        # only clauses THIS batch can express are consumed — a clause
+        # aimed past the lane count (or at a field the family lacks)
+        # stays armed for the batch it targets
+        self._lane_faults = _fi.take_lane_faults(
+            n_lanes=self.n, fields=_field_names(self._n_fields))
+        t0 = time.perf_counter()
+        self._chunk_fn = jax.jit(self._build_chunk())
+        _tm.emit("build", family=f"fleet.{self.family}", lanes=self.n,
+                 trace_wall_s=round(time.perf_counter() - t0, 3))
+
+    def rebind(self, params, sids) -> None:
+        """Point this compiled batch at a NEW same-signature request set
+        — the scheduler's warm path. The vmapped chunk is lane-COUNT-
+        and trace-specific, never lane-VALUE-specific: initial states
+        are rebuilt from the new requests' init fields, the compiled
+        program is reused untouched (zero retrace). Drive knobs re-derive
+        from the new first request; lane-fault clauses re-arm for the
+        new batch like a fresh build would."""
+        from .queue import DRIVE_KEYS
+
+        if len(params) != self.n:
+            raise ValueError(
+                f"rebind needs {self.n} scenarios (got {len(params)}) — "
+                "a different lane count is a different compiled batch")
+        self.params = list(params)
+        self.sids = list(sids)
+        self.param = self.template.param.replace(
+            **{k: getattr(self.params[0], k) for k in DRIVE_KEYS})
+        self._lane_faults = _fi.take_lane_faults(
+            n_lanes=self.n, fields=_field_names(self._n_fields))
+
+    # -- retry-protocol surface (models/_driver._PallasRetry) ----------
+    @property
+    def _backend(self):
+        return self.template._backend
+
+    @_backend.setter
+    def _backend(self, value):
+        self.template._backend = value
+
+    def _uses_pallas(self) -> bool:
+        return self.template._uses_pallas()
+
+    def _dist(self) -> bool:
+        return hasattr(self.template, "_chunk_sm")
+
+    # -- the batched chunk ---------------------------------------------
+    def _build_chunk(self, backend: str | None = None):
+        tpl = self.template
+        if self._dist():
+            # the dist chunk is one traced shard_map program with no
+            # per-backend rebuild path (models/ns2d_dist.run contract):
+            # vmap it as-is; the retry hook returns None there
+            inner = tpl._chunk_sm
+        else:
+            inner = tpl._build_chunk(
+                backend if backend is not None else tpl._backend)
+        vchunk = jax.vmap(inner)
+        ti, mi = self._time_index, (
+            self._lane_arity - 1 if self._metrics else None)
+        n_fields = self._n_fields
+
+        def fleet_chunk(*state):
+            lanes = state[:self._lane_arity]
+            active = state[self._lane_arity]
+            new = vchunk(*lanes)
+            # freeze retired lanes bitwise: a lane that diverged in an
+            # earlier chunk keeps its diagnostic-bearing state untouched
+            out = tuple(
+                jnp.where(active.reshape((-1,) + (1,) * (x.ndim - 1)),
+                          x, old)
+                for x, old in zip(new, lanes))
+            t = out[ti]
+            ok = jnp.isfinite(t)
+            if mi is not None:
+                # the in-band sentinel (PR 3): latched per lane inside
+                # the vmapped chunk, read at the boundary like solo runs
+                ok = jnp.logical_and(ok, out[mi][:, _tm.M_BAD] < 0)
+            else:
+                # telemetry off: no sentinel rides the chunk — the fleet
+                # wrapper's own per-lane finiteness reductions stand in
+                # (one cheap pass per field per chunk, fleet-only ops:
+                # the solo chunk program is untouched)
+                for f in out[:n_fields]:
+                    fin = jnp.all(jnp.isfinite(f),
+                                  axis=tuple(range(1, f.ndim)))
+                    ok = jnp.logical_and(ok, fin)
+            active = jnp.logical_and(active, ok)
+            t_drive = jnp.min(jnp.where(active, t, jnp.inf))
+            nt_drive = jnp.max(out[ti + 1])
+            return (*out, active, t_drive, nt_drive)
+
+        return fleet_chunk
+
+    # -- drive API ------------------------------------------------------
+    def initial_state(self) -> tuple:
+        lanes = [lane_state(self.template, p) for p in self.params]
+        stacked = tuple(jnp.stack(leaves) for leaves in zip(*lanes))
+        names = _field_names(self._n_fields)
+        for field, lane, value in self._lane_faults:
+            # take_lane_faults only hands back clauses this batch can
+            # express, so every one applies
+            i = names.index(field)
+            stacked = (stacked[:i]
+                       + (stacked[i].at[lane].set(value),)
+                       + stacked[i + 1:])
+        active = jnp.ones((self.n,), bool)
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 \
+            else jnp.float32
+        return stacked + (active, jnp.asarray(0.0, time_dtype),
+                          jnp.asarray(0, jnp.int32))
+
+    def run(self, progress: bool = False):
+        """Drive the batch to te through models/_driver.drive_chunks —
+        the solo drive loop, unchanged: transient retry and the
+        pallas->jnp fallback/restore operate per BATCH (all lanes share
+        the program), divergence is per-LANE masking inside the chunk
+        (the loop-level RingRecovery stays a solo feature — a fleet
+        rollback would rewind healthy batchmates to recover one lane).
+        Returns the final fleet state; read it with `results()`."""
+        from ..models._driver import drive_chunks, pallas_retry
+        from ..utils import flags as _flags
+        from ..utils.progress import Progress
+
+        te = self.param.te
+        bar = Progress(te, enabled=progress and not _flags.verbose())
+        state = self.initial_state()
+        rec = (FleetRecorder(self.family, self.sids)
+               if self._metrics else None)
+
+        def on_state(s):
+            if rec is not None:
+                rec.update(self, s)
+
+        # t_drive sits right past the lanes-plus-active block; nt_drive
+        # rides one slot later (the drive loop's ETA contract)
+        time_index = self._lane_arity + 1
+        if self._dist():
+            # no per-backend rebuild path for the shard_map chunk, and
+            # no rank-local transient retry under multi-process (the
+            # models/ns2d_dist.run convention)
+            retry = lambda: None  # noqa: E731 - the dist no-retry hook
+            budget = 0 if jax.process_count() > 1 else 1
+        else:
+            retry = pallas_retry(
+                self, "fleet chunk",
+                restore_after=self.param.tpu_retry_replenish)
+            budget = 1
+        return drive_chunks(
+            state, self._chunk_fn, te, time_index, bar, retry,
+            on_state=on_state, lookahead=self.param.tpu_lookahead,
+            replenish_after=self.param.tpu_retry_replenish,
+            recover=None, transient_budget=budget)
+
+    def results(self, state) -> list[dict]:
+        """Per-scenario results from a final fleet state: one dict per
+        lane {sid, t, nt, diverged, fields} — `fields` in the template's
+        own layout (dist lanes hold stacked shard blocks, exactly what
+        the solo solver publishes)."""
+        active = np.asarray(state[self._lane_arity])
+        t = np.asarray(state[self._time_index])
+        nt = np.asarray(state[self._time_index + 1])
+        out = []
+        for i, sid in enumerate(self.sids):
+            fields = tuple(np.asarray(leaf[i])
+                           for leaf in state[:self._n_fields])
+            out.append({
+                "sid": sid,
+                "t": float(t[i]),
+                "nt": int(nt[i]),
+                "diverged": not bool(active[i]),
+                "fields": fields,
+            })
+        return out
+
+
+class FleetRecorder:
+    """Per-lane telemetry at each host sync: one ChunkRecorder per
+    scenario (chunk records tagged with the scenario id; each lane's
+    divergence record fires once, from its own sentinel). A retired or
+    finished lane whose step counter stopped advancing emits no further
+    chunk records — a frozen lane is visible as silence after its
+    divergence record, not as a stream of zero-step rows."""
+
+    def __init__(self, family: str, sids, nt0: int = 0):
+        self._recs = [_tm.ChunkRecorder(family, nt0, scenario=sid)
+                      for sid in sids]
+        self._nts = [nt0] * len(sids)
+
+    def update(self, batched: BatchedSolver, state) -> None:
+        if not _tm.enabled():
+            return
+        ti = batched._time_index
+        t = np.asarray(state[ti])
+        nt = np.asarray(state[ti + 1])
+        m = np.asarray(state[batched._lane_arity - 1])  # metrics (N, 7)
+        for i, rec in enumerate(self._recs):
+            if int(nt[i]) == self._nts[i]:
+                continue
+            self._nts[i] = int(nt[i])
+            rec.update(float(t[i]), int(nt[i]), m[i])

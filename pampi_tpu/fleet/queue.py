@@ -1,0 +1,156 @@
+"""Scenario request queue + bucketing: the serving front's intake.
+
+A scenario request is one `.par`-equivalent configuration (utils/params.
+Parameter) plus a tenant/scenario id. The scheduler executes requests in
+BUCKETS — groups that share one traced program — so a thousand per-user
+configs compile once per bucket, not once per user.
+
+Bucketing policy (the one statement of "what may share a trace"):
+
+- The bucket key is (family, grid extents, knob-signature hash). Family
+  is ns2d/ns3d (the reference's 2-D/3-D drivers; Poisson requests are
+  refused — the fleet serves the NS time-steppers, whose chunk protocol
+  `models/_driver.drive_chunks` drives).
+- The knob signature is the canonical string of every Parameter field
+  that shapes the TRACED program (solver/layout/fusion knobs, physics
+  constants baked as trace constants, BC codes, obstacle geometry, te,
+  mesh...). Two requests with equal signatures lower to the identical
+  chunk program and may ride one vmap batch.
+- Excluded from the signature: the per-lane STATE keys (`u_init`,
+  `v_init`, `w_init`, `p_init` — pure initial-field values, the natural
+  sweep axis: a hundred initial conditions of one configuration is one
+  bucket) and drive-loop housekeeping that never enters the trace
+  (checkpoint/restart paths, vtk mode, lookahead, retry/recovery knobs,
+  `tpu_fleet` itself).
+
+The signature is a string, the bucket id a stable short hash of it —
+artifact keys and dispatch records stay readable and machine-stable
+across processes (no Python hash randomization).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+
+from ..utils.params import Parameter, is_3d_config, read_parameter
+
+# per-lane state-only keys: they set initial FIELD VALUES, never trace
+# structure — the vmap sweep axis
+LANE_KEYS = ("u_init", "v_init", "w_init", "p_init")
+
+# drive-loop housekeeping: consumed by the host driver, never traced
+HOUSEKEEPING_KEYS = (
+    "tpu_checkpoint", "tpu_ckpt_every", "tpu_restart", "tpu_vtk",
+    "tpu_lookahead", "tpu_retry_replenish", "tpu_recover_ring",
+    "tpu_recover_dt_scale", "tpu_recover_max", "tpu_fleet", "seen_keys",
+)
+
+# the signature-excluded keys that still STEER the drive loop (retry /
+# recovery / pipelining policy). They can differ within a bucket, so the
+# executors must take them from the REQUESTS, never from whichever
+# tenant happened to build the cached template: pjit lanes honor each
+# request's own values (scheduler._reset_lane), a vmap batch — which has
+# ONE drive loop for all lanes — takes its FIRST request's values
+# (batch.BatchedSolver, documented batch-level policy).
+DRIVE_KEYS = ("tpu_lookahead", "tpu_retry_replenish", "tpu_recover_ring",
+              "tpu_recover_dt_scale", "tpu_recover_max",
+              "tpu_checkpoint", "tpu_ckpt_every")
+
+
+@dataclasses.dataclass
+class ScenarioRequest:
+    """One tenant's run request: a scenario id + its configuration."""
+
+    sid: str
+    param: Parameter
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketKey:
+    """The shared-trace equivalence class of a request."""
+
+    family: str      # ns2d | ns3d
+    grid: tuple      # (imax, jmax[, kmax])
+    sig: str         # knob-signature hash (stable across processes)
+
+    @property
+    def label(self) -> str:
+        return f"{self.family}_{'x'.join(str(g) for g in self.grid)}" \
+               f"_{self.sig}"
+
+
+def family_of(param: Parameter) -> str:
+    """ns2d/ns3d from the config geometry (utils/params.is_3d_config —
+    the same dispatch the CLI driver uses). Poisson requests are refused
+    (the fleet drives the NS chunk protocol), and so are restart
+    requests: the CLI wires `tpu_restart` into the solver before the
+    drive, the fleet builds fresh per-lane initial states — silently
+    serving a t=0 run where the tenant asked for a restart would be a
+    wrong answer, not a degraded one. (`tpu_checkpoint` is merely INERT
+    here — no fleet path passes the checkpoint hook — which loses
+    durability, never correctness.)"""
+    if param.name == "poisson":
+        raise ValueError(
+            "the scenario fleet serves the NS families (dcavity/canal/"
+            "canal_obstacle and the 3-D twins); run poisson configs "
+            "through the CLI driver"
+        )
+    if param.tpu_restart:
+        raise ValueError(
+            "fleet requests cannot restart from a checkpoint "
+            "(tpu_restart is set); run restarts through the CLI driver "
+            "— fleet lanes always start from their .par initial fields"
+        )
+    return "ns3d" if is_3d_config(param) else "ns2d"
+
+
+def knob_signature(param: Parameter) -> str:
+    """Canonical string of every trace-shaping Parameter field — equal
+    signatures <=> the solvers build the identical chunk program (the
+    vmap-batch eligibility contract, test-pinned)."""
+    skip = set(LANE_KEYS) | set(HOUSEKEEPING_KEYS)
+    parts = []
+    for f in dataclasses.fields(Parameter):
+        if f.name in skip:
+            continue
+        parts.append(f"{f.name}={getattr(param, f.name)!r}")
+    return "|".join(parts)
+
+
+def signature_hash(param: Parameter) -> str:
+    return hashlib.sha1(
+        knob_signature(param).encode()).hexdigest()[:12]
+
+
+def bucket_key(param: Parameter) -> BucketKey:
+    family = family_of(param)
+    grid = ((param.imax, param.jmax, param.kmax) if family == "ns3d"
+            else (param.imax, param.jmax))
+    return BucketKey(family=family, grid=grid, sig=signature_hash(param))
+
+
+def bucket(requests) -> dict:
+    """Group requests by shared-trace bucket; insertion-ordered (the
+    scheduler executes buckets in first-seen order, lanes in submit
+    order — deterministic end-to-end)."""
+    out: dict[BucketKey, list[ScenarioRequest]] = {}
+    for req in requests:
+        out.setdefault(bucket_key(req.param), []).append(req)
+    return out
+
+
+def load_queue(paths, base: Parameter | None = None) -> list[ScenarioRequest]:
+    """Read a queue of `.par` files into requests; the scenario id is the
+    file stem (deduplicated with #k suffixes for repeated stems)."""
+    reqs: list[ScenarioRequest] = []
+    seen: dict[str, int] = {}
+    for path in paths:
+        stem = os.path.splitext(os.path.basename(path))[0]
+        n = seen.get(stem, 0)
+        seen[stem] = n + 1
+        sid = stem if n == 0 else f"{stem}#{n}"
+        reqs.append(ScenarioRequest(sid=sid,
+                                    param=read_parameter(path, base)))
+    return reqs

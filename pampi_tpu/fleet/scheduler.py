@@ -1,0 +1,369 @@
+"""Fleet scheduler: queue -> buckets -> batched/sequential execution ->
+per-scenario results + a fleet summary artifact.
+
+The serving front of the scenario fleet (ROADMAP item 3): accept a queue
+of `.par`-equivalent requests, group them into shared-trace buckets
+(fleet/queue.py), pick the execution mode per bucket via the `tpu_fleet`
+knob (utils/dispatch.resolve_fleet — every decision recorded like
+`tpu_overlap`), and reuse compiled programs aggressively:
+
+- in-process: ONE template solver per knob signature (`_TEMPLATES`) —
+  the second batch of a bucket, and every later same-signature request,
+  pays zero retrace;
+- cross-process: `utils/xlacache.enable()` is armed by the scheduler
+  (not just the CLI path), so a warm disk cache turns the per-bucket
+  compile into a load on every serving process.
+
+Execution modes (see resolve_fleet for the auto policy):
+  vmap   fleet/batch.BatchedSolver — one vmapped chunk advances every
+         lane; diverged lanes freeze, batchmates continue
+  pjit   whole-mesh per scenario, sequential, template reused (the
+         dist-bucket mode: the existing solver IS the pjit-across-mesh
+         program; lanes run through solver.run() under scenario_scope)
+  solo   the historical path — a fresh solver per request (the
+         fleet-smoke drift oracle)
+
+Every run emits the fleet summary through the telemetry plane: one
+`fleet` record {n_scenarios, buckets: [per-bucket mode/compile-vs-run
+walls], scenarios_per_s, divergence_census}, per-bucket spans, and a
+`fleet_scenarios_per_s` metric record — `tools/telemetry_report.py
+--merge` folds the summary into BENCH/MULTICHIP artifacts as
+`fleet_summary`, `tools/check_artifact.py` lints it, and
+`tools/bench_trend.py` gates the throughput higher-is-better.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from ..utils import telemetry as _tm
+from . import queue as _q
+from .batch import BatchedSolver, lane_state, _field_names, _split_state
+
+# in-process executable caches above the on-disk xlacache:
+# _TEMPLATES: knob signature -> (template solver, dist) — the one traced
+# solo program per bucket; _BATCHES: (signature, lane count) -> the
+# compiled BatchedSolver, so a warm same-shape batch REBINDS to new
+# requests and pays zero retrace (a fresh jax.jit per batch would
+# recompile the vmapped chunk every run — the serving rate would be
+# compile-bound, BENCH_r07's round-14 finding)
+_TEMPLATES: dict[str, tuple] = {}
+_BATCHES: dict[tuple, object] = {}
+
+
+def reset_templates() -> None:
+    """Drop the in-process executable caches (tests)."""
+    _TEMPLATES.clear()
+    _BATCHES.clear()
+
+
+def _drop_batches(sig: str) -> None:
+    """Invalidate cached batches of one signature (their inner chunk
+    wraps a template program that just changed — e.g. a contamination
+    heal re-traced it)."""
+    for key in [k for k in _BATCHES if k[0] == sig]:
+        del _BATCHES[key]
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    sid: str
+    bucket: str
+    mode: str
+    family: str
+    t: float
+    nt: int
+    diverged: bool
+    fields: tuple
+
+
+@dataclasses.dataclass
+class FleetResult:
+    scenarios: list
+    summary: dict
+
+    def by_sid(self, sid: str) -> ScenarioResult:
+        for s in self.scenarios:
+            if s.sid == sid:
+                return s
+        raise KeyError(sid)
+
+
+def _make_comm(param, family: str):
+    """The CLI's mesh resolution (pampi_tpu.cli._make_comm): None for a
+    single-device bucket, a CartComm otherwise."""
+    from ..cli import _make_comm as cli_make_comm
+
+    return cli_make_comm(param, 2 if family == "ns2d" else 3)
+
+
+def _is_dist(param) -> bool:
+    """The _make_comm decision WITHOUT constructing the mesh (no comm
+    build, no config banner) — run() resolves the fleet mode per bucket
+    before any template exists; the template build constructs the real
+    CartComm exactly once. Shares cli.mesh_is_single so the mode
+    decision can never diverge from the comm the build constructs."""
+    from ..cli import mesh_is_single
+
+    return not mesh_is_single(param)
+
+
+def _build_solver(param, family: str, comm):
+    if family == "ns2d":
+        if comm is None:
+            from ..models.ns2d import NS2DSolver
+
+            return NS2DSolver(param)
+        from ..models.ns2d_dist import NS2DDistSolver
+
+        return NS2DDistSolver(param, comm)
+    if comm is None:
+        from ..models.ns3d import NS3DSolver
+
+        return NS3DSolver(param)
+    from ..models.ns3d_dist import NS3DDistSolver
+
+    return NS3DDistSolver(param, comm)
+
+
+def _template(sig: str, param, family: str):
+    """Build (or fetch) the bucket's template solver — the one traced
+    program every lane of the signature rides. Returns
+    (solver, dist, build_wall_s) with build_wall_s None on a cache hit."""
+    hit = _TEMPLATES.get(sig)
+    if hit is not None:
+        return hit[0], hit[1], None
+    t0 = time.perf_counter()
+    comm = _make_comm(param, family)
+    solver = _build_solver(param, family, comm)
+    wall = time.perf_counter() - t0
+    _TEMPLATES[sig] = (solver, comm is not None)
+    return solver, comm is not None, wall
+
+
+def _clear_contamination(solver) -> bool:
+    """Tenant ISOLATION: a previous run's divergence recovery
+    (cumulative `_dt_scale` clamp) or pallas->jnp fallback (`_backend`)
+    must not leak into the next tenant's program — reset the knobs and
+    re-trace when either drifted, so the next lane runs the program a
+    fresh solver would have built. Returns whether a re-trace happened."""
+    if (getattr(solver, "_dt_scale", 1.0) != 1.0
+            or getattr(solver, "_backend", "auto") != "auto"):
+        solver._dt_scale = 1.0
+        solver._backend = "auto"
+        solver._rebuild_chunk()
+        return True
+    return False
+
+
+def _reset_lane(solver, param) -> None:
+    """Point the template solver's state at one scenario's initial
+    conditions (constant fills — the lane_state contract) and ITS drive
+    knobs for the sequential pjit path."""
+    _clear_contamination(solver)
+    # the request's own drive-time knobs (trace-shaping fields are
+    # signature-equal across the bucket, so only these can differ)
+    solver.param = solver.param.replace(
+        **{k: getattr(param, k) for k in _q.DRIVE_KEYS})
+    state = lane_state(solver, param)
+    fields, _tail = _split_state(solver, state)
+    for name, value in zip(_field_names(len(fields)), fields):
+        setattr(solver, name, value)
+    solver.t = 0.0
+    solver.nt = 0
+
+
+def _solo_result(solver, sid, label, mode, family) -> ScenarioResult:
+    n_fields = len(_split_state(solver, solver.initial_state())[0])
+    fields = tuple(np.asarray(getattr(solver, n))
+                   for n in _field_names(n_fields))
+    diverged = not np.isfinite(solver.t) or not all(
+        np.isfinite(f).all() for f in fields)
+    return ScenarioResult(sid=sid, bucket=label, mode=mode, family=family,
+                          t=float(solver.t), nt=int(solver.nt),
+                          diverged=bool(diverged), fields=fields)
+
+
+class FleetScheduler:
+    """Batched multi-tenant serving: submit requests, run the fleet.
+
+    One scheduler instance is one serving session: its template cache
+    persists across `run()` calls (repeated same-bucket batches reuse
+    compiled programs), and construction arms the persistent XLA disk
+    cache so the same holds across processes."""
+
+    def __init__(self, requests=None):
+        from ..utils import xlacache
+
+        xlacache.enable()
+        self.requests: list[_q.ScenarioRequest] = list(requests or [])
+
+    def submit(self, request: _q.ScenarioRequest) -> None:
+        self.requests.append(request)
+
+    def submit_param(self, sid: str, param) -> None:
+        self.submit(_q.ScenarioRequest(sid=sid, param=param))
+
+    # -- execution ------------------------------------------------------
+    def run(self, progress: bool = False) -> FleetResult:
+        from ..utils import dispatch as _dispatch
+
+        if not self.requests:
+            raise ValueError("fleet queue is empty")
+        batch, self.requests = self.requests, []  # run() drains the queue
+        buckets = _q.bucket(batch)
+        scenarios: list[ScenarioResult] = []
+        bucket_rows: list[dict] = []
+        run_wall_total = 0.0
+        for key, reqs in buckets.items():
+            rep = reqs[0].param
+            # mode needs the mesh answer before any build: decide it
+            # without constructing (the template build makes the real comm)
+            dist = _is_dist(rep)
+            mode = _dispatch.resolve_fleet(
+                rep, len(reqs), dist, f"fleet_{key.label}")
+            with _tm.span(f"fleet.bucket.{key.label}", mode=mode,
+                          lanes=len(reqs)):
+                row, results = self._run_bucket(
+                    key, reqs, mode, progress)
+            bucket_rows.append(row)
+            run_wall_total += row["run_wall_s"]
+            scenarios += results
+        diverged = [s.sid for s in scenarios if s.diverged]
+        per_s = (round(len(scenarios) / run_wall_total, 4)
+                 if run_wall_total > 0 else None)
+        summary = {
+            "n_scenarios": len(scenarios),
+            "buckets": bucket_rows,
+            "scenarios_per_s": per_s,
+            "divergence_census": {
+                "diverged": len(diverged),
+                "scenarios": diverged,
+            },
+        }
+        _tm.emit("fleet", **summary)
+        _tm.emit("metric", metric="fleet_scenarios_per_s", value=per_s,
+                 unit="scenarios/s", backend=jax.default_backend())
+        return FleetResult(scenarios=scenarios, summary=summary)
+
+    def _run_bucket(self, key, reqs, mode: str, progress: bool):
+        family = key.family
+        label = key.label
+        cached = False
+        if mode == "solo":
+            build_wall = 0.0
+            t0 = time.perf_counter()
+            results = []
+            for req in reqs:
+                b0 = time.perf_counter()
+                solver = _build_solver(
+                    req.param, family, _make_comm(req.param, family))
+                build_wall += time.perf_counter() - b0
+                with _tm.scenario_scope(req.sid):
+                    solver.run(progress=progress)
+                results.append(_solo_result(
+                    solver, req.sid, label, mode, family))
+            run_wall = time.perf_counter() - t0 - build_wall
+        elif mode == "pjit":
+            template, cached, build_wall = self._warm_template(key, reqs)
+            t0 = time.perf_counter()
+            results = []
+            for req in reqs:
+                _reset_lane(template, req.param)
+                with _tm.scenario_scope(req.sid):
+                    template.run(progress=progress)
+                results.append(_solo_result(
+                    template, req.sid, label, mode, family))
+            run_wall = time.perf_counter() - t0
+        else:  # vmap
+            # the bare template only: the vmap path never executes the
+            # solo chunk, so warming it would be a wasted compile
+            template, _dist, wall = _template(key.sig, reqs[0].param,
+                                              family)
+            build_wall = 0.0 if wall is None else wall
+            # heal BEFORE building: a template left dirty by an earlier
+            # bucket (recovery dt clamp, pallas fallback) would be baked
+            # into the batched trace and serve every lane a wrong program
+            if _clear_contamination(template):
+                _drop_batches(key.sig)  # cached batches wrapped the old trace
+            bkey = (key.sig, len(reqs))
+            batched = _BATCHES.get(bkey)
+            cached = batched is not None
+            if cached:
+                # warm path: same compiled vmapped program, new requests
+                batched.rebind([r.param for r in reqs],
+                               [r.sid for r in reqs])
+            else:
+                c0 = time.perf_counter()
+                batched = BatchedSolver(
+                    template, [r.param for r in reqs],
+                    [r.sid for r in reqs], family=family)
+                # jax.jit is lazy — and on this jax the AOT
+                # lower().compile() path does NOT populate the jit
+                # dispatch cache — so warm by CALLING the batched chunk
+                # once and discarding the result (the loop is
+                # functional; one throwaway chunk of device work is
+                # noise next to the compile it keeps out of the serving
+                # rate bench_trend gates). Scalar-readback fence, the
+                # repo timing convention.
+                out = batched._chunk_fn(*batched.initial_state())
+                float(out[batched._lane_arity + 1])
+                build_wall += time.perf_counter() - c0
+                _BATCHES[bkey] = batched
+            t0 = time.perf_counter()
+            final = batched.run(progress=progress)
+            run_wall = time.perf_counter() - t0
+            # ...and heal AFTER: a pallas fallback during THIS batch
+            # writes through to the cached template's _backend — later
+            # buckets must not silently inherit the jnp path (and the
+            # cached batch itself wraps the now-stale program)
+            if _clear_contamination(template):
+                _drop_batches(key.sig)
+            results = [
+                ScenarioResult(sid=r["sid"], bucket=label, mode=mode,
+                               family=family, t=r["t"], nt=r["nt"],
+                               diverged=r["diverged"], fields=r["fields"])
+                for r in batched.results(final)
+            ]
+        row = {
+            "bucket": label,
+            "family": family,
+            "grid": list(key.grid),
+            "mode": mode,
+            "lanes": len(reqs),
+            "template_cached": cached,
+            "compile_wall_s": round(build_wall, 3),
+            "run_wall_s": round(run_wall, 4),
+        }
+        return row, results
+
+    def _warm_template(self, key, reqs):
+        """Fetch/build the bucket template AND, on a COLD build, force
+        its chunk compile (jax.jit is lazy — without this the cold XLA
+        compile lands in the first tenant's run wall; a cached template
+        already compiled during its earlier batch). Warming is one
+        discarded CALL of the chunk — on this jax the AOT
+        lower().compile() path does not populate the jit dispatch cache,
+        so an executed chunk is the only warm-up that sticks. Returns
+        (template, cache_hit, compile_wall_s)."""
+        template, _dist, wall = _template(key.sig, reqs[0].param,
+                                          key.family)
+        if wall is None:
+            return template, True, 0.0
+        c0 = time.perf_counter()
+        chunk = getattr(template, "_chunk_sm", None) or template._chunk_fn
+        state = template.initial_state()
+        out = chunk(*state)
+        # scalar-readback fence on the carried loop time (the repo
+        # timing convention; t sits 2-or-3 slots from the end)
+        float(out[len(state) - (3 if template._metrics else 2)])
+        return template, False, wall + time.perf_counter() - c0
+
+
+def run_fleet(requests, progress: bool = False) -> FleetResult:
+    """One-shot convenience: schedule + run a request list."""
+    return FleetScheduler(requests).run(progress=progress)

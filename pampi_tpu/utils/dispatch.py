@@ -146,3 +146,41 @@ def resolve_overlap(param, key: str, why_not: str | None = None) -> bool:
         return False
     record(key, "overlap")
     return True
+
+
+def resolve_fleet(param, n_scenarios: int, dist: bool, key: str) -> str:
+    """`tpu_fleet` -> how the fleet scheduler executes one bucket of
+    same-signature scenario requests (pampi_tpu/fleet/scheduler.py).
+    Returns "vmap" (the batched driver: one vmapped chunk advances every
+    lane), "pjit" (whole-mesh per scenario, sequential, reusing the
+    bucket's compiled program) or "solo" (every request its own solver —
+    the historical path and the drift-check oracle). Decision recorded
+    under `key` (one `fleet_<bucket>` key per bucket — the fleet summary
+    and tests assert on it).
+
+    `auto` policy: vmap for single-device buckets with more than one
+    scenario (scenario-parallelism is embarrassingly parallel — the
+    batch rides one program at near-100% efficiency); pjit for
+    distributed buckets (vmapping a shard_map'ed chunk multiplies
+    per-device live state by the lane count — whole-mesh sequential
+    keeps the memory bound while still amortizing the compile) and for
+    1-scenario buckets (a size-1 batch axis buys nothing)."""
+    knob = param.tpu_fleet
+    if knob not in ("auto", "vmap", "pjit", "solo"):
+        raise ValueError(
+            f"tpu_fleet must be auto|vmap|pjit|solo, got {knob!r}"
+        )
+    if knob == "solo":
+        record(key, "solo (tpu_fleet solo)")
+        return "solo"
+    if knob in ("vmap", "pjit"):
+        record(key, f"{knob} (forced)")
+        return knob
+    if dist:
+        record(key, "pjit (dist bucket: whole-mesh per scenario)")
+        return "pjit"
+    if n_scenarios <= 1:
+        record(key, "pjit (single-scenario bucket)")
+        return "pjit"
+    record(key, f"vmap (same-trace bucket of {n_scenarios})")
+    return "vmap"

@@ -21,6 +21,13 @@ Spec grammar — comma-separated clauses, each `kind@site<N>[:field][*count]`:
                           u|v|w|p at step N (exercises the PR 3 in-band
                           divergence sentinel end-to-end)
   inf@step<N>:<field>     same, +inf
+  nan@lane<K>:<field>     host-side NaN corruption of scenario lane K's
+                          field in a FLEET batch's initial state
+                          (pampi_tpu/fleet/batch.py; 0-based lane index;
+                          exercises diverged-lane isolation — the lane
+                          freezes, batchmates must stay bitwise). Solo
+                          runs never consult lane clauses.
+  inf@lane<K>:<field>     same, +inf
   ckpt_torn@write<N>      forged crash mid-`np.savez` on the Nth checkpoint
                           write — a torn `.tmp` is left behind (proves the
                           atomic-rename protocol never corrupts the live file)
@@ -48,13 +55,13 @@ import re
 
 _FIELDS = ("u", "v", "w", "p")
 _KIND_SITE = {
-    "pallas": "chunk",
-    "transient": "chunk",
-    "nan": "step",
-    "inf": "step",
-    "ckpt_torn": "write",
-    "ckpt_corrupt": "write",
-    "telemetry": "emit",
+    "pallas": ("chunk",),
+    "transient": ("chunk",),
+    "nan": ("step", "lane"),
+    "inf": ("step", "lane"),
+    "ckpt_torn": ("write",),
+    "ckpt_corrupt": ("write",),
+    "telemetry": ("emit",),
 }
 
 _CLAUSE_RE = re.compile(
@@ -123,11 +130,12 @@ def _clauses() -> tuple:
         if not raw:
             continue
         m = _CLAUSE_RE.match(raw)
-        if m is None or _KIND_SITE.get(m["kind"]) != m["site"]:
+        if m is None or m["site"] not in _KIND_SITE.get(m["kind"], ()):
             raise FaultSpecError(
                 f"bad PAMPI_FAULTS clause {raw!r}; grammar: "
                 "pallas@chunk<N> | transient@chunk<N> | nan@step<N>:<field> "
-                "| inf@step<N>:<field> | ckpt_torn@write<N> | "
+                "| inf@step<N>:<field> | nan@lane<K>:<field> | "
+                "inf@lane<K>:<field> | ckpt_torn@write<N> | "
                 "ckpt_corrupt@write<N> | telemetry@emit<N>  (comma-separated;"
                 " field faults take an optional *<count> re-arm suffix)"
             )
@@ -242,14 +250,45 @@ def take_field_faults() -> tuple:
     if not enabled():
         return ()
     out = []
-    for idx, (kind, _s, step, field, count) in enumerate(_clauses()):
-        if kind not in ("nan", "inf"):
+    for idx, (kind, site, step, field, count) in enumerate(_clauses()):
+        if kind not in ("nan", "inf") or site != "step":
             continue
         used = _charges.get(idx, 0)
         if used >= count:
             continue
         _charges[idx] = used + 1
         out.append((field, step, float("nan" if kind == "nan" else "inf")))
+    return tuple(out)
+
+
+def take_lane_faults(n_lanes=None, fields=None) -> tuple:
+    """Consume one fleet-batch generation of `nan|inf@lane<K>:<field>`
+    clauses — same charge semantics as `take_field_faults`, consumed by
+    `fleet/batch.BatchedSolver` at batch-build time. Each armed clause
+    returns (field, lane, value); the batch driver corrupts that lane's
+    field in the stacked INITIAL state host-side, so the traced program
+    is untouched (lane isolation is proven on the identical compiled
+    chunk, not an instrumented twin) and solo runs never see the clause.
+
+    A clause the calling batch cannot express — lane index past
+    `n_lanes`, field not in the family's `fields` — is NOT consumed: it
+    stays armed for the batch it was aimed at (a 2-lane bucket built
+    before the 3-lane target must not silently spend `nan@lane2:u`)."""
+    if not enabled():
+        return ()
+    out = []
+    for idx, (kind, site, lane, field, count) in enumerate(_clauses()):
+        if kind not in ("nan", "inf") or site != "lane":
+            continue
+        if n_lanes is not None and lane >= n_lanes:
+            continue  # aimed past this batch: leave the charge armed
+        if fields is not None and field not in fields:
+            continue
+        used = _charges.get(idx, 0)
+        if used >= count:
+            continue
+        _charges[idx] = used + 1
+        out.append((field, lane, float("nan" if kind == "nan" else "inf")))
     return tuple(out)
 
 

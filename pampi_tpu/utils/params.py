@@ -158,6 +158,23 @@ class Parameter:
     #   "off"  the serial schedule (bitwise the historical program —
     #          jaxpr-hash identity vs CONTRACTS.json)
     tpu_overlap: str = "auto"
+    # scenario-fleet dispatch (pampi_tpu/fleet/): how a bucket of
+    # same-signature requests is executed by the fleet scheduler
+    # (utils/dispatch.resolve_fleet records every decision under the
+    # per-bucket `fleet_<bucket>` keys).
+    #   "auto"  vmap-batch single-device buckets with >1 scenario (one
+    #           compiled program advances every lane; a diverged lane is
+    #           frozen by the in-band sentinel, batchmates continue);
+    #           distributed buckets and 1-scenario buckets run pjit:
+    #           each scenario occupies the whole mesh sequentially,
+    #           reusing the bucket's one compiled program
+    #   "vmap"  force the batched driver (dist buckets too — vmap over
+    #           the shard_map'ed chunk; the parity-test mode)
+    #   "pjit"  force whole-mesh-per-scenario with executable reuse
+    #   "solo"  the historical path: every request builds and runs its
+    #           own solver (no template reuse; the oracle mode the
+    #           fleet-smoke drift check compares against)
+    tpu_fleet: str = "auto"
     # MG stall detector (tpu_solver mg only): a V-cycle whose residual
     # changed less than this RELATIVE tolerance is treated as floored and
     # the solve returns early (ops/multigrid.MG_STALL_RTOL rationale). Set 0

@@ -43,6 +43,11 @@ Host plane — every record is one JSON line appended to the
               exchange device-vs-exposed split behind the comm-hidden
               fraction
   metric      a headline metric line (bench.py's JSON lines, artifacts)
+  fleet       one fleet run's summary (pampi_tpu/fleet/scheduler.py):
+              per-bucket mode/compile-vs-run walls, scenarios/s
+              throughput, and the divergence census — the block
+              `tools/telemetry_report.py --merge` folds into artifacts
+              as `fleet_summary` and `tools/check_artifact.py` lints
   finalize    end of run: the `utils/profiling` region table, plus
               `dropped_records` when any write failed — a truncated
               flight record names its own truncation instead of reading
@@ -62,8 +67,10 @@ import os
 import time
 import warnings
 
-SCHEMA_VERSION = 3  # v3: + xprof record kind, finalize drop accounting
-#                     (v2, PR 4: + recover / retry / ckpt record kinds)
+SCHEMA_VERSION = 4  # v4: + fleet record kind, scenario dimension on
+#                     chunk/divergence/solve records (scenario_scope)
+#                     (v3, PR 7: + xprof record kind, drop accounting;
+#                      v2, PR 4: + recover / retry / ckpt record kinds)
 
 # METRICS vector layout (float32, shared by the 2-D and 3-D families; the
 # 2-D solvers leave M_WMAX at 0). M_BAD < 0 means all-finite so far;
@@ -77,6 +84,7 @@ _finalized = False
 _atexit_registered = False
 _write_failed = False
 _dropped = 0  # records lost to write failures (reported by finalize)
+_scenario = None  # current tenant/scenario id (scenario_scope)
 
 
 def _path() -> str:
@@ -97,6 +105,24 @@ def reset() -> None:
     _finalized = False
     _write_failed = False
     _dropped = 0
+
+
+@contextlib.contextmanager
+def scenario_scope(sid):
+    """Tag every record emitted inside the block with a `scenario` id —
+    the multi-tenant dimension (pampi_tpu/fleet/): a fleet run's
+    chunk/divergence/solve records name the scenario they belong to, so
+    `tools/telemetry_report.py` can render per-tenant tables. Records
+    that pass an explicit `scenario=` keyword (the batched driver's
+    per-lane recorders) win over the ambient scope. No-op nesting-safe;
+    None restores untagged emission."""
+    global _scenario
+    prev = _scenario
+    _scenario = sid
+    try:
+        yield
+    finally:
+        _scenario = prev
 
 
 def _is_master() -> bool:
@@ -132,6 +158,8 @@ def emit(kind: str, **fields) -> None:
         atexit.register(finalize)
         _atexit_registered = True
     rec = {"v": SCHEMA_VERSION, "kind": kind, "ts": round(time.time(), 3)}
+    if _scenario is not None and "scenario" not in fields:
+        rec["scenario"] = _scenario
     rec.update(fields)
     try:
         from . import faultinject as _fi
@@ -324,14 +352,21 @@ class ChunkRecorder:
     """Host-plane per-chunk recorder: call update(t, nt, metrics) at each
     host sync. Emits one `chunk` record per sync (the first is
     compile-inclusive) and a single `divergence` record + warning the first
-    time the in-band sentinel reports a non-finite step."""
+    time the in-band sentinel reports a non-finite step.
 
-    def __init__(self, family: str, nt0: int = 0):
+    `scenario` tags every record with a tenant/scenario id (the fleet
+    driver runs one recorder per lane); None keeps the solo-run shape."""
+
+    def __init__(self, family: str, nt0: int = 0, scenario=None):
         self.family = family
+        self.scenario = scenario
         self._last = time.perf_counter()
         self._nt = nt0
         self._first = True
         self._diverged = False
+
+    def _tag(self) -> dict:
+        return {} if self.scenario is None else {"scenario": self.scenario}
 
     def rearm(self, nt=None) -> None:
         """Re-arm the one-shot divergence latch: rollback-recovery rolled
@@ -360,6 +395,7 @@ class ChunkRecorder:
         emit(
             "chunk",
             family=self.family,
+            **self._tag(),
             t=float(t),
             nt=int(nt),
             steps=steps,
@@ -381,6 +417,7 @@ class ChunkRecorder:
             emit(
                 "divergence",
                 family=self.family,
+                **self._tag(),
                 first_bad_step=first_bad,
                 last_good_step=last_good,
                 res=float(m[M_RES]),
@@ -389,8 +426,10 @@ class ChunkRecorder:
                 vmax=float(m[M_VMAX]),
                 wmax=float(m[M_WMAX]),
             )
+            who = (self.family if self.scenario is None
+                   else f"{self.family}[{self.scenario}]")
             warnings.warn(
-                f"{self.family}: solver state went non-finite at step "
+                f"{who}: solver state went non-finite at step "
                 f"{first_bad} (last good step {last_good}) — see the "
                 "telemetry divergence record",
                 stacklevel=2,

@@ -1,0 +1,534 @@
+"""Scenario-fleet serving (pampi_tpu/fleet/): batched multi-tenant runs.
+
+Contracts pinned here:
+- fleet parity: a batch-of-N vmapped run equals N solo runs of the same
+  traced program at the repo's ulp contract — BITWISE on the jnp and
+  dist paths (vmap batches `lax.while_loop` by per-lane select), last-
+  ulp only on the fused kernels (the batched grid re-associates fma like
+  every layout precedent) — across all four families, jnp AND fused;
+- diverged-lane isolation: one injected-NaN lane (PAMPI_FAULTS
+  `nan@lane<K>:<field>` — host-side, the compiled chunk is untouched)
+  freezes at its divergence, emits a scenario-tagged divergence record,
+  and never perturbs its batchmates bitwise;
+- bucket routing: mixed-shape queues split into shared-trace buckets,
+  per-lane init keys and drive housekeeping stay OUT of the knob
+  signature, trace-shaping knobs stay IN, and the signature hash is
+  stable across Parameter instances;
+- the `tpu_fleet` dispatch knob: validation, forced modes, the auto
+  policy (vmap for multi-lane single-device buckets, pjit for dist /
+  singleton buckets), decisions recorded like `tpu_overlap`;
+- the vmapped dist chunk censuses the SAME collectives as its solo twin
+  with zero resharding collectives and intact exchange scopes (the
+  commcheck contract that makes vmap-batching safe on a mesh);
+- telemetry: scenario-tagged chunk records, the fleet summary record,
+  the `fleet_summary` merge block and its check_artifact lint.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pampi_tpu import fleet
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.utils import dispatch
+from pampi_tpu.utils import telemetry as tm
+from pampi_tpu.utils.params import Parameter
+
+_B2 = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02, tau=0.5,
+           itermax=10, eps=1e-4, omg=1.7, gamma=0.9, tpu_mesh="1")
+_B3 = dict(name="dcavity3d", imax=8, jmax=8, kmax=8, re=10.0, te=0.02,
+           tau=0.5, itermax=8, eps=1e-4, omg=1.7, gamma=0.9, tpu_mesh="1")
+
+ULP_TOL = 1e-12  # the repo's ulp contract (tests/test_overlap.py)
+
+
+def _build(param, dims=None):
+    if dims is not None:
+        from pampi_tpu.parallel.comm import CartComm
+
+        if fleet.family_of(param) == "ns2d":
+            from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+
+            return NS2DDistSolver(param, CartComm(ndims=2, dims=dims))
+        from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+
+        return NS3DDistSolver(param, CartComm(ndims=3, dims=dims))
+    if fleet.family_of(param) == "ns2d":
+        return NS2DSolver(param)
+    from pampi_tpu.models.ns3d import NS3DSolver
+
+    return NS3DSolver(param)
+
+
+def _assert_close(a, b, name, bitwise):
+    a, b = np.asarray(a), np.asarray(b)
+    if bitwise:
+        assert np.array_equal(a, b), (name, np.abs(a - b).max())
+    else:
+        d = np.abs(a - b)
+        assert np.isfinite(d).all() and d.max() < ULP_TOL, (name, d.max())
+
+
+def _parity_case(base, dims=None, bitwise=True, lanes=2):
+    """Batch-of-N through BatchedSolver vs N solo drives of the SAME
+    template program (the scheduler's pjit path is the oracle driver —
+    independent-build oracles are the fleet-smoke gate)."""
+    from pampi_tpu.fleet.scheduler import _reset_lane
+
+    param = Parameter(**base)
+    template = _build(param, dims)
+    params = [param.replace(u_init=0.01 * i) for i in range(lanes)]
+    batched = fleet.BatchedSolver(
+        template, params, [f"s{i}" for i in range(lanes)])
+    final = batched.run()
+    results = batched.results(final)
+    n_fields = batched._n_fields
+    names = ("u", "v", "p") if n_fields == 3 else ("u", "v", "w", "p")
+    for lane_param, res in zip(params, results):
+        assert not res["diverged"]
+        _reset_lane(template, lane_param)
+        template.run(progress=False)
+        assert res["nt"] == template.nt and template.nt > 0
+        assert abs(res["t"] - template.t) < 1e-12
+        for name, got in zip(names, res["fields"]):
+            _assert_close(got, getattr(template, name), name, bitwise)
+
+
+# -- fleet parity: all four families, jnp and fused --------------------
+# Tier-1 keeps one representative per axis (2-D jnp + fused, 3-D jnp,
+# 2-D dist jnp); the interpret-kernel-heavy fused/3-D-dist combinations
+# carry the `slow` mark to hold the tier-1 870 s window (the PR 2 trim
+# precedent) and run via `make fleet-suite`.
+
+def test_parity_ns2d_jnp_bitwise():
+    _parity_case(dict(_B2, tpu_fuse_phases="off"), lanes=3)
+
+
+def test_parity_ns2d_fused_ulp():
+    _parity_case(dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
+                      tpu_sor_layout="checkerboard", tpu_sor_inner=1),
+                 bitwise=False)
+
+
+def test_parity_ns3d_jnp_bitwise():
+    _parity_case(dict(_B3, tpu_fuse_phases="off"))
+
+
+@pytest.mark.slow
+def test_parity_ns3d_fused_ulp():
+    _parity_case(dict(_B3, tpu_fuse_phases="on", tpu_solver="fft"),
+                 bitwise=False)
+
+
+def test_parity_ns2d_dist_jnp_bitwise():
+    _parity_case(dict(_B2, tpu_mesh="2x2", tpu_fuse_phases="off",
+                      tpu_sor_layout="checkerboard"), dims=(2, 2))
+
+
+@pytest.mark.slow
+def test_parity_ns2d_dist_fused_ulp():
+    _parity_case(dict(_B2, tpu_mesh="2x2", tpu_fuse_phases="on",
+                      tpu_sor_layout="checkerboard"), dims=(2, 2),
+                 bitwise=False)
+
+
+@pytest.mark.slow
+def test_parity_ns3d_dist_jnp_bitwise():
+    _parity_case(dict(_B3, tpu_mesh="2x2x2", tpu_fuse_phases="off"),
+                 dims=(2, 2, 2))
+
+
+@pytest.mark.slow
+def test_parity_ns3d_dist_fused_ulp():
+    _parity_case(dict(_B3, tpu_mesh="2x2x2", tpu_fuse_phases="on"),
+                 dims=(2, 2, 2), bitwise=False)
+
+
+# -- diverged-lane isolation -------------------------------------------
+
+def test_lane_fault_isolation_bitwise(faults, tmp_path, monkeypatch,
+                                      recwarn):
+    jsonl = tmp_path / "fleet.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(jsonl))
+    tm.reset()
+    faults("nan@lane1:u")
+    param = Parameter(**_B2)
+    params = [param.replace(u_init=0.01 * i) for i in range(3)]
+    template = _build(param)
+    batched = fleet.BatchedSolver(template, params, ["t0", "t1", "t2"],
+                                  family="ns2d")
+    results = batched.results(batched.run())
+    assert [r["diverged"] for r in results] == [False, True, False]
+    # the poisoned lane froze at its first (diverging) chunk and its
+    # divergence record names it; batchmates ran to te
+    records = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    div = [r for r in records if r["kind"] == "divergence"]
+    assert [d.get("scenario") for d in div] == ["t1"]
+    assert div[0]["first_bad_step"] == 1
+    tagged = [r for r in records if r["kind"] == "chunk"
+              and "scenario" in r]
+    assert {r["scenario"] for r in tagged} == {"t0", "t1", "t2"}
+    # clean-lane isolation is BITWISE vs clean solo runs (telemetry still
+    # armed so the chunk arity matches; the clause is spent, solo builds
+    # never consult lane clauses anyway)
+    from pampi_tpu.utils import faultinject as fi
+
+    fi.reset()
+    monkeypatch.delenv("PAMPI_FAULTS")
+    for i in (0, 2):
+        solo = _build(params[i])
+        solo.run(progress=False)
+        for name, got in zip("uvp", results[i]["fields"]):
+            _assert_close(got, getattr(solo, name), (i, name),
+                          bitwise=True)
+        assert results[i]["nt"] == solo.nt
+
+
+def test_lane_fault_spec_validation(faults):
+    from pampi_tpu.utils import faultinject as fi
+
+    faults("nan@lane0:u,inf@lane2:p")
+    taken = fi.take_lane_faults()
+    assert [(f, n) for f, n, _ in taken] == [("u", 0), ("p", 2)]
+    assert np.isnan(taken[0][2]) and np.isinf(taken[1][2])
+    # a spent clause stays spent for this generation
+    assert fi.take_lane_faults() == ()
+    # lane clauses never leak into the solver-generation (step) take
+    fi.reset()
+    assert fi.take_field_faults() == ()
+    with pytest.raises(fi.FaultSpecError):
+        faults("nan@lane1")  # lane clauses need a :<field>
+        fi.take_lane_faults()
+
+
+# -- bucket routing -----------------------------------------------------
+
+def test_bucket_routing_mixed_queue():
+    reqs = [
+        fleet.ScenarioRequest("a", Parameter(**_B2)),
+        fleet.ScenarioRequest("b", Parameter(**_B2, u_init=0.3)),
+        fleet.ScenarioRequest("c", Parameter(**{**_B2, "imax": 24})),
+        fleet.ScenarioRequest("d", Parameter(**{**_B2, "re": 20.0})),
+        fleet.ScenarioRequest("e", Parameter(**_B3)),
+    ]
+    buckets = fleet.bucket(reqs)
+    sids = {key.label: [r.sid for r in v] for key, v in buckets.items()}
+    assert len(buckets) == 4
+    # a+b share a trace (u_init is per-lane state); c is another shape;
+    # d bakes a different re into the trace; e is 3-D
+    groups = sorted(sids.values())
+    assert ["a", "b"] in groups
+    fams = {key.family for key in buckets}
+    assert fams == {"ns2d", "ns3d"}
+    grids = {key.grid for key in buckets if key.family == "ns2d"}
+    assert (24, 16) in grids and (16, 16) in grids
+
+
+def test_knob_signature_stability():
+    a, b = Parameter(**_B2), Parameter(**_B2)
+    assert fleet.signature_hash(a) == fleet.signature_hash(b)
+    # per-lane state keys and drive housekeeping stay OUT
+    assert fleet.signature_hash(a.replace(u_init=9.0)) \
+        == fleet.signature_hash(a)
+    assert fleet.signature_hash(a.replace(tpu_checkpoint="x.npz")) \
+        == fleet.signature_hash(a)
+    assert fleet.signature_hash(a.replace(tpu_fleet="pjit")) \
+        == fleet.signature_hash(a)
+    # trace-shaping knobs stay IN
+    for change in (dict(re=20.0), dict(itermax=11), dict(te=0.03),
+                   dict(tpu_solver="fft"), dict(name="canal"),
+                   dict(obstacles="0.3,0.3,0.6,0.6"),
+                   dict(tpu_mesh="2x2")):
+        assert fleet.signature_hash(a.replace(**change)) \
+            != fleet.signature_hash(a), change
+
+
+def test_fleet_refuses_poisson():
+    with pytest.raises(ValueError, match="poisson"):
+        fleet.family_of(Parameter(name="poisson"))
+
+
+def test_fleet_refuses_restart_requests():
+    # silently serving a fresh t=0 run where the tenant asked for a
+    # checkpoint restart would be a wrong answer, not a degraded one
+    with pytest.raises(ValueError, match="tpu_restart"):
+        fleet.bucket_key(Parameter(**_B2, tpu_restart="ckpt.npz"))
+
+
+def test_lane_fault_charge_survives_ineligible_batch(faults):
+    from pampi_tpu.utils import faultinject as fi
+
+    faults("nan@lane2:u")
+    # a 2-lane batch cannot express lane 2: the charge must stay armed
+    assert fi.take_lane_faults(n_lanes=2, fields=("u", "v", "p")) == ()
+    # ...and a w-clause must not be spent by a 2-D family
+    fi.reset()
+    faults("nan@lane0:w")
+    assert fi.take_lane_faults(n_lanes=3, fields=("u", "v", "p")) == ()
+    # the batch the clause was aimed at still consumes it
+    fi.reset()
+    faults("nan@lane2:u")
+    taken = fi.take_lane_faults(n_lanes=3, fields=("u", "v", "p"))
+    assert [(f, n) for f, n, _ in taken] == [("u", 2)]
+
+
+def test_reset_lane_applies_tenant_drive_knobs():
+    # drive-time knobs are excluded from the bucket signature (same
+    # bucket) but each pjit lane must run under ITS OWN recovery policy,
+    # not whichever tenant built the template
+    from pampi_tpu.fleet.scheduler import _reset_lane
+
+    param = Parameter(**_B2, tpu_fuse_phases="off")
+    template = _build(param)
+    tenant = param.replace(tpu_recover_ring=4, tpu_recover_dt_scale=0.25,
+                           tpu_lookahead=0, tpu_retry_replenish=3)
+    assert fleet.bucket_key(tenant) == fleet.bucket_key(param)
+    _reset_lane(template, tenant)
+    assert template.param.tpu_recover_ring == 4
+    assert template.param.tpu_recover_dt_scale == 0.25
+    assert template.param.tpu_lookahead == 0
+    assert template.param.tpu_retry_replenish == 3
+    # trace-shaping fields stay the template's (signature-equal anyway)
+    assert template.param.te == param.te
+
+
+def test_vmap_batch_heals_template_contamination():
+    # a recovery dt clamp / pallas fallback left on the cached template
+    # by an earlier bucket must be healed BEFORE the next batch builds
+    # (a dirty _dt_scale would be baked into the batched trace and serve
+    # every lane a clamped trajectory) and again after it
+    from pampi_tpu.fleet import scheduler as sch
+
+    fleet.reset_templates()
+    s = fleet.FleetScheduler()
+    param = Parameter(**_B2)
+    s.submit_param("a", param)
+    s.submit_param("b", param.replace(u_init=0.01))
+    s.run()
+    template = next(iter(sch._TEMPLATES.values()))[0]
+    template._backend = "jnp"  # as a mid-batch fallback leaves it
+    template._dt_scale = 0.5   # as a ring recovery leaves it
+    s.submit_param("c", param.replace(u_init=0.02))
+    s.submit_param("d", param.replace(u_init=0.03))
+    res = s.run()
+    assert template._backend == "auto" and template._dt_scale == 1.0
+    assert res.summary["divergence_census"]["diverged"] == 0
+    # the batch served the HEALED program: lanes equal fresh solo runs
+    solo = _build(param.replace(u_init=0.02))
+    solo.run(progress=False)
+    for name, got in zip("uvp", res.by_sid("c").fields):
+        _assert_close(got, getattr(solo, name), name, bitwise=True)
+
+
+def test_vmap_batch_takes_drive_knobs_from_requests():
+    # one drive loop per batch: its retry/recovery policy comes from the
+    # FIRST request, never from whichever tenant built the template
+    param = Parameter(**_B2, tpu_fuse_phases="off")
+    template = _build(param)
+    tenant = param.replace(tpu_retry_replenish=3, tpu_lookahead=0,
+                           tpu_recover_ring=4)
+    batched = fleet.BatchedSolver(template, [tenant, tenant], ["a", "b"])
+    assert batched.param.tpu_retry_replenish == 3
+    assert batched.param.tpu_lookahead == 0
+    assert batched.param.tpu_recover_ring == 4
+    assert batched.param.te == template.param.te  # trace fields: template's
+
+
+def test_reset_lane_clears_recovery_contamination():
+    # a previous tenant's divergence recovery (cumulative dt clamp) or
+    # pallas fallback must not leak into the next tenant's program
+    from pampi_tpu.fleet.scheduler import _reset_lane
+
+    param = Parameter(**_B2, tpu_fuse_phases="off")
+    template = _build(param)
+    clean = _build(param)
+    clean.run(progress=False)
+    template._dt_scale = 0.5  # as RingRecovery.attempt would leave it
+    template._backend = "jnp"  # as a pallas fallback would leave it
+    _reset_lane(template, param)
+    assert template._dt_scale == 1.0 and template._backend == "auto"
+    template.run(progress=False)
+    assert template.nt == clean.nt
+    for name in "uvp":
+        _assert_close(getattr(template, name), getattr(clean, name),
+                      name, bitwise=True)
+
+
+# -- the tpu_fleet knob -------------------------------------------------
+
+def test_resolve_fleet_validation_and_policy():
+    p = Parameter(**_B2)
+    with pytest.raises(ValueError, match="tpu_fleet"):
+        dispatch.resolve_fleet(p.replace(tpu_fleet="batch"), 2, False, "k")
+    assert dispatch.resolve_fleet(p, 3, False, "fleet_t") == "vmap"
+    assert dispatch.last("fleet_t").startswith("vmap")
+    assert dispatch.resolve_fleet(p, 3, True, "fleet_t") == "pjit"
+    assert dispatch.last("fleet_t").startswith("pjit (dist")
+    assert dispatch.resolve_fleet(p, 1, False, "fleet_t") == "pjit"
+    for forced in ("vmap", "pjit", "solo"):
+        assert dispatch.resolve_fleet(
+            p.replace(tpu_fleet=forced), 1, True, "fleet_t") == forced
+
+
+# -- the vmapped dist chunk's collective contract -----------------------
+
+def test_dist_fleet_census_matches_solo():
+    from pampi_tpu.analysis.commcheck import census, scoped_exchanges
+    from pampi_tpu.analysis.jaxprcheck import trace_chunk
+
+    param = Parameter(**_B2, tpu_fuse_phases="off",
+                      tpu_sor_layout="checkerboard")
+    solo = _build(param, dims=(2, 2))
+    batched = fleet.BatchedSolver(solo, [param, param], ["a", "b"])
+    jx_solo = trace_chunk(solo)
+    jx_fleet = trace_chunk(batched)
+    c_solo, c_fleet = census(jx_solo.jaxpr), census(jx_fleet.jaxpr)
+    # identical collective COUNTS: lanes ride the messages, they never
+    # add messages — and zero resharding collectives
+    assert c_fleet["collectives"] == c_solo["collectives"]
+    for resharder in ("all_gather", "all_to_all", "reduce_scatter"):
+        assert c_fleet["collectives"][resharder] == 0
+    # the exchange scopes survive vmap (device-time attribution intact)
+    assert any(scoped_exchanges(jx_fleet.jaxpr))
+
+
+# -- scheduler end-to-end ----------------------------------------------
+
+def test_scheduler_routes_and_reuses_templates():
+    fleet.reset_templates()
+    sched = fleet.FleetScheduler()
+    for sid, p in (("a", Parameter(**_B2)),
+                   ("b", Parameter(**_B2, u_init=0.05)),
+                   ("w", Parameter(**{**_B2, "imax": 24}))):
+        sched.submit_param(sid, p)
+    res = sched.run()
+    assert res.summary["n_scenarios"] == 3
+    by_mode = {b["mode"] for b in res.summary["buckets"]}
+    assert by_mode == {"vmap", "pjit"}  # 2-lane bucket + singleton
+    assert res.summary["divergence_census"] == {
+        "diverged": 0, "scenarios": []}
+    assert res.summary["scenarios_per_s"] > 0
+    assert res.by_sid("a").nt == res.by_sid("b").nt > 0
+    # the queue drained; the first batch built its templates cold
+    assert sched.requests == []
+    assert all(b["template_cached"] is False
+               for b in res.summary["buckets"])
+    # a second same-shape batch REBINDS the cached compiled batch (zero
+    # retrace — the warm serving path): same BatchedSolver object, zero
+    # compile wall, and the lanes still get their own results
+    from pampi_tpu.fleet import scheduler as sch
+
+    warm_batch = next(iter(sch._BATCHES.values()))
+    sched.submit_param("c", Parameter(**_B2, u_init=0.07))
+    sched.submit_param("d", Parameter(**_B2, u_init=0.09))
+    res2 = sched.run()
+    assert res2.summary["buckets"][0]["template_cached"] is True
+    assert res2.summary["buckets"][0]["compile_wall_s"] == 0.0
+    assert next(iter(sch._BATCHES.values())) is warm_batch
+    assert res2.by_sid("c").nt > 0 and not res2.by_sid("d").diverged
+    # dispatch decisions recorded per bucket, tpu_overlap-style
+    snap = dispatch.snapshot()
+    assert any(k.startswith("fleet_ns2d_16x16") and "vmap" in v
+               for k, v in snap.items())
+
+
+def test_scheduler_solo_mode_matches_vmap():
+    fleet.reset_templates()
+    param = Parameter(**_B2, tpu_fleet="solo")
+    reqs = [fleet.ScenarioRequest(f"s{i}", param.replace(u_init=0.01 * i))
+            for i in range(2)]
+    solo_res = fleet.run_fleet(reqs)
+    assert all(b["mode"] == "solo" for b in solo_res.summary["buckets"])
+    vm = [fleet.ScenarioRequest(f"s{i}",
+                                param.replace(tpu_fleet="vmap",
+                                              u_init=0.01 * i))
+          for i in range(2)]
+    vm_res = fleet.run_fleet(vm)
+    for i in range(2):
+        a, b = solo_res.scenarios[i], vm_res.scenarios[i]
+        assert a.nt == b.nt
+        for idx, (fa, fb) in enumerate(zip(a.fields, b.fields)):
+            _assert_close(fa, fb, idx, bitwise=True)
+
+
+# -- telemetry / artifact plumbing --------------------------------------
+
+def test_scenario_scope_tags_records(tmp_path, monkeypatch):
+    jsonl = tmp_path / "scope.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(jsonl))
+    tm.reset()
+    with tm.scenario_scope("tenant42"):
+        tm.emit("solve", family="poisson", iters=3)
+        tm.emit("chunk", family="x", scenario="explicit")
+    tm.emit("solve", family="poisson", iters=4)
+    recs = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    by_kind = {}
+    for r in recs:
+        by_kind.setdefault(r["kind"], []).append(r)
+    assert by_kind["solve"][0]["scenario"] == "tenant42"
+    assert by_kind["chunk"][0]["scenario"] == "explicit"  # explicit wins
+    assert "scenario" not in by_kind["solve"][1]
+
+
+def test_fleet_summary_merge_and_lint(tmp_path):
+    from tools import telemetry_report as tr
+    from tools._artifact import write_merged
+    from tools.check_artifact import lint_bench, lint_fleet_summary
+
+    records = [
+        {"v": 4, "kind": "run", "backend": "cpu"},
+        {"v": 4, "kind": "chunk", "family": "ns2d", "scenario": "a",
+         "steps": 5, "t": 0.02, "nt": 5, "wall_s": 0.1,
+         "ms_per_step": 20.0, "includes_compile": True},
+        {"v": 4, "kind": "divergence", "family": "ns2d", "scenario": "b",
+         "first_bad_step": 3},
+        {"v": 4, "kind": "fleet", "n_scenarios": 2,
+         "buckets": [{"bucket": "ns2d_16x16_abc", "family": "ns2d",
+                      "grid": [16, 16], "mode": "vmap", "lanes": 2,
+                      "compile_wall_s": 0.5, "run_wall_s": 1.0}],
+         "scenarios_per_s": 2.0,
+         "divergence_census": {"diverged": 1, "scenarios": ["b"]}},
+    ]
+    fl = tr.fleet_summary(records)
+    assert fl["scenarios_per_s"] == 2.0
+    assert fl["scenarios"]["b"]["diverged"] is True
+    assert fl["scenarios"]["a"]["steps"] == 5
+    art = tmp_path / "BENCH_r99.json"
+    merged = write_merged(str(art), {
+        "n": 99, "cmd": "t", "rc": 0, "tail": "",
+        "telemetry_summary": tr.summary(records),
+        "fleet_summary": fl,
+    })
+    assert lint_bench(merged, "B") == []
+    # the throughput surfaces in the normalized metric list, cpu-tagged
+    entry = [m for m in merged["metrics"]
+             if m["name"] == "fleet_scenarios_per_s"]
+    assert entry and entry[0]["backend"] == "cpu"
+    # a censusless fleet block is a lint violation, not a quiet pass
+    bad = dict(fl)
+    bad.pop("divergence_census")
+    assert any("divergence_census" in e
+               for e in lint_fleet_summary(bad, "F"))
+    bad2 = dict(fl)
+    bad2["buckets"] = [{"bucket": "x"}]
+    assert any("mode" in e for e in lint_fleet_summary(bad2, "F"))
+
+
+def test_fleet_record_renders(tmp_path, monkeypatch):
+    from tools import telemetry_report as tr
+
+    jsonl = tmp_path / "fleet.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(jsonl))
+    tm.reset()
+    fleet.reset_templates()
+    reqs = [fleet.ScenarioRequest(f"s{i}",
+                                  Parameter(**_B2, u_init=0.01 * i))
+            for i in range(2)]
+    fleet.run_fleet(reqs)
+    tm.finalize()
+    out = tr.render(tr.load(str(jsonl)))
+    assert "== fleet ==" in out
+    assert "== scenarios (per tenant) ==" in out
+    assert "s0" in out and "s1" in out

@@ -60,17 +60,29 @@ def collect_metrics(rec: dict) -> list[dict]:
             "backend": backend_tag(block),
         })
     chf = rec.get("comm_hidden_fraction")
+    # backend from the run the blocks were merged from (telemetry
+    # summary), never the tpu default: the CPU smoke plane must not
+    # seed a chip-gating series
+    run_backend = (rec.get("telemetry_summary") or {}).get("backend")
     if isinstance(chf, dict) and isinstance(
             chf.get("hidden_fraction"), (int, float)) \
             and "comm_hidden_fraction" not in seen:
-        # backend from the run the block was merged from (telemetry
-        # summary), never the tpu default: the CPU smoke plane must not
-        # seed a chip-gating series
-        run_backend = (rec.get("telemetry_summary") or {}).get("backend")
         out.append({
             "name": "comm_hidden_fraction",
             "value": chf["hidden_fraction"],
             "unit": "fraction",
+            "backend": "tpu" if run_backend == "tpu" else "cpu",
+        })
+    fl = rec.get("fleet_summary")
+    if isinstance(fl, dict) and isinstance(
+            fl.get("scenarios_per_s"), (int, float)) \
+            and "fleet_scenarios_per_s" not in seen:
+        # the fleet throughput headline (ROADMAP item 3): a */s rate, so
+        # bench_trend gates it higher-is-better by unit AND by name
+        out.append({
+            "name": "fleet_scenarios_per_s",
+            "value": fl["scenarios_per_s"],
+            "unit": "scenarios/s",
             "backend": "tpu" if run_backend == "tpu" else "cpu",
         })
     return out

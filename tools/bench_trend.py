@@ -98,8 +98,12 @@ def build_series(points: list[dict]) -> dict:
 # its unit: the comm-hidden fraction (ROADMAP item 2) is the overlap
 # refactor's headline — a DROP means exchange time slid back onto the
 # critical path, so it regresses downward despite its unitless [0, 1]
-# range
-NAME_DIRECTIONS = {"comm_hidden_fraction": True}
+# range. The fleet throughput (ROADMAP item 3, tools/perf_fleet.py) is
+# named here explicitly even though its scenarios/s unit already gates
+# upward — the serving headline must never silently degrade to
+# render-only if its unit string drifts.
+NAME_DIRECTIONS = {"comm_hidden_fraction": True,
+                   "fleet_scenarios_per_s": True}
 
 
 def higher_is_better(unit, name: str | None = None) -> bool | None:

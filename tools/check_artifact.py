@@ -28,6 +28,11 @@ Contracts:
   additionally scopes/collectives/exchange_device_ms}) and the ROADMAP
   item 2 block ({mode, steps, exchange device/exposed/serial per-step,
   hidden_fraction}).
+- fleet_summary (optional until a fleet run merges one): the
+  pampi_tpu/fleet scheduler's summary — {n_scenarios, buckets,
+  scenarios_per_s, divergence_census}, every bucket row carrying
+  {bucket, mode, lanes, compile_wall_s, run_wall_s} and the census
+  {diverged, scenarios} — the ROADMAP item 3 serving record.
 - telemetry_summary (optional until a run emits one): the
   tools/telemetry_report.summary shape — {schema_version, dispatch,
   chunks, records}; when the PR 4 resilience blocks are present,
@@ -119,10 +124,43 @@ def lint_comm_hidden(d: dict, where: str) -> list[str]:
     return errs
 
 
+FLEET_KEYS = ("n_scenarios", "buckets", "scenarios_per_s",
+              "divergence_census")
+FLEET_BUCKET_KEYS = ("bucket", "mode", "lanes", "compile_wall_s",
+                     "run_wall_s")
+
+
+def lint_fleet_summary(d: dict, where: str) -> list[str]:
+    """The fleet serving record (pampi_tpu/fleet/scheduler.py summary):
+    buckets + throughput + divergence census are required — a fleet
+    artifact without its census would hide diverged tenants."""
+    errs = _missing(d, FLEET_KEYS, where)
+    buckets = d.get("buckets")
+    if isinstance(buckets, list):
+        for i, b in enumerate(buckets):
+            if not isinstance(b, dict):
+                errs.append(f"{where}.buckets[{i}]: not a dict")
+                continue
+            errs += _missing(b, FLEET_BUCKET_KEYS, f"{where}.buckets[{i}]")
+            if b.get("mode") not in ("vmap", "pjit", "solo"):
+                errs.append(f"{where}.buckets[{i}].mode: "
+                            f"{b.get('mode')!r} not vmap|pjit|solo")
+    elif "buckets" in d:
+        errs.append(f"{where}.buckets: not a list")
+    census = d.get("divergence_census")
+    if isinstance(census, dict):
+        errs += _missing(census, ("diverged", "scenarios"),
+                         f"{where}.divergence_census")
+    elif "divergence_census" in d:
+        errs.append(f"{where}.divergence_census: not a dict")
+    return errs
+
+
 def _lint_optional_blocks(d: dict, where: str) -> list[str]:
     errs = []
     for key, fn in (("xprof_summary", lint_xprof_summary),
-                    ("comm_hidden_fraction", lint_comm_hidden)):
+                    ("comm_hidden_fraction", lint_comm_hidden),
+                    ("fleet_summary", lint_fleet_summary)):
         block = d.get(key)
         if block is None:
             continue

@@ -1,0 +1,190 @@
+"""Fleet smoke: a tiny mixed scenario queue end-to-end on whatever
+backend this host has (make fleet-smoke — CPU-safe).
+
+    python tools/fleet_smoke.py [outdir]
+
+Arms PAMPI_TELEMETRY and drives the whole serving stack: enqueue a mixed
+queue (three same-bucket dcavity scenarios differing only in initial
+conditions, one canal bucket with different BCs, one off-shape dcavity,
+one 3-D scenario) -> bucket -> batch/execute (the `tpu_fleet auto`
+policy) -> per-scenario results + the fleet summary artifact. Then
+proves, before any TPU time is spent:
+
+- DRIFT GATE: every lane's final fields are compared against its SOLO
+  oracle (a fresh solver run through the historical `.run()` path) at
+  the repo's ulp contract — exit 1 if any lane drifts. The vmap batch
+  must serve exactly what a dedicated process would have.
+- the telemetry plane carries the fleet dimension: scenario-tagged
+  chunk records, a `fleet` record with buckets/throughput/census, the
+  `fleet_summary` merge block, and `tools/check_artifact.py` accepting
+  the merged artifact.
+- the throughput metric is recorded (`fleet_scenarios_per_s`, backend-
+  tagged) — the series `tools/bench_trend.py` gates higher-is-better.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU-stable smoke environment: must precede any jax import (the
+# tools/lint.py convention); a TPU image just keeps its own backend
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+ULP_TOL = 1e-12  # the repo's ulp contract (tests/test_overlap.py)
+
+
+def _queue():
+    from pampi_tpu.fleet import ScenarioRequest
+    from pampi_tpu.utils.params import Parameter
+
+    b2 = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02, tau=0.5,
+              itermax=10, eps=1e-4, omg=1.7, gamma=0.9, tpu_mesh="1")
+    b3 = dict(name="dcavity3d", imax=8, jmax=8, kmax=8, re=10.0, te=0.02,
+              tau=0.5, itermax=8, eps=1e-4, omg=1.7, gamma=0.9,
+              tpu_mesh="1")
+    return [
+        # one 3-lane vmap bucket: a u_init sweep of one configuration
+        ScenarioRequest("cavity_a", Parameter(**b2)),
+        ScenarioRequest("cavity_b", Parameter(**b2, u_init=0.05)),
+        ScenarioRequest("cavity_c", Parameter(**b2, p_init=0.25)),
+        # different BCs -> different signature -> its own bucket
+        ScenarioRequest("canal", Parameter(**{**b2, "name": "canal",
+                                              "bcLeft": 3, "bcRight": 3})),
+        # different grid -> different bucket (shape bucketing)
+        ScenarioRequest("cavity_wide",
+                        Parameter(**{**b2, "imax": 24})),
+        # a 3-D tenant rides the same queue
+        ScenarioRequest("cavity3d", Parameter(**b3)),
+    ]
+
+
+def _solo_oracle(req):
+    """The historical path: a dedicated solver for this request."""
+    from pampi_tpu.fleet.queue import family_of
+
+    if family_of(req.param) == "ns2d":
+        from pampi_tpu.models.ns2d import NS2DSolver
+
+        s = NS2DSolver(req.param)
+        names = "uvp"
+    else:
+        from pampi_tpu.models.ns3d import NS3DSolver
+
+        s = NS3DSolver(req.param)
+        names = "uvwp"
+    s.run(progress=False)
+    return s, [np.asarray(getattr(s, n)) for n in names]
+
+
+def main(argv: list[str]) -> int:
+    outdir = argv[1] if len(argv) > 1 else os.path.join(
+        REPO, "results", "fleet_smoke")
+    os.makedirs(outdir, exist_ok=True)
+    jsonl = os.path.join(outdir, "run.jsonl")
+    if os.path.exists(jsonl):
+        os.remove(jsonl)
+    os.environ["PAMPI_TELEMETRY"] = jsonl
+
+    from pampi_tpu.fleet import run_fleet
+    from pampi_tpu.utils import telemetry as tm
+
+    tm.reset()
+    tm.start_run(tool="fleet_smoke")
+    reqs = _queue()
+    result = run_fleet(reqs)
+    tm.finalize()
+
+    failures: list[str] = []
+    summary = result.summary
+    print(json.dumps(summary, indent=2))
+    if summary["n_scenarios"] != len(reqs):
+        failures.append(
+            f"served {summary['n_scenarios']} of {len(reqs)} scenarios")
+    if len(summary["buckets"]) != 4:
+        failures.append(
+            f"{len(summary['buckets'])} buckets (expected 4: cavity "
+            "sweep, canal, wide, 3-D)")
+    modes = {b["bucket"]: b["mode"] for b in summary["buckets"]}
+    if "vmap" not in modes.values():
+        failures.append(f"no vmap bucket in {modes} — the batched "
+                        "driver never ran")
+    if summary["divergence_census"]["diverged"]:
+        failures.append(
+            f"clean queue reported divergence: "
+            f"{summary['divergence_census']}")
+    if not summary["scenarios_per_s"]:
+        failures.append("no scenarios_per_s throughput recorded")
+
+    # the drift gate: every lane vs its solo oracle
+    for req in reqs:
+        lane = result.by_sid(req.sid)
+        oracle, fields = _solo_oracle(req)
+        if lane.nt != oracle.nt:
+            failures.append(
+                f"{req.sid}: lane nt {lane.nt} != solo {oracle.nt}")
+            continue
+        names = "uvp" if len(lane.fields) == 3 else "uvwp"
+        for name, a, b in zip(names, lane.fields, fields):
+            d = np.abs(a - b)
+            if not (np.isfinite(d).all() and
+                    (d.max() if d.size else 0.0) < ULP_TOL):
+                failures.append(
+                    f"{req.sid}: field {name} drifted from its solo "
+                    f"oracle (max |diff| {d.max():.3e})")
+
+    # the telemetry plane end-to-end
+    from tools import telemetry_report as tr
+
+    records = tr.load(jsonl)
+    sys.stdout.write(tr.render(records))
+    fleet_recs = [r for r in records if r.get("kind") == "fleet"]
+    if not fleet_recs:
+        failures.append("no fleet record in the flight record")
+    tagged = [r for r in records
+              if r.get("kind") == "chunk" and r.get("scenario")]
+    if not tagged:
+        failures.append("no scenario-tagged chunk records — the "
+                        "per-tenant dimension is missing")
+    metric = [r for r in records if r.get("kind") == "metric"
+              and r.get("metric") == "fleet_scenarios_per_s"]
+    if not metric:
+        failures.append("no fleet_scenarios_per_s metric record")
+
+    # the merge + lint round trip
+    artifact = os.path.join(outdir, "FLEET_SMOKE.json")
+    if os.path.exists(artifact):
+        os.remove(artifact)
+    from tools._artifact import write_merged
+    from tools.check_artifact import lint_bench
+
+    block = {"n": 0, "cmd": "fleet_smoke", "rc": 0, "tail": "",
+             "telemetry_summary": tr.summary(records),
+             "fleet_summary": tr.fleet_summary(records)}
+    merged = write_merged(artifact, block)
+    failures += lint_bench(merged, "FLEET_SMOKE")
+    if not any(m.get("name") == "fleet_scenarios_per_s"
+               for m in merged.get("metrics", [])):
+        failures.append("merged artifact carries no normalized "
+                        "fleet_scenarios_per_s metric")
+
+    if failures:
+        print("\nFLEET SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"\nfleet smoke ok: {summary['n_scenarios']} scenarios / "
+          f"{len(summary['buckets'])} buckets at "
+          f"{summary['scenarios_per_s']} scenarios/s, every lane "
+          "bitwise-or-ulp equal to its solo oracle")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

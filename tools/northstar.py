@@ -449,6 +449,9 @@ def refconfig() -> dict:
 
 
 if __name__ == "__main__":
+    from pampi_tpu.utils import xlacache
+
+    xlacache.enable()  # repeated 4096² builds become disk loads
     mode = sys.argv[1] if len(sys.argv) > 1 else "run4096"
     os.makedirs(RESULTS, exist_ok=True)
     if mode == "match":

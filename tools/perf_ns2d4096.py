@@ -80,8 +80,9 @@ def measure(solver: str) -> float:
 
 
 if __name__ == "__main__":
-    from pampi_tpu.utils import telemetry
+    from pampi_tpu.utils import telemetry, xlacache
 
+    xlacache.enable()  # per-solver 4096² builds become disk loads
     solvers = sys.argv[1:] or ["sor", "fft", "mg"]
     telemetry.start_run(tool="perf_ns2d4096", solvers=solvers)
     print(f"backend={jax.default_backend()} N={N} itermax=100 eps=1e-3 f32")

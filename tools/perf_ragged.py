@@ -236,8 +236,9 @@ def ragged_step_decomposition() -> dict:
 
 
 if __name__ == "__main__":
-    from pampi_tpu.utils import telemetry
+    from pampi_tpu.utils import telemetry, xlacache
 
+    xlacache.enable()  # the two-point builds recompile the same kernels
     telemetry.start_run(tool="perf_ragged")
     rec = {
         "artifact": "ragged_throughput",

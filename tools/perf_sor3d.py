@@ -30,8 +30,9 @@ DT = jnp.float32
 ITERS = 200
 dx, dy, dz, omega = 1.0 / I, 1.0 / J, 1.0 / K, 1.8
 
-from pampi_tpu.utils import telemetry  # noqa: E402
+from pampi_tpu.utils import telemetry, xlacache  # noqa: E402
 
+xlacache.enable()  # repeated kernel-variant builds become disk loads
 telemetry.start_run(tool="perf_sor3d", grid=[K, J, I])
 
 

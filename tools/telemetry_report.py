@@ -14,12 +14,20 @@ split), and the profiling region table. A telemetry write-failure
 truncation (`finalize.dropped_records`) is surfaced loudly — a clipped
 flight record must never read as a quiet run.
 
+Fleet runs (pampi_tpu/fleet/) add the multi-tenant dimension: chunk/
+divergence/solve records carry a `scenario` id, rendered as a
+per-scenario (per-tenant) table, and the scheduler's `fleet` record
+(bucket modes, compile-vs-run walls, scenarios/s throughput, divergence
+census) renders as the fleet section.
+
 `--merge <path>` folds the machine-readable blocks into a
 BENCH_rXX/MULTICHIP_rXX artifact via tools/_artifact.write_merged (the
 merge-preserving convention): `telemetry_summary`, plus — when the run
-captured them — a top-level `xprof_summary` and the `comm_hidden_fraction`
+captured them — a top-level `xprof_summary`, the `comm_hidden_fraction`
 block ROADMAP item 2 is measured by (exchange device time vs its exposed
-critical-path share vs the serial-probe `.exchange` span).
+critical-path share vs the serial-probe `.exchange` span), and the
+`fleet_summary` block ROADMAP item 3 is measured by
+(tools/check_artifact.py lints all three).
 """
 
 from __future__ import annotations
@@ -148,6 +156,46 @@ def summary(records: list[dict]) -> dict:
     return out
 
 
+def scenario_table(records: list[dict]) -> dict:
+    """Per-scenario (per-tenant) aggregation of the scenario-tagged
+    chunk/divergence records: {scenario: {chunks, steps, last_t,
+    last_nt, diverged, first_bad_step}}. Empty dict when the run had no
+    scenario dimension (solo runs — the pre-fleet shape)."""
+    out: dict[str, dict] = {}
+    for r in records:
+        sid = r.get("scenario")
+        if sid is None:
+            continue
+        row = out.setdefault(str(sid), {
+            "chunks": 0, "steps": 0, "last_t": None, "last_nt": None,
+            "diverged": False, "first_bad_step": None,
+        })
+        if r.get("kind") == "chunk":
+            row["chunks"] += 1
+            row["steps"] += r.get("steps") or 0
+            row["last_t"] = r.get("t")
+            row["last_nt"] = r.get("nt")
+        elif r.get("kind") == "divergence":
+            row["diverged"] = True
+            row["first_bad_step"] = r.get("first_bad_step")
+    return out
+
+
+def fleet_summary(records: list[dict]):
+    """The last `fleet` record, cleaned for the artifact (`fleet_summary`
+    top-level block; tools/check_artifact.py lints it). The per-scenario
+    table rides along so the artifact names every tenant served."""
+    fl = [r for r in records if r.get("kind") == "fleet"]
+    if not fl:
+        return None
+    out = {key: val for key, val in fl[-1].items()
+           if key not in ("v", "kind", "ts")}
+    table = scenario_table(records)
+    if table:
+        out["scenarios"] = table
+    return out
+
+
 def xprof_summary(records: list[dict]):
     """The last captured device-trace region, cleaned for the artifact
     (`xprof_summary` top-level block; tools/check_artifact.py lints it)."""
@@ -246,6 +294,29 @@ def render(records: list[dict]) -> str:
                 f"{_num(c.get('dt')):>12.4e} {_num(c.get('umax')):>10.4g} "
                 f"{_num(c.get('vmax')):>10.4g} {_num(c.get('wmax')):>10.4g}"
                 + ("  [compile]" if c.get("includes_compile") else ""))
+
+    scen = scenario_table(records)
+    if scen:
+        add("== scenarios (per tenant) ==")
+        add(f"  {'scenario':<20} {'chunks':>7} {'steps':>7} {'last t':>12} "
+            f"{'last nt':>8}  status")
+        for sid, row in scen.items():
+            status = ("DIVERGED @ step %s" % row["first_bad_step"]
+                      if row["diverged"] else "ok")
+            add(f"  {sid:<20} {row['chunks']:>7} {row['steps']:>7} "
+                f"{_num(row['last_t']):>12.6g} {str(row['last_nt']):>8}  "
+                f"{status}")
+
+    for f in k.get("fleet", []):
+        add("== fleet ==")
+        add(f"  scenarios={f.get('n_scenarios')} "
+            f"throughput={f.get('scenarios_per_s')} scenarios/s "
+            f"diverged={((f.get('divergence_census') or {}).get('diverged'))}")
+        for b in f.get("buckets") or []:
+            add(f"  bucket {b.get('bucket'):<32} mode={b.get('mode'):<5} "
+                f"lanes={b.get('lanes'):>3} "
+                f"compile={b.get('compile_wall_s')}s "
+                f"run={b.get('run_wall_s')}s")
 
     for d in k.get("divergence", []):
         add("== DIVERGENCE ==")
@@ -379,6 +450,9 @@ def main(argv: list[str]) -> int:
         chf = comm_hidden_fraction(records)
         if chf is not None:
             block["comm_hidden_fraction"] = chf
+        fl = fleet_summary(records)
+        if fl is not None:
+            block["fleet_summary"] = fl
         write_merged(merge_to, block)
     return 0
 
