@@ -115,12 +115,25 @@ fleet-suite:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
 
 # Standalone run of the fault-injection / recovery suite (PAMPI_FAULTS
-# plane, retry budgets, rollback-recovery, checkpoint durability edges).
+# plane, retry budgets, rollback-recovery, checkpoint durability edges,
+# and the PR 10 coordinator protocol: tests/test_coordinator.py carries
+# the simulated 4-rank chunk-boundary smoke — an injected rank-2
+# transient retried globally plus a rank-0 divergence rollback, with
+# identical post-recovery state asserted on every rank — and the
+# elastic-restore matrix rides tests/test_checkpoint.py).
 # The same tests ride tier-1 at 16-squared size; this target is the quick
 # focused loop while touching the recovery layer.
 fault-suite:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_faultinject.py \
-	  tests/test_driver.py tests/test_checkpoint.py -q
+	  tests/test_driver.py tests/test_checkpoint.py \
+	  tests/test_coordinator.py -q
+
+# Offline checkpoint verifier (both formats: elastic manifest + shards,
+# legacy single-.npz): generation, writing mesh, per-field CRC status.
+#   make ckpt-fsck CKPT=ck.npz
+CKPT ?= ckpt.npz
+ckpt-fsck:
+	python tools/ckpt_fsck.py $(CKPT)
 
 clean:
 	rm -rf $(BUILD) exe-$(TAG)
@@ -130,4 +143,4 @@ distclean:
 
 .PHONY: all test asm format telemetry-report check-artifacts bench-trend \
 	profile-smoke fleet-smoke fleet-suite lint lint-update lint-comm \
-	fault-suite clean distclean
+	fault-suite ckpt-fsck clean distclean

@@ -197,6 +197,15 @@ def _dispatch(param, prof) -> int:
         )
         return 1
 
+    if param.tpu_coord not in ("auto", "on", "off") \
+            or param.tpu_ckpt_elastic not in (0, 1):
+        print(
+            "Error: tpu_coord must be auto|on|off and tpu_ckpt_elastic "
+            f"0|1 (got {param.tpu_coord!r}, {param.tpu_ckpt_elastic})",
+            file=sys.stderr,
+        )
+        return 1
+
     from .utils import faultinject as _fi
 
     if _fi.enabled():
@@ -297,7 +306,8 @@ def _dispatch(param, prof) -> int:
         on_sync = None
         if param.tpu_restart:
             try:
-                ckpt.load_checkpoint(param.tpu_restart, solver)
+                # either format: legacy .npz or elastic manifest (sniffed)
+                ckpt.load_any(param.tpu_restart, solver)
             except (OSError, ValueError, KeyError) as exc:
                 # config-class error: same one-line convention as _try_build
                 print(f"Error: cannot restart from {param.tpu_restart}: {exc}",
@@ -305,16 +315,24 @@ def _dispatch(param, prof) -> int:
                 return 1
             print(f"Restarted from {param.tpu_restart} at t={solver.t:.4f}")
         if param.tpu_checkpoint:
-            on_sync = ckpt.periodic_writer(
-                param.tpu_checkpoint, param.tpu_ckpt_every
-            )
+            from .parallel.coordinator import coord_armed
+
+            # an armed coordinator owns the checkpoint cadence itself
+            # (the agreed ckpt vote at chunk boundaries — models/_driver.
+            # coord_ckpt_cadence); wiring the counter-based writer too
+            # would double-write every cadence point
+            if not coord_armed(param):
+                on_sync = ckpt.periodic_writer(
+                    param.tpu_checkpoint, param.tpu_ckpt_every,
+                    save=ckpt.writer_for(param),
+                )
         start = get_timestamp()
         with prof.region("timeloop"):
             solver.run(on_sync=on_sync)
         end = get_timestamp()
         print("Solution took %.2fs" % (end - start))
         if param.tpu_checkpoint:
-            ckpt.save_checkpoint(param.tpu_checkpoint, solver)
+            ckpt.writer_for(param)(param.tpu_checkpoint, solver)
         with prof.region("writeResult"):
             if is3d:
                 if param.tpu_vtk == "sharded":

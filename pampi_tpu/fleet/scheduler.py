@@ -341,6 +341,43 @@ class FleetScheduler:
         }
         return row, results
 
+    def elastic_restore(self, path: str, param, family: str = "ns2d",
+                        devices=None):
+        """The autoscaling primitive (ROADMAP item 4): resume an ELASTIC
+        checkpoint (utils/checkpoint.save_elastic) on however many chips
+        this scheduler currently has — a dist run saved on 8 chips
+        shrinks onto 4 (or 1) because the manifest holds the
+        mesh-independent global fields and `set_global_fields` reshards
+        them onto whatever NamedSharding the freshly-built solver uses.
+        `devices` limits the target (None = every local device); a
+        single device builds the plain solver. Returns the restored
+        solver, ready to drive (`solver.run()`); the caller typically
+        lowers `te`-remaining work back into the queue as a pjit bucket.
+        """
+        import jax
+
+        from ..utils import checkpoint as _ckpt
+        from ..utils import dispatch as _dispatch
+
+        devs = list(devices if devices is not None else jax.devices())
+        ndims = 2 if family == "ns2d" else 3
+        comm = None
+        if len(devs) > 1:
+            from ..parallel.comm import CartComm
+
+            extents = ((param.jmax, param.imax) if ndims == 2
+                       else (param.kmax, param.jmax, param.imax))
+            comm = CartComm(ndims=ndims, devices=devs, extents=extents)
+        solver = _build_solver(param, family, comm)
+        with _tm.span(f"fleet.elastic_restore.{family}",
+                      devices=len(devs)):
+            _ckpt.load_elastic(path, solver)
+        _dispatch.record(
+            f"elastic_restore_{family}",
+            f"{len(devs)} device(s), mesh "
+            f"{list(comm.dims) if comm is not None else [1]}")
+        return solver
+
     def _warm_template(self, key, reqs):
         """Fetch/build the bucket template AND, on a COLD build, force
         its chunk compile (jax.jit is lazy — without this the cold XLA

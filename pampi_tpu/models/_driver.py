@@ -61,7 +61,8 @@ def clamped_dt(dt, scale):
 
 def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
                  lookahead: int = 0, replenish_after: int = 8, recover=None,
-                 transient_budget: int = 1):
+                 transient_budget: int = 1, coordinator=None,
+                 ckpt_every: int = 0, on_ckpt=None, family: str = ""):
     """Run `state = chunk_fn(*state)` while state[time_index] <= te
     (main.c:43-60 loop semantics: a step runs whenever t <= te at its start).
 
@@ -102,7 +103,27 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
     buffers stay alive for the retry path. On any failure the pipeline
     resets to the last CONFIRMED state — the retry protocol is unchanged,
     it just may re-dispatch the speculative tail. lookahead=0 is exactly
-    the historical dispatch-then-sync loop."""
+    the historical dispatch-then-sync loop.
+
+    coordinator, when not None, routes the whole loop through the
+    chunk-boundary agreement protocol (parallel/coordinator.py): ranks
+    allgather a small fault word at each boundary and take every
+    retry / rollback / checkpoint decision identically — the seam that
+    lifts the multi-process `transient_budget=0` ban. None (the
+    single-process default) is THIS exact loop, untouched. The
+    coordinated path forces lookahead=0 (every boundary is a
+    rendezvous) and takes the agreed checkpoint cadence from
+    `ckpt_every`/`on_ckpt` instead of an on_state counter."""
+    if coordinator is not None:
+        from ..parallel.coordinator import drive_coordinated
+
+        return drive_coordinated(
+            state, chunk_fn, te, time_index, bar, retry,
+            coordinator, on_state=on_state,
+            replenish_after=replenish_after, recover=recover,
+            transient_budget=transient_budget, ckpt_every=ckpt_every,
+            on_ckpt=on_ckpt, family=family,
+        )
     if lookahead < 0:
         # cli.py validates the .par key; programmatic callers land here (a
         # negative value would popleft an empty deque and surface an
@@ -397,7 +418,11 @@ class RingRecovery:
         from ..utils import checkpoint as ckpt
 
         try:
-            ckpt.load_checkpoint(self.ckpt_path, self.solver)
+            # load_any: the cold tier must read whichever format the
+            # run's tpu_checkpoint writes (legacy .npz OR the elastic
+            # manifest — tpu_ckpt_elastic routes saves, so the sniff
+            # keeps rollback working under both)
+            ckpt.load_any(self.ckpt_path, self.solver)
         except Exception as exc:  # lint: allow(broad-except) — a cold-tier restore failure of ANY class degrades to "no checkpoint", never kills recovery
             warnings.warn(
                 f"{self.family}: cold-tier restore from "
@@ -416,10 +441,26 @@ class RingRecovery:
             return None
         return self.solver.initial_state()
 
-    def attempt(self):
+    def newest_nt(self) -> int:
+        """Step count of the newest ring-captured state, -1 when empty —
+        the rollback generation this rank PROPOSES in the coordinator
+        fault word (parallel/coordinator.py; the merged min is what every
+        rank then rolls to)."""
+        if not self._ring:
+            return -1
+        return int(self._ring[-1][self.time_index + 1])
+
+    def attempt(self, target_nt=None):
         """Returns (rollback_state, rebuilt_chunk_fn), or None to let the
-        loop terminate on the diverged state."""
+        loop terminate on the diverged state. `target_nt`, when given (the
+        coordinator's AGREED generation), first discards ring entries
+        newer than it, so every rank restores the same step count — the
+        rank-symmetric rollback contract."""
         self._attempts += 1
+        if target_nt is not None:
+            while (self._ring
+                   and int(self._ring[-1][self.time_index + 1]) > target_nt):
+                self._ring.pop()
         if self._attempts > self.max_attempts:
             _tm.emit("recover", family=self.family, attempt=self._attempts,
                      gave_up=True, reason="max_attempts")
@@ -459,6 +500,27 @@ class RingRecovery:
             f"{self.max_attempts})", stacklevel=2,
         )
         return state, new_fn
+
+
+def coord_ckpt_cadence(solver, coord, publish):
+    """Checkpoint cadence under the coordinator: the agreed ckpt vote
+    commits the write at the boundary every rank voted on (the cli's
+    on_sync periodic writer stands down when the coordinator is armed —
+    see cli.py; two counters over the same cadence would double-write).
+    Returns (ckpt_every, on_ckpt) — (0, None) when uncoordinated or no
+    checkpoint path is set."""
+    param = solver.param
+    if coord is None or not param.tpu_checkpoint:
+        return 0, None
+    from ..utils import checkpoint as _ckpt
+
+    writer = _ckpt.writer_for(param)
+
+    def on_ckpt(s):
+        publish(s)
+        writer(param.tpu_checkpoint, solver)
+
+    return max(1, param.tpu_ckpt_every), on_ckpt
 
 
 def make_recovery(solver, family: str, time_index: int, recorder=None):

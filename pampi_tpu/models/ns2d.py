@@ -651,11 +651,30 @@ class NS2DSolver:
             state = state + (_tm.metrics_init(),)
         return state
 
+    # -- elastic-checkpoint contract (utils/checkpoint.save_elastic) ---
+    def global_shape(self) -> tuple:
+        return (self.jmax + 2, self.imax + 2)
+
+    def global_fields(self) -> dict:
+        """Reference-layout global fields: single-device fields ARE the
+        global layout (interior + ghost ring)."""
+        return {f: np.asarray(getattr(self, f)) for f in ("u", "v", "p")}
+
+    def set_global_fields(self, fields: dict) -> None:
+        for f, arr in fields.items():
+            cur = getattr(self, f)
+            setattr(self, f, jnp.asarray(arr, cur.dtype))
+
     def run(self, progress: bool = True, on_sync=None) -> None:
         """Advance from t to te. `on_sync(self)` fires at each host sync
         (every CHUNK device steps) — the checkpoint hook point. Loop +
         retry/rollback protocol live in models/_driver.py."""
-        from ._driver import drive_chunks, make_recovery, pallas_retry
+        from ._driver import (
+            coord_ckpt_cadence,
+            drive_chunks,
+            make_recovery,
+            pallas_retry,
+        )
 
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         state = self.initial_state()
@@ -677,8 +696,13 @@ class NS2DSolver:
 
         if recover is not None:
             recover.capture(state)  # first-chunk divergence is recoverable
+        from ..parallel.coordinator import make_coordinator
         from ..utils import xprof as _xprof
 
+        # single-device default is the uncoordinated historical loop;
+        # tpu_coord on forces the 1-rank protocol path (seam identity)
+        coord = make_coordinator(self.param, "ns2d")
+        ckpt_every, on_ckpt = coord_ckpt_cadence(self, coord, publish)
         nt0 = self.nt
         with _xprof.capture("ns2d", steps=lambda: self.nt - nt0):
             state = drive_chunks(
@@ -689,7 +713,8 @@ class NS2DSolver:
                 ),
                 on_state, lookahead=self.param.tpu_lookahead,
                 replenish_after=self.param.tpu_retry_replenish,
-                recover=recover)
+                recover=recover, coordinator=coord,
+                ckpt_every=ckpt_every, on_ckpt=on_ckpt, family="ns2d")
             publish(state)
 
     def write_result(

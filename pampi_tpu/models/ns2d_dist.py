@@ -1034,7 +1034,7 @@ class NS2DDistSolver:
         is armed. No pallas rebuild hook here (the per-shard kernels have
         no per-backend rebuild path), so non-transient chunk failures
         propagate unchanged."""
-        from ._driver import drive_chunks, make_recovery
+        from ._driver import coord_ckpt_cadence, drive_chunks, make_recovery
 
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         state = self.initial_state()
@@ -1058,11 +1058,18 @@ class NS2DDistSolver:
 
         if recover is not None:
             recover.capture(state)  # first-chunk divergence is recoverable
-        # transient retry is SINGLE-CONTROLLER only: under a multi-process
-        # launch a rank-local re-dispatch would desynchronize the chunk's
-        # collectives across ranks (ROADMAP open item) — disable it there
-        # and let the fault kill the job cleanly
-        budget = 0 if jax.process_count() > 1 else 1
+        # multi-process transient retry rides the chunk-boundary agreement
+        # protocol (parallel/coordinator.py): every rank takes the same
+        # retry/rollback/checkpoint decision from the allgathered fault
+        # word, so the PR 4 single-controller ban (transient_budget=0 —
+        # a rank-local re-dispatch would desynchronize collectives) is
+        # lifted whenever the coordinator is armed. tpu_coord off
+        # restores the ban: a fault kills the job cleanly.
+        from ..parallel.coordinator import make_coordinator
+
+        coord = make_coordinator(self.param, "ns2d_dist")
+        budget = 1 if (coord is not None or jax.process_count() == 1) else 0
+        ckpt_every, on_ckpt = coord_ckpt_cadence(self, coord, publish)
         # PAMPI_XPROF: device-trace the drive loop (no-op when unset);
         # the step count rides the xprof record so report tooling can
         # normalize device times per step
@@ -1072,7 +1079,9 @@ class NS2DDistSolver:
                 state, self._chunk_sm, self.param.te, 3, bar,
                 retry=lambda: None, on_state=on_state,
                 replenish_after=self.param.tpu_retry_replenish,
-                recover=recover, transient_budget=budget)
+                recover=recover, transient_budget=budget,
+                coordinator=coord, ckpt_every=ckpt_every,
+                on_ckpt=on_ckpt, family="ns2d_dist")
             publish(state)
         self._emit_exchange_span()
 
@@ -1137,6 +1146,41 @@ class NS2DDistSolver:
 
     def fields(self):
         return self._assemble(self.u), self._assemble(self.v), self._assemble(self.p)
+
+    # -- elastic-checkpoint contract (utils/checkpoint.save_elastic) ---
+    def global_shape(self) -> tuple:
+        return (self.jmax + 2, self.imax + 2)
+
+    def global_fields(self) -> dict:
+        """MESH-INDEPENDENT reference-layout globals: same assembly as
+        `_assemble` (interiors everywhere, ghost ring from wall shards)
+        through the shared dtype-preserving N-D helper — what makes an
+        elastic checkpoint restorable on a DIFFERENT mesh. Collective
+        under a multi-process launch (CartComm.collect)."""
+        from ..utils.checkpoint import assemble_global
+
+        return {
+            f: assemble_global(
+                self.comm.collect(getattr(self, f)), self.comm.dims,
+                (self.jl, self.il), (self.jmax, self.imax))
+            for f in ("u", "v", "p")
+        }
+
+    def set_global_fields(self, fields: dict) -> None:
+        """The elastic-restore resharding step: re-block the global
+        array for THIS solver's mesh and place it on the solver's own
+        NamedSharding — the saved mesh never constrains the target."""
+        from ..utils.checkpoint import scatter_blocks
+
+        for f, arr in fields.items():
+            cur = getattr(self, f)
+            stacked = scatter_blocks(
+                np.asarray(arr), self.comm.dims, (self.jl, self.il))
+            new = jnp.asarray(stacked, cur.dtype)
+            sh = getattr(cur, "sharding", None)
+            if sh is not None:
+                new = jax.device_put(new, sh)
+            setattr(self, f, new)
 
     def write_result(
         self, pressure_path: str = "pressure.dat", velocity_path: str = "velocity.dat"

@@ -602,9 +602,29 @@ class NS3DSolver:
             state = state + (_tm.metrics_init(),)
         return state
 
+    # -- elastic-checkpoint contract (utils/checkpoint.save_elastic) ---
+    def global_shape(self) -> tuple:
+        g = self.grid
+        return (g.kmax + 2, g.jmax + 2, g.imax + 2)
+
+    def global_fields(self) -> dict:
+        """Reference-layout global fields (see models/ns2d.global_fields)."""
+        return {f: np.asarray(getattr(self, f))
+                for f in ("u", "v", "w", "p")}
+
+    def set_global_fields(self, fields: dict) -> None:
+        for f, arr in fields.items():
+            cur = getattr(self, f)
+            setattr(self, f, jnp.asarray(arr, cur.dtype))
+
     def run(self, progress: bool = True, on_sync=None) -> None:
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
-        from ._driver import drive_chunks, make_recovery, pallas_retry
+        from ._driver import (
+            coord_ckpt_cadence,
+            drive_chunks,
+            make_recovery,
+            pallas_retry,
+        )
 
         state = self.initial_state()
         rec = _tm.ChunkRecorder("ns3d", self.nt) if self._metrics else None
@@ -625,8 +645,13 @@ class NS3DSolver:
 
         if recover is not None:
             recover.capture(state)  # first-chunk divergence is recoverable
+        from ..parallel.coordinator import make_coordinator
         from ..utils import xprof as _xprof
 
+        # uncoordinated by default; tpu_coord on = the 1-rank protocol
+        # path (see models/ns2d.run)
+        coord = make_coordinator(self.param, "ns3d")
+        ckpt_every, on_ckpt = coord_ckpt_cadence(self, coord, publish)
         nt0 = self.nt
         with _xprof.capture("ns3d", steps=lambda: self.nt - nt0):
             state = drive_chunks(
@@ -637,7 +662,8 @@ class NS3DSolver:
                 ),
                 on_state, lookahead=self.param.tpu_lookahead,
                 replenish_after=self.param.tpu_retry_replenish,
-                recover=recover)
+                recover=recover, coordinator=coord,
+                ckpt_every=ckpt_every, on_ckpt=on_ckpt, family="ns3d")
             publish(state)
 
     def collect(self):

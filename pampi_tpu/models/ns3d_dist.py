@@ -949,7 +949,7 @@ class NS3DDistSolver:
     def run(self, progress: bool = True, on_sync=None) -> None:
         """The shared drive loop (models/_driver.drive_chunks) — see
         models/ns2d_dist.run for the migration contract."""
-        from ._driver import drive_chunks, make_recovery
+        from ._driver import coord_ckpt_cadence, drive_chunks, make_recovery
 
         bar = Progress(self.param.te, enabled=progress and not _flags.verbose())
         state = self.initial_state()
@@ -973,15 +973,22 @@ class NS3DDistSolver:
 
         if recover is not None:
             recover.capture(state)  # first-chunk divergence is recoverable
-        # transient retry is single-controller only (see ns2d_dist.run)
-        budget = 0 if jax.process_count() > 1 else 1
+        # multi-process transient retry rides the agreement protocol —
+        # see models/ns2d_dist.run for the lifted single-controller ban
+        from ..parallel.coordinator import make_coordinator
+
+        coord = make_coordinator(self.param, "ns3d_dist")
+        budget = 1 if (coord is not None or jax.process_count() == 1) else 0
+        ckpt_every, on_ckpt = coord_ckpt_cadence(self, coord, publish)
         nt0 = self.nt
         with _xprof.capture("ns3d_dist", steps=lambda: self.nt - nt0):
             state = drive_chunks(
                 state, self._chunk_sm, self.param.te, 4, bar,
                 retry=lambda: None, on_state=on_state,
                 replenish_after=self.param.tpu_retry_replenish,
-                recover=recover, transient_budget=budget)
+                recover=recover, transient_budget=budget,
+                coordinator=coord, ckpt_every=ckpt_every,
+                on_ckpt=on_ckpt, family="ns3d_dist")
             publish(state)
         self._emit_exchange_span()
 
@@ -1011,6 +1018,38 @@ class NS3DDistSolver:
         g = self.grid
         # ragged decompositions carry trailing dead cells — strip them
         return tuple(a[: g.kmax, : g.jmax, : g.imax] for a in out)
+
+    # -- elastic-checkpoint contract (utils/checkpoint.save_elastic) ---
+    def global_shape(self) -> tuple:
+        g = self.grid
+        return (g.kmax + 2, g.jmax + 2, g.imax + 2)
+
+    def global_fields(self) -> dict:
+        """Mesh-independent reference-layout globals — see
+        models/ns2d_dist.global_fields (same helper, 3-D mesh)."""
+        from ..utils.checkpoint import assemble_global
+
+        g = self.grid
+        return {
+            f: assemble_global(
+                self.comm.collect(getattr(self, f)), self.comm.dims,
+                (self.kl, self.jl, self.il), (g.kmax, g.jmax, g.imax))
+            for f in ("u", "v", "w", "p")
+        }
+
+    def set_global_fields(self, fields: dict) -> None:
+        from ..utils.checkpoint import scatter_blocks
+
+        for f, arr in fields.items():
+            cur = getattr(self, f)
+            stacked = scatter_blocks(
+                np.asarray(arr), self.comm.dims,
+                (self.kl, self.jl, self.il))
+            new = jnp.asarray(stacked, cur.dtype)
+            sh = getattr(cur, "sharding", None)
+            if sh is not None:
+                new = jax.device_put(new, sh)
+            setattr(self, f, new)
 
     def write_result(self, path=None, fmt: str = "ascii") -> None:
         # collect() is collective; only rank 0 writes the serial VTK file

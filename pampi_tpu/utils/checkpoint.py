@@ -22,15 +22,39 @@ never fall back — they raise the clear ValueError they always did. The
 drive loop's divergence rollback uses the newest on-disk generation as the
 COLD tier under its in-memory state ring (models/_driver.RingRecovery).
 
+Elastic checkpoints (PR 10): `save_elastic`/`load_elastic` replace the
+single mesh-locked .npz with a JSON MANIFEST + per-rank shard files
+holding the MESH-INDEPENDENT global reference-layout fields (assembled
+exactly like `write_result`'s collection — interiors everywhere, ghost
+ring from the wall shards). Restore accepts a DIFFERENT mesh: the global
+array is reassembled from the shards and resharded onto the target
+solver's NamedSharding (8->4->1 chip shrink, dist<->single, mesh-shape
+transposes — the fleet autoscaling primitive,
+fleet/scheduler.FleetScheduler.elastic_restore). Durability carries
+over: every file lands via tmp+atomic-rename, the manifest rotates to
+`.prev` (shard files embed their generation in the NAME, so the two
+generations never share files), per-field CRCs guard every shard AND the
+assembled global, and a torn/corrupt/missing piece falls back to the
+`.prev` generation set. A shard whose embedded generation differs from
+its manifest's is a MIXED-GENERATION set (the crash window between a
+shard write and the manifest commit, or a mangled restore-from-backup)
+and is refused — never silently combined. `tools/ckpt_fsck.py` verifies
+a checkpoint offline.
+
 .par keys (framework-only):
   tpu_checkpoint        path to write (every tpu_ckpt_every syncs +
                         once at the end); empty = off
-  tpu_ckpt_every  host syncs between writes (default 10)
-  tpu_restart           path to resume from before the run
+  tpu_ckpt_every        host syncs between writes (default 10)
+  tpu_ckpt_elastic      1 = elastic manifest format (default 0: legacy
+                        single-.npz, mesh-locked but ghost-exact)
+  tpu_restart           path to resume from before the run (either
+                        format — load_any sniffs)
 """
 
 from __future__ import annotations
 
+import glob
+import json
 import math
 import os
 import warnings
@@ -252,15 +276,369 @@ def load_checkpoint(path: str, solver, fallback: bool = True) -> None:
              t=float(solver.t), nt=int(solver.nt))
 
 
-def periodic_writer(path: str, every: int = 10):
+def periodic_writer(path: str, every: int = 10, save=None):
     """on_sync callback: writes `path` every `every` host syncs (values < 1
-    mean every sync)."""
+    mean every sync). `save` is the format callable — pass
+    `writer_for(param)` (the ONE format switch); default is the legacy
+    `save_checkpoint`. Used by the SINGLE-CONTROLLER path only — under an
+    armed coordinator the drive loop owns the cadence through the agreed
+    checkpoint vote (models/_driver.coord_ckpt_cadence), so cli.py wires
+    exactly one of the two."""
     every = max(1, every)
     count = {"n": 0}
+    save = save or save_checkpoint
 
     def on_sync(solver) -> None:
         count["n"] += 1
         if count["n"] % every == 0:
-            save_checkpoint(path, solver)
+            save(path, solver)
 
     return on_sync
+
+
+# ---------------------------------------------------------------------------
+# Elastic checkpoints: manifest + per-rank shards, restore on ANY mesh
+# ---------------------------------------------------------------------------
+
+ELASTIC_VERSION = 1
+ELASTIC_FORMAT = "pampi-elastic-ckpt"
+
+
+def writer_for(param):
+    """The save callable a run's .par selects: `save_elastic` under
+    tpu_ckpt_elastic, else the legacy single-.npz `save_checkpoint` —
+    the one switch the cli, the coordinated drive loop and the fleet
+    scheduler all consult."""
+    return save_elastic if getattr(param, "tpu_ckpt_elastic", 0) \
+        else save_checkpoint
+
+
+def assemble_global(stacked, dims, locs, interior) -> np.ndarray:
+    """Stacked extended blocks -> the reference-layout global array
+    (interior+ghost ring): block interiors everywhere, ghost strips only
+    from wall shards — the N-D generalization of
+    models/ns2d_dist._assemble, dtype-preserving (the CRCs hash the
+    bytes as stored). `dims` is the mesh, `locs` the per-shard OWNED
+    extents, `interior` the global interior extents; ragged trailing
+    dead cells are cropped."""
+    stacked = np.asarray(stacked)
+    full = np.zeros([p * l + 2 for p, l in zip(dims, locs)], stacked.dtype)
+    for c in np.ndindex(*dims):
+        src, dst = [], []
+        for a, (ca, pa, la) in enumerate(zip(c, dims, locs)):
+            lo = 0 if ca == 0 else 1
+            hi = la + 2 if ca == pa - 1 else la + 1
+            src.append(slice(ca * (la + 2) + lo, ca * (la + 2) + hi))
+            dst.append(slice(ca * la + lo, ca * la + hi))
+        full[tuple(dst)] = stacked[tuple(src)]
+    return full[tuple(slice(0, g + 2) for g in interior)]
+
+
+def scatter_blocks(full, dims, locs) -> np.ndarray:
+    """The inverse: a reference-layout global array -> stacked extended
+    blocks for an ARBITRARY mesh (the elastic-restore resharding input).
+    Interior-edge ghosts are filled from the neighbour interiors — the
+    state a fresh halo exchange would produce, which every step refreshes
+    before reading; physical-wall ghosts come through bit-exact. Ragged
+    pad cells (past the global interior) zero-fill — they are excluded
+    from updates, residuals and collection by the live masks."""
+    full = np.asarray(full)
+    pad_shape = [p * l + 2 for p, l in zip(dims, locs)]
+    pad = np.zeros(pad_shape, full.dtype)
+    pad[tuple(slice(0, s) for s in full.shape)] = full
+    stacked = np.zeros([p * (l + 2) for p, l in zip(dims, locs)], full.dtype)
+    for c in np.ndindex(*dims):
+        dst = tuple(slice(ca * (la + 2), (ca + 1) * (la + 2))
+                    for ca, la in zip(c, locs))
+        src = tuple(slice(ca * la, ca * la + la + 2)
+                    for ca, la in zip(c, locs))
+        stacked[dst] = pad[src]
+    return stacked
+
+
+def _shard_path(path: str, gen: int, rank: int) -> str:
+    """Shard files embed their GENERATION in the name, so the live and
+    .prev manifests never share files — the rotation that makes the
+    two-generation protocol crash-window-safe without cross-file
+    renames (the manifest rename is the one commit point)."""
+    return f"{path}.g{gen}.r{rank}.npz"
+
+
+def _shard_bounds(rows: int, nshards: int) -> list:
+    """Deterministic per-rank row slabs of the global array's axis 0
+    (np.array_split semantics: sizes differ by at most one)."""
+    splits = np.array_split(np.arange(rows), nshards)
+    return [(int(s[0]), int(s[-1]) + 1) for s in splits if len(s)]
+
+
+def _read_manifest(path: str) -> dict:
+    """Parse + shape-check a manifest; unparseable/truncated JSON is
+    CORRUPTION (falls back), a missing file stays FileNotFoundError."""
+    with open(path) as fh:
+        try:
+            man = json.load(fh)
+        except ValueError as exc:
+            raise CheckpointCorruptError(
+                f"elastic manifest {path}: unparseable JSON ({exc})"
+            ) from exc
+    if not isinstance(man, dict) or man.get("format") != ELASTIC_FORMAT:
+        raise CheckpointCorruptError(
+            f"elastic manifest {path}: not a {ELASTIC_FORMAT} manifest"
+        )
+    missing = [k for k in ("version", "generation", "t", "nt", "mesh",
+                           "global_shape", "dtype", "fields", "shards",
+                           "crc") if k not in man]
+    if missing:
+        raise CheckpointCorruptError(
+            f"elastic manifest {path}: missing keys {missing}"
+        )
+    return man
+
+
+def _manifest_generation(path: str) -> int:
+    """Best-effort generation of an existing manifest chain (primary,
+    else .prev), 0 when none parses — save_elastic numbers the next
+    write from it. Tolerant BY DESIGN: a torn primary must not block
+    the save that replaces it."""
+    for p in (path, f"{path}.prev"):
+        try:
+            return int(_read_manifest(p)["generation"])
+        except (FileNotFoundError, CheckpointCorruptError):
+            continue
+    return 0
+
+
+def save_elastic(path: str, solver) -> None:
+    """Write the elastic checkpoint set: every rank writes its row slab
+    of the MESH-INDEPENDENT assembled global fields to its own shard
+    file (generation-named), rank 0 commits the manifest last. Refuses
+    non-finite states like save_checkpoint; shard writes take the same
+    torn/corrupt fault injection (`ckpt_torn@write<N>` /
+    `ckpt_corrupt@write<N>`)."""
+    import jax
+
+    from ..parallel import multihost
+
+    fields = solver.global_fields()  # collective under multi-process
+    t, nt = float(solver.t), int(solver.nt)
+    if not math.isfinite(t) or not all(
+        np.isfinite(a).all() for a in fields.values()
+    ):
+        warnings.warn(
+            f"refusing to checkpoint a non-finite solver state to {path} "
+            "(the existing generations are left untouched)",
+            stacklevel=2,
+        )
+        _tm.emit("ckpt", event="skip", path=path, reason="non-finite state")
+        return
+    gen = _manifest_generation(path) + 1
+    nshards = jax.process_count()
+    rank = jax.process_index()
+    names = list(fields)
+    gshape = fields[names[0]].shape
+    bounds = _shard_bounds(gshape[0], nshards)
+    injected = _fi.ckpt_write_faults()
+    # my shard: the rows this process owns (tmp + atomic rename)
+    lo, hi = bounds[rank] if rank < len(bounds) else (0, 0)
+    spath = _shard_path(path, gen, rank)
+    data = {f: np.ascontiguousarray(a[lo:hi]) for f, a in fields.items()}
+    for f in names:
+        data[f"crc_{f}"] = np.uint32(_crc(data[f]))
+    data.update(generation=np.int64(gen), rank=np.int64(rank),
+                rows=np.asarray([lo, hi], np.int64))
+    tmp = f"{spath}.tmp"
+    with open(tmp, "wb") as fh:
+        if "torn" in injected:
+            _fi.torn_write(fh)  # forged crash: torn .tmp, manifest intact
+        np.savez(fh, **data)
+    os.replace(tmp, spath)
+    if "corrupt" in injected:
+        _fi.corrupt_file(spath)
+    if not multihost.is_master():
+        return
+    manifest = {
+        "format": ELASTIC_FORMAT,
+        "version": ELASTIC_VERSION,
+        "ckpt_version": CKPT_VERSION,
+        "generation": gen,
+        "t": t,
+        "nt": nt,
+        "mesh": list(_mesh_dims(solver)),
+        "global_shape": [int(s) for s in gshape],
+        "dtype": str(fields[names[0]].dtype),
+        "fields": names,
+        "nshards": nshards,
+        "shards": [
+            {"file": os.path.basename(_shard_path(path, gen, r)),
+             "rank": r, "rows": [b[0], b[1]]}
+            for r, b in enumerate(bounds)
+        ],
+        "crc": {f: int(_crc(a)) for f, a in fields.items()},
+    }
+    rotated = os.path.exists(path)
+    if rotated:
+        try:
+            _read_manifest(path)
+        except CheckpointCorruptError:
+            # same policy as the legacy torn-primary path: never rotate
+            # an evidently-bad manifest over the good .prev generation
+            os.replace(path, f"{path}.bad")
+            rotated = False
+            _tm.emit("ckpt", event="reject", path=path,
+                     error="torn manifest; not rotated over .prev")
+            warnings.warn(
+                f"existing manifest {path} is torn; keeping the .prev "
+                f"generation and parking the bad file at {path}.bad",
+                stacklevel=2,
+            )
+        else:
+            os.replace(path, f"{path}.prev")
+            _tm.emit("ckpt", event="rotate", path=path)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+    os.replace(tmp, path)  # the commit point
+    # retire shard files two generations back (the .prev manifest keeps
+    # generation gen-1 alive; anything older is unreachable)
+    for old in glob.glob(f"{glob.escape(path)}.g*.r*.npz"):
+        try:
+            old_gen = int(os.path.basename(old).rsplit(".g", 1)[1]
+                          .split(".r", 1)[0])
+        except (IndexError, ValueError):
+            continue
+        if old_gen <= gen - 2:
+            try:
+                os.remove(old)
+            except OSError:
+                pass  # a straggler shard is garbage, not a failure
+    _tm.emit("ckpt", event="elastic_save", path=path, generation=gen,
+             mesh=manifest["mesh"], t=t, nt=nt, rotated=rotated)
+
+
+def _load_elastic_set(path: str, solver) -> int:
+    """Load ONE manifest's generation set into the solver; returns the
+    generation. Raises the corruption classes for anything torn, CRC-
+    mismatched, missing or MIXED-GENERATION; config-class mismatches
+    (wrong global shape, unknown schema) stay plain ValueError."""
+    man = _read_manifest(path)
+    if int(man["version"]) > ELASTIC_VERSION:
+        raise ValueError(
+            f"elastic manifest {path} has version {man['version']}; this "
+            f"build reads <= {ELASTIC_VERSION} (written by a newer "
+            "pampi_tpu)"
+        )
+    gshape = tuple(int(s) for s in man["global_shape"])
+    expect = tuple(solver.global_shape())
+    if gshape != expect:
+        raise ValueError(
+            f"elastic checkpoint global shape {gshape} != solver global "
+            f"shape {expect}"
+        )
+    gen = int(man["generation"])
+    dtype = np.dtype(man["dtype"])
+    out = {f: np.zeros(gshape, dtype) for f in man["fields"]}
+    base = os.path.dirname(path)
+    for sh in man["shards"]:
+        spath = os.path.join(base, sh["file"]) if base else sh["file"]
+        try:
+            z = np.load(spath)
+        except FileNotFoundError as exc:
+            # a MANIFEST plainly missing is a config error (stays
+            # FileNotFoundError, no fallback) — but a shard missing
+            # under a present manifest is a mutilated set: corruption
+            raise CheckpointCorruptError(
+                f"elastic shard {spath} is missing (manifest {path} "
+                "names it)"
+            ) from exc
+        except (ValueError, EOFError) as exc:
+            raise CheckpointCorruptError(
+                f"elastic shard {spath}: unreadable container ({exc})"
+            ) from exc
+        with z:
+            if int(z["generation"]) != gen:
+                raise CheckpointCorruptError(
+                    f"elastic shard {spath} is generation "
+                    f"{int(z['generation'])} but manifest {path} is "
+                    f"generation {gen} — mixed-generation set refused"
+                )
+            lo, hi = (int(x) for x in sh["rows"])
+            for f in man["fields"]:
+                slab = z[f]
+                if _crc(slab) != int(z[f"crc_{f}"]):
+                    raise CheckpointCorruptError(
+                        f"elastic shard {spath}: field {f!r} fails its "
+                        "CRC32 (torn or corrupt write)"
+                    )
+                out[f][lo:hi] = slab
+    for f, arr in out.items():
+        if _crc(arr) != int(man["crc"][f]):
+            raise CheckpointCorruptError(
+                f"elastic checkpoint {path}: assembled field {f!r} fails "
+                "the manifest CRC32"
+            )
+    solver.set_global_fields(out)
+    solver.t = float(man["t"])
+    solver.nt = int(man["nt"])
+    return gen
+
+
+def load_elastic(path: str, solver, fallback: bool = True) -> None:
+    """Restore `solver` from an elastic manifest — on WHATEVER mesh the
+    solver was built with (the saved mesh is metadata, not a contract:
+    set_global_fields reshards the assembled global array via the
+    solver's own NamedSharding). Torn/corrupt/missing/mixed-generation
+    pieces fall back to the `.prev` generation set, same semantics as
+    `load_checkpoint`."""
+    from ..parallel import multihost as _mh  # noqa: F401  (doc parity)
+
+    try:
+        gen = _load_elastic_set(path, solver)
+    except _corrupt_classes() as exc:
+        _tm.emit("ckpt", event="reject", path=path, error=str(exc))
+        prev = f"{path}.prev"
+        if not fallback or not os.path.exists(prev):
+            if isinstance(exc, FileNotFoundError):
+                raise
+            raise CheckpointCorruptError(
+                f"elastic checkpoint {path} is torn or corrupt ({exc}) "
+                f"and no previous generation exists at {prev}"
+            ) from exc
+        warnings.warn(
+            f"elastic checkpoint {path} is torn or corrupt ({exc}); "
+            f"falling back to the previous generation {prev}",
+            stacklevel=2,
+        )
+        try:
+            gen = _load_elastic_set(prev, solver)
+        except _corrupt_classes() as exc2:
+            raise CheckpointCorruptError(
+                f"elastic checkpoint {path} is torn or corrupt ({exc}) "
+                f"and so is the previous generation {prev} ({exc2})"
+            ) from exc2
+        _tm.emit("ckpt", event="elastic_load", path=prev,
+                 generation=gen, fell_back=True,
+                 t=float(solver.t), nt=int(solver.nt))
+        return
+    _tm.emit("ckpt", event="elastic_load", path=path, generation=gen,
+             mesh_now=list(_mesh_dims(solver)),
+             t=float(solver.t), nt=int(solver.nt))
+
+
+def is_elastic(path: str) -> bool:
+    """Sniff the on-disk format: an elastic manifest is JSON (first
+    byte '{'), the legacy checkpoint a zip (.npz). Missing files sniff
+    legacy so the caller's FileNotFoundError names the path."""
+    try:
+        with open(path, "rb") as fh:
+            return fh.read(1) == b"{"
+    except OSError:
+        return False
+
+
+def load_any(path: str, solver, fallback: bool = True) -> None:
+    """Restore from either checkpoint format — the restart entry point
+    (cli.py `tpu_restart` takes a path of either kind)."""
+    if is_elastic(path):
+        load_elastic(path, solver, fallback=fallback)
+    else:
+        load_checkpoint(path, solver, fallback=fallback)

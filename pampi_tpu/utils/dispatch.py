@@ -184,3 +184,35 @@ def resolve_fleet(param, n_scenarios: int, dist: bool, key: str) -> str:
         return "pjit"
     record(key, f"vmap (same-trace bucket of {n_scenarios})")
     return "vmap"
+
+
+def resolve_coord(param, key: str) -> str:
+    """`tpu_coord` -> whether this run's drive loop rides the chunk-
+    boundary agreement protocol (parallel/coordinator.py). Returns
+    "multihost" (real cross-process allgather transport), "solo" (the
+    1-rank coordinator — protocol path exercised without a launch) or
+    "none" (the exact historical uncoordinated loop). Decision recorded
+    under `key` ("coord_<family>") like every other knob.
+
+    `auto` policy: coordinate exactly when there is more than one OS
+    process — that is when a rank-local retry would desynchronize
+    collectives (the PR 4 ban this protocol lifts). `off` restores the
+    ban: multi-process runs get transient_budget=0 and any fault kills
+    the job cleanly."""
+    import jax
+
+    knob = param.tpu_coord
+    if knob not in ("auto", "on", "off"):
+        raise ValueError(f"tpu_coord must be auto|on|off, got {knob!r}")
+    if knob == "off":
+        record(key, "uncoordinated (tpu_coord off)")
+        return "none"
+    nprocs = jax.process_count()
+    if nprocs > 1:
+        record(key, f"coordinated ({nprocs} processes)")
+        return "multihost"
+    if knob == "on":
+        record(key, "coordinated (forced, 1 process)")
+        return "solo"
+    record(key, "uncoordinated (single process)")
+    return "none"

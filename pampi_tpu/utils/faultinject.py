@@ -9,7 +9,8 @@ no-op, traced programs are byte-identical to the uninjected build, and the
 drive loop takes the exact historical path (test-asserted in
 tests/test_faultinject.py, the same contract as `PAMPI_TELEMETRY`).
 
-Spec grammar — comma-separated clauses, each `kind@site<N>[:field][*count]`:
+Spec grammar — comma-separated clauses, each
+`kind@site<N>[:field][@rank<R>][*count]`:
 
   pallas@chunk<N>         forged pallas runtime failure on the Nth chunk
                           dispatch (exercises the pallas->jnp rebuild)
@@ -21,6 +22,22 @@ Spec grammar — comma-separated clauses, each `kind@site<N>[:field][*count]`:
                           u|v|w|p at step N (exercises the PR 3 in-band
                           divergence sentinel end-to-end)
   inf@step<N>:<field>     same, +inf
+
+Chunk and step clauses take an optional `@rank<R>` suffix (PR 10): the
+clause fires only on rank R — `jax.process_index()` under a real
+multi-process launch, or the ambient virtual rank inside a
+`rank_scope(R)` block (the coordinator lockstep simulation,
+parallel/coordinator.py). `transient@chunk2@rank1` forges the fault on
+rank 1's second dispatch only; the other ranks learn of it through the
+coordinator's agreed fault word, which is the protocol under test. A
+rank-suffixed clause on a non-matching rank neither fires nor consumes
+its charge (the take_lane_faults convention). Rank-targeted FIELD
+faults (`nan@step<N>:<field>@rank<R>`) are for the per-rank solver
+builds of the SIMULATION path and single-controller runs: under a real
+multi-process launch every process must trace the same SPMD program, so
+baking a corruption into one rank's trace would itself desynchronize
+the job — use the host-side chunk clauses there. The fault sites the
+protocol never coordinates (lane/write/emit) refuse the suffix loudly.
   nan@lane<K>:<field>     host-side NaN corruption of scenario lane K's
                           field in a FLEET batch's initial state
                           (pampi_tpu/fleet/batch.py; 0-based lane index;
@@ -66,8 +83,14 @@ _KIND_SITE = {
 
 _CLAUSE_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@(?P<site>[a-z]+)(?P<n>\d+)"
-    r"(?::(?P<field>[a-z]))?(?:\*(?P<count>\d+))?$"
+    r"(?::(?P<field>[a-z]))?(?:@rank(?P<rank>\d+))?(?:\*(?P<count>\d+))?$"
 )
+
+# the sites a rank-targeted clause makes sense at: host-side chunk
+# dispatches (each process/virtual rank counts its own) and per-rank
+# solver-build field corruption. Writes/emits/lanes are rank-0-only or
+# batch-level concerns — a rank suffix there is a broken spec.
+_RANKABLE_SITES = ("chunk", "step")
 
 
 class FaultSpecError(ValueError):
@@ -94,10 +117,49 @@ class CheckpointWriteCrash(RuntimeError):
     rename — the crash window the rename protocol must survive."""
 
 
-# per-process mutable state: trigger counters, per-clause build charges
-_counters: dict[str, int] = {}
+# per-process mutable state: trigger counters (keyed per ambient rank so
+# the lockstep simulation's virtual ranks count their own dispatches),
+# per-clause build charges
+_counters: dict[tuple, int] = {}
 _charges: dict[int, int] = {}
 _cache: tuple[str, tuple] | None = None
+_rank_override: int | None = None  # ambient virtual rank (rank_scope)
+
+
+def current_rank() -> int:
+    """The rank a `@rank<R>` clause is matched against: the ambient
+    virtual rank inside a `rank_scope` block (the coordinator lockstep
+    simulation), else this OS process's `jax.process_index()`."""
+    if _rank_override is not None:
+        return _rank_override
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # lint: allow(broad-except) — any probe failure (jax not initialised, no runtime) means single-process rank 0
+        return 0
+
+
+class rank_scope:
+    """Context manager pinning the ambient rank for rank-targeted clause
+    matching — the lockstep simulation wraps each virtual rank's solver
+    build and chunk dispatches in one. Reentrant (the previous rank is
+    restored on exit); real multi-process runs never need it."""
+
+    def __init__(self, rank: int):
+        self.rank = int(rank)
+        self._prev: int | None = None
+
+    def __enter__(self):
+        global _rank_override
+        self._prev = _rank_override
+        _rank_override = self.rank
+        return self
+
+    def __exit__(self, *exc):
+        global _rank_override
+        _rank_override = self._prev
+        return False
 
 
 def enabled() -> bool:
@@ -117,7 +179,8 @@ def reset() -> None:
 
 
 def _clauses() -> tuple:
-    """Parse (and cache) the spec: tuples of (kind, site, n, field, count)."""
+    """Parse (and cache) the spec: tuples of
+    (kind, site, n, field, count, rank) with rank None = every rank."""
     from . import flags as _flags
 
     global _cache
@@ -137,7 +200,8 @@ def _clauses() -> tuple:
                 "| inf@step<N>:<field> | nan@lane<K>:<field> | "
                 "inf@lane<K>:<field> | ckpt_torn@write<N> | "
                 "ckpt_corrupt@write<N> | telemetry@emit<N>  (comma-separated;"
-                " field faults take an optional *<count> re-arm suffix)"
+                " chunk/step clauses take an optional @rank<R> target, "
+                "field faults an optional *<count> re-arm suffix)"
             )
         field = m["field"]
         if m["kind"] in ("nan", "inf"):
@@ -150,16 +214,31 @@ def _clauses() -> tuple:
             raise FaultSpecError(
                 f"PAMPI_FAULTS clause {raw!r}: only nan/inf take a :<field>"
             )
+        rank = m["rank"]
+        if rank is not None and m["site"] not in _RANKABLE_SITES:
+            raise FaultSpecError(
+                f"PAMPI_FAULTS clause {raw!r}: @rank<R> targets chunk/step "
+                "sites only (lane/write/emit faults are not per-rank)"
+            )
         out.append((m["kind"], m["site"], int(m["n"]), field,
-                    int(m["count"] or 1)))
+                    int(m["count"] or 1),
+                    None if rank is None else int(rank)))
     _cache = (spec, tuple(out))
     return _cache[1]
 
 
 def _bump(site: str) -> int:
-    n = _counters.get(site, 0) + 1
-    _counters[site] = n
+    key = (site, _rank_override)
+    n = _counters.get(key, 0) + 1
+    _counters[key] = n
     return n
+
+
+def _rank_hit(rank) -> bool:
+    """Does a clause's rank target (None = all) match the ambient rank?
+    current_rank() is only consulted for targeted clauses — untargeted
+    specs never touch jax."""
+    return rank is None or rank == current_rank()
 
 
 # ---------------------------------------------------------------------------
@@ -172,8 +251,8 @@ def maybe_chunk_fault() -> None:
     if not enabled():
         return
     n = _bump("chunk")
-    for kind, site, when, _f, _c in _clauses():
-        if site != "chunk" or when != n:
+    for kind, site, when, _f, _c, rank in _clauses():
+        if site != "chunk" or when != n or not _rank_hit(rank):
             continue
         if kind == "pallas":
             raise InjectedPallasError(
@@ -194,7 +273,7 @@ def ckpt_write_faults() -> frozenset:
         return frozenset()
     n = _bump("write")
     hit = set()
-    for kind, site, when, _f, _c in _clauses():
+    for kind, site, when, _f, _c, _r in _clauses():
         if site == "write" and when == n:
             hit.add(kind.replace("ckpt_", ""))
     return frozenset(hit)
@@ -227,7 +306,7 @@ def maybe_telemetry_fail() -> None:
     if not enabled():
         return
     n = _bump("emit")
-    for kind, site, when, _f, _c in _clauses():
+    for kind, site, when, _f, _c, _r in _clauses():
         if kind == "telemetry" and site == "emit" and when == n:
             raise OSError(
                 f"PAMPI_FAULTS: injected telemetry write failure at record {n}"
@@ -250,9 +329,11 @@ def take_field_faults() -> tuple:
     if not enabled():
         return ()
     out = []
-    for idx, (kind, site, step, field, count) in enumerate(_clauses()):
+    for idx, (kind, site, step, field, count, rank) in enumerate(_clauses()):
         if kind not in ("nan", "inf") or site != "step":
             continue
+        if not _rank_hit(rank):
+            continue  # aimed at another rank: leave the charge armed
         used = _charges.get(idx, 0)
         if used >= count:
             continue
@@ -277,7 +358,7 @@ def take_lane_faults(n_lanes=None, fields=None) -> tuple:
     if not enabled():
         return ()
     out = []
-    for idx, (kind, site, lane, field, count) in enumerate(_clauses()):
+    for idx, (kind, site, lane, field, count, _r) in enumerate(_clauses()):
         if kind not in ("nan", "inf") or site != "lane":
             continue
         if n_lanes is not None and lane >= n_lanes:

@@ -217,6 +217,23 @@ class Parameter:
     tpu_checkpoint: str = ""
     tpu_ckpt_every: int = 10
     tpu_restart: str = ""
+    # elastic checkpoint format (utils/checkpoint.save_elastic): a JSON
+    # manifest + per-rank shard files holding the MESH-INDEPENDENT global
+    # reference-layout fields, so restore accepts a DIFFERENT mesh (or a
+    # single device) by reassembling and resharding via NamedSharding —
+    # the 8->4->1 chip shrink and the fleet autoscaling primitive
+    # (fleet/scheduler.FleetScheduler.elastic_restore). 0 (default) keeps
+    # the legacy single-.npz stacked-block format, which is
+    # mesh-locked but preserves ghost state bit-exactly.
+    tpu_ckpt_elastic: int = 0
+    # chunk-boundary agreement protocol (parallel/coordinator.py):
+    # auto = coordinate exactly under a multi-process launch (lifting
+    # the PR 4 transient_budget=0 ban — the global budget, rollback and
+    # checkpoint decisions are agreed via a host-side allgather at each
+    # boundary), on = force the 1-rank coordinator single-process (the
+    # protocol-path proof shape), off = the historical uncoordinated
+    # loop (multi-process faults kill the job cleanly).
+    tpu_coord: str = "auto"
     # divergence rollback-recovery (models/_driver.RingRecovery; README
     # "Robustness"): tpu_recover_ring > 0 arms an in-memory ring of the
     # last-K confirmed finite chunk states (no disk round-trip on the hot

@@ -30,7 +30,19 @@ Host plane — every record is one JSON line appended to the
   retry       a retry-budget consumption (transient device fault retried,
               pallas->jnp fallback, pallas restore after clean chunks)
   ckpt        a checkpoint event (utils/checkpoint.py): save / rotate /
-              load / reject, with path and t/nt where meaningful
+              load / reject / skip, plus the elastic-manifest events
+              elastic_save / elastic_load (generation, writing mesh,
+              fell_back), with path and t/nt where meaningful
+  coord       one GLOBAL decision of the chunk-boundary agreement
+              protocol (parallel/coordinator.py): armed / retry /
+              fallback / rollback / ckpt / giveup / abort, with the
+              boundary index and the decision's operand (budget_left,
+              target_nt, ...). Emitted once per decision from rank 0 —
+              the merged fault word is identical everywhere by
+              construction, so one line IS the fleet's decision
+  warning     a structured degradation notice from a subsystem that
+              proceeded anyway (component + reason — e.g. utils/xlacache
+              probing its cache dir unreachable and running uncached)
   solve       a driver-level Poisson solve (iters, residual, wall)
   halo        static per-shard halo-exchange byte counts (dist solvers)
   span        a named timing span — the ONE decomposition protocol the
@@ -67,9 +79,11 @@ import os
 import time
 import warnings
 
-SCHEMA_VERSION = 4  # v4: + fleet record kind, scenario dimension on
-#                     chunk/divergence/solve records (scenario_scope)
-#                     (v3, PR 7: + xprof record kind, drop accounting;
+SCHEMA_VERSION = 5  # v5: + coord record kind (chunk-boundary agreement
+#                     decisions), elastic ckpt events (elastic_save /
+#                     elastic_load), warning record kind
+#                     (v4, PR 9: + fleet record kind, scenario dimension;
+#                      v3, PR 7: + xprof record kind, drop accounting;
 #                      v2, PR 4: + recover / retry / ckpt record kinds)
 
 # METRICS vector layout (float32, shared by the 2-D and 3-D families; the

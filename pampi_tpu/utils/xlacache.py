@@ -6,16 +6,25 @@ Enabling JAX's persistent cache makes recompiles of an unchanged program a
 disk load (measured on the v5e tunnel: 23s -> 4s for the 2048² Poisson
 solver program). The CLI and bench.py enable it by default.
 
-  PAMPI_XLA_CACHE=<dir>   cache location (default ~/.cache/pampi_tpu/xla)
-  PAMPI_XLA_CACHE=0       disable (also: off, none)
+  PAMPI_XLA_CACHE=<dir>     cache location (default ~/.cache/pampi_tpu/xla)
+  PAMPI_XLA_CACHE=0         disable (also: off, none)
+  PAMPI_XLA_CACHE_TIMEOUT   cache-dir reachability probe budget in seconds
+                            (default 5; 0 skips the probe)
 
 Multi-process launches share the directory; the cache is content-addressed
-and concurrent-access safe.
+and concurrent-access safe. The directory is PROBED (with a hard timeout)
+before it is handed to XLA: on a shared filesystem a dead NFS/GCS mount —
+or the documented wedge below, where one rank's cache access hangs while
+its peers block inside a collective waiting for it — must degrade to a
+warn-and-run-uncached, never to a hung fleet. The probe failure emits a
+structured telemetry `warning` record, so a silently-slow serving process
+names its own degradation in the flight record.
 """
 
 from __future__ import annotations
 
 import os
+import warnings
 
 
 def enable(path: str | None = None) -> str | None:
@@ -44,6 +53,26 @@ def enable(path: str | None = None) -> str | None:
     path = val or path or os.path.join(
         os.path.expanduser("~"), ".cache", "pampi_tpu", "xla"
     )
+    try:
+        timeout = float(_flags.env(
+            "PAMPI_XLA_CACHE_TIMEOUT", "5",
+            doc="cache-dir reachability probe budget, seconds (0 skips)"))
+    except ValueError:
+        timeout = 5.0
+    reason = _probe_dir(path, timeout) if timeout > 0 else None
+    if reason is not None:
+        # the wedge guard: a dead rank (or dead shared storage) must not
+        # leave peers blocked on the cache path — proceed UNCACHED with a
+        # loud, structured degradation notice instead
+        from . import telemetry as _tm
+
+        warnings.warn(
+            f"XLA compilation cache at {path!r} is unusable ({reason}); "
+            "proceeding UNCACHED — compiles will pay full cost this run",
+            stacklevel=2,
+        )
+        _tm.emit("warning", component="xlacache", reason=reason, path=path)
+        return None
     import jax
 
     try:
@@ -57,3 +86,33 @@ def enable(path: str | None = None) -> str | None:
     except (OSError, AttributeError):
         return None
     return path
+
+
+def _probe_dir(path: str, timeout_s: float):
+    """Reachability probe with a HARD timeout: create + write + remove a
+    marker in the cache dir on a daemon thread, give it `timeout_s`.
+    Returns None when healthy, else the reason string. A hung shared
+    mount makes plain os calls block indefinitely — the thread is the
+    only portable way to bound that (the blocked thread is abandoned;
+    daemon threads die with the process)."""
+    import threading
+
+    err: list = []
+
+    def probe():
+        try:
+            os.makedirs(path, exist_ok=True)
+            marker = os.path.join(path, f".pampi-probe-{os.getpid()}")
+            with open(marker, "w") as fh:
+                fh.write("ok")
+            os.remove(marker)
+        except OSError as exc:
+            err.append(f"cache dir unusable ({exc})")
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return (f"cache-dir probe exceeded {timeout_s:g}s "
+                "(hung shared storage?)")
+    return err[0] if err else None

@@ -305,3 +305,316 @@ def test_both_generations_corrupt_one_structured_error(tmp_path):
         with pytest.raises(ckpt.CheckpointCorruptError,
                            match="and so is the previous generation"):
             ckpt.load_checkpoint(path, other)
+
+
+# ---------------------------------------------------------------------------
+# PR 10: elastic checkpoints — manifest + shards, restore on ANY mesh
+# ---------------------------------------------------------------------------
+
+def _dist2(te, dims):
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    return NS2DDistSolver(_param(te=te), CartComm(ndims=2, dims=dims))
+
+
+def test_elastic_restore_matrix_bitwise(tmp_path):
+    """The acceptance matrix: save on the full virtual 8-device mesh,
+    restore onto 4 / 2 / transposed / single-device solvers — global
+    fields bitwise equal after the NamedSharding reshard (the
+    8->4->1 chip shrink)."""
+    path = str(tmp_path / "ck.elastic")
+    src = _dist2(0.1, (2, 4))
+    src.run(progress=False)
+    ckpt.save_elastic(path, src)
+    ref = src.global_fields()
+
+    for dims in ((2, 2), (4, 2), (1, 2), (2, 1)):
+        tgt = _dist2(0.1, dims)
+        ckpt.load_elastic(path, tgt)
+        assert tgt.t == src.t and tgt.nt == src.nt
+        got = tgt.global_fields()
+        for f in ("u", "v", "p"):
+            np.testing.assert_array_equal(got[f], ref[f], err_msg=str(dims))
+
+    single = NS2DSolver(_param(te=0.1))
+    ckpt.load_elastic(path, single)
+    for f in ("u", "v", "p"):
+        np.testing.assert_array_equal(np.asarray(getattr(single, f)),
+                                      ref[f])
+
+
+def test_elastic_single_to_dist_and_restart_continuation(tmp_path):
+    """Single-device elastic save restores onto a mesh (the scale-UP
+    direction), and a single->single elastic restart continues BITWISE
+    (the full ghost ring rides the global layout)."""
+    path = str(tmp_path / "ck.elastic")
+    ref = NS2DSolver(_param(te=0.5))
+    ref.run(progress=False)
+
+    first = NS2DSolver(_param(te=0.2))
+    first.run(progress=False)
+    ckpt.save_elastic(path, first)
+
+    onto_mesh = _dist2(0.2, (2, 2))
+    ckpt.load_elastic(path, onto_mesh)
+    got = onto_mesh.global_fields()
+    for f in ("u", "v", "p"):
+        np.testing.assert_array_equal(got[f], np.asarray(getattr(first, f)))
+
+    second = NS2DSolver(_param(te=0.5))
+    ckpt.load_elastic(path, second)
+    second.run(progress=False)
+    assert second.nt == ref.nt
+    np.testing.assert_array_equal(np.asarray(second.p), np.asarray(ref.p))
+    np.testing.assert_array_equal(np.asarray(second.u), np.asarray(ref.u))
+
+
+def test_elastic_3d_roundtrip_across_meshes(tmp_path):
+    """The 3-D family through the same N-D helpers: (2,2,2) -> (1,2,2)
+    and single-device, bitwise."""
+    from pampi_tpu.models.ns3d import NS3DSolver
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    def p3(te):
+        return Parameter(
+            name="dcavity3d", imax=8, jmax=8, kmax=8, re=10.0, te=te,
+            tau=0.5, itermax=50, eps=1e-3, omg=1.7, gamma=0.9,
+            tpu_dtype="float64",
+        )
+
+    path = str(tmp_path / "ck3.elastic")
+    src = NS3DDistSolver(p3(0.08), CartComm(ndims=3, dims=(2, 2, 2)))
+    src.run(progress=False)
+    ckpt.save_elastic(path, src)
+    ref = src.global_fields()
+
+    tgt = NS3DDistSolver(p3(0.08), CartComm(ndims=3, dims=(1, 2, 2)))
+    ckpt.load_elastic(path, tgt)
+    for f in ("u", "v", "w", "p"):
+        np.testing.assert_array_equal(tgt.global_fields()[f], ref[f])
+
+    single = NS3DSolver(p3(0.08))
+    ckpt.load_elastic(path, single)
+    for f in ("u", "v", "w", "p"):
+        np.testing.assert_array_equal(np.asarray(getattr(single, f)),
+                                      ref[f])
+
+
+def _two_elastic_generations(tmp_path):
+    path = str(tmp_path / "ck.elastic")
+    s = NS2DSolver(_param(te=0.1))
+    s.run(progress=False)
+    t1 = s.t
+    ckpt.save_elastic(path, s)
+    s.t = t1 + 7.0
+    ckpt.save_elastic(path, s)
+    assert os.path.exists(path + ".prev")
+    return path, s, t1, s.t
+
+
+def test_elastic_rotation_and_generation_named_shards(tmp_path):
+    """Two saves: manifest rotated to .prev, each generation pointing at
+    its OWN generation-named shard files (no cross-generation sharing —
+    the crash-window safety of the scheme)."""
+    import json
+
+    path, _s, t1, t2 = _two_elastic_generations(tmp_path)
+    live = json.load(open(path))
+    prev = json.load(open(path + ".prev"))
+    assert live["generation"] == 2 and prev["generation"] == 1
+    assert live["shards"][0]["file"] != prev["shards"][0]["file"]
+    a = NS2DSolver(_param(te=0.1))
+    ckpt.load_elastic(path, a)
+    assert a.t == t2
+    b = NS2DSolver(_param(te=0.1))
+    ckpt.load_elastic(path + ".prev", b)
+    assert b.t == t1
+
+
+def test_elastic_torn_manifest_falls_back_to_prev(tmp_path):
+    path, _s, t1, _t2 = _two_elastic_generations(tmp_path)
+    with open(path, "w") as fh:
+        fh.write('{"format": "pampi-elastic-ckpt", "tru')  # torn JSON
+    fresh = NS2DSolver(_param(te=0.1))
+    with pytest.warns(UserWarning, match="falling back"):
+        ckpt.load_elastic(path, fresh)
+    assert fresh.t == t1
+
+
+def test_elastic_missing_shard_rejected_then_falls_back(tmp_path):
+    import json
+
+    path, _s, t1, _t2 = _two_elastic_generations(tmp_path)
+    shard = json.load(open(path))["shards"][0]["file"]
+    os.remove(str(tmp_path / shard))
+    fresh = NS2DSolver(_param(te=0.1))
+    with pytest.warns(UserWarning, match="falling back"):
+        ckpt.load_elastic(path, fresh)
+    assert fresh.t == t1
+    # without a fallback generation the rejection is structured + loud
+    os.remove(path + ".prev")
+    other = NS2DSolver(_param(te=0.1))
+    with pytest.raises(ckpt.CheckpointCorruptError, match="torn or corrupt"):
+        ckpt.load_elastic(path, other)
+
+
+def test_elastic_mixed_generation_refused(tmp_path):
+    """A shard whose embedded generation differs from the manifest's is
+    the crash-window / mangled-backup signature: REFUSED, never silently
+    combined — and the error names both generations."""
+    import json
+
+    path, _s, t1, _t2 = _two_elastic_generations(tmp_path)
+    man = json.load(open(path))
+    man["generation"] = 7  # manifest claims a generation no shard has
+    # keep shard names as-is: the EMBEDDED generation is the authority
+    with open(path, "w") as fh:
+        json.dump(man, fh)
+    fresh = NS2DSolver(_param(te=0.1))
+    with pytest.warns(UserWarning, match="falling back"):
+        ckpt.load_elastic(path, fresh)  # .prev set still loads
+    assert fresh.t == t1
+    os.remove(path + ".prev")
+    other = NS2DSolver(_param(te=0.1))
+    with pytest.raises(ckpt.CheckpointCorruptError,
+                       match="mixed-generation"):
+        ckpt.load_elastic(path, other, fallback=False)
+
+
+def test_elastic_shard_crc_rejects_bitflip(tmp_path, faults):
+    """ckpt_corrupt@write<N> now exercises the elastic shard write too:
+    the corrupted shard fails its CRC and load falls back."""
+    path, s, t1, _t2 = _two_elastic_generations(tmp_path)
+    faults("ckpt_corrupt@write1")
+    ckpt.save_elastic(path, s)  # gen3 shard written then corrupted
+    fresh = NS2DSolver(_param(te=0.1))
+    with pytest.warns(UserWarning, match="falling back"):
+        ckpt.load_elastic(path, fresh)
+    assert fresh.t == s.t  # .prev is gen2 (same state, rotated)
+
+
+def test_elastic_torn_shard_write_never_commits(tmp_path, faults):
+    """ckpt_torn@write<N> on an elastic save: the crash lands before the
+    manifest commit, so the OLD generation set stays live and loadable."""
+    path, s, t1, t2 = _two_elastic_generations(tmp_path)
+    faults("ckpt_torn@write1")
+    with pytest.raises(fi.CheckpointWriteCrash, match="torn"):
+        ckpt.save_elastic(path, s)
+    fresh = NS2DSolver(_param(te=0.1))
+    ckpt.load_elastic(path, fresh)  # live manifest: still gen2, intact
+    assert fresh.t == t2
+
+
+def test_elastic_shape_mismatch_is_config_error_no_fallback(tmp_path):
+    path, _s, _t1, _t2 = _two_elastic_generations(tmp_path)
+    other = NS2DSolver(
+        Parameter(name="dcavity", imax=8, jmax=8, re=10.0, te=0.1,
+                  tpu_dtype="float64"))
+    with pytest.raises(ValueError, match="global shape"):
+        ckpt.load_elastic(path, other)  # .prev exists but must NOT mask it
+
+
+def test_elastic_nonfinite_state_refused(tmp_path):
+    path = str(tmp_path / "ck.elastic")
+    s = NS2DSolver(_param(te=0.1))
+    s.run(progress=False)
+    ckpt.save_elastic(path, s)
+    s.t = float("nan")
+    with pytest.warns(UserWarning, match="non-finite"):
+        ckpt.save_elastic(path, s)
+    assert not os.path.exists(path + ".prev")  # no rotation happened
+
+
+def test_load_any_sniffs_both_formats(tmp_path):
+    legacy, elastic = str(tmp_path / "a.npz"), str(tmp_path / "b.el")
+    s = NS2DSolver(_param(te=0.1))
+    s.run(progress=False)
+    ckpt.save_checkpoint(legacy, s)
+    ckpt.save_elastic(elastic, s)
+    for path in (legacy, elastic):
+        fresh = NS2DSolver(_param(te=0.1))
+        ckpt.load_any(path, fresh)
+        assert fresh.t == s.t and fresh.nt == s.nt
+        np.testing.assert_array_equal(np.asarray(fresh.u), np.asarray(s.u))
+
+
+def test_fleet_elastic_restore_shrinks_the_mesh(tmp_path):
+    """The autoscaling hook: an 8-chip elastic checkpoint restored by
+    the FleetScheduler onto 4 (and 1) of the same virtual devices —
+    fields bitwise, solver ready to drive."""
+    import jax
+
+    from pampi_tpu.fleet.scheduler import FleetScheduler
+
+    path = str(tmp_path / "ck.elastic")
+    src = _dist2(0.1, (2, 4))
+    src.run(progress=False)
+    ckpt.save_elastic(path, src)
+    ref = src.global_fields()
+
+    sched = FleetScheduler()
+    shrunk = sched.elastic_restore(path, _param(te=0.2), "ns2d",
+                                   devices=jax.devices()[:4])
+    assert shrunk.comm.size == 4
+    got = shrunk.global_fields()
+    for f in ("u", "v", "p"):
+        np.testing.assert_array_equal(got[f], ref[f])
+
+    one = sched.elastic_restore(path, _param(te=0.2), "ns2d",
+                                devices=jax.devices()[:1])
+    assert not hasattr(one, "comm")  # single-device solver
+    np.testing.assert_array_equal(np.asarray(one.p), ref["p"])
+    one.run(progress=False)  # drives on from the restored state
+    assert one.t > 0.1
+
+
+def test_ckpt_fsck_tool_verdicts(tmp_path):
+    """tools/ckpt_fsck.py: healthy elastic + legacy sets verify (rc 0);
+    a corrupted shard flips the verdict (rc 1) and the report names the
+    failing field/file."""
+    import subprocess
+    import sys as _sys
+
+    path, s, _t1, _t2 = _two_elastic_generations(tmp_path)
+    legacy = str(tmp_path / "l.npz")
+    ckpt.save_checkpoint(legacy, s)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    r = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "ckpt_fsck.py"),
+         path, legacy], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "verdict  ok" in r.stdout and "generation 2" in r.stdout
+
+    import json
+
+    shard = json.load(open(path))["shards"][0]["file"]
+    fi.corrupt_file(str(tmp_path / shard))
+    r = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "ckpt_fsck.py"),
+         path], capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "CORRUPT" in r.stdout
+
+
+def test_ring_recovery_cold_tier_reads_elastic(tmp_path):
+    """Review regression: the divergence rollback's COLD tier must read
+    whichever format tpu_checkpoint writes — with the ring exhausted and
+    an elastic manifest on disk, attempt() restores from it (load_any
+    sniffs) instead of degrading to 'no checkpoint'."""
+    from pampi_tpu.models._driver import RingRecovery
+
+    path = str(tmp_path / "ck.elastic")
+    s = NS2DSolver(_param(te=0.1))
+    s.run(progress=False)
+    good_t, good_nt = s.t, s.nt
+    ckpt.save_elastic(path, s)
+    s.t, s.nt = float("nan"), good_nt + 5  # diverged in-memory state
+    rec = RingRecovery(s, "ns2d", time_index=3, ring=2, ckpt_path=path)
+    rolled = rec.attempt()  # ring empty -> cold tier
+    assert rolled is not None
+    state, _fn = rolled
+    assert float(state[3]) == good_t and int(state[4]) == good_nt
+    assert np.isfinite(np.asarray(s.u)).all()

@@ -1,0 +1,364 @@
+"""Coordinated multi-host fault handling (parallel/coordinator.py, PR 10
+tentpole) on the VIRTUAL-RANK simulation path: N full solver instances
+driven in lockstep through the same agree-then-act protocol the real
+multi-process allgather transport runs, so the global decisions — shared
+transient budget, agreed rollback generation, checkpoint vote, abort —
+are tier-1-provable on this CPU container. tests/test_multihost.py holds
+the real cross-process acceptance cases (capability-gated, un-gate on
+TPU/GPU or a gloo jaxlib).
+
+Compile cost: every solver is 16², tpu_chunk=2, a handful of steps (the
+test_faultinject sizing lever); the 4-rank cases pay 4 small builds by
+design — that IS the simulated fleet.
+"""
+
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.parallel import coordinator as co
+from pampi_tpu.utils import faultinject as fi
+from pampi_tpu.utils import telemetry as tm
+from pampi_tpu.utils.params import Parameter
+
+_BASE = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.05, tau=0.5,
+             itermax=50, eps=1e-4, omg=1.7, gamma=0.9)
+
+
+@pytest.fixture()
+def tel_on(tmp_path, monkeypatch):
+    path = tmp_path / "run.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(path))
+    tm.reset()
+    yield path
+    tm.reset()
+
+
+def _records(path, kind=None):
+    recs = [json.loads(ln) for ln in open(path) if ln.strip()]
+    return recs if kind is None else [r for r in recs if r["kind"] == kind]
+
+
+def _fleet(n, param=None, **loop_kw):
+    """n virtual ranks: each a full NS2DSolver built under its
+    rank_scope (so @rank<R> clauses arm only their target), wrapped in a
+    CoordinatedLoop mirroring the run() wiring."""
+    param = param or Parameter(tpu_chunk=2, **_BASE)
+    solvers, loops = [], []
+    for r in range(n):
+        with fi.rank_scope(r):
+            solvers.append(NS2DSolver(param))
+    for r, s in enumerate(solvers):
+        loops.append(co.sim_rank_loop(s, "ns2d", 3, r, **loop_kw))
+    return solvers, loops
+
+
+def _quiet_run(loops):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return co.LockstepSim(loops).run()
+
+
+# ---------------------------------------------------------------------------
+# the merge rule + the seam itself
+# ---------------------------------------------------------------------------
+
+def test_merge_words_semantics():
+    """done = min (ALL ranks must finish), faults/divergence/vote = max
+    (any rank's fault is everyone's), rollback target = min (the
+    shallowest common generation)."""
+    a = co.blank_word()
+    a[co.W_DONE] = 1
+    b = co.blank_word()
+    b[co.W_FAULT] = 1
+    b[co.W_DIVERGED] = 1
+    b[co.W_ROLLBACK_NT] = 8
+    c = co.blank_word()
+    c[co.W_DONE] = 1
+    c[co.W_ROLLBACK_NT] = 4
+    c[co.W_CKPT] = 1
+    m = co.merge_words(np.stack([a, b, c]))
+    assert m[co.W_DONE] == 0          # b is not done
+    assert m[co.W_FAULT] == 1
+    assert m[co.W_DIVERGED] == 1
+    assert m[co.W_ROLLBACK_NT] == 4   # the common (shallowest) generation
+    assert m[co.W_CKPT] == 1
+    # a lone clean word merges to itself (the SoloCoordinator identity)
+    clean = co.blank_word()
+    np.testing.assert_array_equal(co.merge_words(clean), clean)
+
+
+def test_solo_coordinator_is_bitwise_identical():
+    """tpu_coord on under one process: the protocol path (1-rank
+    coordinator) must reproduce the historical uncoordinated run
+    BITWISE — same compiled chunk, same confirmations, no trace change
+    (the coordinator is host-side only)."""
+    ref = NS2DSolver(Parameter(tpu_chunk=2, **_BASE))
+    ref.run(progress=False)
+    s = NS2DSolver(Parameter(tpu_chunk=2, tpu_coord="on", **_BASE))
+    s.run(progress=False)
+    assert s.nt == ref.nt and s.t == ref.t
+    np.testing.assert_array_equal(np.asarray(s.u), np.asarray(ref.u))
+    np.testing.assert_array_equal(np.asarray(s.v), np.asarray(ref.v))
+    np.testing.assert_array_equal(np.asarray(s.p), np.asarray(ref.p))
+    from pampi_tpu.utils import dispatch
+
+    assert dispatch.last("coord_ns2d") == "coordinated (forced, 1 process)"
+
+
+def test_coord_knob_validation():
+    s = NS2DSolver(Parameter(tpu_chunk=2, tpu_coord="bogus", **_BASE))
+    with pytest.raises(ValueError, match="tpu_coord"):
+        s.run(progress=False)
+
+
+def test_auto_is_uncoordinated_single_process():
+    """The default leaves single-process runs on the exact historical
+    loop: make_coordinator returns None and records why."""
+    assert co.make_coordinator(Parameter(**_BASE), "ns2d") is None
+    from pampi_tpu.utils import dispatch
+
+    assert dispatch.last("coord_ns2d") == "uncoordinated (single process)"
+    assert not co.coord_armed(Parameter(**_BASE))
+    assert co.coord_armed(Parameter(tpu_coord="on", **_BASE))
+
+
+# ---------------------------------------------------------------------------
+# the fault-suite smoke: 4 simulated ranks, rank-2 transient + rank-0
+# divergence rollback — identical post-recovery state on every rank
+# ---------------------------------------------------------------------------
+
+def test_four_rank_transient_retried_globally(faults, tel_on):
+    """An injected rank-LOCAL transient (rank 2, chunk 2) is agreed at
+    the boundary and the chunk re-dispatched on EVERY rank: all four
+    finals match the uninjected solo run bitwise (same compiled chunk,
+    same inputs), and the decision is one flight-recorder `coord`
+    line."""
+    ref = NS2DSolver(Parameter(tpu_chunk=2, **_BASE))
+    ref.run(progress=False)
+    faults("transient@chunk2@rank2")
+    solvers, loops = _fleet(4)
+    _quiet_run(loops)
+    for r, s in enumerate(solvers):
+        assert s.nt == ref.nt, f"rank {r}"
+        np.testing.assert_array_equal(np.asarray(s.u), np.asarray(ref.u))
+        np.testing.assert_array_equal(np.asarray(s.p), np.asarray(ref.p))
+    retries = [r for r in _records(tel_on, "coord")
+               if r["event"] == "retry"]
+    assert len(retries) == 1  # one GLOBAL decision, one line (rank 0)
+    assert retries[0]["budget_left"] == 0
+
+
+def test_four_rank_divergence_rolls_every_rank_back(faults, tel_on):
+    """A rank-0-only corruption diverges rank 0; the merged word rolls
+    EVERY rank back to the same agreed generation and every rank
+    re-drives with the same clamped dt — post-recovery state identical
+    on all ranks, finite, past te. The fault-suite coordinator smoke."""
+    faults("nan@step5:u@rank0")
+    solvers, loops = _fleet(
+        4, Parameter(tpu_chunk=2, tpu_recover_ring=4, **_BASE))
+    _quiet_run(loops)
+    ref = solvers[0]
+    assert ref.t > _BASE["te"]
+    for r, s in enumerate(solvers):
+        assert np.isfinite(np.asarray(s.u)).all(), f"rank {r}"
+        assert s._dt_scale == 0.5, f"rank {r}"  # ONE agreed clamp each
+        assert s.nt == ref.nt and s.t == ref.t, f"rank {r}"
+        np.testing.assert_array_equal(np.asarray(s.u), np.asarray(ref.u))
+        np.testing.assert_array_equal(np.asarray(s.p), np.asarray(ref.p))
+    rolls = [r for r in _records(tel_on, "coord")
+             if r["event"] == "rollback"]
+    assert len(rolls) == 1
+    assert rolls[0]["target_nt"] == 4  # the boundary before the bad step
+
+
+def test_global_budget_spans_ranks_and_aborts_everywhere(faults):
+    """The budget is GLOBAL: back-to-back transients on DIFFERENT ranks
+    inside one replenish window exhaust the single shared charge, and
+    the agreed decision is a clean abort on every rank — never one rank
+    dying inside a collective."""
+    faults("transient@chunk2@rank2,transient@chunk3@rank0")
+    _solvers, loops = _fleet(
+        4, Parameter(tpu_chunk=2, tpu_retry_replenish=50, **_BASE))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(co.CoordinatorAbort, match="budget exhausted"):
+            co.LockstepSim(loops).run()
+
+
+def test_global_budget_replenishes_after_agreed_clean_chunks(faults):
+    """Spaced rank-local transients past the replenish window both
+    retry (the shared budget refills on AGREED clean boundaries) and
+    the fleet completes — the PR 4 replenish semantics, now global."""
+    faults("transient@chunk2@rank1,transient@chunk6@rank3")
+    solvers, loops = _fleet(
+        4, Parameter(tpu_chunk=1, tpu_retry_replenish=3, **_BASE),
+        replenish_after=3)
+    _quiet_run(loops)
+    for s in solvers:
+        assert s.t > _BASE["te"]
+        assert np.isfinite(np.asarray(s.u)).all()
+
+
+def test_checkpoint_vote_commits_on_every_rank(faults, tel_on):
+    """The agreed checkpoint vote: every rank's on_ckpt commit fires at
+    the SAME boundaries (the manifest write itself is rank-0-gated in
+    production; the agreement is what this pins), and each commit is a
+    `coord` ckpt line."""
+    commits = {r: [] for r in range(3)}
+    solvers, loops = _fleet(3, Parameter(tpu_chunk=2, **_BASE))
+    for r, loop in enumerate(loops):
+        loop.ckpt_every = 2
+        loop.on_ckpt = lambda s, r=r: commits[r].append(
+            int(s[4]))  # nt at the commit point
+    _quiet_run(loops)
+    assert commits[0]  # the cadence fired at least once
+    assert commits[0] == commits[1] == commits[2]  # same agreed boundaries
+    votes = [r for r in _records(tel_on, "coord") if r["event"] == "ckpt"]
+    assert len(votes) == len(commits[0])
+
+
+def test_abort_on_unreplenished_budget_is_loud_not_divergent(faults):
+    """tpu_coord off under one process keeps the historical path even
+    with rank clauses armed (targeting rank 0 = this process): the
+    uncoordinated loop's own budget handles it."""
+    faults("transient@chunk2@rank0")
+    s = NS2DSolver(Parameter(tpu_chunk=2, tpu_coord="off", **_BASE))
+    with pytest.warns(UserWarning, match="transient"):
+        s.run(progress=False)
+    assert s.t > _BASE["te"]
+
+
+def test_coordinated_pallas_fallback_completes(faults, tel_on):
+    """The W_FALLBACK decision through the production seam: an injected
+    pallas failure under the 1-rank coordinator swaps to the jnp chunk
+    via the agreed word (retry() on the failing rank, mirrored on
+    peers) and the run completes — one `coord` fallback line."""
+    faults("pallas@chunk2")
+    s = NS2DSolver(Parameter(tpu_fuse_phases="on", tpu_solver="fft",
+                             tpu_chunk=2, tpu_coord="on", **_BASE))
+    assert s._uses_pallas()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s.run(progress=False)
+    assert s._backend == "jnp" and s.t > _BASE["te"]
+    assert np.isfinite(np.asarray(s.u)).all()
+    falls = [r for r in _records(tel_on, "coord")
+             if r["event"] == "fallback"]
+    assert len(falls) == 1
+
+
+# ---------------------------------------------------------------------------
+# xlacache wedge hardening (satellite): dead cache path -> warn + uncached
+# ---------------------------------------------------------------------------
+
+def test_xlacache_unusable_dir_proceeds_uncached(tmp_path, monkeypatch,
+                                                 tel_on):
+    """A cache path that cannot be used (here: a FILE where the dir
+    should be) degrades to warn-and-run-uncached with a structured
+    telemetry `warning` record — never a blocked run."""
+    from pampi_tpu.utils import xlacache
+
+    bogus = tmp_path / "cachefile"
+    bogus.write_text("not a directory")
+    monkeypatch.setenv("PAMPI_XLA_CACHE", str(bogus))
+    with pytest.warns(UserWarning, match="UNCACHED"):
+        assert xlacache.enable() is None
+    warns = _records(tel_on, "warning")
+    assert len(warns) == 1 and warns[0]["component"] == "xlacache"
+    from tools import check_artifact as ca
+    from tools import telemetry_report as tr
+
+    summ = tr.summary(_records(tel_on))
+    assert summ["warnings"][0]["component"] == "xlacache"
+    assert ca.lint_telemetry_summary(summ, "X") == []
+
+
+def test_xlacache_hung_probe_times_out(tmp_path, monkeypatch, tel_on):
+    """The documented wedge (xlacache.py): storage that HANGS (a dead
+    shared mount — os calls block forever) is bounded by the probe
+    timeout; the run proceeds uncached instead of wedging the fleet."""
+    import time
+
+    from pampi_tpu.utils import xlacache
+
+    monkeypatch.setenv("PAMPI_XLA_CACHE", str(tmp_path / "cache"))
+    monkeypatch.setenv("PAMPI_XLA_CACHE_TIMEOUT", "0.2")
+    monkeypatch.setattr(xlacache.os, "makedirs",
+                        lambda *a, **k: time.sleep(5))
+    with pytest.warns(UserWarning, match="UNCACHED"):
+        assert xlacache.enable() is None
+    warns = _records(tel_on, "warning")
+    assert warns and "probe exceeded" in warns[0]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# coord records through the report + artifact lint (schema v5)
+# ---------------------------------------------------------------------------
+
+def test_coord_records_render_and_lint(tel_on):
+    tm.emit("coord", event="armed", family="ns2d_dist", mode="multihost",
+            nranks=4, rank=0)
+    tm.emit("coord", event="retry", boundary=3, family="ns2d_dist",
+            budget_left=0, t=0.5)
+    tm.emit("coord", event="rollback", boundary=7, family="ns2d_dist",
+            target_nt=8, t=0.25)
+    tm.emit("ckpt", event="elastic_save", path="ck", generation=2,
+            mesh=[2, 4], t=0.5, nt=10, rotated=True)
+    tm.emit("ckpt", event="elastic_load", path="ck", generation=2,
+            mesh_now=[2, 2], t=0.5, nt=10)
+
+    from tools import check_artifact as ca
+    from tools import telemetry_report as tr
+
+    recs = _records(tel_on)
+    text = tr.render(recs)
+    for needle in ("coordinator (agreed global decisions)",
+                   "armed: multihost nranks=4", "retry", "rollback",
+                   "elastic_save", "elastic_load"):
+        assert needle in text, needle
+    summ = tr.summary(recs)
+    assert summ["coord"]["nranks"] == 4
+    assert summ["coord"]["decisions"] == {"retry": 1, "rollback": 1}
+    assert summ["ckpt"]["elastic_save"] == 1
+    assert summ["ckpt"]["elastic_load"] == 1
+    where = "BENCH.telemetry_summary"
+    assert ca.lint_telemetry_summary(summ, where) == []
+    # gutted blocks are FLAGGED, not waved through
+    assert ca.lint_telemetry_summary({**summ, "coord": "zap"}, where)
+    assert ca.lint_telemetry_summary({**summ, "coord": {}}, where)
+    assert ca.lint_telemetry_summary(
+        {**summ, "warnings": [{"reason": "no component"}]}, where)
+
+
+def test_fallback_mirrors_onto_transient_rank(faults, tel_on):
+    """Review regression: a rank that raised a TRANSIENT in the same
+    round a peer took the pallas fallback must STILL mirror the swap —
+    guarding on 'did I raise anything' would leave it on the pallas
+    program and desynchronize the fleet. Rank 0 pallas-fails and rank 1
+    transient-fails at the same boundary; both must end on jnp with
+    identical state."""
+    faults("pallas@chunk2@rank0,transient@chunk2@rank1")
+    param = Parameter(tpu_fuse_phases="on", tpu_solver="fft",
+                      tpu_chunk=2, **_BASE)
+    solvers = []
+    for r in range(2):
+        with fi.rank_scope(r):
+            solvers.append(NS2DSolver(param))
+    loops = []
+    for r, s in enumerate(solvers):
+        from pampi_tpu.models._driver import pallas_retry
+
+        loop = co.sim_rank_loop(s, "ns2d", 3, r)
+        loop.retry = pallas_retry(s, "pressure solve")
+        loops.append(loop)
+    _quiet_run(loops)
+    for r, s in enumerate(solvers):
+        assert s._backend == "jnp", f"rank {r} kept the pallas program"
+        assert s.t > _BASE["te"]
+    assert solvers[0].nt == solvers[1].nt
+    np.testing.assert_array_equal(np.asarray(solvers[0].u),
+                                  np.asarray(solvers[1].u))

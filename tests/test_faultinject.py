@@ -80,6 +80,69 @@ def test_spec_parse_and_errors(faults):
             fi.maybe_chunk_fault()
 
 
+def test_rank_suffix_grammar(faults):
+    """PR 10: `@rank<R>` targets chunk/step clauses at ONE rank; the
+    sites the protocol never coordinates (lane/write/emit) and any
+    malformed suffix are refused with FaultSpecError — a broken spec
+    must never run silently uninjected."""
+    faults("transient@chunk2@rank1,nan@step5:u@rank0*2")
+    assert fi._clauses() == (
+        ("transient", "chunk", 2, None, 1, 1),
+        ("nan", "step", 5, "u", 2, 0),
+    )
+    for bad in ("ckpt_torn@write1@rank0", "telemetry@emit1@rank1",
+                "nan@lane1:u@rank2", "transient@chunk1@rank",
+                "transient@chunk1@bank2", "nan@step1:u@rank1x"):
+        faults(bad)
+        with pytest.raises(fi.FaultSpecError, match="PAMPI_FAULTS"):
+            fi._clauses()
+
+
+def test_rank_targeting_fires_and_preserves_charges(faults):
+    """A rank-suffixed clause fires only under its rank's scope; a
+    NON-matching rank neither fires nor consumes the charge (the
+    take_lane_faults convention), and counters are per-rank so every
+    virtual rank counts its own dispatches."""
+    import math
+
+    faults("transient@chunk2@rank1,nan@step5:u@rank0")
+    # rank 1: its SECOND dispatch faults; rank 0's never does
+    with fi.rank_scope(1):
+        fi.maybe_chunk_fault()
+        with pytest.raises(fi.JaxRuntimeError, match="UNAVAILABLE"):
+            fi.maybe_chunk_fault()
+    with fi.rank_scope(0):
+        fi.maybe_chunk_fault()
+        fi.maybe_chunk_fault()  # rank 0's dispatch 2: clean
+    # the step clause: rank 1 must NOT consume rank 0's charge
+    with fi.rank_scope(1):
+        assert fi.take_field_faults() == ()
+    with fi.rank_scope(0):
+        taken = fi.take_field_faults()
+    assert len(taken) == 1 and taken[0][0] == "u" and math.isnan(taken[0][2])
+    with fi.rank_scope(0):
+        assert fi.take_field_faults() == ()  # charge spent by its target
+
+
+def test_rank_clause_for_other_rank_is_trace_identical(faults):
+    """The jaxpr-pin convention (PR 4): a rank-targeted field fault
+    aimed at ANOTHER rank leaves this rank's build byte-identical to
+    the uninjected program — the where() bakes only into its target."""
+    from pampi_tpu.analysis.jaxprcheck import (
+        assert_offpath_identity,
+        trace_chunk,
+    )
+
+    param = Parameter(**_BASE)
+    _off, jx_off = assert_offpath_identity(lambda: NS2DSolver(param))
+    faults("nan@step3:u@rank7")  # this process is rank 0
+    other = NS2DSolver(param)
+    assert str(trace_chunk(other)) == str(jx_off)
+    faults("nan@step3:u@rank0")  # aimed HERE: the corruption bakes
+    armed = NS2DSolver(param)
+    assert str(trace_chunk(armed)) != str(jx_off)
+
+
 def test_counters_reset(faults):
     faults("transient@chunk1")
     with pytest.raises(fi.JaxRuntimeError, match="UNAVAILABLE"):
@@ -352,7 +415,7 @@ def test_resilience_records_render_and_lint(tel_on):
     assert len(summ["recoveries"]) == 1 and summ["recoveries"][0]["nt"] == 8
     assert [r["fault"] for r in summ["retries"]] == ["transient", "pallas"]
     assert summ["ckpt"] == {"save": 1, "rotate": 1, "load": 1, "reject": 1,
-                            "skip": 0}
+                            "skip": 0, "elastic_save": 0, "elastic_load": 0}
     where = "BENCH.telemetry_summary"
     assert ca.lint_telemetry_summary(summ, where) == []
     # gutted blocks are FLAGGED, not waved through

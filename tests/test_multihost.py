@@ -362,3 +362,91 @@ def test_four_process_poisson_quarters_kernel(tmp_path):
     ours = np.loadtxt(tmp_path / "p.dat")
     ref = np.loadtxt(tmp_path / "oracle_dir" / "p.dat")
     np.testing.assert_allclose(ours, ref, rtol=0, atol=1e-11)
+
+
+# ---------------------------------------------------------------------------
+# PR 10: coordinated fault handling + elastic checkpoints across REAL
+# OS processes (ROADMAP item 4's acceptance cases — the virtual-rank
+# lockstep twins live in tests/test_coordinator.py and prove the
+# protocol logic on CPU; these prove the allgather transport and the
+# cross-process checkpoint surfaces on capable backends).
+# ---------------------------------------------------------------------------
+
+COORD_PAR = DCAVITY_PAR.replace("tpu_checkpoint ckpt.npz", "")
+
+
+@pytest.mark.slow
+def test_two_process_transient_retried_by_coordinator(tmp_path):
+    """The lifted transient_budget=0 ban, for real: a rank-1-local
+    injected transient under a 2-process launch is agreed at the chunk
+    boundary and retried GLOBALLY (the whole job completes, bitwise
+    equal to the uninjected run) — where the PR 4 guard would have
+    killed the job. The coord retry decision is a flight-recorder line
+    on rank 0."""
+    import json
+
+    par = tmp_path / "dcavity.par"
+    par.write_text(COORD_PAR)
+    _launch(par, tmp_path)  # uninjected oracle, same launch shape
+    (tmp_path / "oracle_p.dat").write_bytes(
+        (tmp_path / "pressure.dat").read_bytes())
+
+    proc = subprocess.run(
+        [str(LAUNCHER), "2", str(par)],
+        cwd=tmp_path,
+        env=_env(PAMPI_LOCAL_DEVICES="2",
+                 PAMPI_FAULTS="transient@chunk2@rank1",
+                 PAMPI_TELEMETRY=str(tmp_path / "coord.jsonl")),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Solution took" in proc.stdout
+    assert (tmp_path / "pressure.dat").read_bytes() == \
+        (tmp_path / "oracle_p.dat").read_bytes()
+    recs = [json.loads(ln) for ln in open(tmp_path / "coord.jsonl")
+            if ln.strip()]
+    armed = [r for r in recs if r["kind"] == "coord"
+             and r.get("event") == "armed"]
+    assert armed and armed[0]["mode"] == "multihost" \
+        and armed[0]["nranks"] == 2
+    retries = [r for r in recs if r["kind"] == "coord"
+               and r.get("event") == "retry"]
+    assert len(retries) == 1
+
+
+@pytest.mark.slow
+def test_two_process_elastic_checkpoint_restores_on_one_process(tmp_path):
+    """Elastic shrink across the process boundary: a 2-process x
+    2-device run writes the manifest + shard set; THIS single process
+    then restores it onto one device and onto a different in-process
+    mesh — the manifest's mesh is metadata, not a contract."""
+    par = tmp_path / "dcavity.par"
+    par.write_text(COORD_PAR.replace(
+        "tpu_dtype  float64",
+        "tpu_dtype  float64\ntpu_checkpoint ck.elastic\n"
+        "tpu_ckpt_elastic 1"))
+    _launch(par, tmp_path)
+    manifest = tmp_path / "ck.elastic"
+    assert manifest.exists()
+
+    import json
+
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.utils import checkpoint as ckpt
+    from pampi_tpu.utils.params import Parameter, read_parameter
+
+    man = json.loads(manifest.read_text())
+    assert man["format"] == "pampi-elastic-ckpt" and man["nt"] > 0
+    param = read_parameter(str(par), Parameter())
+    single = NS2DSolver(param)
+    ckpt.load_elastic(str(manifest), single)
+    assert single.nt == man["nt"] and single.t == man["t"]
+    assert np.isfinite(np.asarray(single.u)).all()
+    # fsck agrees the set is healthy
+    proc = subprocess.run(
+        ["python", str(REPO / "tools" / "ckpt_fsck.py"), str(manifest)],
+        capture_output=True, text=True, env=_env(PYTHONPATH=str(REPO)),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -191,7 +191,28 @@ def lint_telemetry_summary(d: dict, where: str) -> list[str]:
         if not isinstance(d["ckpt"], dict):
             errs.append(f"{where}.ckpt: not a dict")
         else:
+            # the legacy five are required; elastic_save/elastic_load
+            # (schema v5) ride as extras so pre-elastic artifacts pass
             errs += _missing(d["ckpt"], CKPT_EVENTS, f"{where}.ckpt")
+    # the schema-v5 coordinator decision census (optional until a
+    # coordinated run merges one): a gutted block must be flagged — a
+    # fleet artifact without its decision counts would hide that faults
+    # were handled at all
+    coord = d.get("coord")
+    if coord is not None:
+        if not isinstance(coord, dict):
+            errs.append(f"{where}.coord: not a dict")
+        else:
+            errs += _missing(coord, ("decisions",), f"{where}.coord")
+            if not isinstance(coord.get("decisions", {}), dict):
+                errs.append(f"{where}.coord.decisions: not a dict")
+    warns = d.get("warnings")
+    if warns is not None:
+        if not isinstance(warns, list):
+            errs.append(f"{where}.warnings: not a list")
+        elif not all(isinstance(w, dict) and "component" in w
+                     for w in warns):
+            errs.append(f"{where}.warnings: record missing 'component'")
     return errs
 
 

@@ -1,0 +1,186 @@
+"""Offline checkpoint verifier (`make ckpt-fsck CKPT=<path>`).
+
+    python tools/ckpt_fsck.py <checkpoint> [...]
+
+Verifies a pampi_tpu checkpoint ON DISK without building a solver —
+the operator's pre-restore sanity check and the post-incident triage
+tool. Both formats:
+
+- elastic manifest (utils/checkpoint.save_elastic): manifest parse +
+  schema, every shard file's existence, embedded GENERATION match
+  (a mixed-generation set is the crash-window signature), per-field
+  slab CRC32, and the assembled-global CRC; renders generation, writing
+  mesh, global shape, t/nt and a per-field status table.
+- legacy single-.npz (save_checkpoint): zip container, schema version,
+  mesh/shape metadata, per-field CRC32.
+
+The `.prev` generation (when present) is verified too and reported as
+the fallback's health — but only PRIMARY corruption fails the exit
+code: a healthy primary over a rotted .prev is degraded redundancy,
+not a broken checkpoint.
+
+Exit 0 = every primary verified; 1 = any primary torn/corrupt/missing.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import zipfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pampi_tpu.utils.checkpoint import (  # noqa: E402
+    CKPT_VERSION,
+    ELASTIC_VERSION,
+    CheckpointCorruptError,
+    _corrupt_classes,
+    _crc,
+    _read_manifest,
+    is_elastic,
+)
+
+
+def _fsck_elastic(path: str) -> list[str]:
+    """Verify one elastic manifest set; returns the error lines (empty =
+    healthy). Prints the rendered report as it goes."""
+    errs: list[str] = []
+    try:
+        man = _read_manifest(path)
+    except _corrupt_classes() as exc:
+        return [f"{path}: {exc}"]
+    gen = int(man["generation"])
+    print(f"  format   elastic v{man['version']} "
+          f"(ckpt schema {man.get('ckpt_version', '?')}, "
+          f"this build reads <= {ELASTIC_VERSION})")
+    print(f"  generation {gen}   t={man['t']:.6g} nt={man['nt']}")
+    print(f"  mesh     {man['mesh'] or [1]} -> global "
+          f"{'x'.join(str(s) for s in man['global_shape'])} "
+          f"{man['dtype']} ({man.get('nshards', len(man['shards']))} "
+          f"shard(s))")
+    gshape = tuple(int(s) for s in man["global_shape"])
+    fields = {f: np.zeros(gshape, np.dtype(man["dtype"]))
+              for f in man["fields"]}
+    base = os.path.dirname(path)
+    covered = np.zeros(gshape[0], bool)
+    for sh in man["shards"]:
+        spath = os.path.join(base, sh["file"]) if base else sh["file"]
+        tag = f"shard r{sh['rank']} ({sh['file']})"
+        try:
+            z = np.load(spath)
+        except FileNotFoundError:
+            errs.append(f"{tag}: MISSING")
+            continue
+        except (ValueError, EOFError, zipfile.BadZipFile) as exc:
+            errs.append(f"{tag}: unreadable ({exc})")
+            continue
+        with z:
+            sgen = int(z["generation"])
+            if sgen != gen:
+                errs.append(f"{tag}: generation {sgen} != manifest {gen} "
+                            "(MIXED-GENERATION set)")
+                continue
+            lo, hi = (int(x) for x in sh["rows"])
+            covered[lo:hi] = True
+            for f in man["fields"]:
+                try:
+                    slab = z[f]
+                    ok = _crc(slab) == int(z[f"crc_{f}"])
+                except (KeyError, ValueError, zipfile.BadZipFile) as exc:
+                    errs.append(f"{tag}.{f}: unreadable ({exc})")
+                    continue
+                if not ok:
+                    errs.append(f"{tag}.{f}: slab CRC32 MISMATCH")
+                else:
+                    fields[f][lo:hi] = slab
+    if not covered.all():
+        errs.append(f"{path}: shard rows cover {int(covered.sum())} of "
+                    f"{gshape[0]} global rows")
+    for f, arr in fields.items():
+        status = "ok"
+        if any(e for e in errs if f".{f}:" in e or "MISSING" in e
+               or "MIXED" in e or "cover" in e):
+            status = "UNVERIFIABLE (shard errors above)"
+        elif _crc(arr) != int(man["crc"][f]):
+            status = "global CRC32 MISMATCH"
+            errs.append(f"{path}.{f}: assembled-global CRC32 mismatch")
+        print(f"    field {f:<2} {status}")
+    return errs
+
+
+def _fsck_legacy(path: str) -> list[str]:
+    errs: list[str] = []
+    try:
+        z = np.load(path)
+    except FileNotFoundError:
+        return [f"{path}: MISSING"]
+    except (ValueError, EOFError, zipfile.BadZipFile) as exc:
+        return [f"{path}: unreadable container ({exc})"]
+    with z:
+        ver = int(z["version"]) if "version" in z else 1
+        mesh = list(z["mesh"]) if "mesh" in z else []
+        shape = list(z["shape"]) if "shape" in z else "?"
+        print(f"  format   legacy .npz v{ver} "
+              f"(this build reads <= {CKPT_VERSION})")
+        print(f"  mesh     {[int(m) for m in mesh] or [1]} -> stacked "
+              f"{'x'.join(str(int(s)) for s in shape)}   "
+              f"t={float(z['t']):.6g} nt={int(z['nt'])}")
+        for f in ("u", "v", "w", "p"):
+            if f not in z.files:
+                continue
+            key = f"crc_{f}"
+            if key not in z.files:
+                print(f"    field {f:<2} no CRC (v1 file; container "
+                      "integrity only)")
+                continue
+            try:
+                ok = _crc(z[f]) == int(z[key])
+            except (ValueError, zipfile.BadZipFile) as exc:
+                errs.append(f"{path}.{f}: unreadable ({exc})")
+                print(f"    field {f:<2} UNREADABLE")
+                continue
+            print(f"    field {f:<2} {'ok' if ok else 'CRC32 MISMATCH'}")
+            if not ok:
+                errs.append(f"{path}.{f}: CRC32 mismatch")
+    return errs
+
+
+def fsck(path: str) -> list[str]:
+    """Verify primary + (informationally) .prev; returns PRIMARY errors."""
+    print(f"== {path} ==")
+    try:
+        elastic = is_elastic(path)
+    except CheckpointCorruptError:
+        elastic = True
+    errs = (_fsck_elastic if elastic else _fsck_legacy)(path)
+    for e in errs:
+        print(f"    ERROR {e}")
+    prev = f"{path}.prev"
+    if os.path.exists(prev):
+        print(f"-- fallback generation {prev} --")
+        perrs = (_fsck_elastic if is_elastic(prev) else _fsck_legacy)(prev)
+        for e in perrs:
+            print(f"    (prev) {e}")
+        if errs and not perrs:
+            print("  NOTE primary is damaged but the .prev generation "
+                  "verifies — load_checkpoint/load_elastic will fall back")
+    print(f"  verdict  {'CORRUPT' if errs else 'ok'}")
+    return errs
+
+
+def main(argv: list[str]) -> int:
+    paths = argv[1:]
+    if not paths:
+        print(__doc__.strip(), file=sys.stderr)
+        return 1
+    bad = 0
+    for p in paths:
+        bad += len(fsck(p))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

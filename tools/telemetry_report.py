@@ -126,8 +126,28 @@ def summary(records: list[dict]) -> dict:
         ] or None,
         "ckpt": {
             ev: sum(1 for c in k.get("ckpt", []) if c.get("event") == ev)
-            for ev in ("save", "rotate", "load", "reject", "skip")
+            for ev in ("save", "rotate", "load", "reject", "skip",
+                       "elastic_save", "elastic_load")
         } if k.get("ckpt") else None,
+        # the chunk-boundary agreement protocol's decision census
+        # (schema v5; parallel/coordinator.py emits one `coord` record
+        # per GLOBAL decision from rank 0)
+        "coord": {
+            "nranks": next(
+                (c.get("nranks") for c in k["coord"]
+                 if c.get("event") == "armed"), None),
+            "decisions": {
+                ev: n for ev in ("retry", "fallback", "rollback", "ckpt",
+                                 "giveup", "abort")
+                if (n := sum(1 for c in k["coord"]
+                             if c.get("event") == ev))
+            },
+        } if k.get("coord") else None,
+        "warnings": [
+            {key: val for key, val in w.items()
+             if key not in ("v", "kind", "ts")}
+            for w in k.get("warning", [])
+        ] or None,
         "spans": spans or None,
         "solves": {
             "count": len(k.get("solve", [])),
@@ -325,6 +345,24 @@ def render(records: list[dict]) -> str:
             f"{d.get('last_good_step')})"
             if "first_bad_step" in d else
             f"  {d.get('family')}: non-finite residual {d.get('res')}")
+
+    if k.get("coord"):
+        add("== coordinator (agreed global decisions) ==")
+        for c in k["coord"]:
+            ev = c.get("event")
+            if ev == "armed":
+                add(f"  armed: {c.get('mode')} nranks={c.get('nranks')} "
+                    f"(family {c.get('family')})")
+                continue
+            detail = {key: val for key, val in c.items()
+                      if key not in ("v", "kind", "ts", "event",
+                                     "boundary", "family")}
+            add(f"  boundary {str(c.get('boundary')):>5}  {ev:<9} {detail}")
+
+    if k.get("warning"):
+        add("== warnings (degraded-but-proceeding subsystems) ==")
+        for w in k["warning"]:
+            add(f"  {w.get('component', '?'):<12} {w.get('reason')}")
 
     if k.get("recover"):
         add("== recovery (divergence rollback) ==")
