@@ -116,24 +116,41 @@ fleet-suite:
 
 # Standalone run of the fault-injection / recovery suite (PAMPI_FAULTS
 # plane, retry budgets, rollback-recovery, checkpoint durability edges,
-# and the PR 10 coordinator protocol: tests/test_coordinator.py carries
-# the simulated 4-rank chunk-boundary smoke — an injected rank-2
-# transient retried globally plus a rank-0 divergence rollback, with
-# identical post-recovery state asserted on every rank — and the
-# elastic-restore matrix rides tests/test_checkpoint.py).
+# the PR 10 coordinator protocol — tests/test_coordinator.py carries
+# the simulated 4-rank chunk-boundary smoke plus the PR 12 dead-rank
+# matrix (death at the boundary, hang past the watchdog, double-death,
+# death during rollback, shrink-resume bitwise parity, ledger probation
+# persistence) — the elastic-restore matrix in tests/test_checkpoint.py,
+# and tests/test_multihost.py (the real kill-a-process acceptance cases;
+# capability-gated, so on this container they SKIP with the gloo reason
+# and on real hardware they are the gate).
 # The same tests ride tier-1 at 16-squared size; this target is the quick
 # focused loop while touching the recovery layer.
 fault-suite:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_faultinject.py \
 	  tests/test_driver.py tests/test_checkpoint.py \
-	  tests/test_coordinator.py -q
+	  tests/test_coordinator.py tests/test_multihost.py -q
+
+# Dead-rank survival smoke (PR 12): a 2-virtual-rank lockstep run with
+# an agreed elastic checkpoint cadence; rank 1 is killed at chunk 5 and
+# the survivor must (a) raise the structured RankDeadError naming it,
+# (b) shrink-resume from the newest agreed elastic generation, and
+# (c) finish bitwise-identical to a clean shrunk-mesh run restored from
+# the same generation. The quick loop while touching the dead-rank
+# protocol; the pytest twins ride fault-suite/tier-1.
+dead-rank-smoke:
+	JAX_PLATFORMS=cpu python tools/dead_rank_smoke.py
 
 # Offline checkpoint verifier (both formats: elastic manifest + shards,
 # legacy single-.npz): generation, writing mesh, per-field CRC status.
-#   make ckpt-fsck CKPT=ck.npz
+# SURVIVORS=<N> additionally checks the set is restorable onto an
+# N-rank survivor mesh (full shard coverage + fault ledger present —
+# the dead-rank shrink-resume pre-flight).
+#   make ckpt-fsck CKPT=ck.npz [SURVIVORS=4]
 CKPT ?= ckpt.npz
 ckpt-fsck:
-	python tools/ckpt_fsck.py $(CKPT)
+	python tools/ckpt_fsck.py $(if $(SURVIVORS),--survivors $(SURVIVORS)) \
+	  $(CKPT)
 
 clean:
 	rm -rf $(BUILD) exe-$(TAG)
@@ -143,4 +160,4 @@ distclean:
 
 .PHONY: all test asm format telemetry-report check-artifacts bench-trend \
 	profile-smoke fleet-smoke fleet-suite lint lint-update lint-comm \
-	fault-suite ckpt-fsck clean distclean
+	fault-suite dead-rank-smoke ckpt-fsck clean distclean

@@ -143,6 +143,65 @@ def _run(argv) -> int:
             telemetry.finalize()
 
 
+def _resume_after_death(param, exc, is3d: bool):
+    """The driver's dead-rank policy (`tpu_dead_resume`): on a
+    RankDeadError, restore the newest agreed elastic generation onto
+    whatever capacity THIS process still owns and finish the run
+    degraded (fleet/scheduler.shrink_resume). Returns the completed
+    survivor solver, or None when resume is off / not armed / this
+    process cannot stand alone — then the structured error plus the
+    operator walkthrough is the output, and the caller exits 3.
+
+    Under a real multi-process launch every surviving process lands
+    here; an in-place process-group shrink would need a re-elected
+    coordinator and dense re-ranking, so the cross-process story is the
+    printed relaunch (survivor count + tpu_restart) — the single-process
+    shape (one host owning local devices, and the lockstep proof path)
+    resumes in-process."""
+    import jax
+
+    print(f"Error: {exc}", file=sys.stderr)
+    armed = (param.tpu_dead_resume and param.tpu_checkpoint
+             and param.tpu_ckpt_elastic
+             and os.path.exists(param.tpu_checkpoint))
+    if not armed:
+        print(
+            "dead-rank resume not armed (needs tpu_dead_resume 1 + "
+            "tpu_ckpt_elastic 1 + an existing tpu_checkpoint manifest); "
+            "resume manually via tpu_restart on the survivor set",
+            file=sys.stderr,
+        )
+        return None
+    if jax.process_count() > 1:
+        n_alive = (len(exc.survivors) if exc.survivors is not None
+                   else jax.process_count() - max(1, len(exc.ranks)))
+        print(
+            "dead-rank resume across processes is operator-driven: "
+            f"relaunch with {n_alive} process(es) on the surviving "
+            f"hosts, adding `tpu_restart {param.tpu_checkpoint}` — the "
+            "elastic manifest reshards onto the shrunk mesh and the "
+            "fault ledger restores the fleet's protocol state",
+            file=sys.stderr,
+        )
+        return None
+    from .fleet.scheduler import shrink_resume
+
+    family = "ns3d" if is3d else "ns2d"
+    try:
+        solver = shrink_resume(param.tpu_checkpoint, param,
+                               family=family, dead=exc.ranks,
+                               epoch=exc.epoch)
+    except (OSError, ValueError, KeyError) as err:
+        print(f"Error: dead-rank resume from {param.tpu_checkpoint} "
+              f"failed: {err}", file=sys.stderr)
+        return None
+    print(f"Resumed on the survivor set from {param.tpu_checkpoint} "
+          f"(generation {getattr(solver, '_elastic_generation', '?')}) "
+          f"at t={solver.t:.4f}; finishing at degraded capacity")
+    solver.run()
+    return solver
+
+
 def _dispatch(param, prof) -> int:
     from .utils.timing import get_timestamp
 
@@ -202,6 +261,15 @@ def _dispatch(param, prof) -> int:
         print(
             "Error: tpu_coord must be auto|on|off and tpu_ckpt_elastic "
             f"0|1 (got {param.tpu_coord!r}, {param.tpu_ckpt_elastic})",
+            file=sys.stderr,
+        )
+        return 1
+
+    if param.tpu_coord_timeout < 0 or param.tpu_dead_resume not in (0, 1):
+        print(
+            "Error: tpu_coord_timeout must be >= 0 (seconds; 0 disables "
+            "the boundary watchdog) and tpu_dead_resume 0|1 (got "
+            f"{param.tpu_coord_timeout}, {param.tpu_dead_resume})",
             file=sys.stderr,
         )
         return 1
@@ -327,8 +395,19 @@ def _dispatch(param, prof) -> int:
                     save=ckpt.writer_for(param),
                 )
         start = get_timestamp()
-        with prof.region("timeloop"):
-            solver.run(on_sync=on_sync)
+        from .parallel.coordinator import RankDeadError
+
+        try:
+            with prof.region("timeloop"):
+                solver.run(on_sync=on_sync)
+        except RankDeadError as exc:
+            # a peer stopped answering the boundary allgather: the
+            # watchdog + membership round turned the wedge into this
+            # structured, fleet-symmetric verdict. Shrink to the
+            # survivors when the run armed the elastic resume path.
+            solver = _resume_after_death(param, exc, is3d)
+            if solver is None:
+                return 3
         end = get_timestamp()
         print("Solution took %.2fs" % (end - start))
         if param.tpu_checkpoint:

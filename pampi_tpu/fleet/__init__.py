@@ -35,6 +35,7 @@ from .scheduler import (
     ScenarioResult,
     reset_templates,
     run_fleet,
+    shrink_resume,
 )
 
 __all__ = [
@@ -42,5 +43,5 @@ __all__ = [
     "BucketKey", "ScenarioRequest", "bucket", "bucket_key", "family_of",
     "knob_signature", "load_queue", "signature_hash",
     "FleetResult", "FleetScheduler", "ScenarioResult", "reset_templates",
-    "run_fleet",
+    "run_fleet", "shrink_resume",
 ]

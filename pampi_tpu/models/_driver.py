@@ -62,7 +62,8 @@ def clamped_dt(dt, scale):
 def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
                  lookahead: int = 0, replenish_after: int = 8, recover=None,
                  transient_budget: int = 1, coordinator=None,
-                 ckpt_every: int = 0, on_ckpt=None, family: str = ""):
+                 ckpt_every: int = 0, on_ckpt=None, family: str = "",
+                 ledger=None):
     """Run `state = chunk_fn(*state)` while state[time_index] <= te
     (main.c:43-60 loop semantics: a step runs whenever t <= te at its start).
 
@@ -113,7 +114,14 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
     single-process default) is THIS exact loop, untouched. The
     coordinated path forces lookahead=0 (every boundary is a
     rendezvous) and takes the agreed checkpoint cadence from
-    `ckpt_every`/`on_ckpt` instead of an on_state counter."""
+    `ckpt_every`/`on_ckpt` instead of an on_state counter.
+
+    ledger, when not None, is a restored FAULT LEDGER (the elastic
+    manifest's `ledger` key, stashed on the solver by
+    utils/checkpoint.load_elastic): the spent transient budget carries
+    over so a resumed run starts with the charge it died with — the
+    rank-symmetric no-amnesia contract (the pallas verdict and dt clamp
+    were already re-applied at load time)."""
     if coordinator is not None:
         from ..parallel.coordinator import drive_coordinated
 
@@ -122,7 +130,7 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
             coordinator, on_state=on_state,
             replenish_after=replenish_after, recover=recover,
             transient_budget=transient_budget, ckpt_every=ckpt_every,
-            on_ckpt=on_ckpt, family=family,
+            on_ckpt=on_ckpt, family=family, ledger=ledger,
         )
     if lookahead < 0:
         # cli.py validates the .par key; programmatic callers land here (a
@@ -130,6 +138,11 @@ def drive_chunks(state, chunk_fn, te, time_index, bar, retry, on_state=None,
         # IndexError through the device-fault retry path)
         raise ValueError(f"lookahead must be >= 0 (got {lookahead})")
     max_transient = max(0, transient_budget)  # replenish refills to THIS
+    if ledger:
+        # resumed run: start with the spent charge, refill to the full
+        # budget on the usual clean streak
+        transient_budget = max(
+            0, transient_budget - int(ledger.get("budget_spent", 0)))
     clean = 0  # consecutive confirmed chunks since the last fault/recovery
     # per-chunk steps/s + ETA line behind PAMPI_PROFILE (utils/progress.
     # ChunkEta): a multi-minute run stops being a silent decile bar. The
@@ -297,6 +310,20 @@ class _PallasRetry:
         self._restored = False  # current pallas period came from a restore
         self._dead = False     # pallas judged deterministically broken
         self._clean = 0        # clean chunks since the last transition
+        # a restored fault ledger (utils/checkpoint._restore_ledger has
+        # already parked the solver on jnp): the deterministically-broken
+        # verdict survives the restart — no probation amnesia
+        led = (getattr(solver, "_fault_ledger", None) or {}).get("pallas")
+        if led and led.get("broken"):
+            self._dead = True
+            self._on_jnp = solver._backend == "jnp"
+
+    def ledger(self) -> dict:
+        """This hook's slice of the coordinator fault ledger
+        (parallel/coordinator.CoordinatedLoop.ledger)."""
+        return {"broken": bool(self._dead),
+                "on_jnp": bool(self._on_jnp),
+                "backend": self.solver._backend}
 
     def __call__(self):
         s = self.solver
@@ -508,7 +535,10 @@ def coord_ckpt_cadence(solver, coord, publish):
     on_sync periodic writer stands down when the coordinator is armed —
     see cli.py; two counters over the same cadence would double-write).
     Returns (ckpt_every, on_ckpt) — (0, None) when uncoordinated or no
-    checkpoint path is set."""
+    checkpoint path is set. The returned on_ckpt takes the loop's fault
+    ledger (marked via `takes_ledger`) and hands it to the writer, so
+    every agreed elastic commit persists the protocol state alongside
+    the fields."""
     param = solver.param
     if coord is None or not param.tpu_checkpoint:
         return 0, None
@@ -516,10 +546,25 @@ def coord_ckpt_cadence(solver, coord, publish):
 
     writer = _ckpt.writer_for(param)
 
-    def on_ckpt(s):
+    def on_ckpt(s, ledger=None):
         publish(s)
-        writer(param.tpu_checkpoint, solver)
+        # stash the agreed ledger on the solver too: the cli's
+        # END-OF-RUN write goes through save_elastic's _fault_ledger
+        # fallback, so the final manifest keeps the last agreed
+        # protocol state instead of silently dropping it
+        solver._fault_ledger = ledger
+        writer(param.tpu_checkpoint, solver, ledger=ledger)
 
+    on_ckpt.takes_ledger = True
+
+    def stash_ledger(ledger):
+        # completion stash (no write): a run that finishes before the
+        # first cadence boundary never called on_ckpt, so without this
+        # the end-of-run manifest would drop the ledger entirely and
+        # fail the `ckpt_fsck --survivors` pre-flight
+        solver._fault_ledger = ledger
+
+    on_ckpt.stash_ledger = stash_ledger
     return max(1, param.tpu_ckpt_every), on_ckpt
 
 
@@ -534,10 +579,16 @@ def make_recovery(solver, family: str, time_index: int, recorder=None):
     # every family's state is (..., t, nt[, metrics]): metrics sits two
     # past the loop time when the telemetry vector rides the chunk
     mi = time_index + 2 if getattr(solver, "_metrics", False) else None
-    return RingRecovery(
+    rec = RingRecovery(
         solver, family, time_index, ring=ring,
         dt_scale=param.tpu_recover_dt_scale,
         max_attempts=param.tpu_recover_max,
         metrics_index=mi, recorder=recorder,
         ckpt_path=getattr(param, "tpu_checkpoint", ""),
     )
+    led = getattr(solver, "_fault_ledger", None) or {}
+    # resumed run: the attempt budget carries over (the dt clamp was
+    # re-applied at load time) — a fleet that died mid-recovery cannot
+    # restart with a fresh allowance against the same divergence
+    rec._attempts = int(led.get("recover_attempts", 0))
+    return rec

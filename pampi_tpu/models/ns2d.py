@@ -714,7 +714,8 @@ class NS2DSolver:
                 on_state, lookahead=self.param.tpu_lookahead,
                 replenish_after=self.param.tpu_retry_replenish,
                 recover=recover, coordinator=coord,
-                ckpt_every=ckpt_every, on_ckpt=on_ckpt, family="ns2d")
+                ckpt_every=ckpt_every, on_ckpt=on_ckpt, family="ns2d",
+                ledger=getattr(self, "_fault_ledger", None))
             publish(state)
 
     def write_result(

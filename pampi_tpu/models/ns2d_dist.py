@@ -1081,7 +1081,8 @@ class NS2DDistSolver:
                 replenish_after=self.param.tpu_retry_replenish,
                 recover=recover, transient_budget=budget,
                 coordinator=coord, ckpt_every=ckpt_every,
-                on_ckpt=on_ckpt, family="ns2d_dist")
+                on_ckpt=on_ckpt, family="ns2d_dist",
+                ledger=getattr(self, "_fault_ledger", None))
             publish(state)
         self._emit_exchange_span()
 

@@ -663,7 +663,8 @@ class NS3DSolver:
                 on_state, lookahead=self.param.tpu_lookahead,
                 replenish_after=self.param.tpu_retry_replenish,
                 recover=recover, coordinator=coord,
-                ckpt_every=ckpt_every, on_ckpt=on_ckpt, family="ns3d")
+                ckpt_every=ckpt_every, on_ckpt=on_ckpt, family="ns3d",
+                ledger=getattr(self, "_fault_ledger", None))
             publish(state)
 
     def collect(self):

@@ -988,7 +988,8 @@ class NS3DDistSolver:
                 replenish_after=self.param.tpu_retry_replenish,
                 recover=recover, transient_budget=budget,
                 coordinator=coord, ckpt_every=ckpt_every,
-                on_ckpt=on_ckpt, family="ns3d_dist")
+                on_ckpt=on_ckpt, family="ns3d_dist",
+                ledger=getattr(self, "_fault_ledger", None))
             publish(state)
         self._emit_exchange_span()
 

@@ -28,15 +28,32 @@ Protocol (one round per chunk boundary):
    divergence, commit a checkpoint on a vote, finish when ALL ranks are
    past te. A rank that is locally done keeps joining the allgather
    (dispatching device no-op chunks) until the merged word says done —
-   the DONE path never leaves a peer blocked in the collective. KNOWN
-   WINDOW: a rank whose dispatch dies BEFORE joining the chunk's device
-   collectives leaves peers waiting inside them, not at the allgather;
-   those peers unblock only when the backend's own collective timeout
-   fires (surfacing as a runtime error this loop re-raises), so the
-   failure is eventually loud, just not immediate. The dead-rank story —
-   a timeout on the boundary allgather itself + elastic-restore onto the
-   survivors — is the ROADMAP item 4 follow-on; this layer ships its
-   building blocks (elastic manifest, shrink hook).
+   the DONE path never leaves a peer blocked in the collective.
+
+DEAD RANKS (PR 12): the boundary allgather is WATCHDOG-TIMED — a rank
+that stops answering (process death, wedged host) no longer hangs its
+peers at the rendezvous until the backend's opaque timeout. The watchdog
+(`tpu_coord_timeout` seconds; the utils/xlacache probe pattern — the
+blocking collect runs on a daemon thread with a hard join timeout) fires
+on every survivor at the same boundary; the survivors then run one
+MEMBERSHIP AGREEMENT round over the surviving set — each submits an
+epoch-tagged word whose dead-rank bitmask is OR-merged — so every
+survivor lands on the identical DEAD verdict and the identical
+incremented shrink epoch, and raises the same structured `RankDeadError`
+naming the lost rank(s). Words are EPOCH-TAGGED (W_EPOCH): a stale
+straggler word from before a shrink can never merge into a post-shrink
+round (apply() aborts on skew). Recovery is the shrink-to-survivors
+resume layer (fleet/scheduler.shrink_resume: re-init on the survivor
+set, rebuild the solver on the shrunk mesh, restore the newest agreed
+elastic generation + the persisted fault ledger). Remaining window: a
+rank that dies INSIDE a chunk's device collectives still waits out the
+backend's own collective timeout before its peers reach the boundary —
+the watchdog owns the HOST-side rendezvous. The verdict + shrink epoch
++ elastic resume chain is tier-1-proven on the LockstepSim virtual-rank
+path (a dead virtual rank simply stops producing words —
+`dead@chunk<N>@rank<R>` / `hang@chunk<N>@rank<R>` clauses); the real
+kill-a-process acceptance case is capability-gated in
+tests/test_multihost.py.
 
 The seam is `models/_driver.drive_chunks(coordinator=...)`: None (the
 single-process default) is the exact historical host loop, and the
@@ -73,11 +90,30 @@ from ..utils import telemetry as _tm
 # the fault word: one int64 per slot, merged elementwise with _MERGE_OPS.
 # W_ROLLBACK_NT proposes the newest ring-captured step count; NO_ROLLBACK
 # (merge-neutral under min) means "nothing to roll back to here".
-W_DONE, W_FAULT, W_FALLBACK, W_DIVERGED, W_ROLLBACK_NT, W_CKPT = range(6)
-WORD_LEN = 6
+# W_EPOCH tags the word with the sender's shrink epoch (uniform by
+# construction — apply() aborts on skew, the stale-straggler guard);
+# W_DEADMASK is the membership round's payload: a bitmask of the ranks
+# this sender observed dead, OR-merged so the survivors' union IS the
+# agreed verdict (ranks 0..62 — the real transport's membership round
+# goes through the coordination-service KV store, not the mask).
+(W_DONE, W_FAULT, W_FALLBACK, W_DIVERGED, W_ROLLBACK_NT, W_CKPT,
+ W_EPOCH, W_DEADMASK) = range(8)
+WORD_LEN = 8
 NO_ROLLBACK = np.int64(2**62)
 
-_MERGE_OPS = (np.min, np.max, np.max, np.max, np.min, np.max)
+
+def _or_reduce(col):
+    return np.bitwise_or.reduce(np.asarray(col, np.int64))
+
+
+_MERGE_OPS = (np.min, np.max, np.max, np.max, np.min, np.max,
+              np.max, _or_reduce)
+
+# the watchdog default: well under the backend collective timeouts
+# (XLA's cross-host barriers sit at 10+ minutes) so a dead rank is agreed
+# at the HOST rendezvous first, and generous enough that a straggler
+# paying a cold compile inside its chunk is never misdeclared dead.
+DEFAULT_WATCHDOG_S = 300.0
 
 
 class CoordinatorAbort(RuntimeError):
@@ -85,6 +121,57 @@ class CoordinatorAbort(RuntimeError):
     exhausted (or a peer hit a fault this rank cannot act on). Raised on
     EVERY rank at the same boundary, so the job dies cleanly instead of
     one rank dying inside a collective with its peers blocked."""
+
+
+class RankDeadError(RuntimeError):
+    """A rank stopped answering the boundary allgather: the watchdog
+    fired and the survivors' membership agreement round produced this —
+    the SAME verdict, on every survivor, at the same boundary. Carries
+    the agreed dead set (`ranks`; empty when the transport could not
+    attribute the timeout to specific ranks), the post-shrink `epoch`,
+    the surviving ranks and the boundary index. The structured recovery
+    is the shrink-to-survivors resume: restore the newest agreed elastic
+    checkpoint generation onto the survivor set
+    (fleet/scheduler.shrink_resume; cli.py catches this exception when
+    `tpu_dead_resume` is armed)."""
+
+    def __init__(self, ranks=(), epoch=None, boundary=None, family="",
+                 survivors=None, reason=""):
+        self.ranks = sorted(int(r) for r in ranks)
+        self.epoch = epoch
+        self.boundary = boundary
+        self.family = family
+        self.survivors = (None if survivors is None
+                          else sorted(int(r) for r in survivors))
+        self.reason = reason
+        super().__init__()
+
+    def __str__(self) -> str:
+        # composed late: drive_coordinated annotates boundary/family
+        # after the transport raised
+        who = (f"rank(s) {self.ranks}" if self.ranks
+               else "unattributed rank(s) (allgather timed out)")
+        return (f"{self.family or 'coordinated run'}: DEAD {who} at "
+                f"boundary {self.boundary} — survivors agreed shrink "
+                f"epoch {self.epoch}"
+                + (f"; {self.reason}" if self.reason else "")
+                + "; resume on the survivor set from the newest elastic "
+                  "checkpoint generation (fleet/scheduler.shrink_resume)")
+
+
+def dead_mask(ranks) -> int:
+    """Encode a dead-rank set as the W_DEADMASK bitmask (ranks 0..62)."""
+    m = 0
+    for r in ranks:
+        if not 0 <= int(r) < 63:
+            raise ValueError(f"W_DEADMASK encodes ranks 0..62, got {r}")
+        m |= 1 << int(r)
+    return m
+
+
+def mask_ranks(mask: int) -> list:
+    """Decode a W_DEADMASK bitmask back to the sorted rank list."""
+    return [r for r in range(63) if (int(mask) >> r) & 1]
 
 
 def blank_word() -> np.ndarray:
@@ -123,19 +210,108 @@ class MultihostCoordinator:
     OS processes at each chunk boundary. The allgather is itself a
     collective — which is exactly why every decision below it must be
     taken identically everywhere, and why locally-done ranks keep
-    joining it until the merged word says done."""
+    joining it until the merged word says done.
 
-    def __init__(self):
+    WATCHDOG (PR 12): the allgather runs on a daemon thread with a hard
+    `timeout` join (0 disables — the pre-watchdog hang-until-backend
+    behavior). On expiry every surviving rank raises RankDeadError at
+    the same boundary; the dead set is attributed best-effort through
+    the coordination-service KV store (each survivor posts an
+    epoch-tagged liveness key and reads its peers' with the same grace
+    window — a rank that never posts is dead). Attribution failing
+    (older jax, no KV client) degrades to an EMPTY dead set with the
+    timeout named in the reason — structured and loud either way, never
+    a wedge. The abandoned allgather thread is a daemon: it dies with
+    the process, exactly the xlacache probe contract."""
+
+    def __init__(self, timeout: float = DEFAULT_WATCHDOG_S):
         import jax
 
         self.nranks = jax.process_count()
         self.rank = jax.process_index()
+        self.timeout = timeout
+        self._round = 0  # agree rounds so far (keys the membership round)
 
     def agree(self, word: np.ndarray) -> np.ndarray:
         from jax.experimental import multihost_utils
 
-        mat = np.asarray(multihost_utils.process_allgather(word))
-        return merge_words(mat)
+        self._round += 1
+        if not self.timeout or self.timeout <= 0:
+            return merge_words(
+                np.asarray(multihost_utils.process_allgather(word)))
+        import threading
+
+        box: dict = {}
+
+        def gather():
+            try:
+                box["mat"] = np.asarray(
+                    multihost_utils.process_allgather(word))
+            except Exception as exc:  # lint: allow(broad-except) — surfaced on the driving thread below
+                box["exc"] = exc
+
+        t = threading.Thread(target=gather, daemon=True,
+                             name=f"pampi-coord-agree-{self._round}")
+        t.start()
+        t.join(self.timeout)
+        if t.is_alive():
+            dead = self._membership_round()
+            survivors = ([r for r in range(self.nranks) if r not in dead]
+                         if dead else None)
+            epoch = int(word[W_EPOCH]) + 1
+            # the flight-recorder `dead` line is emitted by
+            # drive_coordinated's handler, where boundary/family are
+            # known — one record shape for both transports
+            raise RankDeadError(
+                ranks=dead or (), epoch=epoch, survivors=survivors,
+                reason=(f"boundary allgather exceeded the "
+                        f"{self.timeout:g}s watchdog"),
+            )
+        if "exc" in box:
+            raise box["exc"]
+        return merge_words(box["mat"])
+
+    def _membership_round(self) -> list:
+        """Best-effort dead-set attribution over the jax coordination
+        service's KV store: post my liveness key for this round, then
+        blocking-read every rank's against ONE shared deadline a
+        watchdog window and a half out — a rank that never posts is
+        dead. The watchdog is the documented bound on an honest rank's
+        lag (`tpu_coord_timeout` must exceed the slowest honest chunk),
+        so survivors enter this round at most one window apart; the
+        extra half window is the margin for KV round-trips and
+        scheduling latency, without which a rank arriving exactly one
+        window late would post AT the deadline and be misdeclared. The
+        verdict is still BEST-EFFORT — a rank slower than the knob it
+        was configured with can be misdeclared, which is the knob's
+        documented contract, and the cross-process resume stays
+        operator-driven (cli.py prints the walkthrough; nothing
+        auto-resumes on a possibly-split verdict). Returns [] when the
+        KV client is unreachable on this jax."""
+        import time
+
+        try:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+            if client is None:
+                return []
+            prefix = f"pampi_coord/alive/round{self._round}"
+            client.key_value_set(f"{prefix}/r{self.rank}", "1")
+            # one deadline for the WHOLE read set: N dead ranks must not
+            # cost N grace windows (each get consumes remaining budget)
+            deadline = time.monotonic() + 1.5 * max(self.timeout, 1.0)
+            dead = []
+            for r in range(self.nranks):
+                left_ms = max(1, int((deadline - time.monotonic()) * 1e3))
+                try:
+                    client.blocking_key_value_get(
+                        f"{prefix}/r{r}", left_ms)
+                except Exception:  # lint: allow(broad-except) — a missing key IS the verdict; any get failure reads as dead
+                    dead.append(r)
+            return dead
+        except Exception:  # lint: allow(broad-except) — attribution is best-effort; the structured RankDeadError fires regardless
+            return []
 
 
 class CoordinatedLoop:
@@ -156,7 +332,8 @@ class CoordinatedLoop:
     def __init__(self, state, chunk_fn, te, time_index, bar, retry,
                  on_state=None, replenish_after: int = 8, recover=None,
                  transient_budget: int = 1, rank: int = 0,
-                 ckpt_every: int = 0, on_ckpt=None, family: str = ""):
+                 ckpt_every: int = 0, on_ckpt=None, family: str = "",
+                 watchdog: float = DEFAULT_WATCHDOG_S, ledger=None):
         self.chunk_fn = chunk_fn
         self.te = te
         self.time_index = time_index
@@ -169,6 +346,7 @@ class CoordinatedLoop:
         self.ckpt_every = max(0, int(ckpt_every))
         self.on_ckpt = on_ckpt
         self.family = family
+        self.watchdog = watchdog
         self.on_final = None  # optional publish-back hook (LockstepSim)
         self.final = None
 
@@ -177,6 +355,14 @@ class CoordinatedLoop:
         self._t_pending = None
         self._budget = max(0, int(transient_budget))
         self._max_budget = self._budget
+        # the restored fault ledger (utils/checkpoint elastic manifest):
+        # a resumed fleet starts with the SPENT budget and the shrink
+        # epoch it died with, rank-symmetrically — every rank read the
+        # same manifest
+        ledger = ledger or {}
+        self.epoch = int(ledger.get("epoch", 0))
+        spent = max(0, int(ledger.get("budget_spent", 0)))
+        self._budget = max(0, self._budget - spent)
         self._clean = 0
         self._boundary = 0  # agreed boundaries so far (rounds of agree)
         self._confirms = 0  # confirmed (clean) chunks — the ckpt cadence
@@ -190,6 +376,7 @@ class CoordinatedLoop:
         report the local observation. Never acts — every action waits
         for the merged word."""
         w = blank_word()
+        w[W_EPOCH] = self.epoch
         self._local_exc = None
         self._took_fallback = False
         if self.final is not None or self._local_done:
@@ -243,6 +430,15 @@ class CoordinatedLoop:
     def apply(self, merged: np.ndarray) -> None:
         if self.final is not None:
             return
+        if int(merged[W_EPOCH]) != self.epoch:
+            # a stale word from before a shrink leaked into this round —
+            # the merge is undefined across epochs, so die loudly rather
+            # than act on a verdict half the fleet never saw
+            raise CoordinatorAbort(
+                f"{self.family}: epoch skew in the merged fault word "
+                f"(merged epoch {int(merged[W_EPOCH])}, this rank's "
+                f"epoch {self.epoch}) at boundary {self._boundary}"
+            )
         self._boundary += 1
         if merged[W_FALLBACK]:
             self._apply_fallback()
@@ -365,7 +561,13 @@ class CoordinatedLoop:
                 self.on_state(self._confirmed)
             if merged[W_CKPT] and self.on_ckpt is not None:
                 self._emit("ckpt", t=self._t_pending)
-                self.on_ckpt(self._confirmed)
+                if getattr(self.on_ckpt, "takes_ledger", False):
+                    # the coordinated writer persists the fault ledger
+                    # into the elastic manifest alongside the fields
+                    # (models/_driver.coord_ckpt_cadence marks itself)
+                    self.on_ckpt(self._confirmed, ledger=self.ledger())
+                else:
+                    self.on_ckpt(self._confirmed)
             if self._t_pending > self.te:
                 self._local_done = True
         if merged[W_DONE]:
@@ -378,22 +580,78 @@ class CoordinatedLoop:
         if self.on_final is not None:
             self.on_final(self.final)
 
+    def ledger(self) -> dict:
+        """The FAULT LEDGER: the protocol state a restarted/shrunk fleet
+        must not forget — spent global transient budget, the pallas
+        probation verdict (a deterministically-broken kernel stays
+        broken across a restart), the divergence-recovery attempts +
+        cumulative dt clamp, and the shrink epoch. Persisted into the
+        elastic manifest at every agreed checkpoint commit
+        (utils/checkpoint.save_elastic) and restored rank-symmetrically
+        by load_elastic — every rank reads the same manifest, so the
+        restored state can never skew."""
+        led = {
+            "budget_spent": int(self._max_budget - self._budget),
+            "epoch": int(self.epoch),
+        }
+        pallas_ledger = getattr(self.retry, "ledger", None)
+        if pallas_ledger is not None:
+            led["pallas"] = pallas_ledger()
+        if self.recover is not None:
+            led["recover_attempts"] = int(self.recover._attempts)
+            led["dt_scale"] = float(
+                getattr(self.recover.solver, "_dt_scale", 1.0))
+        return led
+
 
 def drive_coordinated(state, chunk_fn, te, time_index, bar, retry,
                       coordinator, on_state=None, replenish_after: int = 8,
                       recover=None, transient_budget: int = 1,
-                      ckpt_every: int = 0, on_ckpt=None, family: str = ""):
+                      ckpt_every: int = 0, on_ckpt=None, family: str = "",
+                      ledger=None):
     """The coordinated drive loop: one CoordinatedLoop per rank, one
     `agree` round per chunk boundary. Entered through
-    `models/_driver.drive_chunks(coordinator=...)`."""
+    `models/_driver.drive_chunks(coordinator=...)`. A RankDeadError from
+    the transport's watchdog is annotated with this loop's boundary and
+    family, the progress bar stopped, and re-raised — the resume layer
+    (cli.py / fleet.scheduler.shrink_resume) owns what happens next."""
     loop = CoordinatedLoop(
         state, chunk_fn, te, time_index, bar, retry, on_state=on_state,
         replenish_after=replenish_after, recover=recover,
         transient_budget=transient_budget, rank=coordinator.rank,
         ckpt_every=ckpt_every, on_ckpt=on_ckpt, family=family,
+        watchdog=getattr(coordinator, "timeout", DEFAULT_WATCHDOG_S),
+        ledger=ledger,
     )
     while loop.final is None:
-        loop.apply(coordinator.agree(loop.local_word()))
+        try:
+            merged = coordinator.agree(loop.local_word())
+        except RankDeadError as exc:
+            if exc.boundary is None:
+                exc.boundary = loop._boundary
+            if not exc.family:
+                exc.family = family
+            # the transport raises bare (it knows neither boundary nor
+            # family); the flight-recorder line lands here so both
+            # transports' `dead` records carry the same fields
+            _tm.emit("dead", ranks=exc.ranks or None, epoch=exc.epoch,
+                     boundary=exc.boundary, family=exc.family,
+                     nranks=coordinator.nranks,
+                     watchdog_s=getattr(coordinator, "timeout", None))
+            if exc.survivors is not None:
+                _tm.emit("epoch", epoch=exc.epoch,
+                         nranks=len(exc.survivors),
+                         survivors=exc.survivors)
+            if bar is not None:
+                bar.stop()
+            raise
+        loop.apply(merged)
+    stash = getattr(on_ckpt, "stash_ledger", None)
+    if stash is not None:
+        # the agreed-done ledger survives even when the run finished
+        # before the first cadence commit: the cli's end-of-run elastic
+        # write reads it back via save_elastic's _fault_ledger fallback
+        stash(loop.ledger())
     return loop.final
 
 
@@ -404,19 +662,119 @@ class LockstepSim:
     replica, built under `faultinject.rank_scope(r)` so rank-targeted
     clauses arm only their target) — the collective coupling of a real
     mesh is replaced by the replicas' determinism, which is exactly what
-    lets the agree-then-act logic be proven on one CPU."""
+    lets the agree-then-act logic be proven on one CPU.
 
-    def __init__(self, loops):
+    DEAD RANKS: each rank's word is collected under the WATCHDOG — the
+    dispatch runs on a daemon thread with a hard join timeout (ranks
+    stay SEQUENTIAL: the virtual-rank fault counters are process
+    globals, and determinism is the whole point). A rank that raises
+    InjectedRankDeath (`dead@chunk<N>@rank<R>`) or overruns the window
+    (`hang@chunk<N>@rank<R>`) produces no word; the survivors then run
+    the membership agreement round — the same epoch-tagged OR-merge the
+    word protocol uses — and every survivor raises the identical
+    RankDeadError. This is the tier-1 proof of the dead-rank protocol;
+    the abandoned hung thread is a daemon and dies with the process."""
+
+    def __init__(self, loops, watchdog: float | None = None):
         self.loops = list(loops)
+        # None: take the per-loop watchdog (sim_rank_loop wires it from
+        # the .par tpu_coord_timeout key)
+        self.watchdog = watchdog
+
+    def _window(self) -> float:
+        if self.watchdog is not None:
+            return self.watchdog
+        return getattr(self.loops[0], "watchdog", DEFAULT_WATCHDOG_S)
+
+    def _collect_word(self, loop):
+        """One rank's local_word under the watchdog; None = this rank is
+        dead (stopped answering or overran the window). Any other
+        exception re-raises on the driving thread — the historical
+        propagate-loudly contract."""
+        import threading
+
+        box: dict = {}
+
+        def work():
+            try:
+                box["word"] = loop.local_word()
+            except _fi.InjectedRankDeath:
+                box["dead"] = True
+            except BaseException as exc:  # lint: allow(broad-except) — ferried to the driving thread and re-raised there
+                box["exc"] = exc
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"pampi-sim-rank{loop.rank}")
+        t.start()
+        window = self._window()
+        t.join(window if window and window > 0 else None)
+        if t.is_alive() or box.get("dead"):
+            return None
+        if "exc" in box:
+            raise box["exc"]
+        return box["word"]
+
+    def _declare_dead(self, dead_ranks, survivors):
+        """The membership agreement round: every survivor submits an
+        epoch-tagged word carrying its observed dead-rank bitmask; the
+        OR-merge is the agreed verdict, the incremented epoch the agreed
+        shrink — then every survivor raises the same RankDeadError."""
+        if not survivors:
+            # total fleet loss (an untargeted dead clause): nothing left
+            # to agree with — one structured error instead of a merge of
+            # zero words. Hung sleepers still unwind NOW: an abandoned
+            # hang thread exiting its rank_scope later would restore the
+            # ambient-rank global mid-way through the next test's builds
+            _fi.cancel_hangs()
+            raise RankDeadError(
+                ranks=dead_ranks, epoch=self.loops[0].epoch + 1,
+                survivors=[], reason="no survivors")
+        words = []
+        for loop in survivors:
+            w = blank_word()
+            w[W_EPOCH] = loop.epoch
+            w[W_DEADMASK] = dead_mask(dead_ranks)
+            words.append(w)
+        merged = merge_words(np.stack(words))
+        ranks = mask_ranks(int(merged[W_DEADMASK]))
+        epoch = int(merged[W_EPOCH]) + 1
+        boundary = survivors[0]._boundary if survivors else None
+        _fi.cancel_hangs()  # the verdict is in; hung sleepers may unwind
+        for loop in survivors:
+            loop.epoch = epoch
+            if loop.bar is not None:
+                loop.bar.stop()
+        _tm.emit("dead", ranks=ranks, epoch=epoch,
+                 boundary=boundary, nranks=len(self.loops),
+                 watchdog_s=self._window(),
+                 family=survivors[0].family if survivors else "")
+        _tm.emit("epoch", epoch=epoch, nranks=len(survivors),
+                 survivors=[loop.rank for loop in survivors])
+        raise RankDeadError(
+            ranks=ranks, epoch=epoch, boundary=boundary,
+            family=survivors[0].family if survivors else "",
+            survivors=[loop.rank for loop in survivors],
+        )
 
     def run(self) -> list:
         """Drive all ranks to agreement-confirmed completion; returns
         the per-rank final states. A CoordinatorAbort (or an unhandled
-        fault) on any rank propagates — the job dies, it never hangs."""
+        fault) on any rank propagates — the job dies, it never hangs;
+        a dead/hung rank raises RankDeadError on the survivors within
+        one watchdog window per rank."""
         while any(loop.final is None for loop in self.loops):
-            merged = merge_words(
-                np.stack([loop.local_word() for loop in self.loops])
-            )
+            words, dead = [], []
+            for loop in self.loops:
+                w = self._collect_word(loop)
+                if w is None:
+                    dead.append(loop.rank)
+                else:
+                    words.append(w)
+            if dead:
+                self._declare_dead(
+                    dead,
+                    [lp for lp in self.loops if lp.rank not in dead])
+            merged = merge_words(np.stack(words))
             for loop in self.loops:
                 loop.apply(merged)
         return [loop.final for loop in self.loops]
@@ -465,6 +823,9 @@ def sim_rank_loop(solver, family: str, time_index: int, rank: int,
         replenish_after=replenish_after, recover=recover,
         transient_budget=transient_budget, rank=rank,
         ckpt_every=ckpt_every, on_ckpt=on_ckpt, family=family,
+        watchdog=getattr(solver.param, "tpu_coord_timeout",
+                         DEFAULT_WATCHDOG_S),
+        ledger=getattr(solver, "_fault_ledger", None),
     )
     loop.on_final = publish
     return loop
@@ -497,8 +858,10 @@ def make_coordinator(param, family: str):
     mode = _dispatch.resolve_coord(param, f"coord_{family}")
     if mode == "none":
         return None
-    coord = (MultihostCoordinator() if mode == "multihost"
-             else SoloCoordinator())
+    coord = (MultihostCoordinator(
+                 timeout=getattr(param, "tpu_coord_timeout",
+                                 DEFAULT_WATCHDOG_S))
+             if mode == "multihost" else SoloCoordinator())
     _tm.emit("coord", event="armed", family=family, mode=mode,
              nranks=coord.nranks, rank=coord.rank)
     return coord

@@ -39,7 +39,17 @@ assembled global, and a torn/corrupt/missing piece falls back to the
 its manifest's is a MIXED-GENERATION set (the crash window between a
 shard write and the manifest commit, or a mangled restore-from-backup)
 and is refused — never silently combined. `tools/ckpt_fsck.py` verifies
-a checkpoint offline.
+a checkpoint offline (`--survivors N` additionally checks the set is
+restorable onto an N-rank survivor mesh: full shard coverage + the
+fault ledger present).
+
+Fault ledger (PR 12): under an armed coordinator the manifest also
+carries the fleet's protocol state (`ledger` key — spent global
+transient budget, pallas deterministically-broken verdict, recovery
+attempts + cumulative dt clamp, shrink epoch), written at every agreed
+checkpoint commit and restored rank-symmetrically by `load_elastic`
+(`_restore_ledger`): a restarted or shrunk-to-survivors fleet keeps a
+pre-death broken-kernel verdict instead of re-entering probation.
 
 .par keys (framework-only):
   tpu_checkpoint        path to write (every tpu_ckpt_every syncs +
@@ -99,7 +109,10 @@ def _crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
-def save_checkpoint(path: str, solver) -> None:
+def save_checkpoint(path: str, solver, ledger=None) -> None:
+    # `ledger` is accepted for writer_for signature parity only: the
+    # fault ledger is an elastic-manifest feature (save_elastic) — the
+    # legacy mesh-locked .npz never carried protocol state
     from ..parallel.comm import CartComm
 
     # CartComm.collect is a plain device_get when fully addressable and a
@@ -408,13 +421,22 @@ def _manifest_generation(path: str) -> int:
     return 0
 
 
-def save_elastic(path: str, solver) -> None:
+def save_elastic(path: str, solver, ledger=None) -> None:
     """Write the elastic checkpoint set: every rank writes its row slab
     of the MESH-INDEPENDENT assembled global fields to its own shard
     file (generation-named), rank 0 commits the manifest last. Refuses
     non-finite states like save_checkpoint; shard writes take the same
     torn/corrupt fault injection (`ckpt_torn@write<N>` /
-    `ckpt_corrupt@write<N>`)."""
+    `ckpt_corrupt@write<N>`).
+
+    `ledger` (PR 12) is the coordinator's FAULT LEDGER (parallel/
+    coordinator.CoordinatedLoop.ledger): spent global transient budget,
+    the pallas deterministically-broken verdict, rollback attempts +
+    cumulative dt clamp, shrink epoch. It rides in the manifest so a
+    restarted or shrunk-to-survivors fleet resumes with the protocol
+    state it died with instead of probation amnesia. None falls back to
+    the ledger the solver itself was restored with (`_fault_ledger`) —
+    a save on an already-resumed run re-persists its inherited state."""
     import jax
 
     from ..parallel import multihost
@@ -475,6 +497,12 @@ def save_elastic(path: str, solver) -> None:
         ],
         "crc": {f: int(_crc(a)) for f, a in fields.items()},
     }
+    if ledger is None:
+        ledger = getattr(solver, "_fault_ledger", None)
+    if ledger is not None:
+        manifest["ledger"] = ledger
+        _tm.emit("ckpt", event="ledger_save", path=path, generation=gen,
+                 ledger=ledger)
     rotated = os.path.exists(path)
     if rotated:
         try:
@@ -579,7 +607,37 @@ def _load_elastic_set(path: str, solver) -> int:
     solver.set_global_fields(out)
     solver.t = float(man["t"])
     solver.nt = int(man["nt"])
+    solver._elastic_generation = gen
+    _restore_ledger(path, man.get("ledger"), solver)
     return gen
+
+
+def _restore_ledger(path: str, ledger, solver) -> None:
+    """Apply a manifest's fault ledger to the freshly-restored solver,
+    rank-symmetrically (every rank read the same manifest): re-apply the
+    cumulative recovery dt clamp, and hold a pallas kernel the dead
+    fleet had judged deterministically broken ON THE JNP PATH — the
+    no-probation-amnesia contract. Either change re-traces the chunk via
+    the solver's own rebuild hook; the ledger itself is stashed at
+    `_fault_ledger`, where `pallas_retry`/`make_recovery`/the
+    coordinated loop pick up the rest (spent budget, attempts, epoch).
+    Legacy manifests (no ledger) stash None — the historical restore."""
+    solver._fault_ledger = ledger
+    if not ledger:
+        return
+    rebuild = False
+    dt_scale = float(ledger.get("dt_scale", 1.0))
+    if dt_scale != getattr(solver, "_dt_scale", 1.0):
+        solver._dt_scale = dt_scale
+        rebuild = True
+    pallas = ledger.get("pallas") or {}
+    if pallas.get("broken") and getattr(solver, "_backend", "jnp") != "jnp":
+        solver._backend = "jnp"
+        rebuild = True
+    if rebuild and hasattr(solver, "_rebuild_chunk"):
+        solver._rebuild_chunk()
+    _tm.emit("ckpt", event="ledger_restore", path=path, ledger=ledger,
+             rebuilt=rebuild)
 
 
 def load_elastic(path: str, solver, fallback: bool = True) -> None:

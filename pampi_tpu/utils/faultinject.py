@@ -23,6 +23,22 @@ Spec grammar — comma-separated clauses, each
                           divergence sentinel end-to-end)
   inf@step<N>:<field>     same, +inf
 
+  dead@chunk<N>           the rank STOPS ANSWERING at its Nth chunk
+                          dispatch (raises InjectedRankDeath before the
+                          dispatch): under a coordinator the peers see a
+                          missing fault word — the watchdog + membership
+                          agreement round (parallel/coordinator.py) must
+                          turn it into a structured RankDeadError instead
+                          of a hang. Usually rank-targeted
+                          (`dead@chunk<N>@rank<R>`); untargeted it kills
+                          every rank.
+  hang@chunk<N>           the rank SLEEPS past the watchdog at its Nth
+                          dispatch (PAMPI_FAULT_HANG_S seconds, default
+                          30 — set it above tpu_coord_timeout), then
+                          dies: the mid-dispatch death shape, where a
+                          peer is left waiting on the agreement round
+                          rather than told. Same @rank targeting.
+
 Chunk and step clauses take an optional `@rank<R>` suffix (PR 10): the
 clause fires only on rank R — `jax.process_index()` under a real
 multi-process launch, or the ambient virtual rank inside a
@@ -74,6 +90,8 @@ _FIELDS = ("u", "v", "w", "p")
 _KIND_SITE = {
     "pallas": ("chunk",),
     "transient": ("chunk",),
+    "dead": ("chunk",),
+    "hang": ("chunk",),
     "nan": ("step", "lane"),
     "inf": ("step", "lane"),
     "ckpt_torn": ("write",),
@@ -111,6 +129,16 @@ class JaxRuntimeError(Exception):
     real transient's retry path without touching jax internals."""
 
 
+class InjectedRankDeath(BaseException):
+    """Forged rank death (`dead@chunk<N>` / `hang@chunk<N>`): the rank
+    stops producing fault words. Deliberately NOT an Exception: the drive
+    loops' fault-classification funnels catch Exception, and a death must
+    never be classified as a transient or a pallas fault — it either
+    surfaces to the lockstep simulation's watchdog collector (which turns
+    it into the survivors' membership round) or kills an uncoordinated
+    run loudly."""
+
+
 class CheckpointWriteCrash(RuntimeError):
     """Forged process crash mid-checkpoint-write (`ckpt_torn@write<N>`):
     raised after garbage bytes went into the `.tmp`, before the atomic
@@ -124,6 +152,25 @@ _counters: dict[tuple, int] = {}
 _charges: dict[int, int] = {}
 _cache: tuple[str, tuple] | None = None
 _rank_override: int | None = None  # ambient virtual rank (rank_scope)
+_hang_cancel = None  # threading.Event, created on first hang (cancel_hangs)
+
+
+def _hang_event():
+    global _hang_cancel
+    if _hang_cancel is None:
+        import threading
+
+        _hang_cancel = threading.Event()
+    return _hang_cancel
+
+
+def cancel_hangs() -> None:
+    """Wake every in-flight `hang@chunk<N>` sleeper NOW (it still dies —
+    the sleep just ends early). Called by the lockstep simulation once
+    the membership round has its verdict, so the abandoned hung thread
+    unwinds its rank_scope promptly instead of holding the ambient-rank
+    global across the next test's solver builds."""
+    _hang_event().set()
 
 
 def current_rank() -> int:
@@ -170,12 +217,29 @@ def enabled() -> bool:
                                "(test-only)"))
 
 
+def hang_seconds() -> float:
+    """How long a `hang@chunk<N>` clause sleeps before dying (seconds).
+    Must exceed the watchdog under test (tpu_coord_timeout) — the default
+    30 covers the test-sized windows; a production-timeout exercise sets
+    PAMPI_FAULT_HANG_S above its tpu_coord_timeout."""
+    from . import flags as _flags
+
+    try:
+        return float(_flags.env("PAMPI_FAULT_HANG_S", "30",
+                                doc="injected-hang sleep, seconds "
+                                    "(pair with dead/hang clauses)"))
+    except ValueError:
+        return 30.0
+
+
 def reset() -> None:
     """Re-arm every clause and zero the trigger counters (tests)."""
     global _cache
     _counters.clear()
     _charges.clear()
     _cache = None
+    if _hang_cancel is not None:
+        _hang_cancel.clear()
 
 
 def _clauses() -> tuple:
@@ -196,7 +260,8 @@ def _clauses() -> tuple:
         if m is None or m["site"] not in _KIND_SITE.get(m["kind"], ()):
             raise FaultSpecError(
                 f"bad PAMPI_FAULTS clause {raw!r}; grammar: "
-                "pallas@chunk<N> | transient@chunk<N> | nan@step<N>:<field> "
+                "pallas@chunk<N> | transient@chunk<N> | dead@chunk<N> | "
+                "hang@chunk<N> | nan@step<N>:<field> "
                 "| inf@step<N>:<field> | nan@lane<K>:<field> | "
                 "inf@lane<K>:<field> | ckpt_torn@write<N> | "
                 "ckpt_corrupt@write<N> | telemetry@emit<N>  (comma-separated;"
@@ -258,6 +323,20 @@ def maybe_chunk_fault() -> None:
             raise InjectedPallasError(
                 f"PAMPI_FAULTS: injected pallas runtime failure at chunk "
                 f"dispatch {n}"
+            )
+        if kind == "dead":
+            raise InjectedRankDeath(
+                f"PAMPI_FAULTS: rank {current_rank()} injected dead at "
+                f"chunk dispatch {n} (stops answering)"
+            )
+        if kind == "hang":
+            # a cancellable sleep, then death: the watchdog (not this
+            # sleep ending) is what declares the rank dead — cancel only
+            # bounds how long the abandoned daemon thread lingers
+            _hang_event().wait(hang_seconds())
+            raise InjectedRankDeath(
+                f"PAMPI_FAULTS: rank {current_rank()} injected hang at "
+                f"chunk dispatch {n} (slept past the watchdog)"
             )
         raise JaxRuntimeError(
             f"UNAVAILABLE: PAMPI_FAULTS injected transient device fault at "

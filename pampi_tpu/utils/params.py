@@ -234,6 +234,27 @@ class Parameter:
     # protocol-path proof shape), off = the historical uncoordinated
     # loop (multi-process faults kill the job cleanly).
     tpu_coord: str = "auto"
+    # boundary-allgather watchdog (parallel/coordinator.py, PR 12):
+    # seconds a rank waits at the chunk-boundary rendezvous before the
+    # survivors declare the silent rank(s) DEAD via the membership
+    # agreement round and raise RankDeadError. Keep it well UNDER the
+    # backend's own collective timeout (XLA cross-host barriers default
+    # to 10+ minutes) so the host-side rendezvous is where a death
+    # surfaces, and above the slowest honest chunk (a cold compile
+    # inside a dispatch must not read as a death). 0 disables (the
+    # pre-PR-12 hang-until-backend behavior).
+    tpu_coord_timeout: float = 300.0
+    # shrink-to-survivors resume (cli.py / fleet/scheduler.shrink_resume):
+    # 1 (default) = on RankDeadError, when an elastic checkpoint is
+    # armed, restore the newest agreed generation (+ fault ledger) onto
+    # the surviving capacity and finish the run degraded; 0 = surface
+    # the structured error and stop (operator-driven resume). The
+    # in-process resume covers the single-process shapes (one host
+    # owning local devices; the lockstep proof path) — under a real
+    # multi-process launch the survivors PRINT the relaunch walkthrough
+    # instead (an in-place process-group shrink would need a re-elected
+    # coordinator; see cli._resume_after_death).
+    tpu_dead_resume: int = 1
     # divergence rollback-recovery (models/_driver.RingRecovery; README
     # "Robustness"): tpu_recover_ring > 0 arms an in-memory ring of the
     # last-K confirmed finite chunk states (no disk round-trip on the hot
@@ -296,18 +317,24 @@ def read_parameter(path: str, base: Parameter | None = None) -> Parameter:
                 continue
             tok, val = kv
             # reference semantics: every known key whose name is a prefix of the
-            # token gets assigned (independent `if`s, not elif)
-            for key, ftype in _FIELDS.items():
-                if tok.startswith(key):
-                    cast = _CASTS[ftype if isinstance(ftype, str) else ftype.__name__]
-                    try:
-                        setattr(param, key, cast(val))
-                        seen.add(key)
-                    except ValueError:
-                        print(
-                            f"bad value {val!r} for parameter {key}", file=sys.stderr
-                        )
-                        raise SystemExit(1)
+            # token gets assigned (independent `if`s, not elif) — EXCEPT an
+            # exact key name, which assigns only itself: the framework keys
+            # are namespaced (tpu_coord / tpu_coord_timeout) where the
+            # reference's key set is prefix-free, so without exact-wins the
+            # longer key's line would clobber the shorter key too
+            keys = ([tok] if tok in _FIELDS
+                    else [k for k in _FIELDS if tok.startswith(k)])
+            for key in keys:
+                ftype = _FIELDS[key]
+                cast = _CASTS[ftype if isinstance(ftype, str) else ftype.__name__]
+                try:
+                    setattr(param, key, cast(val))
+                    seen.add(key)
+                except ValueError:
+                    print(
+                        f"bad value {val!r} for parameter {key}", file=sys.stderr
+                    )
+                    raise SystemExit(1)
     param.seen_keys = tuple(sorted(seen))
     return param
 

@@ -43,6 +43,17 @@ Host plane — every record is one JSON line appended to the
   warning     a structured degradation notice from a subsystem that
               proceeded anyway (component + reason — e.g. utils/xlacache
               probing its cache dir unreachable and running uncached)
+  dead        the boundary watchdog fired and the survivors' membership
+              agreement round declared rank(s) DEAD (parallel/
+              coordinator.py): agreed ranks, post-shrink epoch,
+              boundary, watchdog window
+  epoch       a shrink-epoch transition: the agreed new epoch plus the
+              surviving rank set — the membership history of the run
+  shrink      a shrink-to-survivors elastic resume committed
+              (fleet/scheduler.shrink_resume): survivor capacity,
+              restored generation, the dead set it recovers from
+  ckpt (+v6)  the elastic events grow ledger_save / ledger_restore —
+              the coordinator fault ledger riding the manifest
   solve       a driver-level Poisson solve (iters, residual, wall)
   halo        static per-shard halo-exchange byte counts (dist solvers)
   span        a named timing span — the ONE decomposition protocol the
@@ -79,10 +90,12 @@ import os
 import time
 import warnings
 
-SCHEMA_VERSION = 5  # v5: + coord record kind (chunk-boundary agreement
-#                     decisions), elastic ckpt events (elastic_save /
-#                     elastic_load), warning record kind
-#                     (v4, PR 9: + fleet record kind, scenario dimension;
+SCHEMA_VERSION = 6  # v6: + dead / epoch / shrink record kinds (the
+#                     dead-rank survival plane, PR 12) and the ckpt
+#                     ledger_save / ledger_restore events
+#                     (v5, PR 10: + coord record kind, elastic ckpt
+#                      events, warning record kind;
+#                      v4, PR 9: + fleet record kind, scenario dimension;
 #                      v3, PR 7: + xprof record kind, drop accounting;
 #                      v2, PR 4: + recover / retry / ckpt record kinds)
 

@@ -599,6 +599,46 @@ def test_ckpt_fsck_tool_verdicts(tmp_path):
     assert "CORRUPT" in r.stdout
 
 
+def test_ckpt_fsck_survivors_check(tmp_path):
+    """PR 12: `--survivors N` is the shrink-resume pre-flight — a
+    healthy elastic set WITH a fault ledger passes; a set written
+    without an armed coordinator (no ledger) fails naming the amnesia
+    risk; the mesh-locked legacy format is refused outright."""
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "ckpt_fsck.py")
+    s = NS2DSolver(_param(te=0.05))
+    s.run(progress=False)
+
+    with_ledger = str(tmp_path / "led.elastic")
+    ckpt.save_elastic(with_ledger, s,
+                      ledger={"budget_spent": 0, "epoch": 0})
+    r = subprocess.run([_sys.executable, tool, "--survivors", "1",
+                        with_ledger], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "survivors 1: ok" in r.stdout
+
+    bare = str(tmp_path / "bare.elastic")
+    ckpt.save_elastic(bare, s)
+    r = subprocess.run([_sys.executable, tool, "--survivors", "2", bare],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "no fault ledger" in r.stdout
+    # ...and without the flag the same set still verifies clean
+    r = subprocess.run([_sys.executable, tool, bare],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    legacy = str(tmp_path / "l.npz")
+    ckpt.save_checkpoint(legacy, s)
+    r = subprocess.run([_sys.executable, tool, "--survivors", "1",
+                        legacy], capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "mesh-locked" in r.stdout
+
+
 def test_ring_recovery_cold_tier_reads_elastic(tmp_path):
     """Review regression: the divergence rollback's COLD tier must read
     whichever format tpu_checkpoint writes — with the ring exhausted and

@@ -334,6 +334,314 @@ def test_coord_records_render_and_lint(tel_on):
         {**summ, "warnings": [{"reason": "no component"}]}, where)
 
 
+def test_membership_records_render_and_lint(tel_on):
+    """Schema v6: the dead/epoch/shrink kinds and the ckpt ledger events
+    render in the coord section's membership subsection, summarize into
+    coord.membership, and lint clean — while a legacy (pre-v6) summary
+    without the membership key still passes, and a gutted membership
+    block is flagged."""
+    tm.emit("coord", event="armed", family="ns2d_dist", mode="multihost",
+            nranks=2, rank=0)
+    tm.emit("dead", ranks=[1], epoch=1, boundary=5, nranks=2,
+            watchdog_s=5.0, family="ns2d_dist")
+    tm.emit("epoch", epoch=1, nranks=1, survivors=[0])
+    tm.emit("shrink", family="ns2d_dist", path="ck", survivors=1,
+            generation=3, dead=[1], epoch=1, t=0.5, nt=10)
+    tm.emit("ckpt", event="ledger_save", path="ck", generation=3,
+            ledger={"budget_spent": 1, "epoch": 0})
+    tm.emit("ckpt", event="ledger_restore", path="ck", rebuilt=True,
+            ledger={"budget_spent": 1, "epoch": 0})
+
+    from tools import check_artifact as ca
+    from tools import telemetry_report as tr
+
+    recs = _records(tel_on)
+    assert recs[0]["v"] == tm.SCHEMA_VERSION == 6
+    text = tr.render(recs)
+    for needle in ("membership (dead ranks / shrink epochs)",
+                   "DEAD rank(s) [1]", "epoch 1: 1 survivor(s) [0]",
+                   "shrink-resume [ns2d_dist] on 1 device(s) from "
+                   "generation 3", "ledger_save", "ledger_restore"):
+        assert needle in text, needle
+    summ = tr.summary(recs)
+    mem = summ["coord"]["membership"]
+    assert mem["dead"][0]["ranks"] == [1]
+    assert mem["epochs"][0]["survivors"] == [0]
+    assert mem["shrinks"][0]["generation"] == 3
+    assert summ["ckpt"]["ledger_save"] == 1
+    assert summ["ckpt"]["ledger_restore"] == 1
+    where = "BENCH.telemetry_summary"
+    assert ca.lint_telemetry_summary(summ, where) == []
+    # legacy summaries (no membership subsection) still pass
+    legacy = {**summ, "coord": {"nranks": 2, "decisions": {"retry": 1}}}
+    assert ca.lint_telemetry_summary(legacy, where) == []
+    # gutted membership blocks are FLAGGED, not waved through
+    for gutted in ("zap", {"dead": [{"no_ranks": 1}]},
+                   {"epochs": "zap"}):
+        bad = {**summ, "coord": {**summ["coord"], "membership": gutted}}
+        assert ca.lint_telemetry_summary(bad, where), gutted
+
+
+# ---------------------------------------------------------------------------
+# PR 12: the dead-rank matrix — watchdog, membership agreement, shrink
+# epoch, elastic shrink-resume, ledger persistence
+# ---------------------------------------------------------------------------
+
+def _warm(solvers):
+    """Pre-compile each replica's chunk (one discarded functional call)
+    so a small watchdog window judges DISPATCHES, not first-call
+    compiles."""
+    for s in solvers:
+        out = s._chunk_fn(*s.initial_state())
+        float(out[3])
+
+
+def test_dead_rank_at_boundary_is_structured(faults, tel_on):
+    """A rank that stops answering (dead@chunk3@rank1) is agreed DEAD by
+    the survivor within one watchdog window: the same RankDeadError
+    names the rank, the survivor set and the incremented shrink epoch,
+    and the verdict is a flight-recorder `dead` + `epoch` pair — never a
+    hang, never an anonymous timeout."""
+    faults("dead@chunk3@rank1")
+    _solvers, loops = _fleet(2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(co.RankDeadError, match=r"DEAD rank\(s\) \[1\]"):
+            co.LockstepSim(loops).run()
+    dead = _records(tel_on, "dead")
+    assert len(dead) == 1
+    assert dead[0]["ranks"] == [1] and dead[0]["epoch"] == 1
+    epochs = _records(tel_on, "epoch")
+    assert len(epochs) == 1
+    assert epochs[0]["survivors"] == [0] and epochs[0]["nranks"] == 1
+
+
+def test_hang_past_watchdog_is_dead(faults, tel_on, monkeypatch):
+    """Mid-dispatch death via hang: the rank never raises — it just
+    stops coming back — and ONLY the watchdog can tell. With the hang
+    armed past the window, the survivor's collection round times out on
+    rank 1 and the membership round declares it dead, exactly like the
+    stop-answering shape."""
+    monkeypatch.setenv("PAMPI_FAULT_HANG_S", "30")
+    faults("hang@chunk3@rank1")
+    solvers, loops = _fleet(2)
+    _warm(solvers)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(co.RankDeadError) as excinfo:
+            co.LockstepSim(loops, watchdog=0.5).run()
+    assert excinfo.value.ranks == [1]
+    assert excinfo.value.survivors == [0]
+    # the cancel broadcast bounds the abandoned sleeper: give it a beat
+    # to unwind its rank_scope before the next test builds solvers
+    import time
+
+    time.sleep(0.2)
+
+
+def test_double_death_names_both(faults, tel_on):
+    """Two ranks dying in the same round: the OR-merged dead mask names
+    BOTH, the survivors still agree one epoch — degraded-capacity
+    accounting never undercounts the loss."""
+    faults("dead@chunk3@rank1,dead@chunk3@rank2")
+    _solvers, loops = _fleet(3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(co.RankDeadError) as excinfo:
+            co.LockstepSim(loops).run()
+    assert excinfo.value.ranks == [1, 2]
+    assert excinfo.value.survivors == [0]
+    assert _records(tel_on, "dead")[0]["ranks"] == [1, 2]
+
+
+def test_death_during_rollback_still_agreed(faults, tel_on):
+    """Death AFTER an agreed divergence rollback: the fleet first rolls
+    every rank back (the PR 10 protocol), then rank 1 dies on the
+    re-drive — the survivor holds the rolled-back state and still gets
+    the structured verdict. Protocol states compose; neither eats the
+    other's record."""
+    faults("nan@step3:u@rank0,dead@chunk5@rank1")
+    solvers, loops = _fleet(
+        2, Parameter(tpu_chunk=2, tpu_recover_ring=4, **_BASE))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(co.RankDeadError) as excinfo:
+            co.LockstepSim(loops).run()
+    assert excinfo.value.ranks == [1]
+    rolls = [r for r in _records(tel_on, "coord")
+             if r["event"] == "rollback"]
+    assert len(rolls) == 1  # the rollback happened BEFORE the death
+    assert _records(tel_on, "dead")[0]["epoch"] == 1
+    # the survivor's confirmed state is the agreed rolled-back
+    # trajectory: finite (the corruption was rolled away pre-death)
+    assert np.isfinite(np.asarray(loops[0]._confirmed[0])).all()
+    del solvers  # replicas only exist to anchor the loops
+
+
+def test_dead_rank_shrink_resume_bitwise(faults, tel_on, tmp_path):
+    """THE survival contract (ISSUE 12 acceptance): rank 1 dies at chunk
+    5 of a 2-rank coordinated run with an agreed elastic checkpoint
+    cadence; the survivor raises the structured verdict, shrink-resumes
+    from the newest agreed generation onto one device, completes — and
+    the final state is BITWISE-identical to a clean run restored from
+    the same generation on the same shrunk capacity. The manifest also
+    carries the fault ledger (the no-amnesia payload)."""
+    from pampi_tpu.fleet.scheduler import shrink_resume
+    from pampi_tpu.utils import checkpoint as ckpt
+
+    manifest = str(tmp_path / "ck.elastic")
+    faults("dead@chunk5@rank1")
+    param = Parameter(tpu_chunk=2, tpu_checkpoint=manifest,
+                      tpu_ckpt_elastic=1, **dict(_BASE, te=0.08))
+    solvers, loops = [], []
+    for r in range(2):
+        with fi.rank_scope(r):
+            solvers.append(NS2DSolver(param))
+    for r, s in enumerate(solvers):
+        loop = co.sim_rank_loop(s, "ns2d", 3, r, ckpt_every=2)
+        if r == 0:
+            def on_ckpt(state, ledger=None, s=s):
+                s.u, s.v, s.p = state[0], state[1], state[2]
+                s.t, s.nt = float(state[3]), int(state[4])
+                ckpt.save_elastic(manifest, s, ledger=ledger)
+
+            on_ckpt.takes_ledger = True
+            loop.on_ckpt = on_ckpt
+        loops.append(loop)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(co.RankDeadError) as excinfo:
+            co.LockstepSim(loops).run()
+    man = ckpt._read_manifest(manifest)
+    assert "ledger" in man  # the agreed commit persisted protocol state
+    gen = int(man["generation"])
+    assert gen >= 1
+
+    import jax
+
+    shrunk = [jax.devices()[0]]
+    resumed = shrink_resume(manifest, param, family="ns2d",
+                            devices=shrunk, dead=excinfo.value.ranks,
+                            epoch=excinfo.value.epoch)
+    assert resumed.nt == man["nt"]  # the newest agreed generation
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        resumed.run(progress=False)
+    assert resumed.t > 0.08
+
+    oracle = NS2DSolver(param)
+    ckpt.load_elastic(manifest, oracle)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        oracle.run(progress=False)
+    assert resumed.nt == oracle.nt and resumed.t == oracle.t
+    np.testing.assert_array_equal(np.asarray(resumed.u),
+                                  np.asarray(oracle.u))
+    np.testing.assert_array_equal(np.asarray(resumed.v),
+                                  np.asarray(oracle.v))
+    np.testing.assert_array_equal(np.asarray(resumed.p),
+                                  np.asarray(oracle.p))
+    shrinks = _records(tel_on, "shrink")
+    assert len(shrinks) == 1 and shrinks[0]["dead"] == [1]
+    assert shrinks[0]["generation"] == gen
+
+
+def test_cli_resume_after_death_policy(tmp_path):
+    """The driver's dead-rank policy hook (cli._resume_after_death):
+    armed (tpu_dead_resume 1 + elastic manifest on disk) it
+    shrink-resumes onto this process's devices and completes the run;
+    disarmed it surfaces the structured error and returns None (exit 3
+    at the cli)."""
+    from pampi_tpu import cli
+    from pampi_tpu.utils import checkpoint as ckpt
+
+    manifest = str(tmp_path / "ck.elastic")
+    param = Parameter(tpu_chunk=2, tpu_checkpoint=manifest,
+                      tpu_ckpt_elastic=1, **_BASE)
+    donor = NS2DSolver(param)  # t=0: the resume drives the whole run
+    ckpt.save_elastic(manifest, donor,
+                      ledger={"budget_spent": 0, "epoch": 1})
+    exc = co.RankDeadError(ranks=[1], epoch=1, boundary=3,
+                           family="ns2d", survivors=[0])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        solver = cli._resume_after_death(param, exc, is3d=False)
+    assert solver is not None
+    assert solver.t > _BASE["te"]
+    assert np.isfinite(np.asarray(solver.u)).all()
+
+    assert cli._resume_after_death(
+        param.replace(tpu_dead_resume=0), exc, is3d=False) is None
+    assert cli._resume_after_death(
+        param.replace(tpu_checkpoint=""), exc, is3d=False) is None
+
+
+def test_ledger_keeps_pallas_broken_verdict(tmp_path):
+    """No probation amnesia (ISSUE 12 acceptance): a manifest carrying a
+    pallas-broken verdict parks the restored solver on the jnp path at
+    load time, pallas_retry latches the dead verdict (no restore, ever),
+    and the coordinated loop seeds the spent budget + shrink epoch —
+    rank-symmetric because every rank reads the same manifest."""
+    from pampi_tpu.models._driver import pallas_retry
+    from pampi_tpu.utils import checkpoint as ckpt
+
+    manifest = str(tmp_path / "ck.elastic")
+    param = Parameter(tpu_fuse_phases="on", tpu_solver="fft",
+                      tpu_chunk=2, **_BASE)
+    donor = NS2DSolver(param)
+    assert donor._uses_pallas()
+    ledger = {"budget_spent": 1, "epoch": 2,
+              "pallas": {"broken": True, "on_jnp": True,
+                         "backend": "jnp"}}
+    ckpt.save_elastic(manifest, donor, ledger=ledger)
+
+    restored = NS2DSolver(param)
+    assert restored._backend != "jnp"
+    ckpt.load_elastic(manifest, restored)
+    assert restored._fault_ledger["pallas"]["broken"] is True
+    assert restored._backend == "jnp"  # parked on jnp at load
+    hook = pallas_retry(restored, "pressure solve", restore_after=2)
+    assert hook._dead  # the verdict survived the restart
+    for _ in range(6):
+        assert hook.on_clean_chunk() is None  # never restored
+    loop = co.sim_rank_loop(restored, "ns2d", 3, 0)
+    loop.retry = hook           # the production wiring carries the hook
+    assert loop.epoch == 2      # the shrink epoch carried over
+    assert loop._budget == 0    # spent charge carried over (of 1)
+    assert loop.ledger()["pallas"]["broken"] is True  # round-trips
+
+
+def test_short_run_end_of_run_manifest_keeps_ledger(tmp_path):
+    """Regression (found driving the CLI): a coordinated run that
+    completes BEFORE the first checkpoint-cadence boundary never fires
+    on_ckpt, so without the completion stash the end-of-run elastic
+    write dropped the ledger and `ckpt_fsck --survivors` declared a
+    healthy manifest CORRUPT. The agreed-done ledger must reach the
+    solver so save_elastic's _fault_ledger fallback persists it."""
+    from pampi_tpu.utils import checkpoint as ckpt
+
+    manifest = str(tmp_path / "ck.elastic")
+    param = Parameter(tpu_coord="on", tpu_checkpoint=manifest,
+                      tpu_ckpt_elastic=1, tpu_chunk=2,
+                      tpu_ckpt_every=1000, **_BASE)
+    s = NS2DSolver(param)
+    s.run(progress=False)
+    assert s._fault_ledger is not None  # stashed at loop completion
+    ckpt.save_elastic(manifest, s)  # the cli's end-of-run write
+    led = json.load(open(manifest)).get("ledger")
+    assert led is not None and led["budget_spent"] == 0
+    import subprocess
+    import sys as _sys
+
+    import tools.ckpt_fsck as fsck_mod
+
+    r = subprocess.run([_sys.executable, fsck_mod.__file__,
+                        "--survivors", "1", manifest],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "survivors 1: ok" in r.stdout
+
+
 def test_fallback_mirrors_onto_transient_rank(faults, tel_on):
     """Review regression: a rank that raised a TRANSIENT in the same
     round a peer took the pallas fallback must STILL mirror the swap —

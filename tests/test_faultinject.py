@@ -98,6 +98,34 @@ def test_rank_suffix_grammar(faults):
             fi._clauses()
 
 
+def test_dead_hang_grammar(faults):
+    """PR 12: `dead@chunk<N>` / `hang@chunk<N>` parse with the PR 10
+    `@rank<R>` targeting; the non-chunk sites and a :field payload are
+    refused — the death clauses model a rank, not a value."""
+    faults("dead@chunk2@rank1,hang@chunk3@rank0")
+    assert fi._clauses() == (
+        ("dead", "chunk", 2, None, 1, 1),
+        ("hang", "chunk", 3, None, 1, 0),
+    )
+    for bad in ("dead@step2", "hang@write1", "dead@lane1",
+                "dead@chunk2:u", "hang@chunk2:p"):
+        faults(bad)
+        with pytest.raises(fi.FaultSpecError, match="PAMPI_FAULTS"):
+            fi._clauses()
+
+
+def test_dead_rank_uncoordinated_is_loud_not_classified(faults):
+    """A death injected into the UNCOORDINATED single-controller loop
+    surfaces as InjectedRankDeath (a BaseException — the drive loop's
+    fault-classification funnel cannot swallow it into the transient or
+    pallas paths): the run dies loudly naming the injection, never
+    retries on a dead rank's behalf."""
+    faults("dead@chunk2@rank0")
+    s = NS2DSolver(Parameter(tpu_chunk=2, **_BASE))
+    with pytest.raises(fi.InjectedRankDeath, match="injected dead"):
+        s.run(progress=False)
+
+
 def test_rank_targeting_fires_and_preserves_charges(faults):
     """A rank-suffixed clause fires only under its rank's scope; a
     NON-matching rank neither fires nor consumes the charge (the
@@ -415,7 +443,8 @@ def test_resilience_records_render_and_lint(tel_on):
     assert len(summ["recoveries"]) == 1 and summ["recoveries"][0]["nt"] == 8
     assert [r["fault"] for r in summ["retries"]] == ["transient", "pallas"]
     assert summ["ckpt"] == {"save": 1, "rotate": 1, "load": 1, "reject": 1,
-                            "skip": 0, "elastic_save": 0, "elastic_load": 0}
+                            "skip": 0, "elastic_save": 0, "elastic_load": 0,
+                            "ledger_save": 0, "ledger_restore": 0}
     where = "BENCH.telemetry_summary"
     assert ca.lint_telemetry_summary(summ, where) == []
     # gutted blocks are FLAGGED, not waved through

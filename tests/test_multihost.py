@@ -417,6 +417,75 @@ def test_two_process_transient_retried_by_coordinator(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_kill_and_shrink_resume(tmp_path):
+    """ISSUE 12 acceptance, for real: rank 1's OS PROCESS dies mid-run
+    (injected death — the process exits at its 4th chunk dispatch, after
+    at least one agreed elastic commit). The surviving rank must die
+    LOUDLY within the timeout budget — via the boundary watchdog's
+    structured RankDeadError when the death lands at the rendezvous, or
+    via the backend collective failure when it lands mid-dispatch (the
+    documented remaining window) — never hang; the elastic manifest +
+    fault ledger survive; and the operator resume (the walkthrough the
+    survivor prints: relaunch on the survivor count with tpu_restart)
+    completes the run from the agreed generation."""
+    import json
+
+    par = tmp_path / "dcavity.par"
+    par.write_text(COORD_PAR.replace(
+        "tpu_dtype  float64",
+        "tpu_dtype  float64\n"
+        "tpu_checkpoint ck.elastic\n"
+        "tpu_ckpt_elastic 1\n"
+        "tpu_ckpt_every 2\n"
+        "tpu_coord_timeout 20\n"))
+    proc = subprocess.run(
+        [str(LAUNCHER), "2", str(par)],
+        cwd=tmp_path,
+        env=_env(PAMPI_LOCAL_DEVICES="2",
+                 PAMPI_FAULTS="dead@chunk4@rank1",
+                 PAMPI_TELEMETRY=str(tmp_path / "dead.jsonl")),
+        capture_output=True,
+        text=True,
+        timeout=600,  # the non-hang bound: a wedge fails HERE
+    )
+    assert proc.returncode != 0  # the injected death must not read clean
+    r1 = tmp_path / "multihost-r1.log"
+    logs = proc.stdout + proc.stderr + (
+        r1.read_text() if r1.exists() else "")
+    assert "injected dead" in logs  # rank 1 died the injected death
+    if "DEAD rank(s)" in logs:
+        # the watchdog path: the structured verdict is also a
+        # flight-recorder `dead` line on the surviving rank
+        recs = [json.loads(ln) for ln in open(tmp_path / "dead.jsonl")
+                if ln.strip()]
+        assert any(r["kind"] == "dead" for r in recs)
+
+    manifest = tmp_path / "ck.elastic"
+    assert manifest.exists()  # at least one agreed commit pre-death
+    man = json.loads(manifest.read_text())
+    assert "ledger" in man and man["nt"] > 0
+
+    # the operator walkthrough: relaunch on the survivor count with
+    # tpu_restart — the manifest reshards onto the shrunk (here:
+    # single-process) capacity and the ledger restores protocol state
+    par2 = tmp_path / "resume.par"
+    par2.write_text(par.read_text() + "tpu_restart ck.elastic\n")
+    proc2 = subprocess.run(
+        ["python", "-m", "pampi_tpu", str(par2)],
+        cwd=tmp_path,
+        env=_env(JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO)),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "Restarted from ck.elastic" in proc2.stdout
+    assert "Solution took" in proc2.stdout
+    for out in ("pressure.dat", "velocity.dat"):
+        assert (tmp_path / out).exists(), out
+
+
+@pytest.mark.slow
 def test_two_process_elastic_checkpoint_restores_on_one_process(tmp_path):
     """Elastic shrink across the process boundary: a 2-process x
     2-device run writes the manifest + shard set; THIS single process

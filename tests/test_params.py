@@ -53,6 +53,19 @@ def test_prefix_match(tmp_path):
     assert p.eps == 0.5
 
 
+def test_exact_key_wins_over_prefix(tmp_path):
+    """The framework keys are namespaced (tpu_coord / tpu_coord_timeout)
+    where the reference's key set is prefix-free: an EXACT key token
+    assigns only itself — `tpu_coord_timeout 60` must not clobber
+    tpu_coord — while non-exact tokens keep the reference's strncmp
+    prefix semantics (test_prefix_match)."""
+    f = tmp_path / "t.par"
+    f.write_text("tpu_coord  on\ntpu_coord_timeout 60\n")
+    p = read_parameter(str(f))
+    assert p.tpu_coord == "on"
+    assert p.tpu_coord_timeout == 60.0
+
+
 def test_comments_and_blank_lines(tmp_path):
     f = tmp_path / "t.par"
     f.write_text("\n\n# full comment\nomg 1.5\t# inline\n\n")

@@ -206,6 +206,31 @@ def lint_telemetry_summary(d: dict, where: str) -> list[str]:
             errs += _missing(coord, ("decisions",), f"{where}.coord")
             if not isinstance(coord.get("decisions", {}), dict):
                 errs.append(f"{where}.coord.decisions: not a dict")
+            # the schema-v6 membership subsection (dead-rank verdicts /
+            # shrink epochs / elastic shrink-resumes) — optional, so
+            # pre-dead-rank artifacts pass; present but gutted is
+            # flagged (a survival event with no dead set or epoch would
+            # hide WHAT was survived)
+            mem = coord.get("membership")
+            if mem is not None:
+                if not isinstance(mem, dict):
+                    errs.append(f"{where}.coord.membership: not a dict")
+                else:
+                    for key, need in (("dead", "ranks"),
+                                      ("epochs", "epoch"),
+                                      ("shrinks", "survivors")):
+                        block = mem.get(key)
+                        if block is None:
+                            continue
+                        if not isinstance(block, list):
+                            errs.append(
+                                f"{where}.coord.membership.{key}: "
+                                "not a list")
+                        elif not all(isinstance(r, dict) and need in r
+                                     for r in block):
+                            errs.append(
+                                f"{where}.coord.membership.{key}: "
+                                f"record missing {need!r}")
     warns = d.get("warnings")
     if warns is not None:
         if not isinstance(warns, list):

@@ -1,6 +1,6 @@
 """Offline checkpoint verifier (`make ckpt-fsck CKPT=<path>`).
 
-    python tools/ckpt_fsck.py <checkpoint> [...]
+    python tools/ckpt_fsck.py [--survivors N] <checkpoint> [...]
 
 Verifies a pampi_tpu checkpoint ON DISK without building a solver —
 the operator's pre-restore sanity check and the post-incident triage
@@ -19,7 +19,17 @@ the fallback's health — but only PRIMARY corruption fails the exit
 code: a healthy primary over a rotted .prev is degraded redundancy,
 not a broken checkpoint.
 
-Exit 0 = every primary verified; 1 = any primary torn/corrupt/missing.
+`--survivors N` (PR 12): additionally verify the set is restorable onto
+an N-rank SURVIVOR mesh — the dead-rank shrink-resume's pre-flight.
+Elastic only (the legacy .npz is mesh-locked by design): requires the
+full shard row coverage the reshard reassembles from (any missing /
+mixed-generation shard already fails above) AND the fault ledger in the
+manifest, so the shrunk fleet resumes with the protocol state (spent
+budget, pallas-broken verdict, shrink epoch) instead of probation
+amnesia.
+
+Exit 0 = every primary verified; 1 = any primary torn/corrupt/missing
+(or, under --survivors, not shrink-restorable).
 """
 
 from __future__ import annotations
@@ -148,7 +158,35 @@ def _fsck_legacy(path: str) -> list[str]:
     return errs
 
 
-def fsck(path: str) -> list[str]:
+def _fsck_survivors(path: str, n: int, errs: list[str]) -> list[str]:
+    """The shrink-restorability check: could `fleet.shrink_resume` land
+    this set on an N-rank survivor mesh? Appends to (and returns) the
+    error list; prints the verdict line either way."""
+    try:
+        man = _read_manifest(path)
+    except _corrupt_classes():
+        print(f"  survivors {n}: UNVERIFIABLE (manifest unreadable)")
+        return errs  # the manifest error is already in errs
+    new = []
+    if any(errs):
+        new.append(f"{path}: not shrink-restorable onto {n} rank(s) — "
+                   "shard set incomplete (errors above)")
+    if "ledger" not in man:
+        new.append(f"{path}: no fault ledger in the manifest — a "
+                   f"{n}-rank survivor resume would forget the fleet's "
+                   "protocol state (spent budget, pallas verdict); "
+                   "written without an armed coordinator?")
+    status = "ok (full coverage + ledger)" if not new else "NOT RESTORABLE"
+    print(f"  survivors {n}: {status}")
+    nshards = man.get("nshards", len(man.get("shards", [])))
+    if n != nshards:
+        print(f"    (reshard {nshards} writing shard(s) -> {n} "
+              "survivor rank(s) via NamedSharding)")
+    errs += new
+    return errs
+
+
+def fsck(path: str, survivors: int | None = None) -> list[str]:
     """Verify primary + (informationally) .prev; returns PRIMARY errors."""
     print(f"== {path} ==")
     try:
@@ -156,6 +194,12 @@ def fsck(path: str) -> list[str]:
     except CheckpointCorruptError:
         elastic = True
     errs = (_fsck_elastic if elastic else _fsck_legacy)(path)
+    if survivors is not None:
+        if elastic:
+            errs = _fsck_survivors(path, survivors, errs)
+        else:
+            errs.append(f"{path}: --survivors needs an elastic manifest "
+                        "(the legacy .npz is mesh-locked)")
     for e in errs:
         print(f"    ERROR {e}")
     prev = f"{path}.prev"
@@ -172,13 +216,23 @@ def fsck(path: str) -> list[str]:
 
 
 def main(argv: list[str]) -> int:
-    paths = argv[1:]
+    args = argv[1:]
+    survivors = None
+    if "--survivors" in args:
+        i = args.index("--survivors")
+        if i + 1 >= len(args) or not args[i + 1].isdigit() \
+                or int(args[i + 1]) < 1:
+            print("--survivors needs a rank count >= 1", file=sys.stderr)
+            return 1
+        survivors = int(args[i + 1])
+        args = args[:i] + args[i + 2:]
+    paths = args
     if not paths:
         print(__doc__.strip(), file=sys.stderr)
         return 1
     bad = 0
     for p in paths:
-        bad += len(fsck(p))
+        bad += len(fsck(p, survivors=survivors))
     return 1 if bad else 0
 
 

@@ -1,0 +1,142 @@
+"""Dead-rank survival smoke (`make dead-rank-smoke`).
+
+    python tools/dead_rank_smoke.py
+
+The whole survival chain on one CPU, end-to-end, in seconds: a
+2-virtual-rank lockstep fleet (parallel/coordinator.LockstepSim — two
+full NS-2D replicas agreeing at every chunk boundary) with an agreed
+elastic checkpoint cadence; rank 1 is killed at its 5th chunk dispatch
+(`dead@chunk5@rank1`); the smoke asserts
+
+  1. the survivor's membership round raises the structured
+     RankDeadError NAMING rank 1 (never a hang),
+  2. `fleet.scheduler.shrink_resume` restores the newest agreed elastic
+     generation (+ the fault ledger) onto the survivor capacity and the
+     run COMPLETES at degraded capacity,
+  3. the survivor's final state is BITWISE-identical to a clean run
+     restored from the same generation on the same shrunk mesh — the
+     elastic-reshard contract, exercised as the survival contract.
+
+Exit 0 = all three hold. This is the fault-suite's quick dead-rank
+loop; the pytest twins live in tests/test_coordinator.py and the real
+kill-a-process acceptance case (capability-gated) in
+tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import warnings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PAMPI_FAULTS"] = "dead@chunk5@rank1"
+
+import numpy as np  # noqa: E402
+
+from pampi_tpu.models.ns2d import NS2DSolver  # noqa: E402
+from pampi_tpu.parallel import coordinator as co  # noqa: E402
+from pampi_tpu.utils import checkpoint as ckpt  # noqa: E402
+from pampi_tpu.utils import faultinject as fi  # noqa: E402
+from pampi_tpu.utils.params import Parameter  # noqa: E402
+
+_BASE = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.08, tau=0.5,
+             itermax=50, eps=1e-4, omg=1.7, gamma=0.9, tpu_chunk=2,
+             tpu_coord_timeout=5.0, tpu_dtype="float32")
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as tmp:
+        manifest = os.path.join(tmp, "ck.elastic")
+        param = Parameter(tpu_checkpoint=manifest, tpu_ckpt_elastic=1,
+                          **_BASE)
+        solvers, loops = [], []
+        for r in range(2):
+            with fi.rank_scope(r):
+                solvers.append(NS2DSolver(param))
+        for r, solver in enumerate(solvers):
+            loop = co.sim_rank_loop(solver, "ns2d", 3, r, ckpt_every=2)
+            if r == 0:
+                # the production shape (coord_ckpt_cadence): rank 0
+                # publishes + writes the manifest WITH the fault ledger
+                # at every agreed commit; peers vote but don't write
+                def on_ckpt(state, ledger=None, s=solver):
+                    s.u, s.v, s.p = state[0], state[1], state[2]
+                    s.t, s.nt = float(state[3]), int(state[4])
+                    ckpt.save_elastic(manifest, s, ledger=ledger)
+
+                on_ckpt.takes_ledger = True
+                loop.on_ckpt = on_ckpt
+            loops.append(loop)
+
+        verdict = None
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                co.LockstepSim(loops).run()
+        except co.RankDeadError as exc:
+            verdict = exc
+            if verdict.ranks != [1]:
+                print(f"FAIL: dead set {verdict.ranks} != [1]")
+                return 1
+            print(f"[1/3] survivor verdict ok: {verdict}")
+        else:
+            print("FAIL: the fleet completed — rank 1 was never "
+                  "declared dead")
+            return 1
+
+        if not os.path.exists(manifest):
+            print("FAIL: no elastic generation was committed before "
+                  "the death")
+            return 1
+        man = ckpt._read_manifest(manifest)
+        if "ledger" not in man:
+            print("FAIL: the agreed commit carried no fault ledger")
+            return 1
+        gen = int(man["generation"])
+
+        import jax
+
+        from pampi_tpu.fleet.scheduler import shrink_resume
+
+        shrunk = [jax.devices()[0]]  # the survivor's capacity
+        resumed = shrink_resume(manifest, param, family="ns2d",
+                                devices=shrunk, dead=verdict.ranks,
+                                epoch=verdict.epoch)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            resumed.run(progress=False)
+        if not (resumed.t > param.te
+                and np.isfinite(np.asarray(resumed.u)).all()):
+            print("FAIL: the shrink-resumed run did not complete finite")
+            return 1
+        print(f"[2/3] shrink-resume ok: generation {gen} -> "
+              f"t={resumed.t:.4f} nt={resumed.nt} on 1 device")
+
+        # the clean shrunk-mesh oracle: a fresh run restored from the
+        # SAME generation on the same capacity must match bitwise
+        oracle = NS2DSolver(param)
+        ckpt.load_elastic(manifest, oracle)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            oracle.run(progress=False)
+        if (resumed.nt != oracle.nt or resumed.t != oracle.t
+                or not all(
+                    np.array_equal(np.asarray(getattr(resumed, f)),
+                                   np.asarray(getattr(oracle, f)))
+                    for f in ("u", "v", "p"))):
+            print("FAIL: survivor state is not bitwise-identical to the "
+                  "clean shrunk-mesh run from the same generation")
+            return 1
+        print(f"[3/3] bitwise parity ok: survivor == clean shrunk-mesh "
+              f"run from generation {gen} (nt={oracle.nt})")
+        print("dead-rank smoke PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,6 +14,11 @@ split), and the profiling region table. A telemetry write-failure
 truncation (`finalize.dropped_records`) is surfaced loudly — a clipped
 flight record must never read as a quiet run.
 
+Dead-rank survival (schema v6): `dead`/`epoch`/`shrink` records and the
+ckpt ledger events render as the coordinator section's MEMBERSHIP
+subsection and summarize under `telemetry_summary.coord.membership`
+(tools/check_artifact.py lints the shape; legacy artifacts pass).
+
 Fleet runs (pampi_tpu/fleet/) add the multi-tenant dimension: chunk/
 divergence/solve records carry a `scenario` id, rendered as a
 per-scenario (per-tenant) table, and the scheduler's `fleet` record
@@ -74,6 +79,14 @@ def _by_kind(records: list[dict]) -> dict[str, list[dict]]:
     return out
 
 
+def _strip(rec: dict, *extra: str) -> dict:
+    """A record without its envelope fields (schema tag / kind / stamp),
+    the shape every summary block carries — one helper so an envelope
+    change lands in one place, not in a dozen hand-copies."""
+    drop = ("v", "kind", "ts") + extra
+    return {key: val for key, val in rec.items() if key not in drop}
+
+
 def summary(records: list[dict]) -> dict:
     """The machine-readable summary block (`telemetry_summary` in merged
     artifacts; tools/check_artifact.py lints its shape)."""
@@ -85,10 +98,7 @@ def summary(records: list[dict]) -> dict:
     last = chunks[-1] if chunks else None
     spans = {}
     for s in k.get("span", []):
-        spans[s["name"]] = {
-            key: val for key, val in s.items()
-            if key not in ("v", "kind", "ts", "name")
-        }
+        spans[s["name"]] = _strip(s, "name")
     out = {
         "schema_version": run.get("v", 1),
         "backend": run.get("backend"),
@@ -115,37 +125,27 @@ def summary(records: list[dict]) -> dict:
         },
         "divergence": k.get("divergence", []) or None,
         "recoveries": [
-            {key: val for key, val in r.items()
-             if key not in ("v", "kind", "ts")}
+            _strip(r)
             for r in k.get("recover", [])
         ] or None,
         "retries": [
-            {key: val for key, val in r.items()
-             if key not in ("v", "kind", "ts")}
+            _strip(r)
             for r in k.get("retry", [])
         ] or None,
         "ckpt": {
             ev: sum(1 for c in k.get("ckpt", []) if c.get("event") == ev)
             for ev in ("save", "rotate", "load", "reject", "skip",
-                       "elastic_save", "elastic_load")
+                       "elastic_save", "elastic_load",
+                       "ledger_save", "ledger_restore")
         } if k.get("ckpt") else None,
         # the chunk-boundary agreement protocol's decision census
         # (schema v5; parallel/coordinator.py emits one `coord` record
-        # per GLOBAL decision from rank 0)
-        "coord": {
-            "nranks": next(
-                (c.get("nranks") for c in k["coord"]
-                 if c.get("event") == "armed"), None),
-            "decisions": {
-                ev: n for ev in ("retry", "fallback", "rollback", "ckpt",
-                                 "giveup", "abort")
-                if (n := sum(1 for c in k["coord"]
-                             if c.get("event") == ev))
-            },
-        } if k.get("coord") else None,
+        # per GLOBAL decision from rank 0) + the schema-v6 membership
+        # subsection (dead-rank verdicts, shrink epochs, elastic
+        # shrink-resumes) — built whenever either plane recorded
+        "coord": _coord_summary(k),
         "warnings": [
-            {key: val for key, val in w.items()
-             if key not in ("v", "kind", "ts")}
+            _strip(w)
             for w in k.get("warning", [])
         ] or None,
         "spans": spans or None,
@@ -158,8 +158,7 @@ def summary(records: list[dict]) -> dict:
             ),
         },
         "halo": [
-            {key: val for key, val in h.items()
-             if key not in ("v", "kind", "ts")}
+            _strip(h)
             for h in k.get("halo", [])
         ] or None,
         "profile_regions": (
@@ -173,6 +172,45 @@ def summary(records: list[dict]) -> dict:
         # the xprof block deliberately does NOT ride here: --merge writes
         # it once as the top-level `xprof_summary` (the linted contract)
     }
+    return out
+
+
+def _coord_summary(k: dict):
+    """The coordinator block of `summary`: decision census (v5) plus the
+    dead-rank membership subsection (v6 — `dead`/`epoch`/`shrink`
+    records). None when the run recorded neither plane, so pre-coord
+    flight records keep their historical summary shape."""
+    membership = None
+    if k.get("dead") or k.get("epoch") or k.get("shrink"):
+        membership = {
+            "dead": [
+                _strip(d)
+                for d in k.get("dead", [])
+            ] or None,
+            "epochs": [
+                _strip(e)
+                for e in k.get("epoch", [])
+            ] or None,
+            "shrinks": [
+                _strip(s)
+                for s in k.get("shrink", [])
+            ] or None,
+        }
+    if not k.get("coord") and membership is None:
+        return None
+    out = {
+        "nranks": next(
+            (c.get("nranks") for c in k.get("coord", [])
+             if c.get("event") == "armed"), None),
+        "decisions": {
+            ev: n for ev in ("retry", "fallback", "rollback", "ckpt",
+                             "giveup", "abort")
+            if (n := sum(1 for c in k.get("coord", [])
+                         if c.get("event") == ev))
+        },
+    }
+    if membership is not None:
+        out["membership"] = membership
     return out
 
 
@@ -208,8 +246,7 @@ def fleet_summary(records: list[dict]):
     fl = [r for r in records if r.get("kind") == "fleet"]
     if not fl:
         return None
-    out = {key: val for key, val in fl[-1].items()
-           if key not in ("v", "kind", "ts")}
+    out = _strip(fl[-1])
     table = scenario_table(records)
     if table:
         out["scenarios"] = table
@@ -222,8 +259,7 @@ def xprof_summary(records: list[dict]):
     xs = [r for r in records if r.get("kind") == "xprof"]
     if not xs:
         return None
-    return {key: val for key, val in xs[-1].items()
-            if key not in ("v", "kind", "ts")}
+    return _strip(xs[-1])
 
 
 def comm_hidden_fraction(records: list[dict]):
@@ -346,9 +382,9 @@ def render(records: list[dict]) -> str:
             if "first_bad_step" in d else
             f"  {d.get('family')}: non-finite residual {d.get('res')}")
 
-    if k.get("coord"):
+    if k.get("coord") or k.get("dead") or k.get("epoch") or k.get("shrink"):
         add("== coordinator (agreed global decisions) ==")
-        for c in k["coord"]:
+        for c in k.get("coord", []):
             ev = c.get("event")
             if ev == "armed":
                 add(f"  armed: {c.get('mode')} nranks={c.get('nranks')} "
@@ -358,6 +394,22 @@ def render(records: list[dict]) -> str:
                       if key not in ("v", "kind", "ts", "event",
                                      "boundary", "family")}
             add(f"  boundary {str(c.get('boundary')):>5}  {ev:<9} {detail}")
+        if k.get("dead") or k.get("epoch") or k.get("shrink"):
+            add("  -- membership (dead ranks / shrink epochs) --")
+            for d in k.get("dead", []):
+                ranks = d.get("ranks")
+                add(f"  DEAD rank(s) {ranks if ranks else '(unattributed)'}"
+                    f" at boundary {d.get('boundary')} -> epoch "
+                    f"{d.get('epoch')} (watchdog {d.get('watchdog_s')}s,"
+                    f" {d.get('nranks')} rank(s) before)")
+            for e in k.get("epoch", []):
+                add(f"  epoch {e.get('epoch')}: {e.get('nranks')} "
+                    f"survivor(s) {e.get('survivors')}")
+            for s in k.get("shrink", []):
+                add(f"  shrink-resume [{s.get('family')}] on "
+                    f"{s.get('survivors')} device(s) from generation "
+                    f"{s.get('generation')} (t={_num(s.get('t')):.6g} "
+                    f"nt={s.get('nt')}, dead {s.get('dead')})")
 
     if k.get("warning"):
         add("== warnings (degraded-but-proceeding subsystems) ==")
