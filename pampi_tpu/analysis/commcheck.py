@@ -62,6 +62,7 @@ RULE_BYTES = "comm-bytes"
 RULE_RESHARD = "comm-reshard"
 RULE_XCHECK = "comm-telemetry"
 RULE_SCOPE = "comm-scope"
+RULE_TIER = "comm-tier"
 
 # the census vocabulary: every cross-shard primitive a chunk could carry
 COLLECTIVES = ("ppermute", "psum", "pmax", "pmin", "all_gather",
@@ -125,11 +126,56 @@ def census(jaxpr) -> dict:
             "strips": strips}
 
 
+def _scope_axis(e) -> str | None:
+    """Mesh-axis name of a ppermute eqn's halo_exchange./halo_shift.
+    named scope ('halo_exchange.j.4x18:float64' -> 'j'), or None when
+    the eqn is unscoped (e.g. the quarters solve's own q_exchange)."""
+    stack = str(getattr(e.source_info, "name_stack", "") or "")
+    for part in stack.split("/"):
+        if part.startswith(("halo_exchange.", "halo_shift.")):
+            bits = part.split(".")
+            if len(bits) >= 2:
+                return bits[1]
+    return None
+
+
+def census_tiers(jaxpr, tiers: dict) -> dict:
+    """The per-TIER traffic breakdown of a traced program's ppermutes
+    (ROADMAP item 3 — DCN bytes as a first-class contract): every
+    ppermute is attributed through its named scope's mesh axis to the
+    comm's axis->tier map (`tpu_mesh_tiers`); unscoped ppermutes land
+    under 'untiered'. Per tier: collective count, payload bytes, and
+    the strip multiset. The per-tier byte sum always equals the flat
+    census's `ppermute_bytes` (structurally enforced in check_config),
+    so the single-tier default is byte-identical to the historical
+    totals with everything under 'ici'."""
+    import numpy as np
+
+    out: dict[str, dict] = {}
+    for e in iter_eqns(jaxpr):
+        if e.primitive.name != "ppermute":
+            continue
+        axis = _scope_axis(e)
+        tier = tiers.get(axis, "untiered") if axis else "untiered"
+        t = out.setdefault(tier, {"ppermute": 0, "bytes": 0, "strips": {}})
+        aval = e.invars[0].aval
+        key = strip_key(aval.shape, aval.dtype)
+        t["ppermute"] += 1
+        t["bytes"] += int(np.prod(aval.shape)) * np.dtype(
+            aval.dtype).itemsize
+        t["strips"][key] = t["strips"].get(key, 0) + 1
+    return out
+
+
 def config_entry(traced) -> dict:
     """The fresh `comm` baseline entry for one traced config."""
     entry = census(traced.jaxpr.jaxpr)
     rec = getattr(traced.solver, "_halo_record", None)
     entry["halo"] = rec() if callable(rec) else None
+    comm = getattr(traced.solver, "comm", None)
+    tiers = getattr(comm, "tiers", None)
+    if tiers and entry["collectives"].get("ppermute"):
+        entry["tiers"] = census_tiers(traced.jaxpr.jaxpr, tiers)
     return entry
 
 
@@ -194,10 +240,14 @@ def _find_chunk_loop(jaxpr):
     return None
 
 
-def overlap_schedule_violations(closed, rec: dict) -> list[str]:
+def overlap_schedule_violations(closed, rec: dict,
+                                sweeps: bool = False) -> list[str]:
     """Static proof that a chunk program carries the DOUBLE-BUFFERED
     overlap schedule (models/ns*_dist step_overlap; `make profile-smoke`
-    and tests assert through this one helper):
+    and tests assert through this one helper). `sweeps=True` is the
+    sweep-loop mode: additionally prove the solve's convergence loops
+    post their depth-1 exchanges split interior/boundary
+    (`sweep_split_violations`).
 
     1. the chunk's step loop posts the deep exchange but no pallas_call
        of the same iteration consumes its results (forward dataflow
@@ -262,6 +312,134 @@ def overlap_schedule_violations(closed, rec: dict) -> list[str]:
         errs.append(
             "no prologue deep exchange precedes the step loop — the "
             "first iteration's double buffer is never filled")
+    if sweeps:
+        errs += sweep_split_violations(closed, rec)
+    return errs
+
+
+def _depth1_strip_keys(rec: dict) -> set[str]:
+    """Strip-key tokens of the halo-1 exchange messages on the
+    partitioned axes (the depth-1 class the split solve sweeps post)."""
+    from ..parallel.comm import halo_strip_shapes
+
+    import numpy as np
+
+    shard = tuple(rec["shard"])
+    mesh = tuple(rec["mesh"])
+    dtype = np.dtype(rec["dtype"])
+    return {
+        strip_key(shape, dtype)
+        for ax, shape in enumerate(halo_strip_shapes(shard, 1))
+        if mesh[ax] > 1
+    }
+
+
+def _all_whiles(jaxpr):
+    """Every while eqn anywhere in the program, with its body jaxpr."""
+    for e in jaxpr.eqns:
+        if e.primitive.name == "while":
+            yield e.params["body_jaxpr"].jaxpr
+        for v in e.params.values():
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vals:
+                inner = None
+                if type(x).__name__ == "ClosedJaxpr":
+                    inner = x.jaxpr
+                elif type(x).__name__ == "Jaxpr":
+                    inner = x
+                if inner is not None:
+                    yield from _all_whiles(inner)
+
+
+def sweep_split_violations(closed, rec: dict) -> list[str]:
+    """The sweep-loop mode of the overlap schedule proof (ROADMAP item 3
+    layer 2): statically prove the solve's convergence loops post their
+    depth-1 exchanges SPLIT — no half-sweep's whole update consumes the
+    posted ppermutes.
+
+    A candidate sweep loop is any while whose body DIRECTLY carries a
+    depth-1-strip ppermute and a psum (the residual reduction) — the
+    shape of the split RB-SOR loop and the split MG smoother's enclosing
+    cycle loop. For each candidate, the ppermute outputs are tainted
+    forward; the loop passes when some full-block `select_n` merges a
+    tainted (boundary) half with an UNTAINTED (interior) float half —
+    the structural witness that an interior-region update exists with no
+    dependency path from the exchange, i.e. compute the scheduler can
+    hide the exchange behind. A SERIAL solve fails at step one: its
+    sweeps either exchange at CA depth (no depth-1 loop exists) or feed
+    the whole update from the exchanged block (no untainted merge half)
+    — the negative control the mutation test pins. Returns diagnostics
+    (empty = the split holds)."""
+    import numpy as np
+
+    keys = _depth1_strip_keys(rec)
+    if not keys:
+        return ["halo record declares no partitioned axis — no sweep "
+                "loop to check"]
+    block = tuple(int(s) + 2 for s in rec["shard"])
+
+    def is_d1(e):
+        if e.primitive.name != "ppermute":
+            return False
+        aval = e.invars[0].aval
+        return strip_key(aval.shape, aval.dtype) in keys
+
+    candidates = []
+    for body in _all_whiles(closed.jaxpr):
+        has_d1 = any(is_d1(e) for e in body.eqns)
+        has_psum = any(e.primitive.name == "psum" for e in body.eqns)
+        if has_d1 and has_psum:
+            candidates.append(body)
+    if not candidates:
+        return [
+            "no depth-1-exchanging sweep loop in the chunk — the solve "
+            "sweeps serialize their exchanges (CA/deep or in-kernel), "
+            "nothing is split"]
+    def contains_select(e) -> bool:
+        """select_n directly, or inside a sub-jaxpr (jnp.where is an
+        internally-jitted function, so the select arrives wrapped in a
+        pjit eqn)."""
+        if e.primitive.name == "select_n":
+            return True
+        for v in e.params.values():
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vals:
+                inner = None
+                if type(x).__name__ == "ClosedJaxpr":
+                    inner = x.jaxpr
+                elif type(x).__name__ == "Jaxpr":
+                    inner = x
+                if inner is not None and any(
+                        ie.primitive.name == "select_n"
+                        for ie in inner.eqns):
+                    return True
+        return False
+
+    errs = []
+    for body in candidates:
+        tainted: set[int] = set()
+        split_merge = False
+        for e in body.eqns:
+            if is_d1(e):
+                tainted.update(id(v) for v in e.outvars)
+                continue
+            hit = any(id(v) in tainted for v in e.invars)
+            if hit and contains_select(e):
+                floats = [v for v in e.invars
+                          if getattr(v.aval, "shape", None) == block
+                          and np.issubdtype(
+                              np.dtype(getattr(v.aval, "dtype", bool)),
+                              np.floating)]
+                if (any(id(v) in tainted for v in floats)
+                        and any(id(v) not in tainted for v in floats)):
+                    split_merge = True
+            if hit:
+                tainted.update(id(v) for v in e.outvars)
+        if not split_merge:
+            errs.append(
+                "a sweep loop's depth-1 ppermutes feed every full-block "
+                "update — the exchange is serialized against the whole "
+                "half-sweep, not split interior/boundary")
     return errs
 
 
@@ -392,6 +570,16 @@ def check_config(traced, baseline: dict | None,
                  "under a halo_exchange./halo_shift. named scope — the "
                  "exchange lost its device-time attribution "
                  "(parallel/comm._scope)")
+    # per-tier coverage invariant: the tier breakdown must account for
+    # every ppermute byte of the flat census (a mis-attributed strip
+    # would silently vanish from the DCN accounting)
+    if "tiers" in entry:
+        tsum = sum(t["bytes"] for t in entry["tiers"].values())
+        if tsum != entry["ppermute_bytes"]:
+            emit(RULE_TIER,
+                 f"per-tier census covers {tsum} of "
+                 f"{entry['ppermute_bytes']} ppermute bytes — a strip "
+                 "lost its tier attribution")
     # the telemetry cross-check (dist solvers expose _halo_record)
     if entry["halo"] is not None:
         for msg in crosscheck_record(entry["halo"], entry):
@@ -424,6 +612,21 @@ def check_config(traced, baseline: dict | None,
             emit(RULE_BYTES,
                  "halo message geometry drifted at equal byte volume: "
                  + "; ".join(sdiff)
+                 + " (tools/lint.py --update if intended)")
+        if "tiers" in baseline and baseline["tiers"] != entry.get("tiers"):
+            # the per-tier breakdown is pinned too: a re-tiered strip
+            # (bytes migrating between ICI and DCN) is a schedule
+            # change even at constant totals
+            old_t = baseline.get("tiers") or {}
+            new_t = entry.get("tiers") or {}
+            tdiff = diff_counts(
+                {k: v.get("bytes", 0) for k, v in old_t.items()},
+                {k: v.get("bytes", 0) for k, v in new_t.items()},
+                "tier-bytes")
+            emit(RULE_TIER,
+                 "per-tier traffic drifted from the comm baseline: "
+                 + ("; ".join(tdiff) if tdiff
+                    else "same bytes, strip/count reshuffle")
                  + " (tools/lint.py --update if intended)")
     return vs, entry
 

@@ -304,9 +304,11 @@ def _pre2d_entry(shard: str, obstacles: bool = False,
         fn=fn,
         in_shapes=((rows, cols), (rows, cols)),
         owned=owned,
-        declared=nf.FUSE_CHAIN,
+        declared=nf.FUSE_FOOTPRINT,
         anchor=_anchor(nf.make_fused_pre_2d),
-        note="declared = FUSE_CHAIN (deep exchange ships FUSE_DEEP_HALO)",
+        note="declared = FUSE_FOOTPRINT (the deep exchange ships "
+             "FUSE_DEEP_HALO = footprint + 1 — zero slack: a widened "
+             "chain must bump both)",
     )
 
 
@@ -403,9 +405,10 @@ def _pre3d_entry(size: int = 4) -> HaloEntry:
         fn=fn,
         in_shapes=(ext, ext, ext),
         owned=owned,
-        declared=nf3.FUSE_CHAIN,
+        declared=nf3.FUSE_FOOTPRINT,
         anchor=_anchor(nf3.make_fused_pre_3d),
-        note="declared = FUSE_CHAIN (deep exchange ships FUSE_DEEP_HALO)",
+        note="declared = FUSE_FOOTPRINT (the deep exchange ships "
+             "FUSE_DEEP_HALO = footprint + 1 — zero slack)",
     )
 
 
@@ -420,16 +423,20 @@ def _overlap_box(local_extents, ext_pad: int, rim: int):
     )
 
 
-def overlap_interior_entry_2d(smuggle: int = 0) -> HaloEntry:
+def overlap_interior_entry_2d(smuggle: int = 0,
+                              rim: int | None = None) -> HaloEntry:
     """The overlapped 2-D PRE's INTERIOR half: the same chain, owned box
-    restricted to the interior-merge region (parallel/overlap.py). Its
-    measured footprint must stay within FUSE_CHAIN of that box — i.e.
-    strictly clear of the exchanged deep strips, which sit one layer
-    further out. This is the contract that makes the interior half safe
-    to compute on the STALE double buffer: a smuggled read reaching the
-    strips measures FUSE_CHAIN + 1 and fails with the kernel's
-    file:line. `smuggle > 0` (mutation-test hook) forges exactly that —
-    a read `smuggle` layers past the validity chain."""
+    restricted to the interior-merge region (parallel/overlap.py). The
+    declared budget is `rim - 1`: the exchanged strips start one layer
+    outside the extended block's interior, so a cone reaching further
+    than rim - 1 from the interior box touches a strip — the stale
+    double buffer would be consumed. With the production OVERLAP_RIM
+    (= FUSE_FOOTPRINT + 1) the budget equals the measured footprint
+    exactly (zero slack). `smuggle > 0` (mutation-test hook) forges a
+    read `smuggle` layers past the footprint; `rim` below OVERLAP_RIM
+    forges a dropped/too-tight grid restriction — a region plan whose
+    interior band leaks toward the strips fails here with the kernel's
+    file:line."""
     import jax.numpy as jnp
 
     from ..ops import ns2d_fused as nf
@@ -437,29 +444,32 @@ def overlap_interior_entry_2d(smuggle: int = 0) -> HaloEntry:
     jl = il = 12
     base = _pre2d_entry("interior", size=jl)
     ext_pad = nf.FUSE_DEEP_HALO - 1
-    owned = _overlap_box((jl, il), ext_pad, nf.OVERLAP_RIM)
+    rim = nf.OVERLAP_RIM if rim is None else rim
+    owned = _overlap_box((jl, il), ext_pad, rim)
     fn = base.fn
     if smuggle:
         base_fn = base.fn
 
         def fn(u, v):
-            u = u + 1e-3 * jnp.roll(u, nf.FUSE_CHAIN + smuggle, axis=0)
+            u = u + 1e-3 * jnp.roll(u, nf.FUSE_FOOTPRINT + smuggle, axis=0)
             return base_fn(u, v)
 
     return HaloEntry(
         name="ns2d_fused.PRE[overlap interior half"
-             + (", smuggled]" if smuggle else "]"),
+             + (", smuggled]" if smuggle else f", rim={rim}]"
+                if rim != nf.OVERLAP_RIM else "]"),
         fn=fn,
         in_shapes=base.in_shapes,
         owned=owned,
-        declared=nf.FUSE_CHAIN,
+        declared=rim - 1,
         anchor=base.anchor,
         note="overlap interior region: cone must exclude the exchanged "
              "deep strips (stale-buffer safety, parallel/overlap.py)",
     )
 
 
-def overlap_interior_entry_3d(smuggle: int = 0) -> HaloEntry:
+def overlap_interior_entry_3d(smuggle: int = 0,
+                              rim: int | None = None) -> HaloEntry:
     """The 3-D twin of overlap_interior_entry_2d."""
     import jax.numpy as jnp
 
@@ -468,13 +478,15 @@ def overlap_interior_entry_3d(smuggle: int = 0) -> HaloEntry:
     size = 8
     base = _pre3d_entry(size=size)
     ext_pad = nf3.FUSE_DEEP_HALO - 1
-    owned = _overlap_box((size, size, size), ext_pad, nf3.OVERLAP_RIM)
+    rim = nf3.OVERLAP_RIM if rim is None else rim
+    owned = _overlap_box((size, size, size), ext_pad, rim)
     fn = base.fn
     if smuggle:
         base_fn = base.fn
 
         def fn(u, v, w):
-            u = u + 1e-3 * jnp.roll(u, nf3.FUSE_CHAIN + smuggle, axis=0)
+            u = u + 1e-3 * jnp.roll(u, nf3.FUSE_FOOTPRINT + smuggle,
+                                    axis=0)
             return base_fn(u, v, w)
 
     return HaloEntry(
@@ -483,7 +495,7 @@ def overlap_interior_entry_3d(smuggle: int = 0) -> HaloEntry:
         fn=fn,
         in_shapes=base.in_shapes,
         owned=owned,
-        declared=nf3.FUSE_CHAIN,
+        declared=rim - 1,
         anchor=base.anchor,
         note="overlap interior region: cone must exclude the exchanged "
              "deep strips (stale-buffer safety, parallel/overlap.py)",
@@ -512,14 +524,14 @@ def standard_entries() -> list:
 
 def pre_chain_footprint(seed: int = 0) -> int:
     """The MEASURED access footprint of the fused PRE chains (max over
-    the registry's PRE entries and inputs) — as opposed to the DECLARED
-    `FUSE_CHAIN` budget. Currently 2 of the declared 3: the chain budget
-    charges each stage ≤1 conservatively but no composed read path
-    consumes all three layers (see `_pre2d_entry`). A future perf pass
-    tempted by `FUSE_DEEP_HALO = 3` (ROADMAP carried-forward) must
-    re-derive through THIS function rather than trusting the
-    declaration; tests/test_analysis.py pins the current value so the
-    slack can only shrink loudly."""
+    the registry's PRE entries and inputs). Since the ROADMAP
+    carried-forward shrink landed, this IS the declaration:
+    `FUSE_FOOTPRINT` pins it and `FUSE_DEEP_HALO = FUSE_FOOTPRINT + 1`
+    ships exactly one strip layer beyond it (the extended ghost ring) —
+    zero slack, so a chain edit that widens any composed read path
+    fails the PRE entries loudly before a distributed run can consume
+    stale halos. tests/test_analysis.py pins the measured value against
+    the declaration."""
     depth = 0
     for entry in standard_entries():
         if ".PRE" not in entry.name or "[overlap" in entry.name:

@@ -251,7 +251,8 @@ class ChunkConfig:
         else:
             from ..parallel.comm import CartComm
 
-            comm = CartComm(ndims=len(self.dims), dims=self.dims)
+            comm = CartComm(ndims=len(self.dims), dims=self.dims,
+                            tiers=param.tpu_mesh_tiers)
             if self.family == "ns2d_dist":
                 from ..models.ns2d_dist import NS2DDistSolver
 
@@ -337,6 +338,31 @@ def standard_configs() -> list[ChunkConfig]:
             notes="double-buffered overlap: interior + boundary PRE "
                   "halves, the step N+1 deep exchange posted after POST "
                   "(ppermutes feed only the loop carry)"),
+        ChunkConfig(
+            "ns2d_dist_overlap_split", "ns2d_dist",
+            dict(_B2, tpu_fuse_phases="on", tpu_overlap="on",
+                 tpu_overlap_restrict="on", tpu_solver="sor"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist", "overlap_grid_ns2d_dist",
+                           "sweep_split_ns2d_dist"),
+            notes="the full item-3 schedule: grid-restricted PRE halves "
+                  "(forced — degenerate single-band at this shard size) "
+                  "+ jnp RB-SOR with SPLIT sweeps (per-colour depth-1 "
+                  "exchange posted behind the interior update)"),
+        ChunkConfig(
+            "ns2d_dist_tiered", "ns2d_dist",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard", tpu_mesh_tiers="i=dcn"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist"),
+            notes="hierarchical mesh tiers: the i axis declared DCN — "
+                  "its strips post first in every persistent exchange "
+                  "and the census breaks traffic out per tier "
+                  "(dcn/ici); same collectives, same bytes"),
         ChunkConfig(
             "ns2d_dist_ragged_fused", "ns2d_dist",
             dict(_B2, imax=18, jmax=18, tpu_fuse_phases="on",

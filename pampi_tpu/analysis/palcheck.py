@@ -404,6 +404,85 @@ def extra_entries() -> list:
     return out
 
 
+RULE_GRID = "pallas-grid-region"
+
+
+def restricted_grid_entries():
+    """The grid-restricted overlap PRE halves at a geometry where the
+    bands actually differ from the full sweep (explicit block_rows — the
+    matrix's 16² shards collapse to one block): builds the interior and
+    boundary halves for a (P,1)-mesh shard plus the full-sweep control,
+    and returns [(name, jaxpr, expected_grid_blocks, full_blocks), ...].
+    Trace-only. The standard resource rules run over these launches too
+    (`run`), and `restricted_grid_violations` pins that each half's grid
+    covers only its region — fewer grid steps than the full sweep, and
+    interior + boundary strictly below the 2x full-sweep count the
+    restriction replaced."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import ns2d_fused as nf
+    from ..parallel import overlap as ovl
+    from ..utils.params import Parameter
+
+    jl = il = 40
+    ext_pad = nf.FUSE_DEEP_HALO - 1
+    param = Parameter(name="dcavity", imax=80, jmax=80)
+    dt = jnp.float32
+    kw = dict(jl=jl, il=il, ext_pad=ext_pad, block_rows=8, interpret=True)
+    br, _h, wp, nb = nf.fused_deep_layout_2d(jl, il, dt, ext_pad,
+                                             block_rows=8)
+    plan = ovl.region_plan((jl, il), nf.OVERLAP_RIM, ext_pad, br, nb, wp,
+                           (True, False))
+    out = []
+    for name, bands in (("interior", plan["int_bands"]),
+                        ("boundary", plan["bnd_bands"]), ("full", None)):
+        pre, pad, _unpad, _hh = nf.make_fused_pre_2d(
+            param, 80, 80, 1.0 / 80, 1.0 / 80, dt, **kw, grid_bands=bands)
+        z = pad(jnp.zeros((jl + 2 + 2 * ext_pad,) * 2, dt))
+        offs = jnp.zeros((2,), jnp.int32)
+        dt11 = jnp.full((1, 1), 0.01, dt)
+        jx = jax.make_jaxpr(pre)(offs, dt11, z, z)
+        expect = (sum(n for _, n in bands) if bands is not None else nb)
+        out.append((f"ns2d_fused.PRE[restricted {name} half]", jx,
+                    expect, nb))
+    return out
+
+
+def restricted_grid_violations() -> list[Violation]:
+    """Grid-coverage pin for the restricted halves (see
+    restricted_grid_entries): each half's Pallas grid must have exactly
+    its band's block count, each below the full sweep, and the two
+    halves summed strictly below 2x full — the acceptance contract of
+    `tpu_overlap_restrict`."""
+    entries = restricted_grid_entries()
+    vs: list[Violation] = []
+    halves = {}
+    for name, jx, expect, full in entries:
+        ls = launches(jx.jaxpr)
+        if len(ls) != 1:
+            vs.append(Violation("<restricted-grid>", 1, RULE_GRID,
+                                f"{name}: expected 1 pallas_call, "
+                                f"traced {len(ls)}"))
+            continue
+        got = ls[0].grid[0] if ls[0].grid else 0
+        if got != expect:
+            vs.append(Violation(ls[0].path, ls[0].line, RULE_GRID,
+                                f"{name}: grid covers {got} blocks, the "
+                                f"region plan declares {expect} (of "
+                                f"{full} full-sweep blocks)"))
+        if "full" not in name:
+            halves[name] = got
+    if len(halves) == 2 and entries:
+        full = entries[0][3]
+        if sum(halves.values()) >= 2 * full:
+            vs.append(Violation(
+                "<restricted-grid>", 1, RULE_GRID,
+                f"restricted halves sweep {halves} blocks — not below "
+                f"the 2x{full} full-sweep count they must beat"))
+    return vs
+
+
 def check_jaxpr(jaxpr, budget: int | None = None,
                 context: str = "") -> list[Violation]:
     vs: list[Violation] = []
@@ -428,4 +507,9 @@ def run(traced=None, configs=None, budget: int | None = None,
     if extras:
         for name, jx in extra_entries():
             vs += check_jaxpr(jx.jaxpr, budget=budget, context=f"{name}/")
+        # the grid-restricted overlap halves: resource rules + the
+        # region-coverage pin (tpu_overlap_restrict)
+        for name, jx, _expect, _full in restricted_grid_entries():
+            vs += check_jaxpr(jx.jaxpr, budget=budget, context=f"{name}/")
+        vs += restricted_grid_violations()
     return vs

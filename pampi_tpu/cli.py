@@ -78,7 +78,8 @@ def _make_comm(param, ndims: int):
         (param.jmax, param.imax) if ndims == 2
         else (param.kmax, param.jmax, param.imax)
     )
-    comm = CartComm(ndims=ndims, dims=dims, extents=extents)
+    comm = CartComm(ndims=ndims, dims=dims, extents=extents,
+                    tiers=param.tpu_mesh_tiers)
     comm.print_config()
     return comm
 

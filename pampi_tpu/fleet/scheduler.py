@@ -367,7 +367,8 @@ class FleetScheduler:
 
             extents = ((param.jmax, param.imax) if ndims == 2
                        else (param.kmax, param.jmax, param.imax))
-            comm = CartComm(ndims=ndims, devices=devs, extents=extents)
+            comm = CartComm(ndims=ndims, devices=devs, extents=extents,
+                            tiers=param.tpu_mesh_tiers)
         solver = _build_solver(param, family, comm)
         with _tm.span(f"fleet.elastic_restore.{family}",
                       devices=len(devs)):

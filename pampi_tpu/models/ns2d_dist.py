@@ -63,6 +63,7 @@ from ..parallel.stencil2d import (
     ca_supported,
     embed_deep,
     rb_exchange_per_sweep,
+    rb_split_iter,
     strip_deep,
     wall_flags,
 )
@@ -106,7 +107,8 @@ class NS2DDistSolver:
         self.param = param
         self.dtype = dtype
         self.comm = comm if comm is not None else CartComm(
-            ndims=2, extents=(param.jmax, param.imax)
+            ndims=2, extents=(param.jmax, param.imax),
+            tiers=param.tpu_mesh_tiers,
         )
         self.imax, self.jmax = param.imax, param.jmax
         self.dx = param.xlength / param.imax
@@ -290,12 +292,15 @@ class NS2DDistSolver:
         epssq = param.eps * param.eps
         norm = float(self.imax * self.jmax)
 
-        def _solve_sor(p, rhs):
+        def _solve_sor(p, rhs, cap=None):
             """Communication-avoiding red-black solve (stencil2d.ca_*): one
             depth-2n halo exchange per n exact local iterations (n =
             tpu_ca_inner clamped by shard extents; trajectory identical to
             the exchange-per-half-sweep form). Extent-1 shards use the
-            classic per-half-sweep fallback."""
+            classic per-half-sweep fallback. `cap` (the residual-adaptive
+            budget, tpu_itermax_adaptive) dynamically tightens the static
+            itermax; None traces the historical loop."""
+            limit = param.itermax if cap is None else cap
             supported = ca_supported(jl, il)
             n = ca_inner(param, jl, il) if supported else 1
             H = ca_halo(n, ragged=self.ragged) if supported else 1
@@ -305,7 +310,7 @@ class NS2DDistSolver:
 
             def cond(c):
                 _, res, it = c
-                return jnp.logical_and(res >= epssq, it < param.itermax)
+                return jnp.logical_and(res >= epssq, it < limit)
 
             def body(c):
                 pd, _, it = c
@@ -327,6 +332,49 @@ class NS2DDistSolver:
                 (pd, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
             )
             return halo_exchange(strip_deep(pd, H), comm), res, it
+
+        def _solve_sor_split(p, rhs, cap=None):
+            """The sweep-split twin of _solve_sor (dispatched with the
+            overlapped schedule, ROADMAP item 3): same n-iteration
+            residual cadence as the CA form — the trajectory is bitwise
+            identical (the CA discipline already equals the per-half-
+            sweep form) — but each half-sweep posts its depth-1 exchange
+            behind the interior update (stencil2d.rb_split_iter), so on
+            a solve-dominated step no exchange sits serialized on the
+            critical path. Runs on the plain halo-1 layout; the rim-2
+            interior mask gates the merge."""
+            from ..parallel import overlap as _ovl
+            from ..parallel.comm import persistent_exchange
+
+            limit = param.itermax if cap is None else cap
+            supported = ca_supported(jl, il)
+            n = ca_inner(param, jl, il) if supported else 1
+            masks = ca_masks(jl, il, 1, self.jmax, self.imax, dtype)
+            int_mask = _ovl.interior_mask(
+                (jl, il), 2, partitioned=(Pj > 1, Pi > 1))
+            sched1 = persistent_exchange(comm, 1, dtype)
+
+            def cond(c):
+                _, res, it = c
+                return jnp.logical_and(res >= epssq, it < limit)
+
+            def body(c):
+                p, _, it = c
+                r2 = None
+                for _k in range(n):
+                    p, r2 = rb_split_iter(
+                        p, rhs, masks, sched1, int_mask, factor, idx2,
+                        idy2, ragged=self.ragged)
+                res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(comm, "{} Residuum: {}", it + (n - 1), res)
+                return p, res, it + n
+
+            p, res, it = lax.while_loop(
+                cond, body,
+                (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
+            )
+            return halo_exchange(p, comm), res, it
 
         # -- quarter-layout production pressure solve (the round-3 wiring of
         # the headline Pallas kernel into the distributed path; same dispatch
@@ -379,10 +427,11 @@ class NS2DDistSolver:
                 tag += " ragged"
             _dispatch.record("ns2d_dist", tag)
 
-        def _solve_sor_quarters(p, rhs):
+        def _solve_sor_quarters(p, rhs, cap=None):
             """Stacked-quarter CA solve on the halo-1 extended blocks the
             time-stepper carries; returns the exchanged halo-1 block like
             _solve_sor (adaptUV reads p across shard edges)."""
+            limit = param.itermax if cap is None else cap
             joff = get_offsets("j", jl)
             ioff = get_offsets("i", il)
             qoffs = jnp.stack(
@@ -393,7 +442,7 @@ class NS2DDistSolver:
 
             def cond(c):
                 _, res, it = c
-                return jnp.logical_and(res >= epssq, it < param.itermax)
+                return jnp.logical_and(res >= epssq, it < limit)
 
             def body(c):
                 xq, _, it = c
@@ -410,6 +459,20 @@ class NS2DDistSolver:
             )
             return halo_exchange(unpack_q_to_ext(xq, qg), comm), res, it
 
+        # pre-resolution of the overlap knob for the solve builders (the
+        # recorded decision happens after the fused build below — this
+        # predicate only selects the sweep-split smoother forms, whose
+        # values are bitwise the serial forms either way). It mirrors
+        # resolve_overlap's statically-known ineligibility (off / field
+        # faults / fused knob off); the one input not known yet — the
+        # fused probe failing at build — is healed by the serial MG
+        # rebuild next to the sweep_split record below.
+        ovl_pre = (param.tpu_overlap != "off"
+                   and not field_faults
+                   and param.tpu_fuse_phases != "off"
+                   and (param.tpu_overlap == "on"
+                        or jax.default_backend() == "tpu"))
+        mg_serial_rebuild = None
         if param.tpu_solver == "fft":
             from ..ops.dctpoisson import make_dist_dct_solve_2d
 
@@ -436,9 +499,18 @@ class NS2DDistSolver:
                 solve, mg_pallas = make_dist_mg_solve_2d(
                     comm, self.imax, self.jmax, jl, il, dx, dy,
                     param.eps, param.itermax, dtype,
-                    stall_rtol=param.tpu_mg_stall_rtol,
+                    stall_rtol=param.tpu_mg_stall_rtol, split=ovl_pre,
                 )
                 pallas_q = pallas_q or mg_pallas
+                if ovl_pre:
+                    def mg_serial_rebuild():
+                        s2, _ = make_dist_mg_solve_2d(
+                            comm, self.imax, self.jmax, jl, il, dx, dy,
+                            param.eps, param.itermax, dtype,
+                            stall_rtol=param.tpu_mg_stall_rtol,
+                            split=False,
+                        )
+                        return s2
         elif self.masks is not None:
             from ..ops.obstacle import make_dist_obstacle_solver
 
@@ -513,6 +585,54 @@ class NS2DDistSolver:
         overlap = _dispatch.resolve_overlap(
             param, "overlap_ns2d_dist", why_not=ovl_why)
         self._overlap = overlap
+        self._overlap_plan = None  # set by the overlap block when the
+        #   grid-restricted halves dispatch (tpu_overlap_restrict)
+        # sweep split (ROADMAP item 3 layer 2): with the overlapped
+        # schedule dispatched, the jnp RB-SOR convergence loop swaps to
+        # the per-half-sweep split form — bitwise the CA trajectory,
+        # with every depth-1 exchange posted behind an interior update.
+        # Pallas solve paths keep their serial sweeps (the kernel reads
+        # its whole block; a split needs kernel surgery, not a loop
+        # swap) and record why.
+        if overlap and solve is _solve_sor:
+            solve = _solve_sor_split
+            _dispatch.record("sweep_split_ns2d_dist", "split (jnp rb-sor)")
+        elif overlap and param.tpu_solver == "mg" and self.masks is None:
+            _dispatch.record("sweep_split_ns2d_dist",
+                             "split (mg jnp-smoother levels)")
+        elif overlap:
+            _dispatch.record("sweep_split_ns2d_dist",
+                             "serial (pallas/other solve)")
+        elif mg_serial_rebuild is not None:
+            # the pre-resolution guessed overlap but the fused probe
+            # failed at build: drop the split smoother so the traced
+            # program matches the recorded serial schedule
+            solve = mg_serial_rebuild()
+
+        # residual-adaptive itermax (tpu_itermax_adaptive, ROADMAP item
+        # 1's last open bullet): the previous step's (res, it) shrinks
+        # the NEXT solve's sweep budget inside the chunk loop — the cap
+        # rides the chunk carry only (external arity unchanged, resets
+        # to the full itermax at every chunk dispatch). Dist SOR paths
+        # only: mg counts cycles, fft does not iterate, the obstacle
+        # solvers carry their own loops.
+        adapt_n = int(param.tpu_itermax_adaptive)
+        use_cap = adapt_n > 0 and solve in (
+            _solve_sor, _solve_sor_split, _solve_sor_quarters)
+        if adapt_n > 0:
+            _dispatch.record(
+                "itermax_adaptive_ns2d_dist",
+                f"adaptive (+{adapt_n} slack)" if use_cap
+                else "static (solve path carries no sweep budget)")
+        itermax_i = jnp.asarray(param.itermax, jnp.int32)
+
+        def next_cap(res, it):
+            # converged within the budget -> cap the next solve at
+            # it + slack; a capped/non-converged solve restores the full
+            # itermax so the budget never wedges a hard step
+            return jnp.where(res < epssq,
+                             jnp.minimum(itermax_i, it + adapt_n),
+                             itermax_i)
 
         # -- weighted mean for normalizePressure ------------------------
         def wall_weight():
@@ -609,11 +729,12 @@ class NS2DDistSolver:
         adaptive = param.tau > 0.0
 
         # -- one full timestep ------------------------------------------
-        def step_phases(u, v, p, nt):
+        def step_phases(u, v, p, nt, cap=None):
             """All phases of one timestep up to (and incl.) the pressure
             solve; step() appends the projection, debug_kernel returns the
             intermediates (the automated heir of the reference's test.c
-            halo dump, SURVEY.md §4.1)."""
+            halo dump, SURVEY.md §4.1). `cap` is the residual-adaptive
+            sweep budget (None = the historical static-itermax trace)."""
             u, v, p = _fi.apply_field_faults(field_faults, nt, u=u, v=v, p=p)
             u = halo_exchange(u, comm)
             v = halo_exchange(v, comm)
@@ -640,11 +761,13 @@ class NS2DDistSolver:
             g = halo_shift(g, comm, "j")
             rhs = ops.compute_rhs(f, g, dt, dx, dy)
             p = lax.cond(nt % 100 == 0, normalize_pressure, lambda q: q, p)
-            p, res, it = solve(p, rhs)
+            p, res, it = (solve(p, rhs, cap) if cap is not None
+                          else solve(p, rhs))
             return u, v, f, g, rhs, p, dt, res, it
 
-        def step(u, v, p, t, nt):
-            u, v, f, g, _rhs, p, dt, res, it = step_phases(u, v, p, nt)
+        def step(u, v, p, t, nt, cap=None):
+            u, v, f, g, _rhs, p, dt, res, it = step_phases(u, v, p, nt,
+                                                           cap)
 
             def adapt(u, v):
                 if gmasks is not None:
@@ -681,15 +804,16 @@ class NS2DDistSolver:
             if _flags.verbose():
                 # printed AFTER t += dt, matching A5 main.c:52-57
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            capt = (next_cap(res, it),) if cap is not None else ()
             if metrics:
                 # mesh-global |u|/|v| maxima (replicated, like res) — the
                 # in-band telemetry scalars; Allreduce MAX only on this path
                 um = reduction(jnp.max(jnp.abs(u)), comm, "max")
                 vm = reduction(jnp.max(jnp.abs(v)), comm, "max")
-                return u, v, p, t_next, nt + 1, res, it, dt, um, vm
-            return u, v, p, t_next, nt + 1
+                return (u, v, p, t_next, nt + 1, res, it, dt, um, vm) + capt
+            return (u, v, p, t_next, nt + 1) + capt
 
-        def step_fused(u, v, p, t, nt):
+        def step_fused(u, v, p, t, nt, cap=None):
             """The fused-phase twin of step(): one deep exchange feeds the
             PRE kernel (BCs+FG+RHS per shard, redundant halo recompute
             bitwise-consistent across shards), the solve is unchanged, the
@@ -722,7 +846,8 @@ class NS2DDistSolver:
             g = strip_deep(unpad_deep(gpd), H)
             rhs = strip_deep(unpad_deep(rpd), H)
             p = lax.cond(nt % 100 == 0, normalize_pressure, lambda q: q, p)
-            p, _res, _it = solve(p, rhs)
+            p, _res, _it = (solve(p, rhs, cap) if cap is not None
+                            else solve(p, rhs))
             up, vp, um_l, vm_l = post_k(
                 offs, dt11, pad_ext(u), pad_ext(v), pad_ext(f), pad_ext(g),
                 pad_ext(p), *post_extra,
@@ -732,13 +857,15 @@ class NS2DDistSolver:
             t_next = t + dt.astype(idx_dtype)
             if _flags.verbose():
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            capt = (next_cap(_res, _it),) if cap is not None else ()
             if metrics:
                 # the POST kernel's carried maxima are per-shard: one
                 # Allreduce MAX makes them the global telemetry scalars
                 um = reduction(um_l, comm, "max")
                 vm = reduction(vm_l, comm, "max")
-                return u, v, p, t_next, nt + 1, _res, _it, dt, um, vm
-            return u, v, p, t_next, nt + 1
+                return (u, v, p, t_next, nt + 1, _res, _it, dt,
+                        um, vm) + capt
+            return (u, v, p, t_next, nt + 1) + capt
 
         if overlap:
             # -- overlapped fused step (parallel/overlap.py): the deep
@@ -750,13 +877,44 @@ class NS2DDistSolver:
             # buffered exchanged block — merged by the interior mask.
             # Trajectory == step_fused's bitwise (the interior cone
             # avoids the strips; max is reduction-order exact).
+            from ..ops import ns2d_fused as nf
             from ..ops.ns2d_fused import OVERLAP_RIM
             from ..parallel import overlap as _ovl
             from ..parallel.comm import persistent_exchange
 
             H = FUSE_DEEP_HALO
             deep_sched = persistent_exchange(comm, H, dtype)
-            int_mask = _ovl.interior_mask((jl, il), OVERLAP_RIM)
+            # axis-aware rim: a size-1 mesh axis exchanges nothing, so
+            # its sides are bit-identical between the stale block and
+            # the double buffer — the rim (and the boundary half's
+            # sweep) drops there (parallel/overlap.interior_slices)
+            part = (Pj > 1, Pi > 1)
+            int_mask = _ovl.interior_mask((jl, il), OVERLAP_RIM,
+                                          partitioned=part)
+            # grid restriction (tpu_overlap_restrict): band the two PRE
+            # halves over the leading axis — interior core rows for the
+            # interior half, OVERLAP_RIM bands (plus every row when the
+            # column axis is partitioned) for the boundary half
+            br_, _hh, wp_, nb_ = nf.fused_deep_layout_2d(
+                jl, il, dtype, H - 1)
+            plan = _ovl.region_plan((jl, il), OVERLAP_RIM, H - 1,
+                                    br_, nb_, wp_, part)
+            restrict = _dispatch.resolve_overlap_restrict(
+                param, "overlap_grid_ns2d_dist", plan)
+            self._overlap_plan = plan if restrict else None
+            pre_int = pre_bnd = None
+            if restrict:
+                fl_arg = True if self.masks is not None else None
+                pre_int = nf.make_fused_pre_2d(
+                    param, self.jmax, self.imax, dx, dy, dtype,
+                    jl=jl, il=il, ext_pad=H - 1, fluid=fl_arg,
+                    prof_dtype=idx_dtype,
+                    grid_bands=plan["int_bands"])[0]
+                pre_bnd = nf.make_fused_pre_2d(
+                    param, self.jmax, self.imax, dx, dy, dtype,
+                    jl=jl, il=il, ext_pad=H - 1, fluid=fl_arg,
+                    prof_dtype=idx_dtype,
+                    grid_bands=plan["bnd_bands"])[0]
 
             def exchange_buffers(u, v):
                 """Post the next step's deep exchange (the double
@@ -772,8 +930,15 @@ class NS2DDistSolver:
                 return (reduction(jnp.max(jnp.abs(ud)), comm, "max"),
                         reduction(jnp.max(jnp.abs(vd)), comm, "max"))
 
-            def step_overlap(u, v, p, t, nt, ud, vd, um, vm, gen):
+            def step_overlap(u, v, p, t, nt, ud, vd, um, vm, gen,
+                             cap=None):
                 pre_k, post_k = fused_k
+                # the restricted halves (when dispatched) are the SAME
+                # kernel on banded grids; values inside each band are
+                # bitwise the full sweep's (globally gated writes), and
+                # the merge mask selects only band-covered cells
+                pre_i = pre_int if pre_int is not None else pre_k
+                pre_b = pre_bnd if pre_bnd is not None else pre_k
                 dt = (cfl_from_maxima(um, vm) if adaptive
                       else jnp.asarray(param.dt, dtype))
                 # stale-buffer detector: a generation-skewed double
@@ -789,9 +954,9 @@ class NS2DDistSolver:
                     flg_deep, flg_ext = fused_flag_blocks()
                     pre_extra = (flg_deep,)
                     post_extra = (flg_ext,)
-                ints = pre_k(offs, dt11, pad_deep(embed_deep(u, H)),
+                ints = pre_i(offs, dt11, pad_deep(embed_deep(u, H)),
                              pad_deep(embed_deep(v, H)), *pre_extra)
-                bnds = pre_k(offs, dt11, pad_deep(ud), pad_deep(vd),
+                bnds = pre_b(offs, dt11, pad_deep(ud), pad_deep(vd),
                              *pre_extra)
                 u, v, f, g, rhs = _ovl.merge_halves(
                     int_mask,
@@ -799,7 +964,8 @@ class NS2DDistSolver:
                     [strip_deep(unpad_deep(b), H) for b in bnds])
                 p = lax.cond(nt % 100 == 0, normalize_pressure,
                              lambda q: q, p)
-                p, _res, _it = solve(p, rhs)
+                p, _res, _it = (solve(p, rhs, cap) if cap is not None
+                                else solve(p, rhs))
                 up, vp, um_l, vm_l = post_k(
                     offs, dt11, pad_ext(u), pad_ext(v), pad_ext(f),
                     pad_ext(g), pad_ext(p), *post_extra,
@@ -818,8 +984,9 @@ class NS2DDistSolver:
                 t_next = t + dt.astype(idx_dtype)
                 if _flags.verbose():
                     master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+                capt = (next_cap(_res, _it),) if cap is not None else ()
                 return (u, v, p, t_next, nt + 1, ud, vd, um, vm, nt + 1,
-                        _res, _it, dt)
+                        _res, _it, dt) + capt
 
         step_impl = step if fused_k is None else step_fused
         te = param.te
@@ -827,18 +994,24 @@ class NS2DDistSolver:
 
         def chunk_kernel(u, v, p, t, nt):
             def cond(c):
-                _, _, _, t, _, k = c
-                return jnp.logical_and(t <= te, k < chunk)
+                return jnp.logical_and(c[3] <= te, c[5] < chunk)
 
             def body(c):
+                if use_cap:
+                    u, v, p, t, nt, k, cap = c
+                    u, v, p, t, nt, cap = step_impl(u, v, p, t, nt, cap)
+                    return u, v, p, t, nt, k + 1, cap
                 u, v, p, t, nt, k = c
                 u, v, p, t, nt = step_impl(u, v, p, t, nt)
                 return u, v, p, t, nt, k + 1
 
-            u, v, p, t, nt, _ = lax.while_loop(
-                cond, body, (u, v, p, t, nt, jnp.asarray(0, jnp.int32))
-            )
-            return u, v, p, t, nt
+            init = (u, v, p, t, nt, jnp.asarray(0, jnp.int32))
+            if use_cap:
+                # the budget resets to the full itermax per chunk
+                # dispatch (external arity unchanged)
+                init = init + (itermax_i,)
+            out = lax.while_loop(cond, body, init)
+            return out[0], out[1], out[2], out[3], out[4]
 
         def chunk_kernel_metrics(u, v, p, t, nt, m):
             # the telemetry twin: replicated f32 metrics scalars ride the
@@ -847,20 +1020,28 @@ class NS2DDistSolver:
                 return jnp.logical_and(c[3] <= te, c[5] < chunk)
 
             def body(c):
-                u, v, p, t, nt, k, res, it, dtv, um, vm, bad = c
-                u, v, p, t, nt, res, it, dtv, um, vm = step_impl(
-                    u, v, p, t, nt
-                )
+                if use_cap:
+                    (u, v, p, t, nt, k, res, it, dtv, um, vm, bad,
+                     cap) = c
+                    u, v, p, t, nt, res, it, dtv, um, vm, cap = step_impl(
+                        u, v, p, t, nt, cap)
+                else:
+                    u, v, p, t, nt, k, res, it, dtv, um, vm, bad = c
+                    u, v, p, t, nt, res, it, dtv, um, vm = step_impl(
+                        u, v, p, t, nt
+                    )
                 res, it, dtv, um, vm, bad = _tm.metrics_step(
                     bad, nt, res, it, dtv, um, vm)
-                return u, v, p, t, nt, k + 1, res, it, dtv, um, vm, bad
+                out = (u, v, p, t, nt, k + 1, res, it, dtv, um, vm, bad)
+                return out + ((cap,) if use_cap else ())
 
-            (u, v, p, t, nt, _k, res, it, dtv, um, vm, bad) = lax.while_loop(
-                cond, body,
-                (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
-                 m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
-                 m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_BAD]),
-            )
+            init = (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
+                    m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                    m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_BAD])
+            if use_cap:
+                init = init + (itermax_i,)
+            out = lax.while_loop(cond, body, init)
+            (u, v, p, t, nt, _k, res, it, dtv, um, vm, bad) = out[:12]
             return u, v, p, t, nt, _tm.metrics_pack(
                 res, it, dtv, um, vm, 0.0, bad)
 
@@ -878,19 +1059,25 @@ class NS2DDistSolver:
                     return jnp.logical_and(c[3] <= te, c[5] < chunk)
 
                 def body(c):
+                    if use_cap:
+                        u, v, p, t, nt, k, ud, vd, um, vm, gen, cap = c
+                        (u, v, p, t, nt, ud, vd, um, vm, gen,
+                         _res, _it, _dt, cap) = step_overlap(
+                            u, v, p, t, nt, ud, vd, um, vm, gen, cap)
+                        return (u, v, p, t, nt, k + 1, ud, vd, um, vm,
+                                gen, cap)
                     u, v, p, t, nt, k, ud, vd, um, vm, gen = c
                     (u, v, p, t, nt, ud, vd, um, vm, gen,
                      _res, _it, _dt) = step_overlap(
                         u, v, p, t, nt, ud, vd, um, vm, gen)
                     return u, v, p, t, nt, k + 1, ud, vd, um, vm, gen
 
-                (u, v, p, t, nt, _k, _ud, _vd, _um, _vm,
-                 _gen) = lax.while_loop(
-                    cond, body,
-                    (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
-                     ud, vd, um, vm, nt),
-                )
-                return u, v, p, t, nt
+                init = (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
+                        ud, vd, um, vm, nt)
+                if use_cap:
+                    init = init + (itermax_i,)
+                out = lax.while_loop(cond, body, init)
+                return out[0], out[1], out[2], out[3], out[4]
 
             def chunk_kernel_overlap_metrics(u, v, p, t, nt, m):
                 ud, vd = exchange_buffers(u, v)
@@ -900,24 +1087,33 @@ class NS2DDistSolver:
                     return jnp.logical_and(c[3] <= te, c[5] < chunk)
 
                 def body(c):
-                    (u, v, p, t, nt, k, ud, vd, um, vm, gen,
-                     res, it, dtv, mum, mvm, bad) = c
-                    (u, v, p, t, nt, ud, vd, um, vm, gen,
-                     res, it, dtv) = step_overlap(
-                        u, v, p, t, nt, ud, vd, um, vm, gen)
+                    if use_cap:
+                        (u, v, p, t, nt, k, ud, vd, um, vm, gen,
+                         res, it, dtv, mum, mvm, bad, cap) = c
+                        (u, v, p, t, nt, ud, vd, um, vm, gen,
+                         res, it, dtv, cap) = step_overlap(
+                            u, v, p, t, nt, ud, vd, um, vm, gen, cap)
+                    else:
+                        (u, v, p, t, nt, k, ud, vd, um, vm, gen,
+                         res, it, dtv, mum, mvm, bad) = c
+                        (u, v, p, t, nt, ud, vd, um, vm, gen,
+                         res, it, dtv) = step_overlap(
+                            u, v, p, t, nt, ud, vd, um, vm, gen)
                     res, it, dtv, mum, mvm, bad = _tm.metrics_step(
                         bad, nt, res, it, dtv, um, vm)
-                    return (u, v, p, t, nt, k + 1, ud, vd, um, vm, gen,
-                            res, it, dtv, mum, mvm, bad)
+                    out = (u, v, p, t, nt, k + 1, ud, vd, um, vm, gen,
+                           res, it, dtv, mum, mvm, bad)
+                    return out + ((cap,) if use_cap else ())
 
+                init = (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
+                        ud, vd, um, vm, nt,
+                        m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                        m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_BAD])
+                if use_cap:
+                    init = init + (itermax_i,)
+                out = lax.while_loop(cond, body, init)
                 (u, v, p, t, nt, _k, _ud, _vd, _um, _vm, _gen,
-                 res, it, dtv, mum, mvm, bad) = lax.while_loop(
-                    cond, body,
-                    (u, v, p, t, nt, jnp.asarray(0, jnp.int32),
-                     ud, vd, um, vm, nt,
-                     m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
-                     m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_BAD]),
-                )
+                 res, it, dtv, mum, mvm, bad) = out[:17]
                 return u, v, p, t, nt, _tm.metrics_pack(
                     res, it, dtv, mum, mvm, 0.0, bad)
 
@@ -973,11 +1169,21 @@ class NS2DDistSolver:
                 halo_exchange_bytes((jl, il), 1, isz),
         }
         if fused_k is not None:
+            from ..ops.ns2d_fused import fused_deep_layout_2d
+
+            fbr, _fh, fwp, fnb = fused_deep_layout_2d(
+                jl, il, dtype, FUSE_DEEP_HALO - 1)
+            full_cells = fnb * fbr * fwp
             rec.update(
                 deep_halo=FUSE_DEEP_HALO,
                 deep_exchange_bytes=halo_exchange_bytes(
                     (jl, il), FUSE_DEEP_HALO, isz),
                 exchanges_per_step={"deep": 2},
+                # the per-step PRE grid sweep (swept padded cells):
+                # 1x full serial, 2x full for the PR 8 split halves,
+                # the banded plan's sum when grid-restricted — the
+                # BENCH/smoke metric the restriction is judged by
+                pre_grid_cells=full_cells,
             )
             if overlap:
                 # same per-step schedule (2 deep exchanges), but posted
@@ -986,12 +1192,26 @@ class NS2DDistSolver:
                 # census cross-check counts both classes
                 rec.update(path="fused_overlap",
                            overlap="double_buffered",
-                           exchanges_per_chunk={"deep": 2})
+                           exchanges_per_chunk={"deep": 2},
+                           pre_grid_cells=(
+                               self._overlap_plan["cells"]
+                               if self._overlap_plan is not None
+                               else 2 * full_cells),
+                           pre_grid_cells_full=2 * full_cells)
         else:
             rec.update(exchanges_per_step={
                 "depth1": 4 + (2 if gmasks is not None else 0),
                 "shift": 2,
             })
+        # hierarchical-exchange accounting (ROADMAP item 3): the axis->
+        # tier map and the per-step DCN-tier bytes — 0 on single-tier
+        # meshes, the first-class slow-fabric BENCH metric on a
+        # multi-slice pod (tools/bench_trend.py gates it downward)
+        from ..parallel.comm import exchange_schedule_tier_bytes
+
+        rec["tier_map"] = dict(comm.tiers)
+        rec["dcn_exchange_bytes"] = exchange_schedule_tier_bytes(
+            comm, rec).get("dcn", 0)
         self._halo_rec = rec
         if _tm.enabled():
             _tm.emit("halo", **rec)

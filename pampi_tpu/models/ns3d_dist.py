@@ -92,7 +92,8 @@ class NS3DDistSolver:
         self.param = param
         self.dtype = dtype
         self.comm = comm if comm is not None else CartComm(
-            ndims=3, extents=(param.kmax, param.jmax, param.imax)
+            ndims=3, extents=(param.kmax, param.jmax, param.imax),
+            tiers=param.tpu_mesh_tiers,
         )
         self.grid = Grid(
             imax=param.imax,
@@ -261,14 +262,16 @@ class NS3DDistSolver:
         epssq = param.eps * param.eps
         norm = float(g.imax * g.jmax * g.kmax)
 
-        def _solve_sor(p, rhs):
+        def _solve_sor(p, rhs, cap=None):
             """Communication-avoiding red-black solve (stencil3d.ca_*): one
             depth-2n halo exchange per n exact local iterations, n clamped by
             the shard extents (tpu_ca_inner; n=1 still halves the per-
             iteration message count vs exchange-per-half-sweep while keeping
             the trajectory identical). Shards with an extent of 1 cannot ship
             depth-2 strips from owned cells — they use the classic
-            exchange-per-half-sweep fallback."""
+            exchange-per-half-sweep fallback. `cap` is the residual-adaptive
+            budget (tpu_itermax_adaptive); None = the historical trace."""
+            limit = param.itermax if cap is None else cap
             supported = ca_supported(kl, jl, il)
             n = ca_inner(param, kl, jl, il) if supported else 1
             H = ca_halo(n, ragged=self.ragged) if supported else 1
@@ -277,7 +280,7 @@ class NS3DDistSolver:
             rd = halo_exchange(embed_deep(rhs, H), comm, depth=H)
 
             def cond(c):
-                return jnp.logical_and(c[1] >= epssq, c[2] < param.itermax)
+                return jnp.logical_and(c[1] >= epssq, c[2] < limit)
 
             def body(c):
                 pd, _, it = c
@@ -302,6 +305,47 @@ class NS3DDistSolver:
             )
             return halo_exchange(strip_deep(pd, H), comm), res, it
 
+        def _solve_sor_split(p, rhs, cap=None):
+            """The sweep-split twin of _solve_sor (dispatched with the
+            overlapped schedule — see models/ns2d_dist._solve_sor_split):
+            same n-iteration residual cadence, bitwise the CA
+            trajectory, every depth-1 exchange posted behind an
+            interior update (stencil3d.rb_split_iter_3d)."""
+            from ..parallel import overlap as _ovl
+            from ..parallel.comm import persistent_exchange
+            from ..parallel.stencil3d import rb_split_iter_3d
+
+            limit = param.itermax if cap is None else cap
+            supported = ca_supported(kl, jl, il)
+            n = ca_inner(param, kl, jl, il) if supported else 1
+            masks = ca_masks_3d(kl, jl, il, 1, g.kmax, g.jmax, g.imax,
+                                dtype)
+            int_mask = _ovl.interior_mask(
+                (kl, jl, il), 2,
+                partitioned=tuple(d > 1 for d in comm.dims))
+            sched1 = persistent_exchange(comm, 1, dtype)
+
+            def cond(c):
+                return jnp.logical_and(c[1] >= epssq, c[2] < limit)
+
+            def body(c):
+                p, _, it = c
+                r2 = None
+                for _k in range(n):
+                    p, r2 = rb_split_iter_3d(
+                        p, rhs, masks, sched1, int_mask, factor, idx2,
+                        idy2, idz2, ragged=self.ragged)
+                res = reduction(r2, comm, "sum") / norm
+                if _flags.debug():
+                    master_print(comm, "{} Residuum: {}", it + (n - 1), res)
+                return p, res, it + n
+
+            p, res, it = lax.while_loop(
+                cond, body,
+                (p, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32)),
+            )
+            return halo_exchange(p, comm), res, it
+
         # -- octant-layout production pressure solve (the round-3 wiring of
         # the 4.9x/iteration octant kernel into the distributed path; same
         # dispatch contract as models/ns2d_dist's quarters) ---------------
@@ -321,12 +365,13 @@ class NS3DDistSolver:
             _dispatch.record("ns3d_dist", tag)
         self._pallas_o = pallas_o
 
-        def _solve_sor_octants(p, rhs):
+        def _solve_sor_octants(p, rhs, cap=None):
             """Stacked-octant CA solve on the halo-1 extended blocks; returns
             the exchanged halo-1 block like _solve_sor (adaptUVW reads p
             across shard edges, ≙ the trailing commExchange solver.c:288)."""
             from ..parallel.comm import get_offsets
 
+            limit = param.itermax if cap is None else cap
             koff = get_offsets("k", kl)
             joff = get_offsets("j", jl)
             ioff = get_offsets("i", il)
@@ -339,7 +384,7 @@ class NS3DDistSolver:
             xo = pack_ext_to_o(p, og)
 
             def cond(c):
-                return jnp.logical_and(c[1] >= epssq, c[2] < param.itermax)
+                return jnp.logical_and(c[1] >= epssq, c[2] < limit)
 
             def body(c):
                 xo, _, it = c
@@ -356,6 +401,17 @@ class NS3DDistSolver:
             )
             return halo_exchange(unpack_o_to_ext(xo, og), comm), res, it
 
+        # pre-resolution of the overlap knob for the solve builders (see
+        # models/ns2d_dist.py — selects the sweep-split smoother forms,
+        # bitwise the serial forms either way; statically-known
+        # ineligibility mirrored, fused-probe failure healed by the
+        # serial MG rebuild at the sweep_split record)
+        ovl_pre = (param.tpu_overlap != "off"
+                   and not field_faults
+                   and param.tpu_fuse_phases != "off"
+                   and (param.tpu_overlap == "on"
+                        or jax.default_backend() == "tpu"))
+        mg_serial_rebuild = None
         if param.tpu_solver == "fft":
             from ..ops.dctpoisson import make_dist_dct_solve_3d
 
@@ -382,10 +438,19 @@ class NS3DDistSolver:
                 solve, mg_pallas = make_dist_mg_solve_3d(
                     comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                     param.eps, param.itermax, dtype,
-                    stall_rtol=param.tpu_mg_stall_rtol,
+                    stall_rtol=param.tpu_mg_stall_rtol, split=ovl_pre,
                 )
                 pallas_o = pallas_o or mg_pallas
                 self._pallas_o = pallas_o
+                if ovl_pre:
+                    def mg_serial_rebuild():
+                        s2, _ = make_dist_mg_solve_3d(
+                            comm, g.imax, g.jmax, g.kmax, kl, jl, il,
+                            dx, dy, dz, param.eps, param.itermax, dtype,
+                            stall_rtol=param.tpu_mg_stall_rtol,
+                            split=False,
+                        )
+                        return s2
         elif self.masks is not None:
             from ..ops.obstacle3d import make_dist_obstacle_solver_3d
 
@@ -454,6 +519,41 @@ class NS3DDistSolver:
         overlap = _dispatch.resolve_overlap(
             param, "overlap_ns3d_dist", why_not=ovl_why)
         self._overlap = overlap
+        self._overlap_plan = None  # set by the overlap block when the
+        #   grid-restricted halves dispatch (tpu_overlap_restrict)
+        # sweep split (see models/ns2d_dist.py)
+        if overlap and solve is _solve_sor:
+            solve = _solve_sor_split
+            _dispatch.record("sweep_split_ns3d_dist", "split (jnp rb-sor)")
+        elif overlap and param.tpu_solver == "mg" and self.masks is None:
+            _dispatch.record("sweep_split_ns3d_dist",
+                             "split (mg jnp-smoother levels)")
+        elif not overlap and mg_serial_rebuild is not None:
+            # the pre-resolution guessed overlap but the fused probe
+            # failed at build: drop the split smoother so the traced
+            # program matches the recorded serial schedule
+            solve = mg_serial_rebuild()
+        elif overlap:
+            _dispatch.record("sweep_split_ns3d_dist",
+                             "serial (pallas/other solve)")
+
+        # residual-adaptive itermax (see models/ns2d_dist.py): the cap
+        # rides the chunk carry only, resets per chunk dispatch; dist
+        # SOR paths only
+        adapt_n = int(param.tpu_itermax_adaptive)
+        use_cap = adapt_n > 0 and solve in (
+            _solve_sor, _solve_sor_split, _solve_sor_octants)
+        if adapt_n > 0:
+            _dispatch.record(
+                "itermax_adaptive_ns3d_dist",
+                f"adaptive (+{adapt_n} slack)" if use_cap
+                else "static (solve path carries no sweep budget)")
+        itermax_i = jnp.asarray(param.itermax, jnp.int32)
+
+        def next_cap(res, it):
+            return jnp.where(res < epssq,
+                             jnp.minimum(itermax_i, it + adapt_n),
+                             itermax_i)
 
         gmasks = self.masks
         if gmasks is not None:
@@ -528,7 +628,7 @@ class NS3DDistSolver:
         adaptive = param.tau > 0.0
         idx_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
-        def step(u, v, w, p, t, nt):
+        def step(u, v, w, p, t, nt, cap=None):
             u, v, w, p = _fi.apply_field_faults(field_faults, nt, u=u, v=v,
                                                 w=w, p=p)
             u = halo_exchange(u, comm)
@@ -560,7 +660,8 @@ class NS3DDistSolver:
             g_ = halo_shift(g_, comm, "j")
             h = halo_shift(h, comm, "k")
             rhs = ops.compute_rhs(f, g_, h, dt, dx, dy, dz)
-            p, res, it = solve(p, rhs)
+            p, res, it = (solve(p, rhs, cap) if cap is not None
+                          else solve(p, rhs))
 
             def adapt(u, v, w):
                 if gmasks is not None:
@@ -595,15 +696,17 @@ class NS3DDistSolver:
             if _flags.verbose():
                 # printed AFTER t += dt, matching A6 main.c:58-62
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            capt = (next_cap(res, it),) if cap is not None else ()
             if metrics:
                 # mesh-global maxima (replicated) — telemetry scalars
                 um = reduction(jnp.max(jnp.abs(u)), comm, "max")
                 vm = reduction(jnp.max(jnp.abs(v)), comm, "max")
                 wm = reduction(jnp.max(jnp.abs(w)), comm, "max")
-                return u, v, w, p, t_next, nt + 1, res, it, dt, um, vm, wm
-            return u, v, w, p, t_next, nt + 1
+                return (u, v, w, p, t_next, nt + 1, res, it, dt,
+                        um, vm, wm) + capt
+            return (u, v, w, p, t_next, nt + 1) + capt
 
-        def step_fused(u, v, w, p, t, nt):
+        def step_fused(u, v, w, p, t, nt, cap=None):
             """The fused-phase twin of step() (see models/ns2d_dist.py):
             one deep exchange feeds the PRE kernel, the solve is unchanged,
             the POST kernel projects on the exchanged extended blocks."""
@@ -642,7 +745,8 @@ class NS3DDistSolver:
             g_ = strip_deep(unpad_deep(gpd), H)
             h = strip_deep(unpad_deep(hpd), H)
             rhs = strip_deep(unpad_deep(rpd), H)
-            p, _res, _it = solve(p, rhs)
+            p, _res, _it = (solve(p, rhs, cap) if cap is not None
+                            else solve(p, rhs))
             up, vp, wp, um_l, vm_l, wm_l = post_k(
                 offs, dt11, pad_ext(u), pad_ext(v), pad_ext(w),
                 pad_ext(f), pad_ext(g_), pad_ext(h), pad_ext(p),
@@ -654,6 +758,7 @@ class NS3DDistSolver:
             t_next = t + dt.astype(idx_dtype)
             if _flags.verbose():
                 master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+            capt = (next_cap(_res, _it),) if cap is not None else ()
             if metrics:
                 # the POST kernel's maxima are per-shard: Allreduce MAX
                 # makes them the global telemetry scalars
@@ -661,8 +766,8 @@ class NS3DDistSolver:
                 vm = reduction(vm_l, comm, "max")
                 wm = reduction(wm_l, comm, "max")
                 return (u, v, w, p, t_next, nt + 1, _res, _it, dt,
-                        um, vm, wm)
-            return u, v, w, p, t_next, nt + 1
+                        um, vm, wm) + capt
+            return (u, v, w, p, t_next, nt + 1) + capt
 
         if overlap:
             # -- overlapped fused step (parallel/overlap.py; see
@@ -671,13 +776,37 @@ class NS3DDistSolver:
             # carried double-buffered; PRE runs as interior (stale
             # blocks) + boundary (buffered exchanged blocks) halves
             # merged by the interior mask; dt from the carried maxima.
+            from ..ops import ns3d_fused as nf3
             from ..ops.ns3d_fused import OVERLAP_RIM
             from ..parallel import overlap as _ovl
             from ..parallel.comm import get_offsets, persistent_exchange
 
             H3 = FUSE_DEEP_HALO
             deep_sched = persistent_exchange(comm, H3, dtype)
-            int_mask = _ovl.interior_mask((kl, jl, il), OVERLAP_RIM)
+            # axis-aware rim + grid restriction over the leading k axis
+            # (see models/ns2d_dist.py — same plan, k-plane bands)
+            part3 = tuple(d > 1 for d in comm.dims)
+            int_mask = _ovl.interior_mask((kl, jl, il), OVERLAP_RIM,
+                                          partitioned=part3)
+            bk_, _hh3, pw_, nbk_ = nf3.fused_deep_layout_3d(
+                kl, jl, il, dtype, H3 - 1,
+                masked=self.masks is not None)
+            plan3 = _ovl.region_plan((kl, jl, il), OVERLAP_RIM, H3 - 1,
+                                     bk_, nbk_, pw_, part3)
+            restrict3 = _dispatch.resolve_overlap_restrict(
+                param, "overlap_grid_ns3d_dist", plan3)
+            self._overlap_plan = plan3 if restrict3 else None
+            pre_int = pre_bnd = None
+            if restrict3:
+                fl_arg = True if self.masks is not None else None
+                pre_int = nf3.make_fused_pre_3d(
+                    param, g.kmax, g.jmax, g.imax, dx, dy, dz, dtype,
+                    kl=kl, jl=jl, il=il, ext_pad=H3 - 1, fluid=fl_arg,
+                    grid_bands=plan3["int_bands"])[0]
+                pre_bnd = nf3.make_fused_pre_3d(
+                    param, g.kmax, g.jmax, g.imax, dx, dy, dz, dtype,
+                    kl=kl, jl=jl, il=il, ext_pad=H3 - 1, fluid=fl_arg,
+                    grid_bands=plan3["bnd_bands"])[0]
 
             def exchange_buffers(u, v, w):
                 return (deep_sched(embed_deep(u, H3)),
@@ -690,8 +819,10 @@ class NS3DDistSolver:
                         reduction(jnp.max(jnp.abs(wd)), comm, "max"))
 
             def step_overlap(u, v, w, p, t, nt, ud, vd, wd,
-                             um, vm, wm, gen):
+                             um, vm, wm, gen, cap=None):
                 pre_k, post_k = fused_k
+                pre_i = pre_int if pre_int is not None else pre_k
+                pre_b = pre_bnd if pre_bnd is not None else pre_k
                 dt = (cfl_from_maxima(um, vm, wm) if adaptive
                       else jnp.asarray(param.dt, dtype))
                 dt = _ovl.generation_guard(dt, gen, nt)
@@ -706,16 +837,17 @@ class NS3DDistSolver:
                     flg_deep, flg_ext = fused_flag_blocks()
                     pre_extra = (flg_deep,)
                     post_extra = (flg_ext,)
-                ints = pre_k(offs, dt11, pad_deep(embed_deep(u, H3)),
+                ints = pre_i(offs, dt11, pad_deep(embed_deep(u, H3)),
                              pad_deep(embed_deep(v, H3)),
                              pad_deep(embed_deep(w, H3)), *pre_extra)
-                bnds = pre_k(offs, dt11, pad_deep(ud), pad_deep(vd),
+                bnds = pre_b(offs, dt11, pad_deep(ud), pad_deep(vd),
                              pad_deep(wd), *pre_extra)
                 u, v, w, f, g_, h, rhs = _ovl.merge_halves(
                     int_mask,
                     [strip_deep(unpad_deep(a), H3) for a in ints],
                     [strip_deep(unpad_deep(b), H3) for b in bnds])
-                p, _res, _it = solve(p, rhs)
+                p, _res, _it = (solve(p, rhs, cap) if cap is not None
+                                else solve(p, rhs))
                 up, vp, wp, um_l, vm_l, wm_l = post_k(
                     offs, dt11, pad_ext(u), pad_ext(v), pad_ext(w),
                     pad_ext(f), pad_ext(g_), pad_ext(h), pad_ext(p),
@@ -732,8 +864,9 @@ class NS3DDistSolver:
                 t_next = t + dt.astype(idx_dtype)
                 if _flags.verbose():
                     master_print(comm, "TIME {} , TIMESTEP {}", t_next, dt)
+                capt = (next_cap(_res, _it),) if cap is not None else ()
                 return (u, v, w, p, t_next, nt + 1, ud, vd, wd,
-                        um, vm, wm, nt + 1, _res, _it, dt)
+                        um, vm, wm, nt + 1, _res, _it, dt) + capt
 
         step_impl = step if fused_k is None else step_fused
         te = param.te
@@ -744,14 +877,20 @@ class NS3DDistSolver:
                 return jnp.logical_and(c[4] <= te, c[6] < chunk)
 
             def body(c):
+                if use_cap:
+                    u, v, w, p, t, nt, k, cap = c
+                    u, v, w, p, t, nt, cap = step_impl(u, v, w, p, t, nt,
+                                                       cap)
+                    return u, v, w, p, t, nt, k + 1, cap
                 u, v, w, p, t, nt, k = c
                 u, v, w, p, t, nt = step_impl(u, v, w, p, t, nt)
                 return u, v, w, p, t, nt, k + 1
 
-            u, v, w, p, t, nt, _ = lax.while_loop(
-                cond, body, (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32))
-            )
-            return u, v, w, p, t, nt
+            init = (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32))
+            if use_cap:
+                init = init + (itermax_i,)
+            out = lax.while_loop(cond, body, init)
+            return out[0], out[1], out[2], out[3], out[4], out[5]
 
         def chunk_kernel_metrics(u, v, w, p, t, nt, m):
             # the telemetry twin (see models/ns2d_dist.py)
@@ -759,22 +898,32 @@ class NS3DDistSolver:
                 return jnp.logical_and(c[4] <= te, c[6] < chunk)
 
             def body(c):
-                u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm, bad = c
-                (u, v, w, p, t, nt,
-                 res, it, dtv, um, vm, wm) = step_impl(u, v, w, p, t, nt)
+                if use_cap:
+                    (u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm,
+                     bad, cap) = c
+                    (u, v, w, p, t, nt, res, it, dtv, um, vm, wm,
+                     cap) = step_impl(u, v, w, p, t, nt, cap)
+                else:
+                    (u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm,
+                     bad) = c
+                    (u, v, w, p, t, nt,
+                     res, it, dtv, um, vm, wm) = step_impl(u, v, w, p,
+                                                           t, nt)
                 res, it, dtv, um, vm, wm, bad = _tm.metrics_step(
                     bad, nt, res, it, dtv, um, vm, wm)
-                return (u, v, w, p, t, nt, k + 1,
-                        res, it, dtv, um, vm, wm, bad)
+                out = (u, v, w, p, t, nt, k + 1,
+                       res, it, dtv, um, vm, wm, bad)
+                return out + ((cap,) if use_cap else ())
 
+            init = (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
+                    m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                    m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_WMAX],
+                    m[_tm.M_BAD])
+            if use_cap:
+                init = init + (itermax_i,)
+            out = lax.while_loop(cond, body, init)
             (u, v, w, p, t, nt, _k,
-             res, it, dtv, um, vm, wm, bad) = lax.while_loop(
-                cond, body,
-                (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
-                 m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
-                 m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_WMAX],
-                 m[_tm.M_BAD]),
-            )
+             res, it, dtv, um, vm, wm, bad) = out[:14]
             return u, v, w, p, t, nt, _tm.metrics_pack(
                 res, it, dtv, um, vm, wm, bad)
 
@@ -791,6 +940,15 @@ class NS3DDistSolver:
                     return jnp.logical_and(c[4] <= te, c[6] < chunk)
 
                 def body(c):
+                    if use_cap:
+                        (u, v, w, p, t, nt, k, ud, vd, wd, um, vm, wm,
+                         gen, cap) = c
+                        (u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen,
+                         _res, _it, _dt, cap) = step_overlap(
+                            u, v, w, p, t, nt, ud, vd, wd, um, vm, wm,
+                            gen, cap)
+                        return (u, v, w, p, t, nt, k + 1, ud, vd, wd,
+                                um, vm, wm, gen, cap)
                     u, v, w, p, t, nt, k, ud, vd, wd, um, vm, wm, gen = c
                     (u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen,
                      _res, _it, _dt) = step_overlap(
@@ -798,13 +956,12 @@ class NS3DDistSolver:
                     return (u, v, w, p, t, nt, k + 1, ud, vd, wd,
                             um, vm, wm, gen)
 
-                (u, v, w, p, t, nt, _k, _ud, _vd, _wd, _um, _vm, _wm,
-                 _gen) = lax.while_loop(
-                    cond, body,
-                    (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
-                     ud, vd, wd, um, vm, wm, nt),
-                )
-                return u, v, w, p, t, nt
+                init = (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
+                        ud, vd, wd, um, vm, wm, nt)
+                if use_cap:
+                    init = init + (itermax_i,)
+                out = lax.while_loop(cond, body, init)
+                return out[0], out[1], out[2], out[3], out[4], out[5]
 
             def chunk_kernel_overlap_metrics(u, v, w, p, t, nt, m):
                 ud, vd, wd = exchange_buffers(u, v, w)
@@ -814,26 +971,37 @@ class NS3DDistSolver:
                     return jnp.logical_and(c[4] <= te, c[6] < chunk)
 
                 def body(c):
-                    (u, v, w, p, t, nt, k, ud, vd, wd, um, vm, wm, gen,
-                     res, it, dtv, mum, mvm, mwm, bad) = c
-                    (u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen,
-                     res, it, dtv) = step_overlap(
-                        u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen)
+                    if use_cap:
+                        (u, v, w, p, t, nt, k, ud, vd, wd, um, vm, wm,
+                         gen, res, it, dtv, mum, mvm, mwm, bad, cap) = c
+                        (u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen,
+                         res, it, dtv, cap) = step_overlap(
+                            u, v, w, p, t, nt, ud, vd, wd, um, vm, wm,
+                            gen, cap)
+                    else:
+                        (u, v, w, p, t, nt, k, ud, vd, wd, um, vm, wm,
+                         gen, res, it, dtv, mum, mvm, mwm, bad) = c
+                        (u, v, w, p, t, nt, ud, vd, wd, um, vm, wm, gen,
+                         res, it, dtv) = step_overlap(
+                            u, v, w, p, t, nt, ud, vd, wd, um, vm, wm,
+                            gen)
                     res, it, dtv, mum, mvm, mwm, bad = _tm.metrics_step(
                         bad, nt, res, it, dtv, um, vm, wm)
-                    return (u, v, w, p, t, nt, k + 1, ud, vd, wd,
-                            um, vm, wm, gen,
-                            res, it, dtv, mum, mvm, mwm, bad)
+                    out = (u, v, w, p, t, nt, k + 1, ud, vd, wd,
+                           um, vm, wm, gen,
+                           res, it, dtv, mum, mvm, mwm, bad)
+                    return out + ((cap,) if use_cap else ())
 
+                init = (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
+                        ud, vd, wd, um, vm, wm, nt,
+                        m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+                        m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_WMAX],
+                        m[_tm.M_BAD])
+                if use_cap:
+                    init = init + (itermax_i,)
+                out = lax.while_loop(cond, body, init)
                 (u, v, w, p, t, nt, _k, _ud, _vd, _wd, _um, _vm, _wm,
-                 _gen, res, it, dtv, mum, mvm, mwm, bad) = lax.while_loop(
-                    cond, body,
-                    (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
-                     ud, vd, wd, um, vm, wm, nt,
-                     m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
-                     m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_WMAX],
-                     m[_tm.M_BAD]),
-                )
+                 _gen, res, it, dtv, mum, mvm, mwm, bad) = out[:21]
                 return u, v, w, p, t, nt, _tm.metrics_pack(
                     res, it, dtv, mum, mvm, mwm, bad)
 
@@ -897,11 +1065,18 @@ class NS3DDistSolver:
                 halo_exchange_bytes((kl, jl, il), 1, isz),
         }
         if fused_k is not None:
+            from ..ops.ns3d_fused import fused_deep_layout_3d
+
+            fbk, _fh3, fpw, fnb3 = fused_deep_layout_3d(
+                kl, jl, il, dtype, FUSE_DEEP_HALO - 1,
+                masked=gmasks is not None)
+            full_cells = fnb3 * fbk * fpw
             rec.update(
                 deep_halo=FUSE_DEEP_HALO,
                 deep_exchange_bytes=halo_exchange_bytes(
                     (kl, jl, il), FUSE_DEEP_HALO, isz),
                 exchanges_per_step={"deep": 3},
+                pre_grid_cells=full_cells,
             )
             if overlap:
                 # same per-step schedule, posted into the double buffer;
@@ -909,12 +1084,26 @@ class NS3DDistSolver:
                 # models/ns2d_dist.py)
                 rec.update(path="fused_overlap",
                            overlap="double_buffered",
-                           exchanges_per_chunk={"deep": 3})
+                           exchanges_per_chunk={"deep": 3},
+                           pre_grid_cells=(
+                               self._overlap_plan["cells"]
+                               if self._overlap_plan is not None
+                               else 2 * full_cells),
+                           pre_grid_cells_full=2 * full_cells)
         else:
             rec.update(exchanges_per_step={
                 "depth1": 6 + (3 if gmasks is not None else 0),
                 "shift": 3,
             })
+        # hierarchical-exchange accounting (ROADMAP item 3): the axis->
+        # tier map and the per-step DCN-tier bytes — 0 on single-tier
+        # meshes, the first-class slow-fabric BENCH metric on a
+        # multi-slice pod (tools/bench_trend.py gates it downward)
+        from ..parallel.comm import exchange_schedule_tier_bytes
+
+        rec["tier_map"] = dict(comm.tiers)
+        rec["dcn_exchange_bytes"] = exchange_schedule_tier_bytes(
+            comm, rec).get("dcn", 0)
         self._halo_rec = rec
         if _tm.enabled():
             _tm.emit("halo", **rec)

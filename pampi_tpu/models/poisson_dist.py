@@ -86,7 +86,8 @@ class DistPoissonSolver:
         self.param = param
         self.dtype = dtype
         self.comm = comm if comm is not None else CartComm(
-            ndims=2, extents=(param.jmax, param.imax)
+            ndims=2, extents=(param.jmax, param.imax),
+            tiers=param.tpu_mesh_tiers,
         )
         self.imax, self.jmax = param.imax, param.jmax
         self.dx = param.xlength / param.imax

@@ -909,7 +909,8 @@ def _pallas_dist_smoother_3d(comm, gkmax, gjmax, gimax, kl, jl, il,
 
 def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                           dtype, n_pre: int = 2, n_post: int = 2,
-                          stall_rtol=MG_STALL_RTOL, backend: str = "auto"):
+                          stall_rtol=MG_STALL_RTOL, backend: str = "auto",
+                          split: bool = False):
     """Distributed-MG convergence loop (shard_map kernel side): builds
     `(p_ext, rhs_ext) -> (p_ext, res, it)` on the halo-1 extended local
     block — the same contract as the distributed SOR solve; `it` counts
@@ -926,9 +927,14 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
         get_offsets,
         halo_exchange,
         master_print,
+        persistent_exchange,
         reduction,
     )
-    from ..parallel.stencil2d import ca_masks, rb_exchange_per_sweep
+    from ..parallel.stencil2d import (
+        ca_masks,
+        rb_exchange_per_sweep,
+        rb_split_iter,
+    )
     from .dctpoisson import poisson_dct_2d
 
     Pj = comm.axis_size("j")
@@ -967,12 +973,28 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
         c = cfg[lvl]
         return ca_masks(c["jl"], c["il"], 1, c["jmax"], c["imax"], dtype)
 
+    # sweep-split smoothing (`split=True`, the overlapped-schedule
+    # caller): the jnp-fallback levels post each half-sweep's depth-1
+    # exchange behind the rim-2 interior update (stencil2d.rb_split_iter
+    # — bitwise the serial per-half-sweep smoother). Pallas-smoothed
+    # levels keep their deep-exchange sweeps either way.
+    sched1 = persistent_exchange(comm, 1, dtype) if split else None
+    part = tuple(d > 1 for d in comm.dims)
+
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
         k = sm.get((lvl, n))
         if k is not None:
             return k(p, rhs)
         m = masks_at(lvl)
+        if split:
+            from ..parallel.overlap import interior_mask
+
+            im = interior_mask((c["jl"], c["il"]), 2, partitioned=part)
+            for _ in range(n):
+                p, _ = rb_split_iter(p, rhs, m, sched1, im, c["factor"],
+                                     c["idx2"], c["idy2"])
+            return p
         for _ in range(n):
             p, _ = rb_exchange_per_sweep(
                 p, rhs, m, comm, c["factor"], c["idx2"], c["idy2"]
@@ -1044,21 +1066,24 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
 def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
                           eps, itermax, dtype, n_pre: int = 2,
                           n_post: int = 2, stall_rtol=MG_STALL_RTOL,
-                          backend: str = "auto"):
+                          backend: str = "auto", split: bool = False):
     """3-D twin of make_dist_mg_solve_2d (same stall_rtol contract; returns
-    `(solve, used_pallas)` like the 2-D twin)."""
+    `(solve, used_pallas)` like the 2-D twin; `split` swaps the jnp-
+    fallback smoother levels to the sweep-split form)."""
     from jax import lax as _lax
 
     from ..parallel.comm import (
         get_offsets,
         halo_exchange,
         master_print,
+        persistent_exchange,
         reduction,
     )
     from ..parallel.stencil3d import (
         ca_masks_3d,
         neumann_masked_3d,
         rb_exchange_per_sweep_3d,
+        rb_split_iter_3d,
     )
 
     from .dctpoisson import poisson_dct_3d
@@ -1102,12 +1127,26 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
         return ca_masks_3d(c["kl"], c["jl"], c["il"], 1,
                            c["kmax"], c["jmax"], c["imax"], dtype)
 
+    # sweep-split smoothing (see the 2-D twin)
+    sched1 = persistent_exchange(comm, 1, dtype) if split else None
+    part = tuple(d > 1 for d in comm.dims)
+
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
         k = sm.get((lvl, n))
         if k is not None:
             return k(p, rhs)
         m = masks_at(lvl)
+        if split:
+            from ..parallel.overlap import interior_mask
+
+            im = interior_mask((c["kl"], c["jl"], c["il"]), 2,
+                               partitioned=part)
+            for _ in range(n):
+                p, _ = rb_split_iter_3d(
+                    p, rhs, m, sched1, im, c["factor"],
+                    c["idx2"], c["idy2"], c["idz2"])
+            return p
         for _ in range(n):
             p, _ = rb_exchange_per_sweep_3d(
                 p, rhs, m, comm, c["factor"],

@@ -91,16 +91,28 @@ NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
 
 # validity consumed between the raw u/v window and the RHS: wall BC (reads
 # <=1 cell), obstacle velocity BC (<=1), F/G predictor (<=1), RHS (<=1 but
-# only on the low side) — 3 layers cover the chain; the deep-halo exchange
-# ships one extra because embed_deep's own ghost layer sits at depth H-1
+# only on the low side) — 3 layers bound the chain stage-by-stage
 FUSE_CHAIN = 3
-FUSE_DEEP_HALO = FUSE_CHAIN + 1
+# the MEASURED access footprint of the composed chain
+# (halocheck.pre_chain_footprint, pinned by tests/test_analysis.py):
+# RHS reads F/G only same-row/low-side and G reads u only northward, so
+# no composed read path consumes all three budgeted layers — 2 is what
+# the deep exchange must actually cover. A chain edit that widens the
+# footprint fails halocheck's PRE entries (declared = FUSE_FOOTPRINT)
+# before any distributed run can corrupt.
+FUSE_FOOTPRINT = 2
+# deep-halo exchange depth: the measured footprint plus the extended
+# block's own ghost layer (which the depth-H exchange refreshes on
+# partitioned axes). Was FUSE_CHAIN + 1 = 4 until the footprint
+# derivation shrank it (ROADMAP carried-forward): one whole strip layer
+# of exchange bytes saved on every dist step.
+FUSE_DEEP_HALO = FUSE_FOOTPRINT + 1
 # comm/compute overlap (parallel/overlap.py): extended-block cells at
-# least this far from the block edge have a FUSE_CHAIN dependency cone
-# that never reaches the exchanged deep-halo strips — the interior half
-# of the split PRE call is gated to them (its measured footprint
-# excludes the strips; analysis/halocheck.py overlap-interior entries)
-OVERLAP_RIM = FUSE_CHAIN + 1
+# least this far from the block edge have a dependency cone (measured
+# footprint FUSE_FOOTPRINT) that never reaches the exchanged deep-halo
+# strips — the interior half of the split PRE call is gated to them
+# (analysis/halocheck.py overlap-interior entries)
+OVERLAP_RIM = FUSE_FOOTPRINT + 1
 
 
 def fuse_halo(dtype) -> int:
@@ -241,6 +253,7 @@ def _pre_kernel(
     ylength: float,
     prof_dtype,
     masked: bool,
+    bands: tuple | None = None,
 ):
     if masked:
         (u_in, v_in, flg, u_out, v_out, f_out, g_out, r_out,
@@ -258,26 +271,45 @@ def _pre_kernel(
     ioff = sref[1]
     dt = dt_ref[0, 0]
 
+    # banded (grid-restricted) sweeps (`tpu_overlap_restrict`,
+    # parallel/overlap.region_plan): grid step k of band (s, n) covers
+    # padded rows [s + j*br, ...) instead of [k*br, ...). The full-sweep
+    # default keeps the literal k*br indexing, so the unrestricted
+    # program traces byte-identically to the historical kernel.
+    if bands is None or (len(bands) == 1 and bands[0][0] == 0):
+        def row_of(k):
+            return k * br
+    else:
+        def row_of(k):
+            row, acc = None, 0
+            for s, n in bands:
+                r = s + (k - acc) * br
+                row = r if row is None else jnp.where(k >= acc, r, row)
+                acc += n
+            return row
+
     def load(k, s):
+        r0 = row_of(k)
         copies = [
             pltpu.make_async_copy(
-                u_in.at[pl.ds(k * br, br + 2 * h), :], uw2.at[s],
+                u_in.at[pl.ds(r0, br + 2 * h), :], uw2.at[s],
                 ld_sem.at[s, 0]),
             pltpu.make_async_copy(
-                v_in.at[pl.ds(k * br, br + 2 * h), :], vw2.at[s],
+                v_in.at[pl.ds(r0, br + 2 * h), :], vw2.at[s],
                 ld_sem.at[s, 1]),
         ]
         if masked:
             copies.append(pltpu.make_async_copy(
-                flg.at[pl.ds(k * br, br + 2 * h), :], fw2.at[s],
+                flg.at[pl.ds(r0, br + 2 * h), :], fw2.at[s],
                 ld_sem.at[s, 2]))
         return copies
 
     def store(k, s):
+        r0 = row_of(k)
         outs = (u_out, v_out, f_out, g_out, r_out)
         return [
             pltpu.make_async_copy(
-                ob2.at[s, q], outs[q].at[pl.ds(h + k * br, br)],
+                ob2.at[s, q], outs[q].at[pl.ds(h + r0, br)],
                 st_sem.at[s, q])
             for q in range(5)
         ]
@@ -298,10 +330,11 @@ def _pre_kernel(
     u = uw2[slot]
     v = vw2[slot]
 
-    # padded row of window cell (w, c): rho = b*br + w; global extended
-    # index gj = (rho - h) - ext_pad + joff (ext_pad = 0 single-device,
-    # H-1 on deep-halo dist blocks), gi likewise (columns are unshifted)
-    rho = b * br + jax.lax.broadcasted_iota(jnp.int32, u.shape, 0)
+    # padded row of window cell (w, c): rho = row_of(b) + w; global
+    # extended index gj = (rho - h) - ext_pad + joff (ext_pad = 0 single-
+    # device, H-1 on deep-halo dist blocks), gi likewise (columns are
+    # unshifted)
+    rho = row_of(b) + jax.lax.broadcasted_iota(jnp.int32, u.shape, 0)
     a_j = rho - h
     a_i = jax.lax.broadcasted_iota(jnp.int32, u.shape, 1)
     gj = a_j - ext_pad + joff
@@ -611,6 +644,17 @@ def fused_layout_2d(jmax: int, imax: int, dtype, block_rows=None):
     return br, h
 
 
+def fused_deep_layout_2d(jl: int, il: int, dtype, ext_pad: int,
+                         block_rows=None):
+    """(block_rows, halo, width, nblocks) of the distributed deep-halo
+    padded layout — the geometry `parallel/overlap.region_plan` bands
+    over when the PRE halves are grid-restricted
+    (`tpu_overlap_restrict`)."""
+    h, br, wp, nb, _rp = _layout(jl + 2 + 2 * ext_pad,
+                                 il + 2 + 2 * ext_pad, dtype, block_rows)
+    return br, h, wp, nb
+
+
 def make_fused_pre_2d(
     param,
     gjmax: int,
@@ -626,6 +670,7 @@ def make_fused_pre_2d(
     prof_dtype=None,
     block_rows: int | None = None,
     interpret: bool | None = None,
+    grid_bands: tuple | None = None,
 ):
     """Build the PRE kernel for one grid/shard geometry:
       pre(offs_i32[2], dt_11, u_pad, v_pad) -> (u', v', f, g, rhs)  [padded]
@@ -636,15 +681,28 @@ def make_fused_pre_2d(
     argument: pre(offs, dt11, u_pad, v_pad, flg_pad), flg_pad the padded
     per-shard deep-halo slice of the global flag. Raises ValueError on
     VMEM infeasibility — the caller's contract is to fall back to the jnp
-    chain."""
+    chain.
+
+    `grid_bands` (parallel/overlap.region_plan) restricts the Pallas grid
+    to ((start_row, n_blocks), ...) row bands of the SAME padded layout —
+    the grid-restricted overlap halves. Outputs outside the bands are
+    never stored (the interior-merge mask must not select them); the
+    layout, call signature and every stored value inside the bands are
+    identical to the full sweep's (the kernel stays globally gated)."""
     (interpret, ljmax, limax, h, block_rows, wp, nblocks, rp, masked,
      prof_dtype, _pad, _unpad, flg_padded) = _geom(
         param, gjmax, gimax, dtype, jl, il, ext_pad, fluid, prof_dtype,
         block_rows, interpret)
     bc = (param.bcLeft, param.bcRight, param.bcBottom, param.bcTop)
+    if grid_bands is not None:
+        from ..parallel.overlap import check_bands
+
+        check_bands(grid_bands, block_rows, nblocks)
+        nblocks = sum(n for _, n in grid_bands)
 
     pre_kernel = functools.partial(
         _pre_kernel,
+        bands=grid_bands,
         block_rows=block_rows,
         nblocks=nblocks,
         gjmax=gjmax,

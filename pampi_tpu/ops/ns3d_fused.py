@@ -47,6 +47,7 @@ from . import ns3d as ops3
 from .ns2d_fused import (  # shared validity chain + overlap rim
     FUSE_CHAIN,
     FUSE_DEEP_HALO,
+    FUSE_FOOTPRINT,
     OVERLAP_RIM,
 )
 from .sor_pallas import (
@@ -61,8 +62,9 @@ from .sor_pallas import (
 NOSLIP, SLIP, OUTFLOW, PERIODIC = 1, 2, 3, 4
 
 __all__ = [
-    "FUSE_CHAIN", "FUSE_DEEP_HALO", "OVERLAP_RIM", "make_fused_pre_3d",
-    "make_fused_post_3d", "make_fused_step_3d", "probe_fused_3d",
+    "FUSE_CHAIN", "FUSE_DEEP_HALO", "FUSE_FOOTPRINT", "OVERLAP_RIM",
+    "make_fused_pre_3d", "make_fused_post_3d", "make_fused_step_3d",
+    "probe_fused_3d",
 ]
 
 
@@ -231,6 +233,7 @@ def _pre3_kernel(
     dy: float,
     dz: float,
     masked: bool,
+    bands: tuple | None = None,
 ):
     if masked:
         (u_in, v_in, w_in, flg, u_out, v_out, w_out, f_out, g_out, h_out,
@@ -249,22 +252,40 @@ def _pre3_kernel(
     ioff = sref[2]
     dt = dt_ref[0, 0]
 
+    # banded (grid-restricted) sweeps over the leading k axis — the 3-D
+    # twin of the ns2d_fused band mapping (`tpu_overlap_restrict`); the
+    # full-sweep default keeps the literal k*bk indexing (byte-identical
+    # historical trace)
+    if bands is None or (len(bands) == 1 and bands[0][0] == 0):
+        def plane_of(k):
+            return k * bk
+    else:
+        def plane_of(k):
+            row, acc = None, 0
+            for s, n in bands:
+                r = s + (k - acc) * bk
+                row = r if row is None else jnp.where(k >= acc, r, row)
+                acc += n
+            return row
+
     def load(k, s):
+        r0 = plane_of(k)
         ins = [(u_in, uw2), (v_in, vw2), (w_in, ww2)]
         if masked:
             ins.append((flg, fw2))
         return [
             pltpu.make_async_copy(
-                arr.at[pl.ds(k * bk, bk + 2 * h)], win.at[s],
+                arr.at[pl.ds(r0, bk + 2 * h)], win.at[s],
                 ld_sem.at[s, q])
             for q, (arr, win) in enumerate(ins)
         ]
 
     def store(k, s):
+        r0 = plane_of(k)
         outs = (u_out, v_out, w_out, f_out, g_out, h_out, r_out)
         return [
             pltpu.make_async_copy(
-                ob2.at[s, q], outs[q].at[pl.ds(h + k * bk, bk)],
+                ob2.at[s, q], outs[q].at[pl.ds(h + r0, bk)],
                 st_sem.at[s, q])
             for q in range(7)
         ]
@@ -286,9 +307,9 @@ def _pre3_kernel(
     v = vw2[slot]
     w = ww2[slot]
 
-    # window cell (wk, wj, wi): deep-block index a_k = b*bk + wk - h,
+    # window cell (wk, wj, wi): deep-block index a_k = plane_of(b)+wk-h,
     # global extended index gk = a_k - ext_pad + koff (and j/i likewise)
-    a_k = b * bk - h + jax.lax.broadcasted_iota(jnp.int32, u.shape, 0)
+    a_k = plane_of(b) - h + jax.lax.broadcasted_iota(jnp.int32, u.shape, 0)
     a_j = jax.lax.broadcasted_iota(jnp.int32, u.shape, 1)
     a_i = jax.lax.broadcasted_iota(jnp.int32, u.shape, 2)
     gk = a_k - ext_pad + koff
@@ -572,6 +593,25 @@ def pick_block_k_fused(kext: int, jp: int, ip: int, dtype,
     return max(1, min(feasible, kext, 32))
 
 
+def fused_deep_layout_3d(kl: int, jl: int, il: int, dtype, ext_pad: int,
+                         block_k: int | None = None,
+                         masked: bool = False):
+    """(block_k, halo, plane_width, nblocks) of the distributed 3-D
+    deep-halo padded layout — the geometry `parallel/overlap.region_plan`
+    bands over (the 3-D twin of ns2d_fused.fused_deep_layout_2d; the
+    plan's `width` is the padded j*i plane)."""
+    ext_k = kl + 2 + 2 * ext_pad
+    ext_j = jl + 2 + 2 * ext_pad
+    ext_i = il + 2 + 2 * ext_pad
+    a = _align(dtype)
+    jp = -(-ext_j // a) * a
+    ip = -(-ext_i // LANE) * LANE
+    if block_k is None:
+        block_k = pick_block_k_fused(ext_k, jp, ip, dtype, masked)
+    nblocks = -(-ext_k // block_k)
+    return block_k, FUSE_CHAIN, jp * ip, nblocks
+
+
 def _geom3(gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, fluid, block_k,
            interpret):
     """Shared geometry/feasibility resolution (the 2-D _geom contract):
@@ -639,13 +679,17 @@ def make_fused_pre_3d(
     fluid=None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    grid_bands: tuple | None = None,
 ):
     """Build the 3-D PRE kernel:
       pre(offs_i32[3], dt_11, u_pad, v_pad, w_pad)
           -> (u', v', w', f, g, h, rhs)                            [padded]
     plus (pad3, unpad3, halo). Geometry contract as make_fused_pre_2d;
     fluid=True (distributed obstacles) appends a call-time flag argument
-    (the padded per-shard deep-halo slice of the global flag)."""
+    (the padded per-shard deep-halo slice of the global flag).
+    `grid_bands` restricts the Pallas grid to k-plane bands of the same
+    padded layout (see make_fused_pre_2d — the grid-restricted overlap
+    halves)."""
     (interpret, lkmax, ljmax, limax, h, block_k, jp, ip, nblocks, kp,
      masked, pad3, unpad3, flg_padded) = _geom3(
         gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, fluid, block_k,
@@ -655,8 +699,14 @@ def make_fused_pre_3d(
         ("left", param.bcLeft), ("right", param.bcRight),
         ("front", param.bcFront), ("back", param.bcBack),
     )
+    if grid_bands is not None:
+        from ..parallel.overlap import check_bands
+
+        check_bands(grid_bands, block_k, nblocks, label="block_k")
+        nblocks = sum(n for _, n in grid_bands)
     kernel = functools.partial(
         _pre3_kernel,
+        bands=grid_bands,
         block_k=block_k,
         nblocks=nblocks,
         gkmax=gkmax,

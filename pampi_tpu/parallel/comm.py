@@ -54,6 +54,46 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # slowest-varying first; arrays are [k, j, i] / [j, i].
 AXIS_NAMES = ("k", "j", "i")
 
+# mesh interconnect tiers, in POSTING order: DCN (inter-slice, the slow
+# fabric of a multi-slice pod) strips are posted first/deepest so they
+# have the whole interior compute to hide behind; ICI (intra-slice)
+# strips last/shallowest. "Persistent and Partitioned MPI for Stencil
+# Communication" (PAPERS.md) is the per-strip partitioned-send pattern
+# this ordering realizes on the ExchangeSchedule seam.
+TIERS = ("dcn", "ici")
+
+
+def parse_mesh_tiers(spec: str, axis_names) -> dict:
+    """`tpu_mesh_tiers` -> {axis name: tier}. "auto" (the default) maps
+    every axis to the single "ici" tier — today's single-slice meshes,
+    bitwise-unchanged exchange order. A comma list "k=dcn,j=ici,i=ici"
+    declares the hierarchy explicitly; unlisted axes default to "ici",
+    unknown axes/tiers refuse loudly (a typo'd tier map must not
+    silently serve the flat schedule)."""
+    tiers = {name: "ici" for name in axis_names}
+    spec = (spec or "auto").strip()
+    if spec == "auto":
+        return tiers
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"tpu_mesh_tiers entry {part!r} is not axis=tier "
+                f"(axes {tuple(axis_names)}, tiers {TIERS})")
+        axis, tier = (t.strip() for t in part.split("=", 1))
+        if axis not in tiers:
+            raise ValueError(
+                f"tpu_mesh_tiers names unknown mesh axis {axis!r} "
+                f"(this mesh has {tuple(axis_names)})")
+        if tier not in TIERS:
+            raise ValueError(
+                f"tpu_mesh_tiers tier {tier!r} for axis {axis!r} not in "
+                f"{TIERS}")
+        tiers[axis] = tier
+    return tiers
+
 
 def master_print(comm: "CartComm", fmt: str, *args) -> None:
     """`jax.debug.print` from the (0,...,0) mesh shard only — the rank-0
@@ -173,6 +213,9 @@ class CartComm:
     devices: list | None = None
     extents: tuple[int, ...] | None = None  # grid interior extents, mesh
     #   order — makes auto dims GRID-AWARE (prefers feasible factorizations)
+    tiers: str | dict | None = None  # axis->interconnect-tier map
+    #   (tpu_mesh_tiers spec string or a ready dict); None/"auto" = one
+    #   tier — exchange order and every cached schedule bitwise-unchanged
     mesh: Mesh = field(init=False)
     axis_names: tuple[str, ...] = field(init=False)
 
@@ -198,6 +241,21 @@ class CartComm:
         devs = list(devs)[: math.prod(self.dims)]
         self.axis_names = AXIS_NAMES[3 - self.ndims :]
         self.mesh = Mesh(np.asarray(devs).reshape(self.dims), self.axis_names)
+        if not isinstance(self.tiers, dict):
+            self.tiers = parse_mesh_tiers(self.tiers, self.axis_names)
+        else:
+            # a ready dict still goes through validation (the cli passes
+            # the spec string; tests may hand a dict)
+            self.tiers = parse_mesh_tiers(
+                ",".join(f"{a}={t}" for a, t in self.tiers.items()),
+                self.axis_names)
+
+    def tier_of(self, axis: str) -> str:
+        return self.tiers[axis]
+
+    @property
+    def multi_tier(self) -> bool:
+        return len(set(self.tiers.values())) > 1
 
     # --- commIsMaster (comm.h:138) -------------------------------------
     @property
@@ -407,11 +465,17 @@ class ExchangeSchedule:
     solver can swap between the two forms without moving a byte of the
     collective contract (commcheck census, CONTRACTS.json).
 
-    This is also the designated hook for hierarchical meshes: a future
-    intra-slice/inter-slice (ICI/DCN) exchange replaces the flat per-axis
-    plan here — one place, not one per solver. Instances come from
-    `persistent_exchange` (the per-process cache); building one directly
-    skips the cache but loses nothing else."""
+    Hierarchical meshes (ROADMAP item 3): the plan is TIER-ORDERED by the
+    comm's axis->tier map (`tpu_mesh_tiers`) — DCN-tier axes exchange
+    first (posted deepest/earliest, the partitioned-send discipline:
+    inter-slice strips have the most latency to hide and the whole
+    interior compute to hide behind), ICI-tier axes last. Reordering
+    full-strip axis exchanges is VALUE-safe: every strip spans the full
+    extended extent of the other axes, so a ghost corner receives the
+    diagonal neighbour's owned value by either route — the same copied
+    bytes, just posted in a latency-aware order. With the single-tier
+    default the plan keeps the historical axis order and traces
+    bitwise-identically (test-pinned)."""
 
     def __init__(self, comm: CartComm, depth: int = 1, dtype=None,
                  periodic=()):
@@ -421,9 +485,15 @@ class ExchangeSchedule:
         self.periodic = tuple(periodic)
         # the static plan: one entry per mesh axis, permutation lists
         # resolved now (MPI_Send_init semantics — the "build once" half
-        # of persistent requests)
+        # of persistent requests), tier-ordered (DCN first, stable
+        # within a tier — the single-tier default is the identity order)
         self.plan = []
-        for dim, name in enumerate(comm.axis_names):
+        order = sorted(
+            range(comm.ndims),
+            key=lambda d: (TIERS.index(comm.tier_of(comm.axis_names[d])),
+                           d))
+        for dim in order:
+            name = comm.axis_names[dim]
             nper = comm.axis_size(name)
             per = name in self.periodic
             self.plan.append((dim, name, nper, per, (
@@ -451,18 +521,24 @@ _SCHEDULE_CACHE: dict = {}
 
 def _mesh_key(comm: CartComm) -> tuple:
     """Hashable identity of a comm's mesh (axis names + dims + device
-    ids) — stable across jax versions that may or may not hash Mesh."""
+    ids + the axis->tier map) — stable across jax versions that may or
+    may not hash Mesh. The tier map is part of the identity: a re-tiered
+    mesh orders its exchange plan differently, so neither a cached
+    schedule nor a cached `.exchange`-span probe may be served across a
+    tier change (the stale-probe bug class)."""
     return (tuple(comm.axis_names), tuple(comm.dims),
-            tuple(d.id for d in comm.mesh.devices.flat))
+            tuple(d.id for d in comm.mesh.devices.flat),
+            tuple(sorted(comm.tiers.items())))
 
 
 def persistent_exchange(comm: CartComm, depth: int = 1, dtype=None,
                         periodic=()) -> ExchangeSchedule:
-    """The cached `ExchangeSchedule` for (mesh, halo-depth, dtype,
-    periodic) — built once per process, returned by identity afterwards
-    (test-pinned). Callers that exchange the same class of block many
-    times (the overlapped solvers, the exchange probe) hold one schedule
-    instead of re-deriving the plan per trace site."""
+    """The cached `ExchangeSchedule` for (mesh incl. tier map,
+    halo-depth, dtype, periodic) — built once per process, returned by
+    identity afterwards (test-pinned). Callers that exchange the same
+    class of block many times (the overlapped solvers, the exchange
+    probe) hold one schedule instead of re-deriving the plan per trace
+    site."""
     key = (_mesh_key(comm), int(depth),
            None if dtype is None else jnp.dtype(dtype).name,
            tuple(sorted(periodic)))
@@ -503,6 +579,66 @@ def halo_exchange_bytes(extents, depth: int, itemsize: int) -> int:
             n *= s
         total += 2 * n
     return total * itemsize
+
+
+def halo_tier_bytes(comm: CartComm, extents, depth: int,
+                    itemsize: int) -> dict:
+    """Per-TIER bytes of one full `halo_exchange` over a block with the
+    given OWNED extents: each axis's two travelling strips charged to
+    that axis's interconnect tier (`tpu_mesh_tiers`). Axes of size 1
+    move nothing and charge nothing — this is the traffic accounting,
+    not the static geometry. The single-tier default puts everything
+    under "ici", so the per-tier sum equals the moved subset of
+    `halo_exchange_bytes` by construction."""
+    out: dict[str, int] = {t: 0 for t in sorted(set(comm.tiers.values()))}
+    for ax, shape in enumerate(halo_strip_shapes(extents, depth)):
+        name = comm.axis_names[ax]
+        if comm.axis_size(name) == 1:
+            continue
+        n = 1
+        for s in shape:
+            n *= s
+        out[comm.tiers[name]] += 2 * n * itemsize
+    return out
+
+
+def exchange_schedule_tier_bytes(comm: CartComm, record: dict) -> dict:
+    """Per-tier twin of `exchange_schedule_bytes`: the per-step bytes of
+    a solver's declared step-level schedule broken out by interconnect
+    tier. The `dcn` entry is the first-class BENCH metric
+    (`dcn_exchange_bytes`) — the slow-fabric traffic a multi-slice pod
+    pays per step. Priced through the same strip helpers as the flat
+    total, but counting only strips that MOVE (size-1 mesh axes charge
+    nothing — see `halo_tier_bytes`), so on a partially-partitioned
+    mesh the per-tier sum is the moved subset of
+    `exchange_schedule_bytes`, not its full static geometry."""
+    import numpy as np
+
+    shard = tuple(record["shard"])
+    isz = np.dtype(record["dtype"]).itemsize
+    per = record.get("exchanges_per_step", {})
+    out: dict[str, int] = {t: 0 for t in sorted(set(comm.tiers.values()))}
+
+    def add(bytes_by_tier, times):
+        for t, b in bytes_by_tier.items():
+            out[t] += times * b
+
+    add(halo_tier_bytes(comm, shard, 1, isz), per.get("depth1", 0))
+    if "deep" in per:
+        add(halo_tier_bytes(comm, shard, record["deep_halo"], isz),
+            per["deep"])
+    if per.get("shift"):
+        # one single-direction depth-1 strip per shifted axis
+        per_axis = per["shift"] // len(shard)
+        for ax, shape in enumerate(halo_strip_shapes(shard, 1)):
+            name = comm.axis_names[ax]
+            if comm.axis_size(name) == 1:
+                continue
+            n = 1
+            for s in shape:
+                n *= s
+            out[comm.tiers[name]] += per_axis * n * isz
+    return out
 
 
 def halo_shift(x, comm: CartComm, axis: str):

@@ -43,33 +43,37 @@ import jax.numpy as jnp
 GEN_SKEW = 0
 
 
-def overlap_rim(chain: int) -> int:
-    """Width (in extended-block cells, from the block edge inward) of the
-    boundary region: the extended ghost layer itself (1) plus the
-    `chain`-cell validity cone of the fused PRE formulas. Every output
-    cell at least this far from the block edge has a dependency cone that
-    stays inside the OWNED cells — provably independent of the exchanged
-    strips."""
-    return chain + 1
-
-
-def interior_slices(local_extents, rim: int):
+def interior_slices(local_extents, rim: int, partitioned=None):
     """Per-axis slices of the interior region on the (l+2)-extended
     block: indices [rim, l+2-rim). Empty when a shard is thinner than
     two rims — the split then degenerates to boundary-everywhere, which
-    is correct (and overlap-free)."""
-    return tuple(slice(rim, ext + 2 - rim) for ext in local_extents)
+    is correct (and overlap-free).
+
+    `partitioned` (per-axis bools, default all True) drops the rim on
+    UNPARTITIONED mesh axes: a size-1 axis exchanges nothing
+    (`_exchange_axis` short-circuits), so the stale block and the
+    double-buffered exchanged block are bit-identical along it — the
+    interior half's cone may touch those sides freely. This is what
+    lets the grid-restricted boundary half shrink to two row bands on
+    a (P, 1) mesh instead of sweeping every row for column strips that
+    do not exist."""
+    if partitioned is None:
+        partitioned = (True,) * len(local_extents)
+    return tuple(
+        slice(rim if part else 0, ext + 2 - (rim if part else 0))
+        for ext, part in zip(local_extents, partitioned)
+    )
 
 
-def interior_mask(local_extents, rim: int):
+def interior_mask(local_extents, rim: int, partitioned=None):
     """Boolean interior mask on the extended block (the merge gate of
     `merge_halves`). Local-geometry only: ragged pad cells and wall
     shards need no special case — both halves compute identical values
     wherever the cone avoids the strips, and the strips are a local
-    property of the block."""
+    property of the block. See `interior_slices` for `partitioned`."""
     shape = tuple(ext + 2 for ext in local_extents)
     m = jnp.zeros(shape, bool)
-    return m.at[interior_slices(local_extents, rim)].set(True)
+    return m.at[interior_slices(local_extents, rim, partitioned)].set(True)
 
 
 def merge_halves(mask, interior_vals, boundary_vals):
@@ -80,6 +84,123 @@ def merge_halves(mask, interior_vals, boundary_vals):
     return tuple(
         jnp.where(mask, i, b) for i, b in zip(interior_vals, boundary_vals)
     )
+
+
+# ----------------------------------------------------------------------
+# Grid restriction (ROADMAP item 3 / `tpu_overlap_restrict`): the region
+# plan that turns the two full write-gated PRE sweeps into banded Pallas
+# grids — the interior half sweeps only the row blocks of the interior
+# core, the boundary half only the OVERLAP_RIM bands (plus the full rows
+# whenever a non-leading axis is partitioned: column strips cannot be
+# row-banded). Rows are in the padded-layout frame the fused kernels
+# block over (ops/ns2d_fused._layout): the full sweep's block k covers
+# rows [k*br, (k+1)*br) of R = nblocks*br total.
+# ----------------------------------------------------------------------
+
+
+def check_bands(grid_bands, block_rows: int, nblocks: int,
+                label: str = "block_rows") -> None:
+    """Refuse a band list that is not sorted-disjoint or that overhangs
+    the padded layout — the one validation both fused-PRE builders run
+    on `grid_bands` before restricting their grid (a double-stored row
+    would race the output DMA; an overhanging band would DMA past the
+    padded array)."""
+    last_end = 0
+    for s, n in grid_bands:
+        if s < last_end or n < 1 or s + n * block_rows > \
+                nblocks * block_rows:
+            raise ValueError(
+                f"grid_bands {grid_bands} do not tile the padded "
+                f"layout ({label}={block_rows}, nblocks={nblocks}) "
+                "disjointly")
+        last_end = s + n * block_rows
+
+
+def band_cover(lo: int, hi: int, block_rows: int, total_rows: int):
+    """The (start_row, n_blocks) band of `block_rows`-row blocks that
+    covers rows [lo, hi) and stays inside [0, total_rows): the start is
+    shifted down when the rounded-up coverage would overhang (extra
+    covered rows are valid compute — every write is globally gated)."""
+    n = -(-(hi - lo) // block_rows)
+    start = max(0, min(lo, total_rows - n * block_rows))
+    return (start, n)
+
+
+def _merge_bands(bands, block_rows, total_rows):
+    """Coalesce overlapping/adjacent bands so no row is stored twice
+    (a double-store would race the output DMA), keeping every band
+    inside [0, total_rows): a merged band's rounded-up block count can
+    overhang the layout (its end is the max of the inputs' ends but its
+    count is re-derived by ceil), so merged starts are re-clamped like
+    `band_cover`'s — which can re-overlap the previous band, hence the
+    fixpoint loop (bands only move down and merge, so it terminates)."""
+    out = [b for b in bands if b[1] > 0]
+    while True:
+        merged = []
+        for s, n in sorted(out):
+            if merged and s <= merged[-1][0] + merged[-1][1] * block_rows:
+                ps, pn = merged[-1]
+                end = max(ps + pn * block_rows, s + n * block_rows)
+                merged[-1] = (ps, -(-(end - ps) // block_rows))
+            else:
+                merged.append((s, n))
+        clamped = [(max(0, min(s, total_rows - n * block_rows)), n)
+                   for s, n in merged]
+        if clamped == out:
+            return tuple(clamped)
+        out = clamped
+
+
+def region_plan(local_extents, rim: int, ext_pad: int, block_rows: int,
+                nblocks: int, width: int, partitioned):
+    """Banded grid plan for the two PRE halves of one shard geometry,
+    over the LEADING (block-tiled) axis. Returns None when the interior
+    region is empty (the split is boundary-everywhere — nothing to
+    restrict); otherwise a dict:
+
+      int_bands / bnd_bands   ((start_row, n_blocks), ...) for the
+                              interior / boundary half's Pallas grid
+      cells                   summed swept cells of the two banded
+                              grids (blocks x block_rows x width)
+      cells_full              the 2x full-sweep count they replace
+      win                     cells < cells_full — the `auto` predicate
+
+    The interior band covers exactly the interior-merge region
+    (`interior_slices` with the same `partitioned` flags — the mask and
+    the grid cannot drift apart); the boundary band covers the rim rows,
+    widened to every row when any non-leading axis is partitioned (its
+    column strips live in every row)."""
+    L0 = local_extents[0]
+    R = nblocks * block_rows
+    lead = partitioned[0]
+    cross = any(partitioned[1:])
+    rim0 = rim if lead else 0
+    int_lo = ext_pad + rim0
+    int_hi = ext_pad + L0 + 2 - rim0
+    if int_hi <= int_lo:
+        return None
+    int_bands = _merge_bands(
+        [band_cover(int_lo, int_hi, block_rows, R)], block_rows, R)
+    if cross:
+        bnd = [band_cover(ext_pad, ext_pad + L0 + 2, block_rows, R)]
+    elif lead:
+        bnd = [band_cover(ext_pad, ext_pad + rim, block_rows, R),
+               band_cover(ext_pad + L0 + 2 - rim, ext_pad + L0 + 2,
+                          block_rows, R)]
+    else:
+        # no partitioned axis at all: no exchange, no overlap, no plan
+        return None
+    bnd_bands = _merge_bands(bnd, block_rows, R)
+    blocks = sum(n for _, n in int_bands) + sum(n for _, n in bnd_bands)
+    cells = blocks * block_rows * width
+    cells_full = 2 * R * width
+    return {
+        "int_bands": int_bands,
+        "bnd_bands": bnd_bands,
+        "cells": cells,
+        "cells_full": cells_full,
+        "win": cells < cells_full,
+    }
 
 
 def generation_guard(dt, gen, nt):

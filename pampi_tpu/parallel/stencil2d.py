@@ -164,6 +164,50 @@ def rb_exchange_per_sweep(p, rhs, masks, comm: CartComm, factor, idx2, idy2,
     return p, _owned_r2(r_red, r_blk, masks)
 
 
+def rb_split_iter(p, rhs, masks, sched, int_mask, factor, idx2, idy2,
+                  ragged: bool = False):
+    """One red-black iteration with each half-sweep SPLIT
+    interior/boundary — the solve-sweep twin of the overlapped PRE split
+    (ROADMAP item 3): per colour, the depth-1 exchange is posted and its
+    results consumed ONLY by the boundary-region update, while the
+    interior-region update (whose 5-point stencil never reaches the
+    ghost ring) runs on the unexchanged block. The traced program
+    carries no dependency path from the ppermutes to the interior
+    update, so XLA's scheduler can fly each colour's exchange behind
+    the interior compute — per iteration the exchange serialization the
+    WaterLily.jl MPI paper (PAPERS.md) measured as the MG strong-scaling
+    limit disappears from the critical path.
+
+    `sched` is the persistent depth-1 `ExchangeSchedule`; `int_mask` the
+    rim-2 interior mask (`overlap.interior_mask(local, 2, partitioned)`
+    — cells whose stencil cannot read the exchanged ring). Values are
+    BITWISE the serial per-half-sweep form (`rb_exchange_per_sweep`,
+    itself bitwise the CA form): interior cells compute identical
+    values from either block, boundary cells read the exchanged buffer.
+    Ragged layouts split the extra pre-Neumann refresh the same way
+    (interior wall-ghost rows sit >= 2 cells from the block edge or in
+    the boundary region — either way their Neumann source is fresh)."""
+    red = masks["red"][1:-1, 1:-1]
+    black = masks["black"][1:-1, 1:-1]
+    inner = int_mask[1:-1, 1:-1]
+
+    def half(p, colour):
+        g = sched(p)
+        pi, ri = ca_half_sweep(p, rhs, colour, factor, idx2, idy2)
+        pb, rb = ca_half_sweep(g, rhs, colour, factor, idx2, idy2)
+        return jnp.where(int_mask, pi, pb), jnp.where(inner, ri, rb)
+
+    p, r_red = half(p, red)
+    p, r_blk = half(p, black)
+    if ragged:
+        g = sched(p)
+        p = jnp.where(int_mask, neumann_masked(p, masks),
+                      neumann_masked(g, masks))
+    else:
+        p = neumann_masked(p, masks)
+    return p, _owned_r2(r_red, r_blk, masks)
+
+
 def ca_halo(n: int, ragged: bool = False) -> int:
     """Halo depth consumed by n fused red-black iterations. Ragged
     decompositions need ONE extra layer: the wall-ghost row gj == jmax+1

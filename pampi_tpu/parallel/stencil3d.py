@@ -129,6 +129,33 @@ def ca_rb_iters_3d(p, rhs, n: int, masks, factor, idx2, idy2, idz2):
     return p, _owned_r2_3d(r_odd, r_evn, masks)
 
 
+def rb_split_iter_3d(p, rhs, masks, sched, int_mask, factor, idx2, idy2,
+                     idz2, ragged: bool = False):
+    """3-D twin of stencil2d.rb_split_iter: one odd/even iteration with
+    each half-sweep split interior/boundary, the per-colour depth-1
+    exchange consumed only by the boundary-region update (bitwise the
+    serial per-half-sweep form)."""
+    odd = masks["odd"][1:-1, 1:-1, 1:-1]
+    even = masks["even"][1:-1, 1:-1, 1:-1]
+    inner = int_mask[1:-1, 1:-1, 1:-1]
+
+    def half(p, colour):
+        g = sched(p)
+        pi, ri = ca_half_sweep_3d(p, rhs, colour, factor, idx2, idy2, idz2)
+        pb, rb = ca_half_sweep_3d(g, rhs, colour, factor, idx2, idy2, idz2)
+        return jnp.where(int_mask, pi, pb), jnp.where(inner, ri, rb)
+
+    p, r_odd = half(p, odd)
+    p, r_evn = half(p, even)
+    if ragged:
+        g = sched(p)
+        p = jnp.where(int_mask, neumann_masked_3d(p, masks),
+                      neumann_masked_3d(g, masks))
+    else:
+        p = neumann_masked_3d(p, masks)
+    return p, _owned_r2_3d(r_odd, r_evn, masks)
+
+
 def rb_exchange_per_sweep_3d(p, rhs, masks, comm: CartComm,
                              factor, idx2, idy2, idz2, ragged: bool = False):
     """Extent-1-safe fallback on the halo=1 layout (see

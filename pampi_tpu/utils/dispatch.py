@@ -148,6 +148,49 @@ def resolve_overlap(param, key: str, why_not: str | None = None) -> bool:
     return True
 
 
+def resolve_overlap_restrict(param, key: str, plan,
+                             why_not: str | None = None) -> bool:
+    """`tpu_overlap_restrict` -> whether the overlapped PRE halves run
+    GRID-RESTRICTED (parallel/overlap.region_plan: the interior half's
+    Pallas grid bands over the interior core only, the boundary half
+    over the OVERLAP_RIM bands) instead of two full write-gated sweeps.
+    Decision recorded under `key` ("overlap_grid_<family>") with the
+    swept-cell accounting, so the dryrun snapshot shows the ~2x-PRE-HBM
+    question answered per build.
+
+    `plan` is the region plan (None = the interior region is empty —
+    boundary-everywhere, nothing to restrict). `auto` restricts exactly
+    when the plan's summed banded cells beat the two full sweeps at this
+    shard geometry; tiny shards keep the full halves (banding cannot
+    win below a few row blocks). `on` forces the restricted plan
+    (structural tests / smoke); `off` keeps the PR 8 full halves."""
+    knob = param.tpu_overlap_restrict
+    if knob not in ("auto", "on", "off"):
+        raise ValueError(
+            f"tpu_overlap_restrict must be auto|on|off, got {knob!r}"
+        )
+    if knob == "off":
+        record(key, "full (tpu_overlap_restrict off)")
+        return False
+    if why_not is not None:
+        record(key, f"full ({why_not})")
+        return False
+    if plan is None:
+        record(key, "full (interior region empty: boundary-everywhere)")
+        return False
+    cells, full = plan["cells"], plan["cells_full"]
+    if knob == "on":
+        record(key, f"restricted (forced; {cells} vs {full} cells)")
+        return True
+    if plan["win"]:
+        record(key, f"restricted (grid plan wins: {cells} vs {full} "
+                    "cells)")
+        return True
+    record(key, f"full (banding cannot win at this shard geometry: "
+                f"{cells} vs {full} cells)")
+    return False
+
+
 def resolve_fleet(param, n_scenarios: int, dist: bool, key: str) -> str:
     """`tpu_fleet` -> how the fleet scheduler executes one bucket of
     same-signature scenario requests (pampi_tpu/fleet/scheduler.py).

@@ -158,6 +158,44 @@ class Parameter:
     #   "off"  the serial schedule (bitwise the historical program —
     #          jaxpr-hash identity vs CONTRACTS.json)
     tpu_overlap: str = "auto"
+    # grid restriction of the overlapped PRE halves (parallel/overlap.py
+    # region plan + ops/ns*_fused region grids): instead of two full
+    # write-gated sweeps, the interior half's Pallas grid covers only the
+    # row blocks of the interior core and the boundary half only the
+    # OVERLAP_RIM (edge row bands + narrow column strips on partitioned
+    # column axes) — the ~2x PRE HBM traffic of the PR 8 split drops back
+    # toward 1x once PRE is bandwidth-bound.
+    #   "auto" restrict when the overlapped schedule is dispatched AND the
+    #          restricted plan's summed grid cells beat the two full
+    #          sweeps at this shard geometry (tiny shards keep the full
+    #          write-gated halves — banding cannot win below a few row
+    #          blocks); decision recorded under the
+    #          "overlap_grid_<family>" dispatch keys with the call count
+    #   "on"   force the restricted plan whenever the overlap schedule
+    #          runs (the structural-test/smoke mode)
+    #   "off"  always the two full write-gated halves (the PR 8 program)
+    tpu_overlap_restrict: str = "auto"
+    # mesh-tier map for hierarchical halo exchange (parallel/comm
+    # ExchangeSchedule): "auto" = every axis one tier (today's single-
+    # slice meshes — exchange order and traces bitwise-unchanged), or a
+    # comma list "axis=tier" over ici|dcn, e.g. "k=dcn,j=ici,i=ici" for a
+    # multi-slice pod whose k axis crosses the DCN. DCN-tier strips are
+    # posted FIRST (deepest/earliest — they have the most latency to
+    # hide), ICI strips last, in every persistent ExchangeSchedule; the
+    # comm census and the BENCH plane break traffic out per tier
+    # (dcn_exchange_bytes).
+    tpu_mesh_tiers: str = "auto"
+    # residual-adaptive solve budget (ROADMAP item 1's last open bullet):
+    # 0 (default) keeps the static itermax cap. N > 0 lets the previous
+    # step's (res, it) shrink the NEXT step's sweep budget inside the
+    # chunk loop: a solve that converged in `it` sweeps caps the next at
+    # it + N (the slack); a capped solve restores the full itermax. The
+    # budget rides the chunk carry (external arity unchanged, resets per
+    # chunk dispatch); dist SOR paths only (mg counts cycles, fft does
+    # not iterate) — the decision is recorded under the
+    # "itermax_adaptive_<family>" dispatch keys and the per-step `it`
+    # telemetry shows the budget taking effect.
+    tpu_itermax_adaptive: int = 0
     # scenario-fleet dispatch (pampi_tpu/fleet/): how a bucket of
     # same-signature requests is executed by the fleet scheduler
     # (utils/dispatch.resolve_fleet records every decision under the

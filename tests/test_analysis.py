@@ -255,21 +255,22 @@ def test_halo_fused_pre_within_budget():
 
 
 def test_fuse_chain_slack_pinned():
-    """The ROADMAP carried-forward slack, pinned: the MEASURED PRE-chain
-    footprint is 2 of the DECLARED FUSE_CHAIN = 3 — one layer of genuine
-    slack the deep exchange ships unconsumed. A future perf pass wanting
-    `FUSE_DEEP_HALO = 3` re-derives through `halocheck.pre_chain_footprint`
-    instead of trusting the declaration; if the chain ever widens to eat
-    the slack, THIS pin fails before any distributed run corrupts."""
+    """The ROADMAP carried-forward shrink, landed and pinned: the
+    MEASURED PRE-chain footprint (2) now IS the declaration
+    (`FUSE_FOOTPRINT`), and the deep exchange ships exactly
+    footprint + 1 (`FUSE_DEEP_HALO = 3`, down from the conservative
+    FUSE_CHAIN + 1 = 4) — zero slack. If the chain ever widens, the
+    re-derivation here AND halocheck's PRE entries (declared =
+    FUSE_FOOTPRINT) fail before any distributed run corrupts."""
     from pampi_tpu.ops import ns2d_fused as nf
 
     measured = halocheck.pre_chain_footprint()
-    assert measured == 2, (
-        "PRE-chain footprint moved — update the ROADMAP slack note and "
-        "re-audit any FUSE_DEEP_HALO consumer")
-    assert nf.FUSE_CHAIN == 3
-    assert nf.FUSE_DEEP_HALO == nf.FUSE_CHAIN + 1
-    assert measured < nf.FUSE_CHAIN  # the slack exists today
+    assert measured == nf.FUSE_FOOTPRINT == 2, (
+        "PRE-chain footprint moved — re-audit FUSE_DEEP_HALO/OVERLAP_RIM "
+        "and re-run dist parity + make lint-update")
+    assert nf.FUSE_CHAIN == 3  # the stage-count budget, documentation
+    assert nf.FUSE_DEEP_HALO == nf.FUSE_FOOTPRINT + 1 == 3
+    assert nf.OVERLAP_RIM == nf.FUSE_FOOTPRINT + 1 == 3
 
 
 # ---------------------------------------------------------------------------
@@ -537,11 +538,11 @@ def test_comm_extra_collective_flagged(comm_traced):
     tampered = json.loads(json.dumps(fresh))
     entry = tampered["ns2d_dist_fused"]
     entry["ppermute_bytes"] -= 1024
-    entry["strips"]["4x16:float64"] -= 1
+    entry["strips"]["3x14:float64"] -= 1
     vs, _ = commcheck.run(baseline=tampered, traced=comm_traced)
     bytes_vs = [v for v in vs if v.rule == commcheck.RULE_BYTES]
     assert len(bytes_vs) == 1
-    assert "4x16:float64: 3 -> 4 (+1)" in bytes_vs[0].message
+    assert "3x14:float64: 3 -> 4 (+1)" in bytes_vs[0].message
 
 
 def test_comm_smuggled_exchange_census():
@@ -642,7 +643,7 @@ def test_comm_telemetry_crosscheck(comm_traced):
     # (b) a trace missing one declared deep message is caught (exact
     # count for the deep class: a duplicated exchange can't hide either)
     thin = json.loads(json.dumps(entry))
-    thin["strips"]["4x16:float64"] -= 1
+    thin["strips"]["3x14:float64"] -= 1
     errs = commcheck.crosscheck_record(rec, thin)
     assert any("deep-exchange strip" in e for e in errs)
 

@@ -190,3 +190,112 @@ def test_multiprocess_capability_probe():
         assert reason == ""
     else:
         assert "collectives" in reason
+
+
+# ---------------------------------------------------------------------------
+# hierarchical mesh tiers (tpu_mesh_tiers, ROADMAP item 3)
+# ---------------------------------------------------------------------------
+
+def test_mesh_tiers_parse_and_validate():
+    from pampi_tpu.parallel.comm import CartComm, parse_mesh_tiers
+
+    assert parse_mesh_tiers("auto", ("j", "i")) == {"j": "ici", "i": "ici"}
+    assert parse_mesh_tiers("j=dcn", ("j", "i")) == {"j": "dcn",
+                                                    "i": "ici"}
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        parse_mesh_tiers("q=dcn", ("j", "i"))
+    with pytest.raises(ValueError, match="not in"):
+        parse_mesh_tiers("j=pcie", ("j", "i"))
+    with pytest.raises(ValueError, match="axis=tier"):
+        parse_mesh_tiers("dcn", ("j", "i"))
+    comm = CartComm(ndims=2, dims=(2, 2), tiers="j=dcn")
+    assert comm.multi_tier and comm.tier_of("j") == "dcn"
+    assert not CartComm(ndims=2, dims=(2, 2)).multi_tier
+
+
+def test_tiered_schedule_value_safe():
+    """Reordering full-strip axis exchanges is VALUE-safe: the tiered
+    schedule (DCN axis posted first) fills every ghost with the same
+    bytes the flat schedule does."""
+    from pampi_tpu.parallel.comm import CartComm, persistent_exchange
+
+    flat = CartComm(ndims=2, dims=(2, 2))
+    tiered = CartComm(ndims=2, dims=(2, 2), tiers="i=dcn")
+    assert [x[1] for x in persistent_exchange(tiered, 2).plan] == ["i", "j"]
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((2 * 12, 2 * 12)))
+
+    def run(comm):
+        sched = persistent_exchange(comm, 2)
+        spec = comm.spec()
+        fn = jax.jit(comm.shard_map(sched, in_specs=(spec,),
+                                    out_specs=spec))
+        return np.asarray(fn(x))
+
+    assert np.array_equal(run(flat), run(tiered))
+
+
+def test_halo_tier_bytes_accounting():
+    """Per-tier bytes: size-1 axes charge nothing, the single-tier
+    default puts all moved bytes under ici, and the dcn entry feeds the
+    solver records' dcn_exchange_bytes."""
+    from pampi_tpu.parallel.comm import (
+        CartComm,
+        exchange_schedule_tier_bytes,
+        halo_tier_bytes,
+    )
+
+    flat = CartComm(ndims=2, dims=(2, 2))
+    t = halo_tier_bytes(flat, (8, 8), 1, 8)
+    assert t == {"ici": (2 * 10 + 2 * 10) * 8}
+    row = CartComm(ndims=2, dims=(2, 1), tiers="j=dcn")
+    t = halo_tier_bytes(row, (8, 8), 1, 8)
+    assert t == {"dcn": 2 * 10 * 8, "ici": 0}  # i axis size 1: no bytes
+    rec = {"shard": [8, 8], "dtype": "float64", "deep_halo": 3,
+           "exchanges_per_step": {"deep": 2}}
+    tiered = CartComm(ndims=2, dims=(2, 2), tiers="i=dcn")
+    per = exchange_schedule_tier_bytes(tiered, rec)
+    assert per["dcn"] == 2 * 2 * 3 * 14 * 8
+    assert per["dcn"] + per["ici"] > 0
+
+
+def test_per_tier_census_and_mutation():
+    """The per-tier trace census covers every ppermute byte, and a
+    MIS-TIERED strip shows up as a per-tier diff against the baseline
+    (the ISSUE 13 mutation): re-tiering an axis moves its bytes between
+    the dcn and ici buckets at constant totals."""
+    import json
+
+    from pampi_tpu.analysis import commcheck
+    from pampi_tpu.analysis.jaxprcheck import trace_chunk
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.utils.params import Parameter
+
+    param = Parameter(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02,
+                      tau=0.5, itermax=10, eps=1e-4, omg=1.7, gamma=0.9,
+                      tpu_fuse_phases="on", tpu_sor_layout="checkerboard")
+    s = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2),
+                                       tiers="i=dcn"))
+    jx = trace_chunk(s)
+    entry = commcheck.config_entry(
+        type("T", (), {"jaxpr": jx, "solver": s})())
+    tiers = entry["tiers"]
+    assert set(tiers) >= {"dcn", "ici"}
+    assert sum(t["bytes"] for t in tiers.values()) \
+        == entry["ppermute_bytes"]
+    # mutation: the same program censused under the FLAT map books the
+    # dcn bytes under ici — a per-tier diff at identical totals
+    flat = commcheck.census_tiers(jx.jaxpr,
+                                  {"j": "ici", "i": "ici"})
+    assert sum(t["bytes"] for t in flat.values()) \
+        == entry["ppermute_bytes"]
+    assert flat != tiers
+    base = json.loads(json.dumps(entry))
+    base["tiers"] = {k: dict(v) for k, v in flat.items()}
+    vs, _ = commcheck.check_config(
+        type("T", (), {"cfg": type("C", (), {
+            "name": "tier_mutation", "family": "ns2d_dist",
+            "dims": (2, 2)})(), "jaxpr": jx, "solver": s})(),
+        base, env_matches=True)
+    assert any(v.rule == commcheck.RULE_TIER for v in vs)

@@ -89,10 +89,15 @@ def test_overlap_matches_serial_2d_obstacle():
 
 
 def test_overlap_matches_serial_2d_ragged():
-    # 18 rows over a 4-mesh: ceil-divided 5-row shards with a dead tail
-    param = Parameter(**{**_B2, "imax": 18, "jmax": 18})
+    # 18 rows over a 4-mesh: ceil-divided 5-row shards with a dead tail.
+    # Restriction FORCED so the banded grids run at the ragged/uneven
+    # block bounds (auto declines at this degenerate geometry) — the
+    # global-coordinate gating must keep the restricted halves exact.
+    param = Parameter(**{**_B2, "imax": 18, "jmax": 18},
+                      tpu_overlap_restrict="on")
     ser, o = _run_pair_2d(param, (4, 2))
     assert ser.ragged
+    assert dispatch.last("overlap_grid_ns2d_dist").startswith("restricted")
 
 
 def _run_pair_3d(param, dims=(2, 2, 2)):
@@ -115,9 +120,12 @@ def test_overlap_matches_serial_3d_plain():
 
 
 def test_overlap_matches_serial_3d_ragged():
-    param = Parameter(**{**_B3, "imax": 9, "jmax": 9, "kmax": 9})
+    # restriction forced at the ragged bounds (see the 2-D twin)
+    param = Parameter(**{**_B3, "imax": 9, "jmax": 9, "kmax": 9},
+                      tpu_overlap_restrict="on")
     ser, _ = _run_pair_3d(param)
     assert ser.ragged
+    assert dispatch.last("overlap_grid_ns3d_dist").startswith("restricted")
 
 
 @pytest.mark.slow
@@ -278,3 +286,216 @@ def test_exchange_probe_cached():
                          "(mesh, record geometry, dtype)"
     fn_c, _ = make_exchange_probe(comm, {**rec, "deep_halo": 2})
     assert fn_c is not fn_a
+
+
+def test_exchange_probe_not_served_across_tier_change():
+    """The stale-probe bug class (ISSUE 13 satellite): a re-tiered mesh
+    orders its exchange plan differently, so neither a cached schedule
+    nor a cached probe may be served across a tier change."""
+    rec = {"shard": [8, 8], "dtype": "float64", "deep_halo": 3,
+           "exchanges_per_step": {"deep": 2}}
+    flat = CartComm(ndims=2, dims=(2, 2))
+    tiered = CartComm(ndims=2, dims=(2, 2), tiers="i=dcn")
+    fn_a, _ = make_exchange_probe(flat, rec)
+    fn_b, _ = make_exchange_probe(tiered, rec)
+    assert fn_a is not fn_b
+    assert persistent_exchange(flat, 3) is not persistent_exchange(
+        tiered, 3)
+    # the tiered plan posts the DCN axis first
+    assert [x[1] for x in persistent_exchange(tiered, 3).plan] == ["i", "j"]
+    assert [x[1] for x in persistent_exchange(flat, 3).plan] == ["j", "i"]
+
+
+# ---------------------------------------------------------------------------
+# grid-restricted halves (tpu_overlap_restrict)
+# ---------------------------------------------------------------------------
+
+def test_region_plan_bands():
+    """The banded plan at a geometry where restriction wins: interior
+    bands cover exactly the interior rows, the (P,1)-mesh boundary
+    shrinks to two rim bands, and the summed cells beat 2x full."""
+    from pampi_tpu.ops import ns2d_fused as nf
+
+    jl = il = 40
+    ext_pad = nf.FUSE_DEEP_HALO - 1
+    br, _h, wp, nb = nf.fused_deep_layout_2d(jl, il, jnp.float32, ext_pad,
+                                             block_rows=8)
+    plan = ovl.region_plan((jl, il), nf.OVERLAP_RIM, ext_pad, br, nb, wp,
+                           (True, True))
+    assert plan["win"] and plan["cells"] < plan["cells_full"]
+    # interior band covers the interior-merge rows
+    lo = ext_pad + nf.OVERLAP_RIM
+    hi = ext_pad + jl + 2 - nf.OVERLAP_RIM
+    (s, n), = plan["int_bands"]
+    assert s <= lo and s + n * br >= hi
+    # column axis unpartitioned: the boundary half is two rim bands
+    plan1 = ovl.region_plan((jl, il), nf.OVERLAP_RIM, ext_pad, br, nb,
+                            wp, (True, False))
+    assert len(plan1["bnd_bands"]) == 2
+    assert plan1["cells"] < plan["cells"]
+    # empty interior (tiny shard) -> no plan
+    assert ovl.region_plan((4, 4), nf.OVERLAP_RIM, ext_pad, br, nb, wp,
+                           (True, True)) is None
+
+
+def test_region_plan_bands_stay_in_layout():
+    """Merged bands never overhang the padded layout (regression: a thin
+    leading shard whose two rim bands merge used to re-derive the block
+    count by ceil without re-clamping the start — the band ran past
+    nblocks*block_rows and the kernel build refused the grid). Every
+    band of every half must sit inside [0, R) and be disjoint within
+    its half, across a sweep of geometries including the repro."""
+    from pampi_tpu.ops import ns2d_fused as nf
+
+    ext_pad = nf.FUSE_DEEP_HALO - 1
+    geoms = [((6, 40), 8, 2, 128)]  # the repro: rims merge on 2 blocks
+    for jl in (3, 5, 6, 7, 9, 12, 40, 507, 510):
+        br, _h, wp, nb = nf.fused_deep_layout_2d(jl, 64, jnp.float32,
+                                                 ext_pad)
+        geoms.append(((jl, 64), br, nb, wp))
+    for (jl, il), br, nb, wp in geoms:
+        R = nb * br
+        for part in ((True, False), (True, True)):
+            plan = ovl.region_plan((jl, il), nf.OVERLAP_RIM, ext_pad,
+                                   br, nb, wp, part)
+            if plan is None:
+                continue
+            for name in ("int_bands", "bnd_bands"):
+                last = 0
+                for s, n in plan[name]:
+                    assert s >= 0 and s >= last and s + n * br <= R, (
+                        (jl, il), part, name, plan[name], R)
+                    last = s + n * br
+
+
+def test_restricted_overlap_matches_serial_2d():
+    """Forced grid restriction reproduces the serial trajectory (the
+    16² shard degenerates to single-band grids — the wiring and merge
+    coverage are what this pins; the banded-grid win is pinned by
+    palcheck's restricted entries)."""
+    param = Parameter(**_B2, tpu_overlap_restrict="on")
+    ser = NS2DDistSolver(param.replace(tpu_overlap="off"),
+                         CartComm(ndims=2, dims=(2, 2)))
+    ser.run(progress=False)
+    o = NS2DDistSolver(param.replace(tpu_overlap="on"),
+                       CartComm(ndims=2, dims=(2, 2)))
+    o.run(progress=False)
+    assert dispatch.last("overlap_grid_ns2d_dist").startswith("restricted")
+    rec = o._halo_record()
+    assert rec["pre_grid_cells"] <= rec["pre_grid_cells_full"]
+    assert o.nt == ser.nt and ser.nt > 1
+    for n, (a, b) in zip("uvp", zip(ser.fields(), o.fields())):
+        _assert_ulp_equal(a, b, n)
+
+
+def test_restricted_grid_coverage_palcheck():
+    """palcheck pins each restricted half's grid to its region: interior
+    + boundary block counts strictly below the 2x full sweep."""
+    from pampi_tpu.analysis import palcheck
+
+    assert palcheck.restricted_grid_violations() == []
+    entries = {name: expect for name, _jx, expect, _full
+               in palcheck.restricted_grid_entries()}
+    full = [e for n, e in entries.items() if "full" in n][0]
+    halves = sum(e for n, e in entries.items() if "full" not in n)
+    assert halves < 2 * full
+
+
+def test_restriction_dropped_fires_halocheck():
+    """The smuggled full-grid-half mutation: an interior region one rim
+    layer too wide (the restriction dropped toward the strips) fails
+    halocheck with the kernel's file:line."""
+    from pampi_tpu.ops import ns2d_fused as nf
+
+    vs = halocheck.check_entry(
+        halocheck.overlap_interior_entry_2d(rim=nf.OVERLAP_RIM - 1))
+    assert vs, "a rim-leaking interior region must be flagged"
+    assert "ns2d_fused" in vs[0].path and vs[0].line > 0
+
+
+def test_overlap_restrict_knob_validation():
+    comm = CartComm(ndims=2, dims=(2, 2))
+    with pytest.raises(ValueError, match="tpu_overlap_restrict"):
+        NS2DDistSolver(Parameter(**_B2, tpu_overlap="on",
+                                 tpu_overlap_restrict="maybe"), comm)
+
+
+# ---------------------------------------------------------------------------
+# split solve sweeps (ROADMAP item 3 layer 2)
+# ---------------------------------------------------------------------------
+
+_SPLIT = dict(_B2)
+_SPLIT.pop("tpu_sor_layout")  # default layout -> the jnp CA solve
+
+
+def test_sweep_split_matches_serial_and_proves():
+    """Overlap with the jnp RB-SOR solve swaps to split sweeps: the
+    trajectory equals the serial CA solve at the ulp contract, the
+    traced chunk passes the sweep-loop schedule proof, and the SERIAL
+    chunk is the negative control (its sweeps exchange at CA depth —
+    nothing is split)."""
+    param = Parameter(**_SPLIT, tpu_solver="sor")
+    ser = NS2DDistSolver(param.replace(tpu_overlap="off"),
+                         CartComm(ndims=2, dims=(2, 2)))
+    ser.run(progress=False)
+    o = NS2DDistSolver(param.replace(tpu_overlap="on"),
+                       CartComm(ndims=2, dims=(2, 2)))
+    o.run(progress=False)
+    assert dispatch.last("sweep_split_ns2d_dist") == "split (jnp rb-sor)"
+    assert o.nt == ser.nt and ser.nt > 1
+    for n, (a, b) in zip("uvp", zip(ser.fields(), o.fields())):
+        _assert_ulp_equal(a, b, n)
+    assert commcheck.sweep_split_violations(
+        trace_chunk(o), o._halo_record()) == []
+    errs = commcheck.sweep_split_violations(
+        trace_chunk(ser), ser._halo_record())
+    assert errs, "a serial sweep loop must fail the split proof"
+    # the combined mode stacks both proofs
+    assert commcheck.overlap_schedule_violations(
+        trace_chunk(o), o._halo_record(), sweeps=True) == []
+
+
+def test_sweep_split_mg_smoother_matches_serial():
+    """The dist MG smoother's jnp-fallback levels take the same split
+    (make_dist_mg_solve_2d(split=True)) — trajectory unchanged."""
+    param = Parameter(**{**_SPLIT, "eps": 1e-3}, tpu_solver="mg")
+    ser = NS2DDistSolver(param.replace(tpu_overlap="off"),
+                         CartComm(ndims=2, dims=(2, 2)))
+    ser.run(progress=False)
+    o = NS2DDistSolver(param.replace(tpu_overlap="on"),
+                       CartComm(ndims=2, dims=(2, 2)))
+    o.run(progress=False)
+    assert dispatch.last("sweep_split_ns2d_dist") \
+        == "split (mg jnp-smoother levels)"
+    assert o.nt == ser.nt and ser.nt > 1
+    for n, (a, b) in zip("uvp", zip(ser.fields(), o.fields())):
+        _assert_ulp_equal(a, b, n)
+
+
+# ---------------------------------------------------------------------------
+# residual-adaptive itermax (tpu_itermax_adaptive)
+# ---------------------------------------------------------------------------
+
+def test_itermax_adaptive_slack_parity():
+    """slack >= itermax caps nothing: the adaptive run is bitwise the
+    static run (the budget formula can only return itermax); the
+    decision lands as a dispatch record."""
+    param = Parameter(**_SPLIT, tpu_solver="sor")
+    a = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2)))
+    a.run(progress=False)
+    b = NS2DDistSolver(param.replace(tpu_itermax_adaptive=10),
+                       CartComm(ndims=2, dims=(2, 2)))
+    b.run(progress=False)
+    assert dispatch.last("itermax_adaptive_ns2d_dist") \
+        == "adaptive (+10 slack)"
+    assert a.nt == b.nt
+    for n, (x, y) in zip("uvp", zip(a.fields(), b.fields())):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), n
+
+
+def test_itermax_adaptive_declines_off_sor():
+    param = Parameter(**_SPLIT, tpu_solver="fft",
+                      tpu_itermax_adaptive=3)
+    NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2)))
+    assert dispatch.last("itermax_adaptive_ns2d_dist") \
+        == "static (solve path carries no sweep budget)"

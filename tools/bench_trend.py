@@ -103,7 +103,15 @@ def build_series(points: list[dict]) -> dict:
 # upward — the serving headline must never silently degrade to
 # render-only if its unit string drifts.
 NAME_DIRECTIONS = {"comm_hidden_fraction": True,
-                   "fleet_scenarios_per_s": True}
+                   "fleet_scenarios_per_s": True,
+                   # hierarchical-exchange + grid-restriction metrics
+                   # (ROADMAP item 3): DCN bytes are the slow-fabric
+                   # traffic of a multi-slice pod — fewer is better;
+                   # pre_grid_cells is the summed PRE-half grid sweep
+                   # (the restricted halves must stay below the 2x
+                   # full-sweep count they replaced)
+                   "dcn_exchange_bytes": False,
+                   "pre_grid_cells": False}
 
 
 def higher_is_better(unit, name: str | None = None) -> bool | None:

@@ -48,29 +48,65 @@ def main(argv: list[str]) -> int:
 
     from pampi_tpu.models.ns2d_dist import NS2DDistSolver
     from pampi_tpu.parallel.comm import CartComm
-    from pampi_tpu.utils import telemetry as tm
+    from pampi_tpu.utils import dispatch, telemetry as tm
     from pampi_tpu.utils.params import Parameter
 
     tm.reset()
     tm.start_run(tool="profile_smoke")
+    # the FULL item-3 schedule: overlapped fused step + grid-restricted
+    # PRE halves (forced — the structural/smoke mode) + the jnp RB-SOR
+    # solve so the SPLIT sweep loop dispatches (a pallas solve keeps
+    # serial sweeps), on a tiered mesh so the per-tier census and the
+    # dcn_exchange_bytes metric land end-to-end
     param = Parameter(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02,
                       tau=0.5, itermax=10, eps=1e-4, omg=1.7, gamma=0.9,
                       tpu_fuse_phases="on", tpu_overlap="on",
-                      tpu_sor_layout="checkerboard")
-    s = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2)))
+                      tpu_overlap_restrict="on", tpu_solver="sor",
+                      tpu_mesh_tiers="i=dcn")
+    s = NS2DDistSolver(param, CartComm(ndims=2, dims=(2, 2),
+                                       tiers=param.tpu_mesh_tiers))
     # compile OUTSIDE the capture (without executing the chunk): the
     # interpret-mode kernel build is Python-heavy enough to flood the
     # profiler's event cap and crowd out the execution events the
     # ingestion aggregates
     s._chunk_sm.lower(*s.initial_state()).compile()
     s.run(progress=False)
+
+    # the grid-restriction accounting at the PRODUCTION geometry
+    # (northstar 4096² on 8 ranks) — pure host math (the region plan is
+    # static), recorded as a `pre_grid_cells` metric in the smoke
+    # artifact: the banded halves must sweep strictly fewer cells than
+    # the two full write-gated sweeps they replace. The 16² run above
+    # is banding-DEGENERATE (one row block — equal, never more); the
+    # win lives at grids with multiple row blocks.
+    from pampi_tpu.ops import ns2d_fused as nf
+    from pampi_tpu.parallel import overlap as ovl
+
+    jl4, il4 = 4096 // 8, 4096
+    br4, _h4, wp4, nb4 = nf.fused_deep_layout_2d(
+        jl4, il4, "float64", nf.FUSE_DEEP_HALO - 1)
+    plan4096 = ovl.region_plan((jl4, il4), nf.OVERLAP_RIM,
+                               nf.FUSE_DEEP_HALO - 1, br4, nb4, wp4,
+                               (True, False))
+    if plan4096 is not None:
+        tm.emit("metric", metric="pre_grid_cells",
+                value=plan4096["cells"], unit="cells",
+                geometry="4096x4096@(8,1)",
+                full=plan4096["cells_full"])
     tm.finalize()
 
-    from pampi_tpu.analysis.commcheck import overlap_schedule_violations
+    from pampi_tpu.analysis.commcheck import (
+        census_tiers,
+        overlap_schedule_violations,
+    )
     from pampi_tpu.analysis.jaxprcheck import trace_chunk
 
+    jx = trace_chunk(s)
+    # the combined proof: double-buffered deep exchange AND split solve
+    # sweeps (sweeps=True is the ISSUE 13 sweep-loop mode)
     sched_errs = overlap_schedule_violations(
-        trace_chunk(s), s._halo_record())
+        jx, s._halo_record(), sweeps=True)
+    tiers = census_tiers(jx.jaxpr, s.comm.tiers)
 
     from tools import telemetry_report as tr
 
@@ -80,11 +116,20 @@ def main(argv: list[str]) -> int:
     spans = [r for r in records if r.get("kind") == "span"
              and str(r.get("name", "")).endswith(".exchange")]
     chf = tr.comm_hidden_fraction(records)
+    rec = s._halo_record()
     print(f"\nsmoke: nt={s.nt} kinds={sorted(kinds)}")
     print(f"smoke: comm_hidden_fraction = {json.dumps(chf)}")
-    print("smoke: overlap dispatch = "
-          f"{s._halo_record().get('overlap')} "
-          f"path={s._halo_record().get('path')}")
+    print(f"smoke: overlap dispatch = {rec.get('overlap')} "
+          f"path={rec.get('path')} "
+          f"grid={dispatch.last('overlap_grid_ns2d_dist')} "
+          f"sweeps={dispatch.last('sweep_split_ns2d_dist')}")
+    print(f"smoke: pre_grid_cells = {rec.get('pre_grid_cells')} "
+          f"(2x full sweep = {rec.get('pre_grid_cells_full')})")
+    print("smoke: per-tier census = "
+          + json.dumps({k: {"ppermute": v["ppermute"], "bytes": v["bytes"]}
+                        for k, v in sorted(tiers.items())}))
+    print(f"smoke: tier_map = {rec.get('tier_map')} "
+          f"dcn_exchange_bytes = {rec.get('dcn_exchange_bytes')}")
     if "xprof" not in kinds:
         print("FAIL: no xprof record (capture or ingestion broken)",
               file=sys.stderr)
@@ -96,8 +141,34 @@ def main(argv: list[str]) -> int:
         for e in sched_errs:
             print(f"FAIL overlap schedule: {e}", file=sys.stderr)
         return 1
-    print("smoke: overlap schedule double-buffered in the traced chunk "
-          "(exchange posted before the compute that hides it)")
+    if not (dispatch.last("sweep_split_ns2d_dist") or "").startswith(
+            "split"):
+        print("FAIL: the solve sweeps did not dispatch split",
+              file=sys.stderr)
+        return 1
+    if "dcn" not in tiers or tiers["dcn"]["bytes"] <= 0:
+        print("FAIL: per-tier census carries no DCN traffic on the "
+              "tiered mesh", file=sys.stderr)
+        return 1
+    if not rec.get("dcn_exchange_bytes"):
+        print("FAIL: halo record carries no dcn_exchange_bytes",
+              file=sys.stderr)
+        return 1
+    if not rec.get("pre_grid_cells") or rec["pre_grid_cells"] > \
+            rec.get("pre_grid_cells_full", 0):
+        print("FAIL: restricted pre_grid_cells missing or above the "
+              "2x full-sweep count", file=sys.stderr)
+        return 1
+    if plan4096 is None or not plan4096["win"]:
+        print("FAIL: the banded region plan does not beat the 2x full "
+              "sweep at the production 4096^2 geometry", file=sys.stderr)
+        return 1
+    print(f"smoke: pre_grid_cells@4096x4096(8,1) = {plan4096['cells']} "
+          f"< {plan4096['cells_full']} (2x full sweep; "
+          f"{plan4096['cells'] / plan4096['cells_full']:.2f}x)")
+    print("smoke: overlap schedule double-buffered AND solve sweeps "
+          "split in the traced chunk (every exchange posted before the "
+          "compute that hides it)")
     print(f"smoke ok -> {jsonl}")
     return 0
 

@@ -461,13 +461,17 @@ def render(records: list[dict]) -> str:
     if k.get("halo"):
         add("== halo exchange (static per-shard) ==")
         for h in k["halo"]:
+            tiers = h.get("tier_map") or {}
+            multi = len(set(tiers.values())) > 1
             add(f"  {h.get('family'):<12} mesh={h.get('mesh')} "
                 f"shard={h.get('shard')} path={h.get('path')} "
                 f"depth1={h.get('exchange_bytes_depth1')}B"
                 + (f" deep(H={h.get('deep_halo')})="
                    f"{h.get('deep_exchange_bytes')}B"
                    if h.get("deep_halo") else "")
-                + f" per-step={h.get('exchanges_per_step')}")
+                + f" per-step={h.get('exchanges_per_step')}"
+                + (f" tiers={tiers} dcn={h.get('dcn_exchange_bytes')}B"
+                   if multi or h.get("dcn_exchange_bytes") else ""))
 
     if k.get("xprof"):
         add("== device trace (xprof) ==")
