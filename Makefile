@@ -107,6 +107,15 @@ lint-comm:
 fleet-smoke:
 	JAX_PLATFORMS=cpu python tools/fleet_smoke.py
 
+# Serve smoke (serving v2): the persistent daemon on CPU over a temp
+# file-queue — two shape classes (4 distinct grids, at most ONE compile
+# per class), a mid-run lane swap-in, one diverged lane isolated, one
+# malformed .par parked with a warning record, the live status
+# endpoint, and the telemetry/merge/lint round trip. rc 0 = clean
+# shutdown.
+serve-smoke:
+	JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
 # The full fleet test file INCLUDING the slow-marked parity cases
 # (fused / 3-D-dist vmap batches — tier-1 carries one representative
 # per axis to hold its 870 s window; this target is the complete
@@ -159,5 +168,6 @@ distclean:
 	rm -rf build exe-*
 
 .PHONY: all test asm format telemetry-report check-artifacts bench-trend \
-	profile-smoke fleet-smoke fleet-suite lint lint-update lint-comm \
+	profile-smoke fleet-smoke serve-smoke fleet-suite lint lint-update \
+	lint-comm \
 	fault-suite dead-rank-smoke ckpt-fsck clean distclean

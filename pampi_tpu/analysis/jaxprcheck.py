@@ -233,6 +233,12 @@ class ChunkConfig:
     overlap_key: str = ""
     dispatch_keys: tuple = ()
     fleet: int = 0
+    # serving-v2 batched variants (all imply `fleet`): mixed per-lane te
+    # (the te-carried chunk), a shape-class padded batch (grid extents
+    # per-lane data), the scenario axis sharded over the device mesh
+    fleet_te: bool = False
+    fleet_class: bool = False
+    fleet_mesh: bool = False
     notes: str = ""
 
     def build(self):
@@ -264,9 +270,32 @@ class ChunkConfig:
         if self.fleet:
             from ..fleet.batch import BatchedSolver
 
-            return BatchedSolver(solver, [param] * self.fleet,
+            params = [param] * self.fleet
+            if self.fleet_te:
+                # mixed end times: BatchedSolver auto-arms the per-lane
+                # te carry (the te-arg chunk) — the serving-v2 trace
+                params = [param.replace(te=param.te * (i + 1))
+                          for i in range(self.fleet)]
+            if self.fleet_class:
+                from ..fleet.shapeclass import ClassSolver, class_grid
+
+                grid = class_grid((param.imax, param.jmax))
+                solver = ClassSolver(param, ic=grid[0], jc=grid[1])
+                if self.fleet >= 2:
+                    # mixed GRIDS share the class compile: the second
+                    # lane is a smaller grid riding the same program
+                    params = ([param,
+                               param.replace(imax=param.imax - 2,
+                                             jmax=param.jmax - 4)]
+                              + [param] * (self.fleet - 2))
+            mesh = None
+            if self.fleet_mesh:
+                import jax
+
+                mesh = list(jax.devices())
+            return BatchedSolver(solver, params,
                                  [f"lane{i}" for i in range(self.fleet)],
-                                 family=self.family)
+                                 family=self.family, mesh=mesh)
         return solver
 
 
@@ -435,6 +464,38 @@ def standard_configs() -> list[ChunkConfig]:
             notes="2-lane vmapped dist chunk: identical collective "
                   "counts to the solo dist trace (lanes ride the "
                   "messages, never add messages), named scopes intact"),
+        # serving v2 (ISSUE 14): the continuous-batching / shape-class /
+        # fleet-over-mesh programs — pure additions, the PR 9 fleet
+        # configs above keep their baked-te traces (hashes unchanged)
+        ChunkConfig(
+            "ns2d_fleet_te", "ns2d",
+            dict(_B2, tpu_fuse_phases="off", tpu_solver="fft"),
+            expected_pallas=0, dispatch_keys=("ns2d_phases",), fleet=3,
+            fleet_te=True,
+            notes="mixed per-lane te: the end time rides the batched "
+                  "carry as an (N,) vector and each lane's while-cond "
+                  "reads its own — still zero kernels on jnp+fft"),
+        ChunkConfig(
+            "ns2d_fleet_class", "ns2d",
+            dict(_B2, tpu_fuse_phases="off", tpu_solver="sor",
+                 tpu_mesh="1"),
+            expected_pallas=0, dispatch_keys=(), fleet=2,
+            fleet_class=True,
+            notes="shape-class padded batch (fleet/shapeclass.py): two "
+                  "DIFFERENT grids ride one 16x16-class program whose "
+                  "extents are per-lane data — all-jnp masked chain, "
+                  "zero kernels, dead pad cells masked from every "
+                  "reduction"),
+        ChunkConfig(
+            "ns2d_fleet_mesh", "ns2d",
+            dict(_B2, tpu_fuse_phases="off", tpu_solver="fft"),
+            expected_pallas=0, dispatch_keys=("ns2d_phases",), fleet=8,
+            fleet_mesh=True,
+            notes="fleet-over-mesh: 8 lanes NamedSharding-sharded over "
+                  "the 8-device lint mesh — the traced program is the "
+                  "identical vmapped chunk (shardings live at the jit "
+                  "boundary), so the census must stay collective-free "
+                  "(the zero-resharding serving contract)"),
     ]
 
 

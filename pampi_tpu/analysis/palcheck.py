@@ -483,6 +483,52 @@ def restricted_grid_violations() -> list[Violation]:
     return vs
 
 
+RULE_SHAPECLASS = "shapeclass-waste"
+
+
+def shapeclass_violations() -> list[Violation]:
+    """The shape-class padding-waste contract (fleet/shapeclass.py,
+    serving v2): for every class-eligible extent the rung ladder must be
+    covering (class >= live), idempotent (a class maps to itself — a
+    padded lane re-bucketed lands in the same compile), power-of-two
+    above the floor, and BOUNDED — per-axis padded extent under 2x the
+    live extent, so a 2-D class never burns more than WASTE_BOUND (4x)
+    the live cells. Checked over the whole eligible range plus explicit
+    rung-differing geometries; stateless, like every palcheck rule."""
+    from ..fleet import shapeclass as sc
+
+    where = "pampi_tpu/fleet/shapeclass.py"
+    vs: list[Violation] = []
+    for n in range(sc.MIN_CLASS_EXTENT, 4097):
+        c = sc.class_extent(n)
+        if c < n:
+            vs.append(Violation(where, 1, RULE_SHAPECLASS,
+                                f"class_extent({n}) = {c} < live"))
+        if sc.class_extent(c) != c:
+            vs.append(Violation(where, 1, RULE_SHAPECLASS,
+                                f"rung {c} is not idempotent"))
+        if c > sc.RUNG_FLOOR and (c & (c - 1)) != 0:
+            vs.append(Violation(where, 1, RULE_SHAPECLASS,
+                                f"rung {c} not a power of two"))
+        if c + 2 >= 2 * (n + 2):
+            vs.append(Violation(
+                where, 1, RULE_SHAPECLASS,
+                f"extent {n}: padded {c + 2} >= 2x live {n + 2} — "
+                "per-axis waste bound broken"))
+    # rung-differing 2-D geometries: the cells bound (the palcheck
+    # contract ISSUE 14 names) must hold where the two axes land on
+    # different rungs
+    for grid in ((17, 33), (9, 129), (20, 48), (16, 16), (255, 9),
+                 (100, 100), (8, 4096)):
+        w = sc.padding_waste(grid)
+        if w >= sc.WASTE_BOUND:
+            vs.append(Violation(
+                where, 1, RULE_SHAPECLASS,
+                f"grid {grid}: padding waste {w:.2f}x >= the "
+                f"{sc.WASTE_BOUND}x bound"))
+    return vs
+
+
 def check_jaxpr(jaxpr, budget: int | None = None,
                 context: str = "") -> list[Violation]:
     vs: list[Violation] = []
@@ -512,4 +558,7 @@ def run(traced=None, configs=None, budget: int | None = None,
         for name, jx, _expect, _full in restricted_grid_entries():
             vs += check_jaxpr(jx.jaxpr, budget=budget, context=f"{name}/")
         vs += restricted_grid_violations()
+        # the serving-v2 shape-class rung ladder: covering, idempotent,
+        # waste-bounded (fleet/shapeclass.py)
+        vs += shapeclass_violations()
     return vs

@@ -1,5 +1,5 @@
 """Scenario-fleet serving: batched multi-tenant runs as a first-class
-workload (ROADMAP item 3).
+workload (ROADMAP item 2 — serving v2).
 
 The north star's "millions of users" is not one 4096² run — it is
 thousands of concurrent small/medium scenarios (parameter sweeps,
@@ -7,12 +7,21 @@ per-user `.par` configs, ensembles). This package turns the solo-run
 machinery into a serving stack:
 
   queue.py      request intake + shared-trace bucketing (what may share
-                one compiled program)
+                one compiled program); per-lane te and the hardened
+                load_queue error path
+  shapeclass.py shape-class batching: power-of-two padded rungs whose
+                grid extents are per-lane DATA — mixed grids share one
+                compile, dead pad cells masked out of every reduction
   batch.py      the vmapped batched driver: N lanes through one chunk,
-                diverged lanes frozen by the in-band sentinel
+                diverged lanes frozen by the in-band sentinel, per-lane
+                te carried, continuous lane swap, fleet-over-mesh
+                NamedSharding
   scheduler.py  the serving front: buckets -> execution mode
                 (`tpu_fleet` knob) -> compiled-program reuse -> fleet
-                summary artifact
+                summary artifact; the continuous-batching pool
+  serve.py      the persistent daemon: file-queue request plane,
+                admission control, per-tenant quotas, live status
+                endpoint (tools/serve.py is the CLI)
 
 See README "Fleet serving" for the request format, the bucketing policy
 and the knob table.
@@ -24,6 +33,7 @@ from .queue import (
     ScenarioRequest,
     bucket,
     bucket_key,
+    class_bucket_key,
     family_of,
     knob_signature,
     load_queue,
@@ -37,11 +47,14 @@ from .scheduler import (
     run_fleet,
     shrink_resume,
 )
+from .serve import FleetDaemon, ServeConfig
 
 __all__ = [
     "BatchedSolver", "FleetRecorder", "lane_state",
-    "BucketKey", "ScenarioRequest", "bucket", "bucket_key", "family_of",
-    "knob_signature", "load_queue", "signature_hash",
+    "BucketKey", "ScenarioRequest", "bucket", "bucket_key",
+    "class_bucket_key", "family_of", "knob_signature", "load_queue",
+    "signature_hash",
     "FleetResult", "FleetScheduler", "ScenarioResult", "reset_templates",
     "run_fleet", "shrink_resume",
+    "FleetDaemon", "ServeConfig",
 ]

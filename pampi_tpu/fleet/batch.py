@@ -14,6 +14,34 @@ and dist chunks batch bitwise-equal to solo runs, the fused kernels at
 the repo's ulp contract (fma re-association under the batched grid —
 the quarters-layout precedent; test-pinned in tests/test_fleet.py).
 
+Fleet v2 additions (ISSUE 14):
+
+- PER-LANE te: with `te_carry` the end time rides the batched state as
+  an (N,) vector and the inner chunk takes it as a traced trailing
+  argument (`_build_chunk(te_arg=True)` in the single-device families),
+  so mixed end times share one compile and each lane's while-cond stops
+  exactly where its solo twin would — batch-of-N-mixed-te == N solo at
+  the ulp contract, test-pinned. te_carry off (the default) is the
+  byte-identical PR 9 trace (CONTRACTS.json hashes unchanged); mixed-te
+  DIST buckets are split per te by the scheduler instead (the shard_map
+  chunk still bakes te).
+- CONTINUOUS LANE SWAP: `swap_lane(state, lane, param, sid)` splices a
+  fresh scenario into a finished or diverged lane's slot host-side —
+  the compiled chunk is untouched (zero retrace per (signature,
+  lanes)), the new lane starts at t=0 in its slot and tracks its solo
+  twin bitwise on the jnp paths. `harvest(state, lane)` reads one
+  lane's result without draining the batch.
+- FLEET-OVER-MESH: `mesh=` (a device list) shards the scenario axis
+  across a mesh axis via NamedSharding — the middle mode between vmap
+  (one device) and whole-mesh pjit: a v5e-8 serves 8 lanes in true
+  parallel with zero collectives between lanes (the traced program
+  carries no cross-lane ops except the scalar t_drive reduction;
+  commcheck's zero-resharding ban pins it).
+- CLASS TEMPLATES: a template exposing `lane_state(param)` /
+  `crop_lane(fields, param)` / `_time_index` (fleet/shapeclass.
+  ClassSolver) supplies per-lane state with the grid extents as data —
+  the shape-class chunk rides this same wrapper unchanged.
+
 Diverged-lane isolation (the PR 3 sentinel put to work): the fleet
 wrapper appends a per-lane `active` mask plus two drive scalars to the
 stacked state. After each vmapped chunk, a lane whose in-band sentinel
@@ -22,13 +50,13 @@ is retired: `active` drops, and every later chunk passes its state
 through bitwise (`where(active, new, old)`) — the blown-up scenario
 freezes AT its divergence chunk holding the diagnostic-bearing state,
 keeps its emitted divergence record, and its batchmates continue
-untouched. The drive loop reads `t_drive = min over active lanes` (+inf
-once none remain), so a dead lane never blocks — and never spins — the
-fleet. Ring rollback-recovery stays a solo-run feature: a fleet-level
-rollback would rewind HEALTHY batchmates to recover one lane, the
-opposite of the isolation contract, so the batch driver does not arm it
-(requests carrying tpu_recover_ring are still served; the knob is
-recorded as inert for the batch).
+untouched. The drive loop reads `t_drive = min over active (and, under
+te_carry, unfinished) lanes` (+inf once none remain), so a dead lane
+never blocks — and never spins — the fleet. Ring rollback-recovery
+stays a solo-run feature: a fleet-level rollback would rewind HEALTHY
+batchmates to recover one lane, the opposite of the isolation contract,
+so the batch driver does not arm it (requests carrying tpu_recover_ring
+are still served; the knob is recorded as inert for the batch).
 
 Per-lane fault injection (`nan|inf@lane<K>:<field>`, utils/faultinject):
 consumed at batch build, applied host-side to the stacked INITIAL state
@@ -53,7 +81,12 @@ def lane_state(template, param) -> tuple:
     solver: the template's geometry/arity with the request's init values.
     Exact — every family initializes its fields as constant fills (the
     reference's init_arrays), so `full_like` reproduces precisely what a
-    solver built from `param` would hold."""
+    solver built from `param` would hold. A template with its own
+    `lane_state` hook (the shape-class ClassSolver) builds the lane
+    itself — per-lane geometry scalars included."""
+    hook = getattr(template, "lane_state", None)
+    if hook is not None:
+        return hook(param)
     fields, tail = _split_state(template, template.initial_state())
     names = _field_names(len(fields))
     inits = {"u": param.u_init, "v": param.v_init, "w": param.w_init,
@@ -83,17 +116,20 @@ def _split_state(template, state):
 class BatchedSolver:
     """N same-signature scenarios as one drive_chunks-compatible solver.
 
-    State layout: (stacked lane leaves..., active, t_drive, nt_drive)
-    where the lane leaves follow the template's own chunk arity with a
-    leading scenario axis, `active` is the (N,) lane-liveness mask and
-    the two drive scalars are what the host loop reads (`time_index` =
-    the t_drive slot). Exposes the retry-protocol surface
-    (`_backend`/`_uses_pallas`/`_build_chunk`/`_chunk_fn`) by delegating
-    to the template, so `models/_driver.pallas_retry` recovers a batched
-    pallas failure with the same jnp-fallback/restore protocol as a solo
-    run — one fallback covers all N lanes (they share the program)."""
+    State layout: (stacked lane leaves...[, te], active, t_drive,
+    nt_drive) where the lane leaves follow the template's own chunk
+    arity with a leading scenario axis, `te` is the (N,) per-lane end
+    time (present only under te_carry), `active` is the (N,)
+    lane-liveness mask and the two drive scalars are what the host loop
+    reads (`time_index` = the t_drive slot). Exposes the retry-protocol
+    surface (`_backend`/`_uses_pallas`/`_build_chunk`/`_chunk_fn`) by
+    delegating to the template, so `models/_driver.pallas_retry`
+    recovers a batched pallas failure with the same jnp-fallback/restore
+    protocol as a solo run — one fallback covers all N lanes (they share
+    the program)."""
 
-    def __init__(self, template, params, sids, family: str = ""):
+    def __init__(self, template, params, sids, family: str = "",
+                 te_carry=None, mesh=None):
         if not params:
             raise ValueError("BatchedSolver needs at least one scenario")
         from .queue import DRIVE_KEYS
@@ -113,16 +149,49 @@ class BatchedSolver:
         self.n = len(self.params)
         self._metrics = template._metrics
         self._lane_arity = len(template.initial_state())
-        self._time_index = self._lane_arity - (3 if self._metrics else 2)
-        self._n_fields = self._time_index
+        self._time_index = getattr(
+            template, "_time_index",
+            self._lane_arity - (3 if self._metrics else 2))
+        self._n_fields = getattr(template, "_n_fields", self._time_index)
+        lane_tes = {float(p.te) for p in self.params}
+        tpl_te = float(template.param.te)
+        # te needs carrying when the lanes disagree with each other OR
+        # with the end time baked into the template's own trace (te is
+        # signature-excluded since serving v2, so a cached template may
+        # have been built under another tenant's te)
+        mixed_te = len(lane_tes) > 1 or lane_tes != {tpl_te}
+        # a class template's chunk takes te unconditionally (its carry
+        # is inherently per-lane); solver templates opt in per batch
+        self._te_carry = bool(getattr(template, "_te_always", False)
+                              or (mixed_te if te_carry is None
+                                  else te_carry))
+        if mixed_te and not self._te_carry:
+            raise ValueError(
+                "per-lane te off-template needs te_carry (the dist "
+                "chunk bakes te — the scheduler splits such buckets "
+                "per te)")
+        if self._te_carry and self._dist():
+            raise ValueError(
+                "te_carry is a single-device-chunk feature (the "
+                "shard_map chunk bakes te; dist buckets split per te)")
+        self._te_index = self._lane_arity if self._te_carry else None
+        self._active_index = self._lane_arity + (
+            1 if self._te_carry else 0)
+        self._mesh = list(mesh) if mesh else None
+        if self._mesh and self.n % len(self._mesh) != 0:
+            raise ValueError(
+                f"fleet-over-mesh needs lanes ({self.n}) divisible by "
+                f"devices ({len(self._mesh)})")
         # only clauses THIS batch can express are consumed — a clause
         # aimed past the lane count (or at a field the family lacks)
         # stays armed for the batch it targets
         self._lane_faults = _fi.take_lane_faults(
             n_lanes=self.n, fields=_field_names(self._n_fields))
         t0 = time.perf_counter()
-        self._chunk_fn = jax.jit(self._build_chunk())
+        self._chunk_fn = self._jit(self._build_chunk())
         _tm.emit("build", family=f"fleet.{self.family}", lanes=self.n,
+                 te_carry=self._te_carry,
+                 mesh=len(self._mesh) if self._mesh else 0,
                  trace_wall_s=round(time.perf_counter() - t0, 3))
 
     def rebind(self, params, sids) -> None:
@@ -139,6 +208,13 @@ class BatchedSolver:
             raise ValueError(
                 f"rebind needs {self.n} scenarios (got {len(params)}) — "
                 "a different lane count is a different compiled batch")
+        if (not self._te_carry
+                and {float(p.te) for p in params}
+                != {float(self.template.param.te)}):
+            raise ValueError(
+                "this batch was compiled without the per-lane te carry; "
+                "a request set off the template's baked te is a "
+                "different compiled batch")
         self.params = list(params)
         self.sids = list(sids)
         self.param = self.template.param.replace(
@@ -162,6 +238,30 @@ class BatchedSolver:
         return hasattr(self.template, "_chunk_sm")
 
     # -- the batched chunk ---------------------------------------------
+    def _jit(self, fn):
+        """jit the fleet chunk — plain on one device; under `mesh`, the
+        scenario axis is sharded across the mesh's `lanes` axis via
+        NamedSharding (lane leaves P("lanes"), drive scalars
+        replicated). The traced program is the identical vmapped chunk
+        (shardings live at the jit boundary, so the jaxpr census stays
+        collective-free — the commcheck zero-resharding contract); the
+        partitioner then runs n/devices lanes per chip with no
+        cross-lane communication beyond the scalar t_drive reduction."""
+        if not self._mesh:
+            return jax.jit(fn)
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(np.array(self._mesh), ("lanes",))
+
+        def spec(x):
+            if getattr(x, "ndim", 0) >= 1 and x.shape[0] == self.n:
+                return NamedSharding(mesh, PartitionSpec("lanes"))
+            return NamedSharding(mesh, PartitionSpec())
+
+        shardings = tuple(spec(x) for x in self.initial_state())
+        return jax.jit(fn, in_shardings=shardings,
+                       out_shardings=shardings)
+
     def _build_chunk(self, backend: str | None = None):
         tpl = self.template
         if self._dist():
@@ -169,6 +269,12 @@ class BatchedSolver:
             # per-backend rebuild path (models/ns2d_dist.run contract):
             # vmap it as-is; the retry hook returns None there
             inner = tpl._chunk_sm
+        elif self._te_carry:
+            # per-lane te: the inner chunk takes te as a traced trailing
+            # argument (models/ns2d._build_chunk te_arg contract)
+            inner = tpl._build_chunk(
+                backend if backend is not None else tpl._backend,
+                te_arg=True)
         else:
             inner = tpl._build_chunk(
                 backend if backend is not None else tpl._backend)
@@ -177,17 +283,7 @@ class BatchedSolver:
             self._lane_arity - 1 if self._metrics else None)
         n_fields = self._n_fields
 
-        def fleet_chunk(*state):
-            lanes = state[:self._lane_arity]
-            active = state[self._lane_arity]
-            new = vchunk(*lanes)
-            # freeze retired lanes bitwise: a lane that diverged in an
-            # earlier chunk keeps its diagnostic-bearing state untouched
-            out = tuple(
-                jnp.where(active.reshape((-1,) + (1,) * (x.ndim - 1)),
-                          x, old)
-                for x, old in zip(new, lanes))
-            t = out[ti]
+        def lane_ok(out, t):
             ok = jnp.isfinite(t)
             if mi is not None:
                 # the in-band sentinel (PR 3): latched per lane inside
@@ -202,10 +298,43 @@ class BatchedSolver:
                     fin = jnp.all(jnp.isfinite(f),
                                   axis=tuple(range(1, f.ndim)))
                     ok = jnp.logical_and(ok, fin)
-            active = jnp.logical_and(active, ok)
-            t_drive = jnp.min(jnp.where(active, t, jnp.inf))
-            nt_drive = jnp.max(out[ti + 1])
-            return (*out, active, t_drive, nt_drive)
+            return ok
+
+        if self._te_carry:
+            def fleet_chunk(*state):
+                lanes = state[:self._lane_arity]
+                te = state[self._lane_arity]
+                active = state[self._lane_arity + 1]
+                new = vchunk(*lanes, te)
+                out = tuple(
+                    jnp.where(active.reshape(
+                        (-1,) + (1,) * (x.ndim - 1)), x, old)
+                    for x, old in zip(new, lanes))
+                t = out[ti]
+                active = jnp.logical_and(active, lane_ok(out, t))
+                # a lane past its OWN te is finished: exclude it from
+                # the drive minimum (its frozen t would otherwise hold
+                # t_drive below a longer lane's te forever)
+                running = jnp.logical_and(active, t <= te)
+                t_drive = jnp.min(jnp.where(running, t, jnp.inf))
+                nt_drive = jnp.max(out[ti + 1])
+                return (*out, te, active, t_drive, nt_drive)
+        else:
+            def fleet_chunk(*state):
+                lanes = state[:self._lane_arity]
+                active = state[self._lane_arity]
+                new = vchunk(*lanes)
+                # freeze retired lanes bitwise: a lane that diverged in
+                # an earlier chunk keeps its diagnostic-bearing state
+                out = tuple(
+                    jnp.where(active.reshape(
+                        (-1,) + (1,) * (x.ndim - 1)), x, old)
+                    for x, old in zip(new, lanes))
+                t = out[ti]
+                active = jnp.logical_and(active, lane_ok(out, t))
+                t_drive = jnp.min(jnp.where(active, t, jnp.inf))
+                nt_drive = jnp.max(out[ti + 1])
+                return (*out, active, t_drive, nt_drive)
 
         return fleet_chunk
 
@@ -224,8 +353,61 @@ class BatchedSolver:
         active = jnp.ones((self.n,), bool)
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 \
             else jnp.float32
+        if self._te_carry:
+            te = jnp.asarray([float(p.te) for p in self.params],
+                             time_dtype)
+            stacked = stacked + (te,)
         return stacked + (active, jnp.asarray(0.0, time_dtype),
                           jnp.asarray(0, jnp.int32))
+
+    def drive_te(self) -> float:
+        """The end time the HOST loop drives to: the max lane te (every
+        lane's own while-cond stops it at its own te first)."""
+        return max(float(p.te) for p in self.params)
+
+    def lane_done(self, state) -> np.ndarray:
+        """(N,) host bools: lane finished (past its own te) OR retired
+        (diverged) — the continuous-batching swap predicate."""
+        active = np.asarray(state[self._active_index])
+        t = np.asarray(state[self._time_index])
+        if self._te_carry:
+            te = np.asarray(state[self._te_index])
+        else:
+            te = float(self.param.te)
+        return np.logical_or(~active, t > te)
+
+    def swap_lane(self, state, lane: int, param, sid: str) -> tuple:
+        """CONTINUOUS BATCHING: splice a fresh scenario into lane
+        `lane`'s slot — host-side state surgery on the stacked leaves,
+        the compiled chunk untouched (zero retrace). The new lane starts
+        at t=0 under its own te and advances bitwise like a solo run
+        from the next chunk dispatch. The caller harvests the outgoing
+        lane's result FIRST (`harvest`)."""
+        if not (0 <= lane < self.n):
+            raise ValueError(f"lane {lane} out of range 0..{self.n - 1}")
+        if (not self._te_carry
+                and float(param.te) != float(self.param.te)):
+            raise ValueError(
+                "swapping in a different te needs a te_carry batch")
+        fresh = lane_state(self.template, param)
+        out = list(state)
+        for i, leaf in enumerate(fresh):
+            out[i] = out[i].at[lane].set(leaf)
+        if self._te_carry:
+            out[self._te_index] = out[self._te_index].at[lane].set(
+                float(param.te))
+        out[self._active_index] = \
+            out[self._active_index].at[lane].set(True)
+        # the drive scalars refresh at the next chunk boundary; reset
+        # t_drive so the host loop cannot terminate on a stale minimum
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 \
+            else jnp.float32
+        out[self._active_index + 1] = jnp.asarray(0.0, time_dtype)
+        self.params[lane] = param
+        self.sids[lane] = sid
+        _tm.emit("swap", family=f"fleet.{self.family}", lane=lane,
+                 scenario=sid)
+        return tuple(out)
 
     def run(self, progress: bool = False):
         """Drive the batch to te through models/_driver.drive_chunks —
@@ -239,7 +421,7 @@ class BatchedSolver:
         from ..utils import flags as _flags
         from ..utils.progress import Progress
 
-        te = self.param.te
+        te = self.drive_te()
         bar = Progress(te, enabled=progress and not _flags.verbose())
         state = self.initial_state()
         rec = (FleetRecorder(self.family, self.sids)
@@ -249,9 +431,9 @@ class BatchedSolver:
             if rec is not None:
                 rec.update(self, s)
 
-        # t_drive sits right past the lanes-plus-active block; nt_drive
-        # rides one slot later (the drive loop's ETA contract)
-        time_index = self._lane_arity + 1
+        # t_drive sits right past the lanes(+te)-plus-active block;
+        # nt_drive rides one slot later (the drive loop's ETA contract)
+        time_index = self._active_index + 1
         if self._dist():
             # no per-backend rebuild path for the shard_map chunk, and
             # no rank-local transient retry under multi-process (the
@@ -269,26 +451,32 @@ class BatchedSolver:
             replenish_after=self.param.tpu_retry_replenish,
             recover=None, transient_budget=budget)
 
+    def harvest(self, state, lane: int) -> dict:
+        """One lane's result from a fleet state (the continuous-batching
+        read-out; results() maps it over every lane)."""
+        active = np.asarray(state[self._active_index])
+        t = np.asarray(state[self._time_index])
+        nt = np.asarray(state[self._time_index + 1])
+        fields = tuple(np.asarray(leaf[lane])
+                       for leaf in state[:self._n_fields])
+        crop = getattr(self.template, "crop_lane", None)
+        if crop is not None:
+            fields = crop(fields, self.params[lane])
+        return {
+            "sid": self.sids[lane],
+            "t": float(t[lane]),
+            "nt": int(nt[lane]),
+            "diverged": not bool(active[lane]),
+            "fields": fields,
+        }
+
     def results(self, state) -> list[dict]:
         """Per-scenario results from a final fleet state: one dict per
         lane {sid, t, nt, diverged, fields} — `fields` in the template's
         own layout (dist lanes hold stacked shard blocks, exactly what
-        the solo solver publishes)."""
-        active = np.asarray(state[self._lane_arity])
-        t = np.asarray(state[self._time_index])
-        nt = np.asarray(state[self._time_index + 1])
-        out = []
-        for i, sid in enumerate(self.sids):
-            fields = tuple(np.asarray(leaf[i])
-                           for leaf in state[:self._n_fields])
-            out.append({
-                "sid": sid,
-                "t": float(t[i]),
-                "nt": int(nt[i]),
-                "diverged": not bool(active[i]),
-                "fields": fields,
-            })
-        return out
+        the solo solver publishes; class lanes are cropped back to their
+        request's reference layout via the template's crop hook)."""
+        return [self.harvest(state, i) for i in range(self.n)]
 
 
 class FleetRecorder:
@@ -297,12 +485,20 @@ class FleetRecorder:
     divergence record fires once, from its own sentinel). A retired or
     finished lane whose step counter stopped advancing emits no further
     chunk records — a frozen lane is visible as silence after its
-    divergence record, not as a stream of zero-step rows."""
+    divergence record, not as a stream of zero-step rows. `rearm(lane,
+    sid)` re-points one slot at a swapped-in scenario (continuous
+    batching)."""
 
     def __init__(self, family: str, sids, nt0: int = 0):
+        self._family = family
         self._recs = [_tm.ChunkRecorder(family, nt0, scenario=sid)
                       for sid in sids]
         self._nts = [nt0] * len(sids)
+
+    def rearm(self, lane: int, sid: str) -> None:
+        self._recs[lane] = _tm.ChunkRecorder(self._family, 0,
+                                             scenario=sid)
+        self._nts[lane] = 0
 
     def update(self, batched: BatchedSolver, state) -> None:
         if not _tm.enabled():

@@ -19,9 +19,17 @@ Bucketing policy (the one statement of "what may share a trace"):
 - Excluded from the signature: the per-lane STATE keys (`u_init`,
   `v_init`, `w_init`, `p_init` — pure initial-field values, the natural
   sweep axis: a hundred initial conditions of one configuration is one
-  bucket) and drive-loop housekeeping that never enters the trace
-  (checkpoint/restart paths, vtk mode, lookahead, retry/recovery knobs,
-  `tpu_fleet` itself).
+  bucket), the per-lane DRIVE keys (`te` — carried in the batched chunk
+  state since fleet v2, so mixed end times share one compile and a
+  finished lane can be swapped for a queued scenario), and drive-loop
+  housekeeping that never enters the trace (checkpoint/restart paths,
+  vtk mode, lookahead, retry/recovery knobs, `tpu_fleet` itself).
+  Distributed buckets sub-group by te (their shard_map chunk still
+  bakes it — fleet/scheduler splits such buckets per te, recorded).
+
+Shape classes (fleet/shapeclass.py) coarsen the key further when the
+scheduler enables them: eligible mixed-GRID requests coalesce into one
+power-of-two class bucket whose grid extents are per-lane data.
 
 The signature is a string, the bucket id a stable short hash of it —
 artifact keys and dispatch records stay readable and machine-stable
@@ -39,6 +47,11 @@ from ..utils.params import Parameter, is_3d_config, read_parameter
 # per-lane state-only keys: they set initial FIELD VALUES, never trace
 # structure — the vmap sweep axis
 LANE_KEYS = ("u_init", "v_init", "w_init", "p_init")
+
+# per-lane DRIVE keys (fleet v2): trace-shaping for a SOLO build, but
+# the batched chunk carries them per lane (te rides the chunk state like
+# the per-lane dt already does), so they leave the bucket signature
+PER_LANE_KEYS = ("te",)
 
 # drive-loop housekeeping: consumed by the host driver, never traced
 HOUSEKEEPING_KEYS = (
@@ -110,7 +123,7 @@ def knob_signature(param: Parameter) -> str:
     """Canonical string of every trace-shaping Parameter field — equal
     signatures <=> the solvers build the identical chunk program (the
     vmap-batch eligibility contract, test-pinned)."""
-    skip = set(LANE_KEYS) | set(HOUSEKEEPING_KEYS)
+    skip = set(LANE_KEYS) | set(HOUSEKEEPING_KEYS) | set(PER_LANE_KEYS)
     parts = []
     for f in dataclasses.fields(Parameter):
         if f.name in skip:
@@ -131,26 +144,70 @@ def bucket_key(param: Parameter) -> BucketKey:
     return BucketKey(family=family, grid=grid, sig=signature_hash(param))
 
 
-def bucket(requests) -> dict:
+def class_bucket_key(param) -> "BucketKey | None":
+    """The SHAPE-CLASS bucket of a request, or None when it must keep
+    its exact-shape bucket (fleet/shapeclass.class_eligible). The key's
+    grid is the padded class grid; the signature hash excludes the grid
+    extents (per-lane data in the class chunk) and carries a "cls"
+    prefix so a class bucket can never collide with an exact bucket of
+    the same grid."""
+    from . import shapeclass as sc
+
+    family = family_of(param)
+    if family != "ns2d" or sc.class_eligible(param) is not None:
+        return None
+    grid = sc.class_grid((param.imax, param.jmax))
+    return BucketKey(family=family, grid=grid,
+                     sig=sc.class_sig_hash(param))
+
+
+def bucket(requests, classes: bool = False) -> dict:
     """Group requests by shared-trace bucket; insertion-ordered (the
     scheduler executes buckets in first-seen order, lanes in submit
-    order — deterministic end-to-end)."""
+    order — deterministic end-to-end). `classes=True` routes eligible
+    requests into shape-class buckets (pad-and-mask shared compiles);
+    ineligible requests keep their exact-shape bucket either way."""
     out: dict[BucketKey, list[ScenarioRequest]] = {}
     for req in requests:
-        out.setdefault(bucket_key(req.param), []).append(req)
+        key = class_bucket_key(req.param) if classes else None
+        if key is None:
+            key = bucket_key(req.param)
+        out.setdefault(key, []).append(req)
     return out
 
 
-def load_queue(paths, base: Parameter | None = None) -> list[ScenarioRequest]:
+def load_queue(paths, base: Parameter | None = None,
+               on_error=None) -> list[ScenarioRequest]:
     """Read a queue of `.par` files into requests; the scenario id is the
-    file stem (deduplicated with #k suffixes for repeated stems)."""
+    file stem (deduplicated with #k suffixes for repeated stems).
+
+    `on_error(path, exc)`, when given, HARDENS the intake: a malformed
+    or unreadable .par (parse failure, bad value, unreadable file,
+    fleet-ineligible config like a poisson/restart request) is handed to
+    the callback and SKIPPED instead of killing the caller — the serving
+    daemon parks such files with a structured `warning` telemetry record
+    (fleet/serve.py). None keeps the historical raise-through behavior.
+    read_parameter's reference-parity SystemExit on bad input is caught
+    and converted like any other error (a daemon must never inherit the
+    CLI's exit-on-bad-config semantics from one tenant's file)."""
     reqs: list[ScenarioRequest] = []
     seen: dict[str, int] = {}
     for path in paths:
         stem = os.path.splitext(os.path.basename(path))[0]
         n = seen.get(stem, 0)
-        seen[stem] = n + 1
         sid = stem if n == 0 else f"{stem}#{n}"
-        reqs.append(ScenarioRequest(sid=sid,
-                                    param=read_parameter(path, base)))
+        try:
+            param = read_parameter(path, base)
+            if on_error is not None:
+                # hardened intake only: refuse poisson/restart requests
+                # HERE so the daemon parks them (the historical path
+                # keeps refusing at bucketing time, unchanged)
+                family_of(param)
+        except (SystemExit, ValueError, OSError) as exc:
+            if on_error is None:
+                raise
+            on_error(path, exc)
+            continue
+        seen[stem] = n + 1
+        reqs.append(ScenarioRequest(sid=sid, param=param))
     return reqs

@@ -17,11 +17,26 @@ knob (utils/dispatch.resolve_fleet — every decision recorded like
 Execution modes (see resolve_fleet for the auto policy):
   vmap   fleet/batch.BatchedSolver — one vmapped chunk advances every
          lane; diverged lanes freeze, batchmates continue
+  mesh   fleet-over-mesh (fleet v2): the vmapped chunk's scenario axis
+         sharded across a device-mesh axis via NamedSharding — N lanes
+         in true parallel on N chips, zero collectives between lanes
+         (the commcheck zero-resharding ban is the contract)
+  class  shape-class batching (fleet/shapeclass.py): eligible
+         mixed-GRID requests pad-and-mask into one power-of-two class
+         program whose grid extents are per-lane data — a thousand
+         slightly-different grids compile a handful of programs
   pjit   whole-mesh per scenario, sequential, template reused (the
          dist-bucket mode: the existing solver IS the pjit-across-mesh
          program; lanes run through solver.run() under scenario_scope)
   solo   the historical path — a fresh solver per request (the
          fleet-smoke drift oracle)
+
+Continuous batching (fleet v2): with a lane-pool size set (`lanes=`,
+the daemon's max_lanes), a bucket larger than the pool runs as a
+CONTINUOUS batch — a finished or diverged lane is swapped for a queued
+scenario host-side (`BatchedSolver.swap_lane`; zero retrace per
+(signature, lanes)) instead of draining the whole batch, and per-lane
+te rides the chunk carry so mixed end times share the compile.
 
 Every run emits the fleet summary through the telemetry plane: one
 `fleet` record {n_scenarios, buckets: [per-bucket mode/compile-vs-run
@@ -79,6 +94,10 @@ class ScenarioResult:
     nt: int
     diverged: bool
     fields: tuple
+    # scheduling failed for this request's whole bucket (isolate mode:
+    # the daemon's per-bucket degradation — see FleetScheduler.run)
+    failed: bool = False
+    error: str = ""
 
 
 @dataclasses.dataclass
@@ -150,7 +169,11 @@ def _clear_contamination(solver) -> bool:
     (cumulative `_dt_scale` clamp) or pallas->jnp fallback (`_backend`)
     must not leak into the next tenant's program — reset the knobs and
     re-trace when either drifted, so the next lane runs the program a
-    fresh solver would have built. Returns whether a re-trace happened."""
+    fresh solver would have built. Returns whether a re-trace happened.
+    Class templates (fleet/shapeclass.ClassSolver) have exactly one jnp
+    program and no rebuild hook — nothing to heal."""
+    if not hasattr(solver, "_rebuild_chunk"):
+        return False
     if (getattr(solver, "_dt_scale", 1.0) != 1.0
             or getattr(solver, "_backend", "auto") != "auto"):
         solver._dt_scale = 1.0
@@ -169,12 +192,41 @@ def _reset_lane(solver, param) -> None:
     # signature-equal across the bucket, so only these can differ)
     solver.param = solver.param.replace(
         **{k: getattr(param, k) for k in _q.DRIVE_KEYS})
+    if float(solver.param.te) != float(param.te):
+        # te left the bucket signature (per-lane since fleet v2) but the
+        # SOLO chunk still bakes it: a pjit lane with a different end
+        # time re-traces the template against its own te (compile cost
+        # per distinct te in a pjit bucket — correctness over reuse; the
+        # vmap/class paths carry te per lane instead)
+        solver.param = solver.param.replace(te=param.te)
+        import jax as _jax
+
+        solver._chunk_fn = _jax.jit(
+            solver._build_chunk(backend=solver._backend))
     state = lane_state(solver, param)
     fields, _tail = _split_state(solver, state)
     for name, value in zip(_field_names(len(fields)), fields):
         setattr(solver, name, value)
     solver.t = 0.0
     solver.nt = 0
+
+
+def _split_by_te(key, reqs):
+    """Per-te sub-buckets of one DIST bucket (insertion-ordered): the
+    shard_map chunk bakes te, so a mixed-te dist bucket runs as one
+    compiled batch per distinct te (single-device buckets carry te per
+    lane instead — fleet/batch.BatchedSolver te_carry)."""
+    groups: dict[float, list] = {}
+    for req in reqs:
+        groups.setdefault(float(req.param.te), []).append(req)
+    return [
+        # keyed by the te VALUE unconditionally — te is signature-
+        # excluded, so the dist template cache must map (sig, te) ->
+        # its baked-te solver: a later run's different-te bucket would
+        # otherwise hit a stale-te template
+        (dataclasses.replace(key, sig=f"{key.sig}-te{te!r}"), greqs)
+        for te, greqs in groups.items()
+    ]
 
 
 def _solo_result(solver, sid, label, mode, family) -> ScenarioResult:
@@ -196,11 +248,36 @@ class FleetScheduler:
     compiled programs), and construction arms the persistent XLA disk
     cache so the same holds across processes."""
 
-    def __init__(self, requests=None):
+    def __init__(self, requests=None, classes: str = "off",
+                 lanes: int = 0, isolate: bool = False):
         from ..utils import xlacache
 
+        if classes not in ("on", "off", "auto"):
+            raise ValueError(
+                f"classes must be on|off|auto, got {classes!r}")
+        if lanes < 0:
+            raise ValueError(f"lanes must be >= 0, got {lanes}")
+        # isolate=True (the daemon): a bucket whose build/execution
+        # raises degrades to FAILED ScenarioResults + a warning record
+        # and the run continues with the other buckets — one tenant's
+        # unschedulable knob combo must not take down its poll-mates.
+        # False (the default) keeps loud errors for programmatic use.
+        self.isolate = isolate
         xlacache.enable()
         self.requests: list[_q.ScenarioRequest] = list(requests or [])
+        # shape-class batching: "on"/"auto" coalesce eligible mixed-GRID
+        # requests into padded class buckets (the serving daemon's
+        # default); "off" keeps the PR 9 exact-shape bucketing — the
+        # scheduler-construction default, so existing callers and the
+        # drift oracles see unchanged routing
+        self.classes = classes
+        # continuous-batching pool size: a bucket larger than this runs
+        # with lane swap-in instead of one all-lanes batch (0 = off)
+        self.lanes = lanes
+        # serving accounting (the daemon's status plane): per-class/
+        # bucket compile counts and swap totals for THIS scheduler
+        self.compile_census: dict[str, int] = {}
+        self.swap_census: dict[str, int] = {}
 
     def submit(self, request: _q.ScenarioRequest) -> None:
         self.requests.append(request)
@@ -210,31 +287,43 @@ class FleetScheduler:
 
     # -- execution ------------------------------------------------------
     def run(self, progress: bool = False) -> FleetResult:
-        from ..utils import dispatch as _dispatch
-
         if not self.requests:
             raise ValueError("fleet queue is empty")
         batch, self.requests = self.requests, []  # run() drains the queue
-        buckets = _q.bucket(batch)
+        buckets = _q.bucket(batch, classes=self.classes in ("on", "auto"))
         scenarios: list[ScenarioResult] = []
         bucket_rows: list[dict] = []
         run_wall_total = 0.0
         for key, reqs in buckets.items():
-            rep = reqs[0].param
-            # mode needs the mesh answer before any build: decide it
-            # without constructing (the template build makes the real comm)
-            dist = _is_dist(rep)
-            mode = _dispatch.resolve_fleet(
-                rep, len(reqs), dist, f"fleet_{key.label}")
-            with _tm.span(f"fleet.bucket.{key.label}", mode=mode,
-                          lanes=len(reqs)):
-                row, results = self._run_bucket(
-                    key, reqs, mode, progress)
-            bucket_rows.append(row)
-            run_wall_total += row["run_wall_s"]
-            scenarios += results
+            try:
+                rows_results = self._serve_bucket(key, reqs, progress)
+            except Exception as exc:  # lint: allow(broad-except) — per-bucket isolation (isolate mode): any mode-resolution/build/execution failure degrades to failed results, re-raised verbatim otherwise
+                if not self.isolate:
+                    raise
+                _tm.emit("warning", component="fleet.scheduler",
+                         reason="bucket_failed", bucket=key.label,
+                         error=str(exc),
+                         scenarios=[r.sid for r in reqs])
+                row = {"bucket": key.label, "family": key.family,
+                       "grid": list(key.grid), "mode": "failed",
+                       "lanes": len(reqs), "template_cached": False,
+                       "compile_wall_s": 0.0, "run_wall_s": 0.0,
+                       "failed": True, "error": str(exc)}
+                rows_results = [(row, [
+                    ScenarioResult(
+                        sid=r.sid, bucket=key.label, mode="failed",
+                        family=key.family, t=0.0, nt=0,
+                        diverged=False, fields=(), failed=True,
+                        error=str(exc))
+                    for r in reqs])]
+            for row, results in rows_results:
+                bucket_rows.append(row)
+                run_wall_total += row["run_wall_s"]
+                scenarios += results
         diverged = [s.sid for s in scenarios if s.diverged]
-        per_s = (round(len(scenarios) / run_wall_total, 4)
+        failed = [s.sid for s in scenarios if s.failed]
+        per_s = (round((len(scenarios) - len(failed)) / run_wall_total,
+                       4)
                  if run_wall_total > 0 else None)
         summary = {
             "n_scenarios": len(scenarios),
@@ -245,10 +334,55 @@ class FleetScheduler:
                 "scenarios": diverged,
             },
         }
+        if failed:
+            # isolate mode only: buckets that could not be scheduled
+            # (pure addition — legacy summaries never carry the key)
+            summary["failures"] = {"failed": len(failed),
+                                   "scenarios": failed}
         _tm.emit("fleet", **summary)
         _tm.emit("metric", metric="fleet_scenarios_per_s", value=per_s,
                  unit="scenarios/s", backend=jax.default_backend())
         return FleetResult(scenarios=scenarios, summary=summary)
+
+    def _serve_bucket(self, key, reqs, progress: bool) -> list:
+        """Mode resolution + execution of ONE bucket (te sub-groups
+        included). Returns [(bucket row, results), ...] — the unit
+        run()'s per-bucket isolation wraps."""
+        from ..utils import dispatch as _dispatch
+
+        if key.sig.startswith("cls"):
+            # the class chunk is its own (vmap-shaped) program; the
+            # decision is recorded per bucket like every mode
+            mode = "class"
+            _dispatch.record(
+                f"fleet_{key.label}",
+                f"class (padded {'x'.join(map(str, key.grid))}, "
+                f"{len(reqs)} lanes)")
+            groups = [(key, reqs)]
+        else:
+            # mode needs the mesh answer before any build: decide it
+            # without constructing (the template build makes the real
+            # comm). Dist buckets SPLIT per te: te left the bucket
+            # signature (per-lane since fleet v2) but the shard_map
+            # chunk still bakes it. The lane count the mode is resolved
+            # on is the EFFECTIVE batch size — the continuous pool when
+            # one is armed — so a mesh divisibility decision matches
+            # the batch that will actually be built.
+            rep = reqs[0].param
+            dist = _is_dist(rep)
+            n_eff = (min(self.lanes, len(reqs)) if self.lanes > 0
+                     else len(reqs))
+            mode = _dispatch.resolve_fleet(
+                rep, n_eff, dist, f"fleet_{key.label}")
+            groups = ([(key, reqs)] if not dist
+                      else _split_by_te(key, reqs))
+        out = []
+        for gkey, greqs in groups:
+            with _tm.span(f"fleet.bucket.{gkey.label}", mode=mode,
+                          lanes=len(greqs)):
+                out.append(self._run_bucket(gkey, greqs, mode,
+                                            progress))
+        return out
 
     def _run_bucket(self, key, reqs, mode: str, progress: bool):
         family = key.family
@@ -279,43 +413,38 @@ class FleetScheduler:
                 results.append(_solo_result(
                     template, req.sid, label, mode, family))
             run_wall = time.perf_counter() - t0
-        else:  # vmap
-            # the bare template only: the vmap path never executes the
-            # solo chunk, so warming it would be a wasted compile
-            template, _dist, wall = _template(key.sig, reqs[0].param,
-                                              family)
+        else:  # vmap | mesh | class — the batched paths
+            template, cached_tpl, wall = self._bucket_template(
+                key, reqs, mode)
             build_wall = 0.0 if wall is None else wall
             # heal BEFORE building: a template left dirty by an earlier
             # bucket (recovery dt clamp, pallas fallback) would be baked
             # into the batched trace and serve every lane a wrong program
             if _clear_contamination(template):
                 _drop_batches(key.sig)  # cached batches wrapped the old trace
-            bkey = (key.sig, len(reqs))
-            batched = _BATCHES.get(bkey)
-            cached = batched is not None
-            if cached:
-                # warm path: same compiled vmapped program, new requests
-                batched.rebind([r.param for r in reqs],
-                               [r.sid for r in reqs])
-            else:
-                c0 = time.perf_counter()
-                batched = BatchedSolver(
-                    template, [r.param for r in reqs],
-                    [r.sid for r in reqs], family=family)
-                # jax.jit is lazy — and on this jax the AOT
-                # lower().compile() path does NOT populate the jit
-                # dispatch cache — so warm by CALLING the batched chunk
-                # once and discarding the result (the loop is
-                # functional; one throwaway chunk of device work is
-                # noise next to the compile it keeps out of the serving
-                # rate bench_trend gates). Scalar-readback fence, the
-                # repo timing convention.
-                out = batched._chunk_fn(*batched.initial_state())
-                float(out[batched._lane_arity + 1])
-                build_wall += time.perf_counter() - c0
-                _BATCHES[bkey] = batched
+            pool = (min(self.lanes, len(reqs)) if self.lanes > 0
+                    else len(reqs))
+            continuous = pool < len(reqs)
+            batched, bcached, bwall = self._batch_for(
+                key, reqs[:pool], mode, template, family,
+                continuous=continuous)
+            build_wall += bwall
+            cached = bcached
             t0 = time.perf_counter()
-            final = batched.run(progress=progress)
+            if continuous:
+                from ..utils import dispatch as _dispatch
+
+                _dispatch.record(
+                    f"fleet_cont_{label}",
+                    f"continuous ({pool}-lane pool, {len(reqs)} "
+                    "scenarios, swap-in on finish/divergence)")
+                rows, swaps = self._serve_continuous(
+                    batched, reqs[pool:])
+                self.swap_census[label] = \
+                    self.swap_census.get(label, 0) + swaps
+            else:
+                final = batched.run(progress=progress)
+                rows, swaps = batched.results(final), 0
             run_wall = time.perf_counter() - t0
             # ...and heal AFTER: a pallas fallback during THIS batch
             # writes through to the cached template's _backend — later
@@ -327,7 +456,7 @@ class FleetScheduler:
                 ScenarioResult(sid=r["sid"], bucket=label, mode=mode,
                                family=family, t=r["t"], nt=r["nt"],
                                diverged=r["diverged"], fields=r["fields"])
-                for r in batched.results(final)
+                for r in rows
             ]
         row = {
             "bucket": label,
@@ -339,7 +468,150 @@ class FleetScheduler:
             "compile_wall_s": round(build_wall, 3),
             "run_wall_s": round(run_wall, 4),
         }
+        if mode in ("vmap", "mesh", "class") and self.lanes > 0:
+            row["swaps"] = swaps
         return row, results
+
+    def _bucket_template(self, key, reqs, mode):
+        """(template, cache_hit, build_wall) for a batched bucket —
+        the solver template for vmap/mesh, the ClassSolver for class
+        buckets (both live in the same signature-keyed cache)."""
+        if mode != "class":
+            solver, _dist_flag, wall = _template(
+                key.sig, reqs[0].param, key.family)
+            return solver, wall is None, wall
+        hit = _TEMPLATES.get(key.sig)
+        if hit is not None:
+            return hit[0], True, None
+        from .shapeclass import ClassSolver
+
+        t0 = time.perf_counter()
+        grid = key.grid
+        template = ClassSolver(reqs[0].param, ic=grid[0], jc=grid[1])
+        _TEMPLATES[key.sig] = (template, False)
+        return template, False, time.perf_counter() - t0
+
+    def _batch_for(self, key, reqs, mode, template, family,
+                   continuous: bool = False):
+        """(BatchedSolver, cache_hit, compile_wall): fetch-or-build the
+        compiled batch for this (signature, lane count, mode) — the
+        zero-retrace warm path. Continuous pools always carry te (the
+        swap-in queue's end times are unknown at compile time)."""
+        if hasattr(template, "_chunk_sm"):
+            # dist FIRST: te is baked in the shard_map chunk and the
+            # bucket is pre-split per te, so even a continuous pool
+            # runs without the carry (swap-ins share the group's te)
+            te_carry = False
+        elif continuous or mode == "class":
+            # the swap-in queue's end times are unknown at compile time
+            te_carry = True
+        else:
+            tes = {float(r.param.te) for r in reqs}
+            te_carry = (len(tes) > 1
+                        or tes != {float(template.param.te)})
+        mesh = list(jax.devices()) if mode == "mesh" else None
+        bkey = (key.sig, len(reqs), mode, te_carry)
+        batched = _BATCHES.get(bkey)
+        if batched is not None:
+            batched.rebind([r.param for r in reqs],
+                           [r.sid for r in reqs])
+            return batched, True, 0.0
+        c0 = time.perf_counter()
+        batched = BatchedSolver(
+            template, [r.param for r in reqs], [r.sid for r in reqs],
+            family=family, te_carry=te_carry, mesh=mesh)
+        # jax.jit is lazy — and on this jax the AOT lower().compile()
+        # path does NOT populate the jit dispatch cache — so warm by
+        # CALLING the batched chunk once and discarding the result (the
+        # loop is functional; one throwaway chunk of device work is
+        # noise next to the compile it keeps out of the serving rate
+        # bench_trend gates). Scalar-readback fence, the repo timing
+        # convention.
+        out = batched._chunk_fn(*batched.initial_state())
+        float(out[batched._active_index + 1])
+        _BATCHES[bkey] = batched
+        label = key.label
+        self.compile_census[label] = self.compile_census.get(label, 0) + 1
+        return batched, False, time.perf_counter() - c0
+
+    def _serve_continuous(self, batched, pending, feed=None):
+        """CONTINUOUS BATCHING: drive the compiled pool chunk-by-chunk,
+        harvesting each lane the moment it finishes (its own te) or
+        diverges (retired by the in-band sentinel / finiteness mask) and
+        swapping a queued scenario into the freed slot — zero retrace,
+        the batch never drains to serve an arrival. `feed()`, when
+        given, is polled at every chunk boundary for newly-arrived
+        same-bucket requests (the daemon's mid-run swap-in plane).
+        Returns (results in completion order, swap count).
+
+        Fault handling: transient UNAVAILABLE device faults get the
+        same-chunk retry the drive_chunks protocol gives every other
+        mode (inputs unchanged — the loop is functional; budget 1,
+        refilled after 8 clean chunks). The pallas->jnp fallback is NOT
+        armed here — the continuous paths are jnp/class programs today;
+        a genuine kernel fault propagates loudly."""
+        import numpy as np
+
+        from ..models._driver import _is_transient_device_fault
+
+        from .batch import FleetRecorder
+
+        pending = list(pending)
+        rec = (FleetRecorder(batched.family, batched.sids)
+               if batched._metrics else None)
+        state = batched.initial_state()
+        harvested = [False] * batched.n
+        out = []
+        swaps = 0
+        transient_budget = 1
+        clean = 0
+        while True:
+            # fill freed slots first: a lane harvested last boundary (or
+            # freed while the queue was empty) takes the next arrival
+            for lane in range(batched.n):
+                if harvested[lane] and pending:
+                    req = pending.pop(0)
+                    state = batched.swap_lane(
+                        state, lane, req.param, req.sid)
+                    if rec is not None:
+                        rec.rearm(lane, req.sid)
+                    harvested[lane] = False
+                    swaps += 1
+            if all(harvested) and not pending:
+                extra = feed() if feed is not None else []
+                if not extra:
+                    break
+                pending.extend(extra)
+                continue
+            try:
+                state = batched._chunk_fn(*state)
+                clean += 1
+                if clean >= 8:
+                    transient_budget = 1
+            except Exception as exc:  # lint: allow(broad-except) — the transient-retry funnel, same classification as drive_chunks
+                if not _is_transient_device_fault(exc) \
+                        or transient_budget <= 0:
+                    raise
+                transient_budget -= 1
+                clean = 0
+                _tm.emit("retry", fault="transient",
+                         budget_left=transient_budget,
+                         where="fleet.continuous")
+                continue  # state unchanged — re-dispatch the chunk
+            if rec is not None:
+                rec.update(batched, state)
+            if feed is not None:
+                pending.extend(feed())
+            done = batched.lane_done(state)
+            for lane in np.nonzero(done)[0]:
+                lane = int(lane)
+                if harvested[lane]:
+                    continue
+                res = batched.harvest(state, lane)
+                res["served_ts"] = time.time()
+                out.append(res)
+                harvested[lane] = True
+        return out, swaps
 
     def elastic_restore(self, path: str, param, family: str = "ns2d",
                         devices=None):

@@ -1,0 +1,345 @@
+"""Persistent fleet serving daemon: a long-lived process over
+FleetScheduler with a file-queue request plane (ROADMAP item 2's
+"persistent front" — tools/serve.py is the CLI).
+
+Request plane (filesystem — works everywhere the repo does, survives
+restarts, and needs no socket policy): tenants drop `.par` files into
+the watched QUEUE directory; the daemon polls it, ADMITS requests
+(global queue cap + per-tenant quota — over-quota files stay in place
+and retry next poll), moves accepted files to `accepted/`, PARKS
+malformed or fleet-ineligible files to `parked/` with a structured
+`warning` telemetry record (one tenant's bad config must never kill the
+daemon — the hardened `queue.load_queue(on_error=)` path), and serves
+the accepted set through the scheduler: shape-class batching coalesces
+mixed grids into shared compiles, the continuous lane pool swaps queued
+scenarios into finished/diverged lanes, and the warm template/batch
+caches (+ utils/xlacache across restarts) make zero-retrace the common
+case.
+
+Naming convention: `<tenant>__<scenario>.par` attributes the request to
+a tenant for quota accounting and the per-tenant status table; files
+without the `__` separator belong to tenant "default".
+
+Status endpoint: a JSON file rewritten atomically at every poll and
+after every bucket — uptime, served/parked/deferred counts, queue
+depth (+max), per-tenant table, per-class compile counts, swap count,
+latency percentiles, scenarios/s — the live view a load-test watches.
+Shutdown: a `STOP` file in the queue directory (or `max_polls` for
+smokes/CI); the daemon finishes the in-flight poll, writes the final
+status and telemetry (`serving` stop record + the
+fleet_p50_latency_ms / fleet_queue_depth_max metric records the
+bench_trend gate consumes), and exits 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+from ..utils import telemetry as _tm
+from . import queue as _q
+from .scheduler import FleetScheduler
+
+STOP_FILE = "STOP"
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Daemon knobs (tools/serve.py maps CLI flags onto these)."""
+
+    queue_dir: str
+    status_path: str = ""       # default <queue_dir>/status.json
+    results_dir: str = ""       # default <queue_dir>/results
+    poll_s: float = 0.5         # queue-scan cadence
+    max_lanes: int = 4          # continuous-batch pool size per bucket
+    max_queue: int = 64         # admission: max accepted-and-unserved
+    tenant_quota: int = 8       # admission: per-tenant pending cap
+    classes: str = "on"         # shape-class batching (the serving
+    #                             default; "off" = exact-shape buckets)
+    max_polls: int = 0          # 0 = run until the STOP file appears
+
+
+def tenant_of(sid: str) -> str:
+    return sid.split("__", 1)[0] if "__" in sid else "default"
+
+
+def _percentile(values, q: float):
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+    return round(vs[idx], 3)
+
+
+class FleetDaemon:
+    """One serving session: poll -> admit -> serve -> publish status."""
+
+    def __init__(self, config: ServeConfig, base=None):
+        cfg = config
+        self.cfg = cfg
+        self.base = base
+        self.status_path = cfg.status_path or os.path.join(
+            cfg.queue_dir, "status.json")
+        self.results_dir = cfg.results_dir or os.path.join(
+            cfg.queue_dir, "results")
+        self.parked_dir = os.path.join(cfg.queue_dir, "parked")
+        self.accepted_dir = os.path.join(cfg.queue_dir, "accepted")
+        for d in (cfg.queue_dir, self.results_dir, self.parked_dir,
+                  self.accepted_dir):
+            os.makedirs(d, exist_ok=True)
+        self.sched = FleetScheduler(classes=cfg.classes,
+                                    lanes=cfg.max_lanes, isolate=True)
+        self.t0 = time.time()
+        self.polls = 0
+        self.served = 0
+        self.diverged = 0
+        self.failed = 0
+        self.parked = 0
+        self.deferred = 0
+        self.swaps = 0
+        self.queue_depth = 0
+        self.queue_depth_max = 0
+        self.latencies_ms: list[float] = []
+        self.per_tenant: dict[str, dict] = {}
+        self.scenarios_per_s = None
+        self._accept_ts: dict[str, float] = {}
+        self._pending_by_tenant: dict[str, int] = {}
+        _tm.emit("serving", event="start", queue_dir=cfg.queue_dir,
+                 max_lanes=cfg.max_lanes, max_queue=cfg.max_queue,
+                 tenant_quota=cfg.tenant_quota, classes=cfg.classes)
+        self.write_status()
+
+    # -- intake ---------------------------------------------------------
+    def _park(self, path: str, exc) -> None:
+        """The hardened malformed-.par path: move the file aside and
+        record a structured warning — the daemon outlives the tenant's
+        typo (fleet/queue.load_queue on_error contract)."""
+        dest = os.path.join(self.parked_dir, os.path.basename(path))
+        try:
+            os.replace(path, dest)
+        except OSError:
+            dest = None
+        self.parked += 1
+        _tm.emit("warning", component="fleet.serve", reason="parked",
+                 path=path, parked_to=dest, error=str(exc))
+        _tm.emit("admission", action="park", path=path,
+                 tenant=tenant_of(os.path.splitext(
+                     os.path.basename(path))[0]),
+                 error=str(exc))
+
+    def scan(self) -> list:
+        """One admission pass over the queue directory. Returns the
+        newly accepted requests; over-quota/over-cap files are left in
+        place (deferred — they retry next poll), malformed files are
+        parked."""
+        files = sorted(
+            os.path.join(self.cfg.queue_dir, f)
+            for f in os.listdir(self.cfg.queue_dir)
+            if f.endswith(".par")
+            and os.path.isfile(os.path.join(self.cfg.queue_dir, f)))
+        self.queue_depth = len(files)
+        self.queue_depth_max = max(self.queue_depth_max,
+                                   self.queue_depth)
+        accepted: list[_q.ScenarioRequest] = []
+        deferred_now = 0
+        for path in files:
+            sid = os.path.splitext(os.path.basename(path))[0]
+            tenant = tenant_of(sid)
+            # _pending_by_tenant already counts this scan's accepts
+            # (incremented on each accept below)
+            if sum(self._pending_by_tenant.values()) \
+                    >= self.cfg.max_queue:
+                deferred_now += 1
+                _tm.emit("admission", action="defer", sid=sid,
+                         tenant=tenant, reason="queue_cap",
+                         queue_depth=self.queue_depth)
+                continue
+            if self._pending_by_tenant.get(tenant, 0) \
+                    >= self.cfg.tenant_quota:
+                deferred_now += 1
+                _tm.emit("admission", action="defer", sid=sid,
+                         tenant=tenant, reason="tenant_quota")
+                continue
+            reqs = _q.load_queue([path], self.base,
+                                 on_error=self._park)
+            if not reqs:
+                continue  # parked
+            req = reqs[0]
+            req = _q.ScenarioRequest(sid=sid, param=req.param)
+            os.replace(path, os.path.join(self.accepted_dir,
+                                          os.path.basename(path)))
+            self._accept_ts[sid] = time.time()
+            self._pending_by_tenant[tenant] = \
+                self._pending_by_tenant.get(tenant, 0) + 1
+            accepted.append(req)
+            _tm.emit("admission", action="accept", sid=sid,
+                     tenant=tenant, queue_depth=self.queue_depth)
+        self.deferred += deferred_now
+        return accepted
+
+    # -- serving --------------------------------------------------------
+    def serve(self, requests) -> None:
+        for req in requests:
+            self.sched.submit(req)
+        t0 = time.perf_counter()
+        try:
+            result = self.sched.run()
+        except Exception as exc:  # lint: allow(broad-except) — serving isolation: one tenant's bad knob combo (e.g. a forced-mesh bucket with indivisible lanes) must degrade to failed requests, never kill the daemon serving every other tenant
+            self._fail_batch(requests, exc)
+            return
+        wall = time.perf_counter() - t0
+        now = time.time()
+        for sc in result.scenarios:
+            tenant = tenant_of(sc.sid)
+            self._pending_by_tenant[tenant] = max(
+                0, self._pending_by_tenant.get(tenant, 0) - 1)
+            t_acc = self._accept_ts.pop(sc.sid, None)
+            if getattr(sc, "failed", False):
+                # per-bucket isolation (scheduler isolate mode): the
+                # bucket could not be scheduled — a failed result, a
+                # failure file, and the daemon keeps serving
+                self.failed += 1
+                _tm.emit("admission", action="fail", sid=sc.sid,
+                         tenant=tenant, error=sc.error)
+                with open(os.path.join(self.results_dir,
+                                       f"{sc.sid}.json"), "w") as fh:
+                    json.dump({"sid": sc.sid, "tenant": tenant,
+                               "failed": True, "error": sc.error}, fh)
+                continue
+            latency_ms = (round((now - t_acc) * 1e3, 3)
+                          if t_acc is not None else None)
+            if latency_ms is not None:
+                self.latencies_ms.append(latency_ms)
+                _tm.emit("latency", scenario=sc.sid, tenant=tenant,
+                         ms=latency_ms, bucket=sc.bucket, mode=sc.mode)
+            row = self.per_tenant.setdefault(
+                tenant, {"served": 0, "diverged": 0})
+            row["served"] += 1
+            self.served += 1
+            if sc.diverged:
+                row["diverged"] += 1
+                self.diverged += 1
+            with open(os.path.join(self.results_dir,
+                                   f"{sc.sid}.json"), "w") as fh:
+                json.dump({"sid": sc.sid, "tenant": tenant,
+                           "bucket": sc.bucket, "mode": sc.mode,
+                           "t": sc.t, "nt": sc.nt,
+                           "diverged": sc.diverged,
+                           "latency_ms": latency_ms}, fh)
+        self.swaps = sum(self.sched.swap_census.values())
+        self.scenarios_per_s = (round(len(result.scenarios) / wall, 4)
+                                if wall > 0 else None)
+
+    def _fail_batch(self, requests, exc) -> None:
+        """Scheduling failed for this poll's accepted set: release the
+        pending accounting, write per-scenario error results, and keep
+        serving — the structured-degradation twin of `_park` for
+        requests that parsed fine but could not be scheduled."""
+        self.failed += len(requests)
+        _tm.emit("warning", component="fleet.serve",
+                 reason="schedule_failed", error=str(exc),
+                 scenarios=[r.sid for r in requests])
+        for req in requests:
+            tenant = tenant_of(req.sid)
+            self._pending_by_tenant[tenant] = max(
+                0, self._pending_by_tenant.get(tenant, 0) - 1)
+            self._accept_ts.pop(req.sid, None)
+            _tm.emit("admission", action="fail", sid=req.sid,
+                     tenant=tenant, error=str(exc))
+            with open(os.path.join(self.results_dir,
+                                   f"{req.sid}.json"), "w") as fh:
+                json.dump({"sid": req.sid, "tenant": tenant,
+                           "failed": True, "error": str(exc)}, fh)
+
+    # -- status endpoint ------------------------------------------------
+    def status(self) -> dict:
+        return {
+            "uptime_s": round(time.time() - self.t0, 3),
+            "polls": self.polls,
+            "served": self.served,
+            "diverged": self.diverged,
+            "failed": self.failed,
+            "parked": self.parked,
+            "deferred": self.deferred,
+            "swaps": self.swaps,
+            "queue_depth": self.queue_depth,
+            "queue_depth_max": self.queue_depth_max,
+            "active_lanes": self.cfg.max_lanes,
+            "per_tenant": self.per_tenant,
+            "classes": dict(self.sched.compile_census),
+            "latency_ms": {
+                "p50": _percentile(self.latencies_ms, 0.5),
+                "p95": _percentile(self.latencies_ms, 0.95),
+                "max": (round(max(self.latencies_ms), 3)
+                        if self.latencies_ms else None),
+            },
+            "scenarios_per_s": self.scenarios_per_s,
+            "updated": round(time.time(), 3),
+        }
+
+    def write_status(self) -> dict:
+        st = self.status()
+        tmp = self.status_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(st, fh, indent=1)
+        os.replace(tmp, self.status_path)  # atomic: readers never tear
+        return st
+
+    # -- the daemon loop ------------------------------------------------
+    def should_stop(self) -> bool:
+        return os.path.exists(os.path.join(self.cfg.queue_dir,
+                                           STOP_FILE))
+
+    def poll_once(self) -> dict:
+        self.polls += 1
+        accepted = self.scan()
+        if accepted:
+            self.serve(accepted)
+        st = self.write_status()
+        _tm.emit("serving", event="poll", poll=self.polls,
+                 accepted=len(accepted), served=self.served,
+                 queue_depth=self.queue_depth)
+        return st
+
+    def stop(self) -> dict:
+        """Final status + the trend-gated serving metrics."""
+        st = self.write_status()
+        p50 = st["latency_ms"]["p50"]
+        import jax
+
+        backend = jax.default_backend()
+        if p50 is not None:
+            _tm.emit("metric", metric="fleet_p50_latency_ms", value=p50,
+                     unit="ms", backend=backend)
+        _tm.emit("metric", metric="fleet_queue_depth_max",
+                 value=self.queue_depth_max, unit="requests",
+                 backend=backend)
+        _tm.emit("serving", event="stop",
+                 # the daemon's own percentiles ride the stop record so
+                 # the merged serving_summary reports the SAME numbers
+                 # as the status endpoint (one percentile definition)
+                 p50_latency_ms=p50,
+                 max_latency_ms=st["latency_ms"]["max"],
+                 **{k: st[k] for k in (
+                     "polls", "served", "diverged", "failed", "parked",
+                     "deferred", "swaps", "queue_depth_max",
+                     "scenarios_per_s")})
+        return st
+
+    def run(self) -> int:
+        """Serve until the STOP file appears (or max_polls). Returns 0
+        on a clean shutdown."""
+        try:
+            while True:
+                if self.should_stop():
+                    break
+                self.poll_once()
+                if (self.cfg.max_polls
+                        and self.polls >= self.cfg.max_polls):
+                    break
+                time.sleep(self.cfg.poll_s)
+        finally:
+            self.stop()
+        return 0

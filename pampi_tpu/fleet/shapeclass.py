@@ -1,0 +1,436 @@
+"""Shape-class batching: pad-and-mask mixed-GRID requests into shared
+compiles (ROADMAP item 2's serving rung).
+
+A fleet serving thousands of slightly-different grids must not compile
+thousands of programs. This module defines a small ladder of SHAPE
+CLASSES — power-of-two rungs per axis with a floor — and one traced
+chunk per class whose grid EXTENTS are per-lane data, not trace
+constants: a 20x24 request and a 28x17 request both ride the 32x32
+class program, each lane carrying its own (imax, jmax, dx, dy, ...) as
+traced scalars.
+
+The chunk is the ragged machinery promoted to the serving layer: the
+dist solvers already express every wall write as a select by GLOBAL
+index (parallel/ragged2d.py — proven against the solo solver at the ulp
+contract), and those selects work unchanged when jmax/imax are traced
+per-lane scalars on ONE full padded block (grids= hooks, offset 0, no
+shard_map). Dead pad cells hold exact 0.0 and are kept out of every
+reduction by live/interior masks built from the same global-index
+comparisons (`live_masks` semantics), so pad garbage never reaches the
+CFL scan, the residual sum, or the pressure mean — and a padded lane
+tracks its unpadded solo twin to reduction order (bitwise coefficients:
+every grid-derived constant the solo solver folds in Python f64 — dx,
+dy, dt_bound, the SOR factor, idx2/idy2, the residual norm — is
+computed host-side per lane with the identical expressions and carried
+in the lane's geometry vector).
+
+Class eligibility is conservative (the exact-shape bucket is always the
+fallback, recorded per bucket): 2-D, no obstacle flags, the reference
+"sor" solve, a single-device lane, grids at least MIN_CLASS_EXTENT per
+axis. `palcheck.shapeclass_violations` bounds the padding waste per
+class: above the eligibility floor the padded extent stays under 2x the
+live extent per axis, so a class never burns more than WASTE_BOUND
+(4x) the live cells.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+# the rung ladder: per-axis class extent = next power of two, floored —
+# a floor keeps the tiny end of the ladder from fragmenting into many
+# near-empty compiles (a 9x12 and a 14x10 request share the 16x16 rung)
+RUNG_FLOOR = 16
+# smallest per-axis live extent the class path accepts; below it the
+# pad ratio can exceed the waste bound, so such requests keep their
+# exact-shape bucket (recorded)
+MIN_CLASS_EXTENT = 8
+# padding-waste contract, checked by analysis/palcheck: padded cells /
+# live cells (ghost-inclusive) stays strictly under this per class for
+# every eligible grid
+WASTE_BOUND = 4.0
+
+# geometry-vector slots (per lane, time-dtype precision): every
+# grid-derived scalar the solo solver folds as a Python-float constant,
+# computed host-side with the IDENTICAL expressions (bitwise at f64)
+G_IMAX, G_JMAX, G_DX, G_DY, G_DTB, G_FACTOR, G_IDX2, G_IDY2, G_NORM = \
+    range(9)
+GEOM_LEN = 9
+
+# class-signature exclusions ON TOP of the queue's lane/housekeeping
+# sets: the grid extents become per-lane data (xlength/ylength stay in
+# the signature — the canal inflow profile bakes ylength as a value)
+CLASS_KEYS = ("imax", "jmax")
+
+
+def class_extent(n: int) -> int:
+    """The rung of one live extent: next power of two, >= RUNG_FLOOR."""
+    c = RUNG_FLOOR
+    while c < n:
+        c *= 2
+    return c
+
+
+def class_grid(grid) -> tuple:
+    return tuple(class_extent(int(n)) for n in grid)
+
+
+def padding_waste(grid) -> float:
+    """Padded cells / live cells, ghost rings included — the per-class
+    waste the palcheck contract bounds."""
+    cls = class_grid(grid)
+    padded = 1.0
+    live = 1.0
+    for n, c in zip(grid, cls):
+        padded *= c + 2
+        live *= n + 2
+    return padded / live
+
+
+def class_eligible(param) -> str | None:
+    """None when the request may ride a shape class; else the reason it
+    keeps its exact-shape bucket (recorded per bucket)."""
+    from ..cli import mesh_is_single
+    from ..utils.params import is_3d_config
+
+    if is_3d_config(param):
+        return "3-D family (shape classes are 2-D; exact bucket)"
+    if param.obstacles.strip():
+        return "obstacle flags are trace-baked geometry"
+    if param.tpu_solver != "sor":
+        return f"tpu_solver {param.tpu_solver} (class solve is rb-sor)"
+    if param.tpu_flat_solve:
+        return "tpu_flat_solve trips are extent-derived"
+    if not mesh_is_single(param):
+        return "distributed lane (whole-mesh shards are shape-baked)"
+    if param.tpu_fleet not in ("auto", "vmap"):
+        return f"tpu_fleet {param.tpu_fleet} forced"
+    if param.imax < MIN_CLASS_EXTENT or param.jmax < MIN_CLASS_EXTENT:
+        return (f"grid {param.imax}x{param.jmax} below the "
+                f"{MIN_CLASS_EXTENT}-cell class floor (padding waste "
+                "would exceed the bound)")
+    return None
+
+
+def class_signature(param) -> str:
+    """The shape-class knob signature: the queue's trace-shaping
+    signature minus the per-lane grid extents."""
+    from . import queue as _q
+
+    skip = set(_q.LANE_KEYS) | set(_q.HOUSEKEEPING_KEYS) \
+        | set(_q.PER_LANE_KEYS) | set(CLASS_KEYS)
+    parts = []
+    for f in dataclasses.fields(type(param)):
+        if f.name in skip:
+            continue
+        parts.append(f"{f.name}={getattr(param, f.name)!r}")
+    return "|".join(parts)
+
+
+def class_sig_hash(param) -> str:
+    return "cls" + hashlib.sha1(
+        class_signature(param).encode()).hexdigest()[:12]
+
+
+def lane_geometry(param):
+    """The per-lane geometry scalars, each computed in Python f64 exactly
+    as the solo solver folds them (NS2DSolver.__init__ /
+    models/poisson.make_rb_step) — the bitwise-coefficient contract."""
+    dx = param.xlength / param.imax
+    dy = param.ylength / param.jmax
+    inv_sqr_sum = 1.0 / (dx * dx) + 1.0 / (dy * dy)
+    dt_bound = 0.5 * param.re / inv_sqr_sum
+    dx2, dy2 = dx * dx, dy * dy
+    factor = param.omg * 0.5 * (dx2 * dy2) / (dx2 + dy2)
+    idx2, idy2 = 1.0 / dx2, 1.0 / dy2
+    norm = float(param.imax * param.jmax)
+    return (float(param.imax), float(param.jmax), dx, dy, dt_bound,
+            factor, idx2, idy2, norm)
+
+
+def _index_grids(jc: int, ic: int):
+    import jax.numpy as jnp
+
+    gj = jnp.arange(jc + 2, dtype=jnp.int32)[:, None]
+    gi = jnp.arange(ic + 2, dtype=jnp.int32)[None, :]
+    return gj, gi
+
+
+def make_class_solve(param, jc: int, ic: int, dtype, grids):
+    """The masked red-black SOR convergence loop at TRACED extents —
+    models/poisson.make_solver_fn's jnp rb path (red half-sweep, black
+    half-sweep seeing red's updates, Neumann ghost copy, normalized
+    residual vs eps^2) with every position select-by-global-index and
+    every reduction confined to the dynamic interior (dead cells
+    contribute exact zeros)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    gj, gi = grids
+    epssq = param.eps * param.eps
+    itermax = param.itermax
+    res_dtype = jnp.promote_types(dtype, jnp.float32)
+
+    def solve(p0, rhs, imax, jmax, factor, idx2, idy2, norm):
+        factor = factor.astype(dtype)
+        idx2 = idx2.astype(dtype)
+        idy2 = idy2.astype(dtype)
+        norm = norm.astype(dtype)
+        interior = ((gj >= 1) & (gj <= jmax) & (gi >= 1) & (gi <= imax))
+        parity = (gi + gj) % 2
+        red = (interior & (parity == 0)).astype(dtype)
+        black = (interior & (parity == 1)).astype(dtype)
+        tan_j = (gj >= 1) & (gj <= jmax)
+        tan_i = (gi >= 1) & (gi <= imax)
+        m_s = (gj == 0) & tan_i
+        m_n = (gj == jmax + 1) & tan_i
+        m_w = (gi == 0) & tan_j
+        m_e = (gi == imax + 1) & tan_j
+
+        def sweep(p, mask):
+            # ops/sor.sor_pass arithmetic on the full block: the masked
+            # r is exact 0 off its colour, so the update adds -0.0
+            # (identity) everywhere the solo .at[].add never touched
+            lap = (
+                (jnp.roll(p, -1, axis=1) - 2.0 * p
+                 + jnp.roll(p, 1, axis=1)) * idx2
+                + (jnp.roll(p, -1, axis=0) - 2.0 * p
+                   + jnp.roll(p, 1, axis=0)) * idy2
+            )
+            r = (rhs - lap) * mask
+            return p + (-factor) * r, jnp.sum(r * r)
+
+        def neumann(p):
+            # ops/sor.neumann_bc as selects: same write order, corners
+            # untouched (the masks exclude them)
+            p = jnp.where(m_s, jnp.roll(p, -1, axis=0), p)
+            p = jnp.where(m_n, jnp.roll(p, 1, axis=0), p)
+            p = jnp.where(m_w, jnp.roll(p, -1, axis=1), p)
+            p = jnp.where(m_e, jnp.roll(p, 1, axis=1), p)
+            return p
+
+        def cond(carry):
+            _, res, it = carry
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(carry):
+            p, _, it = carry
+            p, r0 = sweep(p, red)
+            p, r1 = sweep(p, black)
+            p = neumann(p)
+            res = ((r0 + r1) / norm).astype(res_dtype)
+            return p, res, it + 1
+
+        return lax.while_loop(
+            cond, body,
+            (p0, jnp.asarray(1.0, res_dtype), jnp.asarray(0, jnp.int32)))
+
+    return solve
+
+
+def make_class_chunk(param, jc: int, ic: int, dtype,
+                     metrics: bool = False, chunk_default: int = 64):
+    """One shape class's chunk program: models/ns2d._build_step's phase
+    order with grid extents as per-lane traced scalars. Lane state is
+    (u, v, p, t, nt, gm[, m]) plus the carried te (the fleet's per-lane
+    te convention — te is always the trailing argument)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import ns2d as ops
+    from ..parallel import ragged2d as rg
+    from ..utils import telemetry as _tm
+
+    grids = _index_grids(jc, ic)
+    gj, gi = grids
+    adaptive = param.tau > 0.0
+    chunk = param.tpu_chunk or chunk_default
+    time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    solve = make_class_solve(param, jc, ic, dtype, grids)
+
+    def step(u, v, p, t, nt, gm):
+        imax, jmax = gm[G_IMAX], gm[G_JMAX]  # whole-number scalars
+        dx = gm[G_DX].astype(dtype)
+        dy = gm[G_DY].astype(dtype)
+        dtb = gm[G_DTB].astype(dtype)
+        interior = ((gj >= 1) & (gj <= jmax) & (gi >= 1) & (gi <= imax))
+        live = (gj <= jmax + 1) & (gi <= imax + 1)
+        if adaptive:
+            # ghost-inclusive maxElement scan: dead cells are exact 0,
+            # so the padded max IS the live max
+            dt = ops.cfl_dt(ops.max_element(u), ops.max_element(v),
+                            dtb, dx, dy, param.tau)
+        else:
+            dt = jnp.asarray(param.dt, dtype)
+        u, v = rg.set_bcs_ragged(u, v, param, None, jc, ic, jmax, imax,
+                                 grids=grids)
+        u = rg.set_special_bc_ragged(u, param, None, jc, ic, jmax, imax,
+                                     dy, dtype, grids=grids)
+        f, g = ops.compute_fg_interior(u, v, dt, param.re, param.gx,
+                                       param.gy, param.gamma, dx, dy)
+        f, g = rg.fg_fixups_ragged(f, g, u, v, None, jc, ic, jmax, imax,
+                                   grids=grids)
+        rhs = jnp.where(interior, ops.rhs_terms(f, g, dt, dx, dy),
+                        jnp.zeros_like(f))
+
+        def norm_p(q):
+            # normalizePressure over the live array only: the dynamic
+            # count replaces the static size, dead cells stay 0
+            cnt = ((jmax + 2.0) * (imax + 2.0)).astype(dtype)
+            mean = jnp.sum(jnp.where(live, q, jnp.zeros_like(q))) / cnt
+            return jnp.where(live, q - mean, q)
+
+        p = lax.cond(nt % 100 == 0, norm_p, lambda q: q, p)
+        p, res, it = solve(p, rhs, imax, jmax, gm[G_FACTOR],
+                           gm[G_IDX2], gm[G_IDY2], gm[G_NORM])
+        u_new, v_new = ops.adapt_terms(f, g, p, dt, dx, dy)
+        u = jnp.where(interior, u_new, u)
+        v = jnp.where(interior, v_new, v)
+        # the ragged POST convention: multiply-mask so pad cells stay
+        # exact 0 for the next step's scans (identity on live cells)
+        lm = live.astype(dtype)
+        u = u * lm
+        v = v * lm
+        t_next = t + dt.astype(time_dtype)
+        return u, v, p, t_next, nt + 1, res, it, dt
+
+    def chunk_fn(u, v, p, t, nt, gm, te):
+        def cond(c):
+            return jnp.logical_and(c[3] <= te, c[6] < chunk)
+
+        def body(c):
+            u, v, p, t, nt, gm, k = c
+            u, v, p, t, nt, _res, _it, _dt = step(u, v, p, t, nt, gm)
+            return u, v, p, t, nt, gm, k + 1
+
+        u, v, p, t, nt, gm, _k = lax.while_loop(
+            cond, body, (u, v, p, t, nt, gm, jnp.asarray(0, jnp.int32)))
+        return u, v, p, t, nt, gm
+
+    def chunk_fn_metrics(u, v, p, t, nt, gm, m, te):
+        def cond(c):
+            return jnp.logical_and(c[3] <= te, c[6] < chunk)
+
+        def body(c):
+            u, v, p, t, nt, gm, k, res, it, dtv, um, vm, bad = c
+            u, v, p, t, nt, res, it, dtv = step(u, v, p, t, nt, gm)
+            res, it, dtv, um, vm, bad = _tm.metrics_step(
+                bad, nt, res, it, dtv,
+                ops.max_element(u), ops.max_element(v))
+            return u, v, p, t, nt, gm, k + 1, res, it, dtv, um, vm, bad
+
+        (u, v, p, t, nt, gm, _k,
+         res, it, dtv, um, vm, bad) = lax.while_loop(
+            cond, body,
+            (u, v, p, t, nt, gm, jnp.asarray(0, jnp.int32),
+             m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+             m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_BAD]))
+        return u, v, p, t, nt, gm, _tm.metrics_pack(
+            res, it, dtv, um, vm, 0.0, bad)
+
+    return chunk_fn_metrics if metrics else chunk_fn
+
+
+class ClassSolver:
+    """The template of one shape class: a BatchedSolver-compatible
+    template whose chunk takes grid extents as per-lane data. Built from
+    a representative request; every same-class-signature request of any
+    eligible grid rides this one compile (`fleet/batch.BatchedSolver`
+    with te always carried)."""
+
+    CHUNK = 64
+    # the class chunk takes te unconditionally (its carry is inherently
+    # per-lane) — BatchedSolver reads this and always carries te
+    _te_always = True
+
+    def __init__(self, param, ic: int, jc: int, dtype=None):
+        import time as _time
+
+        import jax
+
+        from ..utils import telemetry as _tm
+        from ..utils.precision import resolve_dtype
+
+        reason = class_eligible(param)
+        if reason is not None:
+            raise ValueError(f"request is not class-eligible: {reason}")
+        if class_extent(param.imax) > ic or class_extent(param.jmax) > jc:
+            raise ValueError(
+                f"grid {param.imax}x{param.jmax} exceeds class "
+                f"{ic}x{jc}")
+        self.param = param.replace(imax=ic, jmax=jc)
+        self._request = param
+        self.ic, self.jc = ic, jc
+        self.dtype = resolve_dtype(param.tpu_dtype) if dtype is None \
+            else dtype
+        self._backend = "jnp"  # the class chunk is the masked jnp chain
+        self._dt_scale = 1.0
+        self._metrics = _tm.enabled()
+        self._time_index = 3
+        self._n_fields = 3
+        t0 = _time.perf_counter()
+        self._chunk_fn = jax.jit(self._build_chunk())
+        _tm.emit("build", family="ns2d_class",
+                 grid=[jc, ic], cls=f"{ic}x{jc}",
+                 trace_wall_s=round(_time.perf_counter() - t0, 3))
+
+    def _uses_pallas(self) -> bool:
+        return False
+
+    def _build_chunk(self, backend: str | None = None,
+                     te_arg: bool = True):
+        # backend is accepted for the retry-protocol surface; the class
+        # chunk has exactly one (jnp) program. te is ALWAYS the trailing
+        # traced argument — the class carry is inherently per-lane.
+        self._metrics = _metrics_enabled()
+        return make_class_chunk(self.param, self.jc, self.ic, self.dtype,
+                                metrics=self._metrics,
+                                chunk_default=self.CHUNK)
+
+    # -- per-lane state (the BatchedSolver template hooks) --------------
+    def lane_state(self, param) -> tuple:
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils import telemetry as _tm
+
+        reason = class_eligible(param)
+        if reason is not None:
+            raise ValueError(f"request is not class-eligible: {reason}")
+        jc, ic = self.jc, self.ic
+        live = ((np.arange(jc + 2)[:, None] <= param.jmax + 1)
+                & (np.arange(ic + 2)[None, :] <= param.imax + 1))
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 \
+            else jnp.float32
+
+        def field(init):
+            return jnp.asarray(
+                np.where(live, init, 0.0), self.dtype)
+
+        gm = jnp.asarray(lane_geometry(param), time_dtype)
+        out = (field(param.u_init), field(param.v_init),
+               field(param.p_init),
+               jnp.asarray(0.0, time_dtype), jnp.asarray(0, jnp.int32),
+               gm)
+        if self._metrics:
+            out = out + (_tm.metrics_init(),)
+        return out
+
+    def crop_lane(self, fields, param) -> tuple:
+        """Unpad one lane's published fields back to the request's own
+        (jmax+2, imax+2) reference layout."""
+        return tuple(np.asarray(f)[:param.jmax + 2, :param.imax + 2]
+                     for f in fields)
+
+    def initial_state(self) -> tuple:
+        return self.lane_state(self._request)
+
+
+def _metrics_enabled() -> bool:
+    from ..utils import telemetry as _tm
+
+    return _tm.enabled()

@@ -345,7 +345,8 @@ class NS2DSolver:
 
         return step
 
-    def _build_fused_chunk(self, backend: str, metrics: bool = False):
+    def _build_fused_chunk(self, backend: str, metrics: bool = False,
+                           te_arg: bool = False):
         """The fused-phase chunk: the non-solve step phases run as the two
         Pallas kernels of ops/ns2d_fused.py (BCs+FG+RHS before the solve,
         adaptUV+CFL-max after), the loop carries u/v in the kernels' padded
@@ -462,7 +463,7 @@ class NS2DSolver:
         adaptive = param.tau > 0.0
         dt_scale = self._dt_scale  # 1.0 = identity (recovery rebuilds clamp)
         faults = getattr(self, "_field_faults", ())
-        te = param.te
+        te_static = param.te
         chunk = param.tpu_chunk or self.CHUNK
         offs = jnp.zeros((2,), jnp.int32)
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -509,7 +510,11 @@ class NS2DSolver:
                         _res, _it, dt)
             return up, vp, p, t_next, nt + 1, umax, vmax
 
-        def chunk_fn(u, v, p, t, nt):
+        def chunk_fn(u, v, p, t, nt, *te_in):
+            # te_arg builds take the end time as a TRACED trailing arg
+            # (the fleet's per-lane te carry); the default closes over
+            # the baked constant — the byte-identical historical trace
+            te = te_in[0] if te_in else te_static
             up, vp = pad(u), pad(v)
             if folded:
                 p = pad(p)
@@ -532,9 +537,10 @@ class NS2DSolver:
             )
             return unpad(up), unpad(vp), unpad(p) if folded else p, t, nt
 
-        def chunk_fn_metrics(u, v, p, t, nt, m):
+        def chunk_fn_metrics(u, v, p, t, nt, m, *te_in):
             # the telemetry twin: same loop, the f32 metrics scalars ride
             # the carry and pack into the in-band vector at the boundary
+            te = te_in[0] if te_in else te_static
             up, vp = pad(u), pad(v)
             if folded:
                 p = pad(p)
@@ -567,7 +573,7 @@ class NS2DSolver:
 
         return chunk_fn_metrics if metrics else chunk_fn
 
-    def _build_chunk(self, backend: str = "auto"):
+    def _build_chunk(self, backend: str = "auto", te_arg: bool = False):
         # telemetry is a trace-time decision, like utils/flags.py: unset
         # means the chunk below is byte-identical to the uninstrumented
         # program (asserted by tests/test_telemetry.py). Field-fault
@@ -575,17 +581,23 @@ class NS2DSolver:
         # contract via self._field_faults — set by __init__/_rebuild_chunk,
         # NOT taken here (the pallas fallback rebuild reuses the armed
         # generation; only a recovery rebuild advances it).
+        # te_arg=True (the fleet's per-lane te carry) makes the end time a
+        # TRACED trailing argument of the chunk instead of a baked
+        # constant; the default is the byte-identical historical trace.
         metrics = _tm.enabled()
         self._metrics = metrics
-        fused = self._build_fused_chunk(backend, metrics=metrics)
+        fused = self._build_fused_chunk(backend, metrics=metrics,
+                                        te_arg=te_arg)
         self._fused = fused is not None
         if fused is not None:
             return fused
         step = self._build_step(backend, instrumented=metrics)
-        te = self.param.te
+        te_static = self.param.te
         chunk = self.param.tpu_chunk or self.CHUNK
 
-        def chunk_fn(u, v, p, t, nt):
+        def chunk_fn(u, v, p, t, nt, *te_in):
+            te = te_in[0] if te_in else te_static
+
             def cond(c):
                 _, _, _, t, _, k = c
                 return jnp.logical_and(t <= te, k < chunk)
@@ -600,10 +612,12 @@ class NS2DSolver:
             )
             return u, v, p, t, nt
 
-        def chunk_fn_metrics(u, v, p, t, nt, m):
+        def chunk_fn_metrics(u, v, p, t, nt, m, *te_in):
             # the telemetry twin of chunk_fn: the instrumented step exposes
             # the solve's discarded res/it plus dt; |u|/|v| maxima are the
             # two extra fused reductions this path did not already carry
+            te = te_in[0] if te_in else te_static
+
             def cond(c):
                 return jnp.logical_and(c[3] <= te, c[5] < chunk)
 

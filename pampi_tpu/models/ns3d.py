@@ -407,7 +407,8 @@ class NS3DSolver:
 
         return step
 
-    def _build_fused_chunk(self, backend: str, metrics: bool = False):
+    def _build_fused_chunk(self, backend: str, metrics: bool = False,
+                           te_arg: bool = False):
         """The 3-D fused-phase chunk (ops/ns3d_fused.py): the non-solve
         phases run as two Pallas kernels around the solve, the loop carries
         u/v/w in the padded layout plus the running (umax, vmax, wmax),
@@ -440,7 +441,7 @@ class NS3DSolver:
         adaptive = param.tau > 0.0
         dt_scale = self._dt_scale  # 1.0 = identity (recovery rebuilds clamp)
         faults = getattr(self, "_field_faults", ())
-        te = param.te
+        te_static = param.te
         chunk = param.tpu_chunk or self.CHUNK
         offs = jnp.zeros((3,), jnp.int32)
         dt_bound = jnp.asarray(self.dt_bound, dtype)
@@ -470,7 +471,11 @@ class NS3DSolver:
                         _res, _it, dt)
             return up, vp, wp, p, t_next, nt + 1, umax, vmax, wmax
 
-        def chunk_fn(u, v, w, p, t, nt):
+        def chunk_fn(u, v, w, p, t, nt, *te_in):
+            # te_arg builds take the end time as a TRACED trailing arg
+            # (the fleet's per-lane te carry); the default closes over
+            # the baked constant — the byte-identical historical trace
+            te = te_in[0] if te_in else te_static
             up, vp, wp = pad3(u), pad3(v), pad3(w)
             umax = jnp.max(jnp.abs(u))
             vmax = jnp.max(jnp.abs(v))
@@ -493,9 +498,10 @@ class NS3DSolver:
             )
             return unpad3(up), unpad3(vp), unpad3(wp), p, t, nt
 
-        def chunk_fn_metrics(u, v, w, p, t, nt, m):
+        def chunk_fn_metrics(u, v, w, p, t, nt, m, *te_in):
             # the telemetry twin: the carried CFL maxima and the solve's
             # res/it pack into the in-band vector at the chunk boundary
+            te = te_in[0] if te_in else te_static
             up, vp, wp = pad3(u), pad3(v), pad3(w)
             umax = jnp.max(jnp.abs(u))
             vmax = jnp.max(jnp.abs(v))
@@ -527,22 +533,27 @@ class NS3DSolver:
 
         return chunk_fn_metrics if metrics else chunk_fn
 
-    def _build_chunk(self, backend: str = "auto"):
+    def _build_chunk(self, backend: str = "auto", te_arg: bool = False):
         # trace-time telemetry gate (utils/flags.py convention): unset means
         # the chunk below is byte-identical to the uninstrumented program.
         # Field-fault injection reads self._field_faults — set by
-        # __init__/_rebuild_chunk, not taken here (see ns2d)
+        # __init__/_rebuild_chunk, not taken here (see ns2d).
+        # te_arg=True makes the end time a traced trailing argument (the
+        # fleet's per-lane te carry — see models/ns2d._build_chunk).
         metrics = _tm.enabled()
         self._metrics = metrics
-        fused = self._build_fused_chunk(backend, metrics=metrics)
+        fused = self._build_fused_chunk(backend, metrics=metrics,
+                                        te_arg=te_arg)
         self._fused = fused is not None
         if fused is not None:
             return fused
         step = self._build_step(backend, instrumented=metrics)
-        te = self.param.te
+        te_static = self.param.te
         chunk = self.param.tpu_chunk or self.CHUNK
 
-        def chunk_fn(u, v, w, p, t, nt):
+        def chunk_fn(u, v, w, p, t, nt, *te_in):
+            te = te_in[0] if te_in else te_static
+
             def cond(c):
                 return jnp.logical_and(c[4] <= te, c[6] < chunk)
 
@@ -556,7 +567,9 @@ class NS3DSolver:
             )
             return u, v, w, p, t, nt
 
-        def chunk_fn_metrics(u, v, w, p, t, nt, m):
+        def chunk_fn_metrics(u, v, w, p, t, nt, m, *te_in):
+            te = te_in[0] if te_in else te_static
+
             def cond(c):
                 return jnp.logical_and(c[4] <= te, c[6] < chunk)
 

@@ -51,9 +51,17 @@ def live_masks(comm: CartComm, jl: int, il: int, jmax: int, imax: int, dtype):
 
 
 def set_bcs_ragged(u, v, param, comm: CartComm, jl: int, il: int,
-                   jmax: int, imax: int):
-    """setBoundaryConditions (solver.c:236-337) as global-index selects."""
-    gj, gi = global_index_vectors(comm, jl, il)
+                   jmax: int, imax: int, grids=None):
+    """setBoundaryConditions (solver.c:236-337) as global-index selects.
+
+    `grids` (the (gj, gi) index grids) lets callers OUTSIDE shard_map —
+    the fleet's shape-class chunk, which runs this chain on one full
+    padded block with TRACED jmax/imax — supply precomputed vectors
+    instead of the shard-offset lookup (get_offsets reads the shard_map
+    axis index). The arithmetic is unchanged: jmax/imax appear only in
+    comparisons and value terms, so they may be ints or traced scalars."""
+    gj, gi = (global_index_vectors(comm, jl, il)
+              if grids is None else grids)
     tan_j = (gj >= 1) & (gj <= jmax)
     tan_i = (gi >= 1) & (gi <= imax)
 
@@ -123,16 +131,18 @@ def set_bcs_ragged(u, v, param, comm: CartComm, jl: int, il: int,
 
 
 def set_special_bc_ragged(u, param, comm: CartComm, jl: int, il: int,
-                          jmax: int, imax: int, dy, idx_dtype):
+                          jmax: int, imax: int, dy, idx_dtype,
+                          grids=None):
     """setSpecialBoundaryCondition (solver.c:339-357) masked by global
     index; replicates the reference's dcavity lid loop-bound quirk (skips
-    i == imax)."""
-    gj, gi = global_index_vectors(comm, jl, il)
+    i == imax). `grids` as in set_bcs_ragged (offset-0 callers)."""
+    gj, gi = (global_index_vectors(comm, jl, il)
+              if grids is None else grids)
     if param.name == "dcavity":
         m = (gj == jmax + 1) & (gi >= 1) & (gi <= imax - 1)
         return jnp.where(m, 2.0 - jnp.roll(u, 1, axis=0), u)
     if param.name in ("canal", "canal_obstacle"):
-        joff = get_offsets("j", jl)
+        joff = 0 if grids is not None else get_offsets("j", jl)
         jj = jnp.arange(jl + 2, dtype=idx_dtype) + joff
         y = ((jj - 0.5) * dy).astype(u.dtype)
         prof = (y * (param.ylength - y) * 4.0 / (param.ylength**2))[:, None]
@@ -142,10 +152,11 @@ def set_special_bc_ragged(u, param, comm: CartComm, jl: int, il: int,
 
 
 def fg_fixups_ragged(f, g, u, v, comm: CartComm, jl: int, il: int,
-                     jmax: int, imax: int):
+                     jmax: int, imax: int, grids=None):
     """F/G wall fixups (solver.c:425-435): same-position copies from u/v,
-    masked by global index."""
-    gj, gi = global_index_vectors(comm, jl, il)
+    masked by global index. `grids` as in set_bcs_ragged."""
+    gj, gi = (global_index_vectors(comm, jl, il)
+              if grids is None else grids)
     tan_j = (gj >= 1) & (gj <= jmax)
     tan_i = (gi >= 1) & (gi <= imax)
     f = jnp.where(((gi == 0) | (gi == imax)) & tan_j, u, f)

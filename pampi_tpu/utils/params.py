@@ -206,12 +206,24 @@ class Parameter:
     #           distributed buckets and 1-scenario buckets run pjit:
     #           each scenario occupies the whole mesh sequentially,
     #           reusing the bucket's one compiled program
+    #   "auto" additionally picks "mesh" (below) when a multi-device
+    #           host can split the lanes evenly
     #   "vmap"  force the batched driver (dist buckets too — vmap over
     #           the shard_map'ed chunk; the parity-test mode)
+    #   "mesh"  fleet-over-mesh (serving v2): the vmapped chunk's
+    #           scenario axis sharded across a device-mesh axis via
+    #           NamedSharding — N single-chip lanes in true parallel,
+    #           zero collectives between lanes (commcheck's
+    #           zero-resharding ban pins it); lanes must divide the
+    #           device count
     #   "pjit"  force whole-mesh-per-scenario with executable reuse
     #   "solo"  the historical path: every request builds and runs its
     #           own solver (no template reuse; the oracle mode the
     #           fleet-smoke drift check compares against)
+    # Serving v2 (fleet/serve.py): `te` is per-lane (carried in the
+    # batched chunk state), so mixed end times share one compile; the
+    # scheduler's shape classes and continuous lane pool are daemon/
+    # constructor knobs, not .par keys — see README "Fleet serving".
     tpu_fleet: str = "auto"
     # MG stall detector (tpu_solver mg only): a V-cycle whose residual
     # changed less than this RELATIVE tolerance is treated as floored and

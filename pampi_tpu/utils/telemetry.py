@@ -90,10 +90,11 @@ import os
 import time
 import warnings
 
-SCHEMA_VERSION = 6  # v6: + dead / epoch / shrink record kinds (the
-#                     dead-rank survival plane, PR 12) and the ckpt
-#                     ledger_save / ledger_restore events
-#                     (v5, PR 10: + coord record kind, elastic ckpt
+SCHEMA_VERSION = 7  # v7: + serving / admission / latency / swap record
+#                     kinds (the persistent fleet daemon, serving v2)
+#                     (v6, PR 12: + dead / epoch / shrink record kinds,
+#                      ckpt ledger_save / ledger_restore events;
+#                      v5, PR 10: + coord record kind, elastic ckpt
 #                      events, warning record kind;
 #                      v4, PR 9: + fleet record kind, scenario dimension;
 #                      v3, PR 7: + xprof record kind, drop accounting;

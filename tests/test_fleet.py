@@ -229,15 +229,19 @@ def test_bucket_routing_mixed_queue():
 def test_knob_signature_stability():
     a, b = Parameter(**_B2), Parameter(**_B2)
     assert fleet.signature_hash(a) == fleet.signature_hash(b)
-    # per-lane state keys and drive housekeeping stay OUT
+    # per-lane state keys and drive housekeeping stay OUT — and since
+    # serving v2, te too (carried per lane in the batched chunk state;
+    # dist buckets sub-split per te in the scheduler)
     assert fleet.signature_hash(a.replace(u_init=9.0)) \
         == fleet.signature_hash(a)
     assert fleet.signature_hash(a.replace(tpu_checkpoint="x.npz")) \
         == fleet.signature_hash(a)
     assert fleet.signature_hash(a.replace(tpu_fleet="pjit")) \
         == fleet.signature_hash(a)
+    assert fleet.signature_hash(a.replace(te=0.03)) \
+        == fleet.signature_hash(a)
     # trace-shaping knobs stay IN
-    for change in (dict(re=20.0), dict(itermax=11), dict(te=0.03),
+    for change in (dict(re=20.0), dict(itermax=11),
                    dict(tpu_solver="fft"), dict(name="canal"),
                    dict(obstacles="0.3,0.3,0.6,0.6"),
                    dict(tpu_mesh="2x2")):

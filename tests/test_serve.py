@@ -1,0 +1,445 @@
+"""Fleet serving v2 (ISSUE 14): shape-class batching, per-lane te /
+continuous lane swap, fleet-over-mesh, and the persistent daemon.
+
+Contracts pinned here:
+- shape classes: power-of-two rung selection (floor, idempotency,
+  waste bound — the palcheck contract), class-bucket routing, and the
+  PADDED-LANE PARITY oracle: a lane padded into its class program
+  (grid extents as per-lane traced data, dead cells masked from every
+  reduction) equals its unpadded solo run at the repo's ulp contract,
+  for dcavity AND canal BC families and across mixed grids in one
+  batch;
+- per-lane te: a batch of mixed end times rides ONE compiled program
+  (te carried in the chunk state) and equals N solo runs bitwise on
+  the jnp path — the PR 9 follow-on regression;
+- continuous batching: lanes swapped in mid-flight (finished AND
+  diverged slots) produce results bitwise-identical to solo runs, with
+  zero retrace per (signature, lanes) — the compiled batch object and
+  chunk function survive every swap and warm rerun;
+- fleet-over-mesh: the scenario axis sharded across the (8-device
+  test) mesh serves lanes bitwise-equal to solo, and the compiled
+  program contains no resharding collectives (the commcheck ban at the
+  HLO level);
+- the daemon: file-queue intake, admission + per-tenant accounting,
+  malformed .par PARKED with a structured warning (the hardened
+  load_queue path), live status endpoint, serving telemetry (schema
+  v7) through report/merge/lint.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from pampi_tpu import fleet
+from pampi_tpu.fleet import shapeclass as sc
+from pampi_tpu.fleet.shapeclass import ClassSolver
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.utils import telemetry as tm
+from pampi_tpu.utils.params import Parameter
+
+_B = dict(name="dcavity", imax=12, jmax=12, re=10.0, te=0.03, tau=0.5,
+          itermax=8, eps=1e-4, omg=1.7, gamma=0.9, tpu_mesh="1",
+          tpu_fuse_phases="off")
+
+ULP_TOL = 1e-12  # the repo's ulp contract (tests/test_overlap.py)
+
+
+def _assert_lane(got_fields, solo, bitwise=False):
+    for name, got in zip("uvp", got_fields):
+        ref = np.asarray(getattr(solo, name))
+        if bitwise:
+            assert np.array_equal(got, ref), name
+        else:
+            d = np.abs(got - ref)
+            assert np.isfinite(d).all() and d.max() < ULP_TOL, \
+                (name, d.max())
+
+
+# -- shape-class selection ---------------------------------------------
+
+def test_class_selection_units():
+    assert sc.class_extent(8) == 16 and sc.class_extent(16) == 16
+    assert sc.class_extent(17) == 32 and sc.class_extent(100) == 128
+    assert sc.class_grid((20, 48)) == (32, 64)  # rungs differ per axis
+    # idempotent: a padded grid re-bucketed lands in the same compile
+    for n in (8, 12, 16, 17, 64, 100):
+        c = sc.class_extent(n)
+        assert sc.class_extent(c) == c
+    # the waste bound at a geometry where the rungs differ
+    assert sc.padding_waste((20, 48)) < sc.WASTE_BOUND
+    assert sc.padding_waste((17, 17)) < sc.WASTE_BOUND
+    assert sc.padding_waste((16, 16)) < sc.WASTE_BOUND
+
+
+def test_class_eligibility_reasons():
+    p = Parameter(**_B)
+    assert sc.class_eligible(p) is None
+    assert "obstacle" in sc.class_eligible(
+        p.replace(obstacles="0.3,0.3,0.6,0.6"))
+    assert "tpu_solver" in sc.class_eligible(p.replace(tpu_solver="fft"))
+    assert "floor" in sc.class_eligible(p.replace(imax=4))
+    assert "forced" in sc.class_eligible(p.replace(tpu_fleet="solo"))
+    p3 = Parameter(name="dcavity3d", imax=8, jmax=8, kmax=8,
+                   seen_keys=("kmax",))
+    assert "3-D" in sc.class_eligible(p3)
+
+
+def test_class_bucket_routing():
+    p = Parameter(**_B)
+    reqs = [
+        fleet.ScenarioRequest("a", p),
+        fleet.ScenarioRequest("b", p.replace(imax=14, jmax=10)),
+        fleet.ScenarioRequest("w", p.replace(imax=20, jmax=20)),
+        fleet.ScenarioRequest("x", p.replace(imax=4)),  # below floor
+    ]
+    exact = fleet.bucket(reqs, classes=False)
+    assert len(exact) == 4  # the PR 9 routing, untouched
+    classed = fleet.bucket(reqs, classes=True)
+    labels = {k.label: [r.sid for r in v] for k, v in classed.items()}
+    assert len(classed) == 3, labels  # 16-class, 32-class, exact 4x12
+    assert ["a", "b"] in list(labels.values())
+    cls_keys = [k for k in classed if k.sig.startswith("cls")]
+    assert {k.grid for k in cls_keys} == {(16, 16), (32, 32)}
+
+
+def test_palcheck_shapeclass_contract(monkeypatch):
+    from pampi_tpu.analysis import palcheck
+
+    assert palcheck.shapeclass_violations() == []
+    # mutation: a non-idempotent rung ladder must be flagged
+    real = sc.class_extent
+    monkeypatch.setattr(sc, "class_extent",
+                        lambda n: real(n) + (0 if n % 2 else 1))
+    vs = palcheck.shapeclass_violations()
+    assert vs and any(v.rule == "shapeclass-waste" for v in vs)
+
+
+# -- padded-lane parity -------------------------------------------------
+
+def test_padded_class_lanes_match_solo_mixed_grids():
+    p = Parameter(**_B)
+    p2 = p.replace(imax=14, jmax=10, u_init=0.02)
+    tpl = ClassSolver(p, ic=16, jc=16)
+    batched = fleet.BatchedSolver(tpl, [p, p2], ["a", "b"],
+                                  family="ns2d_class")
+    results = batched.results(batched.run())
+    for lane_param, res in zip((p, p2), results):
+        solo = NS2DSolver(lane_param)
+        solo.run(progress=False)
+        assert not res["diverged"]
+        assert res["nt"] == solo.nt and solo.nt > 0
+        assert res["fields"][0].shape == (lane_param.jmax + 2,
+                                          lane_param.imax + 2)
+        _assert_lane(res["fields"], solo)
+
+
+def test_padded_class_lane_canal_bcs():
+    p = Parameter(**{**_B, "name": "canal", "bcLeft": 3, "bcRight": 3,
+                     "imax": 14, "jmax": 9})
+    tpl = ClassSolver(p, ic=16, jc=16)
+    batched = fleet.BatchedSolver(tpl, [p], ["k"], family="ns2d_class")
+    res = batched.results(batched.run())[0]
+    solo = NS2DSolver(p)
+    solo.run(progress=False)
+    assert res["nt"] == solo.nt > 0
+    _assert_lane(res["fields"], solo)
+
+
+# -- per-lane te (the PR 9 follow-on regression) ------------------------
+
+def test_mixed_te_batch_matches_n_solo_bitwise():
+    p = Parameter(**_B)
+    tpl = NS2DSolver(p)
+    params = [p.replace(te=0.02), p.replace(te=0.05, u_init=0.03),
+              p.replace(te=0.08)]
+    batched = fleet.BatchedSolver(tpl, params, ["a", "b", "c"])
+    assert batched._te_carry  # mixed te auto-arms the carry
+    results = batched.results(batched.run())
+    nts = [r["nt"] for r in results]
+    assert len(set(nts)) == 3  # each lane stopped at ITS OWN te
+    for lane_param, res in zip(params, results):
+        solo = NS2DSolver(lane_param)
+        solo.run(progress=False)
+        assert res["nt"] == solo.nt > 0
+        assert abs(res["t"] - solo.t) == 0.0
+        _assert_lane(res["fields"], solo, bitwise=True)
+
+
+def test_te_left_the_bucket_signature():
+    p = Parameter(**_B)
+    assert fleet.signature_hash(p.replace(te=0.5)) \
+        == fleet.signature_hash(p)
+    buckets = fleet.bucket([
+        fleet.ScenarioRequest("a", p),
+        fleet.ScenarioRequest("b", p.replace(te=0.06)),
+    ])
+    assert len(buckets) == 1  # one compile serves both end times
+
+
+# -- continuous batching ------------------------------------------------
+
+def test_continuous_swap_parity_and_zero_retrace():
+    from pampi_tpu.fleet import scheduler as sch
+
+    fleet.reset_templates()
+    p = Parameter(**_B)
+    sched = fleet.FleetScheduler(lanes=2)
+    params = [p.replace(u_init=0.01 * i) for i in range(4)]
+    for i, lp in enumerate(params):
+        sched.submit_param(f"s{i}", lp)
+    res = sched.run()
+    row = res.summary["buckets"][0]
+    assert row["lanes"] == 4 and row["swaps"] == 2
+    # every scenario — swapped-in lanes included — equals its solo twin
+    # bitwise (the template is the oracle driver, zero extra compiles)
+    tpl = sch._TEMPLATES[next(iter(sch._TEMPLATES))][0]
+    for i, lp in enumerate(params):
+        sch._reset_lane(tpl, lp)
+        tpl.run(progress=False)
+        r = res.by_sid(f"s{i}")
+        assert r.nt == tpl.nt > 0
+        _assert_lane(r.fields, tpl, bitwise=True)
+    # zero retrace per (signature, lanes): the warm rerun REBINDS the
+    # same compiled batch object — no jit, no compile wall
+    batch_obj = next(iter(sch._BATCHES.values()))
+    chunk_obj = batch_obj._chunk_fn
+    for i in range(4, 7):
+        sched.submit_param(f"s{i}", p.replace(u_init=0.01 * i))
+    res2 = sched.run()
+    assert res2.summary["buckets"][0]["compile_wall_s"] == 0.0
+    assert next(iter(sch._BATCHES.values())) is batch_obj
+    assert batch_obj._chunk_fn is chunk_obj
+    assert res2.by_sid("s5").nt == res.by_sid("s1").nt
+
+
+def test_cached_template_serves_new_te():
+    # te is signature-excluded: a later run with a DIFFERENT uniform te
+    # hits the same cached template — the batch must auto-arm the te
+    # carry instead of serving the template's stale baked end time
+    fleet.reset_templates()
+    p = Parameter(**{**_B, "te": 0.02})
+    sched = fleet.FleetScheduler()
+    sched.submit_param("a", p)
+    sched.submit_param("b", p.replace(u_init=0.01))
+    sched.run()
+    sched.submit_param("c", p.replace(te=0.06))
+    sched.submit_param("d", p.replace(te=0.06, u_init=0.01))
+    res = sched.run()
+    solo = NS2DSolver(p.replace(te=0.06))
+    solo.run(progress=False)
+    assert res.by_sid("c").nt == solo.nt > 0
+    _assert_lane(res.by_sid("c").fields, solo, bitwise=True)
+
+
+def test_continuous_swap_reuses_diverged_slot():
+    fleet.reset_templates()
+    p = Parameter(**_B)
+    sched = fleet.FleetScheduler(lanes=2)
+    sched.submit_param("bad", p.replace(u_init=float("nan")))
+    sched.submit_param("ok1", p)
+    sched.submit_param("ok2", p.replace(u_init=0.02))
+    res = sched.run()
+    assert res.by_sid("bad").diverged
+    assert res.summary["divergence_census"]["scenarios"] == ["bad"]
+    solo = NS2DSolver(p)
+    solo.run(progress=False)
+    assert not res.by_sid("ok1").diverged
+    _assert_lane(res.by_sid("ok1").fields, solo, bitwise=True)
+    assert not res.by_sid("ok2").diverged  # rode the freed slot
+
+
+# -- fleet-over-mesh ----------------------------------------------------
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="fleet-over-mesh needs a multi-device host")
+def test_mesh_mode_parity_and_no_resharding():
+    fleet.reset_templates()
+    n_dev = len(jax.devices())
+    # forced: auto prefers mesh only on real accelerator backends (the
+    # CPU virtual mesh shares one core — vmap wins there)
+    p = Parameter(**{**_B, "tpu_fleet": "mesh"})
+    sched = fleet.FleetScheduler()
+    for i in range(n_dev):
+        sched.submit_param(f"m{i}", p.replace(u_init=0.004 * i))
+    res = sched.run()
+    row = res.summary["buckets"][0]
+    assert row["mode"] == "mesh" and row["lanes"] == n_dev
+    solo = NS2DSolver(p.replace(u_init=0.004 * 2))
+    solo.run(progress=False)
+    _assert_lane(res.by_sid("m2").fields, solo, bitwise=True)
+    # the compiled program must not reshard the lanes (the commcheck
+    # ban, checked at the HLO level where GSPMD inserts collectives)
+    from pampi_tpu.fleet import scheduler as sch
+
+    batched = next(b for (s, n, mode, tc), b in sch._BATCHES.items()
+                   if mode == "mesh")
+    hlo = batched._chunk_fn.lower(
+        *batched.initial_state()).compile().as_text()
+    for resharder in ("all-gather", "all-to-all", "reduce-scatter"):
+        assert resharder not in hlo, resharder
+
+
+def test_resolve_fleet_mesh_validation():
+    from pampi_tpu.utils import dispatch
+
+    p = Parameter(**_B, tpu_fleet="mesh")
+    with pytest.raises(ValueError, match="divisible"):
+        dispatch.resolve_fleet(p, 3, False, "k")
+    with pytest.raises(ValueError, match="SCENARIO"):
+        dispatch.resolve_fleet(p, 8, True, "k")
+    n_dev = len(jax.devices())
+    assert dispatch.resolve_fleet(p, n_dev, False, "k") == "mesh"
+
+
+# -- the hardened queue intake -----------------------------------------
+
+def test_load_queue_on_error_parks_malformed(tmp_path):
+    good = tmp_path / "ok.par"
+    good.write_text("name dcavity\nimax 12\njmax 12\nte 0.02\n")
+    bad = tmp_path / "bad.par"
+    bad.write_text("name dcavity\nimax notanumber\n")
+    pois = tmp_path / "poisson.par"
+    pois.write_text("name poisson\nimax 12\n")
+    errors = []
+    reqs = fleet.load_queue([str(good), str(bad), str(pois)],
+                            on_error=lambda p, e: errors.append(p))
+    assert [r.sid for r in reqs] == ["ok"]
+    assert errors == [str(bad), str(pois)]
+    # default behavior unchanged: a malformed file still raises
+    with pytest.raises(SystemExit):
+        fleet.load_queue([str(bad)])
+
+
+# -- the persistent daemon ---------------------------------------------
+
+def test_daemon_end_to_end(tmp_path, monkeypatch):
+    from pampi_tpu.fleet import FleetDaemon, ServeConfig
+
+    fleet.reset_templates()
+    jsonl = tmp_path / "run.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(jsonl))
+    tm.reset()
+    qdir = tmp_path / "queue"
+    qdir.mkdir()
+    par = ("name dcavity\nimax {imax}\njmax 12\nre 10.0\nte 0.02\n"
+           "tau 0.5\nitermax 8\neps 0.0001\nomg 1.7\ngamma 0.9\n"
+           "tpu_mesh 1\ntpu_fuse_phases off\n")
+    (qdir / "alice__a.par").write_text(par.format(imax=12))
+    (qdir / "alice__b.par").write_text(par.format(imax=14))
+    (qdir / "mallory__bad.par").write_text("name dcavity\nimax zzz\n")
+    daemon = FleetDaemon(ServeConfig(
+        queue_dir=str(qdir), poll_s=0.01, max_lanes=2, max_polls=1,
+        classes="on"))
+    assert daemon.run() == 0
+    st = json.loads((qdir / "status.json").read_text())
+    assert st["served"] == 2 and st["parked"] == 1
+    assert st["per_tenant"]["alice"]["served"] == 2
+    assert len(st["classes"]) == 1  # both grids share the 16x16 class
+    assert st["latency_ms"]["p50"] is not None
+    assert sorted(f.name for f in (qdir / "results").iterdir()) == [
+        "alice__a.json", "alice__b.json"]
+    assert (qdir / "parked" / "mallory__bad.par").exists()
+    tm.finalize()
+    records = [json.loads(line)
+               for line in jsonl.read_text().splitlines()]
+    kinds = {r["kind"] for r in records}
+    assert {"serving", "admission", "latency", "warning"} <= kinds
+    park = [r for r in records if r["kind"] == "warning"]
+    assert park and park[0]["component"] == "fleet.serve"
+    accepts = [r for r in records if r["kind"] == "admission"
+               and r["action"] == "accept"]
+    assert {a["tenant"] for a in accepts} == {"alice"}
+
+
+def test_daemon_tenant_quota_defers(tmp_path, monkeypatch):
+    from pampi_tpu.fleet import FleetDaemon, ServeConfig
+
+    fleet.reset_templates()
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(tmp_path / "q.jsonl"))
+    tm.reset()
+    qdir = tmp_path / "queue"
+    qdir.mkdir()
+    par = ("name dcavity\nimax 12\njmax 12\nte 0.02\ntau 0.5\n"
+           "itermax 8\ntpu_mesh 1\n")
+    for i in range(3):
+        (qdir / f"alice__r{i}.par").write_text(par)
+    daemon = FleetDaemon(ServeConfig(
+        queue_dir=str(qdir), poll_s=0.01, max_lanes=2, max_polls=1,
+        tenant_quota=2, classes="off"))
+    daemon.poll_once()
+    st = daemon.status()
+    # quota 2: the third request stays queued (deferred), retried later
+    assert st["served"] == 2 and st["deferred"] == 1
+    daemon.poll_once()
+    assert daemon.status()["served"] == 3
+    daemon.stop()
+    tm.reset()
+
+
+def test_daemon_survives_unschedulable_request(tmp_path, monkeypatch):
+    # a WELL-FORMED .par whose knob combo cannot be scheduled (forced
+    # mesh, 1 lane on a multi-device host) must degrade to a failed
+    # request + warning record — never kill the daemon (other tenants
+    # keep their service)
+    from pampi_tpu.fleet import FleetDaemon, ServeConfig
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device host to make mesh indivisible")
+    fleet.reset_templates()
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(tmp_path / "f.jsonl"))
+    tm.reset()
+    qdir = tmp_path / "queue"
+    qdir.mkdir()
+    par = ("name dcavity\nimax 12\njmax 12\nte 0.02\ntau 0.5\n"
+           "itermax 8\ntpu_mesh 1\ntpu_fleet mesh\n")
+    (qdir / "bad__mesh1.par").write_text(par)
+    good = par.replace("tpu_fleet mesh", "tpu_fleet auto")
+    daemon = FleetDaemon(ServeConfig(
+        queue_dir=str(qdir), poll_s=0.01, max_lanes=2, max_polls=1,
+        classes="off"))
+    daemon.poll_once()
+    assert daemon.status()["failed"] == 1
+    # the daemon is still alive and serves the next tenant
+    (qdir / "alice__ok.par").write_text(good)
+    daemon.poll_once()
+    st = daemon.status()
+    assert st["served"] == 1 and st["failed"] == 1
+    daemon.stop()
+    tm.reset()
+
+
+# -- serving telemetry / artifact plumbing -----------------------------
+
+def test_serving_summary_merge_and_lint(tmp_path, monkeypatch):
+    from tools import telemetry_report as tr
+    from tools._artifact import write_merged
+    from tools.check_artifact import lint_bench, lint_serving_summary
+
+    jsonl = tmp_path / "srv.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(jsonl))
+    tm.reset()
+    tm.emit("serving", event="start", queue_dir="q")
+    tm.emit("admission", action="accept", sid="a", tenant="t")
+    tm.emit("admission", action="park", path="bad.par")
+    tm.emit("latency", scenario="a", ms=12.5)
+    tm.emit("swap", family="fleet.ns2d", lane=0, scenario="b")
+    tm.emit("serving", event="stop", polls=1, served=1, diverged=0,
+            parked=1, deferred=0, swaps=1, queue_depth_max=2,
+            scenarios_per_s=3.5)
+    records = tr.load(str(jsonl))
+    srv = tr.serving_summary(records)
+    assert srv["served"] == 1 and srv["p50_latency_ms"] == 12.5
+    assert srv["admission"] == {"accept": 1, "park": 1}
+    artifact = tmp_path / "SRV.json"
+    merged = write_merged(str(artifact), {
+        "n": 0, "cmd": "t", "rc": 0, "tail": "",
+        "telemetry_summary": tr.summary(records),
+        "serving_summary": srv})
+    assert lint_bench(merged, "SRV") == []
+    names = {m["name"] for m in merged["metrics"]}
+    assert {"fleet_p50_latency_ms", "fleet_queue_depth_max"} <= names
+    # a gutted serving block must be flagged
+    assert lint_serving_summary({"served": 1}, "X")
+    tm.reset()

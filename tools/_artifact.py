@@ -85,6 +85,23 @@ def collect_metrics(rec: dict) -> list[dict]:
             "unit": "scenarios/s",
             "backend": "tpu" if run_backend == "tpu" else "cpu",
         })
+    srv = rec.get("serving_summary")
+    if isinstance(srv, dict):
+        # the serving-v2 daemon headlines (fleet/serve.py): tenant-felt
+        # latency and backlog pressure — bench_trend gates both
+        # LOWER-is-better by name (NAME_DIRECTIONS)
+        for name, key, unit in (
+                ("fleet_p50_latency_ms", "p50_latency_ms", "ms"),
+                ("fleet_queue_depth_max", "queue_depth_max",
+                 "requests")):
+            if isinstance(srv.get(key), (int, float)) \
+                    and name not in seen:
+                out.append({
+                    "name": name,
+                    "value": srv[key],
+                    "unit": unit,
+                    "backend": "tpu" if run_backend == "tpu" else "cpu",
+                })
     return out
 
 

@@ -111,7 +111,13 @@ NAME_DIRECTIONS = {"comm_hidden_fraction": True,
                    # (the restricted halves must stay below the 2x
                    # full-sweep count they replaced)
                    "dcn_exchange_bytes": False,
-                   "pre_grid_cells": False}
+                   "pre_grid_cells": False,
+                   # serving v2 (fleet/serve.py): tenant-felt request
+                   # latency and the admission backlog high-water mark —
+                   # both lower-is-better; fleet_scenarios_per_s above
+                   # stays the higher-is-better throughput headline
+                   "fleet_p50_latency_ms": False,
+                   "fleet_queue_depth_max": False}
 
 
 def higher_is_better(unit, name: str | None = None) -> bool | None:

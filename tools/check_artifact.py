@@ -142,9 +142,15 @@ def lint_fleet_summary(d: dict, where: str) -> list[str]:
                 errs.append(f"{where}.buckets[{i}]: not a dict")
                 continue
             errs += _missing(b, FLEET_BUCKET_KEYS, f"{where}.buckets[{i}]")
-            if b.get("mode") not in ("vmap", "pjit", "solo"):
+            # mesh (scenario axis over a device mesh), class
+            # (shape-class padded batch) and failed (a daemon-isolated
+            # unschedulable bucket) joined in serving v2 — pure
+            # addition, legacy artifacts carry only the first three
+            if b.get("mode") not in ("vmap", "mesh", "class", "pjit",
+                                     "solo", "failed"):
                 errs.append(f"{where}.buckets[{i}].mode: "
-                            f"{b.get('mode')!r} not vmap|pjit|solo")
+                            f"{b.get('mode')!r} not "
+                            "vmap|mesh|class|pjit|solo|failed")
     elif "buckets" in d:
         errs.append(f"{where}.buckets: not a list")
     census = d.get("divergence_census")
@@ -156,11 +162,33 @@ def lint_fleet_summary(d: dict, where: str) -> list[str]:
     return errs
 
 
+SERVING_KEYS = ("polls", "served", "parked", "swaps", "queue_depth_max",
+                "requests", "p50_latency_ms")
+
+
+def lint_serving_summary(d: dict, where: str) -> list[str]:
+    """The persistent-daemon serving block (fleet/serve.py via
+    tools/telemetry_report.serving_summary): the admission/latency
+    accounting is required — a serving artifact that cannot say what it
+    parked or how long tenants waited is not a serving artifact. Legacy
+    (pre-daemon) artifacts simply lack the block (optional)."""
+    errs = _missing(d, SERVING_KEYS, where)
+    adm = d.get("admission")
+    if adm is not None and not isinstance(adm, dict):
+        errs.append(f"{where}.admission: not a dict")
+    for k in ("served", "parked", "swaps", "queue_depth_max"):
+        v = d.get(k)
+        if v is not None and not isinstance(v, (int, float)):
+            errs.append(f"{where}.{k}: {v!r} not a number")
+    return errs
+
+
 def _lint_optional_blocks(d: dict, where: str) -> list[str]:
     errs = []
     for key, fn in (("xprof_summary", lint_xprof_summary),
                     ("comm_hidden_fraction", lint_comm_hidden),
-                    ("fleet_summary", lint_fleet_summary)):
+                    ("fleet_summary", lint_fleet_summary),
+                    ("serving_summary", lint_serving_summary)):
         block = d.get(key)
         if block is None:
             continue

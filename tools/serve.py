@@ -1,0 +1,81 @@
+"""Persistent fleet serving daemon — the CLI front of fleet/serve.py.
+
+    python tools/serve.py QUEUE_DIR [options]
+
+Watches QUEUE_DIR for `.par` request files (name them
+`<tenant>__<scenario>.par` for per-tenant accounting), serves them
+through the fleet scheduler (shape-class batching + continuous lane
+swap + warm template/batch caches), and maintains a live status
+endpoint at QUEUE_DIR/status.json. Drop a file named STOP into
+QUEUE_DIR for a clean shutdown.
+
+Options:
+  --status PATH     status endpoint path (default QUEUE_DIR/status.json)
+  --results DIR     per-scenario result files (default QUEUE_DIR/results)
+  --base PATH       base .par applied under every request
+  --lanes N         continuous-batch pool size per bucket (default 4)
+  --max-queue N     admission: max accepted-and-unserved (default 64)
+  --quota N         admission: per-tenant pending cap (default 8)
+  --classes MODE    shape-class batching on|off|auto (default on)
+  --poll S          queue-scan cadence seconds (default 0.5)
+  --max-polls N     exit after N polls (0 = until STOP; smokes/CI)
+
+Arm PAMPI_TELEMETRY for the flight record (serving/admission/latency
+records, schema v7) — `tools/telemetry_report.py --merge` folds the
+`serving_summary` block into BENCH artifacts and `tools/bench_trend.py`
+gates fleet_p50_latency_ms / fleet_queue_depth_max lower-is-better.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        description="pampi-tpu fleet serving daemon")
+    ap.add_argument("queue_dir")
+    ap.add_argument("--status", default="")
+    ap.add_argument("--results", default="")
+    ap.add_argument("--base", default="")
+    ap.add_argument("--lanes", type=int, default=4)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--quota", type=int, default=8)
+    ap.add_argument("--classes", default="on",
+                    choices=("on", "off", "auto"))
+    ap.add_argument("--poll", type=float, default=0.5)
+    ap.add_argument("--max-polls", type=int, default=0)
+    args = ap.parse_args(argv[1:])
+
+    from pampi_tpu.fleet import FleetDaemon, ServeConfig
+    from pampi_tpu.utils import telemetry as tm
+    from pampi_tpu.utils.params import Parameter, read_parameter
+
+    base = (read_parameter(args.base, Parameter())
+            if args.base else None)
+    tm.start_run(tool="serve", queue_dir=args.queue_dir)
+    cfg = ServeConfig(
+        queue_dir=args.queue_dir, status_path=args.status,
+        results_dir=args.results, poll_s=args.poll,
+        max_lanes=args.lanes, max_queue=args.max_queue,
+        tenant_quota=args.quota, classes=args.classes,
+        max_polls=args.max_polls)
+    daemon = FleetDaemon(cfg, base=base)
+    print(f"serving {args.queue_dir} (status: {daemon.status_path}; "
+          f"drop {args.queue_dir}/STOP to shut down)")
+    rc = daemon.run()
+    tm.finalize()
+    st = daemon.status()
+    print(f"served {st['served']} scenario(s), parked {st['parked']}, "
+          f"{st['swaps']} lane swap(s), p50 latency "
+          f"{st['latency_ms']['p50']} ms")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

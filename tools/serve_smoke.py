@@ -1,0 +1,184 @@
+"""Serve smoke: the persistent fleet daemon end-to-end on CPU
+(make serve-smoke).
+
+    python tools/serve_smoke.py [outdir]
+
+Starts the daemon over a temp file-queue and submits a mixed queue that
+exercises every serving-v2 contract at once:
+
+- FOUR distinct grids across TWO shape classes (12x12, 14x10, 10x12 ->
+  the 16x16 rung; 20x20 -> the 32x32 rung): the status endpoint's
+  per-class compile census must show AT MOST ONE compiled program per
+  shape class (the pad-and-mask shared-compile contract).
+- a 2-lane continuous pool under a 4-request class: at least one
+  MID-RUN SWAP-IN (a queued scenario takes a finished/diverged lane's
+  slot, zero retrace).
+- one DIVERGED lane (u_init nan — the in-band sentinel retires it, the
+  swap plane reuses its slot, the divergence census names it).
+- one MALFORMED .par: parked with a structured `warning` telemetry
+  record, the daemon survives (the hardened load_queue path).
+
+Then proves the observability plane end-to-end: live status endpoint
+fields, telemetry (schema v7 serving/admission/latency records) through
+report -> --merge -> check_artifact lint, the trend-gated
+fleet_p50_latency_ms / fleet_queue_depth_max metrics in the merged
+artifact, and a clean shutdown (rc 0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU-stable smoke environment: must precede any jax import (the
+# tools/lint.py convention); a TPU image just keeps its own backend
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+PAR = """name dcavity
+imax {imax}
+jmax {jmax}
+re 10.0
+te {te}
+tau 0.5
+itermax 10
+eps 0.0001
+omg 1.7
+gamma 0.9
+u_init {u}
+tpu_mesh 1
+"""
+
+
+def _write_queue(qdir: str) -> int:
+    """Returns the number of WELL-FORMED requests written."""
+    reqs = [
+        # the 16x16 shape class: 3 distinct grids + one same-grid
+        # swap-in candidate; c2 diverges at step 1 (u_init nan)
+        ("alice__c0.par", PAR.format(imax=12, jmax=12, te=0.03, u=0.0)),
+        ("alice__c1.par", PAR.format(imax=14, jmax=10, te=0.03, u=0.01)),
+        ("alice__c2.par", PAR.format(imax=10, jmax=12, te=0.03,
+                                     u=float("nan"))),
+        ("alice__c3.par", PAR.format(imax=12, jmax=12, te=0.05, u=0.02)),
+        # the 32x32 shape class
+        ("bob__wide.par", PAR.format(imax=20, jmax=20, te=0.03, u=0.0)),
+    ]
+    for name, text in reqs:
+        with open(os.path.join(qdir, name), "w") as fh:
+            fh.write(text)
+    # one malformed request: must be PARKED, never kill the daemon
+    with open(os.path.join(qdir, "mallory__bad.par"), "w") as fh:
+        fh.write("name dcavity\nimax notanumber\n")
+    return len(reqs)
+
+
+def main(argv: list[str]) -> int:
+    outdir = argv[1] if len(argv) > 1 else os.path.join(
+        REPO, "results", "serve_smoke")
+    shutil.rmtree(outdir, ignore_errors=True)
+    qdir = os.path.join(outdir, "queue")
+    os.makedirs(qdir, exist_ok=True)
+    jsonl = os.path.join(outdir, "run.jsonl")
+    os.environ["PAMPI_TELEMETRY"] = jsonl
+
+    from pampi_tpu.fleet import FleetDaemon, ServeConfig
+    from pampi_tpu.utils import telemetry as tm
+
+    tm.reset()
+    tm.start_run(tool="serve_smoke")
+    n_good = _write_queue(qdir)
+
+    daemon = FleetDaemon(ServeConfig(
+        queue_dir=qdir, poll_s=0.01, max_lanes=2, max_queue=32,
+        tenant_quota=8, classes="on", max_polls=2))
+    rc = daemon.run()
+    tm.finalize()
+
+    failures: list[str] = []
+    if rc != 0:
+        failures.append(f"daemon exited rc {rc}")
+
+    # -- the live status endpoint --------------------------------------
+    with open(daemon.status_path) as fh:
+        st = json.load(fh)
+    print(json.dumps(st, indent=1))
+    if st["served"] != n_good:
+        failures.append(f"served {st['served']} of {n_good}")
+    if st["diverged"] != 1:
+        failures.append(f"diverged census {st['diverged']} != 1")
+    if st["parked"] != 1:
+        failures.append(f"parked {st['parked']} != 1 (malformed .par)")
+    if st["swaps"] < 1:
+        failures.append("no mid-run lane swap-in happened")
+    classes = st.get("classes") or {}
+    if len(classes) != 2:
+        failures.append(
+            f"{len(classes)} compiled classes (expected 2 shape-class "
+            f"rungs for 4 distinct grids): {classes}")
+    for label, compiles in classes.items():
+        if compiles > 1:
+            failures.append(
+                f"class {label} compiled {compiles} programs — the "
+                "shared-compile contract is one per shape class")
+    if st["latency_ms"]["p50"] is None:
+        failures.append("no p50 latency in the status endpoint")
+    if not os.path.isdir(os.path.join(qdir, "parked")) or not os.listdir(
+            os.path.join(qdir, "parked")):
+        failures.append("malformed .par was not parked aside")
+    results = sorted(os.listdir(daemon.results_dir))
+    if len(results) != n_good:
+        failures.append(f"result files {results} != {n_good} scenarios")
+
+    # -- telemetry round trip: report -> merge -> lint -----------------
+    from tools import telemetry_report as tr
+
+    records = tr.load(jsonl)
+    sys.stdout.write(tr.render(records))
+    srv = tr.serving_summary(records)
+    if not srv:
+        failures.append("no serving_summary from the flight record")
+    kinds = {r.get("kind") for r in records}
+    for kind in ("serving", "admission", "latency", "swap", "warning"):
+        if kind not in kinds:
+            failures.append(f"no `{kind}` record in the flight record")
+    div = [r for r in records if r.get("kind") == "divergence"
+           and r.get("scenario")]
+    if not div:
+        failures.append("no scenario-tagged divergence record for the "
+                        "nan lane")
+
+    artifact = os.path.join(outdir, "SERVE_SMOKE.json")
+    from tools._artifact import write_merged
+    from tools.check_artifact import lint_bench
+
+    block = {"n": 0, "cmd": "serve_smoke", "rc": 0, "tail": "",
+             "telemetry_summary": tr.summary(records),
+             "fleet_summary": tr.fleet_summary(records),
+             "serving_summary": srv}
+    merged = write_merged(artifact, block)
+    failures += lint_bench(merged, "SERVE_SMOKE")
+    names = {m.get("name") for m in merged.get("metrics", [])}
+    for metric in ("fleet_p50_latency_ms", "fleet_queue_depth_max"):
+        if metric not in names:
+            failures.append(
+                f"merged artifact carries no normalized {metric}")
+
+    if failures:
+        print("\nSERVE SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"\nserve smoke ok: {st['served']} scenarios over "
+          f"{len(classes)} shape classes (1 compile each), "
+          f"{st['swaps']} swap(s), 1 diverged lane isolated, 1 "
+          f"malformed request parked, p50 latency "
+          f"{st['latency_ms']['p50']} ms, clean shutdown")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -253,6 +253,43 @@ def fleet_summary(records: list[dict]):
     return out
 
 
+def serving_summary(records: list[dict]):
+    """The persistent-daemon serving block (`serving_summary` top-level
+    in merged artifacts; tools/check_artifact.py lints it): the daemon's
+    final `serving` stop record plus the admission/latency censuses —
+    requests in, requests served/parked/deferred, swap count, the p50
+    latency and max queue depth the bench_trend gate watches."""
+    srv = [r for r in records if r.get("kind") == "serving"]
+    if not srv:
+        return None
+    stop = next((r for r in reversed(srv) if r.get("event") == "stop"),
+                srv[-1])
+    admissions = [r for r in records if r.get("kind") == "admission"]
+    lats = [r.get("ms") for r in records
+            if r.get("kind") == "latency"
+            and isinstance(r.get("ms"), (int, float))]
+    actions: dict[str, int] = {}
+    for a in admissions:
+        act = str(a.get("action"))
+        actions[act] = actions.get(act, 0) + 1
+    out = _strip(stop, "event")
+    out["requests"] = len(admissions)
+    out["admission"] = actions or None
+    if out.get("p50_latency_ms") is None and lats:
+        # pre-stop-record flight records (or a daemon killed before
+        # stop()): recompute with the daemon's own percentile formula
+        # (fleet/serve._percentile — nearest-rank on the sorted list)
+        vs = sorted(lats)
+        out["p50_latency_ms"] = round(
+            vs[min(len(vs) - 1, max(0, int(round(0.5 * (len(vs) - 1)))))],
+            3)
+    if out.get("max_latency_ms") is None and lats:
+        out["max_latency_ms"] = round(max(lats), 3)
+    out.setdefault("p50_latency_ms", None)
+    out.setdefault("max_latency_ms", None)
+    return out
+
+
 def xprof_summary(records: list[dict]):
     """The last captured device-trace region, cleaned for the artifact
     (`xprof_summary` top-level block; tools/check_artifact.py lints it)."""
@@ -369,10 +406,25 @@ def render(records: list[dict]) -> str:
             f"throughput={f.get('scenarios_per_s')} scenarios/s "
             f"diverged={((f.get('divergence_census') or {}).get('diverged'))}")
         for b in f.get("buckets") or []:
+            swaps = (f" swaps={b['swaps']}" if "swaps" in b else "")
             add(f"  bucket {b.get('bucket'):<32} mode={b.get('mode'):<5} "
                 f"lanes={b.get('lanes'):>3} "
                 f"compile={b.get('compile_wall_s')}s "
-                f"run={b.get('run_wall_s')}s")
+                f"run={b.get('run_wall_s')}s{swaps}")
+
+    srv = serving_summary(records)
+    if srv is not None:
+        add("== serving (persistent daemon) ==")
+        add(f"  polls={srv.get('polls')} served={srv.get('served')} "
+            f"parked={srv.get('parked')} deferred={srv.get('deferred')} "
+            f"swaps={srv.get('swaps')}")
+        add(f"  queue_depth_max={srv.get('queue_depth_max')} "
+            f"p50_latency_ms={srv.get('p50_latency_ms')} "
+            f"throughput={srv.get('scenarios_per_s')} scenarios/s")
+        adm = srv.get("admission")
+        if adm:
+            add("  admission: " + " ".join(
+                f"{a}={n}" for a, n in sorted(adm.items())))
 
     for d in k.get("divergence", []):
         add("== DIVERGENCE ==")
@@ -547,6 +599,9 @@ def main(argv: list[str]) -> int:
         fl = fleet_summary(records)
         if fl is not None:
             block["fleet_summary"] = fl
+        srv = serving_summary(records)
+        if srv is not None:
+            block["serving_summary"] = srv
         write_merged(merge_to, block)
     return 0
 
