@@ -107,12 +107,13 @@ lint-comm:
 fleet-smoke:
 	JAX_PLATFORMS=cpu python tools/fleet_smoke.py
 
-# Serve smoke (serving v2): the persistent daemon on CPU over a temp
-# file-queue — two shape classes (4 distinct grids, at most ONE compile
-# per class), a mid-run lane swap-in, one diverged lane isolated, one
-# malformed .par parked with a warning record, the live status
-# endpoint, and the telemetry/merge/lint round trip. rc 0 = clean
-# shutdown.
+# Serve smoke (serving v2/v3): the persistent daemon on CPU over a temp
+# file-queue — three shape classes (6 distinct grids incl. a 3-D rung,
+# at most ONE compile per class), a mid-run lane swap-in, one diverged
+# lane isolated, one class-ineligible request with its refusal reason
+# in the dispatch record, one malformed .par parked with a warning
+# record, the live status endpoint, and the telemetry/merge/lint round
+# trip. rc 0 = clean shutdown.
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py
 

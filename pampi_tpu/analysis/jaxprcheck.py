@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import hashlib
 import inspect
+import re
 from dataclasses import dataclass
 
 from .astlint import Violation
@@ -116,12 +117,21 @@ def float_dtypes(jaxpr) -> set[str]:
     return out
 
 
+# `name_and_src_info=<kernel> at <file>:<line>` in pallas_call params:
+# the line number is SOURCE metadata, not program structure — an edit
+# that merely shifts a kernel def down the file must not read as trace
+# drift (found in round 20: every fused-config hash churned on a
+# pure-addition kernel change with zero primitive deltas)
+_SRC_INFO_RE = re.compile(r" at [^\s]+:\d+")
+
+
 def jaxpr_hash(closed) -> str:
-    """sha256 of the pretty-printed program — the trace-identity token.
-    Stable within one (jax version, x64, backend) environment; the
-    baseline stores that environment and hashes are only compared when it
-    matches."""
-    return hashlib.sha256(str(closed).encode()).hexdigest()
+    """sha256 of the pretty-printed program with source-location
+    metadata stripped — the trace-identity token. Stable within one
+    (jax version, x64, backend) environment; the baseline stores that
+    environment and hashes are only compared when it matches."""
+    return hashlib.sha256(
+        _SRC_INFO_RE.sub("", str(closed)).encode()).hexdigest()
 
 
 def diff_histograms(old: dict, new: dict) -> list[str]:
@@ -277,17 +287,28 @@ class ChunkConfig:
                 params = [param.replace(te=param.te * (i + 1))
                           for i in range(self.fleet)]
             if self.fleet_class:
-                from ..fleet.shapeclass import ClassSolver, class_grid
+                from ..fleet.shapeclass import (
+                    Class3DSolver,
+                    ClassSolver,
+                    class_grid,
+                )
 
-                grid = class_grid((param.imax, param.jmax))
-                solver = ClassSolver(param, ic=grid[0], jc=grid[1])
+                if self.family == "ns3d":
+                    grid = class_grid((param.imax, param.jmax,
+                                       param.kmax))
+                    solver = Class3DSolver(param, ic=grid[0], jc=grid[1],
+                                           kc=grid[2])
+                    other = param.replace(imax=param.imax + 2,
+                                          jmax=param.jmax + 1)
+                else:
+                    grid = class_grid((param.imax, param.jmax))
+                    solver = ClassSolver(param, ic=grid[0], jc=grid[1])
+                    other = param.replace(imax=param.imax - 2,
+                                          jmax=param.jmax - 4)
                 if self.fleet >= 2:
                     # mixed GRIDS share the class compile: the second
-                    # lane is a smaller grid riding the same program
-                    params = ([param,
-                               param.replace(imax=param.imax - 2,
-                                             jmax=param.jmax - 4)]
-                              + [param] * (self.fleet - 2))
+                    # lane is a different grid riding the same program
+                    params = [param, other] + [param] * (self.fleet - 2)
             mesh = None
             if self.fleet_mesh:
                 import jax
@@ -496,6 +517,41 @@ def standard_configs() -> list[ChunkConfig]:
                   "identical vmapped chunk (shardings live at the jit "
                   "boundary), so the census must stay collective-free "
                   "(the zero-resharding serving contract)"),
+        # serving v3 (ISSUE 15): the class chunk rides the PRODUCTION
+        # kernels — fused PRE/POST at call-time extents plus the padded-
+        # class tblock solve. Pure additions; the serving-v2 jnp class
+        # config above keeps its byte-identical trace (hash unchanged).
+        ChunkConfig(
+            "ns2d_fleet_class_fused", "ns2d",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_mesh="1"),
+            derive=True, phases_key="ns2d_class_phases",
+            solve_key="ns2d_class_solve",
+            dispatch_keys=("ns2d_class_phases", "ns2d_class_solve"),
+            fleet=2, fleet_class=True,
+            notes="the fused class chunk: PRE + padded-class solve + "
+                  "POST — exactly three launches per step, extents as "
+                  "per-lane SMEM scalars, two DIFFERENT grids on one "
+                  "compile"),
+        ChunkConfig(
+            "ns3d_fleet_class", "ns3d",
+            dict(_B3, tpu_fuse_phases="off", tpu_solver="sor",
+                 tpu_mesh="1"),
+            expected_pallas=0, dispatch_keys=("ns3d_class_phases",),
+            fleet=2, fleet_class=True,
+            notes="3-D class rungs (serving v3): the masked jnp chain "
+                  "over ragged3d's select machinery — zero kernels, "
+                  "kmax joins the per-lane data"),
+        ChunkConfig(
+            "ns3d_fleet_class_fused", "ns3d",
+            dict(_B3, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_mesh="1"),
+            derive=True, phases_key="ns3d_class_phases",
+            dispatch_keys=("ns3d_class_phases",),
+            fleet=2, fleet_class=True,
+            notes="the 3-D fused class chunk: dynamic-extent PRE + POST "
+                  "around the masked jnp class solve — exactly two "
+                  "launches per step"),
     ]
 
 

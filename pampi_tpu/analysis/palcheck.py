@@ -526,7 +526,79 @@ def shapeclass_violations() -> list[Violation]:
                 where, 1, RULE_SHAPECLASS,
                 f"grid {grid}: padding waste {w:.2f}x >= the "
                 f"{sc.WASTE_BOUND}x bound"))
+    # 3-D rungs (serving v3): the same per-axis bound cubed
+    for grid in ((17, 33, 9), (9, 9, 9), (20, 48, 12), (16, 16, 16),
+                 (100, 100, 100), (8, 8, 255)):
+        w = sc.padding_waste(grid)
+        if w >= sc.WASTE_BOUND_3D:
+            vs.append(Violation(
+                where, 1, RULE_SHAPECLASS,
+                f"grid {grid}: padding waste {w:.2f}x >= the 3-D "
+                f"{sc.WASTE_BOUND_3D}x bound"))
     return vs
+
+
+def class_kernel_entries() -> list:
+    """The dynamic-extent CLASS kernels at padded geometries sized for
+    the 2x-per-axis waste bound's worst case (live extent one past half
+    the rung, so the padded block is as oversized as eligibility ever
+    allows): the fused 2-D PRE/POST + the padded-class tblock solve at a
+    256² class, and the 3-D PRE/POST at a 32³ class. Trace-only — the
+    standard resource rules (tiling/VMEM/index/alias) then price the
+    class blocks the serving plane actually launches."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..fleet.shapeclass import make_padded_class_solve
+    from ..ops import ns2d_fused as nf
+    from ..ops import ns3d_fused as nf3
+    from ..utils.params import Parameter
+
+    out = []
+    n = 256  # rung for live extents 129..256 (worst pad: live 129)
+    param = Parameter(name="dcavity", imax=n, jmax=n)
+    dt = jnp.float32
+    solve, br, h = make_padded_class_solve(param, n, n, dt,
+                                           interpret=True)
+    pre, pad, _unpad, _h = nf.make_fused_pre_2d(
+        param, n, n, 1.0, 1.0, dt, block_rows=br, interpret=True,
+        dynamic=True)
+    post, _p, _u, _h2 = nf.make_fused_post_2d(
+        param, n, n, 1.0, 1.0, dt, block_rows=br, ragged=True,
+        interpret=True, dynamic=True)
+    z = pad(jnp.zeros((n + 2, n + 2), dt))
+    offs = jnp.zeros((2,), jnp.int32)
+    ext = jnp.asarray([[129, 129]], jnp.int32)
+    geo = jnp.asarray([[1.0 / 129, 1.0 / 129]], dt)
+    dt11 = jnp.full((1, 1), 0.01, dt)
+    out.append((f"ns2d_class.PRE[{n}²]",
+                jax.make_jaxpr(pre)(offs, ext, geo, dt11, z, z)))
+    out.append((f"ns2d_class.POST[{n}²]",
+                jax.make_jaxpr(post)(offs, ext, geo, dt11,
+                                     z, z, z, z, z)))
+    sgeo = jnp.asarray([[0.9, 1.0, 1.0]], dt)
+    norm = jnp.asarray(129.0 * 129.0, dt)
+    out.append((f"ns2d_class.solve[{n}²]",
+                jax.make_jaxpr(solve)(z, z, ext, sgeo, norm)))
+    m = 32  # 3-D rung for live extents 17..32
+    param3 = Parameter(name="dcavity3d", imax=m, jmax=m, kmax=m,
+                       seen_keys=("kmax",))
+    pre3, pad3, _u3, _h3 = nf3.make_fused_pre_3d(
+        param3, m, m, m, 1.0, 1.0, 1.0, dt, interpret=True, dynamic=True)
+    post3, _p3, _uu3, _hh3 = nf3.make_fused_post_3d(
+        param3, m, m, m, 1.0, 1.0, 1.0, dt, ragged=True, interpret=True,
+        dynamic=True)
+    z3 = pad3(jnp.zeros((m + 2, m + 2, m + 2), dt))
+    offs3 = jnp.zeros((3,), jnp.int32)
+    ext3 = jnp.asarray([[17, 17, 17]], jnp.int32)
+    geo3 = jnp.asarray([[1.0 / 17, 1.0 / 17, 1.0 / 17]], dt)
+    out.append((f"ns3d_class.PRE[{m}³]",
+                jax.make_jaxpr(pre3)(offs3, ext3, geo3, dt11,
+                                     z3, z3, z3)))
+    out.append((f"ns3d_class.POST[{m}³]",
+                jax.make_jaxpr(post3)(offs3, ext3, geo3, dt11,
+                                      z3, z3, z3, z3, z3, z3, z3)))
+    return out
 
 
 def check_jaxpr(jaxpr, budget: int | None = None,
@@ -561,4 +633,8 @@ def run(traced=None, configs=None, budget: int | None = None,
         # the serving-v2 shape-class rung ladder: covering, idempotent,
         # waste-bounded (fleet/shapeclass.py)
         vs += shapeclass_violations()
+        # the serving-v3 class KERNELS (fused PRE/POST + padded-class
+        # solve) at the waste bound's worst-case padded geometry
+        for name, jx in class_kernel_entries():
+            vs += check_jaxpr(jx.jaxpr, budget=budget, context=f"{name}/")
     return vs

@@ -144,19 +144,29 @@ def bucket_key(param: Parameter) -> BucketKey:
     return BucketKey(family=family, grid=grid, sig=signature_hash(param))
 
 
-def class_bucket_key(param) -> "BucketKey | None":
+_UNSET = object()
+
+
+def class_bucket_key(param, why_not=_UNSET) -> "BucketKey | None":
     """The SHAPE-CLASS bucket of a request, or None when it must keep
     its exact-shape bucket (fleet/shapeclass.class_eligible). The key's
-    grid is the padded class grid; the signature hash excludes the grid
+    grid is the padded class grid — 2-D or 3-D rungs per family (3-D
+    classes since serving v3); the signature hash excludes the grid
     extents (per-lane data in the class chunk) and carries a "cls"
     prefix so a class bucket can never collide with an exact bucket of
-    the same grid."""
+    the same grid. `why_not` takes a precomputed class_eligible result
+    (bucket()'s admission hot path runs eligibility once per request,
+    not twice)."""
     from . import shapeclass as sc
 
     family = family_of(param)
-    if family != "ns2d" or sc.class_eligible(param) is not None:
+    if why_not is _UNSET:
+        why_not = sc.class_eligible(param)
+    if why_not is not None:
         return None
-    grid = sc.class_grid((param.imax, param.jmax))
+    grid = sc.class_grid(
+        (param.imax, param.jmax, param.kmax) if family == "ns3d"
+        else (param.imax, param.jmax))
     return BucketKey(family=family, grid=grid,
                      sig=sc.class_sig_hash(param))
 
@@ -165,11 +175,25 @@ def bucket(requests, classes: bool = False) -> dict:
     """Group requests by shared-trace bucket; insertion-ordered (the
     scheduler executes buckets in first-seen order, lanes in submit
     order — deterministic end-to-end). `classes=True` routes eligible
-    requests into shape-class buckets (pad-and-mask shared compiles);
+    requests into shape-class buckets (pad-and-mask shared compiles),
+    RECORDING each request's eligibility decision per bucket
+    (`utils/dispatch.resolve_class`, key `class_<bucket>` — a refused
+    request's exact-shape landing carries the class_eligible reason);
     ineligible requests keep their exact-shape bucket either way."""
+    from ..utils import dispatch as _dispatch
+    from . import shapeclass as sc
+
     out: dict[BucketKey, list[ScenarioRequest]] = {}
     for req in requests:
-        key = class_bucket_key(req.param) if classes else None
+        key = None
+        if classes:
+            why_not = sc.class_eligible(req.param)
+            key = class_bucket_key(req.param, why_not=why_not)
+            label = (key if key is not None
+                     else bucket_key(req.param)).label
+            _dispatch.resolve_class(
+                f"class_{label}",
+                key.grid if key is not None else (), why_not)
         if key is None:
             key = bucket_key(req.param)
         out.setdefault(key, []).append(req)

@@ -170,8 +170,9 @@ def _clear_contamination(solver) -> bool:
     must not leak into the next tenant's program — reset the knobs and
     re-trace when either drifted, so the next lane runs the program a
     fresh solver would have built. Returns whether a re-trace happened.
-    Class templates (fleet/shapeclass.ClassSolver) have exactly one jnp
-    program and no rebuild hook — nothing to heal."""
+    Class templates (fleet/shapeclass.ClassSolver/Class3DSolver) carry
+    the same `_backend`/`_rebuild_chunk` surface since the fused class
+    chunk landed (serving v3) and heal the same way."""
     if not hasattr(solver, "_rebuild_chunk"):
         return False
     if (getattr(solver, "_dt_scale", 1.0) != 1.0
@@ -483,11 +484,16 @@ class FleetScheduler:
         hit = _TEMPLATES.get(key.sig)
         if hit is not None:
             return hit[0], True, None
-        from .shapeclass import ClassSolver
+        from .shapeclass import Class3DSolver, ClassSolver
 
         t0 = time.perf_counter()
         grid = key.grid
-        template = ClassSolver(reqs[0].param, ic=grid[0], jc=grid[1])
+        if key.family == "ns3d":
+            # 3-D class rungs (serving v3): grid is (imax, jmax, kmax)
+            template = Class3DSolver(reqs[0].param, ic=grid[0],
+                                     jc=grid[1], kc=grid[2])
+        else:
+            template = ClassSolver(reqs[0].param, ic=grid[0], jc=grid[1])
         _TEMPLATES[key.sig] = (template, False)
         return template, False, time.perf_counter() - t0
 

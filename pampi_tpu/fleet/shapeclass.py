@@ -25,12 +25,27 @@ computed host-side per lane with the identical expressions and carried
 in the lane's geometry vector).
 
 Class eligibility is conservative (the exact-shape bucket is always the
-fallback, recorded per bucket): 2-D, no obstacle flags, the reference
-"sor" solve, a single-device lane, grids at least MIN_CLASS_EXTENT per
-axis. `palcheck.shapeclass_violations` bounds the padding waste per
-class: above the eligibility floor the padded extent stays under 2x the
-live extent per axis, so a class never burns more than WASTE_BOUND
-(4x) the live cells.
+fallback, recorded per bucket via `utils/dispatch.resolve_class`): no
+obstacle flags, the reference "sor" solve in the checkerboard-compatible
+layouts, a single-device lane, grids at least MIN_CLASS_EXTENT per axis.
+Since serving v3 (ISSUE 15) the ladder covers BOTH NS families — 3-D
+rungs ride `parallel/ragged3d.py`'s identical select machinery
+(`Class3DSolver`) — and the class chunk rides the PRODUCTION kernels:
+when `tpu_fuse_phases` dispatches (the solo policy, `resolve_fuse_phases`
+under the `ns2d_class_phases`/`ns3d_class_phases` keys), the chunk lowers
+to the fused PRE/POST megakernels with the per-lane live extents as
+call-time SMEM scalars (`ops/ns2d_fused.py` / `ops/ns3d_fused.py`
+`dynamic=True` — pad cells are dead writes inside the same kernel), and
+the 2-D pressure solve runs as the extent-gated `sor_pallas` tblock
+kernel in the padded class layout (`make_padded_class_solve` — the
+dominant per-step cost stops being jnp inside class lanes; the 3-D class
+solve stays the masked jnp rb loop). The jnp masked chain remains the
+parity oracle (`tpu_fuse_phases off` forces it — kernel-off lanes trace
+byte-identically to serving v2). `palcheck.shapeclass_violations` bounds
+the padding waste per class: above the eligibility floor the padded
+extent stays under 2x the live extent per axis, so a 2-D class never
+burns more than WASTE_BOUND (4x) the live cells (8x for a 3-D class,
+the same per-axis bound cubed).
 """
 
 from __future__ import annotations
@@ -50,8 +65,10 @@ RUNG_FLOOR = 16
 MIN_CLASS_EXTENT = 8
 # padding-waste contract, checked by analysis/palcheck: padded cells /
 # live cells (ghost-inclusive) stays strictly under this per class for
-# every eligible grid
+# every eligible grid (the per-axis < 2x bound squared; cubed for the
+# 3-D rungs — serving v3)
 WASTE_BOUND = 4.0
+WASTE_BOUND_3D = 8.0
 
 # geometry-vector slots (per lane, time-dtype precision): every
 # grid-derived scalar the solo solver folds as a Python-float constant,
@@ -61,9 +78,11 @@ G_IMAX, G_JMAX, G_DX, G_DY, G_DTB, G_FACTOR, G_IDX2, G_IDY2, G_NORM = \
 GEOM_LEN = 9
 
 # class-signature exclusions ON TOP of the queue's lane/housekeeping
-# sets: the grid extents become per-lane data (xlength/ylength stay in
-# the signature — the canal inflow profile bakes ylength as a value)
-CLASS_KEYS = ("imax", "jmax")
+# sets: the grid extents become per-lane data (xlength/ylength/zlength
+# stay in the signature — the canal inflow profile bakes ylength as a
+# value). kmax joins for the 3-D rungs; for a 2-D family it is a default
+# the signature never needed.
+CLASS_KEYS = ("imax", "jmax", "kmax")
 
 
 def class_extent(n: int) -> int:
@@ -92,24 +111,29 @@ def padding_waste(grid) -> float:
 
 def class_eligible(param) -> str | None:
     """None when the request may ride a shape class; else the reason it
-    keeps its exact-shape bucket (recorded per bucket)."""
+    keeps its exact-shape bucket (recorded per bucket via
+    `utils/dispatch.resolve_class`). 2-D AND 3-D families are eligible
+    since serving v3 — the 3-D rungs ride the same select machinery."""
     from ..cli import mesh_is_single
     from ..utils.params import is_3d_config
 
-    if is_3d_config(param):
-        return "3-D family (shape classes are 2-D; exact bucket)"
     if param.obstacles.strip():
         return "obstacle flags are trace-baked geometry"
     if param.tpu_solver != "sor":
         return f"tpu_solver {param.tpu_solver} (class solve is rb-sor)"
+    if param.tpu_sor_layout not in ("auto", "checkerboard"):
+        return (f"tpu_sor_layout {param.tpu_sor_layout} forced (the "
+                "class solve is the checkerboard padded layout)")
     if param.tpu_flat_solve:
         return "tpu_flat_solve trips are extent-derived"
     if not mesh_is_single(param):
         return "distributed lane (whole-mesh shards are shape-baked)"
     if param.tpu_fleet not in ("auto", "vmap"):
         return f"tpu_fleet {param.tpu_fleet} forced"
-    if param.imax < MIN_CLASS_EXTENT or param.jmax < MIN_CLASS_EXTENT:
-        return (f"grid {param.imax}x{param.jmax} below the "
+    extents = ((param.imax, param.jmax, param.kmax)
+               if is_3d_config(param) else (param.imax, param.jmax))
+    if any(n < MIN_CLASS_EXTENT for n in extents):
+        return (f"grid {'x'.join(str(n) for n in extents)} below the "
                 f"{MIN_CLASS_EXTENT}-cell class floor (padding waste "
                 "would exceed the bound)")
     return None
@@ -172,7 +196,7 @@ def make_class_solve(param, jc: int, ic: int, dtype, grids):
     gj, gi = grids
     epssq = param.eps * param.eps
     itermax = param.itermax
-    res_dtype = jnp.promote_types(dtype, jnp.float32)
+    res_dtype = jnp_promote(dtype)
 
     def solve(p0, rhs, imax, jmax, factor, idx2, idy2, norm):
         factor = factor.astype(dtype)
@@ -335,12 +359,211 @@ def make_class_chunk(param, jc: int, ic: int, dtype,
     return chunk_fn_metrics if metrics else chunk_fn
 
 
+def make_padded_class_solve(param, jc: int, ic: int, dtype,
+                            block_rows: int | None = None,
+                            interpret: bool | None = None):
+    """The rb convergence loop as the extent-gated `sor_pallas` tblock
+    kernel in the padded CLASS layout — models/poisson.
+    make_padded_solver_fn with the live extents and update constants as
+    call-time data (`make_rb_iter_tblock(dynamic=True)`), so ONE compiled
+    solve serves every lane of the class:
+
+        solve(p_pad, rhs_pad, ext_i32_12, geo_13, norm) -> (p', res, it)
+
+    ext = (jmax, imax), geo = (factor, idx2, idy2) — each computed
+    host-side per lane in Python f64 with the solo solver's own
+    expressions (the lane geometry vector). Cells beyond the live extent
+    pass through untouched (where-selects), and the masked residual sums
+    exact zeros there — the live-mask residual reduction. Raises
+    ValueError when the kernel is unavailable/VMEM-infeasible (callers
+    fall back to the jnp class chain). Returns (solve, block_rows, halo).
+    """
+    from ..ops import sor_pallas as sp
+
+    eff = max(1, param.tpu_sor_inner)
+    rb_iter, block_rows, halo = sp.make_rb_iter_tblock(
+        ic, jc, 1.0, 1.0, param.omg, dtype, n_inner=eff,
+        block_rows=block_rows, interpret=interpret, dynamic=True,
+    )
+    if rb_iter is None:
+        raise ValueError("pallas backend unavailable")
+    epssq = param.eps * param.eps
+    itermax = param.itermax
+    res_dtype = jnp_promote(dtype)
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    def solve(p_pad, rhs_pad, ext, geo, norm):
+        def cond(carry):
+            _, res, it = carry
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(carry):
+            p, _, it = carry
+            p, rsq = rb_iter(p, rhs_pad, ext, geo)
+            res = (rsq / norm).astype(res_dtype)
+            return p, res, it + eff
+
+        return lax.while_loop(
+            cond, body,
+            (p_pad, jnp.asarray(1.0, res_dtype),
+             jnp.asarray(0, jnp.int32)))
+
+    return solve, block_rows, halo
+
+
+def jnp_promote(dtype):
+    """The class solves' residual dtype: the storage dtype promoted to at
+    least f32 (the convergence scalar must not re-quantize to bf16)."""
+    import jax.numpy as jnp
+
+    return jnp.promote_types(dtype, jnp.float32)
+
+
+def make_fused_class_chunk(param, jc: int, ic: int, dtype,
+                           metrics: bool = False, chunk_default: int = 64):
+    """The PRODUCTION-kernel class chunk (ISSUE 15's tentpole): one shape
+    class's chunk program lowered to the solo fused composition —
+    PRE megakernel -> padded-class tblock solve -> POST megakernel, three
+    pallas launches per step (launch-count test-pinned) — with the
+    per-lane live extents/cell sizes as call-time SMEM scalars
+    (`dynamic=True` kernels), so a padded lane matches its exact-shape
+    fused solo at the ulp contract while every lane of the class shares
+    this ONE compile. External state layout is identical to
+    make_class_chunk's ((u, v, p, t, nt, gm[, m], te) in the reference
+    layout — padding lives inside the chunk like models/ns2d's fused
+    chunk), so BatchedSolver/lane_state/crop_lane ride it unchanged.
+    Raises ValueError when a kernel build is infeasible (the caller
+    records why and falls back to the jnp class chain)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import ns2d_fused as nf
+    from ..ops import ns2d as ops
+    from ..utils import telemetry as _tm
+
+    # the solve picks the shared layout (the p-layout fold contract of
+    # models/ns2d._build_fused_chunk): p and rhs stay padded across the
+    # whole chunk, zero layout passes between the three kernels
+    solve_pad, br, h = make_padded_class_solve(param, jc, ic, dtype)
+    if (br, h) != nf.fused_layout_2d(jc, ic, dtype, block_rows=br):
+        raise ValueError(
+            f"padded-class solve layout ({br}, {h}) does not match the "
+            "fused phase kernels' (no shared padded layout)")
+    pre, pad, unpad, _h = nf.make_fused_pre_2d(
+        param, jc, ic, 1.0, 1.0, dtype, block_rows=br, dynamic=True)
+    post, _p2, _u2, _h2 = nf.make_fused_post_2d(
+        param, jc, ic, 1.0, 1.0, dtype, block_rows=br, ragged=True,
+        dynamic=True)
+
+    grids = _index_grids(jc, ic)
+    gj, gi = grids
+    adaptive = param.tau > 0.0
+    chunk = param.tpu_chunk or chunk_default
+    time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    offs = jnp.zeros((2,), jnp.int32)
+
+    def norm_p(q, jmax, imax):
+        # the jnp class chunk's dynamic normalizePressure, on the
+        # unpadded block (the conversion pair runs only inside the
+        # every-100-steps cond branch, the models/ns2d fold convention)
+        live = (gj <= jmax + 1) & (gi <= imax + 1)
+        cnt = ((jmax + 2.0) * (imax + 2.0)).astype(dtype)
+        mean = jnp.sum(jnp.where(live, q, jnp.zeros_like(q))) / cnt
+        return jnp.where(live, q - mean, q)
+
+    def step(up, vp, p, t, nt, gm, umax, vmax):
+        jmax, imax = gm[G_JMAX], gm[G_IMAX]
+        dx = gm[G_DX].astype(dtype)
+        dy = gm[G_DY].astype(dtype)
+        dtb = gm[G_DTB].astype(dtype)
+        if adaptive:
+            dt = ops.cfl_dt(umax, vmax, dtb, dx, dy, param.tau)
+        else:
+            dt = jnp.asarray(param.dt, dtype)
+        dt11 = jnp.full((1, 1), dt, dtype)
+        ext = jnp.stack([jmax, imax]).astype(jnp.int32).reshape(1, 2)
+        geo = jnp.stack([dx, dy]).reshape(1, 2)
+        up, vp, fp, gp, rhsp = pre(offs, ext, geo, dt11, up, vp)
+        p = lax.cond(
+            nt % 100 == 0,
+            lambda q: pad(norm_p(unpad(q), jmax, imax)),
+            lambda q: q, p)
+        sgeo = jnp.stack([gm[G_FACTOR].astype(dtype),
+                          gm[G_IDX2].astype(dtype),
+                          gm[G_IDY2].astype(dtype)]).reshape(1, 3)
+        p, res, it = solve_pad(p, rhsp, ext, sgeo,
+                               gm[G_NORM].astype(dtype))
+        up, vp, umax, vmax = post(offs, ext, geo, dt11, up, vp, fp, gp, p)
+        t_next = t + dt.astype(time_dtype)
+        return up, vp, p, t_next, nt + 1, umax, vmax, res, it, dt
+
+    def chunk_fn(u, v, p, t, nt, gm, te):
+        up, vp, pp = pad(u), pad(v), pad(p)
+        umax = jnp.max(jnp.abs(u))
+        vmax = jnp.max(jnp.abs(v))
+
+        def cond(c):
+            return jnp.logical_and(c[3] <= te, c[6] < chunk)
+
+        def body(c):
+            up, vp, p, t, nt, gm, k, umax, vmax = c
+            up, vp, p, t, nt, umax, vmax, _res, _it, _dt = step(
+                up, vp, p, t, nt, gm, umax, vmax)
+            return up, vp, p, t, nt, gm, k + 1, umax, vmax
+
+        up, vp, pp, t, nt, gm, _k, _um, _vm = lax.while_loop(
+            cond, body,
+            (up, vp, pp, t, nt, gm, jnp.asarray(0, jnp.int32),
+             umax, vmax))
+        return unpad(up), unpad(vp), unpad(pp), t, nt, gm
+
+    def chunk_fn_metrics(u, v, p, t, nt, gm, m, te):
+        up, vp, pp = pad(u), pad(v), pad(p)
+        umax = jnp.max(jnp.abs(u))
+        vmax = jnp.max(jnp.abs(v))
+
+        def cond(c):
+            return jnp.logical_and(c[3] <= te, c[6] < chunk)
+
+        def body(c):
+            (up, vp, p, t, nt, gm, k, umax, vmax,
+             res, it, dtv, bad) = c
+            up, vp, p, t, nt, umax, vmax, res, it, dtv = step(
+                up, vp, p, t, nt, gm, umax, vmax)
+            res, it, dtv, _um, _vm, bad = _tm.metrics_step(
+                bad, nt, res, it, dtv, umax, vmax)
+            return (up, vp, p, t, nt, gm, k + 1, umax, vmax,
+                    res, it, dtv, bad)
+
+        (up, vp, pp, t, nt, gm, _k, umax, vmax,
+         res, it, dtv, bad) = lax.while_loop(
+            cond, body,
+            (up, vp, pp, t, nt, gm, jnp.asarray(0, jnp.int32),
+             umax, vmax,
+             m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT], m[_tm.M_BAD]))
+        return (unpad(up), unpad(vp), unpad(pp), t, nt, gm,
+                _tm.metrics_pack(res, it, dtv, umax, vmax, 0.0, bad))
+
+    return chunk_fn_metrics if metrics else chunk_fn
+
+
 class ClassSolver:
     """The template of one shape class: a BatchedSolver-compatible
     template whose chunk takes grid extents as per-lane data. Built from
     a representative request; every same-class-signature request of any
     eligible grid rides this one compile (`fleet/batch.BatchedSolver`
-    with te always carried)."""
+    with te always carried).
+
+    Since serving v3 the chunk rides the production kernels wherever the
+    solo solver would (`resolve_fuse_phases` under `ns2d_class_phases`):
+    fused PRE + padded-class tblock solve + POST, kernel-identical to an
+    exact-shape fused solo modulo the traced extents. `tpu_fuse_phases
+    off` (or any refusal) keeps the jnp masked chain — the parity oracle,
+    byte-identical to the serving-v2 trace — and the pallas-retry
+    protocol's jnp rebuild lands there too (`_rebuild_chunk`)."""
 
     CHUNK = 64
     # the class chunk takes te unconditionally (its carry is inherently
@@ -367,29 +590,74 @@ class ClassSolver:
         self.ic, self.jc = ic, jc
         self.dtype = resolve_dtype(param.tpu_dtype) if dtype is None \
             else dtype
-        self._backend = "jnp"  # the class chunk is the masked jnp chain
+        self._backend = "auto"
+        self._fused = False  # set by _build_chunk (fused-class dispatch)
         self._dt_scale = 1.0
         self._metrics = _tm.enabled()
         self._time_index = 3
         self._n_fields = 3
         t0 = _time.perf_counter()
         self._chunk_fn = jax.jit(self._build_chunk())
+        from ..utils import dispatch as _dispatch
+
         _tm.emit("build", family="ns2d_class",
                  grid=[jc, ic], cls=f"{ic}x{jc}",
-                 trace_wall_s=round(_time.perf_counter() - t0, 3))
+                 trace_wall_s=round(_time.perf_counter() - t0, 3),
+                 phases=_dispatch.last("ns2d_class_phases"))
 
     def _uses_pallas(self) -> bool:
-        return False
+        return self._fused
+
+    def _build_fused_chunk(self, backend: str, metrics: bool):
+        """The fused-class dispatch (the models/ns2d._build_fused_chunk
+        shape): None when the production kernels are not dispatched —
+        knob off, jnp retry backend, no TPU/probe failure, or an
+        infeasible kernel build — and the jnp masked chain is the
+        fallback (decision recorded either way)."""
+        from ..ops.ns2d_fused import probe_fused_2d
+        from ..utils.dispatch import record, resolve_fuse_phases
+
+        if not resolve_fuse_phases(
+            self.param, backend, self.dtype, probe_fused_2d,
+            "ns2d_class_phases",
+        ):
+            return None
+        try:
+            fused = make_fused_class_chunk(
+                self.param, self.jc, self.ic, self.dtype,
+                metrics=metrics, chunk_default=self.CHUNK)
+        except ValueError as exc:  # kernel unavailable/VMEM-infeasible
+            record("ns2d_class_phases", f"jnp ({exc})")
+            return None
+        record("ns2d_class_solve",
+               "pallas_padded_class (extent-gated tblock, n_inner="
+               f"{max(1, self.param.tpu_sor_inner)})")
+        return fused
 
     def _build_chunk(self, backend: str | None = None,
                      te_arg: bool = True):
-        # backend is accepted for the retry-protocol surface; the class
-        # chunk has exactly one (jnp) program. te is ALWAYS the trailing
-        # traced argument — the class carry is inherently per-lane.
+        # backend follows the retry-protocol surface ("jnp" = the pallas
+        # fallback rebuild -> the masked jnp chain). te is ALWAYS the
+        # trailing traced argument — the class carry is inherently
+        # per-lane.
+        backend = self._backend if backend is None else backend
         self._metrics = _metrics_enabled()
+        fused = self._build_fused_chunk(backend, self._metrics)
+        self._fused = fused is not None
+        if fused is not None:
+            return fused
         return make_class_chunk(self.param, self.jc, self.ic, self.dtype,
                                 metrics=self._metrics,
                                 chunk_default=self.CHUNK)
+
+    def _rebuild_chunk(self):
+        """Re-trace against the solver's CURRENT `_backend` — the
+        pallas-retry/contamination-heal hook (models/ns2d convention;
+        the class template has no recovery dt clamp)."""
+        import jax
+
+        self._chunk_fn = jax.jit(self._build_chunk(backend=self._backend))
+        return self._chunk_fn
 
     # -- per-lane state (the BatchedSolver template hooks) --------------
     def lane_state(self, param) -> tuple:
@@ -402,6 +670,14 @@ class ClassSolver:
         if reason is not None:
             raise ValueError(f"request is not class-eligible: {reason}")
         jc, ic = self.jc, self.ic
+        if param.imax > ic or param.jmax > jc:
+            # the __init__ guard, repeated per lane: swap_lane feeds
+            # requests straight through here — an oversized lane would
+            # otherwise saturate the live mask silently and crop_lane
+            # would hand the tenant a wrong-shaped result
+            raise ValueError(
+                f"grid {param.imax}x{param.jmax} exceeds class "
+                f"{ic}x{jc}")
         live = ((np.arange(jc + 2)[:, None] <= param.jmax + 1)
                 & (np.arange(ic + 2)[None, :] <= param.imax + 1))
         time_dtype = jnp.float64 if jax.config.jax_enable_x64 \
@@ -434,3 +710,497 @@ def _metrics_enabled() -> bool:
     from ..utils import telemetry as _tm
 
     return _tm.enabled()
+
+
+# ---------------------------------------------------------------------------
+# 3-D class rungs (ISSUE 15): the identical ladder over ragged3d's select
+# machinery — kmax joins the per-lane data, the solve is the masked jnp
+# 3-D rb loop (models/ns3d.make_pressure_solve_3d's jnp path at traced
+# extents; the octant/tblock3d pallas solves stay exact-shape programs),
+# and the fused chunk rides ops/ns3d_fused's dynamic-extent PRE/POST.
+# ---------------------------------------------------------------------------
+
+# 3-D geometry-vector slots (per lane): the grid-derived scalars
+# NS3DSolver folds as Python-float constants, computed host-side with the
+# identical expressions (utils/grid.Grid + ops/ns3d.sor_coefficients_3d)
+(G3_KMAX, G3_JMAX, G3_IMAX, G3_DX, G3_DY, G3_DZ, G3_DTB,
+ G3_FACTOR, G3_IDX2, G3_IDY2, G3_IDZ2, G3_NORM) = range(12)
+GEOM3_LEN = 12
+
+
+def lane_geometry_3d(param):
+    """The 3-D per-lane geometry scalars — NS3DSolver.__init__'s own
+    Python f64 expressions (Grid dx/dy/dz, the dt bound) plus
+    ops/ns3d.sor_coefficients_3d (the single source of the 3-D SOR
+    constants), the bitwise-coefficient contract."""
+    from ..models.ns3d import sor_coefficients_3d
+
+    dx = param.xlength / param.imax
+    dy = param.ylength / param.jmax
+    dz = param.zlength / param.kmax
+    inv_sqr_sum = 1.0 / dx**2 + 1.0 / dy**2 + 1.0 / dz**2
+    dt_bound = 0.5 * param.re / inv_sqr_sum
+    factor, idx2, idy2, idz2 = sor_coefficients_3d(dx, dy, dz, param.omg)
+    norm = float(param.imax * param.jmax * param.kmax)
+    return (float(param.kmax), float(param.jmax), float(param.imax),
+            dx, dy, dz, dt_bound, factor, idx2, idy2, idz2, norm)
+
+
+def _index_grids_3d(kc: int, jc: int, ic: int):
+    import jax.numpy as jnp
+
+    gk = jnp.arange(kc + 2, dtype=jnp.int32)[:, None, None]
+    gj = jnp.arange(jc + 2, dtype=jnp.int32)[None, :, None]
+    gi = jnp.arange(ic + 2, dtype=jnp.int32)[None, None, :]
+    return gk, gj, gi
+
+
+def make_class_solve_3d(param, kc: int, jc: int, ic: int, dtype, grids):
+    """The masked 3-D red-black SOR convergence loop at TRACED extents —
+    models/ns3d.make_pressure_solve_3d's jnp path (odd half-sweep, even
+    half-sweep seeing odd's updates, 6-face Neumann ghost copy,
+    normalized residual vs eps^2) with every position select-by-global-
+    index and every reduction confined to the dynamic interior."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    gk, gj, gi = grids
+    epssq = param.eps * param.eps
+    itermax = param.itermax
+    res_dtype = jnp_promote(dtype)
+
+    def solve(p0, rhs, kmax, jmax, imax, factor, idx2, idy2, idz2, norm):
+        factor = factor.astype(dtype)
+        idx2 = idx2.astype(dtype)
+        idy2 = idy2.astype(dtype)
+        idz2 = idz2.astype(dtype)
+        norm = norm.astype(dtype)
+        interior = ((gk >= 1) & (gk <= kmax) & (gj >= 1) & (gj <= jmax)
+                    & (gi >= 1) & (gi <= imax))
+        parity = (gi + gj + gk) % 2
+        # pass 0 visits parity 1 (odd), pass 1 parity 0 — the reference's
+        # ksw/jsw/isw ordering (models/ns3d.checkerboard_mask_3d)
+        odd = (interior & (parity == 1)).astype(dtype)
+        even = (interior & (parity == 0)).astype(dtype)
+        tan_ji = (gj >= 1) & (gj <= jmax) & (gi >= 1) & (gi <= imax)
+        tan_ki = (gk >= 1) & (gk <= kmax) & (gi >= 1) & (gi <= imax)
+        tan_kj = (gk >= 1) & (gk <= kmax) & (gj >= 1) & (gj <= jmax)
+        m_front = (gk == 0) & tan_ji
+        m_back = (gk == kmax + 1) & tan_ji
+        m_bottom = (gj == 0) & tan_ki
+        m_top = (gj == jmax + 1) & tan_ki
+        m_left = (gi == 0) & tan_kj
+        m_right = (gi == imax + 1) & tan_kj
+
+        def sweep(p, mask):
+            # interior_residual_3d's 7-point stencil on the full block
+            # (rolls deliver the same neighbour values at every cell
+            # whose neighbours are real; the masked r is exact 0 off its
+            # colour, so dead cells add -0.0 — identity)
+            lap = (
+                (jnp.roll(p, -1, axis=2) - 2.0 * p
+                 + jnp.roll(p, 1, axis=2)) * idx2
+                + (jnp.roll(p, -1, axis=1) - 2.0 * p
+                   + jnp.roll(p, 1, axis=1)) * idy2
+                + (jnp.roll(p, -1, axis=0) - 2.0 * p
+                   + jnp.roll(p, 1, axis=0)) * idz2
+            )
+            r = (rhs - lap) * mask
+            return p + (-factor) * r, jnp.sum(r * r)
+
+        def neumann(p):
+            # neumann_faces_3d's face order as selects, corners untouched
+            p = jnp.where(m_front, jnp.roll(p, -1, axis=0), p)
+            p = jnp.where(m_back, jnp.roll(p, 1, axis=0), p)
+            p = jnp.where(m_bottom, jnp.roll(p, -1, axis=1), p)
+            p = jnp.where(m_top, jnp.roll(p, 1, axis=1), p)
+            p = jnp.where(m_left, jnp.roll(p, -1, axis=2), p)
+            p = jnp.where(m_right, jnp.roll(p, 1, axis=2), p)
+            return p
+
+        def cond(carry):
+            _, res, it = carry
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(carry):
+            p, _, it = carry
+            p, r0 = sweep(p, odd)
+            p, r1 = sweep(p, even)
+            p = neumann(p)
+            res = ((r0 + r1) / norm).astype(res_dtype)
+            return p, res, it + 1
+
+        return lax.while_loop(
+            cond, body,
+            (p0, jnp.asarray(1.0, res_dtype), jnp.asarray(0, jnp.int32)))
+
+    return solve
+
+
+def _class_step_3d(param, kc: int, jc: int, ic: int, dtype, grids,
+                   solve, fused=None):
+    """One 3-D class timestep at traced extents — NS3DSolver._build_step's
+    phase order (NO normalizePressure in the 3-D loop) over the ragged3d
+    select machinery. `fused=(pre, post, pad3, unpad3)` swaps the
+    non-solve phases for the dynamic-extent megakernels (u/v/w arrive and
+    leave PADDED, plus carried CFL maxima — the solo fused composition);
+    None is the jnp masked chain."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import ns3d as ops3
+    from ..ops.ns3d_fused import _win_shift
+    from ..parallel import ragged3d as rg3
+
+    gk, gj, gi = grids
+    adaptive = param.tau > 0.0
+    problem = param.name.replace("3d", "")
+    bcs = {
+        "top": param.bcTop,
+        "bottom": param.bcBottom,
+        "left": param.bcLeft,
+        "right": param.bcRight,
+        "front": param.bcFront,
+        "back": param.bcBack,
+    }
+    time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+    def unpack(gm):
+        kmax, jmax, imax = gm[G3_KMAX], gm[G3_JMAX], gm[G3_IMAX]
+        dx = gm[G3_DX].astype(dtype)
+        dy = gm[G3_DY].astype(dtype)
+        dz = gm[G3_DZ].astype(dtype)
+        return kmax, jmax, imax, dx, dy, dz
+
+    def do_solve(p, rhs, gm):
+        kmax, jmax, imax, *_ = unpack(gm)
+        return solve(p, rhs, kmax, jmax, imax, gm[G3_FACTOR],
+                     gm[G3_IDX2], gm[G3_IDY2], gm[G3_IDZ2],
+                     gm[G3_NORM])
+
+    if fused is not None:
+        pre, post, pad3, unpad3 = fused
+        offs = jnp.zeros((3,), jnp.int32)
+
+        def step(up, vp, wp, p, t, nt, gm, umax, vmax, wmax):
+            kmax, jmax, imax, dx, dy, dz = unpack(gm)
+            dtb = gm[G3_DTB].astype(dtype)
+            if adaptive:
+                dt = ops3.cfl_dt_3d(umax, vmax, wmax, dtb, dx, dy, dz,
+                                    param.tau)
+            else:
+                dt = jnp.asarray(param.dt, dtype)
+            dt11 = jnp.full((1, 1), dt, dtype)
+            ext = jnp.stack([kmax, jmax, imax]).astype(
+                jnp.int32).reshape(1, 3)
+            geo = jnp.stack([dx, dy, dz]).reshape(1, 3)
+            up, vp, wp, fp, gp, hp, rhsp = pre(offs, ext, geo, dt11,
+                                               up, vp, wp)
+            p, res, it = do_solve(p, unpad3(rhsp), gm)
+            up, vp, wp, umax, vmax, wmax = post(
+                offs, ext, geo, dt11, up, vp, wp, fp, gp, hp, pad3(p))
+            t_next = t + dt.astype(time_dtype)
+            return (up, vp, wp, p, t_next, nt + 1, umax, vmax, wmax,
+                    res, it, dt)
+
+        return step
+
+    def step(u, v, w, p, t, nt, gm):
+        kmax, jmax, imax, dx, dy, dz = unpack(gm)
+        dtb = gm[G3_DTB].astype(dtype)
+        interior = ((gk >= 1) & (gk <= kmax) & (gj >= 1) & (gj <= jmax)
+                    & (gi >= 1) & (gi <= imax))
+        live = (gk <= kmax + 1) & (gj <= jmax + 1) & (gi <= imax + 1)
+        if adaptive:
+            # ghost-inclusive maxElement scans: dead cells are exact 0
+            dt = ops3.cfl_dt_3d(ops3.max_element(u), ops3.max_element(v),
+                                ops3.max_element(w), dtb, dx, dy, dz,
+                                param.tau)
+        else:
+            dt = jnp.asarray(param.dt, dtype)
+        u, v, w = rg3.set_bcs_3d_ragged(u, v, w, bcs, None, kc, jc, ic,
+                                        kmax, jmax, imax, grids=grids)
+        u = rg3.set_special_bc_3d_ragged(u, problem, None, kc, jc, ic,
+                                         kmax, jmax, imax, grids=grids)
+        f_full, g_full, h_full = ops3.fgh_predictor_terms(
+            u, v, w, dt, param.re, param.gx, param.gy, param.gz,
+            param.gamma, dx, dy, dz, sh=_win_shift)
+        zero = jnp.zeros_like(u)
+        f = jnp.where(interior, f_full, zero)
+        g_ = jnp.where(interior, g_full, zero)
+        h = jnp.where(interior, h_full, zero)
+        f, g_, h = rg3.fgh_fixups_ragged(f, g_, h, u, v, w, None,
+                                         kc, jc, ic, kmax, jmax, imax,
+                                         grids=grids)
+        rhs = jnp.where(
+            interior,
+            ops3.rhs_terms_3d(f, g_, h, dt, dx, dy, dz, sh=_win_shift),
+            zero)
+        p, res, it = do_solve(p, rhs, gm)
+        un, vn, wn = ops3.adapt_terms_3d(f, g_, h, p, dt, dx, dy, dz,
+                                         sh=_win_shift)
+        u = jnp.where(interior, un, u)
+        v = jnp.where(interior, vn, v)
+        w = jnp.where(interior, wn, w)
+        # the ragged POST convention (live_masks_3d): dead pad cells go
+        # to exact 0 before the next step's ghost-inclusive CFL scans
+        lm = live.astype(dtype)
+        u = u * lm
+        v = v * lm
+        w = w * lm
+        t_next = t + dt.astype(time_dtype)
+        return u, v, w, p, t_next, nt + 1, res, it, dt
+
+    return step
+
+
+def make_class_chunk_3d(param, kc: int, jc: int, ic: int, dtype,
+                        metrics: bool = False, chunk_default: int = 32,
+                        fused=None):
+    """One 3-D shape class's chunk program: NS3DSolver's phase order with
+    grid extents as per-lane traced scalars. Lane state is
+    (u, v, w, p, t, nt, gm[, m]) plus the carried te. `fused` (the
+    dynamic-extent kernel tuple) selects the production-kernel step;
+    None is the jnp masked chain."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..utils import telemetry as _tm
+
+    grids = _index_grids_3d(kc, jc, ic)
+    chunk = param.tpu_chunk or chunk_default
+    solve = make_class_solve_3d(param, kc, jc, ic, dtype, grids)
+    step = _class_step_3d(param, kc, jc, ic, dtype, grids, solve,
+                          fused=fused)
+
+    if fused is not None:
+        _pre, _post, pad3, unpad3 = fused
+
+        def chunk_fn(u, v, w, p, t, nt, gm, te):
+            up, vp, wp = pad3(u), pad3(v), pad3(w)
+            umax = jnp.max(jnp.abs(u))
+            vmax = jnp.max(jnp.abs(v))
+            wmax = jnp.max(jnp.abs(w))
+
+            def cond(c):
+                return jnp.logical_and(c[4] <= te, c[7] < chunk)
+
+            def body(c):
+                up, vp, wp, p, t, nt, gm, k, um, vm, wm = c
+                (up, vp, wp, p, t, nt, um, vm, wm,
+                 _res, _it, _dt) = step(up, vp, wp, p, t, nt, gm,
+                                        um, vm, wm)
+                return up, vp, wp, p, t, nt, gm, k + 1, um, vm, wm
+
+            (up, vp, wp, p, t, nt, gm, _k,
+             _um, _vm, _wm) = lax.while_loop(
+                cond, body,
+                (up, vp, wp, p, t, nt, gm, jnp.asarray(0, jnp.int32),
+                 umax, vmax, wmax))
+            return unpad3(up), unpad3(vp), unpad3(wp), p, t, nt, gm
+
+        def chunk_fn_metrics(u, v, w, p, t, nt, gm, m, te):
+            up, vp, wp = pad3(u), pad3(v), pad3(w)
+            umax = jnp.max(jnp.abs(u))
+            vmax = jnp.max(jnp.abs(v))
+            wmax = jnp.max(jnp.abs(w))
+
+            def cond(c):
+                return jnp.logical_and(c[4] <= te, c[7] < chunk)
+
+            def body(c):
+                (up, vp, wp, p, t, nt, gm, k, um, vm, wm,
+                 res, it, dtv, bad) = c
+                (up, vp, wp, p, t, nt, um, vm, wm,
+                 res, it, dtv) = step(up, vp, wp, p, t, nt, gm,
+                                      um, vm, wm)
+                res, it, dtv, _u, _v, _w, bad = _tm.metrics_step(
+                    bad, nt, res, it, dtv, um, vm, wm)
+                return (up, vp, wp, p, t, nt, gm, k + 1, um, vm, wm,
+                        res, it, dtv, bad)
+
+            (up, vp, wp, p, t, nt, gm, _k, um, vm, wm,
+             res, it, dtv, bad) = lax.while_loop(
+                cond, body,
+                (up, vp, wp, p, t, nt, gm, jnp.asarray(0, jnp.int32),
+                 umax, vmax, wmax,
+                 m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT], m[_tm.M_BAD]))
+            return (unpad3(up), unpad3(vp), unpad3(wp), p, t, nt, gm,
+                    _tm.metrics_pack(res, it, dtv, um, vm, wm, bad))
+
+        return chunk_fn_metrics if metrics else chunk_fn
+
+    def chunk_fn(u, v, w, p, t, nt, gm, te):
+        def cond(c):
+            return jnp.logical_and(c[4] <= te, c[7] < chunk)
+
+        def body(c):
+            u, v, w, p, t, nt, gm, k = c
+            u, v, w, p, t, nt, _res, _it, _dt = step(u, v, w, p, t, nt,
+                                                     gm)
+            return u, v, w, p, t, nt, gm, k + 1
+
+        u, v, w, p, t, nt, gm, _k = lax.while_loop(
+            cond, body,
+            (u, v, w, p, t, nt, gm, jnp.asarray(0, jnp.int32)))
+        return u, v, w, p, t, nt, gm
+
+    def chunk_fn_metrics(u, v, w, p, t, nt, gm, m, te):
+        from ..ops import ns3d as ops3
+
+        def cond(c):
+            return jnp.logical_and(c[4] <= te, c[7] < chunk)
+
+        def body(c):
+            u, v, w, p, t, nt, gm, k, res, it, dtv, um, vm, wm, bad = c
+            u, v, w, p, t, nt, res, it, dtv = step(u, v, w, p, t, nt, gm)
+            res, it, dtv, um, vm, wm, bad = _tm.metrics_step(
+                bad, nt, res, it, dtv, ops3.max_element(u),
+                ops3.max_element(v), ops3.max_element(w))
+            return (u, v, w, p, t, nt, gm, k + 1,
+                    res, it, dtv, um, vm, wm, bad)
+
+        (u, v, w, p, t, nt, gm, _k,
+         res, it, dtv, um, vm, wm, bad) = lax.while_loop(
+            cond, body,
+            (u, v, w, p, t, nt, gm, jnp.asarray(0, jnp.int32),
+             m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
+             m[_tm.M_UMAX], m[_tm.M_VMAX], m[_tm.M_WMAX], m[_tm.M_BAD]))
+        return u, v, w, p, t, nt, gm, _tm.metrics_pack(
+            res, it, dtv, um, vm, wm, bad)
+
+    return chunk_fn_metrics if metrics else chunk_fn
+
+
+class Class3DSolver:
+    """The 3-D twin of ClassSolver: one 3-D shape class's
+    BatchedSolver-compatible template — (kc, jc, ic) power-of-two rungs,
+    per-lane (kmax, jmax, imax) as traced data over ragged3d's select
+    machinery, and the production fused PRE/POST kernels when
+    `tpu_fuse_phases` dispatches (`ns3d_class_phases`; the 3-D class
+    solve stays the masked jnp rb loop — PRE + POST per step,
+    launch-count test-pinned)."""
+
+    CHUNK = 32
+    _te_always = True
+
+    def __init__(self, param, ic: int, jc: int, kc: int, dtype=None):
+        import time as _time
+
+        import jax
+
+        from ..utils import telemetry as _tm
+        from ..utils.precision import resolve_dtype
+
+        reason = class_eligible(param)
+        if reason is not None:
+            raise ValueError(f"request is not class-eligible: {reason}")
+        if (class_extent(param.imax) > ic or class_extent(param.jmax) > jc
+                or class_extent(param.kmax) > kc):
+            raise ValueError(
+                f"grid {param.imax}x{param.jmax}x{param.kmax} exceeds "
+                f"class {ic}x{jc}x{kc}")
+        self.param = param.replace(imax=ic, jmax=jc, kmax=kc)
+        self._request = param
+        self.ic, self.jc, self.kc = ic, jc, kc
+        self.dtype = resolve_dtype(param.tpu_dtype) if dtype is None \
+            else dtype
+        self._backend = "auto"
+        self._fused = False
+        self._dt_scale = 1.0
+        self._metrics = _tm.enabled()
+        self._time_index = 4
+        self._n_fields = 4
+        t0 = _time.perf_counter()
+        self._chunk_fn = jax.jit(self._build_chunk())
+        from ..utils import dispatch as _dispatch
+
+        _tm.emit("build", family="ns3d_class",
+                 grid=[kc, jc, ic], cls=f"{ic}x{jc}x{kc}",
+                 trace_wall_s=round(_time.perf_counter() - t0, 3),
+                 phases=_dispatch.last("ns3d_class_phases"))
+
+    def _uses_pallas(self) -> bool:
+        return self._fused
+
+    def _build_chunk(self, backend: str | None = None,
+                     te_arg: bool = True):
+        from ..ops.ns3d_fused import probe_fused_3d
+        from ..utils.dispatch import record, resolve_fuse_phases
+
+        backend = self._backend if backend is None else backend
+        self._metrics = _metrics_enabled()
+        fused = None
+        if resolve_fuse_phases(
+            self.param, backend, self.dtype, probe_fused_3d,
+            "ns3d_class_phases",
+        ):
+            from ..ops import ns3d_fused as nf3
+
+            try:
+                pre, pad3, unpad3, _h = nf3.make_fused_pre_3d(
+                    self.param, self.kc, self.jc, self.ic,
+                    1.0, 1.0, 1.0, self.dtype, dynamic=True)
+                post, _p, _u, _h2 = nf3.make_fused_post_3d(
+                    self.param, self.kc, self.jc, self.ic,
+                    1.0, 1.0, 1.0, self.dtype, ragged=True, dynamic=True)
+                fused = (pre, post, pad3, unpad3)
+            except ValueError as exc:  # VMEM-infeasible geometry
+                record("ns3d_class_phases", f"jnp ({exc})")
+                fused = None
+        self._fused = fused is not None
+        return make_class_chunk_3d(self.param, self.kc, self.jc, self.ic,
+                                   self.dtype, metrics=self._metrics,
+                                   chunk_default=self.CHUNK, fused=fused)
+
+    def _rebuild_chunk(self):
+        import jax
+
+        self._chunk_fn = jax.jit(self._build_chunk(backend=self._backend))
+        return self._chunk_fn
+
+    # -- per-lane state (the BatchedSolver template hooks) --------------
+    def lane_state(self, param) -> tuple:
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils import telemetry as _tm
+
+        reason = class_eligible(param)
+        if reason is not None:
+            raise ValueError(f"request is not class-eligible: {reason}")
+        kc, jc, ic = self.kc, self.jc, self.ic
+        if param.imax > ic or param.jmax > jc or param.kmax > kc:
+            # the __init__ guard, repeated per lane (the swap_lane path)
+            raise ValueError(
+                f"grid {param.imax}x{param.jmax}x{param.kmax} exceeds "
+                f"class {ic}x{jc}x{kc}")
+        live = ((np.arange(kc + 2)[:, None, None] <= param.kmax + 1)
+                & (np.arange(jc + 2)[None, :, None] <= param.jmax + 1)
+                & (np.arange(ic + 2)[None, None, :] <= param.imax + 1))
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 \
+            else jnp.float32
+
+        def field(init):
+            return jnp.asarray(np.where(live, init, 0.0), self.dtype)
+
+        gm = jnp.asarray(lane_geometry_3d(param), time_dtype)
+        out = (field(param.u_init), field(param.v_init),
+               field(param.w_init), field(param.p_init),
+               jnp.asarray(0.0, time_dtype), jnp.asarray(0, jnp.int32),
+               gm)
+        if self._metrics:
+            out = out + (_tm.metrics_init(),)
+        return out
+
+    def crop_lane(self, fields, param) -> tuple:
+        """Unpad one lane's published fields back to the request's own
+        (kmax+2, jmax+2, imax+2) reference layout."""
+        return tuple(
+            np.asarray(f)[:param.kmax + 2, :param.jmax + 2,
+                          :param.imax + 2]
+            for f in fields)
+
+    def initial_state(self) -> tuple:
+        return self.lane_state(self._request)

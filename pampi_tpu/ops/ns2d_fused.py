@@ -254,7 +254,14 @@ def _pre_kernel(
     prof_dtype,
     masked: bool,
     bands: tuple | None = None,
+    dynamic: bool = False,
 ):
+    if dynamic:
+        # shape-class mode (fleet/shapeclass.py): the live extents and the
+        # per-lane cell sizes arrive as SMEM scalars after dt, so one
+        # compiled kernel at the padded CLASS geometry serves every lane
+        # (every write below is already gated by the SAME comparisons)
+        ext_ref, geo_ref, *refs = refs
     if masked:
         (u_in, v_in, flg, u_out, v_out, f_out, g_out, r_out,
          uw2, vw2, fw2, ob2, ld_sem, st_sem) = refs
@@ -270,6 +277,14 @@ def _pre_kernel(
     joff = sref[0]
     ioff = sref[1]
     dt = dt_ref[0, 0]
+    if dynamic:
+        # single-device class lanes: local extents == global extents
+        gjmax = ext_ref[0, 0]
+        gimax = ext_ref[0, 1]
+        ljmax = gjmax
+        limax = gimax
+        dx = geo_ref[0, 0]
+        dy = geo_ref[0, 1]
 
     # banded (grid-restricted) sweeps (`tpu_overlap_restrict`,
     # parallel/overlap.region_plan): grid step k of band (s, n) covers
@@ -422,13 +437,17 @@ def _post_kernel(
     dy: float,
     masked: bool,
     ragged: bool,
+    dynamic: bool = False,
 ):
     """adaptUV + the CFL max|u|/max|v| reduction. u/v/f/g ride as owned
     bands (adaptUV reads them at the center only); p (and the flag, whose
     v_face needs one north row) ride as halo windows. The maxes scan every
     cell of the global extended array exactly once across blocks — the
     maxElement ghost-inclusive quirk — masked to the valid region so dist
-    callers' stale deep-halo rows never leak in."""
+    callers' stale deep-halo rows never leak in. `dynamic` as in
+    _pre_kernel: extents/cell sizes as SMEM scalars (shape-class mode)."""
+    if dynamic:
+        ext_ref, geo_ref, *refs = refs
     if masked:
         (ub, vb, fb, gb, p_in, flg, u_out, v_out, umax, vmax,
          bw2, pw2, fw2, ob2, macc, ld_sem, st_sem) = refs
@@ -444,6 +463,11 @@ def _post_kernel(
     joff = sref[0]
     ioff = sref[1]
     dt = dt_ref[0, 0]
+    if dynamic:
+        gjmax = ext_ref[0, 0]
+        gimax = ext_ref[0, 1]
+        dx = geo_ref[0, 0]
+        dy = geo_ref[0, 1]
 
     def load(k, s):
         copies = [
@@ -671,6 +695,7 @@ def make_fused_pre_2d(
     block_rows: int | None = None,
     interpret: bool | None = None,
     grid_bands: tuple | None = None,
+    dynamic: bool = False,
 ):
     """Build the PRE kernel for one grid/shard geometry:
       pre(offs_i32[2], dt_11, u_pad, v_pad) -> (u', v', f, g, rhs)  [padded]
@@ -688,7 +713,18 @@ def make_fused_pre_2d(
     the grid-restricted overlap halves. Outputs outside the bands are
     never stored (the interior-merge mask must not select them); the
     layout, call signature and every stored value inside the bands are
-    identical to the full sweep's (the kernel stays globally gated)."""
+    identical to the full sweep's (the kernel stays globally gated).
+
+    `dynamic=True` (the shape-class chunk, fleet/shapeclass.py): gjmax/
+    gimax set only the padded CLASS geometry — the live extents and the
+    per-lane cell sizes become call-time SMEM scalars, so the call grows
+    two operands: pre(offs, ext_i32_12, geo_12, dt11, u_pad, v_pad) with
+    ext = (jmax, imax) and geo = (dx, dy). Single-device only
+    (incompatible with fluid/grid_bands — class-ineligible modes)."""
+    if dynamic and (fluid is not None or grid_bands is not None):
+        raise ValueError(
+            "dynamic extents are the single-device shape-class mode "
+            "(no obstacle flags, no grid bands)")
     (interpret, ljmax, limax, h, block_rows, wp, nblocks, rp, masked,
      prof_dtype, _pad, _unpad, flg_padded) = _geom(
         param, gjmax, gimax, dtype, jl, il, ext_pad, fluid, prof_dtype,
@@ -722,6 +758,7 @@ def make_fused_pre_2d(
         ylength=param.ylength,
         prof_dtype=prof_dtype,
         masked=masked,
+        dynamic=dynamic,
     )
     n_in = 3 if masked else 2
     pre_scratch = [
@@ -741,6 +778,7 @@ def make_fused_pre_2d(
             num_scalar_prefetch=1,
             grid=(nblocks,),
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            * (3 if dynamic else 1)
             + [pl.BlockSpec(memory_space=pl.ANY)] * n_in,
             out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
             scratch_shapes=pre_scratch,
@@ -752,7 +790,11 @@ def make_fused_pre_2d(
         interpret=interpret,
     )
 
-    if masked and flg_padded is None:
+    if dynamic:
+
+        def pre(offs, ext, geo, dt11, u_pad, v_pad):
+            return pre_call(offs, dt11, ext, geo, u_pad, v_pad)
+    elif masked and flg_padded is None:
 
         def pre(offs, dt11, u_pad, v_pad, flg_pad):
             return pre_call(offs, dt11, u_pad, v_pad, flg_pad)
@@ -783,6 +825,7 @@ def make_fused_post_2d(
     ragged: bool = False,
     block_rows: int | None = None,
     interpret: bool | None = None,
+    dynamic: bool = False,
 ):
     """Build the POST kernel (same geometry contract as make_fused_pre_2d):
       post(offs_i32[2], dt_11, u_pad, v_pad, f_pad, g_pad, p_pad)
@@ -791,7 +834,13 @@ def make_fused_post_2d(
     adaptUV reads only center/+1 values, all inside the exchanged halo-1
     ring. fluid=True appends a call-time flag argument (the padded
     per-shard EXTENDED-block slice of the global flag); ragged=True
-    appends the dead-cell live-mask multiply after the projection."""
+    appends the dead-cell live-mask multiply after the projection.
+    `dynamic=True` as in make_fused_pre_2d: the call becomes
+    post(offs, ext, geo, dt11, u, v, f, g, p) with extent-gated masks."""
+    if dynamic and fluid is not None:
+        raise ValueError(
+            "dynamic extents are the single-device shape-class mode "
+            "(no obstacle flags)")
     (interpret, ljmax, limax, h, block_rows, wp, nblocks, rp, masked,
      _prof_dtype, _pad, _unpad, flg_padded) = _geom(
         param, gjmax, gimax, dtype, jl, il, ext_pad, fluid, None,
@@ -810,6 +859,7 @@ def make_fused_post_2d(
         dy=dy,
         masked=masked,
         ragged=ragged,
+        dynamic=dynamic,
     )
     n_in_post = 6 if masked else 5
     post_scratch = [
@@ -830,6 +880,7 @@ def make_fused_post_2d(
             num_scalar_prefetch=1,
             grid=(nblocks,),
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            * (3 if dynamic else 1)
             + [pl.BlockSpec(memory_space=pl.ANY)] * n_in_post,
             out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2
             + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2,
@@ -843,7 +894,14 @@ def make_fused_post_2d(
         interpret=interpret,
     )
 
-    if masked and flg_padded is None:
+    if dynamic:
+
+        def post(offs, ext, geo, dt11, u_pad, v_pad, f_pad, g_pad, p_pad):
+            u_pad, v_pad, um, vm = post_call(
+                offs, dt11, ext, geo, u_pad, v_pad, f_pad, g_pad, p_pad
+            )
+            return u_pad, v_pad, um[0, 0], vm[0, 0]
+    elif masked and flg_padded is None:
 
         def post(offs, dt11, u_pad, v_pad, f_pad, g_pad, p_pad, flg_pad):
             u_pad, v_pad, um, vm = post_call(

@@ -234,7 +234,12 @@ def _pre3_kernel(
     dz: float,
     masked: bool,
     bands: tuple | None = None,
+    dynamic: bool = False,
 ):
+    if dynamic:
+        # shape-class mode (the 2-D _pre_kernel contract): live extents
+        # and per-lane cell sizes as SMEM scalars after dt
+        ext_ref, geo_ref, *refs = refs
     if masked:
         (u_in, v_in, w_in, flg, u_out, v_out, w_out, f_out, g_out, h_out,
          r_out, uw2, vw2, ww2, fw2, ob2, ld_sem, st_sem) = refs
@@ -251,6 +256,15 @@ def _pre3_kernel(
     joff = sref[1]
     ioff = sref[2]
     dt = dt_ref[0, 0]
+    if dynamic:
+        # single-device class lanes: local extents == global extents
+        gkmax = ext_ref[0, 0]
+        gjmax = ext_ref[0, 1]
+        gimax = ext_ref[0, 2]
+        lkmax, ljmax, limax = gkmax, gjmax, gimax
+        dx = geo_ref[0, 0]
+        dy = geo_ref[0, 1]
+        dz = geo_ref[0, 2]
 
     # banded (grid-restricted) sweeps over the leading k axis — the 3-D
     # twin of the ns2d_fused band mapping (`tpu_overlap_restrict`); the
@@ -415,7 +429,10 @@ def _post3_kernel(
     dz: float,
     masked: bool,
     ragged: bool,
+    dynamic: bool = False,
 ):
+    if dynamic:
+        ext_ref, geo_ref, *refs = refs
     if masked:
         (ub, vb, wb, fb, gb, hb, p_in, flg,
          u_out, v_out, w_out, umax, vmax, wmax,
@@ -434,6 +451,13 @@ def _post3_kernel(
     joff = sref[1]
     ioff = sref[2]
     dt = dt_ref[0, 0]
+    if dynamic:
+        gkmax = ext_ref[0, 0]
+        gjmax = ext_ref[0, 1]
+        gimax = ext_ref[0, 2]
+        dx = geo_ref[0, 0]
+        dy = geo_ref[0, 1]
+        dz = geo_ref[0, 2]
 
     def load(k, s):
         copies = [
@@ -680,6 +704,7 @@ def make_fused_pre_3d(
     block_k: int | None = None,
     interpret: bool | None = None,
     grid_bands: tuple | None = None,
+    dynamic: bool = False,
 ):
     """Build the 3-D PRE kernel:
       pre(offs_i32[3], dt_11, u_pad, v_pad, w_pad)
@@ -689,7 +714,14 @@ def make_fused_pre_3d(
     (the padded per-shard deep-halo slice of the global flag).
     `grid_bands` restricts the Pallas grid to k-plane bands of the same
     padded layout (see make_fused_pre_2d — the grid-restricted overlap
-    halves)."""
+    halves). `dynamic=True` (the 3-D shape-class chunk): extents/cell
+    sizes as call-time SMEM scalars — the call becomes
+    pre(offs, ext_i32_13, geo_13, dt11, u, v, w) with ext =
+    (kmax, jmax, imax) and geo = (dx, dy, dz); single-device only."""
+    if dynamic and (fluid is not None or grid_bands is not None):
+        raise ValueError(
+            "dynamic extents are the single-device shape-class mode "
+            "(no obstacle flags, no grid bands)")
     (interpret, lkmax, ljmax, limax, h, block_k, jp, ip, nblocks, kp,
      masked, pad3, unpad3, flg_padded) = _geom3(
         gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, fluid, block_k,
@@ -728,6 +760,7 @@ def make_fused_pre_3d(
         dy=dy,
         dz=dz,
         masked=masked,
+        dynamic=dynamic,
     )
     n_in = 4 if masked else 3
     pre_scratch = [
@@ -748,6 +781,7 @@ def make_fused_pre_3d(
             num_scalar_prefetch=1,
             grid=(nblocks,),
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            * (3 if dynamic else 1)
             + [pl.BlockSpec(memory_space=pl.ANY)] * n_in,
             out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 7,
             scratch_shapes=pre_scratch,
@@ -757,7 +791,11 @@ def make_fused_pre_3d(
         interpret=interpret,
     )
 
-    if masked and flg_padded is None:
+    if dynamic:
+
+        def pre(offs, ext, geo, dt11, u_pad, v_pad, w_pad):
+            return call(offs, dt11, ext, geo, u_pad, v_pad, w_pad)
+    elif masked and flg_padded is None:
 
         def pre(offs, dt11, u_pad, v_pad, w_pad, flg_pad):
             return call(offs, dt11, u_pad, v_pad, w_pad, flg_pad)
@@ -791,13 +829,20 @@ def make_fused_post_3d(
     ragged: bool = False,
     block_k: int | None = None,
     interpret: bool | None = None,
+    dynamic: bool = False,
 ):
     """Build the 3-D POST kernel:
       post(offs_i32[3], dt_11, u, v, w, f, g, h, p)  [all padded]
           -> (u'', v'', w'', umax, vmax, wmax).
     fluid=True appends a call-time flag argument (the padded per-shard
     EXTENDED-block slice of the global flag); ragged=True appends the
-    dead-cell live-mask multiply after the projection."""
+    dead-cell live-mask multiply after the projection. `dynamic=True`
+    as in make_fused_pre_3d: post(offs, ext, geo, dt11, u, v, w, f, g,
+    h, p) with extent-gated masks."""
+    if dynamic and fluid is not None:
+        raise ValueError(
+            "dynamic extents are the single-device shape-class mode "
+            "(no obstacle flags)")
     (interpret, lkmax, ljmax, limax, h, block_k, jp, ip, nblocks, kp,
      masked, pad3, unpad3, flg_padded) = _geom3(
         gkmax, gjmax, gimax, dtype, kl, jl, il, ext_pad, fluid, block_k,
@@ -817,6 +862,7 @@ def make_fused_post_3d(
         dz=dz,
         masked=masked,
         ragged=ragged,
+        dynamic=dynamic,
     )
     n_in_post = 8 if masked else 7
     post_scratch = [
@@ -837,6 +883,7 @@ def make_fused_post_3d(
             num_scalar_prefetch=1,
             grid=(nblocks,),
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            * (3 if dynamic else 1)
             + [pl.BlockSpec(memory_space=pl.ANY)] * n_in_post,
             out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3
             + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 3,
@@ -848,7 +895,16 @@ def make_fused_post_3d(
         interpret=interpret,
     )
 
-    if masked and flg_padded is None:
+    if dynamic:
+
+        def post(offs, ext, geo, dt11, u_pad, v_pad, w_pad, f_pad, g_pad,
+                 h_pad, p_pad):
+            u_pad, v_pad, w_pad, um, vm, wm = call(
+                offs, dt11, ext, geo, u_pad, v_pad, w_pad, f_pad, g_pad,
+                h_pad, p_pad
+            )
+            return u_pad, v_pad, w_pad, um[0, 0], vm[0, 0], wm[0, 0]
+    elif masked and flg_padded is None:
 
         def post(offs, dt11, u_pad, v_pad, w_pad, f_pad, g_pad, h_pad,
                  p_pad, flg_pad):

@@ -240,6 +240,7 @@ def _tblock_kernel(
     idx2: float,
     idy2: float,
     masked: bool,
+    dynamic: bool = False,
 ):
     """`n_inner` FULL red-black iterations (each incl. the Neumann ghost
     refresh) in a single HBM sweep — temporal blocking.
@@ -268,8 +269,21 @@ def _tblock_kernel(
     owned band), so a convergence loop stepping this kernel observes the
     residual of its final iteration — the same value a per-iteration loop
     would see at that count.
+
+    dynamic=True is the SHAPE-CLASS mode (fleet/shapeclass.py): the live
+    extents and the grid-derived update constants arrive as SMEM scalars
+    (ext int32 (1,2) = (jmax, imax); geo (1,3) = (factor, idx2, idy2))
+    instead of trace constants, so one compiled kernel at the padded
+    CLASS geometry serves every lane — the interior/parity/ghost masks
+    are extent-gated per call and cells beyond the live extent pass
+    through untouched (where-selects, never multiplies, so garbage
+    there cannot reach any stored value or the residual).
     """
-    if masked:
+    if dynamic:
+        (p_in, rhs, ext_ref, geo_ref, p_out, res,
+         pw2, rw2, ob2, vacc, ld_sem, st_sem) = refs
+        flg = fw2 = None
+    elif masked:
         (p_in, rhs, flg, p_out, res,
          pw2, rw2, fw2, ob2, vacc, ld_sem, st_sem) = refs
     else:
@@ -323,17 +337,24 @@ def _tblock_kernel(
     p = pw2[slot]
     rw = rw2[slot]
 
-    # logical (j, i) of window cell (w, c): j = b*br + w - h, i = c
+    # logical (j, i) of window cell (w, c): j = b*br + w - h, i = c.
+    # dynamic mode reads the live extents from SMEM (the static path's
+    # `width - 2` IS its imax, so the two forms are the same masks)
+    if dynamic:
+        jmax = ext_ref[0, 0]
+        imax_d = ext_ref[0, 1]
+    else:
+        imax_d = width - 2
     jj = b * br - h + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
     ii = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
-    interior = (jj >= 1) & (jj <= jmax) & (ii >= 1) & (ii <= width - 2)
+    interior = (jj >= 1) & (jj <= jmax) & (ii >= 1) & (ii <= imax_d)
     red = interior & (((ii + jj) % 2) == 0)
     black = interior & (((ii + jj) % 2) == 1)
-    row_ghost_lo = (jj == 0) & (ii >= 1) & (ii <= width - 2)
-    row_ghost_hi = (jj == jmax + 1) & (ii >= 1) & (ii <= width - 2)
+    row_ghost_lo = (jj == 0) & (ii >= 1) & (ii <= imax_d)
+    row_ghost_hi = (jj == jmax + 1) & (ii >= 1) & (ii <= imax_d)
     row_int = (jj >= 1) & (jj <= jmax)
     col_ghost_lo = (ii == 0) & row_int
-    col_ghost_hi = (ii == width - 1) & row_int
+    col_ghost_hi = (ii == imax_d + 1) & row_int
 
     if masked:
         # per-block constants (flags don't change across inner iterations):
@@ -344,7 +365,15 @@ def _tblock_kernel(
         black = black & (fl != 0)
         fac, lap = masked_stencil_ops(fl, idx2, idy2, omega)
     else:
-        fac = factor
+        if dynamic:
+            # per-lane update constants (computed host-side in Python f64
+            # with the solo solver's own expressions — the shape-class
+            # bitwise-coefficient contract)
+            fac = geo_ref[0, 0]
+            idx2 = geo_ref[0, 1]
+            idy2 = geo_ref[0, 2]
+        else:
+            fac = factor
 
         def lap(x):
             east = jnp.roll(x, -1, axis=1)
@@ -510,6 +539,7 @@ def make_rb_iter_tblock(
     block_rows: int | None = None,
     interpret: bool | None = None,
     fluid=None,
+    dynamic: bool = False,
 ):
     """Temporal-blocked fused kernel (see `_tblock_kernel`): builds
     `(p_padded, rhs_padded) -> (p_padded', res_sumsq_of_last_iter)` where one
@@ -520,9 +550,18 @@ def make_rb_iter_tblock(
     fluid: optional (jmax+2, imax+2) 0/1 flag field (ops/obstacle.py) —
     switches to the obstacle stencil (per-direction fluid coefficients,
     per-cell factor); the padded flag array is baked into the returned
-    closure as a constant."""
+    closure as a constant.
+
+    dynamic=True (the shape-class padded-layout solve): imax/jmax set the
+    padded CLASS geometry only; the live extents and update constants are
+    call-time SMEM scalars, so rb_iter becomes
+    `(p_padded, rhs_padded, ext_i32_12, geo_13) -> (p', res_sumsq)` with
+    ext = (jmax, imax) and geo = (factor, idx2, idy2). Incompatible with
+    `fluid` (obstacle lanes are class-ineligible)."""
     if pltpu is None:
         return None, 0, 0
+    if dynamic and fluid is not None:
+        raise ValueError("dynamic extents and obstacle flags are exclusive")
     h = tblock_halo(n_inner, dtype)
     if block_rows is None:
         block_rows = pick_block_rows_tblock(jmax, imax, dtype, n_inner)
@@ -558,9 +597,10 @@ def make_rb_iter_tblock(
         idx2=1.0 / dx2,
         idy2=1.0 / dy2,
         masked=masked,
+        dynamic=dynamic,
     )
 
-    n_in = 3 if masked else 2
+    n_any = 3 if masked else 2  # DMA'd HBM operands (sem count)
     scratch = [
         pltpu.VMEM((2, block_rows + 2 * h, wp), dtype),
         pltpu.VMEM((2, block_rows + 2 * h, wp), dtype),
@@ -570,13 +610,17 @@ def make_rb_iter_tblock(
     scratch += [
         pltpu.VMEM((2, block_rows, wp), dtype),
         pltpu.VMEM((1, wp), dtype),  # per-lane residual accumulator
-        pltpu.SemaphoreType.DMA((2, n_in)),
+        pltpu.SemaphoreType.DMA((2, n_any)),
         pltpu.SemaphoreType.DMA((2,)),
     ]
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * n_any
+    if dynamic:
+        # the per-lane extent/constant scalars ride SMEM after the arrays
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
     call = pl.pallas_call(
         kernel,
         grid=(nblocks,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_in,
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((1, 1), lambda b: (0, 0), memory_space=pltpu.SMEM),
@@ -592,7 +636,12 @@ def make_rb_iter_tblock(
         interpret=interpret,
     )
 
-    if masked:
+    if dynamic:
+
+        def rb_iter(p_padded, rhs_padded, ext, geo):
+            p_padded, res = call(p_padded, rhs_padded, ext, geo)
+            return p_padded, res[0, 0]
+    elif masked:
         flg_padded = pad_array(jnp.asarray(fluid, dtype), block_rows, h)
 
         def rb_iter(p_padded, rhs_padded):

@@ -39,11 +39,18 @@ def live_masks_3d(comm: CartComm, kl, jl, il, kmax, jmax, imax, dtype):
 
 
 def set_bcs_3d_ragged(u, v, w, bcs, comm: CartComm, kl, jl, il,
-                      kmax, jmax, imax):
+                      kmax, jmax, imax, grids=None):
     """set_boundary_conditions_3d as global-index selects; same face
     iteration order and staggered positions (wall normal at g == gmax on HI
-    faces, tangential ghosts at g == gmax+1; both at 0 on LO faces)."""
-    g = global_index_grids(comm, kl, jl, il)
+    faces, tangential ghosts at g == gmax+1; both at 0 on LO faces).
+
+    `grids` (the (gk, gj, gi) index grids) is the ragged2d.set_bcs_ragged
+    hook: callers OUTSIDE shard_map — the fleet's 3-D shape-class chunk,
+    which runs this chain on one full padded block with TRACED
+    kmax/jmax/imax — supply precomputed offset-0 vectors instead of the
+    shard-offset lookup."""
+    g = (global_index_grids(comm, kl, jl, il)
+         if grids is None else grids)
     gmaxes = (kmax, jmax, imax)
     fields = {0: w, 1: v, 2: u}
 
@@ -91,11 +98,13 @@ def set_bcs_3d_ragged(u, v, w, bcs, comm: CartComm, kl, jl, il,
 
 
 def set_special_bc_3d_ragged(u, problem, comm: CartComm, kl, jl, il,
-                             kmax, jmax, imax):
+                             kmax, jmax, imax, grids=None):
     """setSpecialBoundaryCondition (solver.c:579-602) masked by global
     index, replicating the reference's dcavity loop-bound quirk (skips the
-    last interior i and k)."""
-    gk, gj, gi = global_index_grids(comm, kl, jl, il)
+    last interior i and k). `grids` as in set_bcs_3d_ragged (offset-0
+    callers)."""
+    gk, gj, gi = (global_index_grids(comm, kl, jl, il)
+                  if grids is None else grids)
     if problem == "dcavity":
         m = (
             (gj == jmax + 1)
@@ -114,10 +123,12 @@ def set_special_bc_3d_ragged(u, problem, comm: CartComm, kl, jl, il,
 
 
 def fgh_fixups_ragged(f, g_, h, u, v, w, comm: CartComm, kl, jl, il,
-                      kmax, jmax, imax):
+                      kmax, jmax, imax, grids=None):
     """F/G/H wall fixups (solver.c:771-823): same-position copies from
-    u/v/w on both walls of each axis, tangentially clipped."""
-    gk, gj, gi = global_index_grids(comm, kl, jl, il)
+    u/v/w on both walls of each axis, tangentially clipped. `grids` as in
+    set_bcs_3d_ragged (offset-0 callers)."""
+    gk, gj, gi = (global_index_grids(comm, kl, jl, il)
+                  if grids is None else grids)
     tan_ji = (gj >= 1) & (gj <= jmax) & (gi >= 1) & (gi <= imax)
     tan_ki = (gk >= 1) & (gk <= kmax) & (gi >= 1) & (gi <= imax)
     tan_kj = (gk >= 1) & (gk <= kmax) & (gj >= 1) & (gj <= jmax)

@@ -191,6 +191,22 @@ def resolve_overlap_restrict(param, key: str, plan,
     return False
 
 
+def resolve_class(key: str, grid, why_not: str | None) -> bool:
+    """Shape-class eligibility of ONE request, recorded per bucket like
+    `tpu_overlap`/`fleet_<bucket>` (ISSUE 15 satellite): `key` is
+    `class_<bucket>` — the CLASS bucket's label when eligible, the
+    exact-shape bucket's when not — `grid` the padded class rungs, and
+    `why_not` the `fleet/shapeclass.class_eligible` refusal string. A
+    tenant silently landing on the exact-shape bucket is then visible in
+    the dispatch snapshot and the telemetry report. Returns whether the
+    request rides a class bucket."""
+    if why_not is not None:
+        record(key, f"exact ({why_not})")
+        return False
+    record(key, f"class (padded {'x'.join(str(g) for g in grid)})")
+    return True
+
+
 def resolve_fleet(param, n_scenarios: int, dist: bool, key: str) -> str:
     """`tpu_fleet` -> how the fleet scheduler executes one bucket of
     same-signature scenario requests (pampi_tpu/fleet/scheduler.py).

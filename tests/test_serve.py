@@ -79,11 +79,28 @@ def test_class_eligibility_reasons():
     assert "obstacle" in sc.class_eligible(
         p.replace(obstacles="0.3,0.3,0.6,0.6"))
     assert "tpu_solver" in sc.class_eligible(p.replace(tpu_solver="fft"))
+    assert "tpu_sor_layout" in sc.class_eligible(
+        p.replace(tpu_sor_layout="quarters"))
     assert "floor" in sc.class_eligible(p.replace(imax=4))
     assert "forced" in sc.class_eligible(p.replace(tpu_fleet="solo"))
+    # 3-D families are ELIGIBLE since serving v3 (their own rungs); the
+    # floor checks kmax too, and dist lanes still keep their exact bucket
     p3 = Parameter(name="dcavity3d", imax=8, jmax=8, kmax=8,
-                   seen_keys=("kmax",))
-    assert "3-D" in sc.class_eligible(p3)
+                   tpu_mesh="1", seen_keys=("kmax",))
+    assert sc.class_eligible(p3) is None
+    assert "floor" in sc.class_eligible(p3.replace(kmax=4))
+    assert "distributed" in sc.class_eligible(p3.replace(tpu_mesh="auto"))
+
+
+def test_lane_state_refuses_oversized_grid():
+    # the swap-lane path feeds requests straight into lane_state: an
+    # eligible grid that exceeds the class rungs must refuse loudly
+    # (the __init__ guard, per lane) instead of silently saturating the
+    # live mask and cropping a wrong-shaped result
+    p = Parameter(**_B)
+    tpl = ClassSolver(p, ic=16, jc=16)
+    with pytest.raises(ValueError, match="exceeds class"):
+        tpl.lane_state(p.replace(imax=20, jmax=20))
 
 
 def test_class_bucket_routing():
@@ -145,6 +162,196 @@ def test_padded_class_lane_canal_bcs():
     solo.run(progress=False)
     assert res["nt"] == solo.nt > 0
     _assert_lane(res["fields"], solo)
+
+
+# -- the fused class chunk (ISSUE 15): production kernels per lane ------
+
+_BF = dict(_B, tpu_fuse_phases="on", tpu_solver="sor",
+           tpu_sor_layout="checkerboard")
+_B3 = dict(name="dcavity3d", imax=8, jmax=8, kmax=8, re=10.0, te=0.02,
+           tau=0.5, itermax=8, eps=1e-4, omg=1.7, gamma=0.9,
+           tpu_mesh="1", seen_keys=("kmax",))
+
+
+def test_class_3d_selection_and_routing():
+    p3 = Parameter(**_B3)
+    assert sc.class_grid((8, 10, 9)) == (16, 16, 16)
+    reqs = [
+        fleet.ScenarioRequest("a", p3),
+        fleet.ScenarioRequest("b", p3.replace(imax=10, jmax=9)),
+        fleet.ScenarioRequest("c", Parameter(**_B)),  # 2-D rides its own
+    ]
+    classed = fleet.bucket(reqs, classes=True)
+    assert len(classed) == 2  # one 3-D 16³ class + one 2-D 16² class
+    k3 = next(k for k in classed if k.family == "ns3d")
+    assert k3.grid == (16, 16, 16) and k3.sig.startswith("cls")
+    assert [r.sid for r in classed[k3]] == ["a", "b"]
+
+
+def test_class_eligibility_recorded_per_request():
+    from pampi_tpu.utils import dispatch
+
+    p = Parameter(**_B)
+    ineligible = p.replace(tpu_solver="fft")
+    reqs = [fleet.ScenarioRequest("good", p),
+            fleet.ScenarioRequest("bad", ineligible)]
+    buckets = fleet.bucket(reqs, classes=True)
+    assert len(buckets) == 2
+    exact = next(k for k in buckets if not k.sig.startswith("cls"))
+    cls = next(k for k in buckets if k.sig.startswith("cls"))
+    # the refusal reason rides the dispatch snapshot under the exact
+    # bucket the request silently landed on (the tpu_overlap convention)
+    assert "fft" in dispatch.last(f"class_{exact.label}")
+    assert dispatch.last(f"class_{cls.label}").startswith("class (padded")
+
+
+def test_fused_class_chunk_launch_count():
+    # the launch-count pin: the fused class chunk stays at PRE + solve +
+    # POST per step (2-D; the 3-D chunk is PRE + POST around the jnp
+    # class solve) — trace-only, the jaxprcheck matrix twin
+    from pampi_tpu.analysis.jaxprcheck import count_prim, trace_chunk
+    from pampi_tpu.fleet.shapeclass import Class3DSolver
+
+    p = Parameter(**_BF)
+    tpl = ClassSolver(p, ic=16, jc=16)
+    assert tpl._fused and tpl._uses_pallas()
+    b = fleet.BatchedSolver(tpl, [p], ["a"], family="ns2d_class")
+    assert count_prim(trace_chunk(b).jaxpr, "pallas_call") == 3
+    p3 = Parameter(**_B3, tpu_fuse_phases="on")
+    tpl3 = Class3DSolver(p3, ic=16, jc=16, kc=16)
+    assert tpl3._fused
+    b3 = fleet.BatchedSolver(tpl3, [p3], ["a"], family="ns3d_class")
+    assert count_prim(trace_chunk(b3).jaxpr, "pallas_call") == 2
+
+
+def test_padded_class_solve_matches_jnp_class_solve():
+    # the padded-class Pallas solve == the jnp class solve on the masked
+    # (live) cells — same per-cell update arithmetic, extent-gated
+    import jax
+    import jax.numpy as jnp
+
+    from pampi_tpu.fleet.shapeclass import (
+        _index_grids,
+        lane_geometry,
+        make_class_solve,
+        make_padded_class_solve,
+    )
+    from pampi_tpu.ops.sor_pallas import pad_array, unpad_array
+
+    p = Parameter(**{**_B, "tpu_sor_inner": 1, "itermax": 6,
+                     "eps": 1e-30})  # itermax-capped: both run 6 iters
+    jc = ic = 16
+    grids = _index_grids(jc, ic)
+    jnp_solve = make_class_solve(p, jc, ic, jnp.float64, grids)
+    pal_solve, br, h = make_padded_class_solve(p, jc, ic, jnp.float64)
+    rng = np.random.default_rng(7)
+    for jmax, imax in ((12, 12), (10, 14)):
+        gm = lane_geometry(p.replace(imax=imax, jmax=jmax))
+        live = ((np.arange(jc + 2)[:, None] <= jmax + 1)
+                & (np.arange(ic + 2)[None, :] <= imax + 1))
+        p0 = jnp.asarray(np.where(live, rng.normal(size=(jc + 2, ic + 2)),
+                                  0.0))
+        rhs = jnp.asarray(np.where(live,
+                                   rng.normal(size=(jc + 2, ic + 2)),
+                                   0.0))
+        args = [jnp.asarray(v) for v in gm]
+        pj, resj, itj = jax.jit(jnp_solve)(
+            p0, rhs, args[0], args[1], args[5], args[6], args[7],
+            args[8])
+        ext = jnp.asarray([[jmax, imax]], jnp.int32)
+        sgeo = jnp.asarray([[gm[5], gm[6], gm[7]]])
+        pp, resp, itp = jax.jit(pal_solve)(
+            pad_array(p0, br, h), pad_array(rhs, br, h), ext, sgeo,
+            jnp.asarray(gm[8]))
+        pp = unpad_array(pp, jc, ic, h)
+        assert int(itj) == int(itp) == 6
+        mask = np.asarray(live)
+        assert np.array_equal(np.asarray(pj)[mask], np.asarray(pp)[mask])
+        assert abs(float(resj) - float(resp)) <= 1e-12 * max(
+            1.0, abs(float(resj)))
+
+
+@pytest.mark.slow
+def test_fused_class_lanes_match_fused_solo_mixed_grids():
+    # ISSUE 15 acceptance: a padded lane on the PRODUCTION kernels
+    # (fused PRE + padded-class solve + POST) matches its exact-shape
+    # FUSED solo at the ulp contract — mixed grids in one batch
+    p = Parameter(**_BF)
+    p2 = p.replace(imax=14, jmax=10, u_init=0.02)
+    tpl = ClassSolver(p, ic=16, jc=16)
+    assert tpl._fused
+    batched = fleet.BatchedSolver(tpl, [p, p2], ["a", "b"],
+                                  family="ns2d_class")
+    results = batched.results(batched.run())
+    for lane_param, res in zip((p, p2), results):
+        solo = NS2DSolver(lane_param)
+        assert solo._fused  # the oracle is the fused solo, same kernels
+        solo.run(progress=False)
+        assert not res["diverged"]
+        assert res["nt"] == solo.nt and solo.nt > 0
+        _assert_lane(res["fields"], solo)
+    # the canal BC family rides the same fused class program (the
+    # inflow profile's dy is per-lane SMEM data in the PRE kernel)
+    pc = Parameter(**{**_BF, "name": "canal", "bcLeft": 3, "bcRight": 3,
+                      "imax": 14, "jmax": 9})
+    tplc = ClassSolver(pc, ic=16, jc=16)
+    assert tplc._fused
+    bc = fleet.BatchedSolver(tplc, [pc], ["k"], family="ns2d_class")
+    res = bc.results(bc.run())[0]
+    soloc = NS2DSolver(pc)
+    soloc.run(progress=False)
+    assert res["nt"] == soloc.nt > 0
+    _assert_lane(res["fields"], soloc)
+
+
+@pytest.mark.slow
+def test_fused_class_lane_3d_matches_fused_solo():
+    from pampi_tpu.fleet.shapeclass import Class3DSolver
+    from pampi_tpu.models.ns3d import NS3DSolver
+
+    p3 = Parameter(**_B3, tpu_fuse_phases="on")
+    p3b = p3.replace(imax=10, jmax=9, u_init=0.01)
+    tpl = Class3DSolver(p3, ic=16, jc=16, kc=16)
+    assert tpl._fused
+    batched = fleet.BatchedSolver(tpl, [p3, p3b], ["a", "b"],
+                                  family="ns3d_class")
+    results = batched.results(batched.run())
+    for lane_param, res in zip((p3, p3b), results):
+        solo = NS3DSolver(lane_param)
+        assert solo._fused
+        solo.run(progress=False)
+        assert res["nt"] == solo.nt > 0
+        assert res["fields"][0].shape == (lane_param.kmax + 2,
+                                          lane_param.jmax + 2,
+                                          lane_param.imax + 2)
+        for name, got in zip("uvwp", res["fields"]):
+            ref = np.asarray(getattr(solo, name))
+            d = np.abs(got - ref)
+            assert np.isfinite(d).all() and d.max() < ULP_TOL, \
+                (name, d.max())
+
+
+def test_class_3d_jnp_lanes_match_solo():
+    # the 3-D jnp class chain (the parity oracle) vs jnp solos
+    from pampi_tpu.fleet.shapeclass import Class3DSolver
+    from pampi_tpu.models.ns3d import NS3DSolver
+
+    p3 = Parameter(**_B3)
+    p3b = p3.replace(imax=10, jmax=9, u_init=0.01)
+    tpl = Class3DSolver(p3, ic=16, jc=16, kc=16)
+    assert not tpl._fused
+    batched = fleet.BatchedSolver(tpl, [p3, p3b], ["a", "b"],
+                                  family="ns3d_class")
+    results = batched.results(batched.run())
+    for lane_param, res in zip((p3, p3b), results):
+        solo = NS3DSolver(lane_param)
+        solo.run(progress=False)
+        assert res["nt"] == solo.nt > 0
+        for name, got in zip("uvwp", res["fields"]):
+            ref = np.asarray(getattr(solo, name))
+            d = np.abs(got - ref)
+            assert np.isfinite(d).all() and d.max() < ULP_TOL, \
+                (name, d.max())
 
 
 # -- per-lane te (the PR 9 follow-on regression) ------------------------

@@ -104,6 +104,12 @@ def build_series(points: list[dict]) -> dict:
 # render-only if its unit string drifts.
 NAME_DIRECTIONS = {"comm_hidden_fraction": True,
                    "fleet_scenarios_per_s": True,
+                   # the shape-class serving rate (serving v3,
+                   # tools/perf_fleet.py --classes): mixed-grid requests
+                   # through one class compile, warm, compile excluded —
+                   # the fused-vs-jnp class win is gated upward from the
+                   # first artifact
+                   "fleet_class_scenarios_per_s": True,
                    # hierarchical-exchange + grid-restriction metrics
                    # (ROADMAP item 3): DCN bytes are the slow-fabric
                    # traffic of a multi-slice pod — fewer is better;

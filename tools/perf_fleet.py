@@ -1,6 +1,6 @@
 """Fleet serving throughput: the `fleet_scenarios_per_s` headline.
 
-    python tools/perf_fleet.py [n_scenarios] [--merge ARTIFACT.json]
+    python tools/perf_fleet.py [n_scenarios] [--classes] [--merge A.json]
 
 Serves a bucket of N same-signature dcavity scenarios (a u_init
 parameter sweep — the canonical ensemble workload) twice through the
@@ -11,13 +11,22 @@ process sustains, not a compile benchmark. The cold wall is reported
 alongside (compile amortization is the fleet's whole point — both
 numbers belong in the artifact).
 
+`--classes` (ISSUE 15): the MIXED-GRID shape-class workload — N
+requests whose extents cycle within one power-of-two rung, served with
+`FleetScheduler(classes="on")` so they coalesce into a single class
+bucket (one compile; the fused class chunk wherever `tpu_fuse_phases`
+dispatches). The warm headline becomes `fleet_class_scenarios_per_s`
+(scenarios_per_s is computed from the run wall alone — compile excluded
+by construction), trend-gated HIGHER-IS-BETTER from the first artifact,
+so the fused-vs-jnp class win lands on the same gate as every other
+serving number.
+
 Sizes: 64² × 25 steps per scenario on TPU; 16² × a handful of steps
 off-TPU (trend data only, like every CPU wall in BENCH history). Prints
-one JSON line ({"metric": "fleet_scenarios_per_s", ...,
-"backend": <platform>}) and emits the same through the telemetry metric
-record; `--merge` folds it into a BENCH artifact whose normalized
-metrics list `tools/bench_trend.py` then gates HIGHER-IS-BETTER
-(NAME_DIRECTIONS pins the direction by name).
+one JSON line ({"metric": ..., "backend": <platform>}) and emits the
+same through the telemetry metric record; `--merge` folds it into a
+BENCH artifact whose normalized metrics list `tools/bench_trend.py`
+then gates (NAME_DIRECTIONS pins the direction by name).
 """
 
 from __future__ import annotations
@@ -39,16 +48,29 @@ from pampi_tpu.utils import telemetry  # noqa: E402
 from pampi_tpu.utils.params import Parameter  # noqa: E402
 
 
-def scenario_sweep(n: int):
+def scenario_sweep(n: int, classes: bool = False):
     on_tpu = jax.default_backend() == "tpu"
     grid = 64 if on_tpu else 16
     te = 0.05 if on_tpu else 0.02
     base = dict(name="dcavity", imax=grid, jmax=grid, re=10.0, te=te,
                 tau=0.5, itermax=10, eps=1e-4, omg=1.7, gamma=0.9,
                 tpu_mesh="1", tpu_dtype="float32" if on_tpu else "float64")
+    if not classes:
+        return [
+            ScenarioRequest(f"sweep{i:03d}",
+                            Parameter(**base, u_init=0.001 * i))
+            for i in range(n)
+        ]
+    # mixed GRIDS within one power-of-two rung: extents cycle below the
+    # class so every request is a different shape sharing ONE compile
+    lo = grid - grid // 4
     return [
-        ScenarioRequest(f"sweep{i:03d}",
-                        Parameter(**base, u_init=0.001 * i))
+        ScenarioRequest(
+            f"cls{i:03d}",
+            Parameter(**{**base,
+                         "imax": lo + (i % (grid - lo + 1)),
+                         "jmax": grid - (i % (grid - lo + 1))},
+                      u_init=0.001 * i))
         for i in range(n)
     ]
 
@@ -59,11 +81,16 @@ def main(argv: list[str]) -> int:
         i = argv.index("--merge")
         merge_to = argv[i + 1]
         argv = argv[:i] + argv[i + 2:]
+    classes = "--classes" in argv
+    if classes:
+        argv = [a for a in argv if a != "--classes"]
     n = int(argv[1]) if len(argv) > 1 else 8
-    telemetry.start_run(tool="perf_fleet", scenarios=n)
+    metric = ("fleet_class_scenarios_per_s" if classes
+              else "fleet_scenarios_per_s")
+    telemetry.start_run(tool="perf_fleet", scenarios=n, classes=classes)
 
-    sched = FleetScheduler()  # arms xlacache
-    reqs = scenario_sweep(n)
+    sched = FleetScheduler(classes="on" if classes else "off")  # + xlacache
+    reqs = scenario_sweep(n, classes=classes)
     for req in reqs:
         sched.submit(req)
     t0 = time.perf_counter()
@@ -79,7 +106,7 @@ def main(argv: list[str]) -> int:
 
     per_s = warm.summary["scenarios_per_s"]
     rec = {
-        "metric": "fleet_scenarios_per_s",
+        "metric": metric,
         "value": per_s,
         "unit": "scenarios/s",
         "backend": jax.default_backend(),
@@ -98,7 +125,8 @@ def main(argv: list[str]) -> int:
 
         from tools._artifact import write_merged
 
-        block = {"parsed_fleet": rec}
+        block = {"parsed_fleet_classes" if classes else "parsed_fleet":
+                 rec}
         if not os.path.exists(merge_to):
             # a fresh artifact needs the BENCH wrapper keys the schema
             # lint requires (merging into a driver-written artifact
@@ -106,7 +134,8 @@ def main(argv: list[str]) -> int:
             m = re.search(r"_r(\d+)", os.path.basename(merge_to))
             block.update(
                 n=int(m.group(1)) if m else 0,
-                cmd=f"python tools/perf_fleet.py {n}",
+                cmd=f"python tools/perf_fleet.py {n}"
+                    + (" --classes" if classes else ""),
                 rc=0,
                 tail=json.dumps(rec),
             )

@@ -4,17 +4,22 @@
     python tools/serve_smoke.py [outdir]
 
 Starts the daemon over a temp file-queue and submits a mixed queue that
-exercises every serving-v2 contract at once:
+exercises every serving contract at once:
 
-- FOUR distinct grids across TWO shape classes (12x12, 14x10, 10x12 ->
-  the 16x16 rung; 20x20 -> the 32x32 rung): the status endpoint's
-  per-class compile census must show AT MOST ONE compiled program per
-  shape class (the pad-and-mask shared-compile contract).
+- SIX distinct grids across THREE shape classes (12x12, 14x10, 10x12 ->
+  the 2-D 16x16 rung; 20x20 -> the 32x32 rung; 8^3 and 10x9x8 -> the
+  3-D 16^3 rung, serving v3): the status endpoint's per-class compile
+  census must show AT MOST ONE compiled program per shape class (the
+  pad-and-mask shared-compile contract — 3-D grids form their OWN
+  rungs, one compile each).
 - a 2-lane continuous pool under a 4-request class: at least one
   MID-RUN SWAP-IN (a queued scenario takes a finished/diverged lane's
   slot, zero retrace).
 - one DIVERGED lane (u_init nan — the in-band sentinel retires it, the
   swap plane reuses its slot, the divergence census names it).
+- one CLASS-INELIGIBLE request (tpu_solver fft): served through its
+  exact-shape bucket, with the refusal reason recorded in the dispatch
+  plane (`class_<bucket>` — ISSUE 15's visibility satellite).
 - one MALFORMED .par: parked with a structured `warning` telemetry
   record, the daemon survives (the hardened load_queue path).
 
@@ -53,6 +58,21 @@ u_init {u}
 tpu_mesh 1
 """
 
+PAR3 = """name dcavity3d
+imax {imax}
+jmax {jmax}
+kmax {kmax}
+re 10.0
+te 0.02
+tau 0.5
+itermax 8
+eps 0.0001
+omg 1.7
+gamma 0.9
+u_init {u}
+tpu_mesh 1
+"""
+
 
 def _write_queue(qdir: str) -> int:
     """Returns the number of WELL-FORMED requests written."""
@@ -66,6 +86,14 @@ def _write_queue(qdir: str) -> int:
         ("alice__c3.par", PAR.format(imax=12, jmax=12, te=0.05, u=0.02)),
         # the 32x32 shape class
         ("bob__wide.par", PAR.format(imax=20, jmax=20, te=0.03, u=0.0)),
+        # the 3-D 16^3 shape class (serving v3): two distinct 3-D grids
+        # must form their OWN class rung -> one compile for both
+        ("dana__cube.par", PAR3.format(imax=8, jmax=8, kmax=8, u=0.0)),
+        ("dana__slab.par", PAR3.format(imax=10, jmax=9, kmax=8, u=0.01)),
+        # a class-INELIGIBLE request: fft solve -> exact-shape bucket,
+        # refusal reason recorded under class_<bucket> (ISSUE 15)
+        ("carol__fft.par", PAR.format(imax=12, jmax=12, te=0.03, u=0.0)
+         + "tpu_solver fft\n"),
     ]
     for name, text in reqs:
         with open(os.path.join(qdir, name), "w") as fh:
@@ -115,10 +143,15 @@ def main(argv: list[str]) -> int:
     if st["swaps"] < 1:
         failures.append("no mid-run lane swap-in happened")
     classes = st.get("classes") or {}
-    if len(classes) != 2:
+    cls_rows = {k: v for k, v in classes.items() if "_cls" in k}
+    if len(cls_rows) != 3:
         failures.append(
-            f"{len(classes)} compiled classes (expected 2 shape-class "
-            f"rungs for 4 distinct grids): {classes}")
+            f"{len(cls_rows)} compiled shape classes (expected 3 rungs "
+            f"— 16², 32², and the 3-D 16³ — for 6 distinct grids): "
+            f"{classes}")
+    if not any(k.startswith("ns3d_") for k in cls_rows):
+        failures.append(
+            f"no 3-D class rung in the compile census: {classes}")
     for label, compiles in classes.items():
         if compiles > 1:
             failures.append(
@@ -150,6 +183,21 @@ def main(argv: list[str]) -> int:
     if not div:
         failures.append("no scenario-tagged divergence record for the "
                         "nan lane")
+    # per-request class-eligibility decisions (ISSUE 15): the fft
+    # request's exact-shape landing must carry the refusal reason, and
+    # eligible requests their padded-class record
+    cls_disp = [r for r in records if r.get("kind") == "dispatch"
+                and str(r.get("key", "")).startswith("class_")]
+    refused = [r for r in cls_disp if "fft" in str(r.get("value"))
+               and str(r.get("value", "")).startswith("exact")]
+    if not refused:
+        failures.append(
+            "no class_<bucket> dispatch record carrying the fft "
+            f"refusal reason (records: {[r.get('key') for r in cls_disp]})")
+    if not any(str(r.get("value", "")).startswith("class (padded")
+               for r in cls_disp):
+        failures.append("no class_<bucket> record for an ELIGIBLE "
+                        "request")
 
     artifact = os.path.join(outdir, "SERVE_SMOKE.json")
     from tools._artifact import write_merged
@@ -173,7 +221,7 @@ def main(argv: list[str]) -> int:
             print(f"  - {f}", file=sys.stderr)
         return 1
     print(f"\nserve smoke ok: {st['served']} scenarios over "
-          f"{len(classes)} shape classes (1 compile each), "
+          f"{len(cls_rows)} shape classes (2-D + 3-D, 1 compile each), "
           f"{st['swaps']} swap(s), 1 diverged lane isolated, 1 "
           f"malformed request parked, p50 latency "
           f"{st['latency_ms']['p50']} ms, clean shutdown")
