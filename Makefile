@@ -99,6 +99,21 @@ lint-update:
 lint-comm:
 	python tools/lint.py --only comm
 
+# MG fused-cycle smoke (ISSUE 16): fused-vs-ladder V-cycle parity on
+# 2-D/3-D × plain/obstacle (CPU interpret mode), the 2-launch /
+# 1-launch (class) static pins, the ragged refusal reason, and the
+# mg_launches_per_cycle telemetry/merge/lint round trip. rc 0 = the
+# whole fused-cycle seam holds before any TPU time is spent.
+mg-smoke:
+	JAX_PLATFORMS=cpu python tools/mg_smoke.py
+
+# The full mg-fused test file INCLUDING the slow-marked cases (3-D
+# parity, the class-lane-vs-solo and rung-invariance contracts, the
+# FFT coarse correction — tier-1 carries one cheap representative per
+# axis to hold its 870 s window; this target is the complete matrix).
+mg-suite:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_mg_fused.py -q
+
 # Fleet smoke: a tiny mixed scenario queue through the whole serving
 # stack on CPU (enqueue -> bucket -> batch -> per-scenario artifacts),
 # with a drift gate — fails if any lane's result differs from its solo
@@ -169,6 +184,7 @@ distclean:
 	rm -rf build exe-*
 
 .PHONY: all test asm format telemetry-report check-artifacts bench-trend \
-	profile-smoke fleet-smoke serve-smoke fleet-suite lint lint-update \
-	lint-comm \
+	profile-smoke mg-smoke mg-suite fleet-smoke serve-smoke fleet-suite \
+	lint \
+	lint-update lint-comm \
 	fault-suite dead-rank-smoke ckpt-fsck clean distclean

@@ -1,13 +1,16 @@
 """Headline benchmark: lattice-site updates/sec/chip, Poisson 4096² red-black
 SOR (the BASELINE.json metric).
 
-Prints THREE JSON lines:
+Prints FOUR JSON lines:
   {"metric": "lattice_site_updates_per_sec_per_chip_poisson4096_rbsor", ...}
   {"metric": "ns2d_dcavity4096_ms_per_step", "value": ms, "solve_ms": ...,
    "nonsolve_ms": ..., "phases": <dispatch>, ...}
   {"metric": "ns2d_obstacle2048x512_ms_per_step", ...}  (PR 2: the fused
    obstacle variant's decomposition; ragged/dist twins live in
    tools/perf_ragged.py and tools/perf_obsdist.py)
+  {"metric": "mg_launches_per_cycle", "value": N, "mg_dispatch": ...,
+   "ladder_launches": ...}  (ISSUE 16: the fused V-cycle's static launch
+   census — 2 with the DOWN/UP cycle kernels dispatched)
 
 The second line is the metric the fused step-phase kernels move (round 6):
 the NS-2D north-star step time WITH its solve/non-solve decomposition, so
@@ -233,6 +236,45 @@ def _ns2d_obstacle_step_line():
     )
 
 
+def _mg_launch_line():
+    """The mg launch census (ISSUE 16): how many Pallas launches ONE
+    V-cycle costs at the north-star mg geometry, counted STATICALLY from
+    the traced cycle program (analysis/jaxprcheck.count_prim) — exact on
+    any backend, no timing. The fused cycle pins 2 (DOWN + UP with the
+    exact jnp bottom between); `ladder_launches` records the per-level
+    ladder's count of the same plan for the amortization ratio (0 off-TPU
+    where the ladder's smoothers stay jnp). Rides the same telemetry
+    metric protocol as the step lines; the trend gate
+    (tools/bench_trend.NAME_DIRECTIONS) holds the count down."""
+    from pampi_tpu.analysis.jaxprcheck import count_prim
+    from pampi_tpu.ops.multigrid import make_mg_vcycle_2d
+    from pampi_tpu.utils import dispatch, telemetry
+
+    on_tpu = jax.default_backend() == "tpu"
+    # off-TPU: the smallest plain grid with a multi-level plan at the
+    # default DCT-bottom budget (512² -> 256²), so the census is real
+    n = 4096 if on_tpu else 512
+
+    def cycle_launches(fused):
+        vc = make_mg_vcycle_2d(n, n, 1.0 / n, 1.0 / n, jnp.float32,
+                               fused=fused)
+        z = jnp.zeros((n + 2, n + 2), jnp.float32)
+        return count_prim(jax.make_jaxpr(vc)(z, z).jaxpr, "pallas_call")
+
+    ladder = cycle_launches("off")
+    fused = cycle_launches("on")
+    line = {
+        "metric": "mg_launches_per_cycle",
+        "value": fused,
+        "unit": "launches/cycle",
+        "mg_dispatch": dispatch.last("mg2d_fused"),
+        "ladder_launches": ladder,
+        "config": f"dcavity {n}^2 f32 mg vcycle",
+    }
+    telemetry.emit("metric", **line)
+    return line
+
+
 def main() -> None:
     from pampi_tpu.utils import telemetry
 
@@ -265,6 +307,11 @@ def main() -> None:
         print(json.dumps(_ns2d_obstacle_step_line()), flush=True)
     except Exception as exc:
         print(f"ns2d obstacle step line failed ({type(exc).__name__}: {exc})",
+              file=sys.stderr)
+    try:
+        print(json.dumps(_mg_launch_line()), flush=True)
+    except Exception as exc:
+        print(f"mg launch line failed ({type(exc).__name__}: {exc})",
               file=sys.stderr)
 
 

@@ -103,6 +103,26 @@ def scoped_exchanges(jaxpr) -> dict[str, int]:
     return out
 
 
+def aggregation_gathers(jaxpr) -> dict[str, int]:
+    """all_gather eqns by their `mg_aggregate.*` name-stack scope — the
+    DECLARED coarse-aggregation boundary of the distributed MG bottom
+    (ops/multigrid wraps the bottom-residual gather in a
+    jax.named_scope). These are the only resharding collectives a chunk
+    may carry: check_config subtracts them from the RULE_RESHARD ban and
+    pins the census in the baseline, so an UNDECLARED gather still fails
+    the ban and a declared one cannot silently multiply."""
+    out: dict[str, int] = {}
+    for e in iter_eqns(jaxpr):
+        if e.primitive.name != "all_gather":
+            continue
+        stack = str(getattr(e.source_info, "name_stack", "") or "")
+        for part in stack.split("/"):
+            if part.startswith("mg_aggregate."):
+                out[part] = out.get(part, 0) + 1
+                break
+    return out
+
+
 def census(jaxpr) -> dict:
     """The collective content of a traced program: per-primitive counts,
     the ppermute message multiset (shape×dtype -> occurrences), and the
@@ -170,6 +190,9 @@ def census_tiers(jaxpr, tiers: dict) -> dict:
 def config_entry(traced) -> dict:
     """The fresh `comm` baseline entry for one traced config."""
     entry = census(traced.jaxpr.jaxpr)
+    agg = aggregation_gathers(traced.jaxpr.jaxpr)
+    if agg:
+        entry["aggregation"] = agg
     rec = getattr(traced.solver, "_halo_record", None)
     entry["halo"] = rec() if callable(rec) else None
     comm = getattr(traced.solver, "comm", None)
@@ -545,13 +568,25 @@ def check_config(traced, baseline: dict | None,
     def emit(rule, msg):
         vs.append(Violation(path, line, rule, f"{cfg.name}: {msg}"))
 
-    # resharding collectives are banned on every chunk path
+    # resharding collectives are banned on every chunk path — EXCEPT the
+    # declared coarse-aggregation boundary (ISSUE 16): all_gathers under
+    # an `mg_aggregate.*` named scope are the distributed MG bottom's
+    # replicated-solve gather, censused and baseline-pinned below; any
+    # gather OUTSIDE that scope still trips the ban
     resharded = {n: counts[n] for n in RESHARDING if counts[n]}
+    declared = sum(entry.get("aggregation", {}).values())
+    if declared and "all_gather" in resharded:
+        undeclared = resharded["all_gather"] - declared
+        if undeclared > 0:
+            resharded["all_gather"] = undeclared
+        else:
+            del resharded["all_gather"]
     if resharded:
         emit(RULE_RESHARD,
              f"chunk contains resharding collectives {resharded} — "
              "sharding propagation re-laid data out behind the explicit "
-             "exchange schedule")
+             "exchange schedule (coarse-aggregation gathers must carry "
+             "the mg_aggregate.* named scope)")
     # single-device chunks carry no collectives at all
     if cfg.dims is None and any(counts.values()):
         emit(RULE_COUNT,
@@ -612,6 +647,18 @@ def check_config(traced, baseline: dict | None,
             emit(RULE_BYTES,
                  "halo message geometry drifted at equal byte volume: "
                  + "; ".join(sdiff)
+                 + " (tools/lint.py --update if intended)")
+        if baseline.get("aggregation") != entry.get("aggregation"):
+            # the declared aggregation boundary is pinned like any other
+            # schedule fact: a gather appearing, vanishing, or
+            # multiplying is a dispatch change, not a tolerance
+            adiff = diff_counts(baseline.get("aggregation") or {},
+                                entry.get("aggregation") or {},
+                                "aggregation")
+            emit(RULE_RESHARD,
+                 "declared coarse-aggregation boundary drifted from the "
+                 "comm baseline: "
+                 + ("; ".join(adiff) if adiff else "scope set changed")
                  + " (tools/lint.py --update if intended)")
         if "tiers" in baseline and baseline["tiers"] != entry.get("tiers"):
             # the per-tier breakdown is pinned too: a re-tiered strip

@@ -241,6 +241,11 @@ class ChunkConfig:
     fold_key: str = ""
     solve_key: str = ""
     overlap_key: str = ""
+    # the fused-V-cycle dispatch key (ISSUE 16): its record carries the
+    # launch census verbatim — "pallas_*_cycle (launches=N, ...)" — and
+    # the derived budget adds exactly that N (2 for the solo DOWN/UP
+    # pair, 1 for the one-launch class cycle)
+    mg_key: str = ""
     dispatch_keys: tuple = ()
     fleet: int = 0
     # serving-v2 batched variants (all imply `fleet`): mixed per-lane te
@@ -552,6 +557,67 @@ def standard_configs() -> list[ChunkConfig]:
             notes="the 3-D fused class chunk: dynamic-extent PRE + POST "
                   "around the masked jnp class solve — exactly two "
                   "launches per step"),
+        # the fused V-cycle (ISSUE 16): one dynamic-extent cycle kernel
+        # pair per cycle (DOWN: smooth+residual+restrict, UP: prolong+
+        # neumann+post-smooth), the jnp bottom between them. Grids here
+        # are the SMALLEST that yield a multi-level plan at the default
+        # budgets (the fused cycle refuses single-level plans), so the
+        # launches=2 census is exercised for real, not vacuously.
+        ChunkConfig(
+            "ns2d_mg_fused", "ns2d",
+            dict(_B2, imax=512, jmax=256, tpu_fuse_phases="off",
+                 tpu_solver="mg", tpu_mg_fused="on"),
+            derive=True, phases_key="ns2d_phases", mg_key="mg2d_fused",
+            dispatch_keys=("ns2d_phases", "mg2d_fused"),
+            notes="the fused 2-D V-cycle: jnp phase chain + exactly the "
+                  "DOWN/UP kernel pair the mg2d_fused census records — "
+                  "512x256 is the smallest plain grid with a 2-level "
+                  "plan at the default DCT-bottom budget"),
+        ChunkConfig(
+            "ns2d_obstacle_mg_fused", "ns2d",
+            dict(_OBS, imax=64, jmax=64, tpu_fuse_phases="off",
+                 tpu_solver="mg", tpu_mg_fused="on"),
+            derive=True, phases_key="ns2d_phases",
+            mg_key="mg2d_obstacle_fused",
+            dispatch_keys=("ns2d_phases", "mg2d_obstacle_fused"),
+            notes="the fused obstacle V-cycle: rediscretized "
+                  "eps-coefficient operator per level, masks in the "
+                  "kernel, dense exact bottom (64^2 -> 32^2 = exactly "
+                  "the dense-bottom budget)"),
+        ChunkConfig(
+            "ns3d_mg_fused", "ns3d",
+            dict(_B3, imax=64, jmax=64, kmax=64, tpu_fuse_phases="off",
+                 tpu_solver="mg", tpu_mg_fused="on"),
+            derive=True, phases_key="ns3d_phases", mg_key="mg3d_fused",
+            dispatch_keys=("ns3d_phases", "mg3d_fused"),
+            notes="the fused 3-D V-cycle: the same DOWN/UP pair over "
+                  "volume planes (64^3 -> 32^3 two-level plan)"),
+        ChunkConfig(
+            "ns2d_dist_mg_agg", "ns2d_dist",
+            dict(_B2, imax=256, jmax=258, tpu_fuse_phases="off",
+                 tpu_solver="mg", tpu_mg_fused="on"),
+            dims=(2, 2), expected_pallas=None,
+            dispatch_keys=("ns2d_dist_phases", "mg_dist",
+                           "mg_dist_fused", "mg_dist_agg"),
+            notes="coarse-level aggregation below the shard floor: the "
+                  "odd local extent (jl=129) stops the shard ladder at "
+                  "one over-budget level, so tpu_mg_fused on continues "
+                  "the hierarchy with the replicated global mini-V-cycle "
+                  "(mg_dist_agg census; the gather is the declared "
+                  "mg_aggregate boundary) — baseline-pinned"),
+        ChunkConfig(
+            "ns2d_fleet_class_mg", "ns2d",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="mg",
+                 tpu_mg_fused="on", tpu_mesh="1"),
+            derive=True, phases_key="ns2d_class_phases",
+            mg_key="mg_class_fused",
+            dispatch_keys=("ns2d_class_phases", "mg_class_fused"),
+            fleet=2, fleet_class=True,
+            notes="the mg class lane: the whole V-cycle is ONE "
+                  "whole-cycle kernel (in-kernel smoothed bottom), so "
+                  "the chunk is jnp phases + exactly one launch — two "
+                  "DIFFERENT grids ride the same class program via the "
+                  "traced-scalar level plan"),
     ]
 
 
@@ -572,6 +638,12 @@ def expected_launches(cfg: ChunkConfig, decisions: dict):
         n += 1
     if (decisions.get(cfg.overlap_key) or "").startswith("overlap"):
         n += 1  # the PRE kernel runs twice: interior + boundary halves
+    mg = decisions.get(cfg.mg_key) or ""
+    if mg.startswith("pallas"):
+        # the fused cycle's record IS the budget: "launches=N" names how
+        # many pallas_calls one V-cycle costs (2 solo, 1 class lane)
+        lm = re.search(r"launches=(\d+)", mg)
+        n += int(lm.group(1)) if lm else 1
     return n, "derived"
 
 
@@ -680,6 +752,17 @@ def check_config(cfg: ChunkConfig, baseline: dict | None,
              f"chunk lowers to {sig['pallas_calls']} pallas_call(s), the "
              f"{how} contract says {expected} "
              f"(dispatch: {decisions}; {cfg.notes})")
+    # the fused-cycle launch ceiling (ISSUE 16): any dispatch decision
+    # advertising a per-cycle launch census must stay within the budget
+    # the amortization argument rests on — 2 solo (DOWN + UP), 1 on the
+    # class lane, 3 the hard ceiling
+    for dkey, dval in decisions.items():
+        lm = re.search(r"launches=(\d+)", str(dval or ""))
+        if lm and int(lm.group(1)) > 3:
+            emit(RULE_LAUNCH,
+                 f"dispatch {dkey} = {dval!r} advertises "
+                 f"{lm.group(1)} launches/cycle — the fused-cycle "
+                 "contract pins <= 3")
     # host callbacks only behind armed flags
     from ..utils import flags as _flags
 

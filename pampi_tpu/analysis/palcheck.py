@@ -601,6 +601,64 @@ def class_kernel_entries() -> list:
     return out
 
 
+def mg_cycle_entries() -> list:
+    """The fused V-cycle kernels (ops/mg_fused.py, ISSUE 16) at the
+    worst-case geometries the solo dispatchers can actually build: the
+    2-D DOWN/UP pair at the 512x256 two-level plan (the smallest plain
+    grid whose plan survives the default DCT-bottom budget — and so the
+    largest plane per level the dispatcher emits), the 3-D pair at the
+    64³ plan, the masked obstacle pair (fluid + factor stacks double the
+    resident inputs — the VMEM worst case per plane), and the one-launch
+    class cycle at a 256² class with a worst-pad live extent (129: the
+    deepest unroll at the biggest plane). Trace-only — the standard
+    resource rules (tiling/VMEM/index/alias) then price every launch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import mg_fused as mf
+
+    out = []
+    dt = jnp.float32
+    for tag, levels, spacings in (
+            ("mg2d_cycle[512x256]", [(256, 512), (128, 256)],
+             (1.0 / 512, 1.0 / 256)),
+            ("mg3d_cycle[64³]", [(64, 64, 64), (32, 32, 32)],
+             (1.0 / 64, 1.0 / 64, 1.0 / 64))):
+        down, up, plane = mf.make_cycle_kernels(levels, spacings, dt,
+                                                interpret=True)
+        stack = (len(levels),) + plane
+        p = jnp.zeros(plane, dt)
+        s = jnp.zeros(stack, dt)
+        out.append((f"{tag}.DOWN", jax.make_jaxpr(down)(p, p)))
+        out.append((f"{tag}.UP", jax.make_jaxpr(up)(s, s, p)))
+    # the masked obstacle pair: per-level fluid/factor stacks ride as two
+    # extra VMEM-resident inputs (the fused cycle's heaviest layout)
+    levels = [(64, 64), (32, 32)]
+    fluids = [np.ones((j + 2, i + 2)) for j, i in levels]
+    factors = [np.full((j, i), 0.25) for j, i in levels]
+    down, up, plane = mf.make_cycle_kernels(
+        levels, (1.0 / 64, 1.0 / 64), dt, interpret=True,
+        fluid_levels=fluids, factor_levels=factors)
+    stack = (len(levels),) + plane
+    p = jnp.zeros(plane, dt)
+    s = jnp.zeros(stack, dt)
+    out.append(("mg2d_obstacle_cycle[64²].DOWN",
+                jax.make_jaxpr(down)(p, p)))
+    out.append(("mg2d_obstacle_cycle[64²].UP",
+                jax.make_jaxpr(up)(s, s, p)))
+    # the one-launch class cycle at the worst-pad lane of a 256² class
+    n = 256
+    cycle, plane, lmax = mf.make_class_cycle_2d(n, n, dt, interpret=True)
+    live = jnp.asarray(129, jnp.int32)  # worst pad on the 256 rung
+    inv2 = jnp.asarray(129.0 * 129.0, dt)
+    ext, geo = mf.class_level_plan(live, live, inv2, inv2, lmax, dt)
+    pc = jnp.zeros(plane, dt)
+    out.append((f"mg_class_cycle[{n}²]",
+                jax.make_jaxpr(cycle)(pc, pc, ext, geo)))
+    return out
+
+
 def check_jaxpr(jaxpr, budget: int | None = None,
                 context: str = "") -> list[Violation]:
     vs: list[Violation] = []
@@ -636,5 +694,9 @@ def run(traced=None, configs=None, budget: int | None = None,
         # the serving-v3 class KERNELS (fused PRE/POST + padded-class
         # solve) at the waste bound's worst-case padded geometry
         for name, jx in class_kernel_entries():
+            vs += check_jaxpr(jx.jaxpr, budget=budget, context=f"{name}/")
+        # the fused V-cycle kernels (ISSUE 16): DOWN/UP pairs at the
+        # worst-case solo level plans + the one-launch class cycle
+        for name, jx in mg_cycle_entries():
             vs += check_jaxpr(jx.jaxpr, budget=budget, context=f"{name}/")
     return vs

@@ -41,7 +41,13 @@ kernel in the padded class layout (`make_padded_class_solve` — the
 dominant per-step cost stops being jnp inside class lanes; the 3-D class
 solve stays the masked jnp rb loop). The jnp masked chain remains the
 parity oracle (`tpu_fuse_phases off` forces it — kernel-off lanes trace
-byte-identically to serving v2). `palcheck.shapeclass_violations` bounds
+byte-identically to serving v2). Since the fused-V-cycle PR, 2-D
+`tpu_solver mg` requests join the ladder: their solve is the ONE-LAUNCH
+dynamic-extent cycle kernel (`ops/mg_fused.make_class_cycle_2d` via
+`make_class_mg_solve` — level plan from per-lane call-time extents,
+in-kernel smoothed bottom, `tpu_mg_fused` gated under the
+`mg_class_fused` dispatch key; knob-off mg requests keep their
+exact-shape bucket). `palcheck.shapeclass_violations` bounds
 the padding waste per class: above the eligibility floor the padded
 extent stays under 2x the live extent per axis, so a 2-D class never
 burns more than WASTE_BOUND (4x) the live cells (8x for a 3-D class,
@@ -119,8 +125,15 @@ def class_eligible(param) -> str | None:
 
     if param.obstacles.strip():
         return "obstacle flags are trace-baked geometry"
-    if param.tpu_solver != "sor":
-        return f"tpu_solver {param.tpu_solver} (class solve is rb-sor)"
+    if param.tpu_solver not in ("sor", "mg"):
+        return (f"tpu_solver {param.tpu_solver} (class solves are rb-sor "
+                "and the one-launch mg cycle)")
+    if param.tpu_solver == "mg":
+        if is_3d_config(param):
+            return "3-D mg lane (the one-launch class cycle is 2-D)"
+        if param.tpu_mg_fused == "off":
+            return ("tpu_mg_fused off (the mg class solve IS the fused "
+                    "cycle kernel)")
     if param.tpu_sor_layout not in ("auto", "checkerboard"):
         return (f"tpu_sor_layout {param.tpu_sor_layout} forced (the "
                 "class solve is the checkerboard padded layout)")
@@ -256,11 +269,14 @@ def make_class_solve(param, jc: int, ic: int, dtype, grids):
 
 
 def make_class_chunk(param, jc: int, ic: int, dtype,
-                     metrics: bool = False, chunk_default: int = 64):
+                     metrics: bool = False, chunk_default: int = 64,
+                     backend: str = "auto"):
     """One shape class's chunk program: models/ns2d._build_step's phase
     order with grid extents as per-lane traced scalars. Lane state is
     (u, v, p, t, nt, gm[, m]) plus the carried te (the fleet's per-lane
-    te convention — te is always the trailing argument)."""
+    te convention — te is always the trailing argument). The solve is
+    per-lane-dispatched: rb-sor lanes keep the masked loop, mg lanes ride
+    the one-launch fused cycle when it dispatches (_class_solve_for)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -274,7 +290,7 @@ def make_class_chunk(param, jc: int, ic: int, dtype,
     adaptive = param.tau > 0.0
     chunk = param.tpu_chunk or chunk_default
     time_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    solve = make_class_solve(param, jc, ic, dtype, grids)
+    solve = _class_solve_for(param, jc, ic, dtype, grids, backend=backend)
 
     def step(u, v, p, t, nt, gm):
         imax, jmax = gm[G_IMAX], gm[G_JMAX]  # whole-number scalars
@@ -411,6 +427,96 @@ def make_padded_class_solve(param, jc: int, ic: int, dtype,
              jnp.asarray(0, jnp.int32)))
 
     return solve, block_rows, halo
+
+
+def make_class_mg_solve(param, jc: int, ic: int, dtype,
+                        interpret: bool | None = None):
+    """The mg class lane's solve: ops/mg_fused.make_class_cycle_2d — the
+    WHOLE V-cycle (pre-smooth, restrict, in-kernel smoothed bottom,
+    prolong, post-smooth, fine residual) as ONE pallas launch whose level
+    plan comes from the lane's call-time extents (class_level_plan), so
+    every mg lane of the class shares one compile at one launch per
+    cycle. Same call contract as make_class_solve:
+
+        solve(p0, rhs, imax, jmax, factor, idx2, idy2, norm) -> (p, res, it)
+
+    on the reference (jc+2, ic+2) block; `it` counts V-cycles; the
+    convergence scalar is the in-kernel fine-level residual riding back
+    through SMEM (no extra launch). The lane's SOR `factor` slot is
+    unused — the cycle's ω=1 smoother factor is re-derived per level from
+    idx2/idy2 inside class_level_plan (the multigrid convention), and the
+    in-kernel smoothed bottom makes the class-lane parity contract
+    padding-invariance + convergence-to-eps rather than the solo ulp bar.
+    Dead cells beyond the lane's live extent are re-zeroed on exit (the
+    class chunk's exact-0 pad contract). Raises when the kernel is
+    unavailable (callers record why and keep the rb-sor chain)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import mg_fused as mf
+
+    cycle, plane, lmax = mf.make_class_cycle_2d(jc, ic, dtype,
+                                                interpret=interpret)
+    epssq = param.eps * param.eps
+    itermax = param.itermax
+    res_dtype = jnp_promote(dtype)
+    gj, gi = _index_grids(jc, ic)
+
+    def solve(p0, rhs, imax, jmax, factor, idx2, idy2, norm):
+        del factor  # ω=1 per-level factors come from class_level_plan
+        ext, geo = mf.class_level_plan(jmax, imax, idx2, idy2, lmax,
+                                       dtype)
+        norm = norm.astype(res_dtype)
+        rp = mf.pad_plane(rhs, plane)
+
+        def cond(carry):
+            _, res, it = carry
+            return jnp.logical_and(res >= epssq, it < itermax)
+
+        def body(carry):
+            p, _, it = carry
+            p, rsq = cycle(p, rp, ext, geo)
+            return p, rsq.astype(res_dtype) / norm, it + 1
+
+        pp, res, it = lax.while_loop(
+            cond, body,
+            (mf.pad_plane(p0, plane), jnp.asarray(1.0, res_dtype),
+             jnp.asarray(0, jnp.int32)))
+        p = mf.unpad_plane(pp, (jc, ic))
+        live = (gj <= jmax + 1) & (gi <= imax + 1)
+        return jnp.where(live, p, jnp.zeros_like(p)), res, it
+
+    return solve
+
+
+def _class_solve_for(param, jc: int, ic: int, dtype, grids,
+                     backend: str = "auto"):
+    """The class chunk's solve dispatch: mg lanes ride the one-launch
+    fused cycle (decision recorded under `mg_class_fused` via
+    resolve_mg_fused); any refusal — knob, retry backend, probe, or an
+    infeasible kernel build — keeps the rb-sor masked chain with the
+    reason recorded (mg lanes converge to the same eps either way, the
+    class-lane contract)."""
+    from ..utils import dispatch as _dispatch
+
+    if param.tpu_solver == "mg":
+        from ..ops import mg_fused as mf
+
+        if _dispatch.resolve_mg_fused(
+            param.tpu_mg_fused, backend, dtype, "mg_class_fused",
+            probe=mf.probe_mg_fused,
+        ):
+            try:
+                solve = make_class_mg_solve(param, jc, ic, dtype)
+            except (ValueError, RuntimeError) as exc:
+                _dispatch.record("mg_class_fused", f"jnp ({exc})")
+            else:
+                _dispatch.record(
+                    "mg_class_fused",
+                    "pallas_class_cycle (launches=1, levels<="
+                    f"{mf.class_level_max(jc, ic)})")
+                return solve
+    return make_class_solve(param, jc, ic, dtype, grids)
 
 
 def jnp_promote(dtype):
@@ -592,6 +698,7 @@ class ClassSolver:
             else dtype
         self._backend = "auto"
         self._fused = False  # set by _build_chunk (fused-class dispatch)
+        self._solve_pallas = False  # mg class lane: one-launch cycle
         self._dt_scale = 1.0
         self._metrics = _tm.enabled()
         self._time_index = 3
@@ -606,7 +713,7 @@ class ClassSolver:
                  phases=_dispatch.last("ns2d_class_phases"))
 
     def _uses_pallas(self) -> bool:
-        return self._fused
+        return self._fused or self._solve_pallas
 
     def _build_fused_chunk(self, backend: str, metrics: bool):
         """The fused-class dispatch (the models/ns2d._build_fused_chunk
@@ -617,6 +724,15 @@ class ClassSolver:
         from ..ops.ns2d_fused import probe_fused_2d
         from ..utils.dispatch import record, resolve_fuse_phases
 
+        if self.param.tpu_solver == "mg":
+            # mg class lanes: the solve IS the one-launch cycle kernel
+            # (make_class_mg_solve, dispatched inside the jnp chunk); the
+            # phase megakernels' padded-layout fold assumes the tblock
+            # sor solve, so the phases stay the masked chain
+            record("ns2d_class_phases",
+                   "jnp (mg class lane: the solve is the one-launch "
+                   "fused cycle)")
+            return None
         if not resolve_fuse_phases(
             self.param, backend, self.dtype, probe_fused_2d,
             "ns2d_class_phases",
@@ -646,9 +762,16 @@ class ClassSolver:
         self._fused = fused is not None
         if fused is not None:
             return fused
-        return make_class_chunk(self.param, self.jc, self.ic, self.dtype,
-                                metrics=self._metrics,
-                                chunk_default=self.CHUNK)
+        chunk = make_class_chunk(self.param, self.jc, self.ic, self.dtype,
+                                 metrics=self._metrics,
+                                 chunk_default=self.CHUNK,
+                                 backend=backend)
+        if self.param.tpu_solver == "mg":
+            from ..utils import dispatch as _dispatch
+
+            last = _dispatch.last("mg_class_fused") or ""
+            self._solve_pallas = last.startswith("pallas")
+        return chunk
 
     def _rebuild_chunk(self):
         """Re-trace against the solver's CURRENT `_backend` — the

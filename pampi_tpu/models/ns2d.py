@@ -42,7 +42,8 @@ from ..utils.progress import Progress
 def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
                         backend: str = "auto", n_inner: int = 1,
                         solver: str = "sor", layout: str = "auto",
-                        stall_rtol=None, flat: bool = False):
+                        stall_rtol=None, flat: bool = False,
+                        mg_fused: str = "off"):
     """Pressure-Poisson solve loop (solve, solver.c:140-191): carry
     (p, res, it); res = Σr²/(imax·jmax) vs eps²; Neumann ghost copy per sweep.
 
@@ -67,7 +68,8 @@ def make_pressure_solve(imax, jmax, dx, dy, omega, eps, itermax, dtype,
         from ..ops.multigrid import make_mg_solve_2d
 
         return make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype,
-                                stall_rtol=stall_rtol, backend=backend)
+                                stall_rtol=stall_rtol, backend=backend,
+                                fused=mg_fused)
     if solver == "fft":
         from ..ops.dctpoisson import make_dct_solve_2d
 
@@ -194,6 +196,7 @@ class NS2DSolver:
                 layout=param.tpu_sor_layout,
                 stall_rtol=param.tpu_mg_stall_rtol,
                 flat=bool(param.tpu_flat_solve),
+                mg_fused=param.tpu_mg_fused,
             )
         elif param.tpu_solver == "mg":
             # obstacle-capable multigrid: rediscretized eps-coefficient
@@ -205,6 +208,7 @@ class NS2DSolver:
                 param.imax, param.jmax, dx, dy, param.eps, param.itermax,
                 masks, dtype,
                 stall_rtol=param.tpu_mg_stall_rtol, backend=backend,
+                fused=param.tpu_mg_fused,
             )
         else:
             from ..ops import obstacle as obst

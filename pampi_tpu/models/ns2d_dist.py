@@ -489,6 +489,7 @@ class NS2DDistSolver:
                     comm, self.imax, self.jmax, jl, il, dx, dy,
                     param.eps, param.itermax, self.masks, dtype,
                     stall_rtol=param.tpu_mg_stall_rtol,
+                    fused=param.tpu_mg_fused,
                 )
                 # the MG factory reports per-shard Pallas smoothing the
                 # same way the obstacle SOR solver does: relax check_vma
@@ -500,6 +501,7 @@ class NS2DDistSolver:
                     comm, self.imax, self.jmax, jl, il, dx, dy,
                     param.eps, param.itermax, dtype,
                     stall_rtol=param.tpu_mg_stall_rtol, split=ovl_pre,
+                    fused=param.tpu_mg_fused,
                 )
                 pallas_q = pallas_q or mg_pallas
                 if ovl_pre:
@@ -508,7 +510,7 @@ class NS2DDistSolver:
                             comm, self.imax, self.jmax, jl, il, dx, dy,
                             param.eps, param.itermax, dtype,
                             stall_rtol=param.tpu_mg_stall_rtol,
-                            split=False,
+                            split=False, fused=param.tpu_mg_fused,
                         )
                         return s2
         elif self.masks is not None:

@@ -114,7 +114,7 @@ def _use_pallas_3d(backend: str, dtype) -> bool:
 def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
                            dtype, backend: str = "auto", n_inner: int = 1,
                            solver: str = "sor", layout: str = "auto",
-                           stall_rtol=None):
+                           stall_rtol=None, mg_fused: str = "off"):
     """Convergence loop for the 3-D pressure solve. solver="sor" (default,
     the reference's algorithm): backend="auto" dispatches to the fused Pallas
     kernel (ops/sor3d_pallas.py) on a real TPU chip and to the jnp half-sweep
@@ -131,7 +131,7 @@ def make_pressure_solve_3d(imax, jmax, kmax, dx, dy, dz, omega, eps, itermax,
 
         return make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
                                 dtype, stall_rtol=stall_rtol,
-                                backend=backend)
+                                backend=backend, fused=mg_fused)
     if solver == "fft":
         from ..ops.dctpoisson import make_dct_solve_3d
 
@@ -315,6 +315,7 @@ class NS3DSolver:
                 g.imax, g.jmax, g.kmax, dx, dy, dz,
                 param.eps, param.itermax, masks, dtype,
                 stall_rtol=param.tpu_mg_stall_rtol, backend=backend,
+                fused=param.tpu_mg_fused,
             )
         elif masks is not None:
             from ..ops.obstacle3d import make_obstacle_solver_fn_3d
@@ -332,6 +333,7 @@ class NS3DSolver:
                 solver=param.tpu_solver,
                 layout=param.tpu_sor_layout,
                 stall_rtol=param.tpu_mg_stall_rtol,
+                mg_fused=param.tpu_mg_fused,
             )
         return solve
 

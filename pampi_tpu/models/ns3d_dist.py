@@ -427,6 +427,7 @@ class NS3DDistSolver:
                     comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                     param.eps, param.itermax, self.masks, dtype,
                     stall_rtol=param.tpu_mg_stall_rtol,
+                    fused=param.tpu_mg_fused,
                 )
                 # the MG factory reports per-shard Pallas smoothing:
                 # relax check_vma (the obstacle-solver contract)
@@ -439,6 +440,7 @@ class NS3DDistSolver:
                     comm, g.imax, g.jmax, g.kmax, kl, jl, il, dx, dy, dz,
                     param.eps, param.itermax, dtype,
                     stall_rtol=param.tpu_mg_stall_rtol, split=ovl_pre,
+                    fused=param.tpu_mg_fused,
                 )
                 pallas_o = pallas_o or mg_pallas
                 self._pallas_o = pallas_o
@@ -448,7 +450,7 @@ class NS3DDistSolver:
                             comm, g.imax, g.jmax, g.kmax, kl, jl, il,
                             dx, dy, dz, param.eps, param.itermax, dtype,
                             stall_rtol=param.tpu_mg_stall_rtol,
-                            split=False,
+                            split=False, fused=param.tpu_mg_fused,
                         )
                         return s2
         elif self.masks is not None:

@@ -465,6 +465,7 @@ class PoissonSolver:
                 self.imax, self.jmax, self.dx, self.dy,
                 self.param.eps, self.param.itermax, self.dtype,
                 stall_rtol=self.param.tpu_mg_stall_rtol, backend=backend,
+                fused=self.param.tpu_mg_fused,
             )
         if self.param.tpu_solver == "fft":
             from ..ops.dctpoisson import make_dct_solve_2d

@@ -170,6 +170,7 @@ class DistPoissonSolver:
                 comm, self.imax, self.jmax, jl, il, dx, dy,
                 param.eps, itermax, dtype,
                 stall_rtol=param.tpu_mg_stall_rtol,
+                fused=param.tpu_mg_fused,
             )
             # per-shard Pallas smoothing needs check_vma relaxed, like the
             # quarters kernel

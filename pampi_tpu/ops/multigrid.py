@@ -253,9 +253,86 @@ def _embed2(interior):
     return jnp.zeros((J + 2, I + 2), interior.dtype).at[1:-1, 1:-1].set(interior)
 
 
+def _resolve_fused_solo(levels, dtype, fused, backend, key):
+    """`tpu_mg_fused` gate for one single-device MG build: plan feasibility
+    via ops/mg_fused.plan_why_not (single-level plans, VMEM-infeasible
+    stacks, missing backend), decision recorded under `key` by
+    utils/dispatch.resolve_mg_fused; a positive decision re-records with
+    the launch/level census the jaxpr contract pins — the whole V-cycle is
+    exactly TWO Pallas launches regardless of depth (DOWN and UP, with the
+    exact jnp bottom between them)."""
+    from ..utils import dispatch as _dispatch
+    from . import mg_fused as mf
+
+    use = _dispatch.resolve_mg_fused(
+        fused, backend, dtype, key,
+        why_not=mf.plan_why_not(levels, dtype),
+        probe=mf.probe_mg_fused,
+    )
+    if use:
+        _dispatch.record(
+            key, f"pallas_fused_cycle (launches=2, levels={len(levels)})"
+        )
+    return use
+
+
+# FFT-preconditioned Richardson iterations for obstacle bottoms the dense
+# pinv cannot afford (tpu_mg_fused on). Each pass corrects by the
+# constant-coefficient DCT solve of the obstacle residual — exact away from
+# the obstacle, where the operator IS constant-coefficient — then polishes
+# the boundary-layer error with one red-black ω=1 sweep. A handful of MXU
+# matmul rounds replaces the n_coarse=60 smooth-to-death unroll (~300
+# launch-bound tiny ops) that over-budget plans historically fell back to.
+_FFT_COARSE_ITERS = 4
+
+
+def _make_fft_coarse_2d(m, dxl, dyl, idx2, idy2, red, black,
+                        n_rich: int = _FFT_COARSE_ITERS):
+    """`apply(p_ext, rhs_ext) -> p_ext` — the 2-D FFT-preconditioned coarse
+    application (see _FFT_COARSE_ITERS). `m` is the bottom level's
+    ObstacleMasks at ω=1; `red`/`black` its checkerboards."""
+    from .dctpoisson import poisson_dct_2d
+    from .obstacle import obstacle_residual, sor_pass_obstacle
+
+    def apply(p, rhs):
+        for _ in range(n_rich):
+            r = obstacle_residual(p, rhs, m, idx2, idy2)
+            e = poisson_dct_2d(r, dxl, dyl)
+            p = _neumann2(p.at[1:-1, 1:-1].add(e * m.p_mask))
+            p, _ = sor_pass_obstacle(p, rhs, red, m, idx2, idy2)
+            p, _ = sor_pass_obstacle(p, rhs, black, m, idx2, idy2)
+            p = _neumann2(p)
+        return p
+
+    return apply
+
+
+def _make_fft_coarse_3d(m, dxl, dyl, dzl, idx2, idy2, idz2, odd, even,
+                        n_rich: int = _FFT_COARSE_ITERS):
+    """3-D twin of _make_fft_coarse_2d (odd-then-even sweep order, the 3-D
+    obstacle solver convention)."""
+    from ..models.ns3d import neumann_faces_3d
+    from .dctpoisson import poisson_dct_3d
+    from .obstacle3d import obstacle_residual_3d, sor_pass_obstacle_3d
+
+    def apply(p, rhs):
+        for _ in range(n_rich):
+            r = obstacle_residual_3d(p, rhs, m, idx2, idy2, idz2)
+            e = poisson_dct_3d(r, dxl, dyl, dzl)
+            p = neumann_faces_3d(
+                p.at[1:-1, 1:-1, 1:-1].add(e * m.p_mask)
+            )
+            p, _ = sor_pass_obstacle_3d(p, rhs, odd, m, idx2, idy2, idz2)
+            p, _ = sor_pass_obstacle_3d(p, rhs, even, m, idx2, idy2, idz2)
+            p = neumann_faces_3d(p)
+        return p
+
+    return apply
+
+
 def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
                       n_pre: int = 2, n_post: int = 2,
-                      backend: str = "auto"):
+                      backend: str = "auto", fused: str = "off"):
     """Build `vcycle(p_ext, rhs_ext) -> p_ext` on the fine extended grid.
     Level geometry doubles the spacing each coarsening (cell-centered).
     The coarsest level is solved EXACTLY by DCT diagonalization
@@ -264,11 +341,18 @@ def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
     of matmuls as a tiny one. Large levels smooth through the
     temporal-blocked Pallas kernel when eligible (_pallas_smoother_2d: same
     red-black ω=1 arithmetic, n sweeps per HBM round trip); small levels
-    and non-TPU runs keep the jnp sweeps."""
+    and non-TPU runs keep the jnp sweeps.
+
+    `fused` (.par key tpu_mg_fused) dispatches the whole cycle as TWO
+    dynamic-extent Pallas launches (ops/mg_fused.py) with this same exact
+    DCT bottom between them — the jnp ladder here stays the parity
+    oracle."""
     from .dctpoisson import poisson_dct_2d
     from .sor import checkerboard_mask
 
     levels = _truncate_levels(mg_levels(jmax, imax), _DCT_BOTTOM_MAX_CELLS)
+    use_fused = _resolve_fused_solo(levels, dtype, fused, backend,
+                                    "mg2d_fused")
     cfg = []
     for lvl, (jl, il) in enumerate(levels):
         dxl, dyl = dx * (2 ** lvl), dy * (2 ** lvl)
@@ -285,7 +369,8 @@ def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
                     checkerboard_mask(jl, il, 0, dtype),
                     checkerboard_mask(jl, il, 1, dtype),
                 ),
-                sm={
+                # fused cycles smooth in-kernel: skip the ladder smoothers
+                sm={} if use_fused else {
                     n: _pallas_smoother_2d(il, jl, dxl, dyl, dtype, n,
                                            backend=backend)
                     for n in {n_pre, n_post} if n
@@ -301,18 +386,22 @@ def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
         return _smooth2(p, rhs, c["masks"], c["factor"],
                         c["idx2"], c["idy2"], n)
 
+    def bottom(p, rhs):
+        # exact ADDITIVE bottom solve: correct p by the zero-mean DCT
+        # solution of its residual equation. For error equations
+        # (p = zeros) this equals the direct solve; for a single-level
+        # hierarchy it preserves the incoming iterate's mean/nullspace
+        # component — the smoother semantics the module contract
+        # promises — while staying exact in one application.
+        c = cfg[-1]
+        r = _residual2(p, rhs, c["idx2"], c["idy2"])
+        e = poisson_dct_2d(r, c["dx"], c["dy"])
+        return _neumann2(p.at[1:-1, 1:-1].add(e))
+
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
         if lvl == len(cfg) - 1:
-            # exact ADDITIVE bottom solve: correct p by the zero-mean DCT
-            # solution of its residual equation. For error equations
-            # (p = zeros) this equals the direct solve; for a single-level
-            # hierarchy it preserves the incoming iterate's mean/nullspace
-            # component — the smoother semantics the module contract
-            # promises — while staying exact in one application.
-            r = _residual2(p, rhs, c["idx2"], c["idy2"])
-            e = poisson_dct_2d(r, c["dx"], c["dy"])
-            return _neumann2(p.at[1:-1, 1:-1].add(e))
+            return bottom(p, rhs)
         p = smooth(p, rhs, lvl, n_pre)
         r = _residual2(p, rhs, c["idx2"], c["idy2"])
         r2 = _restrict2(r)
@@ -321,12 +410,33 @@ def make_mg_vcycle_2d(imax, jmax, dx, dy, dtype,
         p = _neumann2(p)
         return smooth(p, rhs, lvl, n_post)
 
-    return vcycle
+    if not use_fused:
+        return vcycle
+
+    from . import mg_fused as mf
+
+    down, up, plane = mf.make_cycle_kernels(levels, (dx, dy), dtype,
+                                            n_pre, n_post)
+    jb, ib = levels[-1]
+
+    def vcycle_fused(p, rhs):
+        # the whole restrict→smooth→prolong chain in TWO launches; the
+        # exact DCT bottom stays a jnp application between them (the
+        # coarsest rhs plane's live corner is a static slice, and the
+        # recursed ladder always hands the bottom a zero iterate)
+        pstk, rstk = down(mf.pad_plane(p, plane), mf.pad_plane(rhs, plane))
+        rb = rstk[-1][: jb + 2, : ib + 2]
+        pbot = bottom(jnp.zeros_like(rb), rb)
+        return mf.unpad_plane(up(pstk, rstk, mf.pad_plane(pbot, plane)),
+                              (jmax, imax))
+
+    return vcycle_fused
 
 
 def make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype,
                      n_pre: int = 2, n_post: int = 2,
-                     stall_rtol=MG_STALL_RTOL, backend: str = "auto"):
+                     stall_rtol=MG_STALL_RTOL, backend: str = "auto",
+                     fused: str = "off"):
     """Convergence loop with the SOR solve contract:
     `(p_ext, rhs_ext) -> (p_ext, res, it)` where res = Σr²/(imax·jmax) of
     the state BEFORE the last cycle's smoothing — evaluated fresh per cycle —
@@ -334,7 +444,7 @@ def make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype,
     also stops when the residual stalls (`stall_rtol` relative change per
     cycle, .par key tpu_mg_stall_rtol; 0 restores pure eps/itermax)."""
     vcycle = make_mg_vcycle_2d(imax, jmax, dx, dy, dtype, n_pre, n_post,
-                               backend)
+                               backend, fused)
     idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
     return _mg_converge_loop(
         vcycle, lambda p, rhs: _residual2(p, rhs, idx2, idy2),
@@ -423,14 +533,17 @@ def _pallas_smoother_3d(il, jl, kl, dxl, dyl, dzl, dtype, n, fluid=None,
 
 def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
                       n_pre: int = 2, n_post: int = 2,
-                      backend: str = "auto"):
+                      backend: str = "auto", fused: str = "off"):
     """3-D twin of make_mg_vcycle_2d (exact DCT bottom solve; large levels
-    smooth through the temporal-blocked 3-D Pallas kernel when eligible)."""
+    smooth through the temporal-blocked 3-D Pallas kernel when eligible;
+    `fused` dispatches the two-launch cycle of ops/mg_fused.py)."""
     from ..models.ns3d import checkerboard_mask_3d, neumann_faces_3d
     from .dctpoisson import poisson_dct_3d
 
     levels = _truncate_levels(mg_levels(kmax, jmax, imax),
                               _DCT_BOTTOM_MAX_CELLS)
+    use_fused = _resolve_fused_solo(levels, dtype, fused, backend,
+                                    "mg3d_fused")
     cfg = []
     for lvl, (kl, jl, il) in enumerate(levels):
         dxl, dyl, dzl = dx * (2 ** lvl), dy * (2 ** lvl), dz * (2 ** lvl)
@@ -449,7 +562,8 @@ def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
                     checkerboard_mask_3d(kl, jl, il, 1, dtype),
                     checkerboard_mask_3d(kl, jl, il, 0, dtype),
                 ),
-                sm={
+                # fused cycles smooth in-kernel: skip the ladder smoothers
+                sm={} if use_fused else {
                     n: _pallas_smoother_3d(il, jl, kl, dxl, dyl, dzl,
                                            dtype, n, backend=backend)
                     for n in {n_pre, n_post} if n
@@ -465,13 +579,17 @@ def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
         return _smooth3(p, rhs, c["masks"], c["factor"],
                         c["idx2"], c["idy2"], c["idz2"], n)
 
+    def bottom(p, rhs):
+        # exact ADDITIVE bottom solve — see the 2-D twin's rationale
+        c = cfg[-1]
+        r = _residual3(p, rhs, c["idx2"], c["idy2"], c["idz2"])
+        e = poisson_dct_3d(r, c["dx"], c["dy"], c["dz"])
+        return neumann_faces_3d(p.at[1:-1, 1:-1, 1:-1].add(e))
+
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
         if lvl == len(cfg) - 1:
-            # exact ADDITIVE bottom solve — see the 2-D twin's rationale
-            r = _residual3(p, rhs, c["idx2"], c["idy2"], c["idz2"])
-            e = poisson_dct_3d(r, c["dx"], c["dy"], c["dz"])
-            return neumann_faces_3d(p.at[1:-1, 1:-1, 1:-1].add(e))
+            return bottom(p, rhs)
         p = smooth(p, rhs, lvl, n_pre)
         r = _residual3(p, rhs, c["idx2"], c["idy2"], c["idz2"])
         r2 = _restrict3(r)
@@ -480,17 +598,35 @@ def make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
         p = neumann_faces_3d(p)
         return smooth(p, rhs, lvl, n_post)
 
-    return vcycle
+    if not use_fused:
+        return vcycle
+
+    from . import mg_fused as mf
+
+    down, up, plane = mf.make_cycle_kernels(levels, (dx, dy, dz), dtype,
+                                            n_pre, n_post)
+    kb, jb, ib = levels[-1]
+
+    def vcycle_fused(p, rhs):
+        # two launches + the exact jnp DCT bottom — see the 2-D twin
+        pstk, rstk = down(mf.pad_plane(p, plane), mf.pad_plane(rhs, plane))
+        rb = rstk[-1][: kb + 2, : jb + 2, : ib + 2]
+        pbot = bottom(jnp.zeros_like(rb), rb)
+        return mf.unpad_plane(up(pstk, rstk, mf.pad_plane(pbot, plane)),
+                              (kmax, jmax, imax))
+
+    return vcycle_fused
 
 
 def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
                      n_pre: int = 2, n_post: int = 2,
-                     stall_rtol=MG_STALL_RTOL, backend: str = "auto"):
+                     stall_rtol=MG_STALL_RTOL, backend: str = "auto",
+                     fused: str = "off"):
     """3-D twin of make_mg_solve_2d (same solve contract as
     models/ns3d.make_pressure_solve_3d; `it` counts V-cycles; stalls stop
     the loop early per `stall_rtol` — see make_mg_solve_2d)."""
     vcycle = make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
-                               n_pre, n_post, backend)
+                               n_pre, n_post, backend, fused)
     idx2 = 1.0 / (dx * dx)
     idy2 = 1.0 / (dy * dy)
     idz2 = 1.0 / (dz * dz)
@@ -604,7 +740,7 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
                               n_pre: int = 2, n_post: int = 2,
                               n_coarse: int = 60,
                               stall_rtol=MG_STALL_RTOL,
-                              backend: str = "auto"):
+                              backend: str = "auto", fused: str = "off"):
     """Obstacle-capable MG convergence loop:
     `(p_ext, rhs_ext) -> (p_ext, res, it)`, `it` counting V-cycles, residual
     normalized by the FLUID cell count (the contract of
@@ -627,6 +763,8 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
     # bottom was 3.5 of 5.7 ms/cycle at 2048x512 — ~300 tiny ops)
     levels = _truncate_levels(mg_levels(jmax, imax),
                               _DENSE_BOTTOM_MAX_CELLS)
+    use_fused = _resolve_fused_solo(levels, dtype, fused, backend,
+                                    "mg2d_obstacle_fused")
     fine_fluid = np.asarray(masks.fluid).astype(bool)
     cfg = []
     fluid = fine_fluid
@@ -641,7 +779,8 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
                 idy2=1.0 / (dyl * dyl),
                 red=checkerboard_mask(jl, il, 0, dtype),
                 black=checkerboard_mask(jl, il, 1, dtype),
-                sm={
+                # fused cycles smooth in-kernel: skip the ladder smoothers
+                sm={} if use_fused else {
                     n: _pallas_smoother_2d(il, jl, dxl, dyl, dtype, n,
                                            fluid=fluid, backend=backend)
                     for n in {n_pre, n_post} if n
@@ -660,6 +799,21 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
         if jl_b * il_b <= _DENSE_BOTTOM_MAX_CELLS
         else None  # plan could not coarsen into budget: smoothed fallback
     )
+    bottom_fft = None
+    if bottom_exact is None and fused == "on":
+        # tpu_mg_fused on: over-budget bottoms (the plan could not coarsen
+        # into the pinv budget — ragged/odd extents stall coarsening early)
+        # apply the FFT-preconditioned Richardson rounds instead of the
+        # n_coarse smooth-to-death unroll
+        from ..utils import dispatch as _dispatch
+
+        cb = cfg[-1]
+        bottom_fft = _make_fft_coarse_2d(
+            cb["m"], dx * 2 ** lvl_b, dy * 2 ** lvl_b,
+            cb["idx2"], cb["idy2"], cb["red"], cb["black"],
+        )
+        _dispatch.record("mg2d_obstacle_coarse",
+                         f"fft_richardson (n={_FFT_COARSE_ITERS})")
 
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
@@ -676,12 +830,17 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
             p = _neumann2(p)
         return p
 
+    def bottom(p, rhs):
+        if bottom_exact is not None:
+            return bottom_exact(p, rhs)
+        if bottom_fft is not None:
+            return bottom_fft(p, rhs)
+        return smooth(p, rhs, len(cfg) - 1, n_coarse)
+
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
         if lvl == len(cfg) - 1:
-            if bottom_exact is not None:
-                return bottom_exact(p, rhs)
-            return smooth(p, rhs, lvl, n_coarse)
+            return bottom(p, rhs)
         p = smooth(p, rhs, lvl, n_pre)
         r = _obstacle_residual(p, rhs, c["m"], c["idx2"], c["idy2"])
         r2 = _restrict2(r)
@@ -691,9 +850,35 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
         p = _neumann2(p)
         return smooth(p, rhs, lvl, n_post)
 
+    cycle = vcycle
+    if use_fused:
+        from . import mg_fused as mf
+
+        down, up, plane = mf.make_cycle_kernels(
+            levels, (dx, dy), dtype, n_pre, n_post,
+            # per-level flags + ω=1 factors baked verbatim so the kernel
+            # relaxes with bitwise the ladder's precomputed coefficients
+            fluid_levels=[np.asarray(c["m"].fluid) for c in cfg],
+            factor_levels=[c["m"].factor for c in cfg],
+        )
+        jb_f, ib_f = levels[-1]
+
+        def vcycle_fused(p, rhs):
+            # two launches + the exact jnp bottom (dense pinv, or the FFT
+            # Richardson rounds when over budget) — see make_mg_vcycle_2d
+            pstk, rstk = down(mf.pad_plane(p, plane),
+                              mf.pad_plane(rhs, plane))
+            rb = rstk[-1][: jb_f + 2, : ib_f + 2]
+            pbot = bottom(jnp.zeros_like(rb), rb)
+            return mf.unpad_plane(
+                up(pstk, rstk, mf.pad_plane(pbot, plane)), (jmax, imax)
+            )
+
+        cycle = vcycle_fused
+
     fine = cfg[0]
     return _mg_converge_loop(
-        vcycle,
+        cycle,
         lambda p, rhs: _obstacle_residual(
             p, rhs, fine["m"], fine["idx2"], fine["idy2"]
         ),
@@ -910,7 +1095,7 @@ def _pallas_dist_smoother_3d(comm, gkmax, gjmax, gimax, kl, jl, il,
 def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                           dtype, n_pre: int = 2, n_post: int = 2,
                           stall_rtol=MG_STALL_RTOL, backend: str = "auto",
-                          split: bool = False):
+                          split: bool = False, fused: str = "off"):
     """Distributed-MG convergence loop (shard_map kernel side): builds
     `(p_ext, rhs_ext) -> (p_ext, res, it)` on the halo-1 extended local
     block — the same contract as the distributed SOR solve; `it` counts
@@ -969,6 +1154,34 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
                     sm[(lvl, nn)] = k
     _record_mg_dispatch("mg_dist", sm, len(levels))
 
+    # the fused cycle kernel is single-device (one plane per launch); the
+    # distributed build's share of tpu_mg_fused is the COARSE-LEVEL
+    # CONTINUATION below: when the shard floor stopped the plan while the
+    # replicated bottom could still coarsen, "on" keeps the hierarchy going
+    # with a replicated jnp mini-V-cycle (its own bottom is the exact DCT)
+    # instead of paying the direct solve at the gathered extents
+    from ..utils import dispatch as _dispatch
+
+    _dispatch.resolve_mg_fused(
+        fused, backend, dtype, "mg_dist_fused",
+        why_not="fused cycle is single-device; the distributed build gets "
+                "the coarse-aggregation seam below the shard floor",
+    )
+    agg_vcycle = None
+    cb = cfg[-1]
+    if fused == "on":
+        g_levels = _truncate_levels(mg_levels(cb["jmax"], cb["imax"]),
+                                    _DCT_BOTTOM_MAX_CELLS)
+        if len(g_levels) > 1:
+            agg_vcycle = make_mg_vcycle_2d(
+                cb["imax"], cb["jmax"], cb["dx"], cb["dy"], dtype,
+                n_pre, n_post, backend="jnp",
+            )
+            _dispatch.record(
+                "mg_dist_agg",
+                f"replicated_vcycle (levels={len(g_levels)})",
+            )
+
     def masks_at(lvl):
         c = cfg[lvl]
         return ca_masks(c["jl"], c["il"], 1, c["jmax"], c["imax"], dtype)
@@ -1008,10 +1221,19 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
         r = _residual2(p, rhs, c["idx2"], c["idy2"])
         if lvl == len(levels) - 1:
             # replicated bottom solve: gather this level's residual and
-            # solve it EXACTLY (DCT) on every shard, then slice own block
-            rg = _lax.all_gather(r, "j", axis=0, tiled=True)
-            rg = _lax.all_gather(rg, "i", axis=1, tiled=True)
-            e = poisson_dct_2d(rg, c["dx"], c["dy"])
+            # solve it EXACTLY (DCT) on every shard, then slice own block.
+            # The named scope is the declared aggregation boundary the
+            # comm census keys on (analysis/commcheck).
+            with jax.named_scope("mg_aggregate.gather2d"):
+                rg = _lax.all_gather(r, "j", axis=0, tiled=True)
+                rg = _lax.all_gather(rg, "i", axis=1, tiled=True)
+            if agg_vcycle is not None:
+                # coarse-level continuation: keep coarsening globally via
+                # the replicated mini-V-cycle on the gathered residual
+                eg = agg_vcycle(_embed2(jnp.zeros_like(rg)), _embed2(rg))
+                e = eg[1:-1, 1:-1]
+            else:
+                e = poisson_dct_2d(rg, c["dx"], c["dy"])
             joff = get_offsets("j", c["jl"])
             ioff = get_offsets("i", c["il"])
             e_own = _lax.dynamic_slice(e, (joff, ioff), (c["jl"], c["il"]))
@@ -1066,7 +1288,8 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
 def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
                           eps, itermax, dtype, n_pre: int = 2,
                           n_post: int = 2, stall_rtol=MG_STALL_RTOL,
-                          backend: str = "auto", split: bool = False):
+                          backend: str = "auto", split: bool = False,
+                          fused: str = "off"):
     """3-D twin of make_dist_mg_solve_2d (same stall_rtol contract; returns
     `(solve, used_pallas)` like the 2-D twin; `split` swaps the jnp-
     fallback smoother levels to the sweep-split form)."""
@@ -1122,6 +1345,32 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
                     sm[(lvl, nn)] = k
     _record_mg_dispatch("mg_dist_3d", sm, len(levels))
 
+    # coarse-level continuation below the shard floor — see the 2-D twin
+    from ..utils import dispatch as _dispatch
+
+    _dispatch.resolve_mg_fused(
+        fused, backend, dtype, "mg_dist_fused",
+        why_not="fused cycle is single-device; the distributed build gets "
+                "the coarse-aggregation seam below the shard floor",
+    )
+    agg_vcycle = None
+    cb = cfg[-1]
+    if fused == "on":
+        g_levels = _truncate_levels(
+            mg_levels(cb["kmax"], cb["jmax"], cb["imax"]),
+            _DCT_BOTTOM_MAX_CELLS,
+        )
+        if len(g_levels) > 1:
+            agg_vcycle = make_mg_vcycle_3d(
+                cb["imax"], cb["jmax"], cb["kmax"],
+                cb["dx"], cb["dy"], cb["dz"], dtype,
+                n_pre, n_post, backend="jnp",
+            )
+            _dispatch.record(
+                "mg_dist_agg_3d",
+                f"replicated_vcycle (levels={len(g_levels)})",
+            )
+
     def masks_at(lvl):
         c = cfg[lvl]
         return ca_masks_3d(c["kl"], c["jl"], c["il"], 1,
@@ -1160,10 +1409,16 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
         p = halo_exchange(p, comm)
         r = _residual3(p, rhs, c["idx2"], c["idy2"], c["idz2"])
         if lvl == len(levels) - 1:
-            rg = _lax.all_gather(r, "k", axis=0, tiled=True)
-            rg = _lax.all_gather(rg, "j", axis=1, tiled=True)
-            rg = _lax.all_gather(rg, "i", axis=2, tiled=True)
-            e = poisson_dct_3d(rg, c["dx"], c["dy"], c["dz"])
+            # declared aggregation boundary — see the 2-D twin
+            with jax.named_scope("mg_aggregate.gather3d"):
+                rg = _lax.all_gather(r, "k", axis=0, tiled=True)
+                rg = _lax.all_gather(rg, "j", axis=1, tiled=True)
+                rg = _lax.all_gather(rg, "i", axis=2, tiled=True)
+            if agg_vcycle is not None:
+                eg = agg_vcycle(_embed3(jnp.zeros_like(rg)), _embed3(rg))
+                e = eg[1:-1, 1:-1, 1:-1]
+            else:
+                e = poisson_dct_3d(rg, c["dx"], c["dy"], c["dz"])
             koff = get_offsets("k", c["kl"])
             joff = get_offsets("j", c["jl"])
             ioff = get_offsets("i", c["il"])
@@ -1219,7 +1474,8 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
                                    itermax, masks, dtype, n_pre: int = 2,
                                    n_post: int = 2, n_coarse: int = 60,
                                    stall_rtol=MG_STALL_RTOL,
-                                   backend: str = "auto"):
+                                   backend: str = "auto",
+                                   fused: str = "off"):
     """Distributed obstacle-capable MG (shard_map kernel side): the
     composition VERDICT r3 item 6 asked for — the dist-MG skeleton
     (make_dist_mg_solve_2d) with the obstacle coarsening/rediscretization of
@@ -1300,6 +1556,26 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
         bottom_exact = None  # smoothed fallback needs the checkerboards
         cb["red_g"] = checkerboard_mask(cb["jmax"], cb["imax"], 0, dtype)
         cb["black_g"] = checkerboard_mask(cb["jmax"], cb["imax"], 1, dtype)
+    # the fused cycle is single-device; the distributed obstacle build's
+    # share of tpu_mg_fused is the FFT-preconditioned coarse application:
+    # "on" replaces the n_coarse smooth-to-death unroll at over-budget
+    # replicated bottoms (shard floor stalled the plan above the pinv
+    # budget) with _FFT_COARSE_ITERS Richardson+DCT rounds
+    from ..utils import dispatch as _dispatch
+
+    _dispatch.resolve_mg_fused(
+        fused, backend, dtype, "mg_dist_fused",
+        why_not="fused cycle is single-device; the distributed build gets "
+                "the coarse-aggregation seam below the shard floor",
+    )
+    bottom_fft = None
+    if bottom_exact is None and fused == "on":
+        bottom_fft = _make_fft_coarse_2d(
+            cb["m"], dx * 2 ** lvl_b, dy * 2 ** lvl_b,
+            cb["idx2"], cb["idy2"], cb["red_g"], cb["black_g"],
+        )
+        _dispatch.record("mg_dist_obstacle_coarse",
+                         f"fft_richardson (n={_FFT_COARSE_ITERS})")
 
     # per-shard Pallas smoothers at eligible levels: the level's GLOBAL
     # flag field keeps the CA discipline bitwise (the obstacle-SOR kernel
@@ -1339,14 +1615,18 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
         # replicated bottom: gather interiors, solve the global problem on
         # every shard (identical constants -> identical results), slice own
         c = cfg[lvl]
-        pg = _lax.all_gather(p[1:-1, 1:-1], "j", axis=0, tiled=True)
-        pg = _lax.all_gather(pg, "i", axis=1, tiled=True)
-        rg = _lax.all_gather(rhs[1:-1, 1:-1], "j", axis=0, tiled=True)
-        rg = _lax.all_gather(rg, "i", axis=1, tiled=True)
+        # declared aggregation boundary (analysis/commcheck census)
+        with jax.named_scope("mg_aggregate.obstacle2d"):
+            pg = _lax.all_gather(p[1:-1, 1:-1], "j", axis=0, tiled=True)
+            pg = _lax.all_gather(pg, "i", axis=1, tiled=True)
+            rg = _lax.all_gather(rhs[1:-1, 1:-1], "j", axis=0, tiled=True)
+            rg = _lax.all_gather(rg, "i", axis=1, tiled=True)
         pe = _neumann2(_embed2(pg))
         re = _embed2(rg)
         if bottom_exact is not None:
             pe = bottom_exact(pe, re)
+        elif bottom_fft is not None:
+            pe = bottom_fft(pe, re)
         else:
             for _ in range(n_coarse):
                 pe, _ = sor_pass_obstacle(
@@ -1491,7 +1771,7 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
                               masks, dtype, n_pre: int = 2, n_post: int = 2,
                               n_coarse: int = 60,
                               stall_rtol=MG_STALL_RTOL,
-                              backend: str = "auto"):
+                              backend: str = "auto", fused: str = "off"):
     """3-D obstacle-capable MG convergence loop
     `(p_ext, rhs_ext) -> (p_ext, res, it)` — the 3-D twin of
     make_obstacle_mg_solve_2d: fluid-ANY coarsening (coarsen_fluid_3d),
@@ -1511,6 +1791,8 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
 
     levels = _truncate_levels(mg_levels(kmax, jmax, imax),
                               _DENSE_BOTTOM_MAX_CELLS)
+    use_fused = _resolve_fused_solo(levels, dtype, fused, backend,
+                                    "mg3d_obstacle_fused")
     fine_fluid = np.asarray(masks.fluid).astype(bool)
     cfg = []
     fluid = fine_fluid
@@ -1528,7 +1810,8 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
                 # solver (make_obstacle_solver_fn_3d)
                 odd=checkerboard_mask_3d(kl, jl, il, 1, dtype),
                 even=checkerboard_mask_3d(kl, jl, il, 0, dtype),
-                sm={
+                # fused cycles smooth in-kernel: skip the ladder smoothers
+                sm={} if use_fused else {
                     n: _pallas_smoother_3d(il, jl, kl, dxl, dyl, dzl,
                                            dtype, n, fluid=fluid,
                                            backend=backend)
@@ -1547,6 +1830,19 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
         if kl_b * jl_b * il_b <= _DENSE_BOTTOM_MAX_CELLS
         else None
     )
+    bottom_fft = None
+    if bottom_exact is None and fused == "on":
+        # over-budget bottom + tpu_mg_fused on: FFT-preconditioned
+        # Richardson rounds replace the n_coarse unroll — see the 2-D twin
+        from ..utils import dispatch as _dispatch
+
+        cb = cfg[-1]
+        bottom_fft = _make_fft_coarse_3d(
+            cb["m"], dx * 2 ** lvl_b, dy * 2 ** lvl_b, dz * 2 ** lvl_b,
+            cb["idx2"], cb["idy2"], cb["idz2"], cb["odd"], cb["even"],
+        )
+        _dispatch.record("mg3d_obstacle_coarse",
+                         f"fft_richardson (n={_FFT_COARSE_ITERS})")
 
     def smooth(p, rhs, lvl, n):
         c = cfg[lvl]
@@ -1563,12 +1859,17 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
             p = neumann_faces_3d(p)
         return p
 
+    def bottom(p, rhs):
+        if bottom_exact is not None:
+            return bottom_exact(p, rhs)
+        if bottom_fft is not None:
+            return bottom_fft(p, rhs)
+        return smooth(p, rhs, len(cfg) - 1, n_coarse)
+
     def vcycle(p, rhs, lvl=0):
         c = cfg[lvl]
         if lvl == len(cfg) - 1:
-            if bottom_exact is not None:
-                return bottom_exact(p, rhs)
-            return smooth(p, rhs, lvl, n_coarse)
+            return bottom(p, rhs)
         p = smooth(p, rhs, lvl, n_pre)
         r = obstacle_residual_3d(
             p, rhs, c["m"], c["idx2"], c["idy2"], c["idz2"]
@@ -1582,9 +1883,33 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
         p = neumann_faces_3d(p)
         return smooth(p, rhs, lvl, n_post)
 
+    cycle = vcycle
+    if use_fused:
+        from . import mg_fused as mf
+
+        down, up, plane = mf.make_cycle_kernels(
+            levels, (dx, dy, dz), dtype, n_pre, n_post,
+            fluid_levels=[np.asarray(c["m"].fluid) for c in cfg],
+            factor_levels=[c["m"].factor for c in cfg],
+        )
+        kb_f, jb_f, ib_f = levels[-1]
+
+        def vcycle_fused(p, rhs):
+            # two launches + the exact jnp bottom — see the 2-D twin
+            pstk, rstk = down(mf.pad_plane(p, plane),
+                              mf.pad_plane(rhs, plane))
+            rb = rstk[-1][: kb_f + 2, : jb_f + 2, : ib_f + 2]
+            pbot = bottom(jnp.zeros_like(rb), rb)
+            return mf.unpad_plane(
+                up(pstk, rstk, mf.pad_plane(pbot, plane)),
+                (kmax, jmax, imax),
+            )
+
+        cycle = vcycle_fused
+
     fine = cfg[0]
     return _mg_converge_loop(
-        vcycle,
+        cycle,
         lambda p, rhs: obstacle_residual_3d(
             p, rhs, fine["m"], fine["idx2"], fine["idy2"], fine["idz2"]
         ),
@@ -1597,7 +1922,8 @@ def make_dist_obstacle_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il,
                                    n_pre: int = 2, n_post: int = 2,
                                    n_coarse: int = 60,
                                    stall_rtol=MG_STALL_RTOL,
-                                   backend: str = "auto"):
+                                   backend: str = "auto",
+                                   fused: str = "off"):
     """Distributed 3-D obstacle-capable MG (shard_map kernel side) — the
     3-D twin of make_dist_obstacle_mg_solve_2d: GLOBAL flags coarsen by
     fluid-ANY per level, every level rediscretizes at ω=1 from its own
@@ -1666,6 +1992,24 @@ def make_dist_obstacle_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il,
             cb["kmax"], cb["jmax"], cb["imax"], 1, dtype)
         cb["even_g"] = checkerboard_mask_3d(
             cb["kmax"], cb["jmax"], cb["imax"], 0, dtype)
+    # tpu_mg_fused share of the distributed obstacle build — see the 2-D
+    # twin (FFT-preconditioned coarse application at over-budget bottoms)
+    from ..utils import dispatch as _dispatch
+
+    _dispatch.resolve_mg_fused(
+        fused, backend, dtype, "mg_dist_fused",
+        why_not="fused cycle is single-device; the distributed build gets "
+                "the coarse-aggregation seam below the shard floor",
+    )
+    bottom_fft = None
+    if bottom_exact is None and fused == "on":
+        bottom_fft = _make_fft_coarse_3d(
+            cb["m"], dx * 2 ** lvl_b, dy * 2 ** lvl_b, dz * 2 ** lvl_b,
+            cb["idx2"], cb["idy2"], cb["idz2"],
+            cb["odd_g"], cb["even_g"],
+        )
+        _dispatch.record("mg_dist_obstacle_coarse_3d",
+                         f"fft_richardson (n={_FFT_COARSE_ITERS})")
 
     # per-shard Pallas smoothers at eligible levels (the level's GLOBAL
     # flag field keeps the CA discipline bitwise — see the 2-D twin)
@@ -1709,16 +2053,22 @@ def make_dist_obstacle_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il,
 
     def bottom(p, rhs, lvl):
         c = cfg[lvl]
-        pg = _lax.all_gather(p[1:-1, 1:-1, 1:-1], "k", axis=0, tiled=True)
-        pg = _lax.all_gather(pg, "j", axis=1, tiled=True)
-        pg = _lax.all_gather(pg, "i", axis=2, tiled=True)
-        rg = _lax.all_gather(rhs[1:-1, 1:-1, 1:-1], "k", axis=0, tiled=True)
-        rg = _lax.all_gather(rg, "j", axis=1, tiled=True)
-        rg = _lax.all_gather(rg, "i", axis=2, tiled=True)
+        # declared aggregation boundary (analysis/commcheck census)
+        with jax.named_scope("mg_aggregate.obstacle3d"):
+            pg = _lax.all_gather(
+                p[1:-1, 1:-1, 1:-1], "k", axis=0, tiled=True)
+            pg = _lax.all_gather(pg, "j", axis=1, tiled=True)
+            pg = _lax.all_gather(pg, "i", axis=2, tiled=True)
+            rg = _lax.all_gather(
+                rhs[1:-1, 1:-1, 1:-1], "k", axis=0, tiled=True)
+            rg = _lax.all_gather(rg, "j", axis=1, tiled=True)
+            rg = _lax.all_gather(rg, "i", axis=2, tiled=True)
         pe = neumann_faces_3d(_embed3(pg))
         re = _embed3(rg)
         if bottom_exact is not None:
             pe = bottom_exact(pe, re)
+        elif bottom_fft is not None:
+            pe = bottom_fft(pe, re)
         else:
             for _ in range(n_coarse):
                 pe, _ = sor_pass_obstacle_3d(

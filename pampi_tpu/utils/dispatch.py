@@ -110,6 +110,51 @@ def resolve_fuse_phases(param, backend: str, dtype, probe, key: str,
     return True
 
 
+def resolve_mg_fused(knob: str, backend: str, dtype, key: str,
+                     why_not: str | None = None, probe=None) -> bool:
+    """`tpu_mg_fused` -> whether this MG build dispatches the fused
+    V-cycle kernels (ops/mg_fused.py: the whole restrict→smooth→prolong
+    chain as two dynamic-extent Pallas launches per cycle) instead of the
+    per-level smoother-launch ladder. Decision recorded under `key`
+    ("mg2d_fused", "mg3d_fused", "mg2d_obstacle_fused", ... — the factory
+    re-records with the launch/level census once the kernels are built).
+
+    Same contract as resolve_fuse_phases: "off" and the retry-fallback
+    backend are hard offs; `why_not` marks structurally ineligible plans
+    (single-level, VMEM-infeasible stacks, distributed builds — those
+    get the coarse-aggregation seam instead); "on" forces dispatch before
+    the backend checks (the interpret-mode force the parity tests and the
+    CPU smoke drive use); `probe` is the kernel-family one-time smoke."""
+    import jax
+    import jax.numpy as jnp
+
+    if knob not in ("auto", "on", "off"):
+        raise ValueError(f"tpu_mg_fused must be auto|on|off, got {knob!r}")
+    if knob == "off":
+        record(key, "jnp (tpu_mg_fused off)")
+        return False
+    if backend == "jnp":
+        record(key, "jnp (retry fallback backend)")
+        return False
+    if why_not is not None:
+        record(key, f"jnp ({why_not})")
+        return False
+    if knob == "on":
+        record(key, "pallas_fused_cycle (forced)")
+        return True
+    if jax.default_backend() != "tpu":
+        record(key, "jnp (no TPU)")
+        return False
+    if jnp.dtype(dtype).itemsize > 4:
+        record(key, "jnp (dtype not Mosaic-lowerable)")
+        return False
+    if probe is not None and not probe():
+        record(key, "jnp (probe failed)")
+        return False
+    record(key, "pallas_fused_cycle")
+    return True
+
+
 def resolve_overlap(param, key: str, why_not: str | None = None) -> bool:
     """`tpu_overlap` -> whether this dist build dispatches the
     double-buffered comm/compute-overlap schedule (parallel/overlap.py:

@@ -230,6 +230,18 @@ class Parameter:
     # the solve returns early (ops/multigrid.MG_STALL_RTOL rationale). Set 0
     # to disable and burn itermax like the reference's capped solves do.
     tpu_mg_stall_rtol: float = 1e-4
+    # fused MG cycle (tpu_solver mg only): auto|on|off. On eligible plans
+    # the whole V-cycle runs as TWO dynamic-extent Pallas launches (DOWN:
+    # smooth+restrict all levels, UP: prolong+smooth; ops/mg_fused.py)
+    # with the exact direct bottom solve between them, instead of the
+    # per-level smoother-launch ladder. "on" also enables the coarse-level
+    # continuation in the distributed MG bottoms (gather below the shard
+    # floor and keep coarsening globally — "mg_aggregate" seam) and the
+    # FFT-preconditioned coarse application for over-budget obstacle
+    # bottoms. "auto" dispatches the fused cycle on TPU only and keeps the
+    # historical distributed bottoms; "off" is bitwise the historical
+    # ladder. Decisions recorded via utils/dispatch ("mg2d_fused", ...).
+    tpu_mg_fused: str = "auto"
     # capped-solve flat path (models/poisson.make_solver_fn flat=True,
     # tpu_solver sor only): the pressure solve runs EXACTLY
     # ceil(itermax/n_inner) kernel trips under fori_loop instead of the

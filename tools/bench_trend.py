@@ -123,7 +123,15 @@ NAME_DIRECTIONS = {"comm_hidden_fraction": True,
                    # both lower-is-better; fleet_scenarios_per_s above
                    # stays the higher-is-better throughput headline
                    "fleet_p50_latency_ms": False,
-                   "fleet_queue_depth_max": False}
+                   "fleet_queue_depth_max": False,
+                   # the fused V-cycle launch census (ISSUE 16): Pallas
+                   # launches one mg V-cycle costs at the north-star
+                   # geometry (bench.py _mg_launch_line — a static trace
+                   # count, so the gate is exact on any backend). Fewer
+                   # is better: 2 is the fused DOWN/UP pair; a rise
+                   # means the cycle fell back to the per-level launch
+                   # ladder
+                   "mg_launches_per_cycle": False}
 
 
 def higher_is_better(unit, name: str | None = None) -> bool | None:

@@ -17,7 +17,9 @@ Contracts:
 - BENCH: {n, cmd, rc, tail} required. `parsed*` blocks (the JSON lines
   bench.py prints) need {metric, value, unit}; NS step-line blocks
   additionally carry the solve/non-solve decomposition keys (values may be
-  null off-TPU — the bench.py contract — but the KEYS must exist).
+  null off-TPU — the bench.py contract — but the KEYS must exist); the
+  mg launch-census block (mg_launches_per_cycle, ISSUE 16) additionally
+  carries {mg_dispatch, ladder_launches}.
 - BENCH + MULTICHIP both carry the normalized schema tools/_artifact.py
   writes: {schema_version, metrics} with every metrics entry shaped
   {name, value, unit, backend} and backend in {cpu, tpu} — the
@@ -64,6 +66,11 @@ PARSED_REQUIRED = ("metric", "value", "unit")
 # the decomposition keys every NS step line carries (bench.py
 # _step_decomposition_line; null values are legal off-TPU)
 DECOMP_KEYS = ("solve_ms", "nonsolve_ms", "phases", "steps_timed")
+# the mg launch-census line (bench.py _mg_launch_line /
+# tools/repro_mg4096.py, ISSUE 16): the dispatch decision and the
+# ladder comparison count must ride the block — a census that cannot
+# say WHICH cycle program it counted is not a census
+MG_LAUNCH_KEYS = ("mg_dispatch", "ladder_launches")
 SUMMARY_REQUIRED = ("schema_version", "dispatch", "chunks", "records")
 
 
@@ -278,6 +285,8 @@ def lint_bench(d: dict, where: str = "BENCH") -> list[str]:
         metric = str(block.get("metric", ""))
         if metric.startswith("ns2d_") and metric.endswith("ms_per_step"):
             errs += _missing(block, DECOMP_KEYS, f"{where}.{key}")
+        if metric == "mg_launches_per_cycle":
+            errs += _missing(block, MG_LAUNCH_KEYS, f"{where}.{key}")
     if isinstance(d.get("telemetry_summary"), dict):
         errs += lint_telemetry_summary(
             d["telemetry_summary"], f"{where}.telemetry_summary")

@@ -1,0 +1,240 @@
+"""MG fused-cycle smoke: the whole ISSUE 16 seam end-to-end on whatever
+backend this host has (make mg-smoke — CPU-safe via interpret mode).
+
+    python tools/mg_smoke.py [outdir]
+
+Proves, before any TPU time is spent:
+
+- PARITY: the fused V-cycle (tpu_mg_fused on — the DOWN/UP Pallas pair)
+  converges to the SAME iterate as the per-level jnp ladder (off) in the
+  same number of cycles, on 2-D/3-D × plain/obstacle. The bottom budgets
+  are shrunk so the tiny smoke grids build real multi-level plans (the
+  same geometry trick tests/test_mg_fused.py uses).
+- LAUNCH COUNT: every fused solve's traced program carries EXACTLY the
+  2 pallas_calls its dispatch record advertises ("launches=2"), and the
+  one-launch class cycle exactly 1 — the amortization property the
+  kernels exist for, pinned statically (jaxprcheck.count_prim).
+- REFUSAL: a ragged single-level plan refuses the fused cycle WITH a
+  recorded reason (the dispatch record is the contract surface).
+- the telemetry plane: the `mg_launches_per_cycle` metric record, the
+  merge into a BENCH-shaped artifact, and `tools/check_artifact.py`
+  accepting the merged block (incl. the MG_LAUNCH_KEYS census keys).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU-stable smoke environment: must precede any jax import (the
+# tools/lint.py convention); a TPU image just keeps its own backend
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+# fused-vs-ladder tolerance: both paths run the identical red-black ω=1
+# arithmetic but the fused kernel evaluates full planes with masked-out
+# dead cells, so f32 summation order differs at the ulp scale
+TOL = 2e-5
+
+
+def _parity(failures: list[str]) -> list[dict]:
+    """The four fused-vs-ladder cases + launch pins. Returns the metric
+    lines recorded along the way."""
+    import jax
+    import jax.numpy as jnp
+
+    from pampi_tpu.analysis.jaxprcheck import count_prim
+    from pampi_tpu.ops import multigrid as mg
+    from pampi_tpu.ops import obstacle as obst
+    from pampi_tpu.ops.obstacle3d import make_masks_3d
+    from pampi_tpu.utils import dispatch as disp
+    from pampi_tpu.utils import telemetry as tm
+
+    dtype = jnp.float32
+    lines = []
+
+    # shrink the bottom budgets so 32²/16³ build REAL multi-level plans
+    # (at the default budgets these grids are single-level and the fused
+    # cycle would correctly refuse — a vacuous smoke)
+    dct_save = mg._DCT_BOTTOM_MAX_CELLS
+    dense_save = mg._DENSE_BOTTOM_MAX_CELLS
+
+    def case(tag, key, make_pair, p0, rhs):
+        s_off = jax.jit(make_pair("off"))
+        fn_on = make_pair("on")
+        rec = disp.last(key) or ""
+        s_on = jax.jit(fn_on)
+        if not rec.startswith("pallas_fused_cycle"):
+            failures.append(f"{tag}: dispatch {key} = {rec!r} — the "
+                            "forced fused cycle did not dispatch")
+            return
+        n_launch = count_prim(jax.make_jaxpr(fn_on)(p0, rhs).jaxpr,
+                              "pallas_call")
+        if n_launch != 2 or "launches=2" not in rec:
+            failures.append(
+                f"{tag}: traced solve carries {n_launch} pallas_call(s) "
+                f"vs the 2-launch census {rec!r}")
+        a, b = s_off(p0, rhs), s_on(p0, rhs)
+        d = float(jnp.max(jnp.abs(a[0] - b[0])))
+        m = max(float(jnp.max(jnp.abs(a[0]))), 1.0)
+        it_off, it_on = int(a[2]), int(b[2])
+        print(f"[{tag}] {rec} | it {it_off}/{it_on} | "
+              f"maxdiff {d:.3g} (scale {m:.3g})")
+        if it_off != it_on:
+            failures.append(f"{tag}: fused took {it_on} cycles, the "
+                            f"ladder {it_off}")
+        if d > TOL * m:
+            failures.append(f"{tag}: fused-vs-ladder maxdiff {d:.3g} "
+                            f"beyond {TOL} of scale {m:.3g}")
+        line = {"metric": "mg_launches_per_cycle", "value": n_launch,
+                "unit": "launches/cycle", "mg_dispatch": rec,
+                "ladder_launches": count_prim(
+                    jax.make_jaxpr(make_pair("off"))(p0, rhs).jaxpr,
+                    "pallas_call"),
+                "config": f"{tag} (smoke)"}
+        tm.emit("metric", **line)
+        lines.append(line)
+
+    try:
+        # 2-D plain 32² (DCT budget 64 -> 2 levels)
+        mg._DCT_BOTTOM_MAX_CELLS = 64
+        n, h = 32, 1.0 / 32
+        rng = np.random.default_rng(0)
+        rhs = jnp.zeros((n + 2, n + 2), dtype).at[1:-1, 1:-1].set(
+            jnp.asarray(rng.standard_normal((n, n)), dtype))
+        p0 = jnp.zeros_like(rhs)
+        case("plain2d", "mg2d_fused",
+             lambda fused: mg.make_mg_solve_2d(
+                 n, n, h, h, 0.0, 3, dtype, stall_rtol=0, fused=fused),
+             p0, rhs)
+
+        # 2-D obstacle 32² (dense budget 64 -> 2 levels)
+        mg._DENSE_BOTTOM_MAX_CELLS = 64
+        fluid = np.ones((n + 2, n + 2), bool)
+        fluid[10:18, 12:22] = False
+        m2 = obst.make_masks(fluid, h, h, 1.7, dtype)
+        case("obs2d", "mg2d_obstacle_fused",
+             lambda fused: mg.make_obstacle_mg_solve_2d(
+                 n, n, h, h, 0.0, 3, m2, dtype, stall_rtol=0,
+                 fused=fused),
+             p0, rhs)
+
+        # 3-D plain 16³ (DCT budget 512 -> 2 levels)
+        mg._DCT_BOTTOM_MAX_CELLS = 512
+        n3, h3 = 16, 1.0 / 16
+        rhs3 = jnp.zeros((n3 + 2,) * 3, dtype).at[1:-1, 1:-1, 1:-1].set(
+            jnp.asarray(rng.standard_normal((n3, n3, n3)), dtype))
+        p3 = jnp.zeros_like(rhs3)
+        case("plain3d", "mg3d_fused",
+             lambda fused: mg.make_mg_solve_3d(
+                 n3, n3, n3, h3, h3, h3, 0.0, 3, dtype, stall_rtol=0,
+                 fused=fused),
+             p3, rhs3)
+
+        # 3-D obstacle 16³ (dense budget 512 -> 2 levels)
+        mg._DENSE_BOTTOM_MAX_CELLS = 512
+        fl3 = np.ones((n3 + 2,) * 3, bool)
+        fl3[6:10, 5:9, 7:12] = False
+        m3 = make_masks_3d(fl3, h3, h3, h3, 1.7, dtype)
+        case("obs3d", "mg3d_obstacle_fused",
+             lambda fused: mg.make_obstacle_mg_solve_3d(
+                 n3, n3, n3, h3, h3, h3, 0.0, 3, m3, dtype, stall_rtol=0,
+                 fused=fused),
+             p3, rhs3)
+    finally:
+        mg._DCT_BOTTOM_MAX_CELLS = dct_save
+        mg._DENSE_BOTTOM_MAX_CELLS = dense_save
+
+    # refusal: a ragged (odd-extent) grid is a single-level plan at the
+    # default budget — the knob forced on must still refuse WITH a reason
+    mg.make_mg_solve_2d(33, 33, 1 / 33, 1 / 33, 0.0, 2, dtype,
+                        stall_rtol=0, fused="on")
+    reason = disp.last("mg2d_fused") or ""
+    print(f"[ragged] mg2d_fused = {reason}")
+    if not (reason.startswith("jnp") and "single-level" in reason):
+        failures.append(f"ragged 33²: refusal reason missing from the "
+                        f"dispatch record ({reason!r})")
+
+    # the one-launch class cycle (fleet lane): exactly 1 pallas_call
+    import jax
+
+    from pampi_tpu.ops import mg_fused as mf
+
+    cycle, plane, lmax = mf.make_class_cycle_2d(16, 16, dtype,
+                                                interpret=True)
+    live = jnp.asarray(12, jnp.int32)
+    inv2 = jnp.asarray(144.0, dtype)
+    ext, geo = mf.class_level_plan(live, live, inv2, inv2, lmax, dtype)
+    z = jnp.zeros(plane, dtype)
+    n_class = count_prim(
+        jax.make_jaxpr(cycle)(z, z, ext, geo).jaxpr, "pallas_call")
+    print(f"[class] cycle launches = {n_class} (levels<={lmax})")
+    if n_class != 1:
+        failures.append(f"class cycle carries {n_class} pallas_call(s), "
+                        "the one-launch contract says 1")
+    return lines
+
+
+def main(argv: list[str]) -> int:
+    outdir = argv[1] if len(argv) > 1 else os.path.join(
+        REPO, "results", "mg_smoke")
+    os.makedirs(outdir, exist_ok=True)
+    jsonl = os.path.join(outdir, "run.jsonl")
+    if os.path.exists(jsonl):
+        os.remove(jsonl)
+    os.environ["PAMPI_TELEMETRY"] = jsonl
+
+    from pampi_tpu.utils import telemetry as tm
+
+    tm.reset()
+    tm.start_run(tool="mg_smoke")
+    failures: list[str] = []
+    lines = _parity(failures)
+    tm.finalize()
+
+    # the telemetry plane end-to-end
+    from tools import telemetry_report as tr
+
+    records = tr.load(jsonl)
+    metric = [r for r in records if r.get("kind") == "metric"
+              and r.get("metric") == "mg_launches_per_cycle"]
+    if len(metric) != len(lines):
+        failures.append(f"{len(metric)} mg_launches_per_cycle records in "
+                        f"the flight record, {len(lines)} emitted")
+
+    # the merge + lint round trip (incl. the MG_LAUNCH_KEYS block rule)
+    artifact = os.path.join(outdir, "MG_SMOKE.json")
+    if os.path.exists(artifact):
+        os.remove(artifact)
+    from tools._artifact import write_merged
+    from tools.check_artifact import lint_bench
+
+    block = {"n": 0, "cmd": "mg_smoke", "rc": 0, "tail": "",
+             "telemetry_summary": tr.summary(records)}
+    if lines:
+        block["parsed_mg"] = lines[0]
+    merged = write_merged(artifact, block)
+    failures += lint_bench(merged, "MG_SMOKE")
+    if not any(m.get("name") == "mg_launches_per_cycle"
+               for m in merged.get("metrics", [])):
+        failures.append("merged artifact carries no normalized "
+                        "mg_launches_per_cycle metric")
+
+    if failures:
+        print("\nMG SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"\nmg smoke ok: {len(lines)} fused-vs-ladder parity cases at "
+          "2 launches/cycle each, the class cycle at 1, ragged refusal "
+          "recorded, artifact lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -18,6 +18,7 @@ re-runs once on a fault to separate the persistent failure from the
 transient-infra class (models/_driver._is_transient_device_fault).
 """
 
+import json
 import os
 import sys
 
@@ -47,6 +48,37 @@ def _attempt(label, fn):
                 f"{str(e)[:300]}"
             )
     return False
+
+
+def launch_census():
+    """The mg_launches_per_cycle line at the repro geometry (the same
+    metric protocol as bench.py's _mg_launch_line — one static trace per
+    knob setting, no device work), printed before any stage runs so a
+    faulting stage still leaves the census on record: the fault's
+    character (launch-bound ladder vs the 2-launch fused cycle) is the
+    first thing the isolation needs."""
+    from pampi_tpu.analysis.jaxprcheck import count_prim
+    from pampi_tpu.ops.multigrid import make_mg_vcycle_2d
+    from pampi_tpu.utils import dispatch, telemetry
+
+    def cycle_launches(fused):
+        vc = make_mg_vcycle_2d(N, N, 1.0 / N, 1.0 / N, jnp.float32,
+                               fused=fused)
+        z = jnp.zeros((N + 2, N + 2), jnp.float32)
+        return count_prim(jax.make_jaxpr(vc)(z, z).jaxpr, "pallas_call")
+
+    ladder = cycle_launches("off")
+    fused = cycle_launches("on")
+    line = {
+        "metric": "mg_launches_per_cycle",
+        "value": fused,
+        "unit": "launches/cycle",
+        "mg_dispatch": dispatch.last("mg2d_fused"),
+        "ladder_launches": ladder,
+        "config": f"dcavity {N}^2 f32 mg vcycle (repro)",
+    }
+    telemetry.emit("metric", **line)
+    print(json.dumps(line), flush=True)
 
 
 def stage1():
@@ -85,6 +117,11 @@ def stage3():
 
 if __name__ == "__main__":
     print(f"backend={jax.default_backend()} N={N}")
+    try:
+        launch_census()
+    except Exception as e:  # noqa: BLE001 - census must not sink the repro
+        print(f"launch census failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     ok = True
     for st, fn in (("1-mg-solve-alone", stage1), ("2-ns-step", stage2), ("3-ns-chunk-driver", stage3)):
         if st[0] in STAGES:
