@@ -107,6 +107,15 @@ lint-comm:
 mg-smoke:
 	JAX_PLATFORMS=cpu python tools/mg_smoke.py
 
+# K-fused chunk smoke (ISSUE 17): K=4-vs-historical parity on the dist
+# family (jnp bitwise, fused at the ulp contract), the per-tier depth
+# census (exactly 1 dcn capture exchange per field per 4 steps, ici
+# unchanged, tier bytes == flat census), the launches-per-step < 3
+# static pin, and the launches_per_step telemetry/merge/lint round
+# trip. rc 0 = the whole K-fusion seam holds before any TPU time.
+chunk-smoke:
+	JAX_PLATFORMS=cpu python tools/chunk_smoke.py
+
 # The full mg-fused test file INCLUDING the slow-marked cases (3-D
 # parity, the class-lane-vs-solo and rung-invariance contracts, the
 # FFT coarse correction — tier-1 carries one cheap representative per
@@ -184,7 +193,8 @@ distclean:
 	rm -rf build exe-*
 
 .PHONY: all test asm format telemetry-report check-artifacts bench-trend \
-	profile-smoke mg-smoke mg-suite fleet-smoke serve-smoke fleet-suite \
+	profile-smoke mg-smoke chunk-smoke mg-suite fleet-smoke serve-smoke \
+	fleet-suite \
 	lint \
 	lint-update lint-comm \
 	fault-suite dead-rank-smoke ckpt-fsck clean distclean

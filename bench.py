@@ -11,6 +11,11 @@ Prints FOUR JSON lines:
   {"metric": "mg_launches_per_cycle", "value": N, "mg_dispatch": ...,
    "ladder_launches": ...}  (ISSUE 16: the fused V-cycle's static launch
    census — 2 with the DOWN/UP cycle kernels dispatched)
+plus the ISSUE 17 serving/fusion lines: TWO "ns2d_small_ms_per_step"
+lines (64² and 256² serving-regime dcavity, K=4 fused chunk, with the
+historical one-step chunk's ms/step on the same line for the measured
+win) and one "launches_per_step" line (static Pallas census of a traced
+K=4 chunk divided by K — the < 3/step fusion-contract number).
 
 The second line is the metric the fused step-phase kernels move (round 6):
 the NS-2D north-star step time WITH its solve/non-solve decomposition, so
@@ -236,6 +241,93 @@ def _ns2d_obstacle_step_line():
     )
 
 
+def _ns2d_small_step_line():
+    """Small-grid serving-regime step lines (ISSUE 17): at 64²/256² the
+    per-step envelope (loop plumbing, metrics latch, dispatch floor on
+    TPU) is a first-order cost the 4096² north-star line cannot see —
+    exactly the budget the K-fused chunk amortizes. Runs the SAME
+    protocol as the big line (`_step_decomposition_line`) with the
+    production K forced on (`tpu_chunk_fuse=4` traces the scan on any
+    backend), and attaches the historical one-step-per-body chunk's
+    ms/step to the same line so the artifact carries the measured win,
+    not just the fused number. One line per grid, one shared metric
+    name — the normalized trend series gates on the first (64²) point;
+    the 256² twin stays a parsed block keyed by its config string."""
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.utils import dispatch
+    from pampi_tpu.utils.params import Parameter as _P
+
+    lines = []
+    for n in (64, 256):
+        steps = 16
+
+        def small_param(fuse):
+            return _P(
+                name="dcavity", imax=n, jmax=n, re=100.0, te=1e9,
+                tau=0.5, itermax=20, eps=1e-3, omg=1.7, gamma=0.9,
+                tpu_dtype="float32", tpu_sor_inner=8, tpu_flat_solve=1,
+                tpu_chunk=steps, tpu_chunk_fuse=fuse,
+            )
+
+        # one serving-regime chunk is ~10 ms of work — the opposite end
+        # of the latency-floor spectrum from the seconds-long headline
+        # dispatches, so best-of-MANY cheap reps is what amortizes the
+        # scheduler jitter here (the Poisson line's best-of-12 logic)
+        reps = 24
+        line = _step_decomposition_line(
+            small_param("4"), "ns2d_small_ms_per_step",
+            f"dcavity {n}^2 f32 serving-regime itermax=20 flat K=4",
+            steps, reps,
+        )
+        line["chunk_fuse"] = dispatch.last("ns2d_chunk_fuse")
+        # the A/B the fusion moves: the identical config at the
+        # historical chunk (tpu_chunk_fuse=off — bitwise the pre-ISSUE-17
+        # trace), timed with the same fence/best-of protocol
+        s = NS2DSolver(small_param("off"), dtype=jnp.float32)
+        state = s.initial_state()
+        out = s._chunk_fn(*state)
+        float(out[3])
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = s._chunk_fn(*state)
+            float(out[3])
+            best = min(best, time.perf_counter() - t0)
+        line["historical_ms_per_step"] = round(best / steps * 1e3, 3)
+        lines.append(line)
+    return lines
+
+
+def _launches_per_step_line():
+    """Static launches-per-step census (ISSUE 17): the Pallas launch
+    count of ONE traced K-fused chunk divided by K — the scan body
+    traces once, so the static count covers K steps and the quotient is
+    the per-step launch budget the fusion contract pins (< 3 for K ≥ 2,
+    enforced by analysis/jaxprcheck.check_config). Counted from the
+    standard-matrix `ns2d_fused_fft_k4` config (forced K=4, so the scan
+    traces on any backend) — exact, no timing, same census protocol as
+    `_mg_launch_line`."""
+    from pampi_tpu.analysis import jaxprcheck as jc
+    from pampi_tpu.utils import telemetry
+
+    cfg = next(c for c in jc.standard_configs()
+               if c.name == "ns2d_fused_fft_k4")
+    tc = jc.trace_config(cfg)
+    k = jc.chunk_fuse_k(tc.decisions)
+    n_launch = jc.count_prim(tc.jaxpr.jaxpr, "pallas_call")
+    line = {
+        "metric": "launches_per_step",
+        "value": n_launch / k,
+        "unit": "launches/step",
+        "chunk_fuse_dispatch": tc.decisions.get("ns2d_chunk_fuse"),
+        "pallas_calls": n_launch,
+        "k": k,
+        "config": cfg.name,
+    }
+    telemetry.emit("metric", **line)
+    return line
+
+
 def _mg_launch_line():
     """The mg launch census (ISSUE 16): how many Pallas launches ONE
     V-cycle costs at the north-star mg geometry, counted STATICALLY from
@@ -312,6 +404,17 @@ def main() -> None:
         print(json.dumps(_mg_launch_line()), flush=True)
     except Exception as exc:
         print(f"mg launch line failed ({type(exc).__name__}: {exc})",
+              file=sys.stderr)
+    try:
+        for small in _ns2d_small_step_line():
+            print(json.dumps(small), flush=True)
+    except Exception as exc:
+        print(f"ns2d small step line failed ({type(exc).__name__}: {exc})",
+              file=sys.stderr)
+    try:
+        print(json.dumps(_launches_per_step_line()), flush=True)
+    except Exception as exc:
+        print(f"launches-per-step line failed ({type(exc).__name__}: {exc})",
               file=sys.stderr)
 
 

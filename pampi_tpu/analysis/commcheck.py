@@ -481,7 +481,16 @@ def _expected_strips(rec: dict) -> list[tuple[str, int, bool]]:
     the same chunk program and are added to the exact count. The depth-1
     class is checked at-least: its strip shape is shared with the
     staggered shifts and with depth-1 exchanges inside solve/POST
-    plumbing the record deliberately excludes."""
+    plumbing the record deliberately excludes.
+
+    A record carrying `exchange_depths` (ISSUE 17, the per-tier depth
+    map) reroutes the mapped axes: their per-step deep strips are GONE
+    from the trace — replaced by one depth-H capture pair per H-step
+    block, whose strip geometry is `halo_strip_shapes(shard, H)` and
+    whose exact count is 2 x `exchanges_per_block["deep"]` (the K-scan
+    body traces once, so the traced chunk carries exactly one block's
+    capture). Unmapped axes keep the historical exact pin — the ICI
+    depth is provably unchanged."""
     from ..parallel.comm import halo_strip_shapes
 
     import numpy as np
@@ -491,13 +500,22 @@ def _expected_strips(rec: dict) -> list[tuple[str, int, bool]]:
     dtype = np.dtype(rec["dtype"])
     per_step = rec.get("exchanges_per_step", {})
     per_chunk = rec.get("exchanges_per_chunk", {})
+    depths = rec.get("exchange_depths") or {}
+    axes = rec.get("axes") or []
     out = []
     if "deep" in per_step:
         shapes = halo_strip_shapes(shard, rec["deep_halo"])
         deep = per_step["deep"] + per_chunk.get("deep", 0)
         for ax, shape in enumerate(shapes):
-            if mesh[ax] > 1:
+            if mesh[ax] > 1 and not (
+                    ax < len(axes) and axes[ax] in depths):
                 out.append((strip_key(shape, dtype), 2 * deep, True))
+        if depths:
+            epb = rec.get("exchanges_per_block", {}).get("deep", deep)
+            for ax, name in enumerate(axes):
+                if mesh[ax] > 1 and name in depths:
+                    cap = halo_strip_shapes(shard, depths[name])[ax]
+                    out.append((strip_key(cap, dtype), 2 * epb, True))
     if "depth1" in per_step:
         shapes = halo_strip_shapes(shard, 1)
         # one staggered shift per axis (F/G/H donor edges) shares the
@@ -619,6 +637,44 @@ def check_config(traced, baseline: dict | None,
     if entry["halo"] is not None:
         for msg in crosscheck_record(entry["halo"], entry):
             emit(RULE_XCHECK, msg)
+    # the per-tier depth pin (ISSUE 17): a record declaring
+    # `exchange_depths` claims the mapped slow-fabric axis ships ONE
+    # depth-H strip pair per field per H-step block instead of one per
+    # step. The traced K-block is the proof: the mapped axis's tier
+    # must carry EXACTLY 2 x exchanges_per_block["deep"] ppermutes of
+    # the depth-H capture strip and ZERO of the historical per-step
+    # deep strip — "1 slow-tier exchange per H steps", statically.
+    rec = entry["halo"]
+    if rec and rec.get("exchange_depths") and "tiers" in entry:
+        from ..parallel.comm import halo_strip_shapes
+
+        import numpy as np
+
+        shard = tuple(rec["shard"])
+        dtype = np.dtype(rec["dtype"])
+        axes = rec.get("axes") or []
+        tmap = rec.get("tier_map") or {}
+        epb = rec.get("exchanges_per_block", {}).get("deep", 0)
+        for name, h in rec["exchange_depths"].items():
+            ax = axes.index(name)
+            cap_key = strip_key(halo_strip_shapes(shard, h)[ax], dtype)
+            deep_key = strip_key(
+                halo_strip_shapes(shard, rec["deep_halo"])[ax], dtype)
+            tier = tmap.get(name, "untiered")
+            tstrips = entry["tiers"].get(tier, {}).get("strips", {})
+            have = tstrips.get(cap_key, 0)
+            if have != 2 * epb:
+                emit(RULE_TIER,
+                     f"depth map {name}={h}: the {tier} tier carries "
+                     f"{have} capture-strip ({cap_key}) ppermute(s) per "
+                     f"K-block, the record declares exactly {2 * epb} — "
+                     "the amortized slow exchange drifted")
+            if tstrips.get(deep_key, 0):
+                emit(RULE_TIER,
+                     f"depth map {name}={h}: the {tier} tier still "
+                     f"carries {tstrips[deep_key]} per-step deep strip "
+                     f"({deep_key}) ppermute(s) — the step-level "
+                     "exchange was amortized AND kept")
     # baseline comparison — env-gated like the jaxpr hash: collective
     # schedules follow the solve dispatch, which follows toolchain probes
     if baseline is not None and env_matches:

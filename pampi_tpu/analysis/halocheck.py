@@ -502,6 +502,77 @@ def overlap_interior_entry_3d(smuggle: int = 0,
     )
 
 
+def depth_capture_violations(extents, depth: int, inner: int) -> list:
+    """The widened footprint of the per-tier depth capture (ISSUE 17,
+    `comm.capture_axis_strips`), re-derived from first principles and
+    checked against the production slice arithmetic's geometry. The
+    capture pads the 1-ghost extended block by depth-1, exchanges the
+    padded block at depth H on the slow axis, and crops two inner-deep
+    paste-ready strips — four facts must hold for the strips to carry
+    only VALID donor cells:
+
+      1. the shipped depth-H edge window, mapped back into the donor's
+         extended frame, is [e-H+1, e+1) — it stays inside the donor's
+         owned+ghost cells iff H <= e (the `resolve_exchange_depth`
+         shard-extent floor; a deeper capture would ship pad zeros);
+      2. the receiver's crop window [H-inner, H) lies inside the
+         received depth block iff inner <= H (the capture's own
+         ValueError guard);
+      3. the paste windows [0, inner) and [n-inner, n) exactly tile
+         the deep block's ghost ring (n = e + 2*inner), overlapping no
+         owned cell;
+      4. the capture's ppermute message shape equals
+         `halo_strip_shapes(extents, H)` on the captured axis — the
+         commcheck census and the byte accounting key the amortized
+         exchange by exactly that strip.
+
+    Gradient entries cannot measure this (the exchange is mesh-bound:
+    ppermute needs an axis binding `measure()` cannot provide); the
+    runtime twin is tools/chunk_smoke.py's bitwise pin of the step-0
+    paste against a fresh deep exchange."""
+    from ..parallel import comm as pcomm
+
+    vs = []
+    path, line = _anchor(pcomm.capture_axis_strips)
+
+    def emit(msg):
+        vs.append(Violation(
+            path, line, RULE,
+            f"capture_axis_strips[extents={tuple(extents)}, depth={depth}, "
+            f"inner={inner}]: {msg}"))
+
+    for ax, e in enumerate(extents):
+        # (1) the shipped window in the donor frame
+        lo_cell = e - depth + 1
+        if lo_cell < 1:
+            emit(f"axis {ax}: the depth-{depth} edge window starts at cell "
+                 f"{lo_cell} of the donor's extended block — outside the "
+                 f"owned cells [1, {e}] when the shard extent {e} < depth, "
+                 "so the capture would ship ghost/pad contents "
+                 "(resolve_exchange_depth must refuse this geometry)")
+        # (2) the crop window
+        if inner > depth:
+            emit(f"crop window [{depth - inner}, {depth}) underruns the "
+                 f"received depth block — inner {inner} > depth {depth}")
+        # (3) the paste ring tiling
+        n = e + 2 * inner
+        if inner * 2 > n:
+            emit(f"axis {ax}: paste windows [0, {inner}) and "
+                 f"[{n - inner}, {n}) overlap an owned cell of the "
+                 f"{n}-deep block")
+        # (4) the census strip geometry
+        want = pcomm.halo_strip_shapes(extents, depth)[ax]
+        widened = tuple(
+            depth if a == ax else extents[a] + 2 * depth
+            for a in range(len(extents)))
+        if want != widened:
+            emit(f"axis {ax}: the widened capture strip {widened} drifted "
+                 f"from halo_strip_shapes(extents, {depth}) = {want} — "
+                 "the commcheck census would mis-key the amortized "
+                 "exchange")
+    return vs
+
+
 def standard_entries() -> list:
     """The production registry: every deep-halo contract the dispatch
     layer relies on. Kept cheap (tiny blocks, one linearization each) so
@@ -546,4 +617,10 @@ def check_all(entries=None, seed: int = 0) -> list[Violation]:
     vs: list[Violation] = []
     for entry in (standard_entries() if entries is None else entries):
         vs += check_entry(entry, seed=seed)
+    if entries is None:
+        # the per-tier depth capture at the matrix geometry
+        # (jaxprcheck ns2d_dist_depth: 16^2 on (2,2), i=dcn at H=4)
+        from ..ops import ns2d_fused as nf
+
+        vs += depth_capture_violations((8, 8), 4, nf.FUSE_DEEP_HALO)
     return vs

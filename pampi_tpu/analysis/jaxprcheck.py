@@ -618,7 +618,95 @@ def standard_configs() -> list[ChunkConfig]:
                   "the chunk is jnp phases + exactly one launch — two "
                   "DIFFERENT grids ride the same class program via the "
                   "traced-scalar level plan"),
+        # K-step fused chunks (ISSUE 17): tpu_chunk_fuse=<K> is forced,
+        # so the scan-wrapped chunks trace on CPU. The launch contracts
+        # are the SAME counts as the K=1 twins — the scan body traces
+        # ONCE, which is the whole point: the static launches-per-step
+        # is count/K, derived from the "scan (K=...)" dispatch record
+        # and pinned < 3 in check_config.
+        ChunkConfig(
+            "ns2d_fused_fft_k4", "ns2d",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="fft",
+                 tpu_chunk_fuse="4"),
+            expected_pallas=2,
+            dispatch_keys=("ns2d_phases", "ns2d_chunk_fuse"),
+            notes="K=4 scan chunk: still PRE + POST exactly — 0.5 "
+                  "launches/step"),
+        ChunkConfig(
+            "ns3d_fused_fft_k4", "ns3d",
+            dict(_B3, tpu_fuse_phases="on", tpu_solver="fft",
+                 tpu_chunk_fuse="4"),
+            expected_pallas=2,
+            dispatch_keys=("ns3d_phases", "ns3d_chunk_fuse"),
+            notes="the 3-D K=4 scan chunk: PRE + POST exactly"),
+        ChunkConfig(
+            "ns2d_dist_fused_k4", "ns2d_dist",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard", tpu_chunk_fuse="4"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist", "ns2d_dist_chunk_fuse"),
+            notes="the K=4 dist scan keeps the K=1 launch budget"),
+        ChunkConfig(
+            "ns2d_dist_ragged_k4", "ns2d_dist",
+            dict(_B2, imax=18, jmax=18, tpu_fuse_phases="on",
+                 tpu_solver="sor", tpu_sor_layout="checkerboard",
+                 tpu_chunk_fuse="4"),
+            dims=(4, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist", "ns2d_dist_chunk_fuse"),
+            notes="ragged shards ride the K-scan at uneven bounds"),
+        ChunkConfig(
+            "ns2d_dist_obstacle_k4", "ns2d_dist",
+            dict(_OBS, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard", tpu_chunk_fuse="4"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="obstacle_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "obstacle_dist", "overlap_ns2d_dist",
+                           "ns2d_dist_chunk_fuse"),
+            notes="dist obstacle flag blocks compose under the K-scan"),
+        ChunkConfig(
+            "ns2d_dist_depth", "ns2d_dist",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard", tpu_mesh_tiers="i=dcn",
+                 tpu_chunk_fuse="4", tpu_exchange_depth="i=4"),
+            dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
+            solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
+            dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
+                           "overlap_ns2d_dist", "ns2d_dist_chunk_fuse",
+                           "ns2d_dist_exchange_depth"),
+            notes="per-tier exchange depth: the dcn i axis captures ONE "
+                  "depth-4 strip pair per 4-step block (commcheck "
+                  "census pins 1 slow exchange per H steps; relaxed "
+                  "parity, explicit opt-in)"),
+        ChunkConfig(
+            "ns3d_dist_fused_k4", "ns3d_dist",
+            dict(_B3, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_chunk_fuse="4"),
+            dims=(2, 2, 2), derive=True, phases_key="ns3d_dist_phases",
+            solve_key="ns3d_dist", overlap_key="overlap_ns3d_dist",
+            dispatch_keys=("ns3d_dist_phases", "ns3d_dist",
+                           "overlap_ns3d_dist", "ns3d_dist_chunk_fuse"),
+            notes="the 3-D K=4 dist scan keeps the K=1 launch budget"),
     ]
+
+
+def chunk_fuse_k(decisions: dict) -> int:
+    """The K a traced chunk actually fused, read off its chunk_fuse
+    dispatch record. Only a "scan (K=...)" record counts — every
+    refusal spelling ("historical (...)") means the chunk advances one
+    step per body and the per-step launch math divides by 1."""
+    for dkey, dval in decisions.items():
+        if not dkey.endswith("chunk_fuse"):
+            continue
+        sval = str(dval or "")
+        km = re.search(r"scan \(K=(\d+)", sval)
+        if km:
+            return int(km.group(1))
+    return 1
 
 
 def expected_launches(cfg: ChunkConfig, decisions: dict):
@@ -763,6 +851,21 @@ def check_config(cfg: ChunkConfig, baseline: dict | None,
                  f"dispatch {dkey} = {dval!r} advertises "
                  f"{lm.group(1)} launches/cycle — the fused-cycle "
                  "contract pins <= 3")
+    # launches-per-step (ISSUE 17): a K-fused chunk's scan body traces
+    # ONCE, so the static pallas count covers K steps. The per-step
+    # ratio is the serving-regime launch metric (bench.py threads it as
+    # `launches_per_step`) and is pinned < 3 for any config that traced
+    # with K >= 2 — a K-scan that still multiplies launches per step
+    # has lost the whole point of fusing across the step boundary.
+    kf = chunk_fuse_k(decisions)
+    if kf >= 2:
+        lps = sig["pallas_calls"] / kf
+        entry["launches_per_step"] = lps
+        if lps >= 3:
+            emit(RULE_LAUNCH,
+                 f"K={kf} chunk lowers to {sig['pallas_calls']} pallas "
+                 f"launch(es) = {lps:.2f}/step — the K-fusion contract "
+                 "pins < 3 launches per step")
     # host callbacks only behind armed flags
     from ..utils import flags as _flags
 

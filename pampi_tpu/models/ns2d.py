@@ -350,7 +350,7 @@ class NS2DSolver:
         return step
 
     def _build_fused_chunk(self, backend: str, metrics: bool = False,
-                           te_arg: bool = False):
+                           te_arg: bool = False, kfuse: int = 1):
         """The fused-phase chunk: the non-solve step phases run as the two
         Pallas kernels of ops/ns2d_fused.py (BCs+FG+RHS before the solve,
         adaptUV+CFL-max after), the loop carries u/v in the kernels' padded
@@ -528,12 +528,29 @@ class NS2DSolver:
             def cond(c):
                 return jnp.logical_and(c[3] <= te, c[7] < chunk)
 
-            def body(c):
-                up, vp, p, t, nt, umax, vmax, k = c
-                up, vp, p, t, nt, umax, vmax = step(
-                    up, vp, p, t, nt, umax, vmax
-                )
-                return up, vp, p, t, nt, umax, vmax, k + 1
+            if kfuse > 1:
+                # K-step fused trips (ISSUE 17): one scan advances K
+                # gated steps — past te the frozen branch is an identity
+                # on the carry, so nt/t stay exact at the boundary
+                def kblock(c, _):
+                    def live(c):
+                        return step(*c)
+
+                    return lax.cond(c[3] <= te, live, lambda c: c, c), None
+
+                def body(c):
+                    up, vp, p, t, nt, umax, vmax, k = c
+                    (up, vp, p, t, nt, umax, vmax), _ = lax.scan(
+                        kblock, (up, vp, p, t, nt, umax, vmax), None,
+                        length=kfuse)
+                    return up, vp, p, t, nt, umax, vmax, k + kfuse
+            else:
+                def body(c):
+                    up, vp, p, t, nt, umax, vmax, k = c
+                    up, vp, p, t, nt, umax, vmax = step(
+                        up, vp, p, t, nt, umax, vmax
+                    )
+                    return up, vp, p, t, nt, umax, vmax, k + 1
 
             up, vp, p, t, nt, _um, _vm, _k = lax.while_loop(
                 cond, body,
@@ -554,16 +571,45 @@ class NS2DSolver:
             def cond(c):
                 return jnp.logical_and(c[3] <= te, c[7] < chunk)
 
-            def body(c):
-                up, vp, p, t, nt, umax, vmax, k, res, it, dtv, bad = c
-                up, vp, p, t, nt, umax, vmax, res, it, dtv = step(
-                    up, vp, p, t, nt, umax, vmax
-                )
-                # maxima stay native-dtype in the carry (the CFL scalars);
-                # metrics_step's f32 copies feed only the sentinel
-                res, it, dtv, _um, _vm, bad = _tm.metrics_step(
-                    bad, nt, res, it, dtv, umax, vmax)
-                return up, vp, p, t, nt, umax, vmax, k + 1, res, it, dtv, bad
+            if kfuse > 1:
+                # metrics_step runs PER STEP inside the live branch (the
+                # POST-step nt, exactly the historical placement), so the
+                # divergence sentinel keeps step resolution across the
+                # K-block
+                def kblock(c, _):
+                    def live(c):
+                        up, vp, p, t, nt, umax, vmax, res, it, dtv, bad = c
+                        (up, vp, p, t, nt, umax, vmax,
+                         res, it, dtv) = step(up, vp, p, t, nt, umax, vmax)
+                        res, it, dtv, _um, _vm, bad = _tm.metrics_step(
+                            bad, nt, res, it, dtv, umax, vmax)
+                        return (up, vp, p, t, nt, umax, vmax,
+                                res, it, dtv, bad)
+
+                    return lax.cond(c[3] <= te, live, lambda c: c, c), None
+
+                def body(c):
+                    up, vp, p, t, nt, umax, vmax, k, res, it, dtv, bad = c
+                    (up, vp, p, t, nt, umax, vmax,
+                     res, it, dtv, bad), _ = lax.scan(
+                        kblock,
+                        (up, vp, p, t, nt, umax, vmax, res, it, dtv, bad),
+                        None, length=kfuse)
+                    return (up, vp, p, t, nt, umax, vmax, k + kfuse,
+                            res, it, dtv, bad)
+            else:
+                def body(c):
+                    up, vp, p, t, nt, umax, vmax, k, res, it, dtv, bad = c
+                    up, vp, p, t, nt, umax, vmax, res, it, dtv = step(
+                        up, vp, p, t, nt, umax, vmax
+                    )
+                    # maxima stay native-dtype in the carry (the CFL
+                    # scalars); metrics_step's f32 copies feed only the
+                    # sentinel
+                    res, it, dtv, _um, _vm, bad = _tm.metrics_step(
+                        bad, nt, res, it, dtv, umax, vmax)
+                    return (up, vp, p, t, nt, umax, vmax, k + 1,
+                            res, it, dtv, bad)
 
             (up, vp, p, t, nt, umax, vmax, _k,
              res, it, dtv, bad) = lax.while_loop(
@@ -590,14 +636,17 @@ class NS2DSolver:
         # constant; the default is the byte-identical historical trace.
         metrics = _tm.enabled()
         self._metrics = metrics
+        from ..utils.dispatch import resolve_chunk_fuse
+
+        chunk = self.param.tpu_chunk or self.CHUNK
+        kfuse = resolve_chunk_fuse(self.param, "ns2d_chunk_fuse", chunk)
         fused = self._build_fused_chunk(backend, metrics=metrics,
-                                        te_arg=te_arg)
+                                        te_arg=te_arg, kfuse=kfuse)
         self._fused = fused is not None
         if fused is not None:
             return fused
         step = self._build_step(backend, instrumented=metrics)
         te_static = self.param.te
-        chunk = self.param.tpu_chunk or self.CHUNK
 
         def chunk_fn(u, v, p, t, nt, *te_in):
             te = te_in[0] if te_in else te_static
@@ -606,10 +655,25 @@ class NS2DSolver:
                 _, _, _, t, _, k = c
                 return jnp.logical_and(t <= te, k < chunk)
 
-            def body(c):
-                u, v, p, t, nt, k = c
-                u, v, p, t, nt = step(u, v, p, t, nt)
-                return u, v, p, t, nt, k + 1
+            if kfuse > 1:
+                # K-step fused trips (ISSUE 17): one scan advances K
+                # gated steps (frozen identity past te) per while trip
+                def kblock(c, _):
+                    def live(c):
+                        return step(*c)
+
+                    return lax.cond(c[3] <= te, live, lambda c: c, c), None
+
+                def body(c):
+                    u, v, p, t, nt, k = c
+                    (u, v, p, t, nt), _ = lax.scan(
+                        kblock, (u, v, p, t, nt), None, length=kfuse)
+                    return u, v, p, t, nt, k + kfuse
+            else:
+                def body(c):
+                    u, v, p, t, nt, k = c
+                    u, v, p, t, nt = step(u, v, p, t, nt)
+                    return u, v, p, t, nt, k + 1
 
             u, v, p, t, nt, _ = lax.while_loop(
                 cond, body, (u, v, p, t, nt, jnp.asarray(0, jnp.int32))
@@ -625,13 +689,38 @@ class NS2DSolver:
             def cond(c):
                 return jnp.logical_and(c[3] <= te, c[5] < chunk)
 
-            def body(c):
-                u, v, p, t, nt, k, res, it, dtv, um, vm, bad = c
-                u, v, p, t, nt, res, it, dtv = step(u, v, p, t, nt)
-                res, it, dtv, um, vm, bad = _tm.metrics_step(
-                    bad, nt, res, it, dtv,
-                    ops.max_element(u), ops.max_element(v))
-                return u, v, p, t, nt, k + 1, res, it, dtv, um, vm, bad
+            if kfuse > 1:
+                # per-step metrics_step with the POST-step nt inside the
+                # live branch — divergence keeps step resolution in the
+                # K-block
+                def kblock(c, _):
+                    def live(c):
+                        u, v, p, t, nt, res, it, dtv, um, vm, bad = c
+                        u, v, p, t, nt, res, it, dtv = step(u, v, p, t, nt)
+                        res, it, dtv, um, vm, bad = _tm.metrics_step(
+                            bad, nt, res, it, dtv,
+                            ops.max_element(u), ops.max_element(v))
+                        return u, v, p, t, nt, res, it, dtv, um, vm, bad
+
+                    return lax.cond(c[3] <= te, live, lambda c: c, c), None
+
+                def body(c):
+                    u, v, p, t, nt, k, res, it, dtv, um, vm, bad = c
+                    (u, v, p, t, nt, res, it, dtv, um, vm, bad), _ = \
+                        lax.scan(
+                            kblock,
+                            (u, v, p, t, nt, res, it, dtv, um, vm, bad),
+                            None, length=kfuse)
+                    return (u, v, p, t, nt, k + kfuse,
+                            res, it, dtv, um, vm, bad)
+            else:
+                def body(c):
+                    u, v, p, t, nt, k, res, it, dtv, um, vm, bad = c
+                    u, v, p, t, nt, res, it, dtv = step(u, v, p, t, nt)
+                    res, it, dtv, um, vm, bad = _tm.metrics_step(
+                        bad, nt, res, it, dtv,
+                        ops.max_element(u), ops.max_element(v))
+                    return u, v, p, t, nt, k + 1, res, it, dtv, um, vm, bad
 
             (u, v, p, t, nt, _k, res, it, dtv, um, vm, bad) = lax.while_loop(
                 cond, body,

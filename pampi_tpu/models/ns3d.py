@@ -410,7 +410,7 @@ class NS3DSolver:
         return step
 
     def _build_fused_chunk(self, backend: str, metrics: bool = False,
-                           te_arg: bool = False):
+                           te_arg: bool = False, kfuse: int = 1):
         """The 3-D fused-phase chunk (ops/ns3d_fused.py): the non-solve
         phases run as two Pallas kernels around the solve, the loop carries
         u/v/w in the padded layout plus the running (umax, vmax, wmax),
@@ -486,12 +486,28 @@ class NS3DSolver:
             def cond(c):
                 return jnp.logical_and(c[4] <= te, c[9] < chunk)
 
-            def body(c):
-                up, vp, wp, p, t, nt, um, vm, wm, k = c
-                up, vp, wp, p, t, nt, um, vm, wm = step(
-                    up, vp, wp, p, t, nt, um, vm, wm
-                )
-                return up, vp, wp, p, t, nt, um, vm, wm, k + 1
+            if kfuse > 1:
+                # K-step fused trips (ISSUE 17): one scan advances K
+                # gated steps (frozen identity past te) per while trip
+                def kblock(c, _):
+                    def live(c):
+                        return step(*c)
+
+                    return lax.cond(c[4] <= te, live, lambda c: c, c), None
+
+                def body(c):
+                    up, vp, wp, p, t, nt, um, vm, wm, k = c
+                    (up, vp, wp, p, t, nt, um, vm, wm), _ = lax.scan(
+                        kblock, (up, vp, wp, p, t, nt, um, vm, wm), None,
+                        length=kfuse)
+                    return up, vp, wp, p, t, nt, um, vm, wm, k + kfuse
+            else:
+                def body(c):
+                    up, vp, wp, p, t, nt, um, vm, wm, k = c
+                    up, vp, wp, p, t, nt, um, vm, wm = step(
+                        up, vp, wp, p, t, nt, um, vm, wm
+                    )
+                    return up, vp, wp, p, t, nt, um, vm, wm, k + 1
 
             up, vp, wp, p, t, nt, _um, _vm, _wm, _k = lax.while_loop(
                 cond, body,
@@ -512,16 +528,46 @@ class NS3DSolver:
             def cond(c):
                 return jnp.logical_and(c[4] <= te, c[9] < chunk)
 
-            def body(c):
-                (up, vp, wp, p, t, nt, um, vm, wm, k,
-                 res, it, dtv, bad) = c
-                (up, vp, wp, p, t, nt, um, vm, wm,
-                 res, it, dtv) = step(up, vp, wp, p, t, nt, um, vm, wm)
-                # maxima stay native-dtype in the carry (the CFL scalars)
-                res, it, dtv, _u, _v, _w, bad = _tm.metrics_step(
-                    bad, nt, res, it, dtv, um, vm, wm)
-                return (up, vp, wp, p, t, nt, um, vm, wm, k + 1,
-                        res, it, dtv, bad)
+            if kfuse > 1:
+                # per-step metrics_step (POST-step nt) inside the live
+                # branch — divergence keeps step resolution in the K-block
+                def kblock(c, _):
+                    def live(c):
+                        (up, vp, wp, p, t, nt, um, vm, wm,
+                         res, it, dtv, bad) = c
+                        (up, vp, wp, p, t, nt, um, vm, wm,
+                         res, it, dtv) = step(up, vp, wp, p, t, nt,
+                                              um, vm, wm)
+                        res, it, dtv, _u, _v, _w, bad = _tm.metrics_step(
+                            bad, nt, res, it, dtv, um, vm, wm)
+                        return (up, vp, wp, p, t, nt, um, vm, wm,
+                                res, it, dtv, bad)
+
+                    return lax.cond(c[4] <= te, live, lambda c: c, c), None
+
+                def body(c):
+                    (up, vp, wp, p, t, nt, um, vm, wm, k,
+                     res, it, dtv, bad) = c
+                    (up, vp, wp, p, t, nt, um, vm, wm,
+                     res, it, dtv, bad), _ = lax.scan(
+                        kblock,
+                        (up, vp, wp, p, t, nt, um, vm, wm,
+                         res, it, dtv, bad),
+                        None, length=kfuse)
+                    return (up, vp, wp, p, t, nt, um, vm, wm, k + kfuse,
+                            res, it, dtv, bad)
+            else:
+                def body(c):
+                    (up, vp, wp, p, t, nt, um, vm, wm, k,
+                     res, it, dtv, bad) = c
+                    (up, vp, wp, p, t, nt, um, vm, wm,
+                     res, it, dtv) = step(up, vp, wp, p, t, nt, um, vm, wm)
+                    # maxima stay native-dtype in the carry (the CFL
+                    # scalars)
+                    res, it, dtv, _u, _v, _w, bad = _tm.metrics_step(
+                        bad, nt, res, it, dtv, um, vm, wm)
+                    return (up, vp, wp, p, t, nt, um, vm, wm, k + 1,
+                            res, it, dtv, bad)
 
             (up, vp, wp, p, t, nt, um, vm, wm, _k,
              res, it, dtv, bad) = lax.while_loop(
@@ -544,14 +590,17 @@ class NS3DSolver:
         # fleet's per-lane te carry — see models/ns2d._build_chunk).
         metrics = _tm.enabled()
         self._metrics = metrics
+        from ..utils.dispatch import resolve_chunk_fuse
+
+        chunk = self.param.tpu_chunk or self.CHUNK
+        kfuse = resolve_chunk_fuse(self.param, "ns3d_chunk_fuse", chunk)
         fused = self._build_fused_chunk(backend, metrics=metrics,
-                                        te_arg=te_arg)
+                                        te_arg=te_arg, kfuse=kfuse)
         self._fused = fused is not None
         if fused is not None:
             return fused
         step = self._build_step(backend, instrumented=metrics)
         te_static = self.param.te
-        chunk = self.param.tpu_chunk or self.CHUNK
 
         def chunk_fn(u, v, w, p, t, nt, *te_in):
             te = te_in[0] if te_in else te_static
@@ -559,10 +608,25 @@ class NS3DSolver:
             def cond(c):
                 return jnp.logical_and(c[4] <= te, c[6] < chunk)
 
-            def body(c):
-                u, v, w, p, t, nt, k = c
-                u, v, w, p, t, nt = step(u, v, w, p, t, nt)
-                return u, v, w, p, t, nt, k + 1
+            if kfuse > 1:
+                # K-step fused trips (ISSUE 17): one scan advances K
+                # gated steps (frozen identity past te) per while trip
+                def kblock(c, _):
+                    def live(c):
+                        return step(*c)
+
+                    return lax.cond(c[4] <= te, live, lambda c: c, c), None
+
+                def body(c):
+                    u, v, w, p, t, nt, k = c
+                    (u, v, w, p, t, nt), _ = lax.scan(
+                        kblock, (u, v, w, p, t, nt), None, length=kfuse)
+                    return u, v, w, p, t, nt, k + kfuse
+            else:
+                def body(c):
+                    u, v, w, p, t, nt, k = c
+                    u, v, w, p, t, nt = step(u, v, w, p, t, nt)
+                    return u, v, w, p, t, nt, k + 1
 
             u, v, w, p, t, nt, _ = lax.while_loop(
                 cond, body, (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32))
@@ -575,14 +639,42 @@ class NS3DSolver:
             def cond(c):
                 return jnp.logical_and(c[4] <= te, c[6] < chunk)
 
-            def body(c):
-                u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm, bad = c
-                u, v, w, p, t, nt, res, it, dtv = step(u, v, w, p, t, nt)
-                res, it, dtv, um, vm, wm, bad = _tm.metrics_step(
-                    bad, nt, res, it, dtv, ops.max_element(u),
-                    ops.max_element(v), ops.max_element(w))
-                return (u, v, w, p, t, nt, k + 1,
-                        res, it, dtv, um, vm, wm, bad)
+            if kfuse > 1:
+                # per-step metrics_step (POST-step nt) inside the live
+                # branch — divergence keeps step resolution in the K-block
+                def kblock(c, _):
+                    def live(c):
+                        (u, v, w, p, t, nt,
+                         res, it, dtv, um, vm, wm, bad) = c
+                        u, v, w, p, t, nt, res, it, dtv = step(
+                            u, v, w, p, t, nt)
+                        res, it, dtv, um, vm, wm, bad = _tm.metrics_step(
+                            bad, nt, res, it, dtv, ops.max_element(u),
+                            ops.max_element(v), ops.max_element(w))
+                        return (u, v, w, p, t, nt,
+                                res, it, dtv, um, vm, wm, bad)
+
+                    return lax.cond(c[4] <= te, live, lambda c: c, c), None
+
+                def body(c):
+                    u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm, bad = c
+                    (u, v, w, p, t, nt,
+                     res, it, dtv, um, vm, wm, bad), _ = lax.scan(
+                        kblock,
+                        (u, v, w, p, t, nt, res, it, dtv, um, vm, wm, bad),
+                        None, length=kfuse)
+                    return (u, v, w, p, t, nt, k + kfuse,
+                            res, it, dtv, um, vm, wm, bad)
+            else:
+                def body(c):
+                    u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm, bad = c
+                    u, v, w, p, t, nt, res, it, dtv = step(
+                        u, v, w, p, t, nt)
+                    res, it, dtv, um, vm, wm, bad = _tm.metrics_step(
+                        bad, nt, res, it, dtv, ops.max_element(u),
+                        ops.max_element(v), ops.max_element(w))
+                    return (u, v, w, p, t, nt, k + 1,
+                            res, it, dtv, um, vm, wm, bad)
 
             (u, v, w, p, t, nt, _k,
              res, it, dtv, um, vm, wm, bad) = lax.while_loop(

@@ -708,19 +708,33 @@ class NS3DDistSolver:
                         um, vm, wm) + capt
             return (u, v, w, p, t_next, nt + 1) + capt
 
-        def step_fused(u, v, w, p, t, nt, cap=None):
+        def step_fused(u, v, w, p, t, nt, cap=None, strips=None):
             """The fused-phase twin of step() (see models/ns2d_dist.py):
             one deep exchange feeds the PRE kernel, the solve is unchanged,
-            the POST kernel projects on the exchanged extended blocks."""
+            the POST kernel projects on the exchanged extended blocks.
+            `strips` is the depth-scheduled variant (tpu_exchange_depth,
+            see models/ns2d_dist.step_fused): the slow-tier axis pastes
+            the K-block's captured strips instead of exchanging."""
             from ..parallel.comm import get_offsets
 
             pre_k, post_k = fused_k
             H = FUSE_DEEP_HALO
             u, v, w, p = _fi.apply_field_faults(field_faults, nt, u=u, v=v,
                                                 w=w, p=p)
-            ud = halo_exchange(embed_deep(u, H), comm, depth=H)
-            vd = halo_exchange(embed_deep(v, H), comm, depth=H)
-            wd = halo_exchange(embed_deep(w, H), comm, depth=H)
+            if strips is None:
+                ud = halo_exchange(embed_deep(u, H), comm, depth=H)
+                vd = halo_exchange(embed_deep(v, H), comm, depth=H)
+                wd = halo_exchange(embed_deep(w, H), comm, depth=H)
+            else:
+                from ..parallel.comm import paste_axis_strips
+
+                (lo_u, hi_u), (lo_v, hi_v), (lo_w, hi_w) = strips
+                ud = paste_axis_strips(
+                    embed_deep(u, H), comm, dax, H, lo_u, hi_u)
+                vd = paste_axis_strips(
+                    embed_deep(v, H), comm, dax, H, lo_v, hi_v)
+                wd = paste_axis_strips(
+                    embed_deep(w, H), comm, dax, H, lo_w, hi_w)
             # ghost-inclusive CFL max over the deep blocks: same global
             # value set as the exchanged extended blocks
             dt = (compute_dt(ud, vd, wd) if adaptive
@@ -873,20 +887,84 @@ class NS3DDistSolver:
         step_impl = step if fused_k is None else step_fused
         te = param.te
         chunk = self.CHUNK
+        # K-step fused chunks + per-tier exchange depth (ISSUE 17; see
+        # models/ns2d_dist.py for the full invariants): K=1 keeps the
+        # historical while-body verbatim, K>=2 advances by one scan of
+        # K time-gated steps whose body traces once
+        kfuse = _dispatch.resolve_chunk_fuse(
+            param, "ns3d_dist_chunk_fuse", chunk,
+            why_not=("overlapped chunk carries its own cross-step "
+                     "exchange pipeline") if overlap else None)
+        depth_why = None
+        if fused_k is None:
+            depth_why = "needs the fused deep-halo step (tpu_fuse_phases)"
+        elif self.ragged:
+            depth_why = "ragged decomposition"
+        elif field_faults:
+            depth_why = "PAMPI_FAULTS field faults armed"
+        part_names = [n for n in comm.axis_names if comm.axis_size(n) > 1]
+        part_ext = [{"k": kl, "j": jl, "i": il}[n] for n in part_names]
+        depths = _dispatch.resolve_exchange_depth(
+            param, "ns3d_dist_exchange_depth", kfuse, dict(comm.tiers),
+            part_names, part_ext,
+            FUSE_DEEP_HALO if fused_k is not None else 1,
+            why_not=depth_why)
+        dax, ddepth = next(iter(depths.items())) if depths else (None, 0)
+        self._exchange_depths = depths
+
+        def fuse_block_scan(c, kblock):
+            # see models/ns2d_dist.fuse_block_scan
+            if dax is None:
+                c, _ = lax.scan(kblock(None), c, None, length=kfuse)
+                return c
+            from ..parallel.comm import capture_axis_strips
+
+            def dblock(c, _):
+                s = tuple(
+                    capture_axis_strips(x, comm, dax, ddepth,
+                                        FUSE_DEEP_HALO)
+                    for x in (c[0], c[1], c[2]))
+                c, _ = lax.scan(kblock(s), c, None, length=ddepth)
+                return c, None
+
+            c, _ = lax.scan(dblock, c, None, length=kfuse // ddepth)
+            return c
 
         def chunk_kernel(u, v, w, p, t, nt):
             def cond(c):
                 return jnp.logical_and(c[4] <= te, c[6] < chunk)
 
-            def body(c):
-                if use_cap:
-                    u, v, w, p, t, nt, k, cap = c
-                    u, v, w, p, t, nt, cap = step_impl(u, v, w, p, t, nt,
-                                                       cap)
-                    return u, v, w, p, t, nt, k + 1, cap
-                u, v, w, p, t, nt, k = c
-                u, v, w, p, t, nt = step_impl(u, v, w, p, t, nt)
-                return u, v, w, p, t, nt, k + 1
+            if kfuse > 1:
+                def kblock(strips):
+                    skw = {} if strips is None else {"strips": strips}
+
+                    def blk(c, _):
+                        def live(c):
+                            if use_cap:
+                                u, v, w, p, t, nt, cap = c
+                                return step_impl(u, v, w, p, t, nt, cap,
+                                                 **skw)
+                            u, v, w, p, t, nt = c
+                            return step_impl(u, v, w, p, t, nt, **skw)
+
+                        return lax.cond(c[4] <= te, live,
+                                        lambda c: c, c), None
+
+                    return blk
+
+                def body(c):
+                    sc = fuse_block_scan(c[:6] + c[7:], kblock)
+                    return sc[:6] + (c[6] + kfuse,) + sc[6:]
+            else:
+                def body(c):
+                    if use_cap:
+                        u, v, w, p, t, nt, k, cap = c
+                        u, v, w, p, t, nt, cap = step_impl(u, v, w, p, t, nt,
+                                                           cap)
+                        return u, v, w, p, t, nt, k + 1, cap
+                    u, v, w, p, t, nt, k = c
+                    u, v, w, p, t, nt = step_impl(u, v, w, p, t, nt)
+                    return u, v, w, p, t, nt, k + 1
 
             init = (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32))
             if use_cap:
@@ -899,23 +977,59 @@ class NS3DDistSolver:
             def cond(c):
                 return jnp.logical_and(c[4] <= te, c[6] < chunk)
 
-            def body(c):
-                if use_cap:
-                    (u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm,
-                     bad, cap) = c
-                    (u, v, w, p, t, nt, res, it, dtv, um, vm, wm,
-                     cap) = step_impl(u, v, w, p, t, nt, cap)
-                else:
-                    (u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm,
-                     bad) = c
-                    (u, v, w, p, t, nt,
-                     res, it, dtv, um, vm, wm) = step_impl(u, v, w, p,
-                                                           t, nt)
-                res, it, dtv, um, vm, wm, bad = _tm.metrics_step(
-                    bad, nt, res, it, dtv, um, vm, wm)
-                out = (u, v, w, p, t, nt, k + 1,
-                       res, it, dtv, um, vm, wm, bad)
-                return out + ((cap,) if use_cap else ())
+            if kfuse > 1:
+                def kblock(strips):
+                    skw = {} if strips is None else {"strips": strips}
+
+                    def blk(c, _):
+                        def live(c):
+                            if use_cap:
+                                (u, v, w, p, t, nt, res, it, dtv, um,
+                                 vm, wm, bad, cap) = c
+                                (u, v, w, p, t, nt, res, it, dtv, um,
+                                 vm, wm, cap) = step_impl(
+                                    u, v, w, p, t, nt, cap, **skw)
+                            else:
+                                (u, v, w, p, t, nt, res, it, dtv, um,
+                                 vm, wm, bad) = c
+                                (u, v, w, p, t, nt, res, it, dtv, um,
+                                 vm, wm) = step_impl(u, v, w, p, t, nt,
+                                                     **skw)
+                            # POST-step nt: divergence records name the
+                            # true step inside the K-block
+                            (res, it, dtv, um, vm, wm,
+                             bad) = _tm.metrics_step(
+                                bad, nt, res, it, dtv, um, vm, wm)
+                            out = (u, v, w, p, t, nt, res, it, dtv, um,
+                                   vm, wm, bad)
+                            return out + ((cap,) if use_cap else ())
+
+                        return lax.cond(c[4] <= te, live,
+                                        lambda c: c, c), None
+
+                    return blk
+
+                def body(c):
+                    sc = fuse_block_scan(c[:6] + c[7:], kblock)
+                    return sc[:6] + (c[6] + kfuse,) + sc[6:]
+            else:
+                def body(c):
+                    if use_cap:
+                        (u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm,
+                         bad, cap) = c
+                        (u, v, w, p, t, nt, res, it, dtv, um, vm, wm,
+                         cap) = step_impl(u, v, w, p, t, nt, cap)
+                    else:
+                        (u, v, w, p, t, nt, k, res, it, dtv, um, vm, wm,
+                         bad) = c
+                        (u, v, w, p, t, nt,
+                         res, it, dtv, um, vm, wm) = step_impl(u, v, w, p,
+                                                               t, nt)
+                    res, it, dtv, um, vm, wm, bad = _tm.metrics_step(
+                        bad, nt, res, it, dtv, um, vm, wm)
+                    out = (u, v, w, p, t, nt, k + 1,
+                           res, it, dtv, um, vm, wm, bad)
+                    return out + ((cap,) if use_cap else ())
 
             init = (u, v, w, p, t, nt, jnp.asarray(0, jnp.int32),
                     m[_tm.M_RES], m[_tm.M_IT], m[_tm.M_DT],
@@ -1080,6 +1194,16 @@ class NS3DDistSolver:
                 exchanges_per_step={"deep": 3},
                 pre_grid_cells=full_cells,
             )
+            if self._exchange_depths:
+                # per-tier depth map (ISSUE 17; see models/ns2d_dist.py):
+                # the mapped dcn axis captures once per block, the
+                # per-step deep strips then cover the unmapped axes only
+                rec.update(
+                    exchange_depths=dict(self._exchange_depths),
+                    depth_block=max(self._exchange_depths.values()),
+                    exchanges_per_block={"deep": 3},
+                    axes=list(comm.axis_names),
+                )
             if overlap:
                 # same per-step schedule, posted into the double buffer;
                 # the chunk prologue fills the first generation (see

@@ -443,6 +443,9 @@ def make_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, dtype,
     and `it` counts V-cycles. NOTE the contract addition over SOR: the loop
     also stops when the residual stalls (`stall_rtol` relative change per
     cycle, .par key tpu_mg_stall_rtol; 0 restores pure eps/itermax)."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax, dtype, f"mg2d {imax}x{jmax}")
     vcycle = make_mg_vcycle_2d(imax, jmax, dx, dy, dtype, n_pre, n_post,
                                backend, fused)
     idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
@@ -625,6 +628,10 @@ def make_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax, dtype,
     """3-D twin of make_mg_solve_2d (same solve contract as
     models/ns3d.make_pressure_solve_3d; `it` counts V-cycles; stalls stop
     the loop early per `stall_rtol` — see make_mg_solve_2d)."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax * kmax, dtype,
+                    f"mg3d {imax}x{jmax}x{kmax}")
     vcycle = make_mg_vcycle_3d(imax, jmax, kmax, dx, dy, dz, dtype,
                                n_pre, n_post, backend, fused)
     idx2 = 1.0 / (dx * dx)

@@ -451,6 +451,67 @@ def halo_exchange(x, comm: CartComm, periodic=(), depth: int = 1):
     return x
 
 
+def capture_axis_strips(x_ext, comm: CartComm, axis: str, depth: int,
+                        inner: int, periodic: bool = False):
+    """The capture half of the per-tier depth schedule (ISSUE 17,
+    `tpu_exchange_depth axis=H`): ONE depth-`depth` exchange on the slow
+    mesh `axis` over the deep-embedded block, cropped to the two
+    paste-ready `inner`-deep ghost strips of the step's own deep layout.
+    A fused-chunk depth block calls this once, then `paste_axis_strips`
+    re-applies the strips for `depth` scan steps — one slow-fabric
+    exchange amortized over H steps (the partitioned-communication
+    trade: bounded staleness <= H-1 steps on the slow rim, fresh
+    exchanges everywhere else). Requires depth >= inner; `x_ext` is the
+    1-ghost-layer extended block."""
+    if depth < inner:
+        raise ValueError(f"capture depth {depth} < inner depth {inner}")
+    dim = comm.axis_names.index(axis)
+    xw = jnp.pad(x_ext, [(depth - 1, depth - 1)] * x_ext.ndim)
+    xw = _exchange_axis(
+        xw, axis, comm.axis_size(axis), dim, periodic, depth)
+    # the inner-deep block's window starts at depth-inner along every
+    # axis; its two `axis` ghost strips are the innermost `inner` layers
+    # of the fat captured halo
+    lo_start = [depth - inner] * x_ext.ndim
+    hi_start = [depth - inner] * x_ext.ndim
+    hi_start[dim] = depth + (x_ext.shape[dim] - 2)
+    sizes = [x_ext.shape[a] + 2 * (inner - 1) for a in range(x_ext.ndim)]
+    sizes[dim] = inner
+    lo = lax.dynamic_slice(xw, lo_start, sizes)
+    hi = lax.dynamic_slice(xw, hi_start, sizes)
+    return lo, hi
+
+
+def paste_axis_strips(xd, comm: CartComm, axis: str, inner: int, lo, hi,
+                      periodic=()):
+    """The per-step paste half: fill `axis`'s two `inner`-deep ghost
+    strips of the deep-embedded block `xd` from the block-start captured
+    strips (no collective — the amortized slow-tier exchange already
+    ran in `capture_axis_strips`), then run the fresh per-step exchange
+    on every OTHER mesh axis. Wall shards keep their own ghost contents
+    (the MPI_PROC_NULL gate `_exchange_axis` applies), so the paste is
+    an identity there and wall-BC history stays current. Axis-by-axis
+    order puts the pasted axis first: ghost corners take the fresh
+    axes' strips, exactly like `halo_exchange`'s last-axis rule."""
+    dim = comm.axis_names.index(axis)
+    nper = comm.axis_size(axis)
+    n = xd.shape[dim]
+    if nper > 1:
+        idx = lax.axis_index(axis)
+        old_lo = lax.slice_in_dim(xd, 0, inner, axis=dim)
+        old_hi = lax.slice_in_dim(xd, n - inner, n, axis=dim)
+        lo = jnp.where(idx > 0, lo, old_lo)
+        hi = jnp.where(idx < nper - 1, hi, old_hi)
+        xd = lax.dynamic_update_slice_in_dim(xd, lo, 0, axis=dim)
+        xd = lax.dynamic_update_slice_in_dim(xd, hi, n - inner, axis=dim)
+    for d2, name in enumerate(comm.axis_names):
+        if name == axis:
+            continue
+        xd = _exchange_axis(
+            xd, name, comm.axis_size(name), d2, name in periodic, inner)
+    return xd
+
+
 class ExchangeSchedule:
     """Persistent halo-exchange schedule — the partitioned-MPI seam
     (ROADMAP item 2; "Persistent and Partitioned MPI for Stencil
@@ -625,8 +686,31 @@ def exchange_schedule_tier_bytes(comm: CartComm, record: dict) -> dict:
 
     add(halo_tier_bytes(comm, shard, 1, isz), per.get("depth1", 0))
     if "deep" in per:
-        add(halo_tier_bytes(comm, shard, record["deep_halo"], isz),
-            per["deep"])
+        # per-tier depth map (ISSUE 17): mapped axes capture ONE
+        # depth-H strip pair per `depth_block` steps (amortized, like
+        # the flat accounting below); unmapped axes keep the per-step
+        # deep strip. Empty map reduces to the historical flat add.
+        depths = record.get("exchange_depths") or {}
+        blk = max(int(record.get("depth_block", 1)), 1)
+        epb = record.get("exchanges_per_block", {}).get(
+            "deep", per["deep"])
+        for ax, shape in enumerate(
+                halo_strip_shapes(shard, record["deep_halo"])):
+            name = comm.axis_names[ax]
+            if comm.axis_size(name) == 1:
+                continue
+            if name in depths:
+                cap = halo_strip_shapes(shard, depths[name])[ax]
+                n = 1
+                for s in cap:
+                    n *= s
+                out[comm.tiers[name]] += int(round(
+                    epb * 2 * n * isz / blk))
+            else:
+                n = 1
+                for s in shape:
+                    n *= s
+                out[comm.tiers[name]] += per["deep"] * 2 * n * isz
     if per.get("shift"):
         # one single-direction depth-1 strip per shifted axis
         per_axis = per["shift"] // len(shard)
@@ -676,8 +760,28 @@ def exchange_schedule_bytes(record: dict) -> int:
     per = record.get("exchanges_per_step", {})
     total = per.get("depth1", 0) * halo_exchange_bytes(shard, 1, isz)
     if "deep" in per:
-        total += per["deep"] * halo_exchange_bytes(
-            shard, record["deep_halo"], isz)
+        # per-tier depth map (ISSUE 17): mapped axes amortize ONE
+        # depth-H capture pair over `depth_block` steps; unmapped axes
+        # keep the per-step deep strip. Static geometry like the rest
+        # of this accounting (size-1 axes count); empty map reduces to
+        # the historical flat line bit-for-bit.
+        depths = record.get("exchange_depths") or {}
+        if not depths:
+            total += per["deep"] * halo_exchange_bytes(
+                shard, record["deep_halo"], isz)
+        else:
+            blk = max(int(record.get("depth_block", 1)), 1)
+            epb = record.get("exchanges_per_block", {}).get(
+                "deep", per["deep"])
+            axes = record.get("axes") or [str(a) for a in range(len(shard))]
+            for ax, shape in enumerate(
+                    halo_strip_shapes(shard, record["deep_halo"])):
+                if axes[ax] in depths:
+                    cap = halo_strip_shapes(shard, depths[axes[ax]])[ax]
+                    total += int(round(
+                        epb * 2 * int(np.prod(cap)) * isz / blk))
+                else:
+                    total += per["deep"] * 2 * int(np.prod(shape)) * isz
     if per.get("shift"):
         # one shift per axis (F/G/H donor edges): a single depth-1 strip,
         # one direction

@@ -351,3 +351,114 @@ def resolve_coord(param, key: str) -> str:
         return "solo"
     record(key, "uncoordinated (single process)")
     return "none"
+
+
+_CHUNK_FUSE_K = 4  # the auto/forced K: divides both model chunks (64, 32)
+
+
+def resolve_chunk_fuse(param, key: str, chunk: int,
+                       why_not: str | None = None) -> int:
+    """`tpu_chunk_fuse` -> the number of steps one trip of the chunk
+    while-loop advances (ISSUE 17). K == 1 is EXACTLY the historical
+    chunk (the builders keep the old body verbatim — the jaxpr-hash
+    identity contract); K >= 2 wraps K gated steps in one `lax.scan`
+    whose body traces ONCE, so the static launches-per-step is the
+    K=1 launch count divided by K. Decision recorded under `key`
+    ("<family>_chunk_fuse") in a form jaxprcheck parses ("K=<n>").
+
+    `why_not` marks structurally ineligible builds (the overlapped
+    schedule carries its own cross-step pipeline; K must divide the
+    chunk so nt stays exact at every boundary). `auto` fuses on TPU
+    only — off-TPU the historical trace is kept bitwise, so the
+    committed CONTRACTS.json hashes stay valid."""
+    import jax
+
+    knob = param.tpu_chunk_fuse
+    if knob == "off":
+        record(key, "historical (tpu_chunk_fuse off)")
+        return 1
+    if knob not in ("auto", "on"):
+        try:
+            k = int(knob)
+        except ValueError:
+            raise ValueError(
+                f"tpu_chunk_fuse must be auto|on|off|<int>, got {knob!r}"
+            ) from None
+        if k < 1:
+            raise ValueError(
+                f"tpu_chunk_fuse K must be >= 1, got {k}")
+    else:
+        k = _CHUNK_FUSE_K
+    if why_not is not None:
+        record(key, f"historical ({why_not})")
+        return 1
+    if k == 1:
+        record(key, "historical (K=1)")
+        return 1
+    if chunk % k != 0:
+        record(key, f"historical (K={k} does not divide chunk {chunk})")
+        return 1
+    if knob == "on":
+        record(key, f"scan (K={k}, forced)")
+        return k
+    if knob == "auto" and jax.default_backend() != "tpu":
+        record(key, "historical (no TPU)")
+        return 1
+    record(key, f"scan (K={k})")
+    return k
+
+
+def resolve_exchange_depth(param, key: str, k: int, tiers: dict,
+                           axis_names, shard_extents, min_depth: int,
+                           why_not: str | None = None) -> dict:
+    """`tpu_exchange_depth` -> the per-tier depth map {axis: H} for the
+    fused dist step's u/v exchanges (ISSUE 17): the mapped DCN axis
+    ships ONE depth-H strip per H fused scan steps while every other
+    axis keeps its fresh per-step exchange. Returns {} (no depth
+    scheduling) unless the build is eligible; refusals are recorded
+    under `key` ("<family>_exchange_depth") with the reason.
+
+    This is a RELAXED-parity trade (bounded staleness on the slow-tier
+    rim), so `auto` NEVER silently enables it — the map only arms on an
+    explicit "axis=H". Eligibility: K-step fusion active with H | K,
+    H >= the fused step's own deep-halo depth (`min_depth`), the axis
+    present, declared dcn-tier and actually partitioned, and the shard
+    extent on it >= H (the owned strip must cover the fat halo)."""
+    knob = param.tpu_exchange_depth
+    if knob in ("auto", "off"):
+        record(key, f"per-step (tpu_exchange_depth {knob})")
+        return {}
+    try:
+        ax, hs = knob.split("=")
+        ax, h = ax.strip(), int(hs)
+    except ValueError:
+        raise ValueError(
+            f"tpu_exchange_depth must be auto|off|<axis>=<H>, got "
+            f"{knob!r}") from None
+    if h < 1:
+        raise ValueError(f"tpu_exchange_depth H must be >= 1, got {h}")
+    if why_not is not None:
+        record(key, f"per-step ({why_not})")
+        return {}
+    if k < 2:
+        record(key, "per-step (needs tpu_chunk_fuse K >= 2)")
+        return {}
+    if k % h != 0:
+        record(key, f"per-step (H={h} does not divide K={k})")
+        return {}
+    if h < min_depth:
+        record(key, f"per-step (H={h} < deep halo {min_depth})")
+        return {}
+    if ax not in axis_names:
+        record(key, f"per-step (no axis {ax!r} on this mesh)")
+        return {}
+    i = list(axis_names).index(ax)
+    if shard_extents[i] < h:
+        record(key, f"per-step (shard extent {shard_extents[i]} on "
+                    f"{ax!r} < H={h})")
+        return {}
+    if tiers.get(ax, "ici") != "dcn":
+        record(key, f"per-step (axis {ax!r} is not dcn-tier)")
+        return {}
+    record(key, f"depth ({ax}={h}: 1 {ax}-exchange per {h} steps)")
+    return {ax: h}

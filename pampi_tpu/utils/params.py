@@ -267,6 +267,32 @@ class Parameter:
     # sor_lex crashes the TPU worker at any chunk > 1 — scan-in-while f64
     # at size — while tpu_chunk 1 runs; f32 production runs keep 64).
     tpu_chunk: int = 0
+    # K-step fused chunks (ISSUE 17): auto|on|off|<int K>. When K >= 2
+    # each trip of the chunk while-loop advances K steps inside ONE
+    # `lax.scan` (the residual-adaptive itermax cap and the CFL/dt
+    # scalars ride the scan carry; steps past te run a frozen identity
+    # branch), so dispatch/carry-reshuffle overhead amortizes over K and
+    # the static launches-per-step drops below 3. External chunk arity is
+    # UNCHANGED — checkpoints, ring recovery, the coordinator fault word
+    # and the fleet's BatchedSolver see the same state tuple. "off" (and
+    # any resolution to K=1) is bitwise the historical chunk (jaxpr-hash
+    # pinned in CONTRACTS.json); "auto" fuses K=4 on TPU only; "on"
+    # forces K=4 anywhere (the CPU smoke/parity shape); an integer forces
+    # that K (must divide the chunk length). Decisions recorded via
+    # utils/dispatch ("<family>_chunk_fuse").
+    tpu_chunk_fuse: str = "auto"
+    # per-tier exchange depth (ISSUE 17): "axis=H" (e.g. "i=4") ships
+    # depth-H halo strips on that DCN-tier axis so ONE slow exchange
+    # covers H fused scan steps, while ICI axes keep fresh depth-1/deep
+    # exchanges every step. RELAXED parity: slow-tier halo data is up to
+    # H-1 steps stale at the strip's outer rim (the partitioned-
+    # communication / halo-widening trade — PAPERS.md); CFL maxima stay
+    # conservative. Eligibility (fused serial dist step, chunk_fuse
+    # K >= 2 with H | K, tiered mesh with the axis declared dcn, shard
+    # extent >= H, not ragged) is checked per build and refusals are
+    # recorded ("<family>_exchange_depth"). "auto"/"off" = no depth map
+    # (exact parity is never silently traded).
+    tpu_exchange_depth: str = "auto"
     # 3-D VTK output mode: "ascii" (reference default), "binary", or
     # "sharded" — the MPI-IO-pattern parallel write (utils/vtkio.py
     # ShardedVtkWriter; binary, byte-identical to "binary"). On a

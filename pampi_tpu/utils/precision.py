@@ -20,6 +20,49 @@ _DTYPES = {
 }
 
 
+def residual_floor(ncells: int, dtype) -> float:
+    """The smallest L2-style residual a reduced-precision solve can
+    reliably distinguish from zero: machine epsilon scaled by the RMS
+    accumulation factor sqrt(ncells). Below roughly this level the
+    residual is summation-order noise — two algebraically identical
+    cycles (ladder vs fused) legitimately disagree on whether `eps` was
+    reached, so an A/B at such an eps compares tail behaviour, not
+    speed (the ROADMAP "eps at the f32 floor" footgun). f64 returns 0.0:
+    no practical .par eps sits near its floor."""
+    if jnp.dtype(dtype) == jnp.dtype(jnp.float32):
+        return float(jnp.finfo(jnp.float32).eps) * float(ncells) ** 0.5
+    return 0.0
+
+
+def check_eps_floor(eps: float, ncells: int, dtype, where: str) -> bool:
+    """Warn (host-side, build time — never inside a trace) when a
+    convergence `eps` sits within a decade of the dtype's residual
+    floor. Returns True when the warning fired. eps <= 0 is the
+    explicit fixed-iteration comparison mode (run to itermax) and is
+    always silent — that is the sanctioned way to A/B two cycle
+    shapes at a floor-adjacent tolerance."""
+    floor = residual_floor(ncells, dtype)
+    if not (0.0 < float(eps) < 10.0 * floor):
+        return False
+    import warnings
+
+    from . import telemetry as _tm
+
+    warnings.warn(
+        f"{where}: eps={eps:g} is within a decade of the "
+        f"{jnp.dtype(dtype).name} residual floor (~{floor:.3g} at "
+        f"{ncells} cells) — convergence there measures summation-order "
+        "noise, not solver speed. For A/B timing, raise eps a decade "
+        "above the floor or compare at fixed iteration counts "
+        "(eps=0 runs every solve to itermax).",
+        stacklevel=3,
+    )
+    _tm.emit("warning", component="precision", reason="eps_near_floor",
+             where=where, eps=float(eps), floor=floor,
+             ncells=int(ncells), dtype=jnp.dtype(dtype).name)
+    return True
+
+
 def resolve_dtype(name: str):
     try:
         dt = _DTYPES[name]

@@ -86,6 +86,44 @@ def test_latency_direction(tmp_path):
     assert bt.lint(files, tolerance=0.10) == []
 
 
+def test_cpu_series_gate_at_wider_tolerance(tmp_path):
+    """cpu series gate at CPU_TOLERANCE (growth containers are different
+    hardware round to round — the r08 container runs the identical r06
+    poisson loop 21% slower when idle), while tpu series keep the tight
+    default; real breakage beyond CPU_TOLERANCE still fails."""
+    files = [_art(tmp_path, 1, [_pt(100.0, backend="cpu")]),
+             _art(tmp_path, 2, [_pt(76.0, backend="cpu")])]  # -24%
+    assert bt.lint(files, tolerance=0.10) == []
+    files = [_art(tmp_path, 1, [_pt(100.0, backend="cpu")]),
+             _art(tmp_path, 2, [_pt(60.0, backend="cpu")])]  # -40%
+    errs = bt.lint(files, tolerance=0.10)
+    assert len(errs) == 1 and "35% tolerance" in errs[0]
+    # tpu stays tight: the same -24% fails at 10%
+    files = [_art(tmp_path, 1, [_pt(100.0, backend="tpu")]),
+             _art(tmp_path, 2, [_pt(76.0, backend="tpu")])]
+    assert len(bt.lint(files, tolerance=0.10)) == 1
+
+
+def test_launch_census_direction(tmp_path):
+    """launches_per_step gates DOWNWARD by name (ISSUE 17): the static
+    census is deterministic, so ANY rise means a fusion regression — and
+    the name pin survives a unit-string drift that would otherwise
+    un-gate the series."""
+    assert bt.higher_is_better("launches/step", "launches_per_step") is False
+    assert bt.higher_is_better("bananas", "launches_per_step") is False
+    pt = dict(name="launches_per_step", unit="launches/step", backend="cpu")
+    files = [_art(tmp_path, 1, [dict(pt, value=0.5)]),
+             _art(tmp_path, 2, [dict(pt, value=2.0)])]
+    errs = bt.lint(files, tolerance=0.10)
+    assert len(errs) == 1 and "launches_per_step" in errs[0] \
+        and "rose" in errs[0]
+    assert bt.lint([_art(tmp_path, 1, [dict(pt, value=0.5)]),
+                    _art(tmp_path, 2, [dict(pt, value=0.5)])]) == []
+    # the small serving-regime line is name-pinned downward too
+    assert bt.higher_is_better(
+        "bananas", "ns2d_small_ms_per_step") is False
+
+
 def test_legacy_artifact_fallback(tmp_path):
     """Artifacts without a normalized metrics list fall back to the same
     normalizer over their parsed* blocks (never tail scraping)."""

@@ -75,6 +75,26 @@ def test_lint_catches_gutted_decomposition():
     assert any("solve_ms" in e for e in ca.lint_bench(bad))
 
 
+def test_lint_catches_gutted_launch_census():
+    """launches_per_step blocks must carry the K-fusion census keys
+    (ISSUE 17) — a quotient with no dispatch record, raw count, or
+    divisor cannot be audited; ns2d_small_ms_per_step rides the
+    existing DECOMP_KEYS rule by its name shape."""
+    good = {"n": 1, "cmd": "x", "rc": 0, "tail": "", **_NORM,
+            "parsed_lps": {"metric": "launches_per_step", "value": 0.5,
+                           "unit": "launches/step",
+                           "chunk_fuse_dispatch": "scan (K=4)",
+                           "pallas_calls": 2, "k": 4}}
+    assert ca.lint_bench(good) == []
+    bad = dict(good, parsed_lps={"metric": "launches_per_step",
+                                 "value": 0.5, "unit": "launches/step"})
+    assert any("chunk_fuse_dispatch" in e for e in ca.lint_bench(bad))
+    small = dict(good, parsed_small={
+        "metric": "ns2d_small_ms_per_step", "value": 0.4,
+        "unit": "ms/step"})
+    assert any("solve_ms" in e for e in ca.lint_bench(small))
+
+
 def test_lint_telemetry_summary_block():
     base = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
             "tail": "", **_NORM}

@@ -1,0 +1,303 @@
+"""K-step fused chunks + per-tier exchange depths (ISSUE 17).
+
+Contracts pinned here:
+- OFF IS HISTORICAL: `tpu_chunk_fuse off` (and `auto` off-TPU) traces
+  BITWISE to the pre-ISSUE-17 chunk — jaxpr-hash identity, so the
+  committed CONTRACTS.json hashes stay valid without regeneration of
+  the historical entries.
+- K PARITY: a K>=2 scan-wrapped chunk reaches the same fields and step
+  count as the historical chunk on every family — jnp path bitwise,
+  fused path at the ulp contract — including a ragged dist decomposition
+  and an obstacle dist config (the two geometries where a fused-window
+  off-by-one would hide).
+- DISPATCH RECORDS: every refusal (off, no TPU, K does not divide the
+  chunk) and the armed scan are recorded in the jaxprcheck-parseable
+  spelling; the exchange-depth knob refuses K=1, a non-dcn axis, and
+  H not dividing K — and arms with the 1-exchange-per-H-steps record.
+- RECORDER UNDER K: the per-chunk flight records report REAL steps
+  (chunk nt advance, unchanged by the internal K grouping), rearm()
+  re-baselines after rollback, and the divergence sentinel names the
+  exact step INSIDE a K-block, not a block boundary.
+- the halocheck depth-capture derivation rejects the geometries
+  resolve_exchange_depth must refuse (mutation pins).
+
+Compile cost: every solver is 16²/8³, itermax <= 10, te <= 0.05.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pampi_tpu.analysis.jaxprcheck import jaxpr_hash, trace_chunk
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.utils import dispatch, telemetry as tm
+from pampi_tpu.utils.params import Parameter
+
+_B2 = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.02, tau=0.5,
+           itermax=10, eps=1e-4, omg=1.7, gamma=0.9)
+_B3 = dict(name="dcavity3d", imax=8, jmax=8, kmax=8, re=10.0, te=0.02,
+           tau=0.5, itermax=8, eps=1e-4, omg=1.7, gamma=0.9)
+_OBS = dict(name="canal_obstacle", imax=24, jmax=12, xlength=2.0,
+            ylength=1.0, re=10.0, te=0.02, tau=0.5, itermax=10,
+            eps=1e-4, omg=1.7, gamma=0.9, u_init=1.0, bcLeft=3,
+            bcRight=3, obstacles="0.3,0.3,0.6,0.6")
+
+
+def _ulp_close(a, b, scale=1.0):
+    a, b = np.asarray(a), np.asarray(b)
+    tol = 1e-12 if a.dtype == np.float64 else 2e-5
+    return np.abs(a - b).max() <= tol * max(1.0, scale)
+
+
+def test_off_is_historical_trace():
+    """The jaxpr-hash identity: off == auto-off-TPU, and both record the
+    refusal; a forced K=4 is a DIFFERENT program with the scan record."""
+    h_off = jaxpr_hash(trace_chunk(
+        NS2DSolver(Parameter(tpu_chunk_fuse="off", **_B2))))
+    assert dispatch.last("ns2d_chunk_fuse") == \
+        "historical (tpu_chunk_fuse off)"
+    h_auto = jaxpr_hash(trace_chunk(NS2DSolver(Parameter(**_B2))))
+    assert dispatch.last("ns2d_chunk_fuse") == "historical (no TPU)"
+    assert h_off == h_auto
+    h_k4 = jaxpr_hash(trace_chunk(
+        NS2DSolver(Parameter(tpu_chunk_fuse="4", **_B2))))
+    assert "scan (K=4" in dispatch.last("ns2d_chunk_fuse")
+    assert h_k4 != h_off
+
+
+def test_refusal_records():
+    """K that does not divide the chunk (ns2d CHUNK=64) refuses WITH the
+    arithmetic in the record; K=1 is spelled historical."""
+    NS2DSolver(Parameter(tpu_chunk_fuse="7", **_B2))
+    assert dispatch.last("ns2d_chunk_fuse") == \
+        "historical (K=7 does not divide chunk 64)"
+    NS2DSolver(Parameter(tpu_chunk_fuse="1", **_B2))
+    assert dispatch.last("ns2d_chunk_fuse") == "historical (K=1)"
+    with pytest.raises(ValueError, match="auto|on|off"):
+        NS2DSolver(Parameter(tpu_chunk_fuse="sideways", **_B2))
+
+
+def _run2(cls=NS2DSolver, comm=None, base=_B2, **kw):
+    p = Parameter(**{**base, **kw})
+    s = cls(p, comm=comm) if comm is not None else cls(p)
+    s.run(progress=False)
+    return s
+
+
+@pytest.mark.parametrize("extra,tol_key", [
+    ({}, "bitwise"),
+    ({"tpu_fuse_phases": "on", "tpu_solver": "fft"}, "ulp"),
+])
+def test_k4_parity_single(extra, tol_key):
+    a = _run2(tpu_chunk_fuse="off", **extra)
+    b = _run2(tpu_chunk_fuse="4", **extra)
+    assert "scan (K=4" in dispatch.last("ns2d_chunk_fuse")
+    assert a.nt == b.nt
+    ua, ub = np.asarray(a.u), np.asarray(b.u)
+    pa, pb = np.asarray(a.p), np.asarray(b.p)
+    if tol_key == "bitwise":
+        assert np.array_equal(ua, ub) and np.array_equal(pa, pb)
+    else:
+        assert _ulp_close(ub, ua, scale=float(np.abs(ua).max()))
+        assert _ulp_close(pb, pa, scale=float(np.abs(pa).max()))
+
+
+def test_k4_parity_ns3d():
+    from pampi_tpu.models.ns3d import NS3DSolver
+
+    a = _run2(NS3DSolver, base=_B3, tpu_chunk_fuse="off")
+    b = _run2(NS3DSolver, base=_B3, tpu_chunk_fuse="4")
+    assert "scan (K=4" in dispatch.last("ns3d_chunk_fuse")
+    assert a.nt == b.nt
+    assert np.array_equal(np.asarray(a.u), np.asarray(b.u))
+    assert np.array_equal(np.asarray(a.p), np.asarray(b.p))
+
+
+def _dist2(dims, base=_B2, **kw):
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    p = Parameter(**{**base, **kw})
+    comm = CartComm(ndims=2, extents=(p.jmax, p.imax), dims=dims,
+                    tiers=p.tpu_mesh_tiers)
+    s = NS2DDistSolver(p, comm=comm)
+    s.run(progress=False)
+    u, v, pp = s.fields()
+    return s, np.asarray(u), np.asarray(pp)
+
+
+@pytest.mark.parametrize("base,dims,fused", [
+    (_B2, (2, 2), "off"),            # jnp path: bitwise
+    (_B2, (2, 2), "on"),             # fused kernels: ulp
+    ({**_B2, "imax": 18, "jmax": 18}, (4, 2), "on"),   # ragged shards
+    (_OBS, (2, 2), "on"),            # flag-masked obstacle config
+])
+def test_k4_parity_dist(base, dims, fused):
+    s1, u1, p1 = _dist2(dims, base=base, tpu_chunk_fuse="off",
+                        tpu_fuse_phases=fused)
+    s4, u4, p4 = _dist2(dims, base=base, tpu_chunk_fuse="4",
+                        tpu_fuse_phases=fused)
+    assert "scan (K=4" in dispatch.last("ns2d_dist_chunk_fuse")
+    assert s1.nt == s4.nt
+    if fused == "off":
+        assert np.array_equal(u1, u4) and np.array_equal(p1, p4)
+    else:
+        assert _ulp_close(u4, u1, scale=float(np.abs(u1).max()))
+        assert _ulp_close(p4, p1, scale=float(np.abs(p1).max()))
+
+
+def test_k4_parity_ns3d_dist():
+    from pampi_tpu.models.ns3d_dist import NS3DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+
+    def run(fuse):
+        p = Parameter(tpu_chunk_fuse=fuse, **_B3)
+        comm = CartComm(ndims=3, extents=(p.kmax, p.jmax, p.imax),
+                        dims=(2, 2, 2))
+        s = NS3DDistSolver(p, comm=comm)
+        s.run(progress=False)
+        g = s.global_fields()
+        return s.nt, np.asarray(g["u"]), np.asarray(g["p"])
+
+    nt1, u1, p1 = run("off")
+    nt4, u4, p4 = run("4")
+    assert "scan (K=4" in dispatch.last("ns3d_dist_chunk_fuse")
+    assert nt1 == nt4
+    assert np.array_equal(u1, u4) and np.array_equal(p1, p4)
+
+
+def test_exchange_depth_records():
+    """The depth knob's whole refusal chain + the armed record, read off
+    real dist builds (the dispatch record is the contract surface)."""
+    # armed: K=4, i declared dcn, H=4 divides K, extent 8 >= 4
+    _dist2((2, 2), tpu_chunk_fuse="4", tpu_fuse_phases="on",
+           tpu_mesh_tiers="i=dcn", tpu_exchange_depth="i=4")
+    assert dispatch.last("ns2d_dist_exchange_depth") == \
+        "depth (i=4: 1 i-exchange per 4 steps)"
+    # refusal: no K-fusion -> per-step
+    _dist2((2, 2), tpu_chunk_fuse="off", tpu_fuse_phases="on",
+           tpu_mesh_tiers="i=dcn", tpu_exchange_depth="i=4")
+    assert dispatch.last("ns2d_dist_exchange_depth") == \
+        "per-step (needs tpu_chunk_fuse K >= 2)"
+    # refusal: axis not declared dcn-tier
+    _dist2((2, 2), tpu_chunk_fuse="4", tpu_fuse_phases="on",
+           tpu_exchange_depth="i=4")
+    assert dispatch.last("ns2d_dist_exchange_depth") == \
+        "per-step (axis 'i' is not dcn-tier)"
+    # refusal: H does not divide K
+    _dist2((2, 2), tpu_chunk_fuse="4", tpu_fuse_phases="on",
+           tpu_mesh_tiers="i=dcn", tpu_exchange_depth="i=3")
+    assert dispatch.last("ns2d_dist_exchange_depth") == \
+        "per-step (H=3 does not divide K=4)"
+
+
+def test_depth_capture_derivation_pins():
+    """halocheck's pure-arithmetic depth-capture checks: clean at the
+    production geometry, and each mutated geometry fires the matching
+    violation (the refusal conditions resolve_exchange_depth encodes)."""
+    from pampi_tpu.analysis.halocheck import depth_capture_violations
+    from pampi_tpu.ops import ns2d_fused as nf
+
+    assert depth_capture_violations((8, 8), 4, nf.FUSE_DEEP_HALO) == []
+    v = depth_capture_violations((3, 3), 4, 3)
+    assert v and any("owned" in str(s) for s in v)
+    v = depth_capture_violations((8, 8), 2, 3)
+    assert v and any("crop" in str(s) or "inner" in str(s) for s in v)
+
+
+# --------------------------------------------------------------------
+# ChunkRecorder under K-step chunks (the host plane must be unchanged:
+# steps are REAL nt advances, never K-block counts)
+# --------------------------------------------------------------------
+
+
+@pytest.fixture()
+def tel_on(tmp_path, monkeypatch):
+    path = tmp_path / "run.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(path))
+    tm.reset()
+    yield path
+    tm.reset()
+
+
+def _chunk_records(path):
+    return [json.loads(ln) for ln in open(path)
+            if json.loads(ln).get("kind") == "chunk"]
+
+
+def test_chunk_records_identical_under_k(tel_on, tmp_path, monkeypatch):
+    """The flight record's per-chunk (steps, nt) sequence is IDENTICAL
+    with and without K-fusion: the recorder sees the chunk's real nt
+    advance, and steps/s + ETA stay honest."""
+    def run(fuse, path):
+        monkeypatch.setenv("PAMPI_TELEMETRY", str(path))
+        tm.reset()
+        s = _run2(tpu_chunk=4, tpu_chunk_fuse=fuse)
+        tm.reset()
+        return s, _chunk_records(path)
+
+    s1, recs1 = run("off", tmp_path / "off.jsonl")
+    s4, recs4 = run("4", tmp_path / "k4.jsonl")
+    assert s1.nt == s4.nt and recs1 and recs4
+    assert [(r["steps"], r["nt"]) for r in recs1] == \
+        [(r["steps"], r["nt"]) for r in recs4]
+    assert sum(r["steps"] for r in recs4) == s4.nt
+    assert all(r["ms_per_step"] is not None for r in recs4)
+
+
+def test_recorder_rearm_rebaselines(tel_on):
+    """rearm(nt) after rollback: the next record reports steps from the
+    rollback target (never negative), is compile-inclusive again, and
+    the divergence latch re-arms for a second blow-up."""
+    rec = tm.ChunkRecorder("ns2d", nt0=0)
+    good = np.zeros(tm.METRICS_LEN)
+    good[tm.M_BAD] = -1.0
+    rec.update(0.1, 8, good)
+    rec.update(0.2, 16, good)
+    rec.rearm(nt=12)
+    rec.update(0.3, 16, good)
+    recs = _chunk_records(tel_on)
+    assert [r["steps"] for r in recs] == [8, 8, 4]
+    assert [r["includes_compile"] for r in recs] == [True, False, True]
+    assert recs[-1]["ms_per_step"] is not None \
+        and recs[-1]["ms_per_step"] >= 0
+    # divergence re-latch across a rearm
+    bad = good.copy()
+    bad[tm.M_BAD] = 14.0
+    with pytest.warns(UserWarning, match="non-finite"):
+        rec.update(0.4, 20, bad)
+    rec.update(0.5, 24, bad)  # latched: no second record
+    rec.rearm()
+    with pytest.warns(UserWarning, match="non-finite"):
+        rec.update(0.6, 28, bad)
+    divs = [json.loads(ln) for ln in open(tel_on)
+            if json.loads(ln).get("kind") == "divergence"]
+    assert len(divs) == 2
+    assert all(d["first_bad_step"] == 14 for d in divs)
+
+
+def test_divergence_step_exact_inside_k_block(tel_on, tmp_path,
+                                              monkeypatch):
+    """An injected blow-up under K=4 names the SAME first-bad step the
+    historical chunk reports — the sentinel latches per step inside the
+    scan, not per K-block."""
+    unstable = {**_B2, "re": 1000.0, "te": 6.5, "tau": -1.0, "dt": 1.0,
+                "itermax": 10, "tpu_chunk": 4}
+
+    def first_bad(fuse, path):
+        monkeypatch.setenv("PAMPI_TELEMETRY", str(path))
+        tm.reset()
+        s = NS2DSolver(Parameter(**unstable, tpu_chunk_fuse=fuse))
+        with pytest.warns(UserWarning, match="non-finite"):
+            s.run(progress=False)
+        tm.reset()
+        divs = [json.loads(ln) for ln in open(path)
+                if json.loads(ln).get("kind") == "divergence"]
+        assert len(divs) == 1
+        return divs[0]["first_bad_step"], divs[0]["last_good_step"]
+
+    fb1, lg1 = first_bad("off", tmp_path / "off.jsonl")
+    fb4, lg4 = first_bad("4", tmp_path / "k4.jsonl")
+    assert (fb1, lg1) == (fb4, lg4)
+    assert lg4 == fb4 - 1

@@ -12,9 +12,11 @@ regresses beyond the tolerance vs the best earlier point of that series.
 Backend partition: every point is tagged cpu|tpu
 (`tools/_artifact.backend_tag`), and series are keyed (metric, backend) —
 a CPU growth-container round can never gate against a chip number, and
-vice versa. Direction comes from the unit: `*/s` rates regress downward,
-`ms*` latencies regress upward; metrics with unknown units render in the
-table but do not gate.
+vice versa. The cpu series gate at the wider CPU_TOLERANCE (growth
+containers are different hardware round to round — see the constant's
+rationale); tpu series keep the tight default. Direction comes from the
+unit: `*/s` rates regress downward, `ms*` latencies regress upward;
+metrics with unknown units render in the table but do not gate.
 
 Runs as the `trend` pass of `tools/lint.py` (make lint / make
 bench-trend), so a perf-regressing PR fails on CPU before any TPU time
@@ -34,6 +36,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DEFAULT_TOLERANCE = 0.10
+
+# the cpu series gate at a wider tolerance: CPU growth containers are
+# NOT the same hardware round to round — the r08 container runs the
+# byte-identical r06 poisson RB loop 21% slower when idle (67.1M vs
+# 52.9M updates/s, best-of-many) — so a 10% cpu gate false-fires on
+# container luck, not code. 0.35 covers the measured cross-container
+# spread while still catching real order-of-magnitude breakage (a jnp
+# fallback where a fused path gated, an accidental f64 promotion). The
+# tpu series keep the tight gate: chip rounds run on the same part.
+CPU_TOLERANCE = 0.35
 
 
 def default_files() -> list[str]:
@@ -131,7 +143,21 @@ NAME_DIRECTIONS = {"comm_hidden_fraction": True,
                    # is better: 2 is the fused DOWN/UP pair; a rise
                    # means the cycle fell back to the per-level launch
                    # ladder
-                   "mg_launches_per_cycle": False}
+                   "mg_launches_per_cycle": False,
+                   # the K-fused chunk census (ISSUE 17): static Pallas
+                   # launches of one traced K-step chunk divided by K
+                   # (bench.py _launches_per_step_line — exact on any
+                   # backend). Fewer is better: a rise means either the
+                   # scan stopped fusing (K fell to 1) or the chunk body
+                   # grew launches; jaxprcheck pins the hard < 3 ceiling,
+                   # this gate catches drift below it
+                   "launches_per_step": False,
+                   # the serving-regime step time (ISSUE 17): 64²/256²
+                   # dcavity ms/step where the per-step envelope the
+                   # K-fusion amortizes is first-order; the unit already
+                   # gates ms downward — named so a unit-string drift
+                   # can never silently un-gate the serving headline
+                   "ns2d_small_ms_per_step": False}
 
 
 def higher_is_better(unit, name: str | None = None) -> bool | None:
@@ -159,20 +185,21 @@ def check_regressions(series: dict,
         direction = higher_is_better(pts[-1][2], name)
         if direction is None:
             continue
+        tol = tolerance if backend == "tpu" \
+            else max(tolerance, CPU_TOLERANCE)
         last_round, last, _ = pts[-1]
         prior = [v for _, v, _ in pts[:-1]]
         best = max(prior) if direction else min(prior)
         if best == 0:
             continue
         ratio = last / best
-        bad = ratio < 1.0 - tolerance if direction \
-            else ratio > 1.0 + tolerance
+        bad = ratio < 1.0 - tol if direction else ratio > 1.0 + tol
         if bad:
             arrow = "dropped" if direction else "rose"
             errs.append(
                 f"{name} [{backend}]: r{last_round:02d} = {last:.6g} "
                 f"{arrow} {abs(1.0 - ratio) * 100:.1f}% beyond the "
-                f"{tolerance * 100:.0f}% tolerance vs the best earlier "
+                f"{tol * 100:.0f}% tolerance vs the best earlier "
                 f"point {best:.6g}")
     return errs
 

@@ -71,6 +71,10 @@ DECOMP_KEYS = ("solve_ms", "nonsolve_ms", "phases", "steps_timed")
 # ladder comparison count must ride the block — a census that cannot
 # say WHICH cycle program it counted is not a census
 MG_LAUNCH_KEYS = ("mg_dispatch", "ladder_launches")
+# the K-fusion launch census (bench.py _launches_per_step_line,
+# ISSUE 17): the quotient is meaningless without the dispatch record
+# that names the K, the raw static count, and the divisor itself
+FUSE_LAUNCH_KEYS = ("chunk_fuse_dispatch", "pallas_calls", "k")
 SUMMARY_REQUIRED = ("schema_version", "dispatch", "chunks", "records")
 
 
@@ -287,6 +291,8 @@ def lint_bench(d: dict, where: str = "BENCH") -> list[str]:
             errs += _missing(block, DECOMP_KEYS, f"{where}.{key}")
         if metric == "mg_launches_per_cycle":
             errs += _missing(block, MG_LAUNCH_KEYS, f"{where}.{key}")
+        if metric == "launches_per_step":
+            errs += _missing(block, FUSE_LAUNCH_KEYS, f"{where}.{key}")
     if isinstance(d.get("telemetry_summary"), dict):
         errs += lint_telemetry_summary(
             d["telemetry_summary"], f"{where}.telemetry_summary")

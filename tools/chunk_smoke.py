@@ -1,0 +1,214 @@
+"""K-fused chunk smoke: the whole ISSUE 17 seam end-to-end on whatever
+backend this host has (make chunk-smoke — CPU-safe, 8 forced host
+devices).
+
+    python tools/chunk_smoke.py [outdir]
+
+Proves, before any TPU time is spent:
+
+- PARITY: a K=4 fused chunk (tpu_chunk_fuse=4 — the scan-wrapped body)
+  reaches the SAME fields as the historical one-step-per-body chunk
+  (off) on the distributed 2-D family, jnp path bitwise and fused path
+  at the ulp contract, over a full te-bounded run on a (2, 2) mesh.
+- DEPTH CENSUS: the tiered depth config (tpu_mesh_tiers=i=dcn,
+  tpu_exchange_depth=i=4) traces EXACTLY one slow-tier capture exchange
+  per field per 4 steps — the dcn tier carries the depth-4 strips and
+  ZERO historical per-step deep strips, the ici tier keeps its per-step
+  exchange unchanged, and the per-tier byte sum equals the flat census.
+- LAUNCHES/STEP: the traced K-block's static pallas_call count divided
+  by K stays under the fusion contract's 3/step ceiling.
+- the telemetry plane: the `launches_per_step` metric record, the merge
+  into a BENCH-shaped artifact, and `tools/check_artifact.py` accepting
+  the merged block (incl. the FUSE_LAUNCH_KEYS census keys).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU-stable smoke environment: must precede any jax import (the
+# tools/lint.py convention); a TPU image just keeps its own backend
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+# K=4 vs historical on the FUSED phase path: identical arithmetic per
+# step, but the scan body's time-gate cond and the per-K-block metrics
+# latch reassociate a handful of f32 ops — last-ulp scale, like the
+# interpret-fma twins. The jnp path is pinned bitwise (TOL 0).
+TOL_FUSED = 2e-6
+
+
+def _run_dist(failures: list[str], **kw):
+    """One te-bounded NS2DDistSolver run on a (2, 2) mesh; returns
+    (u, p, nt) as host arrays plus the dispatch snapshot."""
+    from pampi_tpu.models.ns2d_dist import NS2DDistSolver
+    from pampi_tpu.parallel.comm import CartComm
+    from pampi_tpu.utils import dispatch as disp
+    from pampi_tpu.utils.params import Parameter
+
+    base = dict(name="dcavity", imax=16, jmax=16, re=10.0, te=0.1,
+                tau=0.5, itermax=10, eps=1e-4, omg=1.7, gamma=0.9)
+    base.update(kw)
+    p = Parameter(**base)
+    comm = CartComm(ndims=2, extents=(p.jmax, p.imax), dims=(2, 2),
+                    tiers=p.tpu_mesh_tiers)
+    s = NS2DDistSolver(p, comm=comm)
+    s.run(progress=False)
+    u, v, pp = s.fields()
+    return np.asarray(u), np.asarray(pp), s.nt, dict(disp.snapshot())
+
+
+def _parity(failures: list[str]) -> None:
+    """K=4 vs historical, jnp bitwise + fused at the ulp contract."""
+    for tag, extra, tol in (
+            ("jnp", {}, 0.0),
+            ("fused", {"tpu_fuse_phases": "on"}, TOL_FUSED)):
+        u1, p1, nt1, _ = _run_dist(failures, tpu_chunk_fuse="off", **extra)
+        u4, p4, nt4, snap = _run_dist(failures, tpu_chunk_fuse="4", **extra)
+        rec = snap.get("ns2d_dist_chunk_fuse") or ""
+        if "scan (K=4" not in rec:
+            failures.append(f"{tag}: dispatch ns2d_dist_chunk_fuse = "
+                            f"{rec!r} — the forced K=4 scan did not arm")
+        if nt1 != nt4:
+            failures.append(f"{tag}: K=4 ran {nt4} steps, historical "
+                            f"{nt1} — external chunk arity drifted")
+        d = max(float(np.abs(u4 - u1).max()), float(np.abs(p4 - p1).max()))
+        m = max(float(np.abs(u1).max()), float(np.abs(p1).max()), 1.0)
+        print(f"[parity {tag}] {rec} | nt {nt1}/{nt4} | "
+              f"maxdiff {d:.3g} (scale {m:.3g})")
+        if d > tol * m:
+            failures.append(f"{tag}: K=4 vs historical maxdiff {d:.3g} "
+                            f"beyond {tol} of scale {m:.3g}")
+
+
+def _census_and_launches(failures: list[str]) -> list[dict]:
+    """Trace the standard depth config once; pin the per-tier exchange
+    census and the launches-per-step quotient off the SAME jaxpr."""
+    from pampi_tpu.analysis import commcheck as cc
+    from pampi_tpu.analysis import jaxprcheck as jc
+    from pampi_tpu.utils import telemetry as tm
+
+    cfg = next(c for c in jc.standard_configs()
+               if c.name == "ns2d_dist_depth")
+    tc = jc.trace_config(cfg)
+    k = jc.chunk_fuse_k(tc.decisions)
+    if k != 4:
+        failures.append(f"depth config traced K={k}, expected 4 "
+                        f"({tc.decisions})")
+
+    def tier_count(tiers, tier, prefix):
+        strips = tiers.get(tier, {}).get("strips", {})
+        return sum(n for key, n in strips.items()
+                   if key.startswith(prefix))
+
+    tiers = cc.census_tiers(tc.jaxpr.jaxpr, tc.solver.comm.tiers)
+    flat = cc.census(tc.jaxpr.jaxpr)
+    # the amortization proof, per traced K=4 block: the dcn axis ships
+    # 2 ppermutes per capture exchange × 2 fields (u, v) of the DEPTH-4
+    # strip — one slow exchange per field per 4 steps — and NONE of the
+    # historical per-step deep strips it replaced; the ici axis keeps
+    # its per-step fresh exchange (4 = one per scan step, 2 fields
+    # × 2 ppermutes would be 8 — paste refreshes u and v in ONE fused
+    # pair per step)
+    n_cap = tier_count(tiers, "dcn", "16x4:")
+    n_old = tier_count(tiers, "dcn", "14x3:")
+    n_ici = tier_count(tiers, "ici", "3x14:")
+    print(f"[census] dcn capture 16x4 ×{n_cap}, dcn historical 14x3 "
+          f"×{n_old}, ici fresh 3x14 ×{n_ici}")
+    if n_cap != 4:
+        failures.append(f"dcn tier carries {n_cap} depth-4 capture "
+                        "ppermutes per K-block, the 1-exchange-per-"
+                        "4-steps contract says 4 (2 fields × 2)")
+    if n_old:
+        failures.append(f"dcn tier still carries {n_old} historical "
+                        "per-step deep strips — amortized AND kept")
+    if n_ici != 4:
+        failures.append(f"ici tier carries {n_ici} per-step fresh "
+                        "ppermutes per K-block, expected 4 (depth "
+                        "unchanged at 1 exchange per step)")
+    tier_bytes = sum(t["bytes"] for t in tiers.values())
+    if tier_bytes != flat["ppermute_bytes"]:
+        failures.append(f"per-tier byte sum {tier_bytes} != flat census "
+                        f"{flat['ppermute_bytes']}")
+
+    n_launch = jc.count_prim(tc.jaxpr.jaxpr, "pallas_call")
+    lps = n_launch / max(k, 1)
+    print(f"[launches] {n_launch} pallas_call(s) / K={k} = {lps}/step")
+    if k >= 2 and lps >= 3:
+        failures.append(f"{lps}/step breaches the K-fusion contract's "
+                        "3-launch ceiling")
+    line = {"metric": "launches_per_step", "value": lps,
+            "unit": "launches/step",
+            "chunk_fuse_dispatch": tc.decisions.get(
+                "ns2d_dist_chunk_fuse"),
+            "pallas_calls": n_launch, "k": k,
+            "config": f"{cfg.name} (smoke)"}
+    tm.emit("metric", **line)
+    return [line]
+
+
+def main(argv: list[str]) -> int:
+    outdir = argv[1] if len(argv) > 1 else os.path.join(
+        REPO, "results", "chunk_smoke")
+    os.makedirs(outdir, exist_ok=True)
+    jsonl = os.path.join(outdir, "run.jsonl")
+    if os.path.exists(jsonl):
+        os.remove(jsonl)
+    os.environ["PAMPI_TELEMETRY"] = jsonl
+
+    from pampi_tpu.utils import telemetry as tm
+
+    tm.reset()
+    tm.start_run(tool="chunk_smoke")
+    failures: list[str] = []
+    _parity(failures)
+    lines = _census_and_launches(failures)
+    tm.finalize()
+
+    # the telemetry plane end-to-end
+    from tools import telemetry_report as tr
+
+    records = tr.load(jsonl)
+    metric = [r for r in records if r.get("kind") == "metric"
+              and r.get("metric") == "launches_per_step"]
+    if len(metric) != len(lines):
+        failures.append(f"{len(metric)} launches_per_step records in "
+                        f"the flight record, {len(lines)} emitted")
+
+    # the merge + lint round trip (incl. the FUSE_LAUNCH_KEYS block rule)
+    artifact = os.path.join(outdir, "CHUNK_SMOKE.json")
+    if os.path.exists(artifact):
+        os.remove(artifact)
+    from tools._artifact import write_merged
+    from tools.check_artifact import lint_bench
+
+    block = {"n": 0, "cmd": "chunk_smoke", "rc": 0, "tail": "",
+             "telemetry_summary": tr.summary(records)}
+    if lines:
+        block["parsed_launches"] = lines[0]
+    merged = write_merged(artifact, block)
+    failures += lint_bench(merged, "CHUNK_SMOKE")
+    if not any(m.get("name") == "launches_per_step"
+               for m in merged.get("metrics", [])):
+        failures.append("merged artifact carries no normalized "
+                        "launches_per_step metric")
+
+    if failures:
+        print("\nCHUNK SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("\nchunk smoke ok: K=4 parity (jnp bitwise, fused at ulp), "
+          "1 dcn exchange per field per 4 steps with ici unchanged, "
+          f"launches/step {lines[0]['value']} < 3, artifact lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -19,6 +19,13 @@ Proves, before any TPU time is spent:
 - the telemetry plane: the `mg_launches_per_cycle` metric record, the
   merge into a BENCH-shaped artifact, and `tools/check_artifact.py`
   accepting the merged block (incl. the MG_LAUNCH_KEYS census keys).
+- EPS FLOOR (ISSUE 17): the parity cases A/B at eps=0 — the sanctioned
+  FIXED-ITERATION comparison mode (every solve runs to itermax), silent
+  by contract. A floor-adjacent eps instead warns at build time
+  (utils/precision.check_eps_floor): near the f32 residual floor the
+  loop residual is summation-order noise and fused-vs-ladder iteration
+  counts legitimately diverge — the ROADMAP footgun this smoke pins
+  shut from both sides (the warning fires, and exactly once).
 """
 
 from __future__ import annotations
@@ -160,6 +167,20 @@ def _parity(failures: list[str]) -> list[dict]:
         failures.append(f"ragged 33²: refusal reason missing from the "
                         f"dispatch record ({reason!r})")
 
+    # eps-floor footgun (ISSUE 17): every parity case above compared at
+    # eps=0, the fixed-iteration mode — silent by contract. A
+    # floor-adjacent eps must warn at build time, and the telemetry
+    # record must land in THIS flight record (main() counts it)
+    import warnings as _w
+
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        mg.make_mg_solve_2d(64, 64, 1 / 64, 1 / 64, 1e-7, 2, dtype,
+                            stall_rtol=0)
+    if not any("residual floor" in str(c.message) for c in caught):
+        failures.append("64² at eps 1e-7: no eps-floor warning from "
+                        "make_mg_solve_2d (utils/precision)")
+
     # the one-launch class cycle (fleet lane): exactly 1 pallas_call
     import jax
 
@@ -206,6 +227,13 @@ def main(argv: list[str]) -> int:
     if len(metric) != len(lines):
         failures.append(f"{len(metric)} mg_launches_per_cycle records in "
                         f"the flight record, {len(lines)} emitted")
+    floor_warns = [r for r in records if r.get("kind") == "warning"
+                   and r.get("component") == "precision"]
+    if len(floor_warns) != 1:
+        failures.append(
+            f"{len(floor_warns)} precision eps-floor warning records in "
+            "the flight record — the floor-adjacent build must emit "
+            "exactly one, and the eps=0 parity cases none")
 
     # the merge + lint round trip (incl. the MG_LAUNCH_KEYS block rule)
     artifact = os.path.join(outdir, "MG_SMOKE.json")
