@@ -141,6 +141,16 @@ fleet-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
+# Soak smoke (serving observability, ISSUE 18): a synthetic mixed-grid /
+# mixed-family / mixed-tenant request stream in waves through the daemon
+# with SLO targets armed — injected divergences + a malformed .par —
+# sampling the queue-depth/latency trajectory per poll. Asserts the
+# request-trace decomposition closes (median request's stage sum ==
+# its end-to-end latency within 5%), the registry/slo/trace blocks lint
+# clean, and the Prometheus scrape file carries the latency histogram.
+soak-smoke:
+	JAX_PLATFORMS=cpu python tools/soak.py
+
 # The full fleet test file INCLUDING the slow-marked parity cases
 # (fused / 3-D-dist vmap batches — tier-1 carries one representative
 # per axis to hold its 870 s window; this target is the complete
@@ -194,6 +204,7 @@ distclean:
 
 .PHONY: all test asm format telemetry-report check-artifacts bench-trend \
 	profile-smoke mg-smoke chunk-smoke mg-suite fleet-smoke serve-smoke \
+	soak-smoke \
 	fleet-suite \
 	lint \
 	lint-update lint-comm \

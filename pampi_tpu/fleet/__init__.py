@@ -22,6 +22,9 @@ machinery into a serving stack:
   serve.py      the persistent daemon: file-queue request plane,
                 admission control, per-tenant quotas, live status
                 endpoint (tools/serve.py is the CLI)
+  slo.py        tenant SLO accounting: per-tenant latency targets, the
+                sliding-window error budget, burn-rate alerting (the
+                `slo` record plane + the status.json block)
 
 See README "Fleet serving" for the request format, the bucketing policy
 and the knob table.
@@ -48,6 +51,7 @@ from .scheduler import (
     shrink_resume,
 )
 from .serve import FleetDaemon, ServeConfig
+from .slo import SloTracker, parse_slo_spec
 
 __all__ = [
     "BatchedSolver", "FleetRecorder", "lane_state",
@@ -57,4 +61,5 @@ __all__ = [
     "FleetResult", "FleetScheduler", "ScenarioResult", "reset_templates",
     "run_fleet", "shrink_resume",
     "FleetDaemon", "ServeConfig",
+    "SloTracker", "parse_slo_spec",
 ]

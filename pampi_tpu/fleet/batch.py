@@ -468,6 +468,11 @@ class BatchedSolver:
             "nt": int(nt[lane]),
             "diverged": not bool(active[lane]),
             "fields": fields,
+            # the harvest clock: when this lane's result left the device
+            # plane — the request trace's `done` boundary (the scheduler
+            # maps it onto utils/tracing marks; the continuous path's
+            # completion ordering rides the same stamp)
+            "served_ts": time.time(),
         }
 
     def results(self, state) -> list[dict]:

@@ -42,6 +42,7 @@ import dataclasses
 import hashlib
 import os
 
+from ..utils import tracing as _tr
 from ..utils.params import Parameter, is_3d_config, read_parameter
 
 # per-lane state-only keys: they set initial FIELD VALUES, never trace
@@ -78,6 +79,10 @@ class ScenarioRequest:
 
     sid: str
     param: Parameter
+    # request-lifecycle trace id (utils/tracing.mint at daemon
+    # admission); None outside the traced serving path — every tracing
+    # helper no-ops on None, so batch-mode callers never pay for it
+    trace: str | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -194,8 +199,14 @@ def bucket(requests, classes: bool = False) -> dict:
             _dispatch.resolve_class(
                 f"class_{label}",
                 key.grid if key is not None else (), why_not)
+            if key is not None:
+                # class resolution is a waterfall detail mark: when the
+                # request's shape class resolved, inside queue_wait
+                _tr.mark(req.trace, "class_pad")
         if key is None:
             key = bucket_key(req.param)
+        _tr.mark(req.trace, "bucket")
+        _tr.note(req.trace, bucket=key.label, family=key.family)
         out.setdefault(key, []).append(req)
     return out
 

@@ -56,6 +56,7 @@ import jax
 import numpy as np
 
 from ..utils import telemetry as _tm
+from ..utils import tracing as _tr
 from . import queue as _q
 from .batch import BatchedSolver, lane_state, _field_names, _split_state
 
@@ -389,28 +390,40 @@ class FleetScheduler:
         family = key.family
         label = key.label
         cached = False
+        # trace boundary: bucket execution starts here — queue_wait ends
+        # for every lane in the bucket (swapped-in continuous lanes are
+        # re-stamped at their swap, latest-wins)
+        for req in reqs:
+            _tr.mark(req.trace, "exec_start")
+            _tr.note(req.trace, mode=mode)
         if mode == "solo":
             build_wall = 0.0
             t0 = time.perf_counter()
             results = []
             for req in reqs:
                 b0 = time.perf_counter()
+                _tr.mark(req.trace, "exec_start")  # per-req solo build
                 solver = _build_solver(
                     req.param, family, _make_comm(req.param, family))
                 build_wall += time.perf_counter() - b0
+                _tr.mark(req.trace, "run_start")
                 with _tm.scenario_scope(req.sid):
                     solver.run(progress=progress)
+                _tr.mark(req.trace, "done")
                 results.append(_solo_result(
                     solver, req.sid, label, mode, family))
             run_wall = time.perf_counter() - t0 - build_wall
         elif mode == "pjit":
             template, cached, build_wall = self._warm_template(key, reqs)
+            for req in reqs:
+                _tr.mark(req.trace, "run_start")
             t0 = time.perf_counter()
             results = []
             for req in reqs:
                 _reset_lane(template, req.param)
                 with _tm.scenario_scope(req.sid):
                     template.run(progress=progress)
+                _tr.mark(req.trace, "done")
                 results.append(_solo_result(
                     template, req.sid, label, mode, family))
             run_wall = time.perf_counter() - t0
@@ -431,6 +444,10 @@ class FleetScheduler:
                 continuous=continuous)
             build_wall += bwall
             cached = bcached
+            # the pool's compile phase ends here; lanes beyond the pool
+            # are re-stamped when they swap in (_serve_continuous)
+            for req in reqs[:pool]:
+                _tr.mark(req.trace, "run_start")
             t0 = time.perf_counter()
             if continuous:
                 from ..utils import dispatch as _dispatch
@@ -453,6 +470,11 @@ class FleetScheduler:
             # cached batch itself wraps the now-stale program)
             if _clear_contamination(template):
                 _drop_batches(key.sig)
+            # the harvest clock is each lane's `done` trace boundary
+            traces = {r.sid: r.trace for r in reqs}
+            for r in rows:
+                _tr.mark(traces.get(r["sid"]), "done",
+                         ts=r.get("served_ts"))
             results = [
                 ScenarioResult(sid=r["sid"], bucket=label, mode=mode,
                                family=family, t=r["t"], nt=r["nt"],
@@ -577,6 +599,10 @@ class FleetScheduler:
             for lane in range(batched.n):
                 if harvested[lane] and pending:
                     req = pending.pop(0)
+                    # the swapped-in lane's queue_wait ends NOW (the
+                    # pool is already compiled, so compile is ~0)
+                    _tr.mark(req.trace, "exec_start")
+                    _tr.mark(req.trace, "run_start")
                     state = batched.swap_lane(
                         state, lane, req.param, req.sid)
                     if rec is not None:
@@ -613,8 +639,7 @@ class FleetScheduler:
                 lane = int(lane)
                 if harvested[lane]:
                     continue
-                res = batched.harvest(state, lane)
-                res["served_ts"] = time.time()
+                res = batched.harvest(state, lane)  # stamps served_ts
                 out.append(res)
                 harvested[lane] = True
         return out, swaps

@@ -24,6 +24,21 @@ Status endpoint: a JSON file rewritten atomically at every poll and
 after every bucket — uptime, served/parked/deferred counts, queue
 depth (+max), per-tenant table, per-class compile counts, swap count,
 latency percentiles, scenarios/s — the live view a load-test watches.
+
+Observability (serving v4): latency percentiles come from a BOUNDED
+log-bucket histogram (utils/metrics.Registry, per serving session — the
+old unbounded `latencies_ms` list grew one float per request forever),
+labeled per tenant and per class; the registry is snapshotted into a
+`metrics` telemetry record each poll and rendered as a Prometheus-style
+text file (`metrics.prom`) next to status.json. Every accepted request
+mints a trace id (utils/tracing) whose parented stage records
+(queue_wait/compile/execute/emit) decompose its end-to-end latency —
+tools/telemetry_report.py renders the waterfall. Tenant SLO targets
+(ServeConfig.slo, `"default=250,alice=100"`) arm fleet/slo.SloTracker:
+sliding-window error-budget burn per tenant as `slo` records + a
+status.json block, burn alerts via `warning` records, and
+fleet_class_p95_ms / slo_violations metric records into bench_trend's
+gate at stop.
 Shutdown: a `STOP` file in the queue directory (or `max_polls` for
 smokes/CI); the daemon finishes the in-flight poll, writes the final
 status and telemetry (`serving` stop record + the
@@ -38,9 +53,12 @@ import json
 import os
 import time
 
+from ..utils import metrics as _mx
 from ..utils import telemetry as _tm
+from ..utils import tracing as _tr
 from . import queue as _q
 from .scheduler import FleetScheduler
+from .slo import SloTracker, parse_slo_spec
 
 STOP_FILE = "STOP"
 
@@ -59,6 +77,12 @@ class ServeConfig:
     classes: str = "on"         # shape-class batching (the serving
     #                             default; "off" = exact-shape buckets)
     max_polls: int = 0          # 0 = run until the STOP file appears
+    slo: str = ""               # tenant SLO targets, fleet/slo.
+    #                             parse_slo_spec ("default=250,alice=100"
+    #                             = p95 latency targets in ms; empty =
+    #                             SLO plane off)
+    slo_window_s: float = 60.0  # sliding error-budget window
+    slo_burn_alert: float = 2.0  # burn-rate warning threshold
 
 
 def tenant_of(sid: str) -> str:
@@ -101,10 +125,22 @@ class FleetDaemon:
         self.swaps = 0
         self.queue_depth = 0
         self.queue_depth_max = 0
-        self.latencies_ms: list[float] = []
+        # latency population: a BOUNDED log-bucket histogram per label
+        # set (overall / tenant / class) — O(#buckets) memory over any
+        # soak length, where the old `latencies_ms` list grew forever.
+        # The registry is per serving SESSION: two daemons in one
+        # process must not share a latency population.
+        self.metrics = _mx.Registry()
+        self.metrics_path = os.path.join(
+            os.path.dirname(self.status_path) or ".", "metrics.prom")
+        self.slo = SloTracker(parse_slo_spec(cfg.slo),
+                              window_s=cfg.slo_window_s,
+                              burn_alert=cfg.slo_burn_alert)
+        self._slo_block: dict = {}
         self.per_tenant: dict[str, dict] = {}
         self.scenarios_per_s = None
         self._accept_ts: dict[str, float] = {}
+        self._trace_ids: dict[str, str | None] = {}
         self._pending_by_tenant: dict[str, int] = {}
         _tm.emit("serving", event="start", queue_dir=cfg.queue_dir,
                  max_lanes=cfg.max_lanes, max_queue=cfg.max_queue,
@@ -167,7 +203,13 @@ class FleetDaemon:
             if not reqs:
                 continue  # parked
             req = reqs[0]
-            req = _q.ScenarioRequest(sid=sid, param=req.param)
+            # admission is the trace root: the minted id threads
+            # queue -> scheduler -> batch and back (None when telemetry
+            # is off — every downstream mark no-ops)
+            trace = _tr.mint(sid, tenant=tenant)
+            req = _q.ScenarioRequest(sid=sid, param=req.param,
+                                     trace=trace)
+            self._trace_ids[sid] = trace
             os.replace(path, os.path.join(self.accepted_dir,
                                           os.path.basename(path)))
             self._accept_ts[sid] = time.time()
@@ -196,31 +238,48 @@ class FleetDaemon:
             self._pending_by_tenant[tenant] = max(
                 0, self._pending_by_tenant.get(tenant, 0) - 1)
             t_acc = self._accept_ts.pop(sc.sid, None)
+            trace = self._trace_ids.pop(sc.sid, None)
             if getattr(sc, "failed", False):
                 # per-bucket isolation (scheduler isolate mode): the
                 # bucket could not be scheduled — a failed result, a
                 # failure file, and the daemon keeps serving
                 self.failed += 1
+                self.metrics.counter("fleet_failed_total",
+                                     tenant=tenant).inc()
                 _tm.emit("admission", action="fail", sid=sc.sid,
                          tenant=tenant, error=sc.error)
                 with open(os.path.join(self.results_dir,
                                        f"{sc.sid}.json"), "w") as fh:
                     json.dump({"sid": sc.sid, "tenant": tenant,
                                "failed": True, "error": sc.error}, fh)
+                _tr.finish(trace, status="failed")
                 continue
             latency_ms = (round((now - t_acc) * 1e3, 3)
                           if t_acc is not None else None)
             if latency_ms is not None:
-                self.latencies_ms.append(latency_ms)
+                self.metrics.histogram(
+                    "fleet_request_latency_ms").observe(latency_ms)
+                self.metrics.histogram(
+                    "fleet_request_latency_ms",
+                    tenant=tenant).observe(latency_ms)
+                self.metrics.histogram(
+                    "fleet_class_latency_ms",
+                    klass=sc.bucket,
+                    family=sc.family).observe(latency_ms)
+                self.slo.observe(tenant, latency_ms, now)
                 _tm.emit("latency", scenario=sc.sid, tenant=tenant,
                          ms=latency_ms, bucket=sc.bucket, mode=sc.mode)
             row = self.per_tenant.setdefault(
                 tenant, {"served": 0, "diverged": 0})
             row["served"] += 1
             self.served += 1
+            self.metrics.counter("fleet_served_total",
+                                 tenant=tenant).inc()
             if sc.diverged:
                 row["diverged"] += 1
                 self.diverged += 1
+                self.metrics.counter("fleet_diverged_total",
+                                     tenant=tenant).inc()
             with open(os.path.join(self.results_dir,
                                    f"{sc.sid}.json"), "w") as fh:
                 json.dump({"sid": sc.sid, "tenant": tenant,
@@ -228,6 +287,10 @@ class FleetDaemon:
                            "t": sc.t, "nt": sc.nt,
                            "diverged": sc.diverged,
                            "latency_ms": latency_ms}, fh)
+            # the result file is the request's emit boundary: the trace
+            # flushes its parented stage records here
+            _tr.mark(trace, "emit_end")
+            _tr.finish(trace)
         self.swaps = sum(self.sched.swap_census.values())
         self.scenarios_per_s = (round(len(result.scenarios) / wall, 4)
                                 if wall > 0 else None)
@@ -246,6 +309,10 @@ class FleetDaemon:
             self._pending_by_tenant[tenant] = max(
                 0, self._pending_by_tenant.get(tenant, 0) - 1)
             self._accept_ts.pop(req.sid, None)
+            self.metrics.counter("fleet_failed_total",
+                                 tenant=tenant).inc()
+            _tr.finish(self._trace_ids.pop(req.sid, None),
+                       status="failed")
             _tm.emit("admission", action="fail", sid=req.sid,
                      tenant=tenant, error=str(exc))
             with open(os.path.join(self.results_dir,
@@ -255,7 +322,11 @@ class FleetDaemon:
 
     # -- status endpoint ------------------------------------------------
     def status(self) -> dict:
-        return {
+        # percentiles off the bounded histogram: nearest-rank at bucket
+        # resolution (< ~4.5% of the exact sorted-list value,
+        # test-pinned); `max` is exact (the histogram tracks it aside)
+        hist = self.metrics.histogram("fleet_request_latency_ms")
+        st = {
             "uptime_s": round(time.time() - self.t0, 3),
             "polls": self.polls,
             "served": self.served,
@@ -270,14 +341,17 @@ class FleetDaemon:
             "per_tenant": self.per_tenant,
             "classes": dict(self.sched.compile_census),
             "latency_ms": {
-                "p50": _percentile(self.latencies_ms, 0.5),
-                "p95": _percentile(self.latencies_ms, 0.95),
-                "max": (round(max(self.latencies_ms), 3)
-                        if self.latencies_ms else None),
+                "p50": hist.quantile(0.5),
+                "p95": hist.quantile(0.95),
+                "max": (round(hist.vmax, 3)
+                        if hist.vmax is not None else None),
             },
             "scenarios_per_s": self.scenarios_per_s,
             "updated": round(time.time(), 3),
         }
+        if self.slo.targets:
+            st["slo"] = self._slo_block
+        return st
 
     def write_status(self) -> dict:
         st = self.status()
@@ -285,6 +359,9 @@ class FleetDaemon:
         with open(tmp, "w") as fh:
             json.dump(st, fh, indent=1)
         os.replace(tmp, self.status_path)  # atomic: readers never tear
+        # the scrape surface rides along: the registry as Prometheus
+        # text, atomically, next to status.json
+        self.metrics.write_prometheus(self.metrics_path)
         return st
 
     # -- the daemon loop ------------------------------------------------
@@ -297,7 +374,16 @@ class FleetDaemon:
         accepted = self.scan()
         if accepted:
             self.serve(accepted)
+        self.metrics.gauge("fleet_queue_depth").set(self.queue_depth)
+        self.metrics.gauge("fleet_active_lanes").set(self.cfg.max_lanes)
+        if self.slo.targets:
+            # per-tenant slo records + edge-triggered burn warnings;
+            # the returned block rides the status endpoint
+            self._slo_block = self.slo.poll(time.time())
         st = self.write_status()
+        # one cumulative registry snapshot per poll — the `metrics`
+        # record plane telemetry_report.metrics_summary folds
+        self.metrics.emit_snapshot(event="poll", poll=self.polls)
         _tm.emit("serving", event="poll", poll=self.polls,
                  accepted=len(accepted), served=self.served,
                  queue_depth=self.queue_depth)
@@ -316,6 +402,22 @@ class FleetDaemon:
         _tm.emit("metric", metric="fleet_queue_depth_max",
                  value=self.queue_depth_max, unit="requests",
                  backend=backend)
+        # the SLO gate metrics (bench_trend NAME_DIRECTIONS, both
+        # lower-is-better): the WORST per-class p95 — one headline per
+        # artifact, so the gate watches the tail class, not an average —
+        # and the lifetime violation count
+        class_p95 = [h.quantile(0.95)
+                     for h in self.metrics.histograms(
+                         "fleet_class_latency_ms") if h.n]
+        if class_p95:
+            _tm.emit("metric", metric="fleet_class_p95_ms",
+                     value=round(max(class_p95), 3), unit="ms",
+                     backend=backend)
+        if self.slo.targets:
+            _tm.emit("metric", metric="slo_violations",
+                     value=self.slo.total_violations(),
+                     unit="requests", backend=backend)
+        self.metrics.emit_snapshot(event="stop")
         _tm.emit("serving", event="stop",
                  # the daemon's own percentiles ride the stop record so
                  # the merged serving_summary reports the SAME numbers

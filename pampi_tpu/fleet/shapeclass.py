@@ -164,6 +164,16 @@ def class_signature(param) -> str:
         if f.name in skip:
             continue
         parts.append(f"{f.name}={getattr(param, f.name)!r}")
+    # the RUNG is part of the traced program's shape even though the
+    # request's own extents are per-lane data: two rungs of otherwise
+    # equal knobs must never share a signature (the scheduler's
+    # _TEMPLATES cache is sig-keyed — a collision hands a 16^2 template
+    # to a 32^2 bucket and every lane trips the exceeds-class guard)
+    from ..utils.params import is_3d_config
+
+    extents = ((param.imax, param.jmax, param.kmax)
+               if is_3d_config(param) else (param.imax, param.jmax))
+    parts.append("rung=" + "x".join(str(c) for c in class_grid(extents)))
     return "|".join(parts)
 
 

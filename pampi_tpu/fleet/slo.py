@@ -1,0 +1,161 @@
+"""Tenant SLO accounting for the serving daemon: per-tenant latency
+targets, a sliding-window error budget, and burn-rate alerting.
+
+The model is the classic SRE error budget: a tenant's target says "p95
+latency under T ms", which budgets 5% of requests (BUDGET) to exceed T.
+The tracker keeps a sliding window (window_s seconds) of per-request
+outcomes and reports, per tenant:
+
+    burn_rate = (violations / requests) / BUDGET
+
+- burn 1.0 = spending the budget exactly as fast as it refills (at the
+  p95 target boundary);
+- burn > 1.0 = on track to exhaust it (20.0 = every request violating);
+- burn 0.0 = no violations in the window.
+
+Targets come from a spec string (`--slo "default=250,alice=100"` on
+tools/serve.py, or ServeConfig.slo): `default` applies to any tenant
+without an explicit entry; tenants without a target (no default either)
+are observed into histograms but carry no SLO accounting.
+
+The daemon calls `observe()` per served request and `poll()` per status
+poll; `poll()` emits one `slo` telemetry record per tenant (schema v8)
+and returns the `status.json` block. Burn beyond `burn_alert` raises a
+`warning` record (component="slo") — EDGE-triggered: one warning when a
+tenant's burn crosses the threshold, re-armed when it drops back under,
+so a sustained burn doesn't spam a warning per poll.
+
+Window memory is bounded by construction: entries older than window_s
+are pruned on every observe/poll, so a soak holds at most one window of
+(ts, violated) pairs per tenant.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from ..utils import telemetry as _tm
+
+# the error budget a p95 target implies: 5% of requests may exceed it
+BUDGET = 0.05
+
+
+def parse_slo_spec(spec: str | None) -> dict[str, float]:
+    """`"default=250,alice=100"` -> {"default": 250.0, "alice": 100.0}.
+    Empty/None -> {} (SLO plane off). Raises ValueError on a malformed
+    entry — a mistyped SLO flag must fail loudly, not silently untrack
+    a tenant."""
+    out: dict[str, float] = {}
+    if not spec:
+        return out
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad SLO entry {part!r} "
+                             "(want tenant=target_ms)")
+        tenant, _, val = part.partition("=")
+        tenant = tenant.strip()
+        try:
+            target = float(val)
+        except ValueError:
+            raise ValueError(f"bad SLO target {val!r} for tenant "
+                             f"{tenant!r} (want a number, ms)")
+        if not tenant or target <= 0:
+            raise ValueError(f"bad SLO entry {part!r} "
+                             "(tenant non-empty, target > 0)")
+        out[tenant] = target
+    return out
+
+
+class SloTracker:
+    """Sliding-window error-budget accounting per tenant."""
+
+    def __init__(self, targets: dict[str, float],
+                 window_s: float = 60.0, burn_alert: float = 2.0):
+        self.targets = dict(targets)
+        self.window_s = float(window_s)
+        self.burn_alert = float(burn_alert)
+        # tenant -> deque[(ts, violated)] spanning at most window_s
+        self._window: dict[str, collections.deque] = {}
+        # tenant -> lifetime violation count (the stop-record metric)
+        self.violations_total: dict[str, int] = {}
+        self._alerting: set[str] = set()
+
+    def target_for(self, tenant: str) -> float | None:
+        return self.targets.get(tenant, self.targets.get("default"))
+
+    def _prune(self, tenant: str, now: float) -> None:
+        win = self._window.get(tenant)
+        if not win:
+            return
+        edge = now - self.window_s
+        # inclusive window: an entry AT the edge still counts, so a
+        # window_s-old outcome leaves exactly when now - ts > window_s
+        while win and win[0][0] < edge:
+            win.popleft()
+
+    def observe(self, tenant: str, latency_ms: float, now: float) -> bool:
+        """Record one served request; returns whether it violated the
+        tenant's target (False when the tenant has no target)."""
+        target = self.target_for(tenant)
+        if target is None:
+            return False
+        violated = float(latency_ms) > target
+        self._window.setdefault(
+            tenant, collections.deque()).append((now, violated))
+        self._prune(tenant, now)
+        if violated:
+            self.violations_total[tenant] = \
+                self.violations_total.get(tenant, 0) + 1
+        return violated
+
+    def burn_rate(self, tenant: str, now: float) -> float | None:
+        """The window's budget-burn rate; None when the tenant has no
+        target or no windowed requests."""
+        if self.target_for(tenant) is None:
+            return None
+        self._prune(tenant, now)
+        win = self._window.get(tenant)
+        if not win:
+            return None
+        bad = sum(1 for _, v in win if v)
+        return round((bad / len(win)) / BUDGET, 4)
+
+    def poll(self, now: float) -> dict:
+        """Per-poll reporting: emits one `slo` record per tracked tenant
+        (+ edge-triggered `warning` on burn > burn_alert) and returns
+        the status.json block."""
+        block: dict[str, dict] = {}
+        for tenant in sorted(self._window):
+            target = self.target_for(tenant)
+            if target is None:
+                continue
+            self._prune(tenant, now)
+            win = self._window.get(tenant) or ()
+            n = len(win)
+            bad = sum(1 for _, v in win if v)
+            burn = round((bad / n) / BUDGET, 4) if n else 0.0
+            row = {"target_ms": target, "window_s": self.window_s,
+                   "requests": n, "violations": bad,
+                   "violations_total": self.violations_total.get(
+                       tenant, 0),
+                   "burn_rate": burn}
+            block[tenant] = row
+            _tm.emit("slo", tenant=tenant, **row)
+            if burn > self.burn_alert:
+                if tenant not in self._alerting:
+                    self._alerting.add(tenant)
+                    _tm.emit("warning", component="slo",
+                             reason=f"tenant {tenant} error-budget burn "
+                                    f"{burn:.2f}x exceeds alert "
+                                    f"threshold {self.burn_alert:.2f}x",
+                             tenant=tenant, burn_rate=burn,
+                             target_ms=target)
+            else:
+                self._alerting.discard(tenant)
+        return block
+
+    def total_violations(self) -> int:
+        return sum(self.violations_total.values())

@@ -1,0 +1,388 @@
+"""Serving-plane metrics registry: counters, gauges, and MERGEABLE
+log-bucket histograms — the bounded-memory measurement layer under the
+fleet daemon's status endpoint and the SLO plane (ROADMAP item 3).
+
+Why a registry and not the flight record alone: the daemon used to keep
+every request latency in an unbounded Python list to compute its status
+percentiles — fine for a smoke, wrong for a 10k-request soak. A
+log-bucket histogram holds the SAME percentiles in O(#buckets) memory
+(a few hundred ints regardless of request count), and two histograms
+FOLD by summing bucket counts — so per-rank registries merge into one
+fleet view exactly like the artifact blocks `--merge` already folds.
+
+Design:
+
+- `Counter` / `Gauge` / `Histogram`, each labeled (tenant/class/family —
+  any string labels); a `Registry` holds one instance per (name, labels)
+  and hands the same object back on re-request.
+- Histogram buckets are LOGARITHMIC: bucket k covers (BASE^(k-1),
+  BASE^k] with BASE = 2^(1/8) (~9.05% relative width). Quantiles are
+  exact WITHIN a bucket's resolution: nearest-rank over the cumulative
+  counts — the same rank rule as `fleet/serve._percentile` — then the
+  bucket's geometric midpoint, so histogram p50/p95 agree with the
+  exact sorted-list computation to within half a bucket (<5% relative,
+  test-pinned in tests/test_metrics.py).
+- `snapshot()` is a plain-JSON dict; `emit_snapshot()` writes it as one
+  `metrics` telemetry record (schema v8) tagged with a per-process
+  source id + sequence number, so `tools/telemetry_report.
+  metrics_summary` can take the LAST snapshot per process and fold
+  across processes (cumulative snapshots from one process must never be
+  summed with each other).
+- `merge_snapshots(a, b)` is the fold: counters sum, gauges keep the
+  max (the conservative cross-rank reading for depth/backlog gauges),
+  histograms sum per-bucket — associative and commutative, test-pinned.
+- `render_prometheus()` / `write_prometheus(path)`: the classic
+  text-exposition format (`*_bucket{le=...}` cumulative counts +
+  `_sum`/`_count`), deterministically ordered so the output is
+  golden-pinnable; the daemon writes it next to status.json every poll.
+
+Everything here is HOST-side: observing into the registry touches no
+traced program (off-path jaxpr identity with the registry armed is
+test-pinned). The process-wide default registry (`registry()` /
+`counter()` / `gauge()` / `histogram()`) serves library callers; the
+serving daemon scopes a fresh `Registry` per session so back-to-back
+daemons in one process never mix latency populations.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+from . import telemetry as _tm
+
+# log-bucket width: 2^(1/8) per bucket (~9.05%); quantile error vs the
+# exact computation is at most half a bucket (BASE^0.5 - 1 ~ 4.4%)
+BASE = 2.0 ** 0.125
+_LOG_BASE = math.log(BASE)
+# bucket-index clamp: BASE^±400 spans ~1e-15..1e15 — any observable
+# latency/size; the clamp bounds memory even against garbage inputs
+_IDX_MIN, _IDX_MAX = -400, 400
+
+
+def _bucket_index(value: float) -> int:
+    """Bucket k covers (BASE^(k-1), BASE^k]; non-positive values get the
+    dedicated floor bucket _IDX_MIN (counted, excluded from the log
+    range)."""
+    if not (value > 0.0) or not math.isfinite(value):
+        return _IDX_MIN
+    k = math.ceil(math.log(value) / _LOG_BASE)
+    # float fuzz at an exact edge: log(BASE**k)/log(BASE) can land a
+    # hair above k; pull back when value is within one ulp-ish of the
+    # lower edge
+    if value <= BASE ** (k - 1):
+        k -= 1
+    return max(_IDX_MIN, min(_IDX_MAX, k))
+
+
+def bucket_edge(index: int) -> float:
+    """The INCLUSIVE upper edge of bucket `index`."""
+    return BASE ** index
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    """A monotone count (requests served, violations, swaps)."""
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = dict(labels)
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """A point-in-time level (queue depth, active lanes)."""
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = dict(labels)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Mergeable log-bucket histogram: O(#touched buckets) memory over
+    any observation count, nearest-rank quantiles at bucket resolution,
+    exact min/max/sum alongside (so `max` in a status block is exact)."""
+
+    def __init__(self, name: str = "", labels: dict | None = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self.counts: dict[int, int] = {}
+        self.n = 0
+        self.total = 0.0
+        self.vmin: float | None = None
+        self.vmax: float | None = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[_bucket_index(v)] = \
+            self.counts.get(_bucket_index(v), 0) + 1
+        self.n += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    def quantile(self, q: float) -> float | None:
+        """Nearest-rank quantile (the `fleet/serve._percentile` rank
+        rule: rank = round(q * (n - 1))) resolved to the holding
+        bucket's geometric midpoint. None when empty. The floor bucket
+        (non-positive observations) resolves to 0.0."""
+        if self.n == 0:
+            return None
+        rank = min(self.n - 1, max(0, int(round(q * (self.n - 1)))))
+        seen = 0
+        for idx in sorted(self.counts):
+            seen += self.counts[idx]
+            if seen > rank:
+                if idx <= _IDX_MIN:
+                    return 0.0
+                # geometric midpoint of (BASE^(idx-1), BASE^idx]
+                return round(BASE ** (idx - 0.5), 6)
+        return round(BASE ** (max(self.counts) - 0.5), 6)
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """The fold: bucket-count sum (associative + commutative)."""
+        out = Histogram(self.name, self.labels)
+        out.counts = dict(self.counts)
+        for idx, c in other.counts.items():
+            out.counts[idx] = out.counts.get(idx, 0) + c
+        out.n = self.n + other.n
+        out.total = self.total + other.total
+        mins = [m for m in (self.vmin, other.vmin) if m is not None]
+        maxs = [m for m in (self.vmax, other.vmax) if m is not None]
+        out.vmin = min(mins) if mins else None
+        out.vmax = max(maxs) if maxs else None
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "labels": self.labels,
+            "base": round(BASE, 9),
+            "n": self.n,
+            "sum": round(self.total, 6),
+            "min": self.vmin,
+            "max": self.vmax,
+            # JSON object keys are strings; parsers int() them back
+            "buckets": {str(k): v for k, v in sorted(self.counts.items())},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Histogram":
+        h = cls(str(d.get("name", "")), d.get("labels") or {})
+        h.counts = {int(k): int(v)
+                    for k, v in (d.get("buckets") or {}).items()}
+        h.n = int(d.get("n", 0))
+        h.total = float(d.get("sum", 0.0))
+        h.vmin = d.get("min")
+        h.vmax = d.get("max")
+        return h
+
+
+class Registry:
+    """One namespace of metrics: instruments keyed by (name, labels),
+    snapshot/emit/Prometheus surfaces. The module-level default is the
+    process-wide registry; the serving daemon scopes its own per
+    session (two daemons in one process must not share a latency
+    population)."""
+
+    def __init__(self):
+        self._counters: dict[tuple, Counter] = {}
+        self._gauges: dict[tuple, Gauge] = {}
+        self._hists: dict[tuple, Histogram] = {}
+        self._seq = 0
+
+    def counter(self, name: str, **labels) -> Counter:
+        key = (name, _label_key(labels))
+        if key not in self._counters:
+            self._counters[key] = Counter(name, labels)
+        return self._counters[key]
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        key = (name, _label_key(labels))
+        if key not in self._gauges:
+            self._gauges[key] = Gauge(name, labels)
+        return self._gauges[key]
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        key = (name, _label_key(labels))
+        if key not in self._hists:
+            self._hists[key] = Histogram(name, labels)
+        return self._hists[key]
+
+    def histograms(self, name: str | None = None) -> list[Histogram]:
+        return [h for h in self._hists.values()
+                if name is None or h.name == name]
+
+    def snapshot(self) -> dict:
+        """The plain-JSON registry state (the `metrics` record body and
+        the merge_snapshots operand)."""
+        return {
+            "counters": [
+                {"name": c.name, "labels": c.labels, "value": c.value}
+                for c in self._counters.values()
+            ],
+            "gauges": [
+                {"name": g.name, "labels": g.labels, "value": g.value}
+                for g in self._gauges.values()
+            ],
+            "histograms": [h.to_dict() for h in self._hists.values()],
+        }
+
+    def emit_snapshot(self, **fields) -> None:
+        """One `metrics` telemetry record: the full snapshot + a
+        per-process source id and sequence number. Snapshots are
+        CUMULATIVE — a reader takes the last per source and folds
+        ACROSS sources only (telemetry_report.metrics_summary)."""
+        self._seq += 1
+        _tm.emit("metrics", source=f"pid{os.getpid()}", seq=self._seq,
+                 **self.snapshot(), **fields)
+
+    # -- Prometheus text exposition ------------------------------------
+    def render_prometheus(self) -> str:
+        """The classic text format, deterministically ordered (sorted
+        by name then labels) so the output is golden-pinnable."""
+        lines: list[str] = []
+
+        def fmt_labels(labels: dict, extra: str = "") -> str:
+            parts = [f'{k}="{v}"' for k, v in sorted(labels.items())]
+            if extra:
+                parts.append(extra)
+            return "{" + ",".join(parts) + "}" if parts else ""
+
+        def fnum(v) -> str:
+            if v is None:
+                return "NaN"
+            f = float(v)
+            return str(int(f)) if f == int(f) else format(f, ".6g")
+
+        for c in sorted(self._counters.values(),
+                        key=lambda c: (c.name, _label_key(c.labels))):
+            if not any(ln.startswith(f"# TYPE {c.name} ")
+                       for ln in lines):
+                lines.append(f"# TYPE {c.name} counter")
+            lines.append(f"{c.name}{fmt_labels(c.labels)} {fnum(c.value)}")
+        for g in sorted(self._gauges.values(),
+                        key=lambda g: (g.name, _label_key(g.labels))):
+            if not any(ln.startswith(f"# TYPE {g.name} ")
+                       for ln in lines):
+                lines.append(f"# TYPE {g.name} gauge")
+            lines.append(f"{g.name}{fmt_labels(g.labels)} {fnum(g.value)}")
+        for h in sorted(self._hists.values(),
+                        key=lambda h: (h.name, _label_key(h.labels))):
+            if not any(ln.startswith(f"# TYPE {h.name} ")
+                       for ln in lines):
+                lines.append(f"# TYPE {h.name} histogram")
+            cum = 0
+            for idx in sorted(h.counts):
+                cum += h.counts[idx]
+                le = fnum(bucket_edge(idx)) if idx > _IDX_MIN else "0"
+                le_attr = 'le="%s"' % le
+                lines.append(
+                    f"{h.name}_bucket"
+                    f"{fmt_labels(h.labels, le_attr)} {cum}")
+            inf_attr = 'le="+Inf"'
+            lines.append(
+                f"{h.name}_bucket"
+                f"{fmt_labels(h.labels, inf_attr)} {h.n}")
+            lines.append(f"{h.name}_sum{fmt_labels(h.labels)} "
+                         f"{fnum(round(h.total, 6))}")
+            lines.append(f"{h.name}_count{fmt_labels(h.labels)} {h.n}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_prometheus(self, path: str) -> None:
+        """Atomic write (tmp + replace — the status.json convention, so
+        a scraper never reads a torn file)."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(self.render_prometheus())
+        os.replace(tmp, path)
+
+
+# -- snapshot-level fold (the cross-rank / cross-process merge) ---------
+
+def merge_snapshots(a: dict, b: dict) -> dict:
+    """Fold two registry snapshots: counters SUM, gauges take the MAX
+    (the conservative reading for backlog/depth levels), histograms sum
+    per bucket. Associative and commutative (test-pinned), so any fold
+    order over N ranks lands on the same fleet view."""
+
+    def key(row: dict) -> tuple:
+        return (row.get("name"), _label_key(row.get("labels") or {}))
+
+    counters: dict[tuple, dict] = {}
+    for row in list(a.get("counters") or []) + list(b.get("counters")
+                                                    or []):
+        k = key(row)
+        if k in counters:
+            counters[k] = {**counters[k],
+                           "value": counters[k]["value"] + row["value"]}
+        else:
+            counters[k] = dict(row)
+    gauges: dict[tuple, dict] = {}
+    for row in list(a.get("gauges") or []) + list(b.get("gauges") or []):
+        k = key(row)
+        if k in gauges:
+            gauges[k] = {**gauges[k],
+                         "value": max(gauges[k]["value"], row["value"])}
+        else:
+            gauges[k] = dict(row)
+    hists: dict[tuple, Histogram] = {}
+    for row in list(a.get("histograms") or []) + list(b.get("histograms")
+                                                      or []):
+        k = key(row)
+        h = Histogram.from_dict(row)
+        hists[k] = hists[k].merge(h) if k in hists else h
+    return {
+        "counters": sorted(counters.values(),
+                           key=lambda r: (r["name"],
+                                          _label_key(r["labels"]))),
+        "gauges": sorted(gauges.values(),
+                         key=lambda r: (r["name"],
+                                        _label_key(r["labels"]))),
+        "histograms": sorted((h.to_dict() for h in hists.values()),
+                             key=lambda r: (r["name"],
+                                            _label_key(r["labels"]))),
+    }
+
+
+def snapshot_quantile(hist_dict: dict, q: float) -> float | None:
+    """Quantile straight off a snapshot's histogram entry (readers that
+    never build a Histogram object — tools/telemetry_report.py)."""
+    return Histogram.from_dict(hist_dict).quantile(q)
+
+
+# -- the process-wide default registry ---------------------------------
+
+_DEFAULT = Registry()
+
+
+def registry() -> Registry:
+    return _DEFAULT
+
+
+def counter(name: str, **labels) -> Counter:
+    return _DEFAULT.counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return _DEFAULT.gauge(name, **labels)
+
+
+def histogram(name: str, **labels) -> Histogram:
+    return _DEFAULT.histogram(name, **labels)
+
+
+def reset() -> None:
+    """Fresh process-wide registry (tests)."""
+    global _DEFAULT
+    _DEFAULT = Registry()

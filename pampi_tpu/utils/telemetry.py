@@ -66,6 +66,21 @@ Host plane — every record is one JSON line appended to the
               exchange device-vs-exposed split behind the comm-hidden
               fraction
   metric      a headline metric line (bench.py's JSON lines, artifacts)
+  metrics     one registry snapshot (utils/metrics.py): counters, gauges,
+              and log-bucket histograms labeled tenant/class/family, with
+              a per-process source id + sequence number — snapshots are
+              CUMULATIVE, so readers take the LAST per source and fold
+              ACROSS sources (tools/telemetry_report.metrics_summary)
+  trace       one request-lifecycle stage (utils/tracing.py): trace id,
+              stage, PARENT stage, offset + duration — the root
+              `request` record carries end-to-end latency and the
+              critical stages (queue_wait/compile/execute/emit) tile it
+              exactly, so the report's per-stage decomposition must sum
+              to end-to-end
+  slo         one tenant's sliding-window SLO accounting (fleet/slo.py):
+              target p95, window requests/violations, error-budget burn
+              rate — burn beyond the alert threshold additionally emits
+              a `warning` record
   fleet       one fleet run's summary (pampi_tpu/fleet/scheduler.py):
               per-bucket mode/compile-vs-run walls, scenarios/s
               throughput, and the divergence census — the block
@@ -90,9 +105,12 @@ import os
 import time
 import warnings
 
-SCHEMA_VERSION = 7  # v7: + serving / admission / latency / swap record
-#                     kinds (the persistent fleet daemon, serving v2)
-#                     (v6, PR 12: + dead / epoch / shrink record kinds,
+SCHEMA_VERSION = 8  # v8: + metrics / slo / trace record kinds (the
+#                     serving-plane observability layer: registry
+#                     snapshots, tenant SLO burn, parented request spans)
+#                     (v7, PR 13: + serving / admission / latency / swap
+#                      record kinds (the persistent fleet daemon);
+#                      v6, PR 12: + dead / epoch / shrink record kinds,
 #                      ckpt ledger_save / ledger_restore events;
 #                      v5, PR 10: + coord record kind, elastic ckpt
 #                      events, warning record kind;

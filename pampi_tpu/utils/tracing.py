@@ -1,0 +1,148 @@
+"""Request-lifecycle tracing: one trace id minted at admission, marked
+at each serving-plane boundary, emitted at completion as PARENTED
+`trace` records (schema v8) — the per-request waterfall behind
+`tools/telemetry_report.py`'s latency decomposition.
+
+Why marks + one flush instead of live span records: a request crosses
+four modules (queue admission → scheduler bucketing → batched execute →
+daemon emit) and its stages only become durations once the NEXT
+boundary stamps its clock — the scheduler can't know queue_wait ended
+until it starts the bucket. So each module just `mark()`s a named
+timestamp on the trace, and `finish()` (the daemon, after the result is
+written — or the failure path) converts the mark sequence into stage
+records in one go. That also makes the off path trivial: `mint()`
+returns None when telemetry is disabled and every helper no-ops on a
+None trace, so flag-off serving does zero extra work and traced
+programs stay byte-identical.
+
+Record protocol (kind="trace", one line per stage per request):
+
+    {trace, sid, stage, parent, t0_ms, ms, ...request fields}
+
+- the ROOT record has stage="request", parent=None, t0_ms=0 and
+  ms = end-to-end (admit → emit_end);
+- the CRITICAL stages tile the root exactly: queue_wait (admit →
+  exec_start) + compile (exec_start → run_start) + execute (run_start
+  → done) + emit (done → emit_end) = end-to-end, so the report's
+  per-stage p50 decomposition must sum to the e2e p50 within the
+  bucket/percentile tolerance (soak-asserted at 5%);
+- bucket / class_pad are DETAIL marks inside queue_wait (when the
+  scheduler grouped the request, when its shape class resolved),
+  parented under queue_wait — they render in the waterfall but do not
+  enter the sum.
+
+The trace table is process-local and BOUNDED (MAX_TRACES): a daemon
+that parks requests forever cannot leak trace state — the oldest
+unfinished trace is dropped (and counted) when the table is full.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from . import telemetry as _tm
+
+# the critical path: these tile admit -> emit_end with no gap/overlap,
+# so their per-stage percentiles decompose the end-to-end latency
+CRITICAL_STAGES = ("queue_wait", "compile", "execute", "emit")
+
+# (stage, start-mark, end-mark) for the critical tiling
+_STAGE_MARKS = (
+    ("queue_wait", "admit", "exec_start"),
+    ("compile", "exec_start", "run_start"),
+    ("execute", "run_start", "done"),
+    ("emit", "done", "emit_end"),
+)
+
+# unfinished-trace cap: a parked/never-served request must not leak
+# table entries over a long soak
+MAX_TRACES = 4096
+
+_TRACES: dict[str, dict] = {}
+_NEXT = 0
+_EVICTED = 0
+
+
+def mint(sid: str, **fields) -> str | None:
+    """Start a trace at admission: stamps the `admit` mark now. Returns
+    None when telemetry is disabled — all helpers no-op on None, so the
+    flag-off serving path does no tracing work at all."""
+    global _NEXT, _EVICTED
+    if not _tm.enabled():
+        return None
+    if len(_TRACES) >= MAX_TRACES:
+        _TRACES.pop(next(iter(_TRACES)))
+        _EVICTED += 1
+    _NEXT += 1
+    trace = f"t{os.getpid():x}-{_NEXT:x}"
+    _TRACES[trace] = {"sid": sid, "fields": dict(fields),
+                      "marks": {"admit": time.time()}}
+    return trace
+
+
+def mark(trace: str | None, name: str, ts: float | None = None) -> None:
+    """Stamp a named boundary clock on the trace (latest stamp wins — a
+    quota-deferred request re-bucketed next poll keeps the bucketing
+    that actually served it)."""
+    ent = _TRACES.get(trace) if trace else None
+    if ent is not None:
+        ent["marks"][name] = time.time() if ts is None else ts
+
+
+def note(trace: str | None, **fields) -> None:
+    """Attach request fields (tenant, class, family, mode) that ride on
+    every emitted stage record."""
+    ent = _TRACES.get(trace) if trace else None
+    if ent is not None:
+        ent["fields"].update(fields)
+
+
+def finish(trace: str | None, status: str = "ok",
+           ts: float | None = None) -> None:
+    """Flush the trace: emit the root + every stage whose marks exist,
+    then drop the table entry. Tolerates partial mark sets (a request
+    failed before execution emits only the stages it reached)."""
+    ent = _TRACES.pop(trace, None) if trace else None
+    if ent is None:
+        return
+    marks = ent["marks"]
+    t_admit = marks.get("admit")
+    if t_admit is None:
+        return
+    end = ts if ts is not None else marks.get(
+        "emit_end", max(marks.values()))
+    fields = ent["fields"]
+    sid = ent["sid"]
+
+    def rel_ms(t: float) -> float:
+        return round((t - t_admit) * 1000.0, 4)
+
+    _tm.emit("trace", trace=trace, sid=sid, stage="request", parent=None,
+             t0_ms=0.0, ms=rel_ms(end), status=status, **fields)
+    for stage, m0, m1 in _STAGE_MARKS:
+        t0, t1 = marks.get(m0), marks.get(m1)
+        if t0 is None or t1 is None:
+            continue
+        _tm.emit("trace", trace=trace, sid=sid, stage=stage,
+                 parent="request", t0_ms=rel_ms(t0),
+                 ms=round((t1 - t0) * 1000.0, 4), status=status, **fields)
+    for detail in ("bucket", "class_pad"):
+        t0 = marks.get(detail)
+        if t0 is not None:
+            _tm.emit("trace", trace=trace, sid=sid, stage=detail,
+                     parent="queue_wait", t0_ms=rel_ms(t0), ms=None,
+                     status=status, **fields)
+
+
+def pending() -> int:
+    """Unfinished traces in the table (tests / leak checks)."""
+    return len(_TRACES)
+
+
+def reset() -> None:
+    """Drop all trace state (tests)."""
+    global _NEXT, _EVICTED
+    _TRACES.clear()
+    _NEXT = 0
+    _EVICTED = 0

@@ -1,0 +1,237 @@
+"""Serving-plane metrics registry (utils/metrics.py) + request tracing
+(utils/tracing.py) — the ISSUE 18 observability layer contracts:
+
+- HISTOGRAM QUANTILE PIN: log-bucket nearest-rank quantiles agree with
+  the exact sorted-list computation (fleet/serve._percentile) within
+  half a bucket (<5% relative) on small samples — the daemon's status
+  percentiles may route through the bounded histogram without changing
+  what a tenant reads;
+- MERGE ALGEBRA: the histogram fold and the snapshot fold are
+  associative and commutative, and merged counts equal the unmerged
+  single-registry run — the cross-rank `--merge` fold is order-free;
+- PROMETHEUS GOLDEN: the text exposition of a deterministic registry is
+  byte-pinned (tests/fixtures/metrics_golden.prom) — scrape-format
+  drift is a test failure, not a dashboard surprise;
+- OFF-PATH IDENTITY: arming the registry (observations recorded) does
+  not change the traced solver program — the shared jaxprcheck pin;
+- TRACE TABLE: mint/mark/finish bound their state (no leaks), no-op
+  with telemetry off, and emit a parented record set whose critical
+  stages tile the end-to-end time exactly.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from pampi_tpu.analysis.jaxprcheck import assert_offpath_identity
+from pampi_tpu.fleet.serve import _percentile
+from pampi_tpu.models.ns2d import NS2DSolver
+from pampi_tpu.utils import metrics as mx
+from pampi_tpu.utils import telemetry as tm
+from pampi_tpu.utils import tracing
+from pampi_tpu.utils.params import Parameter
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+# a deterministic small sample spanning ~3 decades (the quantile pin
+# must hold on SMALL samples — that is what a short daemon run holds)
+SAMPLE = [3.7, 12.5, 12.9, 48.0, 51.2, 55.9, 210.0, 214.5, 220.1,
+          221.7, 230.0, 980.4, 1010.0, 2404.9, 2630.2]
+
+
+@pytest.fixture()
+def tel_off(monkeypatch):
+    monkeypatch.delenv("PAMPI_TELEMETRY", raising=False)
+    tm.reset()
+    tracing.reset()
+    mx.reset()
+
+
+@pytest.fixture()
+def tel_on(tmp_path, monkeypatch):
+    path = tmp_path / "run.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(path))
+    tm.reset()
+    tracing.reset()
+    mx.reset()
+    yield path
+    tm.reset()
+    tracing.reset()
+    mx.reset()
+
+
+# -- histogram quantiles ------------------------------------------------
+
+def test_histogram_quantile_agrees_with_exact_on_small_samples():
+    h = mx.Histogram("lat")
+    for v in SAMPLE:
+        h.observe(v)
+    for q in (0.5, 0.95):
+        exact = _percentile(SAMPLE, q)
+        got = h.quantile(q)
+        assert abs(got - exact) / exact < 0.05, (q, got, exact)
+    # exact min/max ride alongside the buckets
+    assert h.vmin == min(SAMPLE) and h.vmax == max(SAMPLE)
+    assert h.n == len(SAMPLE)
+
+
+def test_histogram_edges_and_floor_bucket():
+    h = mx.Histogram("edges")
+    # bucket k covers (BASE^(k-1), BASE^k]: an exact edge value must
+    # land IN bucket k, not k+1 (the float-fuzz pullback)
+    h.observe(mx.bucket_edge(8))
+    assert h.counts == {8: 1}
+    # non-positive and non-finite observations land in the floor bucket
+    # and resolve to 0.0 — never a crash, never an unbounded index
+    for bad in (0.0, -5.0, float("nan"), float("inf")):
+        h.observe(bad)
+    assert h.quantile(0.0) == 0.0
+    assert h.n == 5
+
+
+def test_histogram_merge_associative_commutative():
+    def hist_of(values):
+        h = mx.Histogram("m")
+        for v in values:
+            h.observe(v)
+        return h
+
+    a = hist_of(SAMPLE[:5])
+    b = hist_of(SAMPLE[5:9])
+    c = hist_of(SAMPLE[9:])
+    ab_c = a.merge(b).merge(c)
+    a_bc = a.merge(b.merge(c))
+    ba_c = b.merge(a).merge(c)
+    whole = hist_of(SAMPLE)
+    for m in (ab_c, a_bc, ba_c):
+        assert m.counts == whole.counts
+        assert m.n == whole.n
+        assert m.vmin == whole.vmin and m.vmax == whole.vmax
+        assert abs(m.total - whole.total) < 1e-9
+    # the merged quantile equals the single-registry quantile exactly
+    # (same buckets -> same nearest-rank resolution)
+    assert ab_c.quantile(0.95) == whole.quantile(0.95)
+
+
+def test_snapshot_fold_and_roundtrip():
+    r1, r2 = mx.Registry(), mx.Registry()
+    for r, served, depth in ((r1, 3, 5), (r2, 4, 2)):
+        r.counter("served", tenant="a").inc(served)
+        r.gauge("depth").set(depth)
+        for v in SAMPLE[:6]:
+            r.histogram("lat", tenant="a").observe(v)
+    s1, s2 = r1.snapshot(), r2.snapshot()
+    fold = mx.merge_snapshots(s1, s2)
+    assert fold == mx.merge_snapshots(s2, s1)  # commutative
+    counters = {(c["name"],): c["value"] for c in fold["counters"]}
+    assert counters[("served",)] == 7          # counters sum
+    assert fold["gauges"][0]["value"] == 5     # gauges keep the max
+    assert fold["histograms"][0]["n"] == 12    # histograms bucket-sum
+    # snapshots are plain JSON and quantile-readable without a Registry
+    again = json.loads(json.dumps(fold))
+    assert mx.snapshot_quantile(again["histograms"][0], 0.5) \
+        == mx.Histogram.from_dict(fold["histograms"][0]).quantile(0.5)
+    # self-fold doubles (cumulative snapshots must never be summed
+    # within a source — the reader contract this algebra implies)
+    twice = mx.merge_snapshots(s1, s1)
+    assert twice["counters"][0]["value"] == 6
+
+
+def test_prometheus_render_golden():
+    r = mx.Registry()
+    r.counter("fleet_served_total", tenant="alice").inc(3)
+    r.counter("fleet_served_total", tenant="bob").inc(1)
+    r.gauge("fleet_queue_depth").set(4)
+    h = r.histogram("fleet_request_latency_ms", tenant="alice")
+    for v in (10.0, 100.0, 1000.0):
+        h.observe(v)
+    got = r.render_prometheus()
+    golden = (FIXTURES / "metrics_golden.prom").read_text()
+    assert got == golden
+    # atomic write path produces the identical bytes
+    assert got.endswith("\n")
+    assert "# TYPE fleet_request_latency_ms histogram" in got
+    assert 'le="+Inf"' in got
+
+
+def test_registry_emits_versioned_snapshots(tel_on):
+    r = mx.Registry()
+    r.counter("c").inc()
+    r.emit_snapshot(event="poll")
+    r.counter("c").inc()
+    r.emit_snapshot(event="stop")
+    tm.finalize()
+    recs = [json.loads(ln) for ln in open(tel_on) if ln.strip()]
+    snaps = [r for r in recs if r["kind"] == "metrics"]
+    assert [s["seq"] for s in snaps] == [1, 2]
+    assert snaps[0]["source"] == snaps[1]["source"]
+    assert snaps[-1]["counters"][0]["value"] == 2  # cumulative
+    assert all(r["v"] == tm.SCHEMA_VERSION for r in snaps)
+
+
+# -- off-path identity with the registry armed --------------------------
+
+def test_offpath_jaxpr_identity_with_registry_armed(tel_off):
+    """Observing into the registry is HOST work: a solver built while
+    the registry holds live instruments traces the identical program
+    (the ISSUE 18 all-host-side acceptance — shared jaxprcheck pin)."""
+    mx.counter("fleet_served_total", tenant="t").inc(7)
+    for v in SAMPLE:
+        mx.histogram("fleet_request_latency_ms").observe(v)
+    param = Parameter(name="dcavity", imax=16, jmax=16, re=10.0,
+                      te=0.02, tau=0.5, itermax=8, eps=1e-4, omg=1.7,
+                      gamma=0.9)
+    assert_offpath_identity(lambda: NS2DSolver(param))
+
+
+# -- request tracing ----------------------------------------------------
+
+def test_tracing_noop_when_telemetry_off(tel_off):
+    assert tracing.mint("sid") is None
+    tracing.mark(None, "exec_start")
+    tracing.note(None, bucket="b")
+    tracing.finish(None)
+    assert tracing.pending() == 0
+
+
+def test_trace_stages_tile_end_to_end(tel_on):
+    t = tracing.mint("alice__s0", tenant="alice")
+    assert t is not None
+    base = tracing._TRACES[t]["marks"]["admit"]
+    for name, dt in (("bucket", 0.001), ("exec_start", 0.002),
+                     ("run_start", 0.010), ("done", 0.050),
+                     ("emit_end", 0.051)):
+        tracing.mark(t, name, ts=base + dt)
+    tracing.note(t, bucket="ns2d_16x16", family="ns2d")
+    tracing.finish(t)
+    assert tracing.pending() == 0
+    tm.finalize()
+    recs = [json.loads(ln) for ln in open(tel_on) if ln.strip()]
+    spans = [r for r in recs if r["kind"] == "trace"]
+    roots = [r for r in spans if r["stage"] == "request"]
+    assert len(roots) == 1 and roots[0]["parent"] is None
+    # every non-root span is parented — no orphans
+    by_stage = {r["stage"]: r for r in spans}
+    for r in spans:
+        if r["stage"] != "request":
+            assert r["parent"] in by_stage, r["stage"]
+    # the critical stages tile the root exactly
+    total = sum(by_stage[s]["ms"] for s in tracing.CRITICAL_STAGES)
+    # each emitted ms is rounded to 4 decimals, so the tiling is exact
+    # to the rounding grain (4 stages x 0.5e-4 ms)
+    assert abs(total - roots[0]["ms"]) < 1e-3
+    # detail marks are parented under queue_wait with no duration
+    assert by_stage["bucket"]["parent"] == "queue_wait"
+    assert by_stage["bucket"]["ms"] is None
+    assert roots[0]["tenant"] == "alice"
+    assert roots[0]["bucket"] == "ns2d_16x16"
+
+
+def test_trace_table_bounded(tel_on, monkeypatch):
+    monkeypatch.setattr(tracing, "MAX_TRACES", 8)
+    ids = [tracing.mint(f"s{i}") for i in range(12)]
+    assert tracing.pending() == 8  # oldest evicted, never unbounded
+    tracing.finish(ids[-1])
+    assert tracing.pending() == 7
+    tracing.reset()

@@ -23,7 +23,10 @@ Contracts pinned here:
 - the daemon: file-queue intake, admission + per-tenant accounting,
   malformed .par PARKED with a structured warning (the hardened
   load_queue path), live status endpoint, serving telemetry (schema
-  v7) through report/merge/lint.
+  v8) through report/merge/lint — plus the ISSUE 18 observability
+  plane: shape-class rung signatures, tenant SLO burn accounting
+  (window edges, edge-triggered alerts), and the daemon's request
+  traces / registry histograms / slo block end to end.
 """
 
 import json
@@ -650,3 +653,178 @@ def test_serving_summary_merge_and_lint(tmp_path, monkeypatch):
     # a gutted serving block must be flagged
     assert lint_serving_summary({"served": 1}, "X")
     tm.reset()
+
+
+# -- serving observability (ISSUE 18) -----------------------------------
+
+def test_class_sig_hash_disambiguates_rungs():
+    """Two requests with EQUAL knobs but different class rungs must get
+    different class signatures: the scheduler's _TEMPLATES cache is
+    sig-keyed, and a collision hands a 16^2 class template to a 32^2
+    bucket — every lane then trips the exceeds-class guard (the
+    pre-existing bug the soak surfaced). Same rung, different request
+    extents: SAME signature (that sharing is the whole point of shape
+    classes)."""
+    p16 = Parameter(**{**_B, "imax": 12, "jmax": 12})
+    p16b = Parameter(**{**_B, "imax": 14, "jmax": 10})
+    p32 = Parameter(**{**_B, "imax": 20, "jmax": 20})
+    assert sc.class_sig_hash(p16) == sc.class_sig_hash(p16b)
+    assert sc.class_sig_hash(p16) != sc.class_sig_hash(p32)
+
+
+def test_parse_slo_spec():
+    from pampi_tpu.fleet.slo import parse_slo_spec
+
+    assert parse_slo_spec("") == {}
+    assert parse_slo_spec(None) == {}
+    assert parse_slo_spec("default=250, alice=100") == {
+        "default": 250.0, "alice": 100.0}
+    for bad in ("alice", "alice=fast", "=250", "alice=-5"):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+
+def test_slo_burn_rate_window_edges():
+    """Pure-python burn math on a fake clock: the sliding window is
+    inclusive at its edge (an exactly-window_s-old outcome still
+    counts) and prunes just past it; the alert is EDGE-triggered (one
+    warning per crossing, re-armed below threshold)."""
+    from pampi_tpu.fleet.slo import BUDGET, SloTracker
+
+    t = SloTracker({"default": 100.0}, window_s=10.0, burn_alert=2.0)
+    # 10 requests at t=0..9, 2 violations
+    for i in range(10):
+        violated = t.observe("a", 250.0 if i < 2 else 50.0, float(i))
+        assert violated == (i < 2)
+    assert t.burn_rate("a", 9.0) == round((2 / 10) / BUDGET, 4)
+    # at now=10.0 the t=0 entry sits exactly AT the edge: still counted
+    assert t.burn_rate("a", 10.0) == round((2 / 10) / BUDGET, 4)
+    # one tick past: the first violation leaves the window
+    assert t.burn_rate("a", 10.0 + 1e-6) == round((1 / 9) / BUDGET, 4)
+    # far past: empty window -> None (no data), lifetime total kept
+    assert t.burn_rate("a", 100.0) is None
+    assert t.violations_total == {"a": 2}
+    # untracked tenant (no default match removed): target_for falls
+    # back to default, an unknown spec has no accounting
+    t2 = SloTracker({"alice": 100.0})
+    assert t2.observe("bob", 9999.0, 0.0) is False
+    assert t2.burn_rate("bob", 0.0) is None
+
+
+def test_slo_alert_edge_triggered(tmp_path, monkeypatch):
+    from pampi_tpu.fleet.slo import SloTracker
+
+    jsonl = tmp_path / "slo.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(jsonl))
+    tm.reset()
+    t = SloTracker({"default": 10.0}, window_s=5.0, burn_alert=2.0)
+    for i in range(4):
+        t.observe("a", 100.0, 0.1 * i)  # every request violates
+    t.poll(0.5)   # burn 20.0 -> ONE warning
+    t.poll(0.6)   # still burning -> no second warning
+    t.poll(100.0)  # window empty -> burn 0, alert re-armed
+    for i in range(4):
+        t.observe("a", 100.0, 100.0 + 0.1 * i)
+    t.poll(100.5)  # second crossing -> second warning
+    tm.finalize()
+    records = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    warns = [r for r in records if r["kind"] == "warning"
+             and r.get("component") == "slo"]
+    assert len(warns) == 2
+    slo_recs = [r for r in records if r["kind"] == "slo"]
+    assert len(slo_recs) == 4  # one per tracked tenant per poll
+    assert slo_recs[0]["burn_rate"] == 20.0
+    assert {r["v"] for r in records} == {tm.SCHEMA_VERSION}
+    tm.reset()
+
+
+def test_daemon_observability_end_to_end(tmp_path, monkeypatch):
+    """The whole ISSUE 18 plane through one daemon session: request
+    traces (minted at admission, every span parented, critical stages
+    tile each request's end-to-end latency, no table leaks), histogram
+    status percentiles agreeing with the exact computation, slo records
+    + status block, registry snapshots, and the report/merge/lint round
+    trip with the new blocks."""
+    from pampi_tpu.fleet import FleetDaemon, ServeConfig
+    from pampi_tpu.utils import tracing
+    from tools import telemetry_report as tr
+    from tools._artifact import write_merged
+    from tools.check_artifact import lint_bench
+
+    fleet.reset_templates()
+    jsonl = tmp_path / "obs.jsonl"
+    monkeypatch.setenv("PAMPI_TELEMETRY", str(jsonl))
+    tm.reset()
+    tracing.reset()
+    qdir = tmp_path / "queue"
+    qdir.mkdir()
+    par = ("name dcavity\nimax {imax}\njmax 12\nre 10.0\nte 0.02\n"
+           "tau 0.5\nitermax 8\neps 0.0001\nomg 1.7\ngamma 0.9\n"
+           "tpu_mesh 1\ntpu_fuse_phases off\n")
+    (qdir / "alice__t0.par").write_text(par.format(imax=12))
+    (qdir / "alice__t1.par").write_text(par.format(imax=14))
+    (qdir / "bob__t2.par").write_text(par.format(imax=12))
+    daemon = FleetDaemon(ServeConfig(
+        queue_dir=str(qdir), poll_s=0.01, max_lanes=2, max_polls=2,
+        classes="on", slo="default=60000,alice=0.001"))
+    assert daemon.run() == 0
+    assert tracing.pending() == 0  # every minted trace flushed
+    tm.finalize()
+
+    st = json.loads((qdir / "status.json").read_text())
+    assert st["served"] == 3
+    # the SLO block: alice's absurd 0.001 ms target makes every alice
+    # request a violation; bob rides the generous default
+    assert st["slo"]["alice"]["violations"] == 2
+    assert st["slo"]["alice"]["burn_rate"] == 20.0
+    assert st["slo"]["bob"]["violations"] == 0
+    # the Prometheus scrape file sits next to status.json
+    prom = (qdir / "metrics.prom").read_text()
+    assert "fleet_request_latency_ms_bucket" in prom
+    assert 'fleet_served_total{tenant="alice"} 2' in prom
+
+    records = tr.load(str(jsonl))
+    # trace continuity: every span parented under a root of its trace,
+    # critical stages tile each root's e2e exactly (pre-rounding)
+    spans = [r for r in records if r["kind"] == "trace"]
+    roots = {r["trace"]: r for r in spans if r["stage"] == "request"}
+    assert len(roots) == 3
+    for r in spans:
+        assert r["trace"] in roots
+        if r["stage"] != "request":
+            assert r["parent"] is not None
+    for trace, root in roots.items():
+        stages = {r["stage"]: r["ms"] for r in spans
+                  if r["trace"] == trace and r["parent"] == "request"}
+        assert set(stages) == set(tracing.CRITICAL_STAGES)
+        assert abs(sum(stages.values()) - root["ms"]) < 1e-2
+    # histogram percentiles vs the exact per-request latencies
+    lats = [r["ms"] for r in records if r["kind"] == "latency"]
+    assert len(lats) == 3
+    for q in (0.5, 0.95):
+        exact = fleet.serve._percentile(lats, q)
+        assert abs(st["latency_ms"]["p%d" % (q * 100)] - exact) \
+            / exact < 0.05
+    assert st["latency_ms"]["max"] == round(max(lats), 3)
+
+    # report/merge/lint round trip with the new blocks
+    dec = tr.trace_decomposition(records)
+    assert dec["requests"] == 3
+    assert dec["sum_residual"] <= 0.05
+    mxs = tr.metrics_summary(records)
+    assert mxs["sources"] == 1
+    slo = tr.slo_summary(records)
+    assert set(slo) == {"alice", "bob"}
+    text = tr.render(records)
+    assert "request traces" in text and "tenant SLOs" in text
+    merged = write_merged(str(tmp_path / "OBS.json"), {
+        "n": 0, "cmd": "t", "rc": 0, "tail": "",
+        "telemetry_summary": tr.summary(records),
+        "serving_summary": tr.serving_summary(records),
+        "metrics_summary": mxs, "slo": slo,
+        "trace_decomposition": dec})
+    assert lint_bench(merged, "OBS") == []
+    names = {m["name"] for m in merged["metrics"]}
+    assert {"fleet_class_p95_ms", "slo_violations"} <= names
+    tm.reset()
+    tracing.reset()

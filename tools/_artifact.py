@@ -102,6 +102,42 @@ def collect_metrics(rec: dict) -> list[dict]:
                     "unit": unit,
                     "backend": "tpu" if run_backend == "tpu" else "cpu",
                 })
+    mx = rec.get("metrics_summary")
+    if isinstance(mx, dict) and "fleet_class_p95_ms" not in seen:
+        # the SLO-plane tail headline (ISSUE 18): the WORST per-class
+        # p95 from the folded registry histograms — one number per
+        # artifact (the metrics list dedups by name), so the gate
+        # watches the slowest class, not an average across classes
+        class_p95 = [
+            row.get("p95")
+            for name, row in (mx.get("histograms") or {}).items()
+            if str(name).startswith("fleet_class_latency_ms{")
+            and isinstance(row, dict)
+            and isinstance(row.get("p95"), (int, float))
+        ]
+        if class_p95:
+            out.append({
+                "name": "fleet_class_p95_ms",
+                "value": round(max(class_p95), 3),
+                "unit": "ms",
+                "backend": "tpu" if run_backend == "tpu" else "cpu",
+            })
+    slo = rec.get("slo")
+    if isinstance(slo, dict) and "slo_violations" not in seen:
+        # lifetime violation count across tenants (fleet/slo.py);
+        # lower-is-better by name (bench_trend NAME_DIRECTIONS)
+        totals = [
+            row.get("violations_total", row.get("violations"))
+            for row in slo.values() if isinstance(row, dict)
+        ]
+        nums = [v for v in totals if isinstance(v, (int, float))]
+        if nums:
+            out.append({
+                "name": "slo_violations",
+                "value": sum(nums),
+                "unit": "requests",
+                "backend": "tpu" if run_backend == "tpu" else "cpu",
+            })
     return out
 
 

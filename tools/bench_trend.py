@@ -157,7 +157,16 @@ NAME_DIRECTIONS = {"comm_hidden_fraction": True,
                    # K-fusion amortizes is first-order; the unit already
                    # gates ms downward — named so a unit-string drift
                    # can never silently un-gate the serving headline
-                   "ns2d_small_ms_per_step": False}
+                   "ns2d_small_ms_per_step": False,
+                   # the SLO plane (ISSUE 18, serving observability):
+                   # the WORST per-class p95 request latency — the gate
+                   # watches the tail class, not a fleet average, so one
+                   # class regressing behind a healthy mean still fails
+                   # lint like a perf regression — and the daemon's
+                   # lifetime SLO violation count (fleet/slo.py); both
+                   # lower-is-better
+                   "fleet_class_p95_ms": False,
+                   "slo_violations": False}
 
 
 def higher_is_better(unit, name: str | None = None) -> bool | None:

@@ -35,6 +35,13 @@ Contracts:
   scenarios_per_s, divergence_census}, every bucket row carrying
   {bucket, mode, lanes, compile_wall_s, run_wall_s} and the census
   {diverged, scenarios} — the ROADMAP item 3 serving record.
+- serving observability blocks (optional until a schema-v8 daemon run
+  merges them): metrics_summary (folded registry snapshots — counters/
+  gauges/histograms, every histogram row carrying n/p50/p95/max), slo
+  (per-tenant target + windowed counts + burn rate), trace_decomposition
+  (stage table + median-request waterfall whose stage sum must close on
+  its end-to-end latency within 5%), and soak_trajectory (tools/soak.py:
+  monotone t_s + equal-length queue-depth/latency series).
 - telemetry_summary (optional until a run emits one): the
   tools/telemetry_report.summary shape — {schema_version, dispatch,
   chunks, records}; when the PR 4 resilience blocks are present,
@@ -194,12 +201,121 @@ def lint_serving_summary(d: dict, where: str) -> list[str]:
     return errs
 
 
+METRICS_SUMMARY_KEYS = ("sources", "counters", "gauges", "histograms")
+METRICS_HIST_KEYS = ("n", "p50", "p95", "max")
+SLO_ROW_KEYS = ("target_ms", "window_s", "requests", "violations",
+                "burn_rate")
+TRACE_DECOMP_KEYS = ("requests", "e2e_ms", "stages", "p50_waterfall",
+                     "p50_sum_ms", "sum_residual")
+# the decomposition closure tolerance: the median request's stage sum
+# must land on its end-to-end latency (exact by construction up to
+# per-stage rounding and a missing mark — 5% catches a broken tiling)
+TRACE_SUM_TOLERANCE = 0.05
+SOAK_SERIES = ("t_s", "queue_depth", "p50_ms", "served")
+
+
+def lint_metrics_summary(d: dict, where: str) -> list[str]:
+    """The folded registry-snapshot block (tools/telemetry_report.
+    metrics_summary over utils/metrics `metrics` records): the three
+    instrument maps are required, and every histogram row must carry its
+    count + quantile summary — a histogram that cannot say its n or p95
+    defeats the reason the registry exists."""
+    errs = _missing(d, METRICS_SUMMARY_KEYS, where)
+    for key in ("counters", "gauges", "histograms"):
+        if key in d and not isinstance(d[key], dict):
+            errs.append(f"{where}.{key}: not a dict")
+    hists = d.get("histograms")
+    if isinstance(hists, dict):
+        for name, row in hists.items():
+            if not isinstance(row, dict):
+                errs.append(f"{where}.histograms[{name}]: not a dict")
+                continue
+            errs += _missing(row, METRICS_HIST_KEYS,
+                             f"{where}.histograms[{name}]")
+    return errs
+
+
+def lint_slo(d: dict, where: str) -> list[str]:
+    """The per-tenant SLO block (fleet/slo via telemetry_report.
+    slo_summary): every tenant row needs its target, windowed counts and
+    burn rate — an SLO block that cannot say how fast a tenant burns its
+    budget is not an SLO block. Burn must be non-negative."""
+    errs = []
+    for tenant, row in d.items():
+        if not isinstance(row, dict):
+            errs.append(f"{where}.{tenant}: not a dict")
+            continue
+        errs += _missing(row, SLO_ROW_KEYS, f"{where}.{tenant}")
+        burn = row.get("burn_rate")
+        if burn is not None and not (isinstance(burn, (int, float))
+                                     and burn >= 0):
+            errs.append(f"{where}.{tenant}.burn_rate: {burn!r} "
+                        "not a non-negative number")
+    return errs
+
+
+def lint_trace_decomposition(d: dict, where: str) -> list[str]:
+    """The request-trace decomposition block: stage table + the
+    median-request waterfall, whose stage sum must CLOSE on its
+    end-to-end latency within TRACE_SUM_TOLERANCE — the contract that
+    the critical stages tile a request with no gap or overlap."""
+    errs = _missing(d, TRACE_DECOMP_KEYS, where)
+    res = d.get("sum_residual")
+    if res is not None:
+        if not isinstance(res, (int, float)):
+            errs.append(f"{where}.sum_residual: {res!r} not a number")
+        elif res > TRACE_SUM_TOLERANCE:
+            errs.append(
+                f"{where}.sum_residual: {res} — the median request's "
+                f"stage sum ({d.get('p50_sum_ms')} ms) misses its "
+                "end-to-end latency beyond "
+                f"{TRACE_SUM_TOLERANCE:.0%} (broken stage tiling)")
+    stages = d.get("stages")
+    if isinstance(stages, dict):
+        for stage, row in stages.items():
+            if not isinstance(row, dict) or "p50" not in row \
+                    or "p95" not in row:
+                errs.append(f"{where}.stages[{stage}]: "
+                            "missing p50/p95")
+    elif "stages" in d:
+        errs.append(f"{where}.stages: not a dict")
+    return errs
+
+
+def lint_soak(d: dict, where: str) -> list[str]:
+    """The soak trajectory block (tools/soak.py): the time axis must be
+    MONOTONE non-decreasing and every required series present with the
+    same length — a capacity-planning trajectory with misaligned or
+    time-warped samples plots lies."""
+    errs = _missing(d, SOAK_SERIES, where)
+    ts = d.get("t_s")
+    if isinstance(ts, list):
+        if any(not isinstance(t, (int, float)) for t in ts):
+            errs.append(f"{where}.t_s: non-numeric timestamp")
+        elif any(b < a for a, b in zip(ts, ts[1:])):
+            errs.append(f"{where}.t_s: timestamps not monotone")
+        for key in SOAK_SERIES[1:]:
+            series = d.get(key)
+            if isinstance(series, list) and len(series) != len(ts):
+                errs.append(f"{where}.{key}: length {len(series)} != "
+                            f"t_s length {len(ts)}")
+            elif key in d and not isinstance(series, list):
+                errs.append(f"{where}.{key}: not a list")
+    elif "t_s" in d:
+        errs.append(f"{where}.t_s: not a list")
+    return errs
+
+
 def _lint_optional_blocks(d: dict, where: str) -> list[str]:
     errs = []
     for key, fn in (("xprof_summary", lint_xprof_summary),
                     ("comm_hidden_fraction", lint_comm_hidden),
                     ("fleet_summary", lint_fleet_summary),
-                    ("serving_summary", lint_serving_summary)):
+                    ("serving_summary", lint_serving_summary),
+                    ("metrics_summary", lint_metrics_summary),
+                    ("slo", lint_slo),
+                    ("trace_decomposition", lint_trace_decomposition),
+                    ("soak_trajectory", lint_soak)):
         block = d.get(key)
         if block is None:
             continue

@@ -19,11 +19,19 @@ Options:
   --classes MODE    shape-class batching on|off|auto (default on)
   --poll S          queue-scan cadence seconds (default 0.5)
   --max-polls N     exit after N polls (0 = until STOP; smokes/CI)
+  --slo SPEC        tenant SLO p95 targets, ms ("default=250,alice=100";
+                    empty = SLO plane off)
+  --slo-window S    sliding error-budget window seconds (default 60)
+  --slo-burn-alert X  burn-rate warning threshold (default 2.0)
 
-Arm PAMPI_TELEMETRY for the flight record (serving/admission/latency
-records, schema v7) — `tools/telemetry_report.py --merge` folds the
-`serving_summary` block into BENCH artifacts and `tools/bench_trend.py`
-gates fleet_p50_latency_ms / fleet_queue_depth_max lower-is-better.
+Arm PAMPI_TELEMETRY for the flight record (serving/admission/latency/
+trace/metrics/slo records, schema v8 — utils/telemetry.py's docstring
+is the kind table) — `tools/telemetry_report.py --merge` folds the
+`serving_summary`/`metrics_summary`/`slo`/`trace_decomposition` blocks
+into BENCH artifacts and `tools/bench_trend.py` gates
+fleet_p50_latency_ms / fleet_queue_depth_max / fleet_class_p95_ms /
+slo_violations lower-is-better. The daemon also writes the registry as
+Prometheus text at `metrics.prom` next to the status endpoint.
 """
 
 from __future__ import annotations
@@ -50,6 +58,9 @@ def main(argv: list[str]) -> int:
                     choices=("on", "off", "auto"))
     ap.add_argument("--poll", type=float, default=0.5)
     ap.add_argument("--max-polls", type=int, default=0)
+    ap.add_argument("--slo", default="")
+    ap.add_argument("--slo-window", type=float, default=60.0)
+    ap.add_argument("--slo-burn-alert", type=float, default=2.0)
     args = ap.parse_args(argv[1:])
 
     from pampi_tpu.fleet import FleetDaemon, ServeConfig
@@ -64,7 +75,9 @@ def main(argv: list[str]) -> int:
         results_dir=args.results, poll_s=args.poll,
         max_lanes=args.lanes, max_queue=args.max_queue,
         tenant_quota=args.quota, classes=args.classes,
-        max_polls=args.max_polls)
+        max_polls=args.max_polls, slo=args.slo,
+        slo_window_s=args.slo_window,
+        slo_burn_alert=args.slo_burn_alert)
     daemon = FleetDaemon(cfg, base=base)
     print(f"serving {args.queue_dir} (status: {daemon.status_path}; "
           f"drop {args.queue_dir}/STOP to shut down)")

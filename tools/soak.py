@@ -1,0 +1,282 @@
+"""Serving soak: a synthetic mixed-tenant request stream through the
+persistent fleet daemon, committing the queue-depth/latency TRAJECTORY
+artifact (make soak-smoke; the ROADMAP item 3 capacity-planning
+measurement — trajectories, not endpoint scalars).
+
+    python tools/soak.py [outdir] [--requests N] [--waves N]
+                         [--artifact PATH] [--round N]
+
+The generator emits WAVES of requests between daemon polls — mixed
+grids (four 2-D shapes across two shape-class rungs + a 3-D rung),
+mixed families (ns2d/ns3d), three tenants — with the failure modes a
+real queue carries: every DIVERGE_EVERY-th request blows up at step 1
+(u_init nan; the in-band sentinel retires the lane) and every
+MALFORMED_EVERY-th file does not parse (parked with a warning record,
+the daemon survives). Tenant SLOs are armed, so the run exercises the
+whole schema-v8 observability plane: request traces, registry
+snapshots, slo records, burn warnings.
+
+Per poll, the soak samples the status endpoint into the trajectory
+block (`soak_trajectory`: t_s + queue_depth/p50_ms/p95_ms/served/
+deferred series — tools/check_artifact.lint_soak pins monotone
+timestamps and equal-length series), then runs the full observability
+round trip and ASSERTS:
+
+- rc 0, every well-formed request served, malformed parked;
+- the per-stage trace decomposition CLOSES: the median request's stage
+  sum lands on its end-to-end latency within 5%
+  (tools/telemetry_report.trace_decomposition — percentiles are not
+  additive, so the closure contract is checked on the median request's
+  own waterfall, the exact decomposition of the p50 latency);
+- the merged artifact lints clean (check_artifact.lint_bench) and
+  carries the trend-gated fleet_class_p95_ms / slo_violations metrics;
+- the Prometheus text file exists next to status.json with the latency
+  histogram series.
+
+`--artifact PATH` additionally merges the blocks into a committed
+BENCH artifact (with `--round N` as its `n`), which enters `make
+lint`'s artifact + trend passes via the default BENCH_r*.json glob.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU-stable soak environment: must precede any jax import (the
+# tools/lint.py convention); a TPU image just keeps its own backend
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+PAR = """name dcavity
+imax {imax}
+jmax {jmax}
+re 10.0
+te {te}
+tau 0.5
+itermax 8
+eps 0.0001
+omg 1.7
+gamma 0.9
+u_init {u}
+tpu_mesh 1
+"""
+
+PAR3 = """name dcavity3d
+imax {imax}
+jmax {jmax}
+kmax {kmax}
+re 10.0
+te 0.015
+tau 0.5
+itermax 6
+eps 0.0001
+omg 1.7
+gamma 0.9
+u_init {u}
+tpu_mesh 1
+"""
+
+# the mixed-grid catalog: (tenant, 2-D grid | 3-D grid) cycled
+# round-robin — two 2-D rungs (16^2, 32^2) + the 3-D 16^3 rung
+CATALOG = (
+    ("alice", (12, 12)),
+    ("bob", (14, 10)),
+    ("alice", (20, 20)),
+    ("dana", (8, 8, 8)),
+    ("bob", (12, 12)),
+    ("alice", (10, 12)),
+)
+DIVERGE_EVERY = 5    # every 5th request blows up at step 1
+MALFORMED_EVERY = 9  # every 9th file does not parse (parked)
+
+
+def _request_text(i: int) -> tuple[str, str]:
+    """(filename, .par text) of the i-th synthetic request."""
+    tenant, grid = CATALOG[i % len(CATALOG)]
+    if (i + 1) % MALFORMED_EVERY == 0:
+        return (f"mallory__bad{i}.par", "name dcavity\nimax notanumber\n")
+    u = float("nan") if (i + 1) % DIVERGE_EVERY == 0 else 0.01 * (i % 3)
+    if len(grid) == 3:
+        text = PAR3.format(imax=grid[0], jmax=grid[1], kmax=grid[2], u=u)
+    else:
+        # staggered end times exercise the per-lane te carry
+        text = PAR.format(imax=grid[0], jmax=grid[1],
+                          te=0.02 + 0.01 * (i % 2), u=u)
+    return (f"{tenant}__s{i:03d}.par", text)
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("outdir", nargs="?",
+                    default=os.path.join(REPO, "results", "soak"))
+    ap.add_argument("--requests", type=int, default=12,
+                    help="total synthetic requests (default 12)")
+    ap.add_argument("--waves", type=int, default=4,
+                    help="request waves across polls (default 4)")
+    ap.add_argument("--artifact", default="",
+                    help="also merge the blocks into this committed "
+                         "BENCH artifact (default: outdir-local only)")
+    ap.add_argument("--round", type=int, default=0,
+                    help="artifact round number `n` (with --artifact)")
+    args = ap.parse_args(argv[1:])
+
+    outdir = args.outdir
+    shutil.rmtree(outdir, ignore_errors=True)
+    qdir = os.path.join(outdir, "queue")
+    os.makedirs(qdir, exist_ok=True)
+    jsonl = os.path.join(outdir, "run.jsonl")
+    os.environ["PAMPI_TELEMETRY"] = jsonl
+
+    from pampi_tpu.fleet import FleetDaemon, ServeConfig
+    from pampi_tpu.utils import telemetry as tm
+
+    tm.reset()
+    tm.start_run(tool="soak", requests=args.requests)
+
+    # SLO targets: alice's tight target is violated by cold-compile
+    # requests (the burn-alert plane fires on a real signal), the
+    # default is generous enough that warm requests pass
+    daemon = FleetDaemon(ServeConfig(
+        queue_dir=qdir, poll_s=0.01, max_lanes=2, max_queue=32,
+        tenant_quota=16, classes="on",
+        slo="default=60000,alice=1500", slo_window_s=120.0,
+        slo_burn_alert=2.0))
+
+    # the wave plan: spread the request stream across polls so the
+    # queue-depth trajectory actually moves (all-at-once would plot a
+    # single spike)
+    waves = max(1, args.waves)
+    per_wave = [args.requests // waves
+                + (1 if w < args.requests % waves else 0)
+                for w in range(waves)]
+    n_good = n_malformed = 0
+    traj = {"t_s": [], "queue_depth": [], "p50_ms": [], "p95_ms": [],
+            "served": [], "deferred": []}
+    t0 = time.time()
+    i = 0
+    for wave in per_wave:
+        for _ in range(wave):
+            name, text = _request_text(i)
+            i += 1
+            if name.startswith("mallory__"):
+                n_malformed += 1
+            else:
+                n_good += 1
+            with open(os.path.join(qdir, name), "w") as fh:
+                fh.write(text)
+        st = daemon.poll_once()
+        traj["t_s"].append(round(time.time() - t0, 4))
+        traj["queue_depth"].append(st["queue_depth"])
+        traj["p50_ms"].append(st["latency_ms"]["p50"])
+        traj["p95_ms"].append(st["latency_ms"]["p95"])
+        traj["served"].append(st["served"])
+        traj["deferred"].append(st["deferred"])
+    # drain polls: anything deferred at a wave boundary retries here
+    while daemon.served + daemon.failed < n_good \
+            and len(traj["t_s"]) < waves + 8:
+        st = daemon.poll_once()
+        traj["t_s"].append(round(time.time() - t0, 4))
+        traj["queue_depth"].append(st["queue_depth"])
+        traj["p50_ms"].append(st["latency_ms"]["p50"])
+        traj["p95_ms"].append(st["latency_ms"]["p95"])
+        traj["served"].append(st["served"])
+        traj["deferred"].append(st["deferred"])
+    st = daemon.stop()
+    tm.finalize()
+
+    failures: list[str] = []
+    if st["served"] != n_good:
+        failures.append(f"served {st['served']} of {n_good} well-formed "
+                        "requests")
+    if st["parked"] != n_malformed:
+        failures.append(f"parked {st['parked']} != {n_malformed} "
+                        "malformed requests")
+    if st["diverged"] < 1:
+        failures.append("no diverged lane (the nan injection vanished)")
+    if not st.get("slo"):
+        failures.append("no slo block in the status endpoint")
+
+    # -- the scrape surface --------------------------------------------
+    prom_path = daemon.metrics_path
+    prom = open(prom_path).read() if os.path.exists(prom_path) else ""
+    if "fleet_request_latency_ms_bucket" not in prom:
+        failures.append(f"{prom_path}: no latency histogram series")
+
+    # -- telemetry round trip: report -> decomposition -> merge -> lint
+    from tools import telemetry_report as tr
+
+    records = tr.load(jsonl)
+    sys.stdout.write(tr.render(records))
+    dec = tr.trace_decomposition(records)
+    if dec is None:
+        failures.append("no trace records -> no latency decomposition")
+    else:
+        res = dec.get("sum_residual")
+        if not isinstance(res, (int, float)) or res > 0.05:
+            failures.append(
+                f"decomposition does not close: median request's stage "
+                f"sum {dec.get('p50_sum_ms')} ms vs e2e p50 "
+                f"{dec['e2e_ms']['p50']} ms (residual {res})")
+    mx = tr.metrics_summary(records)
+    if not mx:
+        failures.append("no metrics_summary from the registry snapshots")
+    slo = tr.slo_summary(records)
+    if not slo:
+        failures.append("no slo records in the flight record")
+
+    from tools._artifact import write_merged
+    from tools.check_artifact import lint_bench
+
+    block = {"n": args.round, "cmd": "soak", "rc": 0,
+             "tail": f"soak: {st['served']} served, "
+                     f"{st['parked']} parked, "
+                     f"p50 {st['latency_ms']['p50']} ms",
+             "telemetry_summary": tr.summary(records),
+             "fleet_summary": tr.fleet_summary(records),
+             "serving_summary": tr.serving_summary(records),
+             "metrics_summary": mx,
+             "slo": slo,
+             "trace_decomposition": dec,
+             "soak_trajectory": traj}
+    merged = write_merged(os.path.join(outdir, "SOAK.json"), block)
+    failures += lint_bench(merged, "SOAK")
+    names = {m.get("name") for m in merged.get("metrics", [])}
+    for metric in ("fleet_p50_latency_ms", "fleet_queue_depth_max",
+                   "fleet_class_p95_ms", "slo_violations"):
+        if metric not in names:
+            failures.append(
+                f"merged artifact carries no normalized {metric}")
+    if args.artifact:
+        # the COMMITTED artifact drops the fleet/serving summary blocks:
+        # their throughput/latency headlines are warm-path series seeded
+        # by tools/perf_fleet.py and tools/serve_smoke.py — the soak's
+        # cold-compile-dominated versions of the same metric names would
+        # gate apples against oranges in bench_trend. The soak commits
+        # the planes that are ITS headline: the trajectory block and the
+        # registry/slo-derived tail metrics (fleet_class_p95_ms,
+        # slo_violations — the ISSUE 18 gate series).
+        commit = {k: v for k, v in block.items()
+                  if k not in ("fleet_summary", "serving_summary")}
+        write_merged(args.artifact, commit)
+
+    if failures:
+        print("\nSOAK FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"\nsoak ok: {st['served']} served ({st['diverged']} diverged"
+          f" lanes isolated, {st['parked']} malformed parked) over "
+          f"{len(traj['t_s'])} polls; p50 {st['latency_ms']['p50']} ms,"
+          f" decomposition residual {dec['sum_residual']}; trajectory +"
+          " metrics + slo blocks linted clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -30,9 +30,14 @@ BENCH_rXX/MULTICHIP_rXX artifact via tools/_artifact.write_merged (the
 merge-preserving convention): `telemetry_summary`, plus — when the run
 captured them — a top-level `xprof_summary`, the `comm_hidden_fraction`
 block ROADMAP item 2 is measured by (exchange device time vs its exposed
-critical-path share vs the serial-probe `.exchange` span), and the
-`fleet_summary` block ROADMAP item 3 is measured by
-(tools/check_artifact.py lints all three).
+critical-path share vs the serial-probe `.exchange` span), the
+`fleet_summary` block ROADMAP item 3 is measured by, the daemon's
+`serving_summary`, and the serving-plane observability blocks (schema
+v8): `metrics_summary` (registry snapshots folded last-per-source then
+across sources), `slo` (per-tenant error-budget burn), and
+`trace_decomposition` (per-stage request-latency decomposition + the
+median-request waterfall whose stage sum must close on its end-to-end
+latency) — tools/check_artifact.py lints all of them.
 """
 
 from __future__ import annotations
@@ -290,6 +295,129 @@ def serving_summary(records: list[dict]):
     return out
 
 
+def _label_str(name: str, labels: dict) -> str:
+    if not labels:
+        return str(name)
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def metrics_summary(records: list[dict]):
+    """Fold the `metrics` registry snapshots (schema v8, utils/metrics)
+    into one artifact block. Snapshots are CUMULATIVE per process, so
+    the fold takes the LAST snapshot per `source` (highest seq) and
+    merges ACROSS sources only — the same counter/gauge/histogram fold
+    `utils/metrics.merge_snapshots` gives the multi-rank `--merge`
+    plane. Histograms summarize to {n, p50, p95, max} (quantiles at
+    log-bucket resolution)."""
+    ms = [r for r in records if r.get("kind") == "metrics"]
+    if not ms:
+        return None
+    from pampi_tpu.utils import metrics as _mx
+
+    last: dict[str, dict] = {}
+    for r in ms:
+        src = str(r.get("source"))
+        if src not in last or (r.get("seq") or 0) \
+                >= (last[src].get("seq") or 0):
+            last[src] = r
+    folded: dict = {"counters": [], "gauges": [], "histograms": []}
+    for r in last.values():
+        folded = _mx.merge_snapshots(
+            folded, {key: r.get(key)
+                     for key in ("counters", "gauges", "histograms")})
+    hists = {}
+    for h in folded["histograms"]:
+        hists[_label_str(h["name"], h.get("labels") or {})] = {
+            "n": h.get("n"),
+            "p50": _mx.snapshot_quantile(h, 0.5),
+            "p95": _mx.snapshot_quantile(h, 0.95),
+            "max": h.get("max"),
+        }
+    return {
+        "sources": len(last),
+        "counters": {_label_str(c["name"], c.get("labels") or {}):
+                     c["value"] for c in folded["counters"]},
+        "gauges": {_label_str(g["name"], g.get("labels") or {}):
+                   g["value"] for g in folded["gauges"]},
+        "histograms": hists,
+    }
+
+
+def slo_summary(records: list[dict]):
+    """The per-tenant SLO block (`slo` top-level in merged artifacts):
+    each tenant's LAST `slo` record — target, windowed requests/
+    violations, lifetime violations, burn rate."""
+    slos = [r for r in records if r.get("kind") == "slo"]
+    if not slos:
+        return None
+    out: dict[str, dict] = {}
+    for r in slos:  # later records overwrite: last-per-tenant wins
+        out[str(r.get("tenant"))] = _strip(r, "tenant")
+    return out
+
+
+def trace_decomposition(records: list[dict]):
+    """The per-stage latency decomposition of the request traces
+    (utils/tracing, kind="trace"). Two views:
+
+    - `stages`: population p50/p95 per critical stage (queue_wait/
+      compile/execute/emit over every completed request) — the "where
+      does latency go" table;
+    - `p50_waterfall`: the MEDIAN request's own stage durations. This is
+      the view the sums-to-e2e contract is checked on: percentiles are
+      not additive (a bimodal fleet — cold-compile requests next to
+      warm ones — has per-stage p50s that sum far from the e2e p50),
+      but one request's stages tile its own end-to-end latency by
+      construction, so the median request's waterfall IS the exact
+      decomposition of the p50 latency. `p50_sum_ms` / `sum_residual`
+      report the closure (tools/check_artifact.py + tools/soak.py
+      assert residual <= 5%, covering rounding and any missing mark)."""
+    traces = [r for r in records if r.get("kind") == "trace"]
+    roots = [r for r in traces if r.get("stage") == "request"
+             and r.get("status") == "ok"
+             and isinstance(r.get("ms"), (int, float))]
+    if not roots:
+        return None
+    from pampi_tpu.fleet.serve import _percentile
+
+    by_trace: dict[str, dict] = {}
+    for r in traces:
+        if r.get("parent") == "request" \
+                and isinstance(r.get("ms"), (int, float)):
+            by_trace.setdefault(
+                str(r.get("trace")), {})[str(r.get("stage"))] = r["ms"]
+    stage_pop: dict[str, list] = {}
+    for stages in by_trace.values():
+        for stage, ms in stages.items():
+            stage_pop.setdefault(stage, []).append(ms)
+    # the median request: nearest-rank on the root e2e population (the
+    # daemon's own percentile formula)
+    ranked = sorted(roots, key=lambda r: r["ms"])
+    median = ranked[min(len(ranked) - 1,
+                        max(0, int(round(0.5 * (len(ranked) - 1)))))]
+    waterfall = by_trace.get(str(median.get("trace")), {})
+    p50_sum = round(sum(waterfall.values()), 4)
+    e2e_p50 = _percentile([r["ms"] for r in roots], 0.5)
+    return {
+        "requests": len(roots),
+        "e2e_ms": {"p50": e2e_p50,
+                   "p95": _percentile([r["ms"] for r in roots], 0.95)},
+        "stages": {
+            stage: {"count": len(vals),
+                    "p50": _percentile(vals, 0.5),
+                    "p95": _percentile(vals, 0.95)}
+            for stage, vals in sorted(stage_pop.items())
+        },
+        "p50_waterfall": {"sid": median.get("sid"),
+                          "e2e_ms": median["ms"], **waterfall},
+        "p50_sum_ms": p50_sum,
+        "sum_residual": (round(abs(p50_sum - median["ms"])
+                               / median["ms"], 6)
+                         if median["ms"] else None),
+    }
+
+
 def xprof_summary(records: list[dict]):
     """The last captured device-trace region, cleaned for the artifact
     (`xprof_summary` top-level block; tools/check_artifact.py lints it)."""
@@ -425,6 +553,53 @@ def render(records: list[dict]) -> str:
         if adm:
             add("  admission: " + " ".join(
                 f"{a}={n}" for a, n in sorted(adm.items())))
+
+    dec = trace_decomposition(records)
+    if dec is not None:
+        add("== request traces (per-stage latency decomposition) ==")
+        add(f"  requests={dec['requests']} "
+            f"e2e p50={dec['e2e_ms']['p50']} ms "
+            f"p95={dec['e2e_ms']['p95']} ms")
+        add(f"  {'stage':<14} {'count':>6} {'p50 ms':>12} {'p95 ms':>12}")
+        for stage, row in dec["stages"].items():
+            add(f"  {stage:<14} {row['count']:>6} "
+                f"{_num(row['p50']):>12.3f} {_num(row['p95']):>12.3f}")
+        wf = dec["p50_waterfall"]
+        add(f"  -- median request waterfall ({wf.get('sid')}, "
+            f"e2e {wf.get('e2e_ms')} ms; stage sum {dec['p50_sum_ms']} "
+            f"ms, residual {dec['sum_residual']}) --")
+        offset = 0.0
+        for stage in ("queue_wait", "compile", "execute", "emit"):
+            ms = wf.get(stage)
+            if ms is None:
+                continue
+            add(f"    {stage:<12} [{offset:>10.3f} .. "
+                f"{offset + ms:>10.3f}] {ms:>10.3f} ms")
+            offset += ms
+
+    mx = metrics_summary(records)
+    if mx is not None:
+        add("== metrics registry (folded snapshots) ==")
+        add(f"  sources={mx['sources']}")
+        for name, val in sorted(mx["counters"].items()):
+            add(f"  counter    {name:<52} {val}")
+        for name, val in sorted(mx["gauges"].items()):
+            add(f"  gauge      {name:<52} {val}")
+        for name, row in sorted(mx["histograms"].items()):
+            add(f"  histogram  {name:<52} n={row['n']} "
+                f"p50={row['p50']} p95={row['p95']} max={row['max']}")
+
+    slo = slo_summary(records)
+    if slo is not None:
+        add("== tenant SLOs (sliding-window error budget) ==")
+        add(f"  {'tenant':<16} {'target ms':>10} {'requests':>9} "
+            f"{'violations':>11} {'burn':>8}")
+        for tenant, row in sorted(slo.items()):
+            add(f"  {tenant:<16} {_num(row.get('target_ms')):>10.3f} "
+                f"{row.get('requests'):>9} {row.get('violations'):>11} "
+                f"{_num(row.get('burn_rate')):>8.2f}"
+                + ("  BURN ALERT" if _num(row.get("burn_rate")) > 2
+                   else ""))
 
     for d in k.get("divergence", []):
         add("== DIVERGENCE ==")
@@ -602,6 +777,15 @@ def main(argv: list[str]) -> int:
         srv = serving_summary(records)
         if srv is not None:
             block["serving_summary"] = srv
+        mx = metrics_summary(records)
+        if mx is not None:
+            block["metrics_summary"] = mx
+        slo = slo_summary(records)
+        if slo is not None:
+            block["slo"] = slo
+        dec = trace_decomposition(records)
+        if dec is not None:
+            block["trace_decomposition"] = dec
         write_merged(merge_to, block)
     return 0
 
