@@ -151,6 +151,19 @@ serve-smoke:
 soak-smoke:
 	JAX_PLATFORMS=cpu python tools/soak.py
 
+# Chaos smoke (autopilot, ISSUE 19): the scripted storm through the
+# self-healing elastic control plane — injected rank death at poll 3
+# (auto shrink_resume, fault ledger carried), a sustained synthetic SLO
+# burn (exactly one hysteresis-banded regrow, checkpoint-fenced, then
+# the degradation ladder down to shedding and monotonically back up),
+# and a high-priority preemption whose parked victim resumes bitwise.
+# Asserts zero flaps, a monotone recorded rung sequence, two bitwise
+# parity contracts (healed resident vs clean restore; preempted-run
+# fields vs a flat run), and the autoscale/chaos_trajectory artifact
+# blocks linting clean. rc 0 = the whole story holds.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
 # The full fleet test file INCLUDING the slow-marked parity cases
 # (fused / 3-D-dist vmap batches — tier-1 carries one representative
 # per axis to hold its 870 s window; this target is the complete
@@ -204,7 +217,7 @@ distclean:
 
 .PHONY: all test asm format telemetry-report check-artifacts bench-trend \
 	profile-smoke mg-smoke chunk-smoke mg-suite fleet-smoke serve-smoke \
-	soak-smoke \
+	soak-smoke chaos-smoke \
 	fleet-suite \
 	lint \
 	lint-update lint-comm \

@@ -25,6 +25,12 @@ machinery into a serving stack:
   slo.py        tenant SLO accounting: per-tenant latency targets, the
                 sliding-window error budget, burn-rate alerting (the
                 `slo` record plane + the status.json block)
+  autopilot.py  the self-healing elastic control plane (ISSUE 19): a
+                policy loop in the daemon's poll cycle consuming the
+                SLO/queue/latency signals to drive shrink_resume,
+                elastic lane scaling, QoS preemption and the explicit
+                degradation ladder (`tpu_autopilot`; every decision an
+                `autoscale` record)
 
 See README "Fleet serving" for the request format, the bucketing policy
 and the knob table.
@@ -50,6 +56,13 @@ from .scheduler import (
     run_fleet,
     shrink_resume,
 )
+from .autopilot import (
+    Autopilot,
+    AutopilotConfig,
+    ParkStore,
+    parse_autopilot_spec,
+    parse_priority_spec,
+)
 from .serve import FleetDaemon, ServeConfig
 from .slo import SloTracker, parse_slo_spec
 
@@ -62,4 +75,6 @@ __all__ = [
     "run_fleet", "shrink_resume",
     "FleetDaemon", "ServeConfig",
     "SloTracker", "parse_slo_spec",
+    "Autopilot", "AutopilotConfig", "ParkStore",
+    "parse_autopilot_spec", "parse_priority_spec",
 ]

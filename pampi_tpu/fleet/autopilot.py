@@ -1,0 +1,643 @@
+"""Autopilot: the self-healing elastic control plane for the serving
+fleet (ISSUE 19 — ROADMAP item 3's closing move).
+
+PR 18 built the observability plane (per-tenant SLO burn rates, the
+queue-depth gauge, per-class latency histograms) and PR 10/12 built the
+elastic machinery (mesh-independent manifests, `shrink_resume`,
+survivor consensus) — but nothing consumed the signals to drive the
+machinery: a dead rank, an SLO burn, a backlog spike all waited for an
+operator. This module is the policy loop that closes observe→decide→act
+inside the daemon's poll cycle:
+
+observe   every poll: max tenant burn rate (fleet/slo.burn_snapshot),
+          queue depth + backlog trend (a short depth window), worst
+          per-class p95 from the registry histograms.
+decide    a hysteresis BAND, not a threshold: hot above
+          `burn_high`/`backlog_high`, calm below `burn_low` — the gap
+          between them is where nothing changes, so a burn oscillating
+          around one number cannot flap the fleet. Transitions need
+          `sustain` consecutive hot (or calm) polls AND `cooldown`
+          polls since the last transition.
+act       through surfaces that already exist, never new ones:
+
+  self-healing      a RankDeadError from the resident elastic job (or a
+                    `dead@poll<N>` injection) triggers automatic
+                    `shrink_resume` onto survivor capacity — no
+                    operator; the fault ledger rides the manifest so
+                    probation history survives the shrink.
+  elastic scaling   sustained burn/backlog grows the continuous-batch
+                    lane pool (and checkpoint-FENCES the resident
+                    through its elastic manifest: save a generation,
+                    restore from it — every transition provably
+                    resumable, bitwise vs a clean run from the same
+                    generation); sustained idle shrinks it.
+  QoS preemption    tenant priority classes (`high`/`normal`/`low`)
+                    weight admission quotas, and the scheduler's
+                    continuous loop parks a low-priority lane's full
+                    per-lane carry through a parked-lane manifest
+                    (utils/checkpoint.save_parked_lane) when a
+                    higher-priority request has no slot — the victim
+                    resumes bitwise once the queue drains.
+  degraded rungs    when the pool is at capacity and burn persists, an
+                    EXPLICIT degradation ladder (LADDER below), one
+                    rung per decision, telemetry-recorded:
+                      1 class_consolidation  force shape-class batching
+                                             (fewer compiles, shared
+                                             lanes)
+                      2 itermax_cap          cap admitted requests'
+                                             pressure-solve budget
+                      3 shed_low_priority    refuse lowest-priority
+                                             tenants at admission
+                    and the same ladder back UP, one rung per sustained
+                    calm window.
+
+Every decision — including "hold" — lands as an `autoscale` telemetry
+record (schema v9): policy inputs, decision, rung, lane/capacity counts
+and the live hysteresis state, rendered by tools/telemetry_report and
+linted by tools/check_artifact. Transition counts and time-to-recover
+land as trend-gated metrics at daemon stop (`autoscale_flaps`,
+`autoscale_time_to_recover_ms` — both lower-is-better in bench_trend).
+
+The knob is `tpu_autopilot` (utils/params.py) / `--autopilot`
+(tools/serve.py): "off" — the default — constructs NO Autopilot and the
+daemon is byte-identical to the policy-less build (test-pinned);
+"on[:k=v,...]" arms the loop with optional hysteresis overrides.
+tools/chaos_smoke.py is the proof harness: injected kill →
+auto-shrink → synthetic-burn regrow (exactly once across the band) →
+preempt → bitwise resume, on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from ..utils import faultinject as _fi
+from ..utils import telemetry as _tm
+
+# tenant priority classes: lower = more important. Admission quotas are
+# WEIGHTED by class (never reordered — FIFO within a tenant is part of
+# the starvation story), preemption is strict: only a strictly
+# higher-priority pending request may evict a running lane.
+PRIORITY_CLASSES = {"high": 0, "normal": 1, "low": 2}
+PRIORITY_WEIGHTS = {0: 2.0, 1: 1.0, 2: 0.5}
+# the class the shed rung refuses (only ever the lowest)
+SHED_CLASS = 2
+
+# the degradation ladder, rung 0 = full service. Moves are one rung per
+# decision in BOTH directions and every move is an `autoscale` record —
+# the chaos smoke asserts the recorded sequence is monotone (no
+# skipping, no oscillation inside one hot/calm phase).
+LADDER = ("full_service", "class_consolidation", "itermax_cap",
+          "shed_low_priority")
+
+
+@dataclasses.dataclass
+class AutopilotConfig:
+    """The hysteresis band and pool bounds (parse_autopilot_spec)."""
+
+    burn_high: float = 3.0    # hot above this max-tenant burn rate...
+    burn_low: float = 1.0     # ...calm below this one; between = hold
+    backlog_high: int = 8     # queue depth that also counts as hot
+    sustain: int = 2          # consecutive hot/calm polls to act
+    cooldown: int = 3         # min polls between transitions
+    min_lanes: int = 1        # deliberate shrink floor
+    max_lanes: int = 0        # grow ceiling (0 = 2x the starting pool,
+    #                           capped by local device count)
+    idle_polls: int = 6       # consecutive empty-queue calm polls
+    #                           before a deliberate shrink
+    itermax_cap: int = 4      # rung-2 admission cap on itermax
+    flap_window: int = 6      # opposite-direction capacity moves
+    #                           within this many polls count as a flap
+    trend_window: int = 4     # queue-depth polls behind backlog_trend
+
+
+def parse_autopilot_spec(spec: str | None):
+    """`"off"`/empty -> None (policy plane off). `"on"` -> defaults,
+    `"on:burn_high=4,sustain=3"` -> overridden config. Unknown keys and
+    unparsable values fail loudly — a mistyped policy knob must not
+    silently run a different policy."""
+    spec = (spec or "").strip()
+    if spec in ("", "off"):
+        return None
+    head, _, tail = spec.partition(":")
+    if head != "on":
+        raise ValueError(
+            f"bad tpu_autopilot spec {spec!r} (want off | on[:k=v,...])")
+    cfg = AutopilotConfig()
+    for part in tail.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad tpu_autopilot override {part!r} (want k=v)")
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if not hasattr(cfg, key):
+            raise ValueError(
+                f"unknown tpu_autopilot key {key!r} (have "
+                f"{', '.join(f.name for f in dataclasses.fields(cfg))})")
+        kind = type(getattr(cfg, key))
+        try:
+            setattr(cfg, key, kind(val))
+        except ValueError:
+            raise ValueError(
+                f"bad tpu_autopilot value {val!r} for {key} "
+                f"(want {kind.__name__})")
+    return cfg
+
+
+def parse_priority_spec(spec: str | None) -> dict[str, int]:
+    """`"zoe=high,bob=low,default=normal"` -> {tenant: class int}.
+    Empty -> {} (flat priorities: weighted admission and preemption both
+    off). Unknown class names fail loudly."""
+    out: dict[str, int] = {}
+    if not spec:
+        return out
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad priority entry {part!r} "
+                             "(want tenant=high|normal|low)")
+        tenant, _, klass = part.partition("=")
+        tenant, klass = tenant.strip(), klass.strip()
+        if not tenant or klass not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"bad priority entry {part!r} (tenant non-empty, class "
+                f"one of {'|'.join(PRIORITY_CLASSES)})")
+        out[tenant] = PRIORITY_CLASSES[klass]
+    return out
+
+
+@dataclasses.dataclass
+class ParkedLane:
+    """One preempted lane: sid + its param in memory, the leaf arrays on
+    disk behind a CRC-checked manifest (utils/checkpoint)."""
+
+    sid: str
+    param: object
+    path: str
+
+    def load(self) -> list:
+        from ..utils import checkpoint as _ckpt
+
+        return _ckpt.load_parked_lane(self.path)
+
+
+class ParkStore:
+    """Parked-lane manifests for the preemption plane, keyed by bucket
+    signature (a parked lane may only resume into the SAME compiled
+    shape it left — the signature is that contract). FIFO per bucket:
+    the first victim parked is the first resumed."""
+
+    def __init__(self, dirpath: str):
+        os.makedirs(dirpath, exist_ok=True)
+        self.dir = dirpath
+        self._by_bucket: dict[str, list[ParkedLane]] = {}
+        self.parked_total = 0
+        self.resumed_total = 0
+
+    def park(self, bucket_sig: str, sid: str, param, leaves) -> str:
+        from ..utils import checkpoint as _ckpt
+
+        path = os.path.join(self.dir, f"{sid}.lane.npz")
+        _ckpt.save_parked_lane(path, sid, leaves)
+        self._by_bucket.setdefault(bucket_sig, []).append(
+            ParkedLane(sid=sid, param=param, path=path))
+        self.parked_total += 1
+        return path
+
+    def pop(self, bucket_sig: str) -> ParkedLane | None:
+        q = self._by_bucket.get(bucket_sig)
+        if not q:
+            return None
+        self.resumed_total += 1
+        return q.pop(0)
+
+    def count(self, bucket_sig: str | None = None) -> int:
+        if bucket_sig is not None:
+            return len(self._by_bucket.get(bucket_sig, ()))
+        return sum(len(q) for q in self._by_bucket.values())
+
+
+@dataclasses.dataclass
+class ResidentJob:
+    """The long-lived elastic job the heal/fence plane acts on: its
+    manifest path + rebuild parameters. `solver` is the live restored
+    solver after a heal/fence (None until the first one)."""
+
+    path: str
+    param: object
+    family: str = "ns2d"
+    solver: object = None
+    devices: int = 0
+
+
+class Autopilot:
+    """The per-daemon policy loop. Constructed by FleetDaemon only when
+    the knob is on; every method is driven from the daemon's poll cycle
+    (`pre_poll` before the scan, `tick` after the SLO poll)."""
+
+    def __init__(self, daemon, spec: str):
+        import jax
+
+        cfg = parse_autopilot_spec(spec)
+        if cfg is None:
+            raise ValueError("Autopilot constructed with the knob off — "
+                             "the daemon must not build one")
+        self.d = daemon
+        self.cfg = cfg
+        self.priorities = parse_priority_spec(
+            getattr(daemon.cfg, "priorities", ""))
+        self.devices = list(jax.devices())
+        self.lanes = daemon.cfg.max_lanes
+        if cfg.max_lanes <= 0:
+            cfg.max_lanes = max(self.lanes,
+                                min(len(self.devices), self.lanes * 2))
+        self.rung = 0
+        self.epoch = 0
+        self.resident: ResidentJob | None = None
+        # hysteresis state
+        self._above = 0
+        self._below = 0
+        self._idle = 0
+        self._last_transition = -(10 ** 9)  # poll index
+        self._last_dir: str | None = None
+        self._last_dir_poll = -(10 ** 9)
+        self._breach_ts: float | None = None
+        self._depths: list[int] = []
+        self._saved_classes: str | None = None
+        # the trend-gated tallies
+        self.counts = {"heal": 0, "grow": 0, "shrink": 0,
+                       "degrade": 0, "recover": 0, "shed": 0}
+        self.flaps = 0
+        self.recoveries_ms: list[float] = []
+        if self.priorities:
+            # arm the scheduler's preemption hooks (scheduler defaults
+            # are None/None — the byte-identical hookless loop)
+            daemon.sched.park_store = ParkStore(
+                os.path.join(daemon.cfg.queue_dir, "parked_lanes"))
+            daemon.sched.priority_of = self.priority_of_sid
+        from ..utils import dispatch as _dispatch
+
+        _dispatch.record(
+            "tpu_autopilot",
+            f"on (burn {cfg.burn_low}..{cfg.burn_high}, backlog "
+            f"{cfg.backlog_high}, sustain {cfg.sustain}, cooldown "
+            f"{cfg.cooldown}, lanes {cfg.min_lanes}..{cfg.max_lanes}, "
+            f"{len(self.priorities)} priority entries)")
+
+    # -- tenant QoS ------------------------------------------------------
+    def priority_for(self, tenant: str) -> int:
+        return self.priorities.get(
+            tenant, self.priorities.get(
+                "default", PRIORITY_CLASSES["normal"]))
+
+    def priority_of_sid(self, sid: str) -> int:
+        from .serve import tenant_of
+
+        return self.priority_for(tenant_of(sid))
+
+    def quota_for(self, tenant: str) -> int:
+        """WEIGHTED admission: the per-tenant pending cap scaled by
+        priority class (2x / 1x / 0.5x), floor 1 — a low-priority tenant
+        is throttled, never locked out (shedding is rung 3's explicit,
+        recorded move, not a quota side effect)."""
+        base = self.d.cfg.tenant_quota
+        if not self.priorities:
+            return base
+        return max(1, int(round(base
+                                * PRIORITY_WEIGHTS[
+                                    self.priority_for(tenant)])))
+
+    def should_shed(self, tenant: str) -> bool:
+        """Rung 3: refuse the lowest class at admission."""
+        return (self.rung >= LADDER.index("shed_low_priority")
+                and bool(self.priorities)
+                and self.priority_for(tenant) >= SHED_CLASS)
+
+    def admit(self, req):
+        """Rung-2 degradation applied at admission: cap the request's
+        pressure-solve budget. Returns the (possibly replaced) request;
+        below rung 2 the request passes through untouched."""
+        if self.rung < LADDER.index("itermax_cap"):
+            return req
+        cap = self.cfg.itermax_cap
+        if int(req.param.itermax) <= cap:
+            return req
+        _tm.emit("admission", action="degrade", sid=req.sid,
+                 reason="itermax_cap", itermax=cap,
+                 requested=int(req.param.itermax), rung=self.rung)
+        return dataclasses.replace(req, param=req.param.replace(
+            itermax=cap))
+
+    # -- the resident elastic job ---------------------------------------
+    def register_resident(self, path: str, param,
+                          family: str = "ns2d") -> None:
+        """Tell the autopilot which elastic manifest the heal/fence
+        plane owns. The daemon serves request traffic; the RESIDENT is
+        the long-lived distributed job sharing the capacity — the thing
+        a rank death actually hits."""
+        self.resident = ResidentJob(path=path, param=param,
+                                    family=family,
+                                    devices=len(self.devices))
+        self._record("resident", manifest=path, family=family)
+
+    def _restore_resident(self, shrink: bool, dead=None, epoch=None):
+        """(Re)build the resident on current capacity, stepping the
+        device count DOWN on an infeasible mesh (CartComm refuses
+        factorizations the grid cannot shard — a 7-survivor mesh on a
+        16x16 grid falls back to 4; the divisibility fallback is itself
+        a policy decision, recorded via the shrink/fence record's
+        devices field)."""
+        r = self.resident
+        last_exc = None
+        for n in range(len(self.devices), 0, -1):
+            devs = self.devices[:n]
+            try:
+                if shrink:
+                    from .scheduler import shrink_resume
+
+                    solver = shrink_resume(
+                        r.path, r.param, family=r.family, devices=devs,
+                        dead=dead, epoch=epoch, scheduler=self.d.sched)
+                else:
+                    solver = self.d.sched.elastic_restore(
+                        r.path, r.param, family=r.family, devices=devs)
+            except ValueError as exc:
+                last_exc = exc
+                continue
+            r.solver = solver
+            r.devices = n
+            return solver
+        raise last_exc if last_exc is not None else RuntimeError(
+            "no feasible device count for the resident")
+
+    def heal(self, exc=None) -> None:
+        """Self-healing: a rank death becomes `shrink_resume` onto
+        survivor capacity — no operator. Accepts the structured
+        RankDeadError (ranks/epoch/survivors attached) or the raw
+        InjectedRankDeath from a `dead@poll<N>` clause (no verdict
+        attached: the last device is taken as the casualty)."""
+        from ..parallel.coordinator import RankDeadError
+
+        if isinstance(exc, RankDeadError):
+            dead = list(exc.ranks)
+            epoch = exc.epoch
+        else:
+            dead = [len(self.devices) - 1]
+            epoch = self.epoch + 1
+        lost = {r for r in dead if 0 <= r < len(self.devices)}
+        survivors = [d for i, d in enumerate(self.devices)
+                     if i not in lost]
+        if not survivors:
+            survivors = self.devices[:1]
+        self.devices = survivors
+        self.epoch = int(epoch) if epoch is not None else self.epoch + 1
+        gen = None
+        if self.resident is not None:
+            solver = self._restore_resident(shrink=True, dead=dead,
+                                            epoch=self.epoch)
+            gen = getattr(solver, "_elastic_generation", None)
+        # the pool never exceeds capacity: a heal that drops below the
+        # current lane count shrinks the pool with it (not a flap — the
+        # fleet did not oscillate, it lost hardware)
+        cap = max(self.cfg.min_lanes, len(self.devices))
+        if self.lanes > cap:
+            self.lanes = cap
+            self.d.sched.lanes = cap
+        self.counts["heal"] += 1
+        self._last_transition = self.d.polls
+        self._record("heal", dead=sorted(lost), epoch=self.epoch,
+                     survivors=len(self.devices), generation=gen,
+                     resident_devices=(self.resident.devices
+                                       if self.resident else None))
+
+    def _fence(self, reason: str):
+        """Checkpoint-fence a capacity transition: save the resident's
+        state as a NEW manifest generation, then restore from it — every
+        grow/shrink leaves a generation a clean run can bitwise-match
+        (the chaos smoke's twin-restore assertion)."""
+        if self.resident is None or self.resident.solver is None:
+            return None
+        from ..utils import checkpoint as _ckpt
+
+        solver = self.resident.solver
+        _ckpt.save_elastic(self.resident.path, solver,
+                           ledger=getattr(solver, "_fault_ledger",
+                                          None))
+        solver = self._restore_resident(shrink=False)
+        gen = getattr(solver, "_elastic_generation", None)
+        _tm.emit("ckpt", event="fence", path=self.resident.path,
+                 reason=reason, generation=gen,
+                 devices=self.resident.devices)
+        return gen
+
+    # -- the poll-cycle hooks -------------------------------------------
+    def pre_poll(self, now: float) -> None:
+        """Before the scan: consume the daemon-plane fault clauses
+        (utils/faultinject.poll_faults). Catching InjectedRankDeath — a
+        BaseException by design — is correct HERE and only here: the
+        autopilot is the structured consumer that turns a death into
+        `shrink_resume`, the same role the lockstep watchdog collector
+        plays for `dead@chunk`; it must never reach the generic
+        Exception funnels that would misread it as a request failure."""
+        try:
+            directives = _fi.poll_faults()
+        except _fi.InjectedRankDeath:
+            self.heal()
+            return
+        for kind, tenant, count in directives:
+            if kind == "burst":
+                n = self.d.slo.inject_synthetic(tenant, count, now)
+                self._record("inject", fault="burst", tenant=tenant,
+                             injected=n)
+            elif kind == "slow_lane":
+                target = self.d.slo.target_for(tenant) or 1000.0
+                for _ in range(int(count)):
+                    self.d.metrics.histogram(
+                        "fleet_request_latency_ms",
+                        tenant=tenant).observe(target * 10.0)
+                    self.d.metrics.histogram(
+                        "fleet_class_latency_ms", klass="synthetic",
+                        family="synthetic").observe(target * 10.0)
+                self.d.slo.inject_synthetic(tenant, count, now)
+                self._record("inject", fault="slow_lane", tenant=tenant,
+                             injected=int(count))
+
+    def tick(self, now: float) -> None:
+        """After the SLO poll: one observe→decide→act step. Every tick
+        emits exactly one `autoscale` record (decision "hold" included —
+        the flight record shows the policy SEEING the signals, not just
+        reacting)."""
+        inputs = self._observe(now)
+        decision = self._decide(inputs, now)
+        if decision == "hold":
+            self._record("hold", inputs=inputs)
+        else:
+            self._act(decision, inputs, now)
+
+    # -- observe / decide / act -----------------------------------------
+    def _observe(self, now: float) -> dict:
+        d = self.d
+        burns = d.slo.burn_snapshot(now)
+        self._depths.append(int(d.queue_depth))
+        if len(self._depths) > self.cfg.trend_window:
+            self._depths.pop(0)
+        p95s = [h.quantile(0.95)
+                for h in d.metrics.histograms("fleet_class_latency_ms")
+                if h.n]
+        return {
+            "burn_max": max(burns.values(), default=0.0),
+            "burns": burns,
+            "queue_depth": int(d.queue_depth),
+            "backlog_trend": int(d.queue_depth) - self._depths[0],
+            "p95_worst_ms": (round(max(p95s), 3) if p95s else None),
+        }
+
+    def _decide(self, inputs: dict, now: float) -> str:
+        cfg = self.cfg
+        hot = (inputs["burn_max"] > cfg.burn_high
+               or inputs["queue_depth"] >= cfg.backlog_high)
+        calm = (inputs["burn_max"] < cfg.burn_low
+                and inputs["queue_depth"] < cfg.backlog_high)
+        if hot:
+            self._above += 1
+            self._below = 0
+            self._idle = 0
+            if self._breach_ts is None:
+                self._breach_ts = now  # the time-to-recover clock
+        elif calm:
+            self._below += 1
+            self._above = 0
+            self._idle = (self._idle + 1
+                          if inputs["queue_depth"] == 0 else 0)
+        else:
+            # INSIDE the band: hold, and reset both sustain counters —
+            # the band is the no-flap buffer
+            self._above = 0
+            self._below = 0
+            self._idle = 0
+        # recovery completes when calm has sustained AND the ladder is
+        # back at full service — the clock spans breach to full recovery
+        if (self._breach_ts is not None and self.rung == 0
+                and self._below >= cfg.sustain):
+            self.recoveries_ms.append(
+                round((now - self._breach_ts) * 1e3, 3))
+            self._breach_ts = None
+        if self.d.polls - self._last_transition < cfg.cooldown:
+            return "hold"
+        if self._above >= cfg.sustain:
+            cap = min(cfg.max_lanes, len(self.devices))
+            if self.lanes < cap:
+                return "grow"
+            if self.rung < len(LADDER) - 1:
+                return "degrade"
+            return "hold"  # bottom rung: nothing left to give up
+        if self._below >= cfg.sustain:
+            if self.rung > 0:
+                return "recover"
+            if (self._idle >= cfg.idle_polls
+                    and self.lanes > cfg.min_lanes):
+                return "shrink"
+        return "hold"
+
+    def _act(self, decision: str, inputs: dict, now: float) -> None:
+        gen = None
+        if decision == "grow":
+            self.lanes += 1
+            self.d.sched.lanes = self.lanes
+            gen = self._fence("grow")
+            self._mark_dir("up")
+        elif decision == "shrink":
+            self.lanes -= 1
+            self.d.sched.lanes = self.lanes
+            gen = self._fence("shrink")
+            self._mark_dir("down")
+        elif decision == "degrade":
+            self.rung += 1
+            self._apply_rung()
+        elif decision == "recover":
+            self.rung -= 1
+            self._apply_rung()
+        self.counts[decision] += 1
+        self._above = 0
+        self._below = 0
+        self._idle = 0
+        self._last_transition = self.d.polls
+        self._record(decision, inputs=inputs, generation=gen)
+
+    def _apply_rung(self) -> None:
+        """Rung 1 is the only rung with daemon state to flip NOW (force
+        shape-class consolidation); rungs 2/3 are consulted at admission
+        (`admit` / `should_shed`) so they need no apply step."""
+        if (self.rung >= LADDER.index("class_consolidation")
+                and self._saved_classes is None):
+            self._saved_classes = self.d.sched.classes
+            self.d.sched.classes = "on"
+        elif self.rung == 0 and self._saved_classes is not None:
+            self.d.sched.classes = self._saved_classes
+            self._saved_classes = None
+
+    def _mark_dir(self, direction: str) -> None:
+        """Flap accounting: an opposite-direction CAPACITY move within
+        flap_window polls of the last one is a flap — the thing the
+        hysteresis band exists to make zero (trend-gated)."""
+        if (self._last_dir is not None and direction != self._last_dir
+                and self.d.polls - self._last_dir_poll
+                <= self.cfg.flap_window):
+            self.flaps += 1
+        self._last_dir = direction
+        self._last_dir_poll = self.d.polls
+
+    # -- reporting -------------------------------------------------------
+    def _record(self, decision: str, **extra) -> None:
+        cfg = self.cfg
+        _tm.emit("autoscale", decision=decision, poll=self.d.polls,
+                 rung=self.rung, rung_name=LADDER[self.rung],
+                 lanes=self.lanes, capacity=len(self.devices),
+                 hysteresis={
+                     "above": self._above, "below": self._below,
+                     "cooldown_left": max(
+                         0, cfg.cooldown
+                         - (self.d.polls - self._last_transition)),
+                 },
+                 **extra)
+
+    def status_block(self) -> dict:
+        store = self.d.sched.park_store
+        return {
+            "mode": "on",
+            "lanes": self.lanes,
+            "capacity": len(self.devices),
+            "rung": self.rung,
+            "rung_name": LADDER[self.rung],
+            "epoch": self.epoch,
+            "counts": dict(self.counts),
+            "flaps": self.flaps,
+            "recoveries_ms": list(self.recoveries_ms),
+            "parked_lanes": (store.count() if store is not None
+                             else 0),
+        }
+
+    def emit_stop_metrics(self, backend: str) -> None:
+        """The trend-gated autoscale metrics (bench_trend
+        NAME_DIRECTIONS pins both lower-is-better): flap count always,
+        WORST-case time-to-recover when a breach recovered, and the
+        total transition tally (render-only — unitless context, not a
+        gate)."""
+        _tm.emit("metric", metric="autoscale_flaps", value=self.flaps,
+                 unit="transitions", backend=backend)
+        if self.recoveries_ms:
+            _tm.emit("metric", metric="autoscale_time_to_recover_ms",
+                     value=max(self.recoveries_ms), unit="ms",
+                     backend=backend)
+        transitions = sum(self.counts[k] for k in
+                          ("heal", "grow", "shrink", "degrade",
+                           "recover"))
+        _tm.emit("metric", metric="autoscale_transitions",
+                 value=transitions, unit="transitions",
+                 backend=backend)

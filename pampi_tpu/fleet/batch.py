@@ -409,6 +409,52 @@ class BatchedSolver:
                  scenario=sid)
         return tuple(out)
 
+    def park_lane(self, state, lane: int) -> dict:
+        """QoS PREEMPTION (fleet/autopilot.py): lift lane `lane`'s full
+        per-lane carry off the device — every stacked leaf below the
+        batch scalars (fields, per-lane t/nt, the te slot when carried)
+        at the current chunk boundary — so a higher-priority tenant can
+        take the slot NOW and the victim resumes later via `resume_lane`
+        from exactly this state, bitwise (chunk advances are per-lane
+        independent, so park/resume at boundaries never perturbs the
+        victim's own step sequence or its batchmates'). Returns
+        {sid, param, leaves}; the caller persists `leaves` through
+        utils/checkpoint.save_parked_lane."""
+        if not (0 <= lane < self.n):
+            raise ValueError(f"lane {lane} out of range 0..{self.n - 1}")
+        leaves = [np.asarray(leaf[lane])
+                  for leaf in state[:self._active_index]]
+        return {"sid": self.sids[lane], "param": self.params[lane],
+                "leaves": leaves}
+
+    def resume_lane(self, state, lane: int, leaves, param,
+                    sid: str) -> tuple:
+        """Splice a parked lane's carry back into slot `lane` — the
+        inverse of `park_lane`, same host-side surgery as `swap_lane`
+        except the state comes from the park file instead of a fresh
+        `lane_state`, so the lane continues mid-flight from the boundary
+        it was evicted at."""
+        if not (0 <= lane < self.n):
+            raise ValueError(f"lane {lane} out of range 0..{self.n - 1}")
+        if len(leaves) != self._active_index:
+            raise ValueError(
+                f"parked lane carries {len(leaves)} leaves; this batch "
+                f"expects {self._active_index} (a different te-carry or "
+                "family shape is a different bucket)")
+        out = list(state)
+        for i, leaf in enumerate(leaves):
+            out[i] = out[i].at[lane].set(jnp.asarray(leaf))
+        out[self._active_index] = \
+            out[self._active_index].at[lane].set(True)
+        time_dtype = jnp.float64 if jax.config.jax_enable_x64 \
+            else jnp.float32
+        out[self._active_index + 1] = jnp.asarray(0.0, time_dtype)
+        self.params[lane] = param
+        self.sids[lane] = sid
+        _tm.emit("swap", family=f"fleet.{self.family}", lane=lane,
+                 scenario=sid, resumed=True)
+        return tuple(out)
+
     def run(self, progress: bool = False):
         """Drive the batch to te through models/_driver.drive_chunks —
         the solo drive loop, unchanged: transient retry and the

@@ -58,7 +58,8 @@ PER_LANE_KEYS = ("te",)
 HOUSEKEEPING_KEYS = (
     "tpu_checkpoint", "tpu_ckpt_every", "tpu_restart", "tpu_vtk",
     "tpu_lookahead", "tpu_retry_replenish", "tpu_recover_ring",
-    "tpu_recover_dt_scale", "tpu_recover_max", "tpu_fleet", "seen_keys",
+    "tpu_recover_dt_scale", "tpu_recover_max", "tpu_fleet",
+    "tpu_autopilot", "seen_keys",
 )
 
 # the signature-excluded keys that still STEER the drive loop (retry /

@@ -280,6 +280,25 @@ class FleetScheduler:
         # bucket compile counts and swap totals for THIS scheduler
         self.compile_census: dict[str, int] = {}
         self.swap_census: dict[str, int] = {}
+        # QoS preemption hooks (fleet/autopilot.py wires them when the
+        # autopilot runs with tenant priorities; None — the default, and
+        # the policy-off daemon — keeps _serve_continuous byte-identical
+        # to the hookless loop):
+        #   priority_of(sid) -> int   lower = more important
+        #   park_store                autopilot.ParkStore (parked-lane
+        #                             manifests, keyed by bucket sig)
+        #   feed(key) -> [requests]   chunk-boundary arrivals for the
+        #                             bucket (the mid-run swap-in plane,
+        #                             now reachable from run())
+        self.priority_of = None
+        self.park_store = None
+        self.feed = None
+        # isolate mode turns ANY bucket failure into failed results —
+        # but a RankDeadError is capacity loss, not a tenant's bad
+        # config: with a death consumer armed (the autopilot) it must
+        # surface so the heal plane can shrink and requeue. False (the
+        # default) keeps the historical funnel byte-identical.
+        self.raise_rank_death = False
 
     def submit(self, request: _q.ScenarioRequest) -> None:
         self.requests.append(request)
@@ -302,6 +321,11 @@ class FleetScheduler:
             except Exception as exc:  # lint: allow(broad-except) — per-bucket isolation (isolate mode): any mode-resolution/build/execution failure degrades to failed results, re-raised verbatim otherwise
                 if not self.isolate:
                     raise
+                if self.raise_rank_death:
+                    from ..parallel.coordinator import RankDeadError
+
+                    if isinstance(exc, RankDeadError):
+                        raise
                 _tm.emit("warning", component="fleet.scheduler",
                          reason="bucket_failed", bucket=key.label,
                          error=str(exc),
@@ -457,7 +481,9 @@ class FleetScheduler:
                     f"continuous ({pool}-lane pool, {len(reqs)} "
                     "scenarios, swap-in on finish/divergence)")
                 rows, swaps = self._serve_continuous(
-                    batched, reqs[pool:])
+                    batched, reqs[pool:], bucket=key,
+                    feed=((lambda: self.feed(key))
+                          if self.feed is not None else None))
                 self.swap_census[label] = \
                     self.swap_census.get(label, 0) + swaps
             else:
@@ -562,7 +588,8 @@ class FleetScheduler:
         self.compile_census[label] = self.compile_census.get(label, 0) + 1
         return batched, False, time.perf_counter() - c0
 
-    def _serve_continuous(self, batched, pending, feed=None):
+    def _serve_continuous(self, batched, pending, feed=None,
+                          bucket=None):
         """CONTINUOUS BATCHING: drive the compiled pool chunk-by-chunk,
         harvesting each lane the moment it finishes (its own te) or
         diverges (retired by the in-band sentinel / finiteness mask) and
@@ -571,6 +598,17 @@ class FleetScheduler:
         given, is polled at every chunk boundary for newly-arrived
         same-bucket requests (the daemon's mid-run swap-in plane).
         Returns (results in completion order, swap count).
+
+        QoS preemption (fleet/autopilot.py, armed only when both
+        `self.park_store` and `self.priority_of` are set — the default
+        None/None keeps this loop byte-identical to the hookless build):
+        when a strictly higher-priority request is waiting and no slot
+        is free, the WORST-priority active lane is parked — its full
+        per-lane carry persisted through a parked-lane manifest
+        (utils/checkpoint.save_parked_lane) — and the slot handed over;
+        parked lanes resume bitwise into freed slots once the pending
+        queue drains (new arrivals first: parked tenants are by
+        construction the lowest priority in the bucket).
 
         Fault handling: transient UNAVAILABLE device faults get the
         same-chunk retry the drive_chunks protocol gives every other
@@ -593,6 +631,9 @@ class FleetScheduler:
         swaps = 0
         transient_budget = 1
         clean = 0
+        preempt_on = (self.park_store is not None
+                      and self.priority_of is not None
+                      and bucket is not None)
         while True:
             # fill freed slots first: a lane harvested last boundary (or
             # freed while the queue was empty) takes the next arrival
@@ -608,6 +649,53 @@ class FleetScheduler:
                     if rec is not None:
                         rec.rearm(lane, req.sid)
                     harvested[lane] = False
+                    swaps += 1
+            if preempt_on and not pending:
+                # queue drained: resume parked victims into free slots
+                for lane in range(batched.n):
+                    if not harvested[lane]:
+                        continue
+                    entry = self.park_store.pop(bucket.sig)
+                    if entry is None:
+                        break
+                    _tm.emit("autoscale", decision="resume",
+                             sid=entry.sid, lane=lane,
+                             bucket=bucket.label, manifest=entry.path)
+                    state = batched.resume_lane(
+                        state, lane, entry.load(), entry.param,
+                        entry.sid)
+                    if rec is not None:
+                        rec.rearm(lane, entry.sid)
+                    harvested[lane] = False
+                    swaps += 1
+            if preempt_on and pending and not any(harvested):
+                # no free slot + someone waiting: does the best pending
+                # request strictly outrank the worst active lane?
+                best = min(range(len(pending)),
+                           key=lambda i: self.priority_of(
+                               pending[i].sid))
+                active = [ln for ln in range(batched.n)
+                          if not harvested[ln]]
+                worst = max(active,
+                            key=lambda ln: self.priority_of(
+                                batched.sids[ln]))
+                if (self.priority_of(pending[best].sid)
+                        < self.priority_of(batched.sids[worst])):
+                    payload = batched.park_lane(state, worst)
+                    mpath = self.park_store.park(
+                        bucket.sig, payload["sid"], payload["param"],
+                        payload["leaves"])
+                    _tm.emit("autoscale", decision="preempt",
+                             victim=payload["sid"], lane=worst,
+                             by=pending[best].sid, bucket=bucket.label,
+                             manifest=mpath)
+                    req = pending.pop(best)
+                    _tr.mark(req.trace, "exec_start")
+                    _tr.mark(req.trace, "run_start")
+                    state = batched.swap_lane(
+                        state, worst, req.param, req.sid)
+                    if rec is not None:
+                        rec.rearm(worst, req.sid)
                     swaps += 1
             if all(harvested) and not pending:
                 extra = feed() if feed is not None else []

@@ -39,6 +39,17 @@ sliding-window error-budget burn per tenant as `slo` records + a
 status.json block, burn alerts via `warning` records, and
 fleet_class_p95_ms / slo_violations metric records into bench_trend's
 gate at stop.
+Autopilot (serving v5, ISSUE 19): with `ServeConfig.autopilot` (or the
+base .par's `tpu_autopilot`) on, fleet/autopilot.py threads a policy
+loop through this poll cycle — self-healing `shrink_resume` on rank
+death, hysteresis-banded elastic lane scaling, priority-weighted
+admission + parked-lane preemption, and an explicit degradation ladder
+— every decision an `autoscale` record (schema v9). Off (the default)
+constructs nothing: the daemon is byte-identical to the policy-less
+build, test-pinned. Independent of the knob, admission now ages
+deferred files (most-deferred first, `starving` records past
+defer_alert_polls) and status.json carries a bounded `parked_census`
+(+ the `parked_max` retention knob).
 Shutdown: a `STOP` file in the queue directory (or `max_polls` for
 smokes/CI); the daemon finishes the in-flight poll, writes the final
 status and telemetry (`serving` stop record + the
@@ -83,6 +94,28 @@ class ServeConfig:
     #                             SLO plane off)
     slo_window_s: float = 60.0  # sliding error-budget window
     slo_burn_alert: float = 2.0  # burn-rate warning threshold
+    autopilot: str = ""         # policy loop (fleet/autopilot.py):
+    #                             "off"/"" = no Autopilot — the daemon
+    #                             is byte-identical to the policy-less
+    #                             build (test-pinned); "on[:k=v,...]"
+    #                             arms heal/scale/preempt/degrade.
+    #                             Empty falls back to the base .par's
+    #                             tpu_autopilot knob.
+    priorities: str = ""        # tenant priority classes for the QoS
+    #                             plane ("zoe=high,bob=low,default=
+    #                             normal"; empty = flat — weighted
+    #                             admission and preemption both off)
+    parked_max: int = 0         # parked/ retention: keep at most this
+    #                             many parked malformed files (0 =
+    #                             unbounded); beyond it the OLDEST are
+    #                             deleted with a warning record — the
+    #                             bounded-census knob (status.json
+    #                             `parked_census` reports count +
+    #                             oldest age either way)
+    defer_alert_polls: int = 5  # an `admission` action="starving"
+    #                             record once a request has deferred
+    #                             more than this many polls (its aging
+    #                             boost is already active — see scan)
 
 
 def tenant_of(sid: str) -> str:
@@ -142,6 +175,23 @@ class FleetDaemon:
         self._accept_ts: dict[str, float] = {}
         self._trace_ids: dict[str, str | None] = {}
         self._pending_by_tenant: dict[str, int] = {}
+        # admission-starvation fix (ISSUE 19): consecutive deferral
+        # count per queue FILE -> the aging boost in scan()'s sort;
+        # _starving de-dupes the one-shot starving record per file
+        self._defer_polls: dict[str, int] = {}
+        self._starving: set[str] = set()
+        self.shed = 0
+        # the policy plane: config wins, else the base .par's knob;
+        # "off" builds NOTHING — the daemon stays byte-identical to the
+        # policy-less build (test-pinned; fleet/autopilot.py docstring)
+        mode = cfg.autopilot or (getattr(base, "tpu_autopilot", "")
+                                 if base is not None else "") or "off"
+        self.autopilot = None
+        if mode != "off":
+            from .autopilot import Autopilot
+
+            self.autopilot = Autopilot(self, mode)
+            self.sched.raise_rank_death = True
         _tm.emit("serving", event="start", queue_dir=cfg.queue_dir,
                  max_lanes=cfg.max_lanes, max_queue=cfg.max_queue,
                  tenant_quota=cfg.tenant_quota, classes=cfg.classes)
@@ -158,12 +208,62 @@ class FleetDaemon:
         except OSError:
             dest = None
         self.parked += 1
+        self._defer_polls.pop(os.path.basename(path), None)
+        self._starving.discard(os.path.basename(path))
         _tm.emit("warning", component="fleet.serve", reason="parked",
                  path=path, parked_to=dest, error=str(exc))
         _tm.emit("admission", action="park", path=path,
                  tenant=tenant_of(os.path.splitext(
                      os.path.basename(path))[0]),
                  error=str(exc))
+        self._retain_parked()
+
+    def _retain_parked(self) -> None:
+        """parked/ retention (ISSUE 19): with parked_max > 0, keep only
+        the newest parked_max files — the oldest are deleted with a
+        warning record, so a misconfigured tenant spraying malformed
+        .par files cannot fill the queue dir's disk. 0 (the default)
+        keeps the historical unbounded behavior; either way the census
+        rides status.json."""
+        cap = self.cfg.parked_max
+        if cap <= 0:
+            return
+        entries = sorted(
+            (os.path.getmtime(p), p)
+            for p in (os.path.join(self.parked_dir, f)
+                      for f in os.listdir(self.parked_dir))
+            if os.path.isfile(p))
+        for _mt, victim in entries[:-cap] if len(entries) > cap else ():
+            try:
+                os.remove(victim)
+            except OSError:
+                continue
+            _tm.emit("warning", component="fleet.serve",
+                     reason="parked_evicted", path=victim,
+                     parked_max=cap)
+
+    def _parked_census(self) -> dict:
+        """The bounded parked/ view for status.json: count + oldest age
+        (None when empty) + the retention cap — an operator sees the
+        malformed backlog without listing the directory."""
+        now = time.time()
+        oldest = None
+        count = 0
+        for f in os.listdir(self.parked_dir):
+            p = os.path.join(self.parked_dir, f)
+            if not os.path.isfile(p):
+                continue
+            count += 1
+            try:
+                age = now - os.path.getmtime(p)
+            except OSError:
+                continue
+            if oldest is None or age > oldest:
+                oldest = age
+        return {"count": count,
+                "oldest_age_s": (round(oldest, 3)
+                                 if oldest is not None else None),
+                "max": self.cfg.parked_max}
 
     def scan(self) -> list:
         """One admission pass over the queue directory. Returns the
@@ -175,14 +275,28 @@ class FleetDaemon:
             for f in os.listdir(self.cfg.queue_dir)
             if f.endswith(".par")
             and os.path.isfile(os.path.join(self.cfg.queue_dir, f)))
+        if self._defer_polls:
+            # starvation fix: a deferred file's retry outranks newer
+            # arrivals — most-deferred first, name-order tiebreak. With
+            # zero deferrals outstanding (every key popped on accept/
+            # park) this IS the historical sorted order.
+            files.sort(key=lambda p: (
+                -self._defer_polls.get(os.path.basename(p), 0), p))
         self.queue_depth = len(files)
         self.queue_depth_max = max(self.queue_depth_max,
                                    self.queue_depth)
         accepted: list[_q.ScenarioRequest] = []
         deferred_now = 0
         for path in files:
-            sid = os.path.splitext(os.path.basename(path))[0]
+            fname = os.path.basename(path)
+            sid = os.path.splitext(fname)[0]
             tenant = tenant_of(sid)
+            if self.autopilot is not None \
+                    and self.autopilot.should_shed(tenant):
+                # rung 3: lowest-priority tenants are refused outright
+                # (an explicit, recorded degradation — not a deferral)
+                self._shed(path, sid, tenant)
+                continue
             # _pending_by_tenant already counts this scan's accepts
             # (incremented on each accept below)
             if sum(self._pending_by_tenant.values()) \
@@ -190,13 +304,19 @@ class FleetDaemon:
                 deferred_now += 1
                 _tm.emit("admission", action="defer", sid=sid,
                          tenant=tenant, reason="queue_cap",
-                         queue_depth=self.queue_depth)
+                         queue_depth=self.queue_depth,
+                         deferrals=self._note_defer(fname, sid, tenant,
+                                                    "queue_cap"))
                 continue
-            if self._pending_by_tenant.get(tenant, 0) \
-                    >= self.cfg.tenant_quota:
+            quota = (self.autopilot.quota_for(tenant)
+                     if self.autopilot is not None
+                     else self.cfg.tenant_quota)
+            if self._pending_by_tenant.get(tenant, 0) >= quota:
                 deferred_now += 1
                 _tm.emit("admission", action="defer", sid=sid,
-                         tenant=tenant, reason="tenant_quota")
+                         tenant=tenant, reason="tenant_quota",
+                         deferrals=self._note_defer(fname, sid, tenant,
+                                                    "tenant_quota"))
                 continue
             reqs = _q.load_queue([path], self.base,
                                  on_error=self._park)
@@ -209,9 +329,13 @@ class FleetDaemon:
             trace = _tr.mint(sid, tenant=tenant)
             req = _q.ScenarioRequest(sid=sid, param=req.param,
                                      trace=trace)
-            self._trace_ids[sid] = trace
-            os.replace(path, os.path.join(self.accepted_dir,
-                                          os.path.basename(path)))
+            if self.autopilot is not None:
+                # rung-2 degradation: cap the pressure-solve budget
+                req = self.autopilot.admit(req)
+            self._trace_ids[sid] = req.trace
+            os.replace(path, os.path.join(self.accepted_dir, fname))
+            self._defer_polls.pop(fname, None)
+            self._starving.discard(fname)
             self._accept_ts[sid] = time.time()
             self._pending_by_tenant[tenant] = \
                 self._pending_by_tenant.get(tenant, 0) + 1
@@ -221,6 +345,43 @@ class FleetDaemon:
         self.deferred += deferred_now
         return accepted
 
+    def _note_defer(self, fname: str, sid: str, tenant: str,
+                    reason: str) -> int:
+        """Count a deferral for the aging boost; past defer_alert_polls
+        the file earns ONE `admission` action="starving" record (cleared
+        when it finally admits — a later starvation re-alerts)."""
+        n = self._defer_polls.get(fname, 0) + 1
+        self._defer_polls[fname] = n
+        if (n > self.cfg.defer_alert_polls
+                and fname not in self._starving):
+            self._starving.add(fname)
+            _tm.emit("admission", action="starving", sid=sid,
+                     tenant=tenant, reason=reason, deferrals=n,
+                     boost_active=True)
+        return n
+
+    def _shed(self, path: str, sid: str, tenant: str) -> None:
+        """Rung-3 admission shedding: the request is refused NOW with a
+        structured failure result (the tenant sees a decision, not a
+        silent stall) and the queue file removed."""
+        self.shed += 1
+        self.failed += 1
+        self._defer_polls.pop(os.path.basename(path), None)
+        self._starving.discard(os.path.basename(path))
+        self.metrics.counter("fleet_shed_total", tenant=tenant).inc()
+        _tm.emit("admission", action="shed", sid=sid, tenant=tenant,
+                 rung=self.autopilot.rung)
+        with open(os.path.join(self.results_dir,
+                               f"{sid}.json"), "w") as fh:
+            json.dump({"sid": sid, "tenant": tenant, "failed": True,
+                       "shed": True,
+                       "error": "shed: degraded fleet is refusing "
+                                "lowest-priority admissions"}, fh)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
     # -- serving --------------------------------------------------------
     def serve(self, requests) -> None:
         for req in requests:
@@ -229,6 +390,18 @@ class FleetDaemon:
         try:
             result = self.sched.run()
         except Exception as exc:  # lint: allow(broad-except) — serving isolation: one tenant's bad knob combo (e.g. a forced-mesh bucket with indivisible lanes) must degrade to failed requests, never kill the daemon serving every other tenant
+            if self.autopilot is not None:
+                from ..parallel.coordinator import RankDeadError
+
+                if isinstance(exc, RankDeadError):
+                    # self-healing (fleet/autopilot.py): the death
+                    # becomes shrink_resume onto survivor capacity and
+                    # the poll's requests go BACK in the queue — they
+                    # retry next poll on the healed fleet instead of
+                    # failing to the tenants
+                    self.autopilot.heal(exc)
+                    self._requeue(requests)
+                    return
             self._fail_batch(requests, exc)
             return
         wall = time.perf_counter() - t0
@@ -295,6 +468,27 @@ class FleetDaemon:
         self.scenarios_per_s = (round(len(result.scenarios) / wall, 4)
                                 if wall > 0 else None)
 
+    def _requeue(self, requests) -> None:
+        """Put a poll's accepted-but-unserved requests back in the
+        queue (the heal path): accounting released, accepted/ files
+        moved home, traces finished as requeued — next poll re-admits
+        them onto the healed fleet."""
+        for req in requests:
+            tenant = tenant_of(req.sid)
+            self._pending_by_tenant[tenant] = max(
+                0, self._pending_by_tenant.get(tenant, 0) - 1)
+            self._accept_ts.pop(req.sid, None)
+            _tr.finish(self._trace_ids.pop(req.sid, None),
+                       status="requeued")
+            src = os.path.join(self.accepted_dir, f"{req.sid}.par")
+            dst = os.path.join(self.cfg.queue_dir, f"{req.sid}.par")
+            try:
+                os.replace(src, dst)
+            except OSError:
+                continue  # already gone: the request is simply dropped
+            _tm.emit("admission", action="requeue", sid=req.sid,
+                     tenant=tenant, reason="heal")
+
     def _fail_batch(self, requests, exc) -> None:
         """Scheduling failed for this poll's accepted set: release the
         pending accounting, write per-scenario error results, and keep
@@ -349,8 +543,13 @@ class FleetDaemon:
             "scenarios_per_s": self.scenarios_per_s,
             "updated": round(time.time(), 3),
         }
+        st["parked_census"] = self._parked_census()
+        if self.shed:
+            st["shed"] = self.shed
         if self.slo.targets:
             st["slo"] = self._slo_block
+        if self.autopilot is not None:
+            st["autopilot"] = self.autopilot.status_block()
         return st
 
     def write_status(self) -> dict:
@@ -371,6 +570,11 @@ class FleetDaemon:
 
     def poll_once(self) -> dict:
         self.polls += 1
+        if self.autopilot is not None:
+            # daemon-plane fault clauses (dead/burst/slow_lane@poll)
+            # land BEFORE the scan: a heal reshapes capacity for this
+            # poll's admissions, a burst is visible to this poll's tick
+            self.autopilot.pre_poll(time.time())
         accepted = self.scan()
         if accepted:
             self.serve(accepted)
@@ -380,6 +584,10 @@ class FleetDaemon:
             # per-tenant slo records + edge-triggered burn warnings;
             # the returned block rides the status endpoint
             self._slo_block = self.slo.poll(time.time())
+        if self.autopilot is not None:
+            # observe→decide→act, exactly one autoscale record; the
+            # status write below publishes the post-decision state
+            self.autopilot.tick(time.time())
         st = self.write_status()
         # one cumulative registry snapshot per poll — the `metrics`
         # record plane telemetry_report.metrics_summary folds
@@ -417,6 +625,10 @@ class FleetDaemon:
             _tm.emit("metric", metric="slo_violations",
                      value=self.slo.total_violations(),
                      unit="requests", backend=backend)
+        if self.autopilot is not None:
+            # autoscale_flaps / autoscale_time_to_recover_ms /
+            # autoscale_transitions — the policy plane's own gate series
+            self.autopilot.emit_stop_metrics(backend)
         self.metrics.emit_snapshot(event="stop")
         _tm.emit("serving", event="stop",
                  # the daemon's own percentiles ride the stop record so

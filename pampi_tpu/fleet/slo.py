@@ -19,7 +19,7 @@ without an explicit entry; tenants without a target (no default either)
 are observed into histograms but carry no SLO accounting.
 
 The daemon calls `observe()` per served request and `poll()` per status
-poll; `poll()` emits one `slo` telemetry record per tenant (schema v8)
+poll; `poll()` emits one `slo` telemetry record per tenant (schema v9)
 and returns the `status.json` block. Burn beyond `burn_alert` raises a
 `warning` record (component="slo") — EDGE-triggered: one warning when a
 tenant's burn crosses the threshold, re-armed when it drops back under,
@@ -110,6 +110,35 @@ class SloTracker:
             self.violations_total[tenant] = \
                 self.violations_total.get(tenant, 0) + 1
         return violated
+
+    def burn_snapshot(self, now: float) -> dict[str, float]:
+        """Every tracked tenant's current burn rate — the autopilot's
+        per-poll policy input (fleet/autopilot.py). Tenants with no
+        windowed requests are omitted (their burn is undefined, not
+        zero)."""
+        out: dict[str, float] = {}
+        for tenant in sorted(self._window):
+            burn = self.burn_rate(tenant, now)
+            if burn is not None:
+                out[tenant] = burn
+        return out
+
+    def inject_synthetic(self, tenant: str, count: int, now: float,
+                         factor: float = 10.0) -> int:
+        """TEST-ONLY synthetic burn — the payload of the PAMPI_FAULTS
+        `burst@poll<N>:<tenant>*<count>` clause (utils/faultinject.py):
+        `count` violating observations at `factor`x the tenant's target
+        land in the sliding window, so the hysteresis plane gets
+        deterministic fuel without timing a real overload. Returns the
+        number injected (0 when the tenant carries no target — a burst
+        aimed at an untracked tenant is inert, same as a real slow
+        request would be)."""
+        target = self.target_for(tenant)
+        if target is None:
+            return 0
+        for _ in range(int(count)):
+            self.observe(tenant, target * factor, now)
+        return int(count)
 
     def burn_rate(self, tenant: str, now: float) -> float | None:
         """The window's budget-burn rate; None when the tenant has no

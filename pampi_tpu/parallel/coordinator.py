@@ -76,6 +76,15 @@ explicit boundary state machine):
 
 Every global decision is a flight-recorder line: telemetry `coord`
 records (schema v5), emitted once per decision from rank 0.
+
+CONSUMERS of the RankDeadError verdict: besides the CLI surfaces
+(tools/serve_elastic.py-style operator flows and the test harnesses),
+the serving daemon's autopilot (fleet/autopilot.py, PR 19) subscribes
+to it as a POLICY INPUT — a verdict raised by a resident elastic job is
+turned into an automatic `shrink_resume` onto survivor capacity (fault
+ledger carried through the elastic manifest), no operator in the loop.
+The protocol's guarantee that every survivor raises the IDENTICAL
+structured verdict is what makes that safe to automate.
 """
 
 from __future__ import annotations

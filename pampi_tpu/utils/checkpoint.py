@@ -700,3 +700,55 @@ def load_any(path: str, solver, fallback: bool = True) -> None:
         load_elastic(path, solver, fallback=fallback)
     else:
         load_checkpoint(path, solver, fallback=fallback)
+
+
+# ---------------------------------------------------------------------------
+# Parked continuous-batching lanes (QoS preemption, fleet/autopilot.py)
+# ---------------------------------------------------------------------------
+
+PARKED_LANE_VERSION = 1
+
+
+def save_parked_lane(path: str, sid: str, leaves) -> None:
+    """Park one continuous-batching lane's full per-lane carry — every
+    stacked leaf below the batch scalars: fields, the per-lane t/nt, the
+    per-lane te — under the elastic-manifest write discipline (CRC32 per
+    leaf, write to `.tmp`, atomic rename). The autopilot's preemption
+    plane writes one of these when a higher-priority tenant evicts a
+    running lane; `load_parked_lane` + `BatchedSolver.resume_lane`
+    splice the arrays back and the lane continues from the exact chunk
+    boundary it was parked at — bitwise, the same proof `shrink_resume`
+    carries for whole meshes."""
+    data = {"version": np.int64(PARKED_LANE_VERSION),
+            "sid": np.asarray(sid),
+            "n_leaves": np.int64(len(leaves))}
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        data[f"leaf_{i}"] = arr
+        data[f"crc_{i}"] = np.uint32(_crc(arr))
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **data)
+    os.replace(tmp, path)
+    _tm.emit("ckpt", event="lane_park", path=path, sid=sid,
+             leaves=len(leaves))
+
+
+def load_parked_lane(path: str) -> list:
+    """Read a parked lane back: per-leaf CRC verified (a corrupt park
+    file must refuse loudly — resuming a half-true lane state would
+    poison its batchmates' bitwise story), returns the leaf arrays in
+    stack order."""
+    with np.load(path) as z:
+        n = int(z["n_leaves"])
+        out = []
+        for i in range(n):
+            arr = z[f"leaf_{i}"]
+            if _crc(arr) != int(z[f"crc_{i}"]):
+                raise CheckpointCorruptError(
+                    f"parked lane {path}: leaf {i} fails its CRC32"
+                )
+            out.append(arr)
+        sid = str(z["sid"])
+    _tm.emit("ckpt", event="lane_resume", path=path, sid=sid)
+    return out

@@ -70,6 +70,29 @@ protocol never coordinates (lane/write/emit) refuse the suffix loudly.
   telemetry@emit<N>       OSError on the Nth telemetry record write
                           (exercises the warn-once stand-down)
 
+Daemon-plane clauses (PR 19) fire at the serving daemon's poll cycle —
+the autopilot's `pre_poll` hook (fleet/autopilot.py) bumps the `poll`
+counter once per `poll_once` and consumes whatever is armed; with the
+autopilot off the hook is never called and the clauses stay inert:
+
+  dead@poll<N>            the resident elastic job's rank dies at the
+                          daemon's Nth poll (raises InjectedRankDeath
+                          from the hook): the autopilot — not an
+                          operator — must turn it into `shrink_resume`
+                          onto survivor capacity, fault ledger carried.
+  burst@poll<N>:<tenant>*<count>
+                          synthetic SLO burn: <count> violating
+                          observations (10x the tenant's target) folded
+                          into the tenant's sliding window at poll N —
+                          the hysteresis-banded grow/degrade plane's
+                          deterministic fuel. The :<field> slot carries
+                          the TENANT name here; *<count> is the
+                          observation count (default 1), not a re-arm.
+  slow_lane@poll<N>:<tenant>*<count>
+                          same injection shape, but folded into the
+                          per-class latency histograms as well — the
+                          per-class-p95 policy input moves too.
+
 Field-corruption clauses (`nan`/`inf`) are consumed by SOLVER GENERATIONS
 (one take in __init__, one per recovery `_rebuild_chunk` — a pallas->jnp
 fallback rebuild keeps the current generation): each clause arms `count`
@@ -90,18 +113,25 @@ _FIELDS = ("u", "v", "w", "p")
 _KIND_SITE = {
     "pallas": ("chunk",),
     "transient": ("chunk",),
-    "dead": ("chunk",),
+    "dead": ("chunk", "poll"),
     "hang": ("chunk",),
     "nan": ("step", "lane"),
     "inf": ("step", "lane"),
     "ckpt_torn": ("write",),
     "ckpt_corrupt": ("write",),
     "telemetry": ("emit",),
+    "burst": ("poll",),
+    "slow_lane": ("poll",),
 }
 
+# the :<field> slot is a solver field (single letter) for nan/inf and a
+# TENANT name for the daemon-plane burst/slow_lane clauses, so the group
+# is a word, not a char; per-kind validation below keeps nan/inf pinned
+# to u|v|w|p exactly as before
 _CLAUSE_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@(?P<site>[a-z]+)(?P<n>\d+)"
-    r"(?::(?P<field>[a-z]))?(?:@rank(?P<rank>\d+))?(?:\*(?P<count>\d+))?$"
+    r"(?::(?P<field>[a-z][a-z0-9_]*))?(?:@rank(?P<rank>\d+))?"
+    r"(?:\*(?P<count>\d+))?$"
 )
 
 # the sites a rank-targeted clause makes sense at: host-side chunk
@@ -264,7 +294,9 @@ def _clauses() -> tuple:
                 "hang@chunk<N> | nan@step<N>:<field> "
                 "| inf@step<N>:<field> | nan@lane<K>:<field> | "
                 "inf@lane<K>:<field> | ckpt_torn@write<N> | "
-                "ckpt_corrupt@write<N> | telemetry@emit<N>  (comma-separated;"
+                "ckpt_corrupt@write<N> | telemetry@emit<N> | dead@poll<N> | "
+                "burst@poll<N>:<tenant>*<count> | "
+                "slow_lane@poll<N>:<tenant>*<count>  (comma-separated;"
                 " chunk/step clauses take an optional @rank<R> target, "
                 "field faults an optional *<count> re-arm suffix)"
             )
@@ -275,9 +307,18 @@ def _clauses() -> tuple:
                     f"PAMPI_FAULTS clause {raw!r}: field must be one of "
                     f"{'|'.join(_FIELDS)}"
                 )
+        elif m["kind"] in ("burst", "slow_lane"):
+            # the :<field> slot carries the target TENANT for the
+            # daemon-plane burn injections — required, any word
+            if field is None:
+                raise FaultSpecError(
+                    f"PAMPI_FAULTS clause {raw!r}: burst/slow_lane need a "
+                    ":<tenant> target"
+                )
         elif field is not None:
             raise FaultSpecError(
                 f"PAMPI_FAULTS clause {raw!r}: only nan/inf take a :<field>"
+                " (and burst/slow_lane a :<tenant>)"
             )
         rank = m["rank"]
         if rank is not None and m["site"] not in _RANKABLE_SITES:
@@ -342,6 +383,33 @@ def maybe_chunk_fault() -> None:
             f"UNAVAILABLE: PAMPI_FAULTS injected transient device fault at "
             f"chunk dispatch {n}"
         )
+
+
+def poll_faults() -> tuple:
+    """Called by the serving autopilot once per daemon poll (1-based;
+    fleet/autopilot.py `pre_poll` — with the autopilot off nothing bumps
+    this counter and daemon-plane clauses stay inert). A `dead@poll<N>`
+    armed for this poll raises InjectedRankDeath — the autopilot is the
+    structured consumer here, the same role the lockstep watchdog
+    collector plays for `dead@chunk` (which is why it may catch the
+    BaseException: it turns the death into a membership verdict +
+    `shrink_resume`, never misclassifies it as transient). Burn clauses
+    return (kind, tenant, count) tuples for this poll, kind in
+    {"burst", "slow_lane"}."""
+    if not enabled():
+        return ()
+    n = _bump("poll")
+    out = []
+    for kind, site, when, field, count, _r in _clauses():
+        if site != "poll" or when != n:
+            continue
+        if kind == "dead":
+            raise InjectedRankDeath(
+                f"PAMPI_FAULTS: resident rank injected dead at daemon "
+                f"poll {n}"
+            )
+        out.append((kind, field, count))
+    return tuple(out)
 
 
 def ckpt_write_faults() -> frozenset:

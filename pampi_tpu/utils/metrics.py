@@ -23,7 +23,7 @@ Design:
   exact sorted-list computation to within half a bucket (<5% relative,
   test-pinned in tests/test_metrics.py).
 - `snapshot()` is a plain-JSON dict; `emit_snapshot()` writes it as one
-  `metrics` telemetry record (schema v8) tagged with a per-process
+  `metrics` telemetry record (schema v9) tagged with a per-process
   source id + sequence number, so `tools/telemetry_report.
   metrics_summary` can take the LAST snapshot per process and fold
   across processes (cumulative snapshots from one process must never be

@@ -343,6 +343,22 @@ class Parameter:
     # instead (an in-place process-group shrink would need a re-elected
     # coordinator; see cli._resume_after_death).
     tpu_dead_resume: int = 1
+    # serving autopilot (fleet/autopilot.py, ISSUE 19): the policy loop
+    # that closes observe->decide->act inside the daemon's poll cycle —
+    # "off" (default: the daemon is byte-identical to the policy-less
+    # build, test-pinned) or "on[:k=v,...]" with hysteresis overrides
+    # (burn_high/burn_low/backlog_high/sustain/cooldown/min_lanes/
+    # max_lanes/idle_polls/itermax_cap/flap_window — see
+    # fleet/autopilot.parse_autopilot_spec). On: a RankDeadError from the
+    # resident elastic job auto-`shrink_resume`s onto survivor capacity
+    # (ledger carried), sustained SLO burn/backlog grows the lane pool
+    # (checkpoint-fenced via the elastic manifest), sustained idle
+    # shrinks it, and past capacity the daemon steps down the explicit
+    # degradation ladder (class-lane consolidation -> itermax caps ->
+    # lowest-priority admission shedding), back up when burn recovers.
+    # Every decision is an `autoscale` telemetry record. A HOUSEKEEPING
+    # key: never part of the bucket signature or traced programs.
+    tpu_autopilot: str = "off"
     # divergence rollback-recovery (models/_driver.RingRecovery; README
     # "Robustness"): tpu_recover_ring > 0 arms an in-memory ring of the
     # last-K confirmed finite chunk states (no disk round-trip on the hot

@@ -81,6 +81,14 @@ Host plane — every record is one JSON line appended to the
               target p95, window requests/violations, error-budget burn
               rate — burn beyond the alert threshold additionally emits
               a `warning` record
+  autoscale   one autopilot decision (fleet/autopilot.py, schema v9):
+              decision (hold/grow/shrink/degrade/recover/heal/preempt/
+              resume/shed/inject/resident), degradation rung + name,
+              lane/capacity counts, the policy INPUTS that drove it
+              (burn_max, queue depth, backlog trend, worst class p95)
+              and the live hysteresis state (above/below/cooldown_left)
+              — one per daemon poll minimum (hold included), so the
+              flight record replays the whole observe→decide→act loop
   fleet       one fleet run's summary (pampi_tpu/fleet/scheduler.py):
               per-bucket mode/compile-vs-run walls, scenarios/s
               throughput, and the divergence census — the block
@@ -105,10 +113,15 @@ import os
 import time
 import warnings
 
-SCHEMA_VERSION = 8  # v8: + metrics / slo / trace record kinds (the
-#                     serving-plane observability layer: registry
-#                     snapshots, tenant SLO burn, parented request spans)
-#                     (v7, PR 13: + serving / admission / latency / swap
+SCHEMA_VERSION = 9  # v9: + autoscale record kind (the fleet autopilot's
+#                     observe→decide→act loop: every policy decision
+#                     with its inputs and hysteresis state), ckpt
+#                     lane_park / lane_resume / fence events
+#                     (v8: + metrics / slo / trace record kinds (the
+#                      serving-plane observability layer: registry
+#                      snapshots, tenant SLO burn, parented request
+#                      spans);
+#                      v7, PR 13: + serving / admission / latency / swap
 #                      record kinds (the persistent fleet daemon);
 #                      v6, PR 12: + dead / epoch / shrink record kinds,
 #                      ckpt ledger_save / ledger_restore events;

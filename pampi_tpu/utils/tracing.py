@@ -1,6 +1,6 @@
 """Request-lifecycle tracing: one trace id minted at admission, marked
 at each serving-plane boundary, emitted at completion as PARENTED
-`trace` records (schema v8) — the per-request waterfall behind
+`trace` records (schema v9) — the per-request waterfall behind
 `tools/telemetry_report.py`'s latency decomposition.
 
 Why marks + one flush instead of live span records: a request crosses

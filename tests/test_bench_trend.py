@@ -181,3 +181,41 @@ def test_comm_hidden_fraction_normalized_from_block(tmp_path):
     # a null hidden fraction (attribution failure) yields no point
     rec["comm_hidden_fraction"]["hidden_fraction"] = None
     assert collect_metrics(rec) == []
+
+
+def test_autoscale_directions(tmp_path):
+    """The control-plane health lines gate DOWNWARD by name (ISSUE 19):
+    a longer time-to-recover or more capacity flaps under the same
+    chaos script is a policy regression, whatever the unit says."""
+    assert bt.higher_is_better(
+        "ms", "autoscale_time_to_recover_ms") is False
+    assert bt.higher_is_better("bananas", "autoscale_flaps") is False
+    pt = dict(name="autoscale_time_to_recover_ms", unit="ms",
+              backend="cpu")
+    files = [_art(tmp_path, 1, [dict(pt, value=4000.0)]),
+             _art(tmp_path, 2, [dict(pt, value=9000.0)])]
+    errs = bt.lint(files, tolerance=0.35)
+    assert len(errs) == 1 and "autoscale_time_to_recover_ms" in errs[0]
+    assert bt.lint([_art(tmp_path, 1, [dict(pt, value=4000.0)]),
+                    _art(tmp_path, 2, [dict(pt, value=4100.0)])],
+                   tolerance=0.35) == []
+
+
+def test_autoscale_normalized_from_block(tmp_path):
+    """collect_metrics surfaces the merged autoscale block's flap count
+    and recovery latency as normalized, backend-tagged trend points."""
+    from tools._artifact import collect_metrics
+
+    rec = {"autoscale": {"records": 25, "flaps": 0,
+                         "time_to_recover_ms": 4204.7},
+           "telemetry_summary": {"backend": "cpu"}}
+    pts = {m["name"]: m for m in collect_metrics(rec)}
+    assert pts["autoscale_flaps"]["value"] == 0
+    assert pts["autoscale_flaps"]["backend"] == "cpu"
+    assert pts["autoscale_time_to_recover_ms"]["value"] == 4204.7
+    assert pts["autoscale_time_to_recover_ms"]["unit"] == "ms"
+    # an unfinished storm (no recovery) yields no latency point
+    rec["autoscale"]["time_to_recover_ms"] = None
+    names = [m["name"] for m in collect_metrics(rec)]
+    assert "autoscale_time_to_recover_ms" not in names \
+        and "autoscale_flaps" in names

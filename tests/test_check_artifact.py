@@ -135,3 +135,45 @@ def test_lint_dispatch_snapshot_overlap_keys():
     assert "overlap_ns2d_dist" in d["tail"] \
         and "overlap_ns3d_dist" in d["tail"]
     assert ca.lint_multichip(d, "MULTICHIP_r06") == []
+
+
+def test_lint_autoscale_block():
+    """The autopilot decision block (ISSUE 19): the decision tally, the
+    ordered transition log and the final posture must all ride the
+    block; a transition that cannot say what it decided is noise."""
+    good = {"records": 25, "decisions": {"hold": 20, "grow": 1},
+            "transitions": [{"decision": "grow", "poll": 7}],
+            "final": {"rung": 0, "lanes": 3}}
+    assert ca.lint_autoscale(good, "A") == []
+    errs = ca.lint_autoscale({"records": 1}, "A")
+    assert any("decisions" in e for e in errs) \
+        and any("final" in e for e in errs)
+    bad = dict(good, decisions={"grow": -1})
+    assert any("non-negative" in e for e in ca.lint_autoscale(bad, "A"))
+    bad = dict(good, transitions=[{"poll": 7}])
+    assert any("missing decision" in e
+               for e in ca.lint_autoscale(bad, "A"))
+    bad = dict(good, final={"rung": 0})
+    assert any("final" in e and "lanes" in e
+               for e in ca.lint_autoscale(bad, "A"))
+
+
+def test_lint_chaos_trajectory_block():
+    """The chaos recovery trajectory: monotone poll axis, equal-length
+    series, and a ladder that moves AT MOST one rung per sample — a
+    ladder that jumps rungs is not a ladder."""
+    good = {"poll": [1, 2, 3, 4], "rung": [0, 1, 2, 1],
+            "lanes": [2, 2, 3, 3], "burn_max": [0.0, 5.0, 9.0, 2.0]}
+    assert ca.lint_chaos_trajectory(good, "C") == []
+    bad = dict(good, poll=[1, 3, 2, 4])
+    assert any("monotone" in e
+               for e in ca.lint_chaos_trajectory(bad, "C"))
+    bad = dict(good, lanes=[2, 2, 3])
+    assert any("length" in e
+               for e in ca.lint_chaos_trajectory(bad, "C"))
+    bad = dict(good, rung=[0, 2, 2, 1])
+    assert any("more than one rung" in e
+               for e in ca.lint_chaos_trajectory(bad, "C"))
+    bad = dict(good, rung=[0, 1, 0, -1])
+    assert any("negative rung" in e
+               for e in ca.lint_chaos_trajectory(bad, "C"))

@@ -114,6 +114,50 @@ def test_dead_hang_grammar(faults):
             fi._clauses()
 
 
+def test_poll_clause_grammar(faults):
+    """ISSUE 19: the daemon-plane clauses parse — `dead@poll<N>` bare,
+    `burst`/`slow_lane` with a REQUIRED :<tenant> (the :<field> slot
+    repurposed as a word) and *<count> as an observation count. The
+    poll site is uncoordinated, so @rank<R> is refused; dead takes no
+    payload."""
+    faults("dead@poll3,burst@poll5:alice*50,slow_lane@poll2:bob")
+    assert fi._clauses() == (
+        ("dead", "poll", 3, None, 1, None),
+        ("burst", "poll", 5, "alice", 50, None),
+        ("slow_lane", "poll", 2, "bob", 1, None),
+    )
+    for bad in ("burst@poll2", "slow_lane@poll1", "dead@poll2:alice",
+                "burst@poll2:alice@rank1", "dead@poll2@rank0",
+                "burst@chunk2:alice"):
+        faults(bad)
+        with pytest.raises(fi.FaultSpecError, match="PAMPI_FAULTS"):
+            fi._clauses()
+
+
+def test_poll_faults_fire_and_stay_inert_unpolled(faults):
+    """poll_faults() is 1-based and per-poll: burn clauses return their
+    (kind, tenant, count) tuples exactly at their poll, `dead` raises
+    InjectedRankDeath (a BaseException — the autopilot is its one
+    structured consumer), and a counter reset re-arms the timeline.
+    Solver-plane hooks never consult poll clauses: building and running
+    a solver with only poll clauses armed injects nothing."""
+    faults("burst@poll1:alice*3,slow_lane@poll2:bob,dead@poll3")
+    assert fi.poll_faults() == (("burst", "alice", 3),)
+    assert fi.poll_faults() == (("slow_lane", "bob", 1),)
+    with pytest.raises(fi.InjectedRankDeath, match="poll 3"):
+        fi.poll_faults()
+    assert fi.poll_faults() == ()  # poll 4: timeline passed
+    fi.reset()
+    assert fi.poll_faults() == (("burst", "alice", 3),)  # re-armed
+
+    faults("dead@poll1,burst@poll1:alice*9")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = NS2DSolver(Parameter(**{**_BASE, "te": 0.02, "itermax": 8}))
+        s.run(progress=False)  # chunk/step hooks ignore poll clauses
+    assert np.isfinite(np.asarray(s.p)).all()
+
+
 def test_dead_rank_uncoordinated_is_loud_not_classified(faults):
     """A death injected into the UNCOORDINATED single-controller loop
     surfaces as InjectedRankDeath (a BaseException — the drive loop's

@@ -138,6 +138,73 @@ def test_snapshot_fold_and_roundtrip():
     assert twice["counters"][0]["value"] == 6
 
 
+def _snap_record(source: str, seq: int, served: int, depth: float):
+    """One telemetry `metrics` record as the daemon emits it: a
+    CUMULATIVE registry snapshot stamped with its (source, seq)
+    lineage — the fold key telemetry_report.metrics_summary dedups on."""
+    r = mx.Registry()
+    r.counter("served").inc(served)
+    r.gauge("depth").set(depth)
+    return {"kind": "metrics", "source": source, "seq": seq,
+            **r.snapshot()}
+
+
+def test_metrics_fold_out_of_order_seq_last_per_source_wins():
+    """The artifact fold takes the HIGHEST-seq snapshot per source even
+    when records land out of order (a multi-rank merge file has no
+    ordering guarantee): snapshots are cumulative, so folding any
+    earlier one would double-count or under-count."""
+    from tools import telemetry_report as tr
+
+    records = [
+        _snap_record("h1:100", 3, served=9, depth=2.0),   # newest first
+        _snap_record("h1:100", 1, served=3, depth=7.0),
+        _snap_record("h1:100", 2, served=6, depth=1.0),
+    ]
+    out = tr.metrics_summary(records)
+    assert out["sources"] == 1
+    # the seq-3 snapshot alone — not a sum across the cumulative series
+    assert out["counters"]["served"] == 9
+    assert out["gauges"]["depth"] == 2.0
+
+
+def test_metrics_fold_duplicate_pid_seq_last_in_file_wins():
+    """A replayed/duplicated (source, seq) pair must not double-count:
+    the fold keeps exactly one snapshot per source, and on an exact
+    (source, seq) tie the LAST record in the file wins (the `>=` in the
+    fold — a rewritten snapshot supersedes its earlier flush)."""
+    from tools import telemetry_report as tr
+
+    records = [
+        _snap_record("h1:100", 2, served=5, depth=4.0),
+        _snap_record("h1:100", 2, served=7, depth=3.0),  # rewrite, wins
+    ]
+    out = tr.metrics_summary(records)
+    assert out["sources"] == 1
+    assert out["counters"]["served"] == 7
+    assert out["gauges"]["depth"] == 3.0
+
+
+def test_metrics_fold_across_sources_sums_last_snapshots_only():
+    """Interleaved out-of-order arrivals from TWO sources: the fold
+    merges across sources (counters sum, gauges max) but within each
+    source only the newest snapshot contributes."""
+    from tools import telemetry_report as tr
+
+    records = [
+        _snap_record("h1:100", 2, served=4, depth=1.0),
+        _snap_record("h2:200", 1, served=10, depth=6.0),
+        _snap_record("h1:100", 1, served=2, depth=9.0),   # stale, late
+        _snap_record("h2:200", 2, served=11, depth=2.0),
+    ]
+    out = tr.metrics_summary(records)
+    assert out["sources"] == 2
+    assert out["counters"]["served"] == 4 + 11
+    # max of the two LAST gauges (1.0, 2.0) — the stale seq-1 peak of
+    # 9.0/6.0 must not leak into the fold
+    assert out["gauges"]["depth"] == 2.0
+
+
 def test_prometheus_render_golden():
     r = mx.Registry()
     r.counter("fleet_served_total", tenant="alice").inc(3)

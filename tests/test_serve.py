@@ -23,7 +23,7 @@ Contracts pinned here:
 - the daemon: file-queue intake, admission + per-tenant accounting,
   malformed .par PARKED with a structured warning (the hardened
   load_queue path), live status endpoint, serving telemetry (schema
-  v8) through report/merge/lint — plus the ISSUE 18 observability
+  v9) through report/merge/lint — plus the ISSUE 18 observability
   plane: shape-class rung signatures, tenant SLO burn accounting
   (window edges, edge-triggered alerts), and the daemon's request
   traces / registry histograms / slo block end to end.

@@ -122,6 +122,24 @@ def collect_metrics(rec: dict) -> list[dict]:
                 "unit": "ms",
                 "backend": "tpu" if run_backend == "tpu" else "cpu",
             })
+    ap = rec.get("autoscale")
+    if isinstance(ap, dict):
+        # the autopilot headlines (ISSUE 19, fleet/autopilot.py): flap
+        # count and worst breach→full-service recovery time — both
+        # lower-is-better by name (bench_trend NAME_DIRECTIONS); the
+        # summary folds them off the daemon's stop metrics
+        for name, key, unit in (
+                ("autoscale_flaps", "flaps", "transitions"),
+                ("autoscale_time_to_recover_ms", "time_to_recover_ms",
+                 "ms")):
+            if isinstance(ap.get(key), (int, float)) \
+                    and name not in seen:
+                out.append({
+                    "name": name,
+                    "value": ap[key],
+                    "unit": unit,
+                    "backend": "tpu" if run_backend == "tpu" else "cpu",
+                })
     slo = rec.get("slo")
     if isinstance(slo, dict) and "slo_violations" not in seen:
         # lifetime violation count across tenants (fleet/slo.py);

@@ -166,7 +166,17 @@ NAME_DIRECTIONS = {"comm_hidden_fraction": True,
                    # lifetime SLO violation count (fleet/slo.py); both
                    # lower-is-better
                    "fleet_class_p95_ms": False,
-                   "slo_violations": False}
+                   "slo_violations": False,
+                   # the autopilot control plane (ISSUE 19,
+                   # fleet/autopilot.py): time from the first hysteresis
+                   # breach back to full service (rung 0, calm sustained)
+                   # — the headline the chaos harness measures; and the
+                   # flap count (opposite-direction capacity moves inside
+                   # the flap window), whose ideal is zero — a rising
+                   # flap count means the hysteresis band stopped doing
+                   # its job. Both lower-is-better
+                   "autoscale_time_to_recover_ms": False,
+                   "autoscale_flaps": False}
 
 
 def higher_is_better(unit, name: str | None = None) -> bool | None:

@@ -1,0 +1,381 @@
+"""Autopilot chaos smoke (`make chaos-smoke`): kill -> auto-shrink ->
+burn-driven regrow -> degrade ladder -> shed -> preempt -> bitwise
+resume, on one CPU, in minutes (ISSUE 19's proof harness).
+
+    python tools/chaos_smoke.py [outdir] [--artifact PATH] [--round N]
+
+The harness arms the daemon-plane fault clauses
+(`dead@poll3,burst@poll5..12:alice*50` — utils/faultinject.poll_faults)
+under a serving daemon with the autopilot ON and drives one scripted
+storm through the policy loop:
+
+  polls 1-2    warm serving traffic (alice/bob requests, flat path)
+  poll 3       the resident elastic job's rank DIES: the autopilot — no
+               operator — turns the InjectedRankDeath into
+               `shrink_resume` onto survivor capacity, fault ledger
+               carried through the manifest
+  polls 5-12   a sustained synthetic SLO burn on alice: the hysteresis
+               band grows the lane pool EXACTLY ONCE (checkpoint-fenced
+               through the elastic manifest), then walks the degradation
+               ladder one rung per sustained-hot window:
+               class_consolidation -> itermax_cap -> shed_low_priority
+  poll 13      a low-priority (bob) request hits rung 3 and is SHED with
+               a structured failure result
+  recovery     the burn window drains; the ladder steps back to full
+               service one rung per sustained-calm window and the
+               time-to-recover clock closes
+  preempt      3 bob + 1 zoe requests over a 3-lane pool: zoe (high)
+               preempts a bob lane through a parked-lane manifest; the
+               victim resumes bitwise once the queue drains
+
+and then ASSERTS the whole story:
+
+- rc 0, every non-shed request served, exactly one grow, zero flaps;
+- the recorded rung sequence is MONOTONE (|delta| <= 1 per autoscale
+  record — no rung skipping, no intra-phase oscillation);
+- the final manifest still carries the pre-death fault ledger (heal and
+  every fence re-persist it — no probation amnesia);
+- BITWISE parity #1 (heal/fence): the resident solver driven to
+  completion equals a fresh `elastic_restore` twin from the same
+  manifest generation on the same surviving mesh;
+- BITWISE parity #2 (preempt): a scheduler run with preemption armed
+  produces per-scenario fields bitwise-identical to the same request
+  set served without priorities — the park/resume roundtrip is
+  lossless;
+- the merged artifact lints clean (check_artifact: `autoscale` +
+  `chaos_trajectory` blocks) and carries the trend-gated
+  autoscale_flaps / autoscale_time_to_recover_ms metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+import warnings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU-stable chaos environment: must precede any jax import (the
+# tools/lint.py convention); a TPU image just keeps its own backend
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+# the scripted storm: one rank death at poll 3, then a sustained
+# synthetic burn on alice across polls 5..12 (50 violating observations
+# per poll — burn ~20x with everything in-window, far above burn_high)
+os.environ["PAMPI_FAULTS"] = "dead@poll3," + ",".join(
+    f"burst@poll{n}:alice*50" for n in range(5, 13))
+
+PAR = """name dcavity
+imax 12
+jmax 12
+re 10.0
+te {te}
+tau 0.5
+itermax 8
+eps 0.0001
+omg 1.7
+gamma 0.9
+tpu_mesh 1
+"""
+
+_RESIDENT = dict(name="dcavity", imax=16, jmax=16, re=10.0, tau=0.5,
+                 itermax=50, eps=1e-4, omg=1.7, gamma=0.9,
+                 tpu_dtype="float32")
+# the marker the ledger-carry assertion looks for at the END of the run:
+# heal's shrink_resume and every grow/shrink fence must re-persist it
+LEDGER = {"chaos_marker": 1, "transient_budget_spent": 0,
+          "pallas_broken": False}
+
+
+def _drop(qdir: str, name: str, te: float) -> None:
+    with open(os.path.join(qdir, name), "w") as fh:
+        fh.write(PAR.format(te=te))
+
+
+def _sample(traj: dict, daemon) -> None:
+    ap = daemon.autopilot
+    burns = daemon.slo.burn_snapshot(time.time())
+    traj["poll"].append(daemon.polls)
+    traj["rung"].append(ap.rung)
+    traj["lanes"].append(ap.lanes)
+    traj["burn_max"].append(round(max(burns.values(), default=0.0), 3))
+
+
+def main(argv: list[str]) -> int:
+    ap_cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap_cli.add_argument("outdir", nargs="?",
+                        default=os.path.join(REPO, "results", "chaos"))
+    ap_cli.add_argument("--artifact", default="",
+                        help="also merge the blocks into this committed "
+                             "BENCH artifact (default: outdir-local only)")
+    ap_cli.add_argument("--round", type=int, default=0,
+                        help="artifact round number `n` (with --artifact)")
+    args = ap_cli.parse_args(argv[1:])
+
+    outdir = args.outdir
+    shutil.rmtree(outdir, ignore_errors=True)
+    qdir = os.path.join(outdir, "queue")
+    os.makedirs(qdir, exist_ok=True)
+    jsonl = os.path.join(outdir, "run.jsonl")
+    os.environ["PAMPI_TELEMETRY"] = jsonl
+
+    import numpy as np
+
+    from pampi_tpu import fleet
+    from pampi_tpu.fleet import FleetDaemon, ServeConfig
+    from pampi_tpu.fleet.autopilot import ParkStore
+    from pampi_tpu.fleet.scheduler import FleetScheduler
+    from pampi_tpu.models.ns2d import NS2DSolver
+    from pampi_tpu.utils import checkpoint as ckpt
+    from pampi_tpu.utils import faultinject as fi
+    from pampi_tpu.utils import telemetry as tm
+    from pampi_tpu.utils.params import Parameter
+
+    fleet.reset_templates()
+    fi.reset()
+    tm.reset()
+    tm.start_run(tool="chaos_smoke")
+
+    failures: list[str] = []
+
+    # -- the resident elastic job: a mid-flight generation to die on ---
+    manifest = os.path.join(outdir, "resident.elastic")
+    pre = NS2DSolver(Parameter(te=0.03, **_RESIDENT))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pre.run(progress=False)
+    ckpt.save_elastic(manifest, pre, ledger=dict(LEDGER))
+    param_full = Parameter(te=0.08, **_RESIDENT)
+
+    daemon = FleetDaemon(ServeConfig(
+        queue_dir=qdir, poll_s=0.01, max_lanes=2, max_queue=32,
+        tenant_quota=8, classes="on",
+        slo="default=60000,alice=800", slo_window_s=1.2,
+        autopilot=("on:sustain=2,cooldown=2,max_lanes=3,min_lanes=1,"
+                   "idle_polls=99,backlog_high=50"),
+        priorities="zoe=high,bob=low"))
+    pilot = daemon.autopilot
+    pilot.register_resident(manifest, param_full)
+
+    traj = {"poll": [], "rung": [], "lanes": [], "burn_max": []}
+
+    # polls 1-2: warm traffic on the flat path
+    _drop(qdir, "alice__w1.par", te=0.02)
+    _drop(qdir, "bob__w2.par", te=0.02)
+    for _ in range(2):
+        daemon.poll_once()
+        _sample(traj, daemon)
+
+    # poll 3: the injected death -> heal; poll 4: calm filler
+    for _ in range(2):
+        daemon.poll_once()
+        _sample(traj, daemon)
+    if pilot.counts["heal"] != 1:
+        failures.append(f"heal count {pilot.counts['heal']} != 1 after "
+                        "the poll-3 death")
+    if len(pilot.devices) != 7:
+        failures.append(f"{len(pilot.devices)} survivors != 7 after one "
+                        "casualty")
+
+    # polls 5-12: the sustained burn — grow once, then walk the ladder
+    # down to shed_low_priority (tight sleeps keep the 1.2 s SLO window
+    # saturated across the whole storm)
+    for _ in range(5, 13):
+        daemon.poll_once()
+        _sample(traj, daemon)
+        time.sleep(0.02)
+    if pilot.counts["grow"] != 1:
+        failures.append(f"grow count {pilot.counts['grow']} != 1 during "
+                        "the burn storm")
+    if pilot.rung != 3:
+        failures.append(f"rung {pilot.rung} != 3 (shed_low_priority) "
+                        "after the sustained burn")
+
+    # poll 13: a low-priority request meets rung 3 -> shed
+    _drop(qdir, "bob__shed.par", te=0.02)
+    daemon.poll_once()
+    _sample(traj, daemon)
+    shed_res = os.path.join(daemon.results_dir, "bob__shed.json")
+    if not os.path.exists(shed_res):
+        failures.append("no structured result for the shed request")
+    else:
+        with open(shed_res) as fh:
+            row = json.load(fh)
+        if not (row.get("failed") and row.get("shed")):
+            failures.append(f"shed result is not a shed failure: {row}")
+
+    # recovery: the burn window drains, the ladder climbs back to full
+    # service and the time-to-recover clock closes
+    for _ in range(20):
+        if pilot.rung == 0 and pilot.recoveries_ms:
+            break
+        time.sleep(0.35)
+        daemon.poll_once()
+        _sample(traj, daemon)
+    if pilot.rung != 0:
+        failures.append(f"ladder never recovered (rung {pilot.rung})")
+    if not pilot.recoveries_ms:
+        failures.append("time-to-recover clock never closed")
+
+    # preempt: 3 low + 1 high over a 3-lane pool — zoe evicts a bob
+    # lane through a parked-lane manifest, the victim resumes bitwise
+    for i in range(3):
+        _drop(qdir, f"bob__p{i}.par", te=0.02 + 0.005 * i)
+    _drop(qdir, "zoe__p9.par", te=0.02)
+    daemon.poll_once()
+    _sample(traj, daemon)
+    daemon.stop()
+    tm.finalize()
+
+    served_expect = 2 + 4  # warmup + preempt phase (the shed one failed)
+    if daemon.served != served_expect:
+        failures.append(f"served {daemon.served} != {served_expect}")
+    if daemon.failed != 1:
+        failures.append(f"failed {daemon.failed} != 1 (the shed request)")
+    if pilot.flaps != 0:
+        failures.append(f"{pilot.flaps} capacity flaps (hysteresis band "
+                        "failed)")
+    if pilot.counts["degrade"] != 3 or pilot.counts["recover"] != 3:
+        failures.append(
+            f"ladder walked {pilot.counts['degrade']} down / "
+            f"{pilot.counts['recover']} up (want 3/3)")
+
+    # -- the flight record tells the same story -------------------------
+    from tools import telemetry_report as tr
+
+    records = tr.load(jsonl)
+    sys.stdout.write(tr.render(records))
+    auto = [r for r in records if r.get("kind") == "autoscale"]
+    decisions = [r.get("decision") for r in auto]
+    for want in ("heal", "grow", "degrade", "recover", "preempt",
+                 "resume", "hold"):
+        if want not in decisions:
+            failures.append(f"no autoscale decision={want!r} record")
+    if decisions.count("grow") != 1:
+        failures.append(f"{decisions.count('grow')} grow records != 1")
+    rung_seq = [r["rung"] for r in auto if r.get("rung") is not None]
+    if any(abs(b - a) > 1 for a, b in zip(rung_seq, rung_seq[1:])):
+        failures.append(f"recorded rung sequence skips rungs: {rung_seq}")
+    parked = [r for r in auto if r.get("decision") == "preempt"]
+    if not (parked and os.path.exists(parked[0].get("manifest", ""))):
+        failures.append("preempt record names no parked-lane manifest "
+                        "on disk")
+    if not any(r.get("action") == "shed" for r in records
+               if r.get("kind") == "admission"):
+        failures.append("no admission action=shed record")
+
+    # -- ledger carry: no probation amnesia through heal + fences -------
+    man = ckpt._read_manifest(manifest)
+    if man.get("ledger", {}).get("chaos_marker") != 1:
+        failures.append("the fault ledger did not survive heal/fence "
+                        f"(manifest ledger: {man.get('ledger')})")
+
+    # -- bitwise parity #1: resident vs a clean twin from the same
+    #    generation on the same surviving mesh -------------------------
+    resident = pilot.resident.solver
+    devs = pilot.devices[:pilot.resident.devices]
+    twin = daemon.sched.elastic_restore(manifest, param_full,
+                                        family="ns2d", devices=devs)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        resident.run(progress=False)
+        twin.run(progress=False)
+    if (resident.nt != twin.nt or resident.t != twin.t or not all(
+            np.array_equal(np.asarray(getattr(resident, f)),
+                           np.asarray(getattr(twin, f)))
+            for f in ("u", "v", "p"))):
+        failures.append(
+            "healed resident is not bitwise-identical to a clean "
+            f"restore from generation {man.get('generation')} on "
+            f"{len(devs)} device(s)")
+
+    # -- bitwise parity #2: preemption leaves every tenant's fields
+    #    untouched vs the same requests served flat ---------------------
+    def _preempt_requests():
+        # tpu_mesh=1 keeps these single-device like the daemon's .par
+        # template: a dist config would split the bucket per te into
+        # sub-3-lane groups and never enter the continuous pool
+        return ([(f"bob__q{i}", Parameter(name="dcavity", imax=12,
+                                          jmax=12, re=10.0,
+                                          te=0.02 + 0.005 * i, tau=0.5,
+                                          itermax=8, eps=1e-4, omg=1.7,
+                                          gamma=0.9, tpu_mesh="1"))
+                 for i in range(3)]
+                + [("zoe__q9", Parameter(name="dcavity", imax=12,
+                                         jmax=12, re=10.0, te=0.02,
+                                         tau=0.5, itermax=8, eps=1e-4,
+                                         omg=1.7, gamma=0.9,
+                                         tpu_mesh="1"))])
+
+    armed = FleetScheduler(classes="on", lanes=3, isolate=False)
+    armed.park_store = ParkStore(os.path.join(outdir, "parity_park"))
+    armed.priority_of = lambda sid: 0 if sid.startswith("zoe") else 2
+    flat = FleetScheduler(classes="on", lanes=3, isolate=False)
+    for sid, param in _preempt_requests():
+        armed.submit_param(sid, param)
+    for sid, param in _preempt_requests():
+        flat.submit_param(sid, param)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        res_a = {s.sid: s for s in armed.run().scenarios}
+        res_f = {s.sid: s for s in flat.run().scenarios}
+    if armed.park_store.parked_total < 1:
+        failures.append("parity run never parked a lane (preemption "
+                        "did not trigger)")
+    for sid, a in sorted(res_a.items()):
+        f = res_f.get(sid)
+        if f is None or a.nt != f.nt or a.t != f.t or not all(
+                np.array_equal(np.asarray(x), np.asarray(y))
+                for x, y in zip(a.fields, f.fields)):
+            failures.append(f"{sid}: preempted-run fields are not "
+                            "bitwise-identical to the flat run")
+
+    # -- artifact round trip -------------------------------------------
+    from tools._artifact import write_merged
+    from tools.check_artifact import lint_bench
+
+    block = {"n": args.round, "cmd": "chaos_smoke", "rc": 0,
+             "tail": f"chaos: heal=1 grow=1 flaps={pilot.flaps} "
+                     f"recover_ms={max(pilot.recoveries_ms or [0])}",
+             "telemetry_summary": tr.summary(records),
+             "serving_summary": tr.serving_summary(records),
+             "autoscale": tr.autoscale_summary(records),
+             "metrics_summary": tr.metrics_summary(records),
+             "slo": tr.slo_summary(records),
+             "chaos_trajectory": traj}
+    merged = write_merged(os.path.join(outdir, "CHAOS.json"), block)
+    failures += lint_bench(merged, "CHAOS")
+    names = {m.get("name") for m in merged.get("metrics", [])}
+    for metric in ("autoscale_flaps", "autoscale_time_to_recover_ms"):
+        if metric not in names:
+            failures.append(
+                f"merged artifact carries no normalized {metric}")
+    if args.artifact:
+        # the committed artifact keeps the chaos planes only: the
+        # serving latency headlines here are storm-shaped, not the
+        # warm-path series tools/perf_fleet.py seeds (same policy as
+        # tools/soak.py)
+        commit = {k: v for k, v in block.items()
+                  if k != "serving_summary"}
+        write_merged(args.artifact, commit)
+
+    if failures:
+        print("\nCHAOS SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"\nchaos smoke ok: heal -> grow(x1) -> ladder 0..3..0 -> "
+          f"shed -> preempt/resume bitwise over {daemon.polls} polls; "
+          f"flaps=0, time-to-recover "
+          f"{max(pilot.recoveries_ms):.0f} ms; autoscale + trajectory "
+          "blocks linted clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

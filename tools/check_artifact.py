@@ -40,8 +40,12 @@ Contracts:
   gauges/histograms, every histogram row carrying n/p50/p95/max), slo
   (per-tenant target + windowed counts + burn rate), trace_decomposition
   (stage table + median-request waterfall whose stage sum must close on
-  its end-to-end latency within 5%), and soak_trajectory (tools/soak.py:
-  monotone t_s + equal-length queue-depth/latency series).
+  its end-to-end latency within 5%), soak_trajectory (tools/soak.py:
+  monotone t_s + equal-length queue-depth/latency series), autoscale
+  (fleet/autopilot: decision tally + transition log + final rung/lane
+  posture) and chaos_trajectory (tools/chaos_smoke.py: monotone poll
+  axis, equal-length series, degradation ladder moving at most one
+  rung per sample).
 - telemetry_summary (optional until a run emits one): the
   tools/telemetry_report.summary shape — {schema_version, dispatch,
   chunks, records}; when the PR 4 resilience blocks are present,
@@ -306,6 +310,74 @@ def lint_soak(d: dict, where: str) -> list[str]:
     return errs
 
 
+AUTOSCALE_KEYS = ("records", "decisions", "transitions", "final")
+CHAOS_SERIES = ("poll", "rung", "lanes", "burn_max")
+
+
+def lint_autoscale(d: dict, where: str) -> list[str]:
+    """The autopilot decision block (fleet/autopilot via
+    telemetry_report.autoscale_summary): the decision tally, the ordered
+    transition log and the final rung/lane posture must all ride the
+    block — an autoscale record that cannot say WHAT it decided and
+    WHERE the fleet ended up is noise, not a control-plane audit."""
+    errs = _missing(d, AUTOSCALE_KEYS, where)
+    decisions = d.get("decisions")
+    if isinstance(decisions, dict):
+        for dec, n in decisions.items():
+            if not (isinstance(n, int) and n >= 0):
+                errs.append(f"{where}.decisions[{dec}]: {n!r} "
+                            "not a non-negative count")
+    elif "decisions" in d:
+        errs.append(f"{where}.decisions: not a dict")
+    trans = d.get("transitions")
+    if isinstance(trans, list):
+        for i, t in enumerate(trans):
+            if not isinstance(t, dict) or "decision" not in t:
+                errs.append(f"{where}.transitions[{i}]: "
+                            "missing decision")
+    elif "transitions" in d:
+        errs.append(f"{where}.transitions: not a list")
+    final = d.get("final")
+    if isinstance(final, dict):
+        errs += _missing(final, ("rung", "lanes"), f"{where}.final")
+    elif "final" in d:
+        errs.append(f"{where}.final: not a dict")
+    return errs
+
+
+def lint_chaos_trajectory(d: dict, where: str) -> list[str]:
+    """The chaos recovery-trajectory block (tools/chaos_smoke.py): the
+    poll axis must be monotone increasing, every series equal length,
+    and the degradation ladder MONOTONE — the rung may only move one
+    step per sample. A ladder that jumps rungs is not a ladder, and a
+    trajectory with misaligned series plots lies about the recovery."""
+    errs = _missing(d, CHAOS_SERIES, where)
+    polls = d.get("poll")
+    if isinstance(polls, list):
+        if any(not isinstance(p, (int, float)) for p in polls):
+            errs.append(f"{where}.poll: non-numeric sample")
+        elif any(b <= a for a, b in zip(polls, polls[1:])):
+            errs.append(f"{where}.poll: not monotone increasing")
+        for key in CHAOS_SERIES[1:]:
+            series = d.get(key)
+            if isinstance(series, list) and len(series) != len(polls):
+                errs.append(f"{where}.{key}: length {len(series)} != "
+                            f"poll length {len(polls)}")
+            elif key in d and not isinstance(series, list):
+                errs.append(f"{where}.{key}: not a list")
+    elif "poll" in d:
+        errs.append(f"{where}.poll: not a list")
+    rungs = d.get("rung")
+    if isinstance(rungs, list) and all(
+            isinstance(r, int) for r in rungs):
+        if any(abs(b - a) > 1 for a, b in zip(rungs, rungs[1:])):
+            errs.append(f"{where}.rung: ladder jumps more than one "
+                        "rung between samples (non-monotone ladder)")
+        if any(r < 0 for r in rungs):
+            errs.append(f"{where}.rung: negative rung")
+    return errs
+
+
 def _lint_optional_blocks(d: dict, where: str) -> list[str]:
     errs = []
     for key, fn in (("xprof_summary", lint_xprof_summary),
@@ -315,7 +387,9 @@ def _lint_optional_blocks(d: dict, where: str) -> list[str]:
                     ("metrics_summary", lint_metrics_summary),
                     ("slo", lint_slo),
                     ("trace_decomposition", lint_trace_decomposition),
-                    ("soak_trajectory", lint_soak)):
+                    ("soak_trajectory", lint_soak),
+                    ("autoscale", lint_autoscale),
+                    ("chaos_trajectory", lint_chaos_trajectory)):
         block = d.get(key)
         if block is None:
             continue

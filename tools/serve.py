@@ -23,15 +23,30 @@ Options:
                     empty = SLO plane off)
   --slo-window S    sliding error-budget window seconds (default 60)
   --slo-burn-alert X  burn-rate warning threshold (default 2.0)
+  --autopilot SPEC  the self-healing elastic policy loop
+                    (fleet/autopilot.py): off | on[:k=v,...] — e.g.
+                    "on:burn_high=4,sustain=3,max_lanes=8". Default
+                    off; empty falls back to the base .par's
+                    tpu_autopilot knob. Off constructs nothing — the
+                    daemon is byte-identical to the policy-less build.
+  --priorities SPEC tenant priority classes for the QoS plane
+                    ("zoe=high,bob=low,default=normal"; empty = flat —
+                    weighted admission and preemption both off)
+  --parked-max N    parked/ retention: keep at most N parked malformed
+                    files, delete the oldest beyond it (0 = unbounded;
+                    status.json `parked_census` reports count + oldest
+                    age either way)
 
 Arm PAMPI_TELEMETRY for the flight record (serving/admission/latency/
-trace/metrics/slo records, schema v8 — utils/telemetry.py's docstring
-is the kind table) — `tools/telemetry_report.py --merge` folds the
-`serving_summary`/`metrics_summary`/`slo`/`trace_decomposition` blocks
-into BENCH artifacts and `tools/bench_trend.py` gates
-fleet_p50_latency_ms / fleet_queue_depth_max / fleet_class_p95_ms /
-slo_violations lower-is-better. The daemon also writes the registry as
-Prometheus text at `metrics.prom` next to the status endpoint.
+trace/metrics/slo/autoscale records, schema v9 — utils/telemetry.py's
+docstring is the kind table) — `tools/telemetry_report.py --merge`
+folds the `serving_summary`/`metrics_summary`/`slo`/
+`trace_decomposition`/`autoscale` blocks into BENCH artifacts and
+`tools/bench_trend.py` gates fleet_p50_latency_ms /
+fleet_queue_depth_max / fleet_class_p95_ms / slo_violations /
+autoscale_time_to_recover_ms / autoscale_flaps lower-is-better. The
+daemon also writes the registry as Prometheus text at `metrics.prom`
+next to the status endpoint.
 """
 
 from __future__ import annotations
@@ -61,6 +76,9 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--slo", default="")
     ap.add_argument("--slo-window", type=float, default=60.0)
     ap.add_argument("--slo-burn-alert", type=float, default=2.0)
+    ap.add_argument("--autopilot", default="")
+    ap.add_argument("--priorities", default="")
+    ap.add_argument("--parked-max", type=int, default=0)
     args = ap.parse_args(argv[1:])
 
     from pampi_tpu.fleet import FleetDaemon, ServeConfig
@@ -77,7 +95,9 @@ def main(argv: list[str]) -> int:
         tenant_quota=args.quota, classes=args.classes,
         max_polls=args.max_polls, slo=args.slo,
         slo_window_s=args.slo_window,
-        slo_burn_alert=args.slo_burn_alert)
+        slo_burn_alert=args.slo_burn_alert,
+        autopilot=args.autopilot, priorities=args.priorities,
+        parked_max=args.parked_max)
     daemon = FleetDaemon(cfg, base=base)
     print(f"serving {args.queue_dir} (status: {daemon.status_path}; "
           f"drop {args.queue_dir}/STOP to shut down)")

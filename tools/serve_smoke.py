@@ -24,7 +24,7 @@ exercises every serving contract at once:
   record, the daemon survives (the hardened load_queue path).
 
 Then proves the observability plane end-to-end: live status endpoint
-fields, telemetry (schema v8 serving/admission/latency records) through
+fields, telemetry (schema v9 serving/admission/latency records) through
 report -> --merge -> check_artifact lint, the trend-gated
 fleet_p50_latency_ms / fleet_queue_depth_max metrics in the merged
 artifact, and a clean shutdown (rc 0).

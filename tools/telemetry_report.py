@@ -303,7 +303,7 @@ def _label_str(name: str, labels: dict) -> str:
 
 
 def metrics_summary(records: list[dict]):
-    """Fold the `metrics` registry snapshots (schema v8, utils/metrics)
+    """Fold the `metrics` registry snapshots (schema v9, utils/metrics)
     into one artifact block. Snapshots are CUMULATIVE per process, so
     the fold takes the LAST snapshot per `source` (highest seq) and
     merges ACROSS sources only — the same counter/gauge/histogram fold
@@ -341,6 +341,49 @@ def metrics_summary(records: list[dict]):
         "gauges": {_label_str(g["name"], g.get("labels") or {}):
                    g["value"] for g in folded["gauges"]},
         "histograms": hists,
+    }
+
+
+def autoscale_summary(records: list[dict]):
+    """The autopilot decision block (`autoscale` top-level in merged
+    artifacts; tools/check_artifact.py lints it): every `autoscale`
+    record the policy loop emitted — decision tally, the ordered
+    non-hold transition log (heal/grow/shrink/degrade/recover/preempt/
+    resume, the trajectory the chaos harness asserts on) and the final
+    rung/lane posture."""
+    recs = [r for r in records if r.get("kind") == "autoscale"]
+    if not recs:
+        return None
+    decisions: dict[str, int] = {}
+    for r in recs:
+        d = str(r.get("decision"))
+        decisions[d] = decisions.get(d, 0) + 1
+    transitions = [
+        {"poll": r.get("poll"), "decision": r.get("decision"),
+         "rung": r.get("rung"), "rung_name": r.get("rung_name"),
+         "lanes": r.get("lanes")}
+        for r in recs if r.get("decision") != "hold"
+    ]
+    # the last policy-loop record (preempt/resume come from the
+    # scheduler and carry no rung/lanes posture)
+    final = next((r for r in reversed(recs)
+                  if r.get("rung") is not None), recs[-1])
+    # the trend-gated tallies ride the daemon's stop metrics
+    # (fleet/autopilot.emit_stop_metrics) — folded here so
+    # tools/_artifact.collect_metrics normalizes them off this block
+    stop = {r.get("metric"): r.get("value") for r in records
+            if r.get("kind") == "metric"
+            and str(r.get("metric", "")).startswith("autoscale_")}
+    return {
+        "records": len(recs),
+        "decisions": decisions,
+        "transitions": transitions,
+        "flaps": stop.get("autoscale_flaps"),
+        "time_to_recover_ms": stop.get("autoscale_time_to_recover_ms"),
+        "final": {"rung": final.get("rung"),
+                  "rung_name": final.get("rung_name"),
+                  "lanes": final.get("lanes"),
+                  "capacity": final.get("capacity")},
     }
 
 
@@ -589,6 +632,23 @@ def render(records: list[dict]) -> str:
             add(f"  histogram  {name:<52} n={row['n']} "
                 f"p50={row['p50']} p95={row['p95']} max={row['max']}")
 
+    asc = autoscale_summary(records)
+    if asc is not None:
+        add("== autopilot (self-healing elastic control plane) ==")
+        add("  decisions: " + " ".join(
+            f"{d}={n}" for d, n in sorted(asc["decisions"].items())))
+        fin = asc["final"]
+        add(f"  final: rung={fin.get('rung')} "
+            f"({fin.get('rung_name')}) lanes={fin.get('lanes')} "
+            f"capacity={fin.get('capacity')}")
+        for t in asc["transitions"]:
+            # scheduler-side moves (preempt/resume) carry no poll/rung
+            def _c(v):
+                return "-" if v is None else v
+            add(f"  poll {str(_c(t.get('poll'))):>4}  "
+                f"{str(t.get('decision')):<10} "
+                f"rung={_c(t.get('rung'))} lanes={_c(t.get('lanes'))}")
+
     slo = slo_summary(records)
     if slo is not None:
         add("== tenant SLOs (sliding-window error budget) ==")
@@ -783,6 +843,9 @@ def main(argv: list[str]) -> int:
         slo = slo_summary(records)
         if slo is not None:
             block["slo"] = slo
+        asc = autoscale_summary(records)
+        if asc is not None:
+            block["autoscale"] = asc
         dec = trace_decomposition(records)
         if dec is not None:
             block["trace_decomposition"] = dec
