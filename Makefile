@@ -86,10 +86,12 @@ profile-smoke:
 # lint rules over pampi_tpu/ tools/ tests/, stencil halo footprints vs
 # declared depths, the dispatch-matrix jaxpr contracts vs CONTRACTS.json,
 # the collective-schedule census (comm) and Pallas kernel-resource
-# checks (pallas), and the committed-artifact schema lint. Regenerate
-# the baseline (configs + comm sections) after an INTENDED change with
+# checks (pallas), the precision-flow contracts (prec) and the
+# committed-artifact schema lint. Regenerate the baseline
+# (configs + comm + precision sections) after an INTENDED change with
 # `make lint-update`. `make lint-comm` runs the comm contract alone —
-# the overlap refactor's inner loop (one matrix trace, no AST/halo).
+# the overlap refactor's inner loop (one matrix trace, no AST/halo);
+# `make lint-prec` is the mixed-precision twin.
 lint:
 	python tools/lint.py
 
@@ -98,6 +100,9 @@ lint-update:
 
 lint-comm:
 	python tools/lint.py --only comm
+
+lint-prec:
+	python tools/lint.py --only prec
 
 # MG fused-cycle smoke (ISSUE 16): fused-vs-ladder V-cycle parity on
 # 2-D/3-D × plain/obstacle (CPU interpret mode), the 2-launch /
@@ -220,5 +225,5 @@ distclean:
 	soak-smoke chaos-smoke \
 	fleet-suite \
 	lint \
-	lint-update lint-comm \
+	lint-update lint-comm lint-prec \
 	fault-suite dead-rank-smoke ckpt-fsck clean distclean

@@ -26,6 +26,16 @@ Every rule guards a contract that past PRs fixed by hand at least once:
                  on concrete exception classes reaching it.
   print-call     `print()` in library code where telemetry/progress
                  records exist (CLI entry points are exempt).
+  dtype-policy   raw float-dtype literals in solver/ops builder code
+                 (`.astype(jnp.float32)`, `jnp.float64(x)`,
+                 `dtype=jnp.bfloat16`) — the compute dtype is a POLICY
+                 (`utils/precision.resolve_dtype` resolves it once per
+                 solver; `precision.cast` declares every intentional
+                 downcast), so a hard-coded dtype in models/ or ops/ is
+                 a precision decision the preccheck census cannot see
+                 coming. Builder-context only (constants baked by
+                 builders ARE the traced program); passing a dtype
+                 VARIABLE is always fine.
 
 Escape hatch: a trailing `# lint: allow(<rule>[, <rule>...])` comment on
 the offending line (for `except` clauses, on the `except` line), with a
@@ -50,9 +60,10 @@ NP_IN_TRACED = "np-in-traced"
 TRACED_NONDET = "traced-nondet"
 BROAD_EXCEPT = "broad-except"
 PRINT_CALL = "print-call"
+DTYPE_POLICY = "dtype-policy"
 
 ALL_RULES = (ENV_READ, RAW_SHARD_MAP, NP_IN_TRACED, TRACED_NONDET,
-             BROAD_EXCEPT, PRINT_CALL)
+             BROAD_EXCEPT, PRINT_CALL, DTYPE_POLICY)
 
 # rule sets by tree: library code gets everything; tools/tests are
 # harness code (prints, env knobs and numpy are their job) but must still
@@ -65,6 +76,14 @@ ENV_ACCESSOR_FILES = ("utils/flags.py",)
 SHARD_MAP_HOME_FILES = ("parallel/comm.py",)
 PRINT_EXEMPT_FILES = ("cli.py", "__main__.py", "utils/progress.py",
                       "utils/params.py")
+
+# the dtype-policy rule applies only where solver/ops builders live —
+# elsewhere (utils/precision.py above all) a dtype literal IS the policy
+DTYPE_POLICY_DIRS = ("models", "ops")
+
+_FLOAT_DTYPE_NAMES = frozenset(
+    ("float16", "float32", "float64", "bfloat16",
+     "half", "single", "double"))
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
 
@@ -104,6 +123,22 @@ def _dotted(node: ast.AST) -> str:
     return ""
 
 
+def _dtype_literal(node: ast.AST) -> str:
+    """The spelled-out float-dtype literal an expression hard-codes
+    ('jnp.float32', "'float64'"), or '' when the expression is a name/
+    computed value (a dtype VARIABLE — policy-resolved, always fine)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _FLOAT_DTYPE_NAMES:
+        return repr(node.value)
+    dotted = _dotted(node)
+    if dotted:
+        parts = dotted.split(".")
+        if parts[-1] in _FLOAT_DTYPE_NAMES \
+                and parts[0] in ("jnp", "np", "numpy", "jax"):
+            return dotted
+    return ""
+
+
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, rel: str, source: str, rules):
         self.path = path
@@ -129,6 +164,14 @@ class _Linter(ast.NodeVisitor):
         """Inside a def nested under a `_build_*`/`make_*` builder (the
         repo's traced-closure convention)."""
         return any(traced for _name, traced in self._funcs)
+
+    def _in_builder(self) -> bool:
+        """Inside a builder's own body OR a def nested under one — the
+        dtype-policy scope: both the baked constants and the traced
+        closures are the program the precision contract governs."""
+        return self._traced() or any(
+            name.startswith(("_build_", "make_"))
+            for name, _traced in self._funcs)
 
     # -- visitors -------------------------------------------------------
     def _visit_funcdef(self, node) -> None:
@@ -189,6 +232,36 @@ class _Linter(ast.NodeVisitor):
                            f"{dotted}() inside a traced context — a "
                            "nondeterministic trace breaks the flag-off "
                            "byte-identity contract and the XLA cache")
+        if self._in_builder():
+            # raw `.astype(<float literal>)`
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype" and node.args:
+                lit = _dtype_literal(node.args[0])
+                if lit:
+                    self._emit(node, DTYPE_POLICY,
+                               f".astype({lit}) hard-codes a float dtype "
+                               "in builder code — the compute dtype is "
+                               "policy (utils/precision.resolve_dtype); "
+                               "declare an intentional downcast through "
+                               "precision.cast(x, dtype, why)")
+            # `jnp.float64(x)` constructor casts
+            parts = dotted.split(".") if dotted else []
+            if len(parts) == 2 and parts[0] in ("jnp", "np", "numpy") \
+                    and parts[1] in _FLOAT_DTYPE_NAMES and node.args:
+                self._emit(node, DTYPE_POLICY,
+                           f"{dotted}(...) hard-codes a float dtype in "
+                           "builder code — resolve the dtype through "
+                           "utils/precision instead of constructing one")
+            # `dtype=<float literal>` keywords
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    lit = _dtype_literal(kw.value)
+                    if lit:
+                        self._emit(node, DTYPE_POLICY,
+                                   f"dtype={lit} hard-codes a float dtype "
+                                   "in builder code — thread the solver's "
+                                   "policy dtype (or annotate `# lint: "
+                                   "allow(dtype-policy)` with the why)")
         self.generic_visit(node)
 
     def visit_Subscript(self, node: ast.Subscript) -> None:
@@ -270,6 +343,10 @@ def lint_file(path: str, rules=None, root: str | None = None):
         rules.discard(RAW_SHARD_MAP)
     if any(matches(f) for f in PRINT_EXEMPT_FILES):
         rules.discard(PRINT_CALL)
+    # dtype-policy scopes to the solver/ops trees by directory component
+    comps = norm.split("/")[:-1]
+    if not any(d in comps for d in DTYPE_POLICY_DIRS):
+        rules.discard(DTYPE_POLICY)
     try:
         with open(path, encoding="utf-8") as fh:
             source = fh.read()

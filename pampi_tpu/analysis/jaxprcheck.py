@@ -104,15 +104,18 @@ def host_callbacks(jaxpr) -> list[str]:
 
 
 def float_dtypes(jaxpr) -> set[str]:
-    """Every floating dtype appearing on an eqn output anywhere."""
-    import numpy as np
+    """Every floating dtype appearing on an eqn output anywhere.
+    jnp.issubdtype, not np: the ml_dtypes extension floats (bfloat16)
+    are NOT np.floating subtypes, so an np-based check is blind to
+    exactly the dtypes the mixed-precision work introduces."""
+    import jax.numpy as jnp
 
     out = set()
     for e in iter_eqns(jaxpr):
         for v in e.outvars:
             aval = getattr(v, "aval", None)
             dt = getattr(aval, "dtype", None)
-            if dt is not None and np.issubdtype(dt, np.floating):
+            if dt is not None and jnp.issubdtype(dt, jnp.floating):
                 out.add(str(dt))
     return out
 
@@ -254,6 +257,14 @@ class ChunkConfig:
     fleet_te: bool = False
     fleet_class: bool = False
     fleet_mesh: bool = False
+    # precision-flow contract strength (analysis/preccheck.py):
+    # `oracle` pins jnp f64 parity-oracle purity — zero sub-f64 float
+    # compute anywhere in the trace; `advisory` traces the config and
+    # pins its precision census in the baseline but REPORTS the
+    # precision rule findings instead of gating on them (the forced-
+    # bf16 scouts that price the future mixed-precision lanes)
+    oracle: bool = False
+    advisory: bool = False
     notes: str = ""
 
     def build(self):
@@ -343,6 +354,7 @@ def standard_configs() -> list[ChunkConfig]:
             "ns2d_jnp", "ns2d",
             dict(_B2, tpu_fuse_phases="off", tpu_solver="fft"),
             expected_pallas=0, dispatch_keys=("ns2d_phases",),
+            oracle=True,
             notes="jnp phase chain + fft solve: zero kernels by contract"),
         ChunkConfig(
             "ns2d_fused_fft", "ns2d",
@@ -371,7 +383,7 @@ def standard_configs() -> list[ChunkConfig]:
             dims=(2, 2), derive=True, phases_key="ns2d_dist_phases",
             solve_key="ns2d_dist", overlap_key="overlap_ns2d_dist",
             dispatch_keys=("ns2d_dist_phases", "ns2d_dist",
-                           "overlap_ns2d_dist")),
+                           "overlap_ns2d_dist"), oracle=True),
         ChunkConfig(
             "ns2d_dist_fused", "ns2d_dist",
             dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
@@ -439,7 +451,8 @@ def standard_configs() -> list[ChunkConfig]:
         ChunkConfig(
             "ns3d_jnp", "ns3d",
             dict(_B3, tpu_fuse_phases="off", tpu_solver="fft"),
-            expected_pallas=0, dispatch_keys=("ns3d_phases",)),
+            expected_pallas=0, dispatch_keys=("ns3d_phases",),
+            oracle=True),
         ChunkConfig(
             "ns3d_fused_fft", "ns3d",
             dict(_B3, tpu_fuse_phases="on", tpu_solver="fft"),
@@ -472,6 +485,7 @@ def standard_configs() -> list[ChunkConfig]:
             "ns2d_fleet_jnp", "ns2d",
             dict(_B2, tpu_fuse_phases="off", tpu_solver="fft"),
             expected_pallas=0, dispatch_keys=("ns2d_phases",), fleet=3,
+            oracle=True,
             notes="3-lane vmapped jnp+fft chunk: still zero kernels"),
         ChunkConfig(
             "ns2d_fleet_fused", "ns2d",
@@ -691,6 +705,43 @@ def standard_configs() -> list[ChunkConfig]:
             dispatch_keys=("ns3d_dist_phases", "ns3d_dist",
                            "overlap_ns3d_dist", "ns3d_dist_chunk_fuse"),
             notes="the 3-D K=4 dist scan keeps the K=1 launch budget"),
+        # advisory bf16 scouts (ISSUE 20): tpu_dtype=bf16 FORCED onto
+        # the NS2D/NS3D SOR paths before the mixed-precision knob
+        # exists. Advisory = the precision rule findings (implicit
+        # downcasts, f32 residual accumulations, the bf16 eps floor —
+        # ~0.125 at 16², far above eps=1e-4, deliberately) are REPORTED
+        # by the prec pass, not gated; the cast/reduction census IS
+        # pinned in the baseline, so the future bf16 lanes land against
+        # a priced contract, not a blank slate.
+        ChunkConfig(
+            "ns2d_bf16_sor", "ns2d",
+            dict(_B2, tpu_fuse_phases="off", tpu_solver="sor",
+                 tpu_dtype="bf16"),
+            expected_pallas=0,
+            dispatch_keys=("ns2d_phases", "ns2d_dtype"),
+            advisory=True,
+            notes="the jnp rb chain at forced bf16: zero kernels, the "
+                  "residual accumulates at f32 (sor.py) and every "
+                  "f64->bf16 entry cast shows up in the census"),
+        ChunkConfig(
+            "ns2d_bf16_fused", "ns2d",
+            dict(_B2, tpu_fuse_phases="on", tpu_solver="sor",
+                 tpu_sor_layout="checkerboard", tpu_dtype="bf16"),
+            expected_pallas=None,
+            dispatch_keys=("ns2d_phases", "ns2d_p_layout", "ns2d_dtype"),
+            advisory=True,
+            notes="the fused bf16 chunk (PRE + tblock solve + POST): "
+                  "baseline-pinned launches, the kernels' f32 residual "
+                  "accumulation (sor_pallas.py) joins the census"),
+        ChunkConfig(
+            "ns3d_bf16_sor", "ns3d",
+            dict(_B3, tpu_fuse_phases="off", tpu_solver="sor",
+                 tpu_dtype="bf16"),
+            expected_pallas=0,
+            dispatch_keys=("ns3d_phases", "ns3d_dtype"),
+            advisory=True,
+            notes="the 3-D jnp solve at forced bf16: the volume twin of "
+                  "the 2-D scout (f32 residual home: ns3d.py)"),
     ]
 
 

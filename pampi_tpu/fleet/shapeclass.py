@@ -704,8 +704,9 @@ class ClassSolver:
         self.param = param.replace(imax=ic, jmax=jc)
         self._request = param
         self.ic, self.jc = ic, jc
-        self.dtype = resolve_dtype(param.tpu_dtype) if dtype is None \
-            else dtype
+        self.dtype = resolve_dtype(
+            param.tpu_dtype, record_key="ns2d_class_dtype") \
+            if dtype is None else dtype
         self._backend = "auto"
         self._fused = False  # set by _build_chunk (fused-class dispatch)
         self._solve_pallas = False  # mg class lane: one-launch cycle
@@ -1237,8 +1238,9 @@ class Class3DSolver:
         self.param = param.replace(imax=ic, jmax=jc, kmax=kc)
         self._request = param
         self.ic, self.jc, self.kc = ic, jc, kc
-        self.dtype = resolve_dtype(param.tpu_dtype) if dtype is None \
-            else dtype
+        self.dtype = resolve_dtype(
+            param.tpu_dtype, record_key="ns3d_class_dtype") \
+            if dtype is None else dtype
         self._backend = "auto"
         self._fused = False
         self._dt_scale = 1.0

@@ -107,7 +107,8 @@ class NS2DSolver:
 
         param = resolve_solver(param, obstacles=bool(param.obstacles.strip()))
         if dtype is None:
-            dtype = resolve_dtype(param.tpu_dtype)
+            dtype = resolve_dtype(param.tpu_dtype,
+                                  record_key="ns2d_dtype")
         self.param = param
         self.dtype = dtype
         self.imax, self.jmax = param.imax, param.jmax

@@ -97,7 +97,8 @@ class NS2DDistSolver:
         metrics = _tm.enabled()
         self._metrics = metrics
         if dtype is None:
-            dtype = resolve_dtype(param.tpu_dtype)
+            dtype = resolve_dtype(param.tpu_dtype,
+                                  record_key="ns2d_dist_dtype")
         if param.tpu_solver == "sor_lex":
             raise ValueError(
                 "tpu_solver sor_lex is the single-device ordering oracle "

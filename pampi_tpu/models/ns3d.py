@@ -234,7 +234,8 @@ class NS3DSolver:
 
         param = resolve_solver(param, obstacles=bool(param.obstacles.strip()))
         if dtype is None:
-            dtype = resolve_dtype(param.tpu_dtype)
+            dtype = resolve_dtype(param.tpu_dtype,
+                                  record_key="ns3d_dtype")
         self.param = param
         self.dtype = dtype
         self.grid = Grid(

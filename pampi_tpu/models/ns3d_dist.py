@@ -88,7 +88,8 @@ class NS3DDistSolver:
         metrics = _tm.enabled()
         self._metrics = metrics
         if dtype is None:
-            dtype = resolve_dtype(param.tpu_dtype)
+            dtype = resolve_dtype(param.tpu_dtype,
+                                  record_key="ns3d_dist_dtype")
         self.param = param
         self.dtype = dtype
         self.comm = comm if comm is not None else CartComm(

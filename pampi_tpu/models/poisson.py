@@ -321,7 +321,10 @@ def make_padded_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
     n_inner/flat contracts as make_solver_fn. Returns
     (solve, block_rows, halo)."""
     from ..ops import sor_pallas as sp
+    from ..utils.precision import check_eps_floor
 
+    check_eps_floor(eps, imax * jmax, dtype,
+                    f"sor_tblock {imax}x{jmax}")
     eff = max(1, n_inner)
     rb_iter, block_rows, halo = sp.make_rb_iter_tblock(
         imax, jmax, dx, dy, omega, dtype, n_inner=eff,
@@ -384,6 +387,9 @@ def make_solver_fn(imax, jmax, dx, dy, omega, eps, itermax, dtype,
     solve. Opt-in, default off. Perf note: measured NEUTRAL at 4096²
     (interleaved A/B, 19.01 vs 19.04 ms/step) — the loop trip overhead,
     not the residual gating, is the per-trip cost."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax, dtype, f"sor {imax}x{jmax}")
     epssq = eps * eps
     res_dtype = jnp.promote_types(dtype, jnp.float32)
     if method == "lex":
@@ -447,7 +453,8 @@ class PoissonSolver:
 
         param = resolve_solver(param, obstacles=False)
         if dtype is None:
-            dtype = resolve_dtype(param.tpu_dtype)
+            dtype = resolve_dtype(param.tpu_dtype,
+                                  record_key="poisson_dtype")
         self.param = param
         self.dtype = dtype
         self.imax, self.jmax = param.imax, param.jmax

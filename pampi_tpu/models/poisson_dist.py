@@ -74,7 +74,8 @@ class DistPoissonSolver:
         self, param: Parameter, comm: CartComm | None = None, problem: int = 2, dtype=None
     ):
         if dtype is None:
-            dtype = resolve_dtype(param.tpu_dtype)
+            dtype = resolve_dtype(param.tpu_dtype,
+                                  record_key="poisson_dist_dtype")
         if param.tpu_solver in ("sor_lex", "sor_rba"):
             # the assignment-4 oracle modes are sequential by definition;
             # silently running the red-black path instead would defeat their

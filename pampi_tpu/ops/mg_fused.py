@@ -379,7 +379,7 @@ def make_cycle_kernels(levels, spacings, dtype, n_pre: int = 2,
         for lvl, (flu, fac) in enumerate(zip(fluid_levels, factor_levels)):
             flu = np.asarray(flu)
             sl = tuple(slice(0, s) for s in flu.shape)
-            fl_np[(lvl,) + sl] = flu.astype(np.float64)
+            fl_np[(lvl,) + sl] = flu.astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
             isl = tuple(slice(1, 1 + s) for s in np.asarray(fac).shape)
             fac_np[(lvl,) + isl] = np.asarray(fac)
         stacks = (jnp.asarray(fl_np, dtype), jnp.asarray(fac_np, dtype))

@@ -760,6 +760,10 @@ def make_obstacle_mg_solve_2d(imax, jmax, dx, dy, eps, itermax, masks, dtype,
     level plan stops at _DENSE_BOTTOM_MAX_CELLS; `n_coarse` smoothing is
     the fallback only when the pinv is unavailable. Stalled residuals
     stop the loop early per `stall_rtol` — see make_mg_solve_2d."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax, dtype,
+                    f"mg2d_obstacle {imax}x{jmax}")
     import numpy as np
 
     from .obstacle import make_masks
@@ -1113,6 +1117,10 @@ def make_dist_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
     exchange per n sweeps (_pallas_dist_smoother_2d); returns
     `(solve, used_pallas)` so callers can relax shard_map's check_vma
     around the pallas_call (the make_dist_obstacle_solver contract)."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax, dtype,
+                    f"mg2d_dist {imax}x{jmax}")
     from jax import lax as _lax
 
     from ..parallel.comm import (
@@ -1300,6 +1308,10 @@ def make_dist_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il, dx, dy, dz,
     """3-D twin of make_dist_mg_solve_2d (same stall_rtol contract; returns
     `(solve, used_pallas)` like the 2-D twin; `split` swaps the jnp-
     fallback smoother levels to the sweep-split form)."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax * kmax, dtype,
+                    f"mg3d_dist {imax}x{jmax}x{kmax}")
     from jax import lax as _lax
 
     from ..parallel.comm import (
@@ -1511,6 +1523,10 @@ def make_dist_obstacle_mg_solve_2d(comm, imax, jmax, jl, il, dx, dy, eps,
     the fallback when the global bottom exceeds the pinv budget) — then
     each shard slices its own block back out. Stalled residuals stop the
     loop early per `stall_rtol` — see make_mg_solve_2d."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax, dtype,
+                    f"mg2d_dist_obstacle {imax}x{jmax}")
     import numpy as np
 
     from jax import lax as _lax
@@ -1787,6 +1803,10 @@ def make_obstacle_mg_solve_3d(imax, jmax, kmax, dx, dy, dz, eps, itermax,
     count, exact dense bottom (_dense_obstacle_bottom_3d; `n_coarse`
     smoothing only as the over-budget fallback). `it` counts V-cycles;
     stalls stop the loop early per `stall_rtol` — see make_mg_solve_2d."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax * kmax, dtype,
+                    f"mg3d_obstacle {imax}x{jmax}x{kmax}")
     import numpy as np
 
     from ..models.ns3d import checkerboard_mask_3d, neumann_faces_3d
@@ -1945,6 +1965,10 @@ def make_dist_obstacle_mg_solve_3d(comm, imax, jmax, kmax, kl, jl, il,
     `it` counts V-cycles; stalls stop the loop early per `stall_rtol`.
     Returns `(solve, used_pallas)` — the make_dist_obstacle_solver
     contract."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax * kmax, dtype,
+                    f"mg3d_dist_obstacle {imax}x{jmax}x{kmax}")
     import numpy as np
 
     from jax import lax as _lax

@@ -117,10 +117,10 @@ def make_masks(fluid_np: np.ndarray, dx: float, dy: float, omega: float,
     v_face = f & np.roll(f, -1, axis=0)
     v_face[-1, :] = True
     fi = f[1:-1, 1:-1]
-    eps_e = (f[1:-1, 2:] & fi).astype(np.float64)
-    eps_w = (f[1:-1, :-2] & fi).astype(np.float64)
-    eps_n = (f[2:, 1:-1] & fi).astype(np.float64)
-    eps_s = (f[:-2, 1:-1] & fi).astype(np.float64)
+    eps_e = (f[1:-1, 2:] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
+    eps_w = (f[1:-1, :-2] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
+    eps_n = (f[2:, 1:-1] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
+    eps_s = (f[:-2, 1:-1] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
     idx2, idy2 = 1.0 / (dx * dx), 1.0 / (dy * dy)
     denom = (eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
     with np.errstate(divide="ignore", invalid="ignore"):
@@ -213,6 +213,10 @@ def make_obstacle_solver_fn(imax, jmax, dx, dy, eps, itermax, m: ObstacleMasks,
     make_solver_fn); otherwise the jnp eps-coefficient passes. Both paths
     relax with `m.omega` — the ω the masks were built with — so backends
     cannot drift apart."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax, dtype,
+                    f"sor_obstacle {imax}x{jmax}")
     import jax
 
     from ..models.poisson import _use_pallas
@@ -387,6 +391,10 @@ def make_dist_obstacle_solver(comm, imax, jmax, jl, il, dx, dy, eps, itermax,
     residuals. The reference's remainder ranks run the identical optimized
     solver (assignment-6/src/comm.c:19-22 sizeOfRank) — this is that
     property for the flag-masked kernel."""
+    from ..utils.precision import check_eps_floor
+
+    check_eps_floor(eps, imax * jmax, dtype,
+                    f"sor_dist_obstacle {imax}x{jmax}")
     from ..parallel.comm import (
         get_offsets,
         halo_exchange,

@@ -131,12 +131,12 @@ def make_masks_3d(fluid_np: np.ndarray, dx, dy, dz, omega, dtype
     w_face = f & np.roll(f, -1, axis=0)
     w_face[-1, :, :] = True
     fi = f[1:-1, 1:-1, 1:-1]
-    eps_e = (f[1:-1, 1:-1, 2:] & fi).astype(np.float64)
-    eps_w = (f[1:-1, 1:-1, :-2] & fi).astype(np.float64)
-    eps_n = (f[1:-1, 2:, 1:-1] & fi).astype(np.float64)
-    eps_s = (f[1:-1, :-2, 1:-1] & fi).astype(np.float64)
-    eps_b = (f[2:, 1:-1, 1:-1] & fi).astype(np.float64)
-    eps_f = (f[:-2, 1:-1, 1:-1] & fi).astype(np.float64)
+    eps_e = (f[1:-1, 1:-1, 2:] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
+    eps_w = (f[1:-1, 1:-1, :-2] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
+    eps_n = (f[1:-1, 2:, 1:-1] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
+    eps_s = (f[1:-1, :-2, 1:-1] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
+    eps_b = (f[2:, 1:-1, 1:-1] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
+    eps_f = (f[:-2, 1:-1, 1:-1] & fi).astype(np.float64)  # lint: allow(dtype-policy) host-side mask coeffs
     idx2, idy2, idz2 = 1.0 / (dx * dx), 1.0 / (dy * dy), 1.0 / (dz * dz)
     denom = ((eps_e + eps_w) * idx2 + (eps_n + eps_s) * idy2
              + (eps_b + eps_f) * idz2)

@@ -20,6 +20,28 @@ _DTYPES = {
 }
 
 
+# Convergence-feeding reductions whose accumulation order is an
+# ACKNOWLEDGED precision trade rather than an oversight. Keyed by
+# "<source file basename>:<accumulator dtype>" — the file names the
+# reduction's home and the dtype names the trade, while staying stable
+# under line churn. preccheck's reduction-order audit (the static twin
+# of the fused-vs-ladder hazard the eps-floor caveat documents) requires
+# every reduce feeding a convergence predicate to be f64-accumulated OR
+# declared here; an undeclared sub-f64 accumulation fails the lint with
+# the reduce's file:line. Declare sparingly, with a why.
+DECLARED_ORDER_SENSITIVE = {
+    # the SOR residual accumulation: the solve deliberately accumulates
+    # the residual at res_dtype = promote(dtype, f32) so bf16 lanes
+    # don't re-quantize the convergence scalar (models/poisson.py's
+    # carry comment) — the eps-floor check prices the resulting
+    # summation-order noise. One key per reduction home: the jnp rb
+    # sweep, the tblock kernel, and the 3-D jnp solve.
+    "sor.py:float32",
+    "sor_pallas.py:float32",
+    "ns3d.py:float32",
+}
+
+
 def residual_floor(ncells: int, dtype) -> float:
     """The smallest L2-style residual a reduced-precision solve can
     reliably distinguish from zero: machine epsilon scaled by the RMS
@@ -27,10 +49,12 @@ def residual_floor(ncells: int, dtype) -> float:
     residual is summation-order noise — two algebraically identical
     cycles (ladder vs fused) legitimately disagree on whether `eps` was
     reached, so an A/B at such an eps compares tail behaviour, not
-    speed (the ROADMAP "eps at the f32 floor" footgun). f64 returns 0.0:
-    no practical .par eps sits near its floor."""
-    if jnp.dtype(dtype) == jnp.dtype(jnp.float32):
-        return float(jnp.finfo(jnp.float32).eps) * float(ncells) ** 0.5
+    speed (the ROADMAP "eps at the f32 floor" footgun). Any sub-f64
+    float (f32, bf16, f16) has a floor; f64 returns 0.0: no practical
+    .par eps sits near its floor."""
+    dt = jnp.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.floating) and jnp.finfo(dt).bits < 64:
+        return float(jnp.finfo(dt).eps) * float(ncells) ** 0.5
     return 0.0
 
 
@@ -63,7 +87,25 @@ def check_eps_floor(eps: float, ncells: int, dtype, where: str) -> bool:
     return True
 
 
-def resolve_dtype(name: str):
+def cast(x, dtype, why: str):
+    """The DECLARED downcast: every intentional narrowing conversion in
+    library code routes through here, wrapped in a
+    `precision.cast.<why>` named scope. preccheck's dtype-lattice pass
+    reads that scope off the convert eqn's name stack (the same
+    convention the comm census uses for `halo_exchange.*`): a narrowing
+    convert under the scope is censused by its `why`; one without it is
+    an IMPLICIT downcast and fails the lint. `why` is a short token
+    ("metrics", "storage", "smoother") — it becomes the census key."""
+    with jax.named_scope(f"precision.cast.{why}"):
+        return jnp.asarray(x).astype(dtype)
+
+
+def resolve_dtype(name: str, record_key: str | None = None):
+    """Resolve a `tpu_dtype` .par value to the compute dtype. With
+    `record_key` ("<family>_dtype"), the decision is recorded through
+    `utils/dispatch.record` like every other knob, so MULTICHIP dryrun
+    snapshots carry the per-family dtype decision and
+    `check_artifact.lint_dispatch_snapshot` can require it."""
     try:
         dt = _DTYPES[name]
     except KeyError:
@@ -77,5 +119,17 @@ def resolve_dtype(name: str):
         warnings.warn(
             "tpu_dtype float64 requested but jax_enable_x64 is off; using float32"
         )
-        return jnp.float32
+        dt = jnp.float32
+        if record_key is not None:
+            from . import dispatch as _dispatch
+
+            _dispatch.record(
+                record_key,
+                f"float32 (tpu_dtype={name}, jax_enable_x64 off)")
+        return dt
+    if record_key is not None:
+        from . import dispatch as _dispatch
+
+        _dispatch.record(record_key,
+                         f"{jnp.dtype(dt).name} (tpu_dtype={name})")
     return dt

@@ -401,6 +401,12 @@ def test_committed_baseline_current():
     for entry in baseline["comm"].values():
         assert set(entry) >= {"collectives", "ppermute_bytes", "strips",
                               "halo"}
+    # so does the precision census (ISSUE 20: the cast contract is
+    # committed alongside)
+    assert set(baseline["precision"]) == set(baseline["configs"])
+    for entry in baseline["precision"].values():
+        assert set(entry) >= {"dtype", "float_dtypes", "casts",
+                              "narrowing", "reductions"}
     # and it passes the shared artifact lint (the one import spelling the
     # other suites use — don't load the module under a second name)
     from tools import check_artifact as ca

@@ -1,0 +1,416 @@
+"""Preccheck (pampi_tpu/analysis/preccheck.py + the prec driver pass) —
+ISSUE 20 acceptance:
+
+- CAST CENSUS: a traced subset round-trips through the `precision`
+  baseline (update -> check clean -> update byte-stable); a declared
+  `precision.cast(x, dtype, why)` downcast is censused under its @why
+  scope and passes; an implicit downcast fails at its file:line.
+- ORACLE PURITY: the committed f64 parity oracles carry zero sub-f64
+  compute; a smuggled `.astype(float32)` in an oracle trace fails with
+  both the purity and the implicit-cast rule at the seeded line.
+- REDUCTION ORDER: an f32 `jnp.sum` feeding a while convergence
+  predicate fails at its file:line unless its '<file>:<dtype>' key is
+  declared in `precision.DECLARED_ORDER_SENSITIVE`.
+- EPS FLOOR: the matrix-wide static (eps, ncells, dtype) check fires
+  when eps sits within a decade of the dtype residual floor; the bf16
+  advisory scouts report it as an advisory note, not a violation.
+- BASELINE DRIFT: a tampered precision baseline fails with the per-key
+  src->dst census diff; `--only prec --update` through the driver
+  preserves the configs/comm sections byte-identically.
+- AST dtype-policy: raw `.astype(<literal>)` / `jnp.float64(...)` /
+  `dtype=<literal>` inside models/ops builders is flagged; the
+  per-line allow escape and non-builder/non-solver trees are exempt.
+- ARTIFACT LINT: a truncated or gutted precision section of
+  CONTRACTS.json is a lint error; a dispatch-snapshot `*_dtype` record
+  must lead with the resolved float dtype.
+
+Compile cost: everything TRACES (make_jaxpr) — no jit execution.
+"""
+
+import json
+import os
+import types
+
+import pytest
+
+from pampi_tpu.analysis import astlint, commcheck, jaxprcheck, preccheck
+from pampi_tpu.utils import precision
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+THIS = os.path.basename(__file__)
+
+
+# ---------------------------------------------------------------------------
+# shared traces
+# ---------------------------------------------------------------------------
+
+def _subset():
+    keep = {"ns2d_jnp", "ns2d_dist_jnp", "ns2d_bf16_sor"}
+    return [c for c in jaxprcheck.standard_configs() if c.name in keep]
+
+
+@pytest.fixture(scope="module")
+def prec_traced():
+    """One traced subset shared by the precision tests (each config is a
+    solver build — don't pay it per test): an f64 oracle, a dist chunk
+    with an f64 convergence reduction, and a bf16 advisory scout."""
+    return jaxprcheck.trace_matrix(_subset())
+
+
+def _stub(fn, *args, dtype=None, oracle=False, advisory=False,
+          params=None):
+    """A hand-built TracedConfig over a tiny function — the mutation
+    harness (the real matrix never contains the seeded defect)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = jaxprcheck.ChunkConfig("seeded", "ns2d", dict(params or {}),
+                                 oracle=oracle, advisory=advisory)
+    solver = types.SimpleNamespace(dtype=jnp.dtype(dtype or jnp.float64))
+    return types.SimpleNamespace(cfg=cfg, solver=solver,
+                                 jaxpr=jax.make_jaxpr(fn)(*args),
+                                 decisions={})
+
+
+# ---------------------------------------------------------------------------
+# census round trip + committed-matrix properties
+# ---------------------------------------------------------------------------
+
+def test_prec_roundtrip_stable(prec_traced):
+    """update -> check clean -> update again byte-stable (the precision
+    section --update contract)."""
+    vs, fresh, notes = preccheck.run(traced=prec_traced, update=True)
+    assert vs == [], [str(v) for v in vs]
+    vs, _, _ = preccheck.run(baseline=fresh, traced=prec_traced)
+    assert vs == [], [str(v) for v in vs]
+    _, again, _ = preccheck.run(traced=prec_traced, update=True)
+    assert json.dumps(again, sort_keys=True) == json.dumps(
+        fresh, sort_keys=True)
+
+
+def test_oracle_configs_pure_f64(prec_traced):
+    """The jnp parity oracle traces ONLY f64 float compute — the
+    property the mixed-precision knob must never break."""
+    oracle = next(t for t in prec_traced if t.cfg.name == "ns2d_jnp")
+    assert oracle.cfg.oracle
+    assert preccheck.subf64_sites(oracle.jaxpr.jaxpr) == []
+    entry, _, _ = preccheck.config_entry(oracle)
+    assert entry["float_dtypes"] == ["float64"]
+    assert entry["narrowing"] == 0
+
+
+def test_advisory_scout_census_pinned(prec_traced):
+    """The bf16 scout's entry prices the future mixed-precision lane:
+    bf16 compute, a non-empty narrowing census, and the f32 residual
+    accumulation declared in DECLARED_ORDER_SENSITIVE."""
+    scout = next(t for t in prec_traced
+                 if t.cfg.name == "ns2d_bf16_sor")
+    assert scout.cfg.advisory
+    entry, _, _ = preccheck.config_entry(scout)
+    assert entry["dtype"] == "bfloat16"
+    assert entry["advisory"] is True
+    assert entry["narrowing"] > 0
+    assert "bfloat16" in entry["float_dtypes"]
+    assert "sor.py:float32" in entry["reductions"]
+    assert "sor.py:float32" in precision.DECLARED_ORDER_SENSITIVE
+
+
+# ---------------------------------------------------------------------------
+# mutation: the four rules
+# ---------------------------------------------------------------------------
+
+def test_smuggled_astype_in_oracle_flagged():
+    """A f32 detour smuggled into an f64 oracle fails BOTH ways: the
+    purity rule and the implicit-downcast ban, each at the seeded
+    file:line."""
+    import jax.numpy as jnp
+
+    def leaky(x):
+        y = x.astype(jnp.float32) * 2.0  # the smuggled narrow compute
+        return y.astype(jnp.float64)
+
+    t = _stub(leaky, jnp.zeros((4,), jnp.float64), oracle=True)
+    vs, _, notes = preccheck.check_config(t, None, True)
+    assert notes == []
+    rules = {v.rule for v in vs}
+    assert preccheck.RULE_ORACLE in rules
+    assert preccheck.RULE_CAST in rules
+    for v in vs:
+        assert THIS in v.message and ":" in v.message
+    cast = next(v for v in vs if v.rule == preccheck.RULE_CAST)
+    assert "float64 -> float32" in cast.message
+    assert "precision.cast" in cast.message
+
+
+def test_declared_cast_censused_not_flagged():
+    """The same downcast routed through utils/precision.cast carries its
+    why on the census key and passes the ban."""
+    import jax.numpy as jnp
+
+    def declared(x):
+        y = precision.cast(x, jnp.float32, "metrics")
+        return y.astype(jnp.float64)
+
+    t = _stub(declared, jnp.zeros((4,), jnp.float64))
+    vs, entry, _ = preccheck.check_config(t, None, True)
+    assert [v for v in vs if v.rule == preccheck.RULE_CAST] == []
+    assert entry["casts"].get("float64->float32@metrics") == 1
+    assert entry["narrowing"] == 1
+
+
+def test_undeclared_convergence_reduction_flagged(monkeypatch):
+    """An f32 sum feeding a while convergence predicate is the fused-vs-
+    ladder hazard class: flagged at its file:line unless the
+    '<file>:<dtype>' trade is declared in the registry."""
+    import jax
+    import jax.numpy as jnp
+
+    def solve(x):
+        def cond(c):
+            i, r, _ = c
+            return (r > jnp.float32(1e-6)) & (i < 10)
+
+        def body(c):
+            i, _, x = c
+            x = x * jnp.float32(0.5)
+            return i + 1, jnp.sum(x * x), x
+
+        return jax.lax.while_loop(cond, body,
+                                  (0, jnp.float32(1e9), x))
+
+    t = _stub(solve, jnp.ones((8,), jnp.float32), dtype=jnp.float32)
+    monkeypatch.setattr(precision, "DECLARED_ORDER_SENSITIVE",
+                        frozenset())
+    vs, entry, _ = preccheck.check_config(t, None, True)
+    red = [v for v in vs if v.rule == preccheck.RULE_REDUCE]
+    assert len(red) == 1
+    assert THIS in red[0].message
+    assert f"{THIS}:float32" in red[0].message
+    assert entry["reductions"] == {f"{THIS}:float32": 1}
+    # declaring the trade (with a why, in code review) clears it
+    monkeypatch.setattr(precision, "DECLARED_ORDER_SENSITIVE",
+                        frozenset({f"{THIS}:float32"}))
+    vs, _, _ = preccheck.check_config(t, None, True)
+    assert [v for v in vs if v.rule == preccheck.RULE_REDUCE] == []
+
+
+def test_f64_convergence_reduction_passes():
+    """An f64-accumulated residual needs no declaration — the audit
+    gates only sub-f64 order-sensitive accumulation."""
+    import jax
+    import jax.numpy as jnp
+
+    def solve(x):
+        def cond(c):
+            i, r, _ = c
+            return (r > 1e-12) & (i < 10)
+
+        def body(c):
+            i, _, x = c
+            x = x * 0.5
+            return i + 1, jnp.sum(x * x), x
+
+        return jax.lax.while_loop(cond, body, (0, jnp.float64(1e9), x))
+
+    t = _stub(solve, jnp.ones((8,), jnp.float64))
+    vs, entry, _ = preccheck.check_config(t, None, True)
+    assert [v for v in vs if v.rule == preccheck.RULE_REDUCE] == []
+    assert "float64" in "".join(entry["reductions"]) \
+        or entry["reductions"] == {f"{THIS}:float64": 1}
+
+
+def test_eps_floor_static_check():
+    """The build-time check_eps_floor warning, generalized: a sub-f64
+    config whose eps sits within a decade of the residual floor fails
+    statically; an advisory config reports the same finding as a note."""
+    import jax.numpy as jnp
+
+    params = dict(eps=1e-7, imax=64, jmax=64)
+    t = _stub(lambda x: x * 2, jnp.ones((4,), jnp.float32),
+              dtype=jnp.float32, params=params)
+    vs, _, notes = preccheck.check_config(t, None, True)
+    floor = [v for v in vs if v.rule == preccheck.RULE_FLOOR]
+    assert len(floor) == 1
+    assert "residual floor" in floor[0].message
+    # an f64 config at the same eps is safely above its (zero) floor
+    t64 = _stub(lambda x: x * 2, jnp.ones((4,), jnp.float64),
+                params=params)
+    vs, _, _ = preccheck.check_config(t64, None, True)
+    assert [v for v in vs if v.rule == preccheck.RULE_FLOOR] == []
+    # the advisory spelling: same finding, reported not gated
+    ta = _stub(lambda x: x * 2, jnp.ones((4,), jnp.float32),
+               dtype=jnp.float32, params=params, advisory=True)
+    vs, _, notes = preccheck.check_config(ta, None, True)
+    assert vs == []
+    assert any(f"[{preccheck.RULE_FLOOR}]" in n for n in notes)
+
+
+def test_bf16_scout_floor_advisory(prec_traced):
+    """The real bf16 scout at 16x16 sits UNDER its ~0.12 residual floor
+    with the standard eps — exactly the price the advisory lane exists
+    to report before the tpu_dtype knob lands."""
+    vs, _, notes = preccheck.run(traced=prec_traced, update=True)
+    assert vs == []
+    floor_notes = [n for n in notes
+                   if f"[{preccheck.RULE_FLOOR}]" in n]
+    assert any(n.startswith("ns2d_bf16_sor:") for n in floor_notes)
+
+
+# ---------------------------------------------------------------------------
+# mutation: baseline drift
+# ---------------------------------------------------------------------------
+
+def test_tampered_precision_baseline_diffed(prec_traced):
+    """A hand-edited cast census fails with the per-key src->dst diff
+    (and the fresh sites' file:line), not a bare hash mismatch."""
+    _, fresh, _ = preccheck.run(traced=prec_traced, update=True)
+    tampered = json.loads(json.dumps(fresh))
+    entry = tampered["ns2d_bf16_sor"]
+    key = next(k for k in entry["casts"] if "->bfloat16@" in k)
+    entry["casts"][key] += 2
+    vs, _, _ = preccheck.run(baseline=tampered, traced=prec_traced)
+    drift = [v for v in vs if v.rule == preccheck.RULE_BASELINE]
+    assert len(drift) == 1
+    assert "ns2d_bf16_sor" in drift[0].message
+    assert key in drift[0].message and "->" in drift[0].message
+    assert "--update" in drift[0].message
+
+
+def test_missing_baseline_entry_flagged(prec_traced):
+    """A config added without --update fails (no silent fresh-trace
+    fallback once a precision baseline exists)."""
+    _, fresh, _ = preccheck.run(traced=prec_traced, update=True)
+    fresh.pop("ns2d_dist_jnp")
+    vs, _, _ = preccheck.run(baseline=fresh, traced=prec_traced)
+    missing = [v for v in vs if v.rule == preccheck.RULE_BASELINE]
+    assert any("ns2d_dist_jnp" in v.message and "--update" in v.message
+               for v in missing)
+
+
+def test_env_mismatch_census_not_compared(prec_traced):
+    """A baseline from another toolchain skips the census comparison
+    (the jaxpr pass owns the one env-drift violation) but still runs
+    the precision rules."""
+    _, fresh, _ = preccheck.run(traced=prec_traced, update=True)
+    tampered = json.loads(json.dumps(fresh))
+    tampered["ns2d_jnp"]["casts"] = {"float64->float32@implicit": 99}
+    vs, _, _ = preccheck.run(baseline=tampered, traced=prec_traced,
+                             env_matches=False)
+    assert vs == [], [str(v) for v in vs]
+
+
+def test_driver_prec_update_preserves_other_sections(tmp_path,
+                                                     prec_traced):
+    """`--only prec --update` through the driver regenerates ONLY the
+    precision section: configs/comm ride through byte-identically and
+    the rewrite is a no-op diff on an already-current baseline."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint as lint_mod
+    finally:
+        sys.path.pop(0)
+
+    _, configs_fresh = jaxprcheck.run(traced=prec_traced, update=True)
+    _, comm_fresh = commcheck.run(traced=prec_traced, update=True)
+    _, prec_fresh, _ = preccheck.run(traced=prec_traced, update=True)
+    full = dict(configs_fresh, comm=comm_fresh, precision=prec_fresh)
+    path = tmp_path / "CONTRACTS.json"
+    path.write_text(json.dumps(full, indent=1, sort_keys=True) + "\n")
+    before = path.read_text()
+
+    ctx = lint_mod.TraceContext(str(path), update=True)
+    ctx._traced = prec_traced
+    vs = ctx.run_prec()
+    assert vs == [], [str(v) for v in vs]
+    assert ctx.fresh_configs is None and ctx.fresh_comm is None
+    ctx.write()
+    assert path.read_text() == before
+
+
+# ---------------------------------------------------------------------------
+# astlint dtype-policy
+# ---------------------------------------------------------------------------
+
+def _lint_src(tmp_path, src, name):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(src)
+    vs, err = astlint.lint_file(str(path), root=str(tmp_path))
+    assert err is None
+    return [v for v in vs if v.rule == astlint.DTYPE_POLICY]
+
+
+def test_dtype_policy_flags_builder_literals(tmp_path):
+    """Raw dtype spellings inside a solver/ops builder are flagged per
+    line; the same code outside a builder (host-side setup) is not."""
+    src = ("import jax.numpy as jnp\n"
+           "def make_solver_fn(x):\n"
+           "    a = x.astype(jnp.float32)\n"
+           "    b = jnp.float64(2.0)\n"
+           "    c = jnp.zeros((2,), dtype='float32')\n"
+           "    d = x.astype(jnp.float32)  # lint: allow(dtype-policy) t\n"
+           "    return a, b, c, d\n"
+           "def helper(x):\n"
+           "    return x.astype(jnp.float32)\n")
+    vs = _lint_src(tmp_path, src, "pampi_tpu/ops/seeded.py")
+    assert [v.line for v in vs] == [3, 4, 5]
+    assert "resolve_dtype" in vs[0].message \
+        or "precision" in vs[0].message
+    # the same file outside the policy dirs is exempt by location
+    vs = _lint_src(tmp_path, src, "pampi_tpu/utils/seeded.py")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# artifact lint: the precision section + dtype dispatch records
+# ---------------------------------------------------------------------------
+
+def test_artifact_lint_precision_section(prec_traced):
+    """A truncated or gutted precision section of CONTRACTS.json is a
+    lint error, not a silent no-op."""
+    from tools import check_artifact as ca
+
+    _, configs_fresh = jaxprcheck.run(traced=prec_traced, update=True)
+    _, comm_fresh = commcheck.run(traced=prec_traced, update=True)
+    _, prec_fresh, _ = preccheck.run(traced=prec_traced, update=True)
+    full = dict(configs_fresh, comm=comm_fresh, precision=prec_fresh)
+    assert ca.lint_contracts(full) == []
+    # a missing section fails outright
+    gone = {k: v for k, v in full.items() if k != "precision"}
+    assert any("precision" in e for e in ca.lint_contracts(gone))
+    # a dropped config breaks the same-matrix invariant
+    broken = json.loads(json.dumps(full))
+    broken["precision"].popitem()
+    assert any(".precision" in e for e in ca.lint_contracts(broken))
+    # a gutted entry loses its census keys
+    broken2 = json.loads(json.dumps(full))
+    next(iter(broken2["precision"].values())).pop("casts")
+    assert any("casts" in e for e in ca.lint_contracts(broken2))
+
+
+def test_dispatch_snapshot_dtype_record_linted():
+    """The resolve_dtype record in a dryrun tail must lead with the
+    float dtype it resolved to — a raw knob echo is a lint error."""
+    from tools import check_artifact as ca
+
+    ok = "dispatch snapshot: {'ns2d_dtype': 'bfloat16 (tpu_dtype=bf16)'}"
+    assert ca.lint_dispatch_snapshot(ok, "M") == []
+    bad = "dispatch snapshot: {'ns2d_dtype': 'bf16'}"
+    errs = ca.lint_dispatch_snapshot(bad, "M")
+    assert errs and "ns2d_dtype" in errs[0]
+
+
+def test_resolve_dtype_records_decision():
+    """utils/precision.resolve_dtype streams the resolved dtype into the
+    dispatch probe under its record_key (satellite c)."""
+    from pampi_tpu.utils import dispatch
+
+    dt = precision.resolve_dtype("bf16", record_key="seeded_dtype")
+    import jax.numpy as jnp
+
+    assert jnp.dtype(dt) == jnp.dtype(jnp.bfloat16)
+    rec = dispatch.snapshot().get("seeded_dtype", "")
+    assert rec.startswith("bfloat16")
+    assert "tpu_dtype=bf16" in rec

@@ -51,13 +51,16 @@ Contracts:
   chunks, records}; when the PR 4 resilience blocks are present,
   `recoveries`/`retries` must be lists of records and `ckpt` a
   save/rotate/load/reject count map.
-- CONTRACTS: {version, env, configs, comm} with env naming the trace
-  environment (jax/x64/backend), every config entry carrying the
-  jaxprcheck signature keys ({hash, outvars, pallas_calls, prims,
-  dispatch}), and every comm entry the commcheck census keys
-  ({collectives, ppermute_bytes, strips, halo}) over the SAME config
-  set — a hand-edited or truncated baseline would otherwise turn the
-  trace-identity or collective contract into a silent no-op.
+- CONTRACTS: {version, env, configs, comm, precision} with env naming
+  the trace environment (jax/x64/backend), every config entry carrying
+  the jaxprcheck signature keys ({hash, outvars, pallas_calls, prims,
+  dispatch}), every comm entry the commcheck census keys
+  ({collectives, ppermute_bytes, strips, halo}) and every precision
+  entry the preccheck census keys ({dtype, float_dtypes, casts,
+  narrowing, reductions}) — comm and precision over the SAME config
+  set as configs — a hand-edited or truncated baseline would
+  otherwise turn the trace-identity, collective or precision-flow
+  contract into a silent no-op.
 """
 
 from __future__ import annotations
@@ -494,6 +497,10 @@ def lint_bench(d: dict, where: str = "BENCH") -> list[str]:
 # the per-family overlap dispatch keys the dryrun snapshot records
 # (utils/dispatch.resolve_overlap); values are overlap-/serial-tagged
 OVERLAP_SNAPSHOT_KEYS = ("overlap_ns2d_dist", "overlap_ns3d_dist")
+# the dtype resolutions utils/precision.resolve_dtype records
+# (ISSUE 20): every *_dtype snapshot value must lead with the resolved
+# float dtype name so the record is lintable
+DTYPE_SNAPSHOT_VALUES = ("float64", "float32", "float16", "bfloat16")
 
 
 def lint_dispatch_snapshot(tail: str, where: str) -> list[str]:
@@ -502,8 +509,9 @@ def lint_dispatch_snapshot(tail: str, where: str) -> list[str]:
     BOTH dist families must be present with an overlap|serial-tagged
     value — a dryrun that exercised one family's overlap knob but
     silently skipped the other would otherwise read as covered.
-    Pre-overlap artifacts (no overlap_* key in the snapshot) pass
-    unchanged."""
+    Likewise every *_dtype resolution (utils/precision.resolve_dtype)
+    must lead with the float dtype it resolved to. Pre-overlap /
+    pre-dtype artifacts (no such key in the snapshot) pass unchanged."""
     m = re.search(r"dispatch snapshot: (\{.*\})", tail)
     if not m:
         return []
@@ -511,10 +519,18 @@ def lint_dispatch_snapshot(tail: str, where: str) -> list[str]:
         snap = ast.literal_eval(m.group(1))
     except (ValueError, SyntaxError):
         return [f"{where}.tail: dispatch snapshot line unparseable"]
-    if not isinstance(snap, dict) \
-            or not any(str(k).startswith("overlap_") for k in snap):
+    if not isinstance(snap, dict):
         return []
     errs = []
+    for key in snap:
+        if str(key).endswith("_dtype"):
+            val = str(snap.get(key, "") or "")
+            if not val.startswith(DTYPE_SNAPSHOT_VALUES):
+                errs.append(
+                    f"{where}.tail snapshot: {key} does not lead with "
+                    f"a resolved float dtype ({val!r})")
+    if not any(str(k).startswith("overlap_") for k in snap):
+        return errs
     for key in OVERLAP_SNAPSHOT_KEYS:
         val = str(snap.get(key, "") or "")
         if not val.startswith(("overlap", "serial")):
@@ -535,17 +551,21 @@ def lint_multichip(d: dict, where: str = "MULTICHIP") -> list[str]:
     return errs
 
 
-CONTRACTS_REQUIRED = ("version", "env", "configs", "comm")
+CONTRACTS_REQUIRED = ("version", "env", "configs", "comm", "precision")
 CONTRACTS_ENV = ("jax", "x64", "backend")
 CONTRACTS_ENTRY = ("hash", "outvars", "pallas_calls", "prims", "dispatch")
 # the commcheck census entry (analysis/commcheck.config_entry): a
 # truncated comm section would silently no-op the collective contract
 CONTRACTS_COMM_ENTRY = ("collectives", "ppermute_bytes", "strips", "halo")
+# the preccheck census entry (analysis/preccheck.config_entry): same
+# reasoning — a gutted precision entry would no-op the cast contract
+CONTRACTS_PREC_ENTRY = ("dtype", "float_dtypes", "casts", "narrowing",
+                        "reductions")
 
 
 def lint_contracts(d: dict, where: str = "CONTRACTS") -> list[str]:
-    """The analysis/jaxprcheck + commcheck baseline shape (see module
-    docstring)."""
+    """The analysis/jaxprcheck + commcheck + preccheck baseline shape
+    (see module docstring)."""
     errs = _missing(d, CONTRACTS_REQUIRED, where)
     env = d.get("env")
     if isinstance(env, dict):
@@ -584,6 +604,27 @@ def lint_contracts(d: dict, where: str = "CONTRACTS") -> list[str]:
                         f"{where}.configs")
     elif "comm" in d:
         errs.append(f"{where}.comm: not a dict")
+    prec = d.get("precision")
+    if isinstance(prec, dict):
+        if not prec:
+            errs.append(f"{where}.precision: empty")
+        for name, entry in prec.items():
+            if not isinstance(entry, dict):
+                errs.append(f"{where}.precision.{name}: not a dict")
+                continue
+            errs += _missing(entry, CONTRACTS_PREC_ENTRY,
+                             f"{where}.precision.{name}")
+            for key in ("casts", "reductions"):
+                if key in entry and not isinstance(entry[key], dict):
+                    errs.append(
+                        f"{where}.precision.{name}.{key}: not a dict")
+        # the precision census describes the same matrix as configs
+        if isinstance(configs, dict) and configs \
+                and set(prec) != set(configs):
+            errs.append(f"{where}.precision: config set differs from "
+                        f"{where}.configs")
+    elif "precision" in d:
+        errs.append(f"{where}.precision: not a dict")
     return errs
 
 

@@ -3,7 +3,7 @@
     python tools/lint.py [--only PASS[,PASS...]] [--update]
                          [--contracts PATH] [--vmem-budget BYTES] [paths...]
 
-Five analysis passes plus the artifact lint (all by default, `make lint`):
+Six analysis passes plus the artifact lint (all by default, `make lint`):
 
   ast        repo lint rules over pampi_tpu/, tools/, tests/ (or the
              given paths) — file:line diagnostics, `# lint: allow(<rule>)`
@@ -19,6 +19,12 @@ Five analysis passes plus the artifact lint (all by default, `make lint`):
              (analysis/commcheck.py); `--update` regenerates
   pallas     pallas_call block tiling, static VMEM footprint vs budget,
              grid×index-map bounds, aliasing (analysis/palcheck.py)
+  prec       precision-flow contracts: the cast census vs the
+             `precision` section of CONTRACTS.json, the implicit-
+             downcast ban, f64 oracle purity, the reduction-order audit
+             and the matrix-wide eps-floor check; advisory (bf16 scout)
+             findings are reported on stderr, not gated
+             (analysis/preccheck.py); `--update` regenerates
   artifacts  the committed BENCH/MULTICHIP/CONTRACTS schema lint
              (tools/check_artifact.py) — CI, the test suite and this
              driver share the one analysis layer
@@ -27,9 +33,11 @@ Five analysis passes plus the artifact lint (all by default, `make lint`):
              best earlier same-backend point — a perf-regressing PR
              fails on CPU before any TPU time is spent
 
-The jaxpr/comm/pallas passes share ONE trace of the config matrix per
-run (`jaxprcheck.trace_matrix`). `--only comm` is the overlap refactor's
-inner loop (`make lint-comm`): the comm contract alone, one matrix trace.
+The jaxpr/comm/pallas/prec passes share ONE trace of the config matrix
+per run (`jaxprcheck.trace_matrix`). `--only comm` is the overlap
+refactor's inner loop (`make lint-comm`): the comm contract alone, one
+matrix trace; `--only prec` (`make lint-prec`) is the mixed-precision
+twin.
 
 The trace passes pin their environment (CPU backend, x64, 8 host devices
 — the test harness environment) BEFORE importing jax, so the committed
@@ -51,8 +59,9 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CONTRACTS = os.path.join(REPO, "CONTRACTS.json")
 
-PASSES = ("ast", "halo", "jaxpr", "comm", "pallas", "artifacts", "trend")
-TRACE_PASSES = ("jaxpr", "comm", "pallas")
+PASSES = ("ast", "halo", "jaxpr", "comm", "pallas", "prec", "artifacts",
+          "trend")
+TRACE_PASSES = ("jaxpr", "comm", "pallas", "prec")
 
 # the pinned trace environment — must precede any jax import
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -142,6 +151,7 @@ class TraceContext:
         self.fresh_configs = None
         self.fresh_env = None
         self.fresh_comm = None
+        self.fresh_prec = None
 
     def traced(self):
         if self._traced is None:
@@ -194,6 +204,30 @@ class TraceContext:
 
         return palcheck.run(traced=self.traced(), budget=budget)
 
+    def run_prec(self) -> list:
+        from pampi_tpu.analysis import jaxprcheck, preccheck
+
+        base_prec = (self.baseline or {}).get("precision")
+        if base_prec is None and self.baseline is not None \
+                and not self.update:
+            print("prec: baseline has no precision section — tracing "
+                  "fresh (run with --update to commit one)",
+                  file=sys.stderr)
+        env_matches = self.env_matches()
+        if base_prec is not None and not env_matches and not self.update:
+            print("prec: baseline environment differs — cast census not "
+                  "compared (precision rules still checked; regenerate "
+                  "with tools/lint.py --update)", file=sys.stderr)
+        violations, fresh, notes = preccheck.run(
+            baseline=base_prec, update=self.update, traced=self.traced(),
+            env_matches=env_matches)
+        for note in notes:
+            print(f"prec advisory: {note}", file=sys.stderr)
+        self.fresh_prec = fresh
+        if self.fresh_env is None:
+            self.fresh_env = jaxprcheck.environment()
+        return violations
+
     def write(self) -> None:
         """Merge the fresh sections over the on-disk baseline and write
         once. Sections whose pass did not run this invocation are
@@ -202,17 +236,21 @@ class TraceContext:
         `env` key and silently defeat env-drift detection, so the
         missing section is regenerated from the shared matrix too (the
         traces are already in memory; only the bookkeeping re-runs)."""
-        from pampi_tpu.analysis import commcheck, jaxprcheck
+        from pampi_tpu.analysis import commcheck, jaxprcheck, preccheck
 
         env_changed = (self.baseline or {}).get("env") != self.fresh_env
         if env_changed and self.baseline is not None:
-            if self.fresh_configs is None and self.fresh_comm is not None:
+            any_fresh = any(f is not None for f in (
+                self.fresh_configs, self.fresh_comm, self.fresh_prec))
+            if any_fresh and self.fresh_configs is None:
                 _, fresh = jaxprcheck.run(update=True, traced=self.traced())
                 self.fresh_configs = fresh["configs"]
-            elif self.fresh_comm is None \
-                    and self.fresh_configs is not None:
+            if any_fresh and self.fresh_comm is None:
                 _, self.fresh_comm = commcheck.run(update=True,
                                                    traced=self.traced())
+            if any_fresh and self.fresh_prec is None:
+                _, self.fresh_prec, _ = preccheck.run(update=True,
+                                                      traced=self.traced())
         merged = dict(self.baseline or {})
         merged["version"] = jaxprcheck.BASELINE_VERSION
         if self.fresh_env is not None:
@@ -221,11 +259,14 @@ class TraceContext:
             merged["configs"] = self.fresh_configs
         if self.fresh_comm is not None:
             merged["comm"] = self.fresh_comm
+        if self.fresh_prec is not None:
+            merged["precision"] = self.fresh_prec
         with open(self.path, "w") as fh:
             json.dump(merged, fh, indent=1, sort_keys=True)
             fh.write("\n")
         sections = [s for s, fresh in (("configs", self.fresh_configs),
-                                       ("comm", self.fresh_comm))
+                                       ("comm", self.fresh_comm),
+                                       ("precision", self.fresh_prec))
                     if fresh is not None]
         print(f"baseline written to {self.path} "
               f"(sections regenerated: {', '.join(sections)})")
@@ -238,7 +279,8 @@ def main(argv) -> int:
                          + ",".join(PASSES))
     ap.add_argument("--update", action="store_true",
                     help="regenerate the CONTRACTS.json baseline "
-                         "(configs/comm sections of the passes run)")
+                         "(configs/comm/precision sections of the "
+                         "passes run)")
     ap.add_argument("--contracts", default=CONTRACTS)
     ap.add_argument("--vmem-budget", type=int, default=None,
                     help="override the pallas pass VMEM budget in bytes "
@@ -279,6 +321,8 @@ def main(argv) -> int:
             vs = ctx.run_comm()
         elif name == "pallas":
             vs = ctx.run_pallas(args.vmem_budget)
+        elif name == "prec":
+            vs = ctx.run_prec()
         elif name == "trend":
             vs = run_trend()
         else:
